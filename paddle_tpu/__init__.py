@@ -1,0 +1,14 @@
+"""paddle_tpu — a TPU-native deep learning framework with the capability
+surface of PaddlePaddle Fluid (reference: /root/reference, Fluid 0.14).
+
+Programs are built with the fluid API (``paddle_tpu.fluid``), compiled
+whole-block to XLA, and executed on TPU.  See SURVEY.md for the layer map.
+"""
+
+__version__ = '0.1.0'
+
+from . import fluid  # noqa: F401
+from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
+
+__all__ = ['fluid', 'reader', 'dataset']

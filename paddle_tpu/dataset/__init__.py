@@ -1,0 +1,14 @@
+"""Datasets (reference: python/paddle/dataset/).
+
+The reference downloads real corpora; this build (zero-egress environment)
+provides deterministic synthetic generators with the same reader-creator
+signatures so every book/benchmark model runs unmodified.  Real-data loaders
+can be pointed at local files.
+"""
+
+from . import mnist  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import imdb  # noqa: F401
+from . import cifar  # noqa: F401
+
+__all__ = ['mnist', 'uci_housing', 'imdb', 'cifar']

@@ -1,0 +1,42 @@
+"""CIFAR-shaped dataset (reference: python/paddle/dataset/cifar.py).
+
+Synthetic 3x32x32 images with class-dependent colour/structure statistics.
+Sample format matches the reference: (3072-float32 flattened image, int64
+label)."""
+
+import numpy as np
+
+__all__ = ['train10', 'test10', 'train100', 'test100']
+
+_IMG = 3 * 32 * 32
+
+
+def _reader_creator(seed, n, num_classes):
+    def reader():
+        rng0 = np.random.RandomState(123)
+        templates = rng0.uniform(-1, 1, size=(num_classes, _IMG)).astype(
+            'float32')
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rng.randint(0, num_classes))
+            img = templates[label] + 0.4 * rng.standard_normal(_IMG).astype(
+                'float32')
+            yield np.clip(img, -1, 1).astype('float32'), label
+
+    return reader
+
+
+def train10(n=2048):
+    return _reader_creator(21, n, 10)
+
+
+def test10(n=512):
+    return _reader_creator(22, n, 10)
+
+
+def train100(n=2048):
+    return _reader_creator(23, n, 100)
+
+
+def test100(n=512):
+    return _reader_creator(24, n, 100)

@@ -1,0 +1,41 @@
+"""IMDB-shaped sentiment dataset (reference: python/paddle/dataset/imdb.py).
+
+Synthetic: two vocab regions are biased positive/negative so an embedding +
+LSTM model genuinely converges.  Sample format matches the reference:
+(list of int64 word ids — variable length, int64 label in {0, 1})."""
+
+import numpy as np
+
+__all__ = ['train', 'test', 'word_dict']
+
+_VOCAB = 5149  # mirrors the reference's imdb.word_dict() size ballpark
+
+
+def word_dict(vocab_size=_VOCAB):
+    return {('w%d' % i): i for i in range(vocab_size)}
+
+
+def _reader_creator(seed, n, vocab_size):
+    def reader():
+        rng = np.random.RandomState(seed)
+        half = vocab_size // 2
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(8, 64))
+            if label == 1:
+                words = rng.randint(0, half, size=length)
+            else:
+                words = rng.randint(half, vocab_size, size=length)
+            yield list(map(int, words)), label
+
+    return reader
+
+
+def train(word_idx=None, n=2000):
+    vocab = len(word_idx) if word_idx else _VOCAB
+    return _reader_creator(13, n, vocab)
+
+
+def test(word_idx=None, n=500):
+    vocab = len(word_idx) if word_idx else _VOCAB
+    return _reader_creator(17, n, vocab)

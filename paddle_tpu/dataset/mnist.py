@@ -1,0 +1,41 @@
+"""MNIST-shaped dataset (reference: python/paddle/dataset/mnist.py).
+
+Synthetic digits: each class is a fixed random template plus noise, so a
+small MLP/LeNet genuinely learns and loss decreases — good enough for the
+book-chapter convergence tests without network access.  Sample format
+matches the reference: (784-float32 image in [-1, 1], int64 label).
+"""
+
+import numpy as np
+
+__all__ = ['train', 'test', 'IMAGE_SIZE', 'NUM_CLASSES']
+
+IMAGE_SIZE = 784
+NUM_CLASSES = 10
+
+
+def _templates(seed=42):
+    rng = np.random.RandomState(seed)
+    return rng.uniform(-1.0, 1.0, size=(NUM_CLASSES, IMAGE_SIZE)).astype(
+        'float32')
+
+
+def _reader_creator(num_samples, seed):
+    def reader():
+        templates = _templates()
+        rng = np.random.RandomState(seed)
+        for _ in range(num_samples):
+            label = int(rng.randint(0, NUM_CLASSES))
+            img = templates[label] + 0.35 * rng.standard_normal(
+                IMAGE_SIZE).astype('float32')
+            yield np.clip(img, -1.0, 1.0).astype('float32'), label
+
+    return reader
+
+
+def train(num_samples=2048):
+    return _reader_creator(num_samples, seed=7)
+
+
+def test(num_samples=512):
+    return _reader_creator(num_samples, seed=11)

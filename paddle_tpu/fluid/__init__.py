@@ -1,0 +1,44 @@
+"""paddle_tpu.fluid — the TPU-native Fluid-compatible frontend.
+
+Re-designed from the reference python/paddle/fluid/__init__.py: the same
+program-building API, but every program block compiles to XLA and runs on
+TPU (fluid.TPUPlace()) instead of per-op CPU/CUDA kernels.
+"""
+
+from . import core
+from .core import (CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace, LoDTensor,
+                   Scope, is_compiled_with_tpu, is_compiled_with_cuda)
+from . import framework
+from .framework import (Program, Operator, Variable, Parameter,
+                        default_main_program, default_startup_program,
+                        program_guard, name_scope)
+from . import executor
+from .executor import Executor, global_scope, scope_guard
+from . import initializer
+from . import layers
+from . import nets
+from . import optimizer
+from . import backward
+from .backward import append_backward, calc_gradient, gradients
+from . import regularizer
+from . import clip
+from .clip import (ErrorClipByValue, GradientClipByValue, GradientClipByNorm,
+                   GradientClipByGlobalNorm)
+from .param_attr import ParamAttr, WeightNormParamAttr
+from . import unique_name
+from .data_feeder import DataFeeder
+from . import io
+from .io import (save_vars, save_params, save_persistables, load_vars,
+                 load_params, load_persistables, save_inference_model,
+                 load_inference_model, get_inference_program)
+from . import metrics
+from . import profiler
+
+Tensor = LoDTensor
+
+__all__ = framework.__all__ + executor.__all__ + [
+    'io', 'initializer', 'layers', 'nets', 'optimizer', 'backward',
+    'regularizer', 'LoDTensor', 'CPUPlace', 'TPUPlace', 'CUDAPlace',
+    'CUDAPinnedPlace', 'Tensor', 'ParamAttr', 'WeightNormParamAttr',
+    'DataFeeder', 'clip', 'profiler', 'unique_name',
+]

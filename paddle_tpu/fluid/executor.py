@@ -1,0 +1,273 @@
+"""Executor: compiles whole program blocks to XLA and runs them on TPU.
+
+The reference Executor interprets a ProgramDesc op-by-op, dispatching a
+CPU/CUDA kernel per op with per-op InferShape (framework/executor.cc:321-339).
+That design wastes a TPU: launch overhead per op, no fusion, host round-trips.
+This Executor instead:
+
+  1. partitions the block (currently: whole block) and traces every op's
+     XLA lowering into ONE jitted function;
+  2. threads persistable state (params, optimizer slots, BN stats) in and out
+     functionally — the analog of in-place Scope variables;
+  3. caches compiled executables keyed by (program version, feed signature,
+     fetch list) — the analog of the reference's ExecutorPrepareContext +
+     program cache (python/paddle/fluid/executor.py:283);
+  4. falls back to eager op-by-op execution for programs containing host ops
+     (save/load/print/reader) — those run unfused but with identical
+     semantics.
+
+API parity: Executor(place), run(program, feed, fetch_list, ...) matching
+python/paddle/fluid/executor.py:256.
+"""
+
+import numpy as np
+
+from . import core
+from .framework import default_main_program, Variable
+from ..ops import registry
+
+__all__ = ['Executor', 'global_scope', 'scope_guard', '_switch_scope']
+
+global_scope = core.global_scope
+_scope_stack = [core.global_scope()]
+
+
+def _current_scope():
+    return _scope_stack[-1]
+
+
+def _switch_scope(scope):
+    _scope_stack[-1] = scope
+    return _scope_stack[-1]
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+def _is_host_op(op):
+    # host ops (save/load/print/readers) register in the host-op registry;
+    # any op with a host impl forces the eager (unfused) execution path
+    return registry.is_host_op_type(op.type)
+
+
+def as_numpy(value):
+    if isinstance(value, core.LoDTensor):
+        return value.numpy()
+    return np.asarray(value)
+
+
+def _to_device_value(value, var_desc, device):
+    import jax
+    if isinstance(value, jax.Array):
+        # already on device (the common case for state after step 1):
+        # avoid the device->host->device round trip
+        try:
+            if device in value.devices():
+                return value
+        except Exception:
+            pass
+        return jax.device_put(value, device)
+    if isinstance(value, core.LoDTensor):
+        value = value.numpy()
+    arr = np.asarray(value)
+    if var_desc is not None and arr.dtype != var_desc.np_dtype:
+        # feeding python lists/floats: trust the declared dtype
+        if np.issubdtype(arr.dtype, np.floating) and np.issubdtype(
+                var_desc.np_dtype, np.floating):
+            arr = arr.astype(var_desc.np_dtype)
+    return jax.device_put(arr, device)
+
+
+class _CompiledBlock(object):
+    """One jitted XLA executable for a (program, feed-sig, fetch) triple."""
+
+    def __init__(self, program, block_idx, feed_names, fetch_names, place,
+                 scope):
+        import jax
+        self.program = program
+        self.block = program.block(block_idx)
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        self.place = place
+        block = self.block
+
+        ops = [op for op in block.ops if op.type not in ('feed', 'fetch')]
+        self.ops = ops
+
+        # Walk program order to find which persistable vars must come from
+        # the scope (read-before-write) and which are written.
+        defined = set(self.feed_names)
+        state_in = []
+        state_out = []
+        for op in ops:
+            for name in op.input_arg_names:
+                if name in defined or name in state_in:
+                    continue
+                v = block._find_var_recursive(name)
+                if v is not None and v.persistable:
+                    state_in.append(name)
+                    defined.add(name)
+            for name in op.output_arg_names:
+                v = block._find_var_recursive(name)
+                if v is not None and v.persistable and name not in state_out:
+                    state_out.append(name)
+                defined.add(name)
+        # fetching a persistable var that no op writes still needs its value
+        for name in self.fetch_names:
+            if name not in defined:
+                v = block._find_var_recursive(name)
+                if v is not None and v.persistable:
+                    state_in.append(name)
+                    defined.add(name)
+        self.state_in = state_in
+        self.state_out = state_out
+
+        fetch_names_ = self.fetch_names
+        state_out_ = state_out
+
+        def fn(state, feeds, rng):
+            env = {}
+            env.update(state)
+            env.update(feeds)
+            ctx = registry.LoweringContext(block, env, rng_key=rng,
+                                           place=place)
+            for op in ops:
+                registry.run_op(ctx, op)
+            new_state = {n: env[n] for n in state_out_ if n in env}
+            fetches = [env[n] for n in fetch_names_]
+            return new_state, fetches
+
+        self._fn = fn
+        # donate state buffers only when the block actually updates state
+        # (in-place param update semantics without the copy)
+        donate = (0, ) if state_out else ()
+        self._jit = jax.jit(fn, donate_argnums=donate)
+
+    def _run_eager(self, scope, state, feeds, rng):
+        """Unfused op-by-op execution for blocks containing host ops
+        (save/load/print/readers) — identical semantics, no jit."""
+        env = {}
+        env.update(state)
+        env.update(feeds)
+        ctx = registry.LoweringContext(
+            self.block, env, rng_key=rng, place=self.place)
+        ctx.scope = scope
+        for op in self.ops:
+            host_impl = registry.get_host_op(op.type)
+            if host_impl is not None:
+                host_impl(ctx, op, scope)
+            else:
+                registry.run_op(ctx, op)
+        new_state = {n: env[n] for n in self.state_out if n in env}
+        fetches = [env[n] for n in self.fetch_names]
+        return new_state, fetches
+
+    def run(self, scope, feed_values, rng_key, eager=False):
+        device = self.place.jax_device()
+        state = {}
+        for name in self.state_in:
+            var = scope.find_var(name)
+            if var is None or var.value() is None:
+                raise RuntimeError(
+                    'persistable var %r is not initialized in scope — '
+                    'did you run the startup program?' % name)
+            state[name] = _to_device_value(
+                var.value(), self.block._find_var_recursive(name), device)
+        feeds = {
+            n: _to_device_value(v, self.block._find_var_recursive(n), device)
+            for n, v in feed_values.items()
+        }
+        if eager:
+            new_state, fetches = self._run_eager(scope, state, feeds, rng_key)
+        else:
+            new_state, fetches = self._jit(state, feeds, rng_key)
+        for name, val in new_state.items():
+            scope.var(name).set_value(val)
+        return fetches
+
+
+class Executor(object):
+    """Program runner (reference executor.py:256 / executor.cc:125)."""
+
+    _CACHE_MAX = 64  # LRU bound; each entry pins its Program (stable ids)
+
+    def __init__(self, place=None):
+        import collections
+        self.place = place if place is not None else core.CPUPlace()
+        self._cache = collections.OrderedDict()
+        self._rng = None
+        self._closed = False
+
+    def _next_rng(self, program):
+        import jax
+        if self._rng is None:
+            self._rng = jax.random.PRNGKey(program.random_seed or 0)
+        self._rng, key = jax.random.split(self._rng)
+        return key
+
+    def as_lodtensor(self, data):
+        return core.LoDTensor(np.asarray(data))
+
+    def run(self,
+            program=None,
+            feed=None,
+            fetch_list=None,
+            feed_var_name='feed',
+            fetch_var_name='fetch',
+            scope=None,
+            return_numpy=True,
+            use_program_cache=False):
+        if self._closed:
+            raise RuntimeError('Attempted to use a closed Executor')
+        program = program if program is not None else default_main_program()
+        scope = scope if scope is not None else _current_scope()
+        feed = feed if feed is not None else {}
+        fetch_list = fetch_list if fetch_list is not None else []
+        if isinstance(fetch_list, (Variable, str)):
+            fetch_list = [fetch_list]
+        fetch_names = [
+            f.name if isinstance(f, Variable) else str(f) for f in fetch_list
+        ]
+        feed_arrays = {}
+        for name, value in feed.items():
+            if isinstance(value, core.LoDTensor):
+                feed_arrays[name] = value
+            else:
+                feed_arrays[name] = np.asarray(value)
+
+        sig = tuple(
+            (n, tuple(np.shape(as_numpy(v))), str(as_numpy(v).dtype))
+            for n, v in sorted(feed_arrays.items()))
+        key = (id(program), program._version, tuple(fetch_names), sig,
+               self.place, id(scope))
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = _CompiledBlock(program, 0, [n for n, _, _ in sig],
+                                      fetch_names, self.place, scope)
+            self._cache[key] = compiled
+            if len(self._cache) > self._CACHE_MAX:
+                self._cache.popitem(last=False)
+        else:
+            self._cache.move_to_end(key)
+
+        eager = any(_is_host_op(op) for op in compiled.ops)
+        rng = self._next_rng(program)
+        fetches = compiled.run(scope, feed_arrays, rng, eager=eager)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [core.LoDTensor(np.asarray(f)) for f in fetches]
+
+    def close(self):
+        """Reference Executor.Close() notifies pservers (executor.h:51); here
+        it just drops the compile cache."""
+        self._cache = {}
+        self._closed = True

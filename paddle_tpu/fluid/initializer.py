@@ -1,0 +1,253 @@
+"""Parameter initializers (reference: python/paddle/fluid/initializer.py).
+
+Each initializer appends an init op (fill_constant / uniform_random /
+gaussian_random) to the startup program block holding the parameter — the
+same program-as-initialization design as the reference.
+"""
+
+import numpy as np
+
+from . import framework
+
+__all__ = [
+    'Constant', 'Uniform', 'Normal', 'TruncatedNormal', 'Xavier', 'MSRA',
+    'Bilinear', 'force_init_on_cpu', 'init_on_cpu',
+    'ConstantInitializer', 'UniformInitializer', 'NormalInitializer',
+    'TruncatedNormalInitializer', 'XavierInitializer', 'MSRAInitializer',
+    'BilinearInitializer', 'NumpyArrayInitializer',
+]
+
+_force_init_on_cpu_ = False
+
+
+def force_init_on_cpu():
+    return _force_init_on_cpu_
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def init_on_cpu():
+    global _force_init_on_cpu_
+    prev = _force_init_on_cpu_
+    _force_init_on_cpu_ = True
+    yield
+    _force_init_on_cpu_ = prev
+
+
+class Initializer(object):
+    def __init__(self):
+        pass
+
+    def __call__(self, param, block):
+        raise NotImplementedError()
+
+    @staticmethod
+    def _compute_fans(var):
+        shape = var.shape
+        if not shape:
+            return 1, 1
+        if len(shape) == 2:
+            return shape[0], shape[1]
+        receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+        fan_in = shape[1] * receptive if len(shape) > 1 else shape[0]
+        fan_out = shape[0] * receptive
+        return fan_in, fan_out
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value=0.0, force_cpu=False):
+        super(ConstantInitializer, self).__init__()
+        self._value = value
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type='fill_constant',
+            outputs={'Out': [var.name]},
+            attrs={
+                'shape': list(var.shape),
+                'dtype': var.dtype,
+                'value': float(self._value)
+            })
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, low=-1.0, high=1.0, seed=0):
+        super(UniformInitializer, self).__init__()
+        self._low = low
+        self._high = high
+        self._seed = seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type='uniform_random',
+            outputs={'Out': [var.name]},
+            attrs={
+                'shape': list(var.shape),
+                'dtype': var.dtype,
+                'min': self._low,
+                'max': self._high,
+                'seed': self._seed
+            })
+
+
+class NormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        super(NormalInitializer, self).__init__()
+        self._mean = loc
+        self._std_dev = scale
+        self._seed = seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type='gaussian_random',
+            outputs={'Out': [var.name]},
+            attrs={
+                'shape': list(var.shape),
+                'dtype': var.dtype,
+                'mean': self._mean,
+                'std': self._std_dev,
+                'seed': self._seed
+            })
+
+
+class TruncatedNormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        super(TruncatedNormalInitializer, self).__init__()
+        self._mean = loc
+        self._std_dev = scale
+        self._seed = seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type='truncated_gaussian_random',
+            outputs={'Out': [var.name]},
+            attrs={
+                'shape': list(var.shape),
+                'dtype': var.dtype,
+                'mean': self._mean,
+                'std': self._std_dev,
+                'seed': self._seed
+            })
+
+
+class XavierInitializer(Initializer):
+    """Glorot init (reference initializer.py XavierInitializer)."""
+
+    def __init__(self, uniform=True, fan_in=None, fan_out=None, seed=0):
+        super(XavierInitializer, self).__init__()
+        self._uniform = uniform
+        self._fan_in = fan_in
+        self._fan_out = fan_out
+        self._seed = seed
+
+    def __call__(self, var, block):
+        f_in, f_out = self._compute_fans(var)
+        fan_in = f_in if self._fan_in is None else self._fan_in
+        fan_out = f_out if self._fan_out is None else self._fan_out
+        if self._uniform:
+            limit = np.sqrt(6.0 / (fan_in + fan_out))
+            return block.append_op(
+                type='uniform_random',
+                outputs={'Out': [var.name]},
+                attrs={
+                    'shape': list(var.shape),
+                    'dtype': var.dtype,
+                    'min': -limit,
+                    'max': limit,
+                    'seed': self._seed
+                })
+        std = np.sqrt(2.0 / (fan_in + fan_out))
+        return block.append_op(
+            type='gaussian_random',
+            outputs={'Out': [var.name]},
+            attrs={
+                'shape': list(var.shape),
+                'dtype': var.dtype,
+                'mean': 0.0,
+                'std': std,
+                'seed': self._seed
+            })
+
+
+class MSRAInitializer(Initializer):
+    """He/Kaiming init (reference initializer.py MSRAInitializer)."""
+
+    def __init__(self, uniform=True, fan_in=None, seed=0):
+        super(MSRAInitializer, self).__init__()
+        self._uniform = uniform
+        self._fan_in = fan_in
+        self._seed = seed
+
+    def __call__(self, var, block):
+        f_in, _ = self._compute_fans(var)
+        fan_in = f_in if self._fan_in is None else self._fan_in
+        if self._uniform:
+            limit = np.sqrt(6.0 / fan_in)
+            return block.append_op(
+                type='uniform_random',
+                outputs={'Out': [var.name]},
+                attrs={
+                    'shape': list(var.shape),
+                    'dtype': var.dtype,
+                    'min': -limit,
+                    'max': limit,
+                    'seed': self._seed
+                })
+        std = np.sqrt(2.0 / fan_in)
+        return block.append_op(
+            type='gaussian_random',
+            outputs={'Out': [var.name]},
+            attrs={
+                'shape': list(var.shape),
+                'dtype': var.dtype,
+                'mean': 0.0,
+                'std': std,
+                'seed': self._seed
+            })
+
+
+class BilinearInitializer(Initializer):
+    """Bilinear upsampling kernel init for conv2d_transpose
+    (reference initializer.py BilinearInitializer)."""
+
+    def __call__(self, var, block):
+        shape = var.shape
+        if len(shape) != 4:
+            raise ValueError('BilinearInitializer needs a 4-D weight')
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        weight = np.zeros(shape, dtype=np.float32)
+        size = int(np.prod(shape))
+        vals = np.zeros(size, dtype=np.float32)
+        for i in range(size):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            vals[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return NumpyArrayInitializer(vals.reshape(shape))(var, block)
+
+
+class NumpyArrayInitializer(Initializer):
+    def __init__(self, value):
+        super(NumpyArrayInitializer, self).__init__()
+        self._value = np.asarray(value)
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type='assign_value',
+            outputs={'Out': [var.name]},
+            attrs={
+                'shape': list(self._value.shape),
+                'dtype': var.dtype,
+                'values': self._value,
+            })
+
+
+Constant = ConstantInitializer
+Uniform = UniformInitializer
+Normal = NormalInitializer
+TruncatedNormal = TruncatedNormalInitializer
+Xavier = XavierInitializer
+MSRA = MSRAInitializer
+Bilinear = BilinearInitializer

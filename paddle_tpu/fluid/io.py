@@ -1,0 +1,198 @@
+"""Model save/load (reference: python/paddle/fluid/io.py).
+
+The reference implements persistence as programs of ``save``/``load`` ops run
+by the Executor (io.py:89-506, operators/save_op.cc).  Here the same public
+API persists scope tensors directly from the host — params are pulled from
+the device once and written as one ``.npz``-style combined file or one file
+per variable (matching save_vars/save_combine semantics).  The serialized
+inference model keeps the program-is-data contract: ``__model__`` holds the
+serialized program (program_serde), params sit next to it.
+"""
+
+import json
+import os
+
+import numpy as np
+
+from . import core
+from .framework import Program, Parameter, Variable, default_main_program
+from .executor import global_scope
+
+__all__ = [
+    'save_vars', 'save_params', 'save_persistables', 'load_vars',
+    'load_params', 'load_persistables', 'save_inference_model',
+    'load_inference_model', 'get_inference_program',
+]
+
+
+def is_persistable(var):
+    return var.persistable
+
+
+def is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def _scope_value(scope, name):
+    var = scope.find_var(name)
+    if var is None or var.value() is None:
+        raise RuntimeError('variable %r has no value in scope' % name)
+    val = var.value()
+    if isinstance(val, core.LoDTensor):
+        return val.numpy()
+    return np.asarray(val)
+
+
+def _save_one(path, arr):
+    with open(path, 'wb') as f:
+        np.lib.format.write_array(f, np.asarray(arr))
+
+
+def _load_one(path):
+    with open(path, 'rb') as f:
+        return np.lib.format.read_array(f)
+
+
+def save_vars(executor,
+              dirname,
+              main_program=None,
+              vars=None,
+              predicate=None,
+              filename=None):
+    """Save variables matching ``predicate`` (reference io.py:89)."""
+    if vars is None:
+        if main_program is None:
+            main_program = default_main_program()
+        vars = list(filter(predicate, main_program.list_vars()))
+    scope = global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    if filename is None:
+        for var in vars:
+            _save_one(
+                os.path.join(dirname, var.name), _scope_value(scope, var.name))
+    else:
+        # combined file: npz (data-only), analog of save_combine_op
+        blob = {v.name: _scope_value(scope, v.name) for v in vars}
+        with open(os.path.join(dirname, filename), 'wb') as f:
+            np.savez(f, **blob)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    save_vars(
+        executor,
+        dirname,
+        main_program=main_program,
+        vars=None,
+        predicate=is_parameter,
+        filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    save_vars(
+        executor,
+        dirname,
+        main_program=main_program,
+        vars=None,
+        predicate=is_persistable,
+        filename=filename)
+
+
+def load_vars(executor,
+              dirname,
+              main_program=None,
+              vars=None,
+              predicate=None,
+              filename=None):
+    """Load variables into the global scope (reference io.py:295)."""
+    if vars is None:
+        if main_program is None:
+            main_program = default_main_program()
+        vars = list(filter(predicate, main_program.list_vars()))
+    scope = global_scope()
+    if filename is None:
+        for var in vars:
+            arr = _load_one(os.path.join(dirname, var.name))
+            scope.var(var.name).set_value(arr)
+    else:
+        with np.load(os.path.join(dirname, filename),
+                     allow_pickle=False) as blob:
+            for var in vars:
+                scope.var(var.name).set_value(blob[var.name])
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(
+        executor,
+        dirname,
+        main_program=main_program,
+        predicate=is_parameter,
+        filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(
+        executor,
+        dirname,
+        main_program=main_program,
+        predicate=is_persistable,
+        filename=filename)
+
+
+def get_inference_program(target_vars, main_program=None):
+    if main_program is None:
+        main_program = default_main_program()
+    if not isinstance(target_vars, list):
+        target_vars = [target_vars]
+    pruned = main_program.prune(targets=target_vars)
+    return pruned.inference_optimize()
+
+
+def save_inference_model(dirname,
+                         feeded_var_names,
+                         target_vars,
+                         executor,
+                         main_program=None,
+                         model_filename=None,
+                         params_filename=None):
+    """Prune to fetch targets, serialize program + params
+    (reference io.py:561)."""
+    if isinstance(feeded_var_names, str):
+        feeded_var_names = [feeded_var_names]
+    if isinstance(target_vars, Variable):
+        target_vars = [target_vars]
+    if main_program is None:
+        main_program = default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+
+    pruned = main_program.prune(targets=target_vars)
+    inference_program = pruned.inference_optimize()
+    fetch_var_names = [v.name for v in target_vars]
+
+    model_filename = model_filename or '__model__'
+    meta = {
+        'program': inference_program.serialize_to_string().decode('utf-8'),
+        'feed_var_names': list(feeded_var_names),
+        'fetch_var_names': fetch_var_names,
+    }
+    with open(os.path.join(dirname, model_filename), 'w') as f:
+        json.dump(meta, f)
+    save_persistables(executor, dirname, main_program, params_filename)
+    return fetch_var_names
+
+
+def load_inference_model(dirname,
+                         executor,
+                         model_filename=None,
+                         params_filename=None):
+    """Returns (program, feed_target_names, fetch_targets)
+    (reference io.py:677)."""
+    model_filename = model_filename or '__model__'
+    with open(os.path.join(dirname, model_filename), 'r') as f:
+        meta = json.load(f)
+    program = Program.parse_from_string(meta['program'])
+    load_persistables(executor, dirname, program, params_filename)
+    feed_names = meta['feed_var_names']
+    fetch_targets = [
+        program.global_block().var(n) for n in meta['fetch_var_names']
+    ]
+    return program, feed_names, fetch_targets

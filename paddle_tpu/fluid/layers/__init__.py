@@ -1,0 +1,20 @@
+"""Layers package (reference: python/paddle/fluid/layers/__init__.py)."""
+
+from . import nn
+from .nn import *
+from . import io
+from .io import *
+from . import tensor
+from .tensor import *
+from . import ops
+from .ops import *
+from . import metric_op
+from .metric_op import *
+from . import math_op_patch  # installs Variable operator overloads
+
+__all__ = []
+__all__ += nn.__all__
+__all__ += io.__all__
+__all__ += tensor.__all__
+__all__ += ops.__all__
+__all__ += metric_op.__all__

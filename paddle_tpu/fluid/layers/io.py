@@ -1,0 +1,35 @@
+"""Input layers (reference: python/paddle/fluid/layers/io.py)."""
+
+from .. import core
+from ..framework import default_main_program, default_startup_program, \
+    Variable
+from ..layer_helper import LayerHelper
+
+__all__ = ['data']
+
+
+def data(name,
+         shape,
+         append_batch_size=True,
+         dtype='float32',
+         lod_level=0,
+         type=core.VarDesc.VarType.LOD_TENSOR,
+         stop_gradient=True):
+    """Declare a feed variable (reference layers/io.py:38).
+
+    With ``append_batch_size`` the leading dim becomes -1 (batch)."""
+    helper = LayerHelper('data', name=name)
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+
+    data_var = helper.create_global_variable(
+        name=name,
+        shape=shape,
+        dtype=dtype,
+        type=type,
+        stop_gradient=stop_gradient,
+        lod_level=lod_level,
+        is_data=True,
+        persistable=False)
+    return data_var

@@ -1,0 +1,116 @@
+"""Operator-overload sugar for Variable
+(reference: python/paddle/fluid/layers/math_op_patch.py)."""
+
+from .. import core
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from .. import unique_name
+
+__all__ = ['monkey_patch_variable']
+
+
+def monkey_patch_variable():
+    def unique_tmp_name():
+        return unique_name.generate('tmp')
+
+    def current_block(var):
+        return var.block.program.current_block()
+
+    def create_new_tmp_var(block, dtype):
+        return block.create_var(
+            name=unique_tmp_name(), dtype=dtype, persistable=False)
+
+    def create_scalar_op(var, value, op):
+        """var <op> python-scalar via the scale op."""
+        block = current_block(var)
+        out = create_new_tmp_var(block, var.dtype)
+        out.shape = var.shape
+        if op == 'add':
+            attrs = {'scale': 1.0, 'bias': float(value)}
+        elif op == 'radd':
+            attrs = {'scale': 1.0, 'bias': float(value)}
+        elif op == 'sub':
+            attrs = {'scale': 1.0, 'bias': -float(value)}
+        elif op == 'rsub':
+            attrs = {'scale': -1.0, 'bias': float(value)}
+        elif op == 'mul':
+            attrs = {'scale': float(value), 'bias': 0.0}
+        elif op == 'div':
+            attrs = {'scale': 1.0 / float(value), 'bias': 0.0}
+        else:
+            raise ValueError(op)
+        block.append_op(
+            type='scale',
+            inputs={'X': [var]},
+            outputs={'Out': [out]},
+            attrs=attrs)
+        return out
+
+    def binary(op_type, reverse=False):
+        def impl(self, other):
+            if isinstance(other, (int, float)):
+                simple = {
+                    'elementwise_add': 'radd' if reverse else 'add',
+                    'elementwise_sub': 'rsub' if reverse else 'sub',
+                    'elementwise_mul': 'mul',
+                }
+                if op_type in simple:
+                    return create_scalar_op(self, other, simple[op_type])
+                if op_type == 'elementwise_div' and not reverse:
+                    return create_scalar_op(self, other, 'div')
+                # fall back: materialize the scalar as a tensor
+                block = current_block(self)
+                const = create_new_tmp_var(block, self.dtype)
+                const.shape = (1, )
+                block.append_op(
+                    type='fill_constant',
+                    outputs={'Out': [const]},
+                    attrs={
+                        'shape': [1],
+                        'dtype': const.dtype,
+                        'value': float(other)
+                    })
+                other = const
+            block = current_block(self)
+            lhs, rhs = (other, self) if reverse else (self, other)
+            out = create_new_tmp_var(
+                block,
+                lhs.dtype if op_type not in _CMP_OPS else
+                core.VarDesc.VarType.BOOL)
+            out.shape = lhs.shape
+            block.append_op(
+                type=op_type,
+                inputs={'X': [lhs],
+                        'Y': [rhs]},
+                outputs={'Out': [out]},
+                attrs={'axis': -1} if op_type.startswith('elementwise')
+                else {})
+            return out
+
+        return impl
+
+    _CMP_OPS = ('less_than', 'less_equal', 'greater_than', 'greater_equal',
+                'equal', 'not_equal')
+
+    def neg(self):
+        return create_scalar_op(self, 0.0, 'rsub')
+
+    Variable.__add__ = binary('elementwise_add')
+    Variable.__radd__ = binary('elementwise_add', reverse=True)
+    Variable.__sub__ = binary('elementwise_sub')
+    Variable.__rsub__ = binary('elementwise_sub', reverse=True)
+    Variable.__mul__ = binary('elementwise_mul')
+    Variable.__rmul__ = binary('elementwise_mul', reverse=True)
+    Variable.__div__ = binary('elementwise_div')
+    Variable.__truediv__ = binary('elementwise_div')
+    Variable.__rdiv__ = binary('elementwise_div', reverse=True)
+    Variable.__rtruediv__ = binary('elementwise_div', reverse=True)
+    Variable.__pow__ = binary('elementwise_pow')
+    Variable.__lt__ = binary('less_than')
+    Variable.__le__ = binary('less_equal')
+    Variable.__gt__ = binary('greater_than')
+    Variable.__ge__ = binary('greater_equal')
+    Variable.__neg__ = neg
+
+
+monkey_patch_variable()

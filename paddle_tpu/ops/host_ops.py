@@ -1,0 +1,71 @@
+"""Host-side op implementations (run outside XLA, eager path).
+
+The reference runs save/load/print as ordinary kernels inside the Executor's
+interpreter loop (operators/save_op.cc, load_op.cc, print_op.cc).  Here they
+register in the host-op registry: a block containing any of them executes
+eagerly, op by op, with these impls receiving concrete arrays and the Scope.
+"""
+
+import os
+
+import numpy as np
+
+from .registry import register_host_op
+
+
+@register_host_op('print')
+def _print(ctx, op, scope):
+    x = ctx.get(op, 'In')
+    if x is None:
+        x = ctx.get(op, 'X')
+    message = op.attrs.get('message', '')
+    first_n = op.attrs.get('first_n', -1)
+    count = op.attrs.setdefault('__print_count__', 0)
+    if first_n < 0 or count < first_n:
+        arr = np.asarray(x)
+        print('%s %s  shape=%s\n%s' % (message, op.input('In') or
+                                       op.input('X'), arr.shape, arr))
+        op.attrs['__print_count__'] = count + 1
+    out_names = op.output('Out')
+    if out_names and x is not None:
+        ctx.store(out_names[0], x)
+
+
+@register_host_op('save')
+def _save(ctx, op, scope):
+    x = ctx.get(op, 'X')
+    path = op.attrs['file_path']
+    os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+    with open(path, 'wb') as f:
+        np.lib.format.write_array(f, np.asarray(x))
+
+
+@register_host_op('load')
+def _load(ctx, op, scope):
+    path = op.attrs['file_path']
+    with open(path, 'rb') as f:
+        arr = np.lib.format.read_array(f)
+    names = op.output('Out')
+    if names:
+        ctx.store(names[0], arr)
+        scope.var(names[0]).set_value(arr)
+
+
+@register_host_op('save_combine')
+def _save_combine(ctx, op, scope):
+    xs = ctx.get_list(op, 'X')
+    names = op.input('X')
+    path = op.attrs['file_path']
+    os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+    with open(path, 'wb') as f:
+        np.savez(f, **{n: np.asarray(x) for n, x in zip(names, xs)})
+
+
+@register_host_op('load_combine')
+def _load_combine(ctx, op, scope):
+    path = op.attrs['file_path']
+    names = op.output('Out')
+    with np.load(path, allow_pickle=False) as blob:
+        for n in names:
+            ctx.store(n, blob[n])
+            scope.var(n).set_value(blob[n])

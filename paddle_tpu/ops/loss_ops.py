@@ -1,0 +1,154 @@
+"""Loss op lowerings (reference: paddle/fluid/operators/cross_entropy_op.cc,
+softmax_with_cross_entropy_op.cc, and the *_loss_op.cc family)."""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_lowering
+
+_EPS = 1e-12
+
+
+def _index_label(label):
+    """(N,1) or (N,) int labels -> (N,) int32."""
+    if label.ndim > 1 and label.shape[-1] == 1:
+        label = jnp.reshape(label, label.shape[:-1])
+    return label.astype(jnp.int32)
+
+
+@register_lowering('cross_entropy')
+def _cross_entropy(ctx, op):
+    x = ctx.get(op, 'X')  # probabilities (N, C)
+    label = ctx.get(op, 'Label')
+    if op.attrs.get('soft_label', False):
+        loss = -jnp.sum(label * jnp.log(jnp.maximum(x, _EPS)), axis=-1,
+                        keepdims=True)
+    else:
+        idx = _index_label(label)
+        picked = jnp.take_along_axis(x, idx[:, None], axis=-1)
+        loss = -jnp.log(jnp.maximum(picked, _EPS))
+        ignore = op.attrs.get('ignore_index', -100)
+        loss = jnp.where(idx[:, None] == ignore, jnp.zeros_like(loss), loss)
+    ctx.set(op, 'Y', loss)
+
+
+@register_lowering('softmax_with_cross_entropy')
+def _softmax_with_cross_entropy(ctx, op):
+    logits = ctx.get(op, 'Logits')
+    label = ctx.get(op, 'Label')
+    log_p = jax.nn.log_softmax(logits, axis=-1)
+    softmax = jnp.exp(log_p)
+    if op.attrs.get('soft_label', False):
+        loss = -jnp.sum(label * log_p, axis=-1, keepdims=True)
+    else:
+        idx = _index_label(label)
+        loss = -jnp.take_along_axis(log_p, idx[:, None], axis=-1)
+        ignore = op.attrs.get('ignore_index', -100)
+        loss = jnp.where(idx[:, None] == ignore, jnp.zeros_like(loss), loss)
+    ctx.set(op, 'Softmax', softmax)
+    ctx.set(op, 'Loss', loss)
+
+
+@register_lowering('sigmoid_cross_entropy_with_logits')
+def _sigmoid_ce(ctx, op):
+    x = ctx.get(op, 'X')
+    label = ctx.get(op, 'Label')
+    # max(x,0) - x*z + log(1+exp(-|x|)), numerically stable
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ctx.set(op, 'Out', loss)
+
+
+@register_lowering('huber_loss')
+def _huber_loss(ctx, op):
+    x = ctx.get(op, 'X')
+    y = ctx.get(op, 'Y')
+    delta = op.attrs['delta']
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= delta, 0.5 * r * r, delta * (ar - 0.5 * delta))
+    ctx.set(op, 'Residual', r)
+    ctx.set(op, 'Out', loss)
+
+
+@register_lowering('smooth_l1_loss')
+def _smooth_l1(ctx, op):
+    x = ctx.get(op, 'X')
+    y = ctx.get(op, 'Y')
+    sigma = op.attrs.get('sigma', 1.0)
+    in_w = ctx.get(op, 'InsideWeight')
+    out_w = ctx.get(op, 'OutsideWeight')
+    s2 = sigma * sigma
+    d = x - y
+    if in_w is not None:
+        d = d * in_w
+    ad = jnp.abs(d)
+    l = jnp.where(ad < 1.0 / s2, 0.5 * d * d * s2, ad - 0.5 / s2)
+    ctx.set(op, 'Diff', d)
+    if out_w is not None:
+        l = l * out_w
+    ctx.set(op, 'Out', jnp.sum(l, axis=tuple(range(1, l.ndim)),
+                               keepdims=False)[:, None])
+
+
+@register_lowering('log_loss')
+def _log_loss(ctx, op):
+    p = ctx.get(op, 'Predicted')
+    label = ctx.get(op, 'Labels')
+    eps = op.attrs.get('epsilon', 1e-4)
+    loss = -label * jnp.log(p + eps) - (1 - label) * jnp.log(1 - p + eps)
+    ctx.set(op, 'Loss', loss)
+
+
+@register_lowering('hinge_loss')
+def _hinge_loss(ctx, op):
+    logits = ctx.get(op, 'Logits')
+    labels = ctx.get(op, 'Labels')
+    ctx.set(op, 'Loss',
+            jnp.maximum(1.0 - (2.0 * labels - 1.0) * logits, 0.0))
+
+
+@register_lowering('rank_loss')
+def _rank_loss(ctx, op):
+    label = ctx.get(op, 'Label')
+    left = ctx.get(op, 'Left')
+    right = ctx.get(op, 'Right')
+    d = left - right
+    ctx.set(op, 'Out', jnp.log1p(jnp.exp(d)) - label * d)
+
+
+@register_lowering('margin_rank_loss')
+def _margin_rank_loss(ctx, op):
+    label = ctx.get(op, 'Label')
+    x1 = ctx.get(op, 'X1')
+    x2 = ctx.get(op, 'X2')
+    margin = op.attrs.get('margin', 0.0)
+    out = jnp.maximum(-label * (x1 - x2) + margin, 0.0)
+    ctx.set(op, 'Activated', (out > 0).astype(x1.dtype))
+    ctx.set(op, 'Out', out)
+
+
+@register_lowering('modified_huber_loss')
+def _modified_huber_loss(ctx, op):
+    x = ctx.get(op, 'X')
+    y = ctx.get(op, 'Y')
+    z = (2.0 * y - 1.0) * x
+    loss = jnp.where(z < -1.0, -4.0 * z,
+                     jnp.where(z < 1.0, jnp.square(1.0 - z),
+                               jnp.zeros_like(z)))
+    ctx.set(op, 'IntermediateVal', z)
+    ctx.set(op, 'Out', loss)
+
+
+@register_lowering('kldiv_loss')
+def _kldiv_loss(ctx, op):
+    x = ctx.get(op, 'X')  # log-probabilities
+    target = ctx.get(op, 'Target')
+    loss = target * (jnp.log(jnp.maximum(target, _EPS)) - x)
+    reduction = op.attrs.get('reduction', 'mean')
+    if reduction == 'mean':
+        loss = jnp.mean(loss)
+    elif reduction == 'sum':
+        loss = jnp.sum(loss)
+    elif reduction == 'batchmean':
+        loss = jnp.sum(loss) / x.shape[0]
+    ctx.set(op, 'Loss', loss)

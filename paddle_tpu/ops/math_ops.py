@@ -1,0 +1,232 @@
+"""Math op lowerings: matmul family, elementwise broadcast family, reductions.
+
+Reference kernels: paddle/fluid/operators/mul_op.cc, matmul_op.cc,
+elementwise_*_op.cc (broadcast semantics in elementwise_op_function.h),
+reduce_*_op.cc, sum_op.cc, scale_op.cc, clip_op.cc.  On TPU these all lower
+to jnp/lax inside one compiled block; matmuls hit the MXU.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register_lowering
+
+
+def _flatten_2d(x, num_col_dims):
+    """Flatten leading num_col_dims axes into rows, rest into cols
+    (mul_op's x_num_col_dims semantics)."""
+    rows = int(np.prod(x.shape[:num_col_dims])) if num_col_dims > 0 else 1
+    return jnp.reshape(x, (rows, -1))
+
+
+@register_lowering('mul')
+def _mul(ctx, op):
+    x = ctx.get(op, 'X')
+    y = ctx.get(op, 'Y')
+    xn = op.attrs.get('x_num_col_dims', 1)
+    yn = op.attrs.get('y_num_col_dims', 1)
+    x2 = _flatten_2d(x, xn)
+    y2 = _flatten_2d(y, yn)
+    out = x2 @ y2
+    out_shape = tuple(x.shape[:xn]) + tuple(y.shape[yn:])
+    ctx.set(op, 'Out', jnp.reshape(out, out_shape))
+
+
+@register_lowering('matmul')
+def _matmul(ctx, op):
+    x = ctx.get(op, 'X')
+    y = ctx.get(op, 'Y')
+    tx = op.attrs.get('transpose_X', False)
+    ty = op.attrs.get('transpose_Y', False)
+    alpha = op.attrs.get('alpha', 1.0)
+    # fluid matmul: 1-D inputs get promoted; batch dims broadcast
+    squeeze_front = squeeze_back = False
+    if x.ndim == 1:
+        x = x[None, :]
+        squeeze_front = True
+    if y.ndim == 1:
+        y = y[:, None]
+        squeeze_back = True
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * jnp.asarray(alpha, out.dtype)
+    if squeeze_front:
+        out = jnp.squeeze(out, -2)
+    if squeeze_back:
+        out = jnp.squeeze(out, -1)
+    ctx.set(op, 'Out', out)
+
+
+def _bcast_y(x, y, axis):
+    """Reference broadcast: Y's shape aligns into X starting at `axis`
+    (elementwise_op_function.h); axis=-1 aligns trailing dims."""
+    if x.shape == y.shape:
+        return y
+    # trim trailing 1s of y (fluid allows y shape (C,1,1) matching mid dims)
+    yshape = list(y.shape)
+    while yshape and yshape[-1] == 1 and len(yshape) > 1:
+        yshape = yshape[:-1]
+    if axis == -1 or axis is None:
+        axis = x.ndim - len(yshape)
+    new_shape = [1] * axis + yshape + [1] * (x.ndim - axis - len(yshape))
+    return jnp.reshape(y, new_shape)
+
+
+def _register_elementwise(name, fn):
+    @register_lowering('elementwise_' + name)
+    def _lower(ctx, op, fn=fn):
+        x = ctx.get(op, 'X')
+        y = ctx.get(op, 'Y')
+        axis = op.attrs.get('axis', -1)
+        y = _bcast_y(x, y, axis)
+        ctx.set(op, 'Out', fn(x, y))
+
+
+_register_elementwise('add', jnp.add)
+_register_elementwise('sub', jnp.subtract)
+_register_elementwise('mul', jnp.multiply)
+_register_elementwise('div', jnp.divide)
+_register_elementwise('max', jnp.maximum)
+_register_elementwise('min', jnp.minimum)
+_register_elementwise('pow', jnp.power)
+_register_elementwise('mod', jnp.mod)
+_register_elementwise('floordiv', jnp.floor_divide)
+
+
+@register_lowering('sum')
+def _sum(ctx, op):
+    xs = ctx.get_list(op, 'X')
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    ctx.set(op, 'Out', out)
+
+
+@register_lowering('scale')
+def _scale(ctx, op):
+    x = ctx.get(op, 'X')
+    scale = jnp.asarray(op.attrs.get('scale', 1.0), x.dtype)
+    bias = jnp.asarray(op.attrs.get('bias', 0.0), x.dtype)
+    if op.attrs.get('bias_after_scale', True):
+        out = x * scale + bias
+    else:
+        out = (x + bias) * scale
+    ctx.set(op, 'Out', out)
+
+
+@register_lowering('mean')
+def _mean(ctx, op):
+    # fluid MeanOp fixes the output dim to {1} (operators/mean_op.cc)
+    x = ctx.get(op, 'X')
+    ctx.set(op, 'Out', jnp.reshape(jnp.mean(x), (1, )))
+
+
+def _reduce_dims(x, op):
+    if op.attrs.get('reduce_all', False):
+        return None
+    dim = op.attrs.get('dim', [0])
+    if isinstance(dim, int):
+        dim = [dim]
+    return tuple(d % x.ndim for d in dim)
+
+
+def _register_reduce(name, fn):
+    @register_lowering('reduce_' + name)
+    def _lower(ctx, op, fn=fn):
+        x = ctx.get(op, 'X')
+        dims = _reduce_dims(x, op)
+        keep = op.attrs.get('keep_dim', False)
+        out = fn(x, axis=dims, keepdims=keep)
+        if dims is None and not keep:
+            out = jnp.reshape(out, (1, ))  # fluid keeps rank-1 [1] output
+        ctx.set(op, 'Out', out)
+
+
+_register_reduce('sum', jnp.sum)
+_register_reduce('mean', jnp.mean)
+_register_reduce('max', jnp.max)
+_register_reduce('min', jnp.min)
+_register_reduce('prod', jnp.prod)
+
+
+@register_lowering('clip')
+def _clip(ctx, op):
+    x = ctx.get(op, 'X')
+    lo = op.attrs.get('min', float('-inf'))
+    hi = op.attrs.get('max', float('inf'))
+    ctx.set(op, 'Out', jnp.clip(x, lo, hi))
+
+
+@register_lowering('clip_by_norm')
+def _clip_by_norm(ctx, op):
+    x = ctx.get(op, 'X')
+    max_norm = op.attrs['max_norm']
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12),
+                      jnp.ones((), x.dtype))
+    ctx.set(op, 'Out', x * scale)
+
+
+@register_lowering('squared_l2_norm')
+def _squared_l2_norm(ctx, op):
+    x = ctx.get(op, 'X')
+    ctx.set(op, 'Out', jnp.reshape(jnp.sum(jnp.square(x)), (1, )))
+
+
+@register_lowering('squared_l2_distance')
+def _squared_l2_distance(ctx, op):
+    x = ctx.get(op, 'X')
+    y = ctx.get(op, 'Y')
+    sub = x - y
+    ctx.set(op, 'sub_result', sub)
+    ctx.set(op, 'Out', jnp.sum(jnp.square(sub), axis=-1, keepdims=True))
+
+
+@register_lowering('cumsum')
+def _cumsum(ctx, op):
+    x = ctx.get(op, 'X')
+    axis = op.attrs.get('axis', -1)
+    exclusive = op.attrs.get('exclusive', False)
+    reverse = op.attrs.get('reverse', False)
+    if reverse:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if exclusive:
+        out = out - x
+    if reverse:
+        out = jnp.flip(out, axis)
+    ctx.set(op, 'Out', out)
+
+
+@register_lowering('pow')
+def _pow(ctx, op):
+    x = ctx.get(op, 'X')
+    ctx.set(op, 'Out', jnp.power(x, op.attrs.get('factor', 1.0)))
+
+
+@register_lowering('sign')
+def _sign(ctx, op):
+    ctx.set(op, 'Out', jnp.sign(ctx.get(op, 'X')))
+
+
+@register_lowering('l1_norm')
+def _l1_norm(ctx, op):
+    x = ctx.get(op, 'X')
+    ctx.set(op, 'Out', jnp.sum(jnp.abs(x)))
+
+
+@register_lowering('norm')
+def _norm(ctx, op):
+    x = ctx.get(op, 'X')
+    axis = op.attrs.get('axis', -1)
+    eps = op.attrs.get('epsilon', 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    ctx.set(op, 'Norm', norm)
+    ctx.set(op, 'Out', x / norm)

@@ -1,0 +1,62 @@
+"""Metric op lowerings (reference: paddle/fluid/operators/accuracy_op.cc,
+auc_op.cc, precision_recall_op.cc)."""
+
+import jax.numpy as jnp
+
+from .registry import register_lowering
+
+
+@register_lowering('accuracy')
+def _accuracy(ctx, op):
+    indices = ctx.get(op, 'Indices')  # (N, k) from top_k
+    label = ctx.get(op, 'Label')  # (N, 1) int64
+    if label.ndim == 1:
+        label = label[:, None]
+    hit = jnp.any(indices == label.astype(indices.dtype), axis=1)
+    correct = jnp.sum(hit.astype(jnp.int32))
+    total = jnp.asarray(indices.shape[0], jnp.int32)
+    ctx.set(op, 'Accuracy',
+            jnp.reshape(correct.astype(jnp.float32) / total, (1, )))
+    ctx.set(op, 'Correct', jnp.reshape(correct, (1, )))
+    ctx.set(op, 'Total', jnp.reshape(total, (1, )))
+
+
+@register_lowering('auc')
+def _auc(ctx, op):
+    probs = ctx.get(op, 'Predict')
+    if probs is None:
+        probs = ctx.get(op, 'Out')
+    label = jnp.reshape(ctx.get(op, 'Label'), (-1, ))
+    num_thresholds = op.attrs.get('num_thresholds', 200)
+    pos_prob = probs[:, -1] if probs.ndim > 1 else probs
+    thresholds = jnp.linspace(0.0, 1.0, num_thresholds)
+    pos = (label > 0)
+    # (T, N) comparisons
+    pred_pos = pos_prob[None, :] >= thresholds[:, None]
+    tp = jnp.sum(pred_pos & pos[None, :], axis=1).astype(jnp.float64)
+    fp = jnp.sum(pred_pos & ~pos[None, :], axis=1).astype(jnp.float64)
+    fn = jnp.sum(~pred_pos & pos[None, :], axis=1).astype(jnp.float64)
+    tn = jnp.sum(~pred_pos & ~pos[None, :], axis=1).astype(jnp.float64)
+    tpr = tp / jnp.maximum(tp + fn, 1e-12)
+    fpr = fp / jnp.maximum(fp + tn, 1e-12)
+    # trapezoid over descending thresholds (ROC)
+    auc = jnp.sum((fpr[:-1] - fpr[1:]) * (tpr[:-1] + tpr[1:]) / 2.0)
+    ctx.set(op, 'AUC', jnp.reshape(jnp.abs(auc).astype(jnp.float32), (1, )))
+
+
+@register_lowering('precision_recall')
+def _precision_recall(ctx, op):
+    # per-class precision/recall/F1 for multi-class classification
+    indices = jnp.reshape(ctx.get(op, 'Indices'), (-1, ))
+    label = jnp.reshape(ctx.get(op, 'Labels'), (-1, ))
+    cls_num = op.attrs['class_number']
+    pred_oh = (indices[:, None] == jnp.arange(cls_num)[None, :])
+    label_oh = (label[:, None] == jnp.arange(cls_num)[None, :])
+    tp = jnp.sum(pred_oh & label_oh, axis=0).astype(jnp.float32)
+    fp = jnp.sum(pred_oh & ~label_oh, axis=0).astype(jnp.float32)
+    fn = jnp.sum(~pred_oh & label_oh, axis=0).astype(jnp.float32)
+    precision = tp / jnp.maximum(tp + fp, 1e-12)
+    recall = tp / jnp.maximum(tp + fn, 1e-12)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-12)
+    ctx.set(op, 'BatchMetrics',
+            jnp.stack([jnp.mean(precision), jnp.mean(recall), jnp.mean(f1)]))

@@ -1,0 +1,439 @@
+"""NN op lowerings: conv, pooling, normalization, dropout, embedding.
+
+Reference kernels: paddle/fluid/operators/conv_op.cc (+conv_cudnn_op.cu),
+pool_op.cc, batch_norm_op.cc, layer_norm_op.cc, dropout_op.cc,
+lookup_table_op.cc, lrn_op.cc.  Convs lower to lax.conv_general_dilated —
+XLA tiles them onto the MXU; layouts are left to the compiler rather than
+hand-picking NCHW/NHWC kernels like the cuDNN path does.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register_lowering, register_grad_lowering, fwd_structure
+
+_CONV_DN = ('NCHW', 'OIHW', 'NCHW')
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v, v]
+
+
+@register_lowering('conv2d')
+def _conv2d(ctx, op):
+    x = ctx.get(op, 'Input')
+    w = ctx.get(op, 'Filter')
+    strides = _pair(op.attrs.get('strides', [1, 1]))
+    paddings = _pair(op.attrs.get('paddings', [0, 0]))
+    dilations = _pair(op.attrs.get('dilations', [1, 1]))
+    groups = op.attrs.get('groups', 1) or 1
+    out = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=strides,
+        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=_CONV_DN,
+        feature_group_count=groups)
+    ctx.set(op, 'Output', out)
+
+
+@register_lowering('depthwise_conv2d')
+def _depthwise_conv2d(ctx, op):
+    x = ctx.get(op, 'Input')
+    w = ctx.get(op, 'Filter')
+    strides = _pair(op.attrs.get('strides', [1, 1]))
+    paddings = _pair(op.attrs.get('paddings', [0, 0]))
+    dilations = _pair(op.attrs.get('dilations', [1, 1]))
+    out = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=strides,
+        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=_CONV_DN,
+        feature_group_count=x.shape[1])
+    ctx.set(op, 'Output', out)
+
+
+@register_lowering('conv2d_transpose')
+def _conv2d_transpose(ctx, op):
+    x = ctx.get(op, 'Input')
+    w = ctx.get(op, 'Filter')  # (C_in, C_out/groups, kh, kw)
+    strides = _pair(op.attrs.get('strides', [1, 1]))
+    paddings = _pair(op.attrs.get('paddings', [0, 0]))
+    dilations = _pair(op.attrs.get('dilations', [1, 1]))
+    groups = op.attrs.get('groups', 1) or 1
+    # gradient-of-conv formulation (matches the reference's col2im path)
+    out = jax.lax.conv_transpose(
+        x, jnp.swapaxes(w, 0, 1) if groups == 1 else w,
+        strides=strides,
+        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=('NCHW', 'IOHW', 'NCHW'),
+        transpose_kernel=True)
+    ctx.set(op, 'Output', out)
+
+
+@register_lowering('conv3d')
+def _conv3d(ctx, op):
+    x = ctx.get(op, 'Input')
+    w = ctx.get(op, 'Filter')
+    strides = op.attrs.get('strides', [1, 1, 1])
+    paddings = op.attrs.get('paddings', [0, 0, 0])
+    dilations = op.attrs.get('dilations', [1, 1, 1])
+    groups = op.attrs.get('groups', 1) or 1
+    out = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=list(strides),
+        padding=[(p, p) for p in paddings],
+        rhs_dilation=list(dilations),
+        dimension_numbers=('NCDHW', 'OIDHW', 'NCDHW'),
+        feature_group_count=groups)
+    ctx.set(op, 'Output', out)
+
+
+def _pool(x, op, ndim):
+    ptype = op.attrs.get('pooling_type', 'max')
+    ksize = list(op.attrs.get('ksize'))
+    strides = list(op.attrs.get('strides', [1] * ndim))
+    paddings = list(op.attrs.get('paddings', [0] * ndim))
+    ceil_mode = op.attrs.get('ceil_mode', False)
+    if op.attrs.get('global_pooling', False):
+        ksize = list(x.shape[2:])
+        paddings = [0] * ndim
+        strides = [1] * ndim
+        ceil_mode = False
+    # ceil_mode (reference pool_op.cc): extra high-side padding so the last
+    # partial window is kept
+    pads_hl = []
+    padded_extra = False
+    for i, p in enumerate(paddings):
+        if ceil_mode:
+            size = x.shape[2 + i]
+            out_ceil = -(-(size + 2 * p - ksize[i]) // strides[i]) + 1
+            extra = (out_ceil - 1) * strides[i] + ksize[i] - (size + 2 * p)
+            extra = max(extra, 0)
+            padded_extra = padded_extra or extra > 0
+            pads_hl.append((p, p + extra))
+        else:
+            pads_hl.append((p, p))
+    window = (1, 1) + tuple(ksize)
+    strides_full = (1, 1) + tuple(strides)
+    pads = ((0, 0), (0, 0)) + tuple(pads_hl)
+    if ptype == 'max':
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, window,
+                                     strides_full, pads)
+    # avg pool; exclusive=True counts only in-bounds elements
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides_full,
+                                   pads)
+    if (op.attrs.get('exclusive', True) and
+            any(p > 0 for p in paddings)) or padded_extra:
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                       strides_full, pads)
+        return summed / jnp.maximum(counts, 1.0)
+    return summed / np.prod(ksize)
+
+
+@register_lowering('pool2d')
+def _pool2d(ctx, op):
+    ctx.set(op, 'Out', _pool(ctx.get(op, 'X'), op, 2))
+
+
+@register_lowering('pool3d')
+def _pool3d(ctx, op):
+    ctx.set(op, 'Out', _pool(ctx.get(op, 'X'), op, 3))
+
+
+@register_lowering('batch_norm')
+def _batch_norm(ctx, op):
+    x = ctx.get(op, 'X')
+    scale = ctx.get(op, 'Scale')
+    bias = ctx.get(op, 'Bias')
+    mean_in = ctx.get(op, 'Mean')
+    var_in = ctx.get(op, 'Variance')
+    eps = op.attrs.get('epsilon', 1e-5)
+    momentum = op.attrs.get('momentum', 0.9)
+    is_test = op.attrs.get('is_test', False)
+    layout = op.attrs.get('data_layout', 'NCHW')
+    axes = tuple(i for i in range(x.ndim)
+                 if i != (1 if layout == 'NCHW' else x.ndim - 1))
+    bshape = [1] * x.ndim
+    bshape[1 if layout == 'NCHW' else x.ndim - 1] = -1
+
+    if is_test:
+        mean, var = mean_in, var_in
+        saved_mean, saved_var = mean_in, var_in
+        mean_out, var_out = mean_in, var_in
+    else:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.mean(jnp.square(x), axis=axes) - jnp.square(mean)
+        # running stats do not take gradients
+        m_s = jax.lax.stop_gradient(mean)
+        v_s = jax.lax.stop_gradient(var)
+        mean_out = momentum * mean_in + (1 - momentum) * m_s
+        var_out = momentum * var_in + (1 - momentum) * v_s
+        saved_mean, saved_var = mean, var
+    inv_std = jax.lax.rsqrt(jnp.reshape(var, bshape) + eps)
+    y = (x - jnp.reshape(mean, bshape)) * inv_std * jnp.reshape(
+        scale, bshape) + jnp.reshape(bias, bshape)
+    ctx.set(op, 'Y', y)
+    ctx.set(op, 'MeanOut', mean_out)
+    ctx.set(op, 'VarianceOut', var_out)
+    ctx.set(op, 'SavedMean', saved_mean)
+    ctx.set(op, 'SavedVariance', saved_var)
+
+
+@register_lowering('layer_norm')
+def _layer_norm(ctx, op):
+    x = ctx.get(op, 'X')
+    scale = ctx.get(op, 'Scale')
+    bias = ctx.get(op, 'Bias')
+    eps = op.attrs.get('epsilon', 1e-5)
+    begin = op.attrs.get('begin_norm_axis', 1)
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    norm_shape = (1, ) * begin + x.shape[begin:]
+    if scale is not None:
+        y = y * jnp.reshape(scale, norm_shape)
+    if bias is not None:
+        y = y + jnp.reshape(bias, norm_shape)
+    ctx.set(op, 'Y', y)
+    ctx.set(op, 'Mean', jnp.reshape(mean, mean.shape[:begin]))
+    ctx.set(op, 'Variance', jnp.reshape(var, var.shape[:begin]))
+
+
+@register_lowering('dropout')
+def _dropout(ctx, op):
+    x = ctx.get(op, 'X')
+    p = op.attrs.get('dropout_prob', 0.5)
+    is_test = op.attrs.get('is_test', False) or ctx.is_test
+    if is_test:
+        # reference "downgrade_in_infer": scale activations at inference
+        ctx.set(op, 'Out', x * (1.0 - p))
+        ctx.set(op, 'Mask', jnp.ones_like(x))
+        return
+    key = ctx.next_rng()
+    mask = (jax.random.uniform(key, x.shape) >= p).astype(x.dtype)
+    ctx.set(op, 'Out', x * mask)
+    ctx.set(op, 'Mask', mask)
+
+
+@register_grad_lowering('dropout')
+def _dropout_grad(ctx, op):
+    """Explicit grad: must reuse the forward Mask, not fresh randomness
+    (reference operators/dropout_op.h DropoutGradKernel)."""
+    _, fwd_outputs, attrs = fwd_structure(op)
+    out_name = fwd_outputs['Out'][0]
+    dout = ctx.lookup(out_name + '@GRAD')
+    gnames = op.output('X@GRAD')
+    if not gnames:
+        return
+    if attrs.get('is_test', False) or ctx.is_test:
+        ctx.store(gnames[0], dout * (1.0 - attrs.get('dropout_prob', 0.5)))
+    else:
+        mask = ctx.lookup(fwd_outputs['Mask'][0])
+        ctx.store(gnames[0], dout * mask)
+
+
+@register_lowering('lookup_table')
+def _lookup_table(ctx, op):
+    w = ctx.get(op, 'W')
+    ids = ctx.get(op, 'Ids')
+    padding_idx = op.attrs.get('padding_idx', -1)
+    flat = jnp.reshape(ids, (-1, )).astype(jnp.int32)
+    out = jnp.take(w, flat, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        out = jnp.where((flat == padding_idx)[:, None], jnp.zeros_like(out),
+                        out)
+    out_shape = tuple(ids.shape[:-1] if ids.shape and ids.shape[-1] == 1 else
+                      ids.shape) + (w.shape[-1], )
+    ctx.set(op, 'Out', jnp.reshape(out, out_shape))
+
+
+@register_lowering('lrn')
+def _lrn(ctx, op):
+    x = ctx.get(op, 'X')  # NCHW
+    n = op.attrs.get('n', 5)
+    k = op.attrs.get('k', 2.0)
+    alpha = op.attrs.get('alpha', 1e-4)
+    beta = op.attrs.get('beta', 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(padded[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    ctx.set(op, 'MidOut', mid)
+    ctx.set(op, 'Out', x / jnp.power(mid, beta))
+
+
+@register_lowering('im2sequence')
+def _im2sequence(ctx, op):
+    x = ctx.get(op, 'X')  # NCHW
+    kernels = op.attrs['kernels']
+    strides = op.attrs.get('strides', [1, 1])
+    paddings = op.attrs.get('paddings', [0, 0, 0, 0])
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (paddings[0], paddings[2]),
+                     (paddings[1], paddings[3])))
+    oh = (xp.shape[2] - kernels[0]) // strides[0] + 1
+    ow = (xp.shape[3] - kernels[1]) // strides[1] + 1
+    patches = []
+    for i in range(kernels[0]):
+        for j in range(kernels[1]):
+            patches.append(xp[:, :, i:i + oh * strides[0]:strides[0],
+                              j:j + ow * strides[1]:strides[1]])
+    # (N, C*kh*kw, OH, OW) -> (N*OH*OW, C*kh*kw)
+    stacked = jnp.reshape(
+        jnp.stack(patches, axis=2), (n, c * kernels[0] * kernels[1], oh, ow))
+    out = jnp.reshape(jnp.transpose(stacked, (0, 2, 3, 1)),
+                      (n * oh * ow, c * kernels[0] * kernels[1]))
+    ctx.set(op, 'Out', out)
+
+
+@register_lowering('lstm_unit')
+def _lstm_unit(ctx, op):
+    """One LSTM cell step on pre-computed gate activations
+    (reference operators/lstm_unit_op.cc; gate order i, j, f, o)."""
+    x = ctx.get(op, 'X')  # (N, 4D)
+    c_prev = ctx.get(op, 'C_prev')
+    forget_bias = op.attrs.get('forget_bias', 0.0)
+    i, j, f, o = jnp.split(x, 4, axis=1)
+    c = c_prev * jax.nn.sigmoid(f + forget_bias) + \
+        jax.nn.sigmoid(i) * jnp.tanh(j)
+    h = jnp.tanh(c) * jax.nn.sigmoid(o)
+    ctx.set(op, 'C', c)
+    ctx.set(op, 'H', h)
+
+
+@register_lowering('hsigmoid')
+def _hsigmoid(ctx, op):
+    """Hierarchical sigmoid via the reference's SimpleCode binary tree
+    (operators/math/matrix_bit_code.h): code(c) = c + num_classes, walk the
+    implicit-heap path.  Variable path lengths are masked for static shapes."""
+    x = ctx.get(op, 'X')  # (N, D)
+    w = ctx.get(op, 'W')  # (num_classes-1, D)
+    bias = ctx.get(op, 'Bias')  # (1, num_classes-1) or None
+    label = jnp.reshape(ctx.get(op, 'Label'), (-1, )).astype(jnp.int32)
+    num_classes = op.attrs['num_classes']
+    max_len = int(np.ceil(np.log2(num_classes)))
+    code = label + num_classes
+    length = jnp.floor(jnp.log2(code.astype(jnp.float32))).astype(jnp.int32)
+    js = jnp.arange(max_len)
+    valid = js[None, :] < length[:, None]  # (N, L)
+    shift_idx = jnp.maximum(length[:, None] - js[None, :], 1)
+    node = (code[:, None] >> shift_idx) - 1  # internal node ids
+    node = jnp.clip(node, 0, num_classes - 2)
+    bit = (code[:, None] >> jnp.maximum(shift_idx - 1, 0)) & 1
+    w_sel = w[node]  # (N, L, D)
+    pre = jnp.einsum('nld,nd->nl', w_sel, x)
+    if bias is not None:
+        pre = pre + jnp.reshape(bias, (-1, ))[node]
+    ctx.set(op, 'PreOut', pre)
+    # sigmoid cross entropy against the path bits, masked to path length
+    loss = jnp.maximum(pre, 0) - pre * bit.astype(pre.dtype) + \
+        jnp.log1p(jnp.exp(-jnp.abs(pre)))
+    loss = jnp.where(valid, loss, jnp.zeros_like(loss))
+    ctx.set(op, 'Out', jnp.sum(loss, axis=1, keepdims=True))
+
+
+@register_lowering('nce')
+def _nce(ctx, op):
+    """Noise-contrastive estimation (reference operators/nce_op.h) with
+    uniform negative sampling."""
+    x = ctx.get(op, 'Input')  # (N, D)
+    label = jnp.reshape(ctx.get(op, 'Label'), (-1, )).astype(jnp.int32)
+    w = ctx.get(op, 'Weight')  # (C, D)
+    b = ctx.get(op, 'Bias')  # (C, 1) or None
+    num_total = op.attrs['num_total_classes']
+    num_neg = op.attrs.get('num_neg_samples', 10)
+    n = x.shape[0]
+    key = ctx.next_rng()
+    neg = jax.random.randint(key, (n, num_neg), 0, num_total)
+    samples = jnp.concatenate([label[:, None], neg], axis=1)  # (N, 1+K)
+    w_sel = w[samples]  # (N, 1+K, D)
+    logits = jnp.einsum('nkd,nd->nk', w_sel, x)
+    if b is not None:
+        logits = logits + jnp.reshape(b, (-1, ))[samples]
+    ctx.set(op, 'SampleLogits', logits)
+    ctx.set(op, 'SampleLabels', samples.astype(jnp.int64))
+    # uniform noise distribution q = K / C
+    log_q = jnp.log(jnp.asarray(num_neg / num_total, logits.dtype))
+    adj = logits - log_q
+    pos_loss = jnp.maximum(adj[:, :1], 0) - adj[:, :1] + \
+        jnp.log1p(jnp.exp(-jnp.abs(adj[:, :1])))
+    neg_loss = jnp.maximum(adj[:, 1:], 0) + \
+        jnp.log1p(jnp.exp(-jnp.abs(adj[:, 1:])))
+    ctx.set(op, 'Cost', pos_loss + jnp.sum(neg_loss, axis=1, keepdims=True))
+
+
+@register_grad_lowering('nce')
+def _nce_grad(ctx, op):
+    """NCE grad must reuse the forward's sampled labels, not resample."""
+    fwd_inputs, fwd_outputs, attrs = fwd_structure(op)
+    samples = ctx.lookup(fwd_outputs['SampleLabels'][0])
+    x = ctx.lookup(fwd_inputs['Input'][0])
+    w = ctx.lookup(fwd_inputs['Weight'][0])
+    has_bias = bool(fwd_inputs.get('Bias'))
+    b = ctx.lookup(fwd_inputs['Bias'][0]) if has_bias else None
+    num_total = attrs['num_total_classes']
+    num_neg = attrs.get('num_neg_samples', 10)
+    cost_name = fwd_outputs['Cost'][0]
+    dcost = ctx.lookup(cost_name + '@GRAD')
+
+    def cost_fn(x, w, b):
+        w_sel = w[samples]
+        logits = jnp.einsum('nkd,nd->nk', w_sel, x)
+        if b is not None:
+            logits = logits + jnp.reshape(b, (-1, ))[samples]
+        log_q = jnp.log(jnp.asarray(num_neg / num_total, logits.dtype))
+        adj = logits - log_q
+        pos = jnp.maximum(adj[:, :1], 0) - adj[:, :1] + \
+            jnp.log1p(jnp.exp(-jnp.abs(adj[:, :1])))
+        neg = jnp.maximum(adj[:, 1:], 0) + \
+            jnp.log1p(jnp.exp(-jnp.abs(adj[:, 1:])))
+        return pos + jnp.sum(neg, axis=1, keepdims=True)
+
+    if has_bias:
+        _, vjp = jax.vjp(cost_fn, x, w, b)
+        dx, dw, db = vjp(dcost)
+    else:
+        _, vjp = jax.vjp(lambda x, w: cost_fn(x, w, None), x, w)
+        dx, dw = vjp(dcost)
+        db = None
+    for slot, g in (('Input', dx), ('Weight', dw), ('Bias', db)):
+        names = op.output(slot + '@GRAD')
+        if names and names[0] and g is not None:
+            ctx.store(names[0], g)
+
+
+@register_lowering('bilinear_interp')
+def _bilinear_interp(ctx, op):
+    x = ctx.get(op, 'X')  # NCHW
+    out_size = ctx.get(op, 'OutSize')
+    oh = ow = None
+    if out_size is not None:
+        try:  # concrete OutSize only; traced values fall back to attrs
+            oh, ow = int(np.asarray(out_size)[0]), int(np.asarray(out_size)[1])
+        except Exception:
+            oh = ow = None
+    if oh is None:
+        oh = op.attrs['out_h']
+        ow = op.attrs['out_w']
+    ctx.set(op, 'Out',
+            jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), 'bilinear'))
+
+
+@register_lowering('nearest_interp')
+def _nearest_interp(ctx, op):
+    x = ctx.get(op, 'X')
+    oh = op.attrs['out_h']
+    ow = op.attrs['out_w']
+    ctx.set(op, 'Out',
+            jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), 'nearest'))

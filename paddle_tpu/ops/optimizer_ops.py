@@ -1,0 +1,163 @@
+"""Optimizer op lowerings (reference: paddle/fluid/operators/sgd_op.cc,
+momentum_op.cc, adam_op.cc, adagrad_op.cc, rmsprop_op.cc, adadelta_op.cc,
+adamax_op.cc, decayed_adagrad_op.cc, ftrl_op.cc).
+
+Each op functionally returns the updated slots (ParamOut etc.); the executor
+threads persistable state so updates land back in the scope — the pure
+analog of the reference's in-place param update kernels.
+"""
+
+import jax.numpy as jnp
+
+from .registry import register_lowering
+
+
+def _lr(ctx, op):
+    lr = ctx.get(op, 'LearningRate')
+    return jnp.reshape(lr, ())
+
+
+@register_lowering('sgd')
+def _sgd(ctx, op):
+    p = ctx.get(op, 'Param')
+    g = ctx.get(op, 'Grad')
+    lr = _lr(ctx, op)
+    ctx.set(op, 'ParamOut', p - lr * g)
+
+
+@register_lowering('momentum')
+def _momentum(ctx, op):
+    p = ctx.get(op, 'Param')
+    g = ctx.get(op, 'Grad')
+    v = ctx.get(op, 'Velocity')
+    lr = _lr(ctx, op)
+    mu = op.attrs['mu']
+    v_out = mu * v + g
+    if op.attrs.get('use_nesterov', False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    ctx.set(op, 'ParamOut', p_out)
+    ctx.set(op, 'VelocityOut', v_out)
+
+
+@register_lowering('adam')
+def _adam(ctx, op):
+    p = ctx.get(op, 'Param')
+    g = ctx.get(op, 'Grad')
+    m1 = ctx.get(op, 'Moment1')
+    m2 = ctx.get(op, 'Moment2')
+    b1p = jnp.reshape(ctx.get(op, 'Beta1Pow'), ())
+    b2p = jnp.reshape(ctx.get(op, 'Beta2Pow'), ())
+    lr = _lr(ctx, op)
+    b1 = op.attrs.get('beta1', 0.9)
+    b2 = op.attrs.get('beta2', 0.999)
+    eps = op.attrs.get('epsilon', 1e-8)
+    m1_out = b1 * m1 + (1 - b1) * g
+    m2_out = b2 * m2 + (1 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_out = p - lr_t * m1_out / (jnp.sqrt(m2_out) + eps)
+    ctx.set(op, 'ParamOut', p_out)
+    ctx.set(op, 'Moment1Out', m1_out)
+    ctx.set(op, 'Moment2Out', m2_out)
+
+
+@register_lowering('adagrad')
+def _adagrad(ctx, op):
+    p = ctx.get(op, 'Param')
+    g = ctx.get(op, 'Grad')
+    mom = ctx.get(op, 'Moment')
+    lr = _lr(ctx, op)
+    eps = op.attrs.get('epsilon', 1e-6)
+    mom_out = mom + jnp.square(g)
+    p_out = p - lr * g / (jnp.sqrt(mom_out) + eps)
+    ctx.set(op, 'ParamOut', p_out)
+    ctx.set(op, 'MomentOut', mom_out)
+
+
+@register_lowering('decayed_adagrad')
+def _decayed_adagrad(ctx, op):
+    p = ctx.get(op, 'Param')
+    g = ctx.get(op, 'Grad')
+    mom = ctx.get(op, 'Moment')
+    lr = _lr(ctx, op)
+    decay = op.attrs.get('decay', 0.95)
+    eps = op.attrs.get('epsilon', 1e-6)
+    mom_out = decay * mom + (1 - decay) * jnp.square(g)
+    p_out = p - lr * g / (jnp.sqrt(mom_out) + eps)
+    ctx.set(op, 'ParamOut', p_out)
+    ctx.set(op, 'MomentOut', mom_out)
+
+
+@register_lowering('adadelta')
+def _adadelta(ctx, op):
+    p = ctx.get(op, 'Param')
+    g = ctx.get(op, 'Grad')
+    avg_sq_grad = ctx.get(op, 'AvgSquaredGrad')
+    avg_sq_upd = ctx.get(op, 'AvgSquaredUpdate')
+    rho = op.attrs.get('rho', 0.95)
+    eps = op.attrs.get('epsilon', 1e-6)
+    asg_out = rho * avg_sq_grad + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_sq_upd + eps) / (asg_out + eps)) * g
+    asu_out = rho * avg_sq_upd + (1 - rho) * jnp.square(update)
+    ctx.set(op, 'ParamOut', p + update)
+    ctx.set(op, 'AvgSquaredGradOut', asg_out)
+    ctx.set(op, 'AvgSquaredUpdateOut', asu_out)
+
+
+@register_lowering('adamax')
+def _adamax(ctx, op):
+    p = ctx.get(op, 'Param')
+    g = ctx.get(op, 'Grad')
+    m = ctx.get(op, 'Moment')
+    inf_norm = ctx.get(op, 'InfNorm')
+    b1p = jnp.reshape(ctx.get(op, 'Beta1Pow'), ())
+    lr = _lr(ctx, op)
+    b1 = op.attrs.get('beta1', 0.9)
+    b2 = op.attrs.get('beta2', 0.999)
+    eps = op.attrs.get('epsilon', 1e-8)
+    m_out = b1 * m + (1 - b1) * g
+    inf_out = jnp.maximum(b2 * inf_norm, jnp.abs(g) + eps)
+    lr_t = lr / (1 - b1p)
+    ctx.set(op, 'ParamOut', p - lr_t * m_out / inf_out)
+    ctx.set(op, 'MomentOut', m_out)
+    ctx.set(op, 'InfNormOut', inf_out)
+
+
+@register_lowering('rmsprop')
+def _rmsprop(ctx, op):
+    p = ctx.get(op, 'Param')
+    g = ctx.get(op, 'Grad')
+    ms = ctx.get(op, 'MeanSquare')
+    mom = ctx.get(op, 'Moment')
+    lr = _lr(ctx, op)
+    eps = op.attrs.get('epsilon', 1e-10)
+    decay = op.attrs.get('decay', 0.9)
+    momentum = op.attrs.get('momentum', 0.0)
+    ms_out = decay * ms + (1 - decay) * jnp.square(g)
+    mom_out = momentum * mom + lr * g / jnp.sqrt(ms_out + eps)
+    ctx.set(op, 'ParamOut', p - mom_out)
+    ctx.set(op, 'MomentOut', mom_out)
+    ctx.set(op, 'MeanSquareOut', ms_out)
+
+
+@register_lowering('ftrl')
+def _ftrl(ctx, op):
+    p = ctx.get(op, 'Param')
+    g = ctx.get(op, 'Grad')
+    sq_accum = ctx.get(op, 'SquaredAccumulator')
+    lin_accum = ctx.get(op, 'LinearAccumulator')
+    lr = _lr(ctx, op)
+    l1 = op.attrs.get('l1', 0.0)
+    l2 = op.attrs.get('l2', 0.0)
+    lr_power = op.attrs.get('lr_power', -0.5)
+    new_accum = sq_accum + jnp.square(g)
+    pow_new = jnp.power(new_accum, -lr_power)
+    pow_old = jnp.power(sq_accum, -lr_power)
+    lin_out = lin_accum + g - (pow_new - pow_old) / lr * p
+    x = l1 * jnp.sign(lin_out) - lin_out
+    y = pow_new / lr + 2 * l2
+    p_out = jnp.where(jnp.abs(lin_out) > l1, x / y, jnp.zeros_like(p))
+    ctx.set(op, 'ParamOut', p_out)
+    ctx.set(op, 'SquaredAccumOut', new_accum)
+    ctx.set(op, 'LinearAccumOut', lin_out)

@@ -1,0 +1,244 @@
+"""Op lowering registry: OpDesc -> JAX/XLA.
+
+The reference dispatches each op to a hand-written CPU/CUDA kernel at runtime
+(paddle/fluid/framework/operator.cc:657-714, registered via
+REGISTER_OP_CPU_KERNEL / REGISTER_OP_CUDA_KERNEL, op_registry.h:214-217).
+Here every op type instead registers a *lowering*: a function that, while the
+enclosing block is being traced for XLA compilation, reads its input values
+from the tracing environment and writes its outputs.  The whole block becomes
+ONE fused XLA computation (the TPU-first swap for the per-op interpreter hot
+loop, executor.cc:332-339).
+
+Gradients: the reference synthesizes grad OpDescs with per-op C++
+GradOpDescMakers (framework/grad_op_desc_maker.h:34).  We synthesize the same
+grad-op graph structure (backward.py) but lower ``<op>_grad`` generically via
+``jax.vjp`` of the forward lowering — XLA's CSE merges the recomputed forward
+with the original, so this costs nothing inside one compiled block.  Ops whose
+forward draws randomness (dropout) register explicit grad lowerings.
+"""
+
+import numpy as np
+
+_LOWERINGS = {}
+_GRAD_LOWERINGS = {}
+# host ops run outside XLA on concrete values (save/load/print/readers);
+# impl signature: fn(ctx, op, scope) with ctx.env holding concrete arrays
+_HOST_OPS = {}
+
+
+def register_host_op(op_type):
+    def deco(fn):
+        _HOST_OPS[op_type] = fn
+        return fn
+
+    return deco
+
+
+def get_host_op(op_type):
+    return _HOST_OPS.get(op_type)
+
+
+def is_host_op_type(op_type):
+    return op_type in _HOST_OPS
+
+
+def register_lowering(op_type):
+    def deco(fn):
+        _LOWERINGS[op_type] = fn
+        return fn
+
+    return deco
+
+
+def register_grad_lowering(op_type):
+    """Register an explicit lowering for ``<op_type>_grad``."""
+
+    def deco(fn):
+        _GRAD_LOWERINGS[op_type] = fn
+        return fn
+
+    return deco
+
+
+def has_lowering(op_type):
+    return op_type in _LOWERINGS or (op_type.endswith('_grad') and
+                                     op_type[:-5] in _LOWERINGS)
+
+
+def get_lowering(op_type):
+    fn = _LOWERINGS.get(op_type)
+    if fn is not None:
+        return fn
+    if op_type.endswith('_grad'):
+        fwd = op_type[:-5]
+        if fwd in _GRAD_LOWERINGS:
+            return _GRAD_LOWERINGS[fwd]
+        if fwd in _LOWERINGS:
+            return _make_generic_grad(fwd)
+    raise NotImplementedError('no XLA lowering registered for op %r' %
+                              op_type)
+
+
+class LoweringContext(object):
+    """Tracing environment handed to every lowering.
+
+    ``env`` maps var name -> traced jax value.  ``block`` gives access to var
+    descs (shape/dtype metadata).  RNG keys are derived from a carried key so
+    compiled functions stay pure.
+    """
+
+    def __init__(self, block, env, rng_key=None, is_test=False, place=None):
+        self.block = block
+        self.env = env
+        self._rng = rng_key
+        self.is_test = is_test
+        self.place = place
+
+    # ---- value access ----
+    def get(self, op, slot, default=None):
+        names = op.input(slot)
+        if not names:
+            return default
+        return self.env[names[0]]
+
+    def get_list(self, op, slot):
+        return [self.env[n] for n in op.input(slot)]
+
+    def set(self, op, slot, value):
+        names = op.output(slot)
+        if names:
+            self.env[names[0]] = value
+
+    def set_list(self, op, slot, values):
+        names = op.output(slot)
+        for n, v in zip(names, values):
+            self.env[n] = v
+
+    def lookup(self, name):
+        return self.env[name]
+
+    def has(self, name):
+        return name in self.env
+
+    def store(self, name, value):
+        self.env[name] = value
+
+    def var_desc(self, name):
+        return self.block._find_var_recursive(name)
+
+    def next_rng(self):
+        import jax
+        if self._rng is None:
+            raise RuntimeError('op requested randomness but no RNG key was '
+                               'threaded into this block')
+        self._rng, key = jax.random.split(self._rng)
+        return key
+
+    def sub_context(self, block=None, env=None):
+        return LoweringContext(
+            block if block is not None else self.block,
+            env if env is not None else self.env,
+            rng_key=None,
+            is_test=self.is_test,
+            place=self.place)
+
+
+def run_op(ctx, op):
+    """Lower one op into the trace."""
+    get_lowering(op.type)(ctx, op)
+
+
+GRAD_SUFFIX = '@GRAD'
+# attr keys on grad ops recording the forward op's slot structure
+FWD_IN_SLOTS_ATTR = '__fwd_in_slots__'
+FWD_OUT_SLOTS_ATTR = '__fwd_out_slots__'
+
+
+def fwd_structure(grad_op):
+    """Recover (fwd_inputs, fwd_outputs, fwd_attrs) slot->names maps from a
+    grad OpDesc built by backward.append_backward."""
+    in_slots = grad_op.attrs[FWD_IN_SLOTS_ATTR]
+    out_slots = grad_op.attrs[FWD_OUT_SLOTS_ATTR]
+    fwd_inputs = {s: grad_op.input(s) for s in in_slots}
+    fwd_outputs = {s: grad_op.input(s) for s in out_slots}
+    fwd_attrs = {
+        k: v
+        for k, v in grad_op.attrs.items()
+        if k not in (FWD_IN_SLOTS_ATTR, FWD_OUT_SLOTS_ATTR)
+    }
+    return fwd_inputs, fwd_outputs, fwd_attrs
+
+
+def _make_generic_grad(fwd_type):
+    """Build a grad lowering from the forward lowering via jax.vjp.
+
+    The grad OpDesc (built by backward.py) carries the forward op's inputs,
+    outputs and attrs; declared grad outputs ``<slot>@GRAD`` name which inputs
+    need gradients.  Missing output-grads are treated as zeros (the analog of
+    fill_zeros_like insertion in the reference backward pass).
+    """
+    import jax
+    import jax.numpy as jnp
+    fwd_lower = _LOWERINGS[fwd_type]
+
+    def grad_lowering(ctx, op):
+        from ..fluid.framework import Operator
+        fwd_inputs, fwd_outputs, fwd_attrs = fwd_structure(op)
+
+        # differentiable primal args: those with a declared <slot>@GRAD output
+        diff_specs = []  # (slot, idx, grad_out_name)
+        for slot, in_names in fwd_inputs.items():
+            gnames = op.output(slot + GRAD_SUFFIX)
+            for i, gname in enumerate(gnames):
+                if gname and i < len(in_names):
+                    diff_specs.append((slot, i, gname))
+        if not diff_specs:
+            return
+
+        fwd_input_vals = {
+            slot: [ctx.lookup(n) for n in names]
+            for slot, names in fwd_inputs.items()
+        }
+        out_slots = list(fwd_outputs.keys())
+        faux = Operator(
+            ctx.block, fwd_type,
+            inputs={s: list(n) for s, n in fwd_inputs.items()},
+            outputs={s: list(n) for s, n in fwd_outputs.items()},
+            attrs=fwd_attrs)
+
+        def primal(*diff_vals):
+            env2 = {}
+            vals = {s: list(v) for s, v in fwd_input_vals.items()}
+            for (slot, i, _), v in zip(diff_specs, diff_vals):
+                vals[slot][i] = v
+            for slot, names in fwd_inputs.items():
+                for n, v in zip(names, vals[slot]):
+                    env2[n] = v
+            sub = ctx.sub_context(env=env2)
+            fwd_lower(sub, faux)
+            return tuple(env2[n] for slot in out_slots
+                         for n in fwd_outputs[slot])
+
+        diff_vals = [fwd_input_vals[s][i] for s, i, _ in diff_specs]
+        primal_outs, vjp_fn = jax.vjp(primal, *diff_vals)
+
+        cotangents = []
+        k = 0
+        for slot in out_slots:
+            for n in fwd_outputs[slot]:
+                gname = n + GRAD_SUFFIX
+                if ctx.has(gname):
+                    ct = ctx.lookup(gname)
+                    if ct.dtype != primal_outs[k].dtype:
+                        ct = ct.astype(primal_outs[k].dtype)
+                    cotangents.append(ct)
+                else:
+                    cotangents.append(jnp.zeros_like(primal_outs[k]))
+                k += 1
+        grads = vjp_fn(tuple(cotangents))
+        for (slot, i, gname), g in zip(diff_specs, grads):
+            if ctx.has(gname):  # accumulate if a rename pass didn't split it
+                g = ctx.lookup(gname) + g
+            ctx.store(gname, g)
+
+    return grad_lowering

@@ -1,0 +1,451 @@
+"""Tensor creation / manipulation op lowerings.
+
+Reference kernels: paddle/fluid/operators/fill_constant_op.cc,
+uniform_random_op.cc, gaussian_random_op.cc, cast_op.cc, reshape_op.cc,
+transpose_op.cc, concat_op.cc, split_op.cc, gather_op.cc, one_hot_op.cc, etc.
+Random ops draw from the block's carried PRNG key (pure-functional analog of
+the reference's per-device curand generators).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register_lowering
+from ..fluid import core
+
+
+def _np_dtype(attr_dtype, default=np.float32):
+    if attr_dtype is None:
+        return np.dtype(default)
+    return core.convert_dtype_to_np(attr_dtype)
+
+
+@register_lowering('fill_constant')
+def _fill_constant(ctx, op):
+    dtype = _np_dtype(op.attrs.get('dtype'))
+    value = op.attrs.get('value', 0.0)
+    shape = op.attrs.get('shape', [1])
+    ctx.set(op, 'Out', jnp.full(tuple(shape), value, dtype=dtype))
+
+
+@register_lowering('fill_constant_batch_size_like')
+def _fill_constant_bsl(ctx, op):
+    ref = ctx.get(op, 'Input')
+    dtype = _np_dtype(op.attrs.get('dtype'))
+    shape = list(op.attrs.get('shape'))
+    in_idx = op.attrs.get('input_dim_idx', 0)
+    out_idx = op.attrs.get('output_dim_idx', 0)
+    shape[out_idx] = ref.shape[in_idx]
+    ctx.set(op, 'Out',
+            jnp.full(tuple(shape), op.attrs.get('value', 0.0), dtype=dtype))
+
+
+@register_lowering('fill_zeros_like')
+def _fill_zeros_like(ctx, op):
+    ctx.set(op, 'Out', jnp.zeros_like(ctx.get(op, 'X')))
+
+
+@register_lowering('uniform_random')
+def _uniform_random(ctx, op):
+    dtype = _np_dtype(op.attrs.get('dtype'))
+    shape = tuple(op.attrs.get('shape'))
+    lo = op.attrs.get('min', -1.0)
+    hi = op.attrs.get('max', 1.0)
+    seed = op.attrs.get('seed', 0)
+    key = jax.random.PRNGKey(seed) if seed else ctx.next_rng()
+    ctx.set(op, 'Out',
+            jax.random.uniform(key, shape, dtype=jnp.float32, minval=lo,
+                               maxval=hi).astype(dtype))
+
+
+@register_lowering('gaussian_random')
+def _gaussian_random(ctx, op):
+    dtype = _np_dtype(op.attrs.get('dtype'))
+    shape = tuple(op.attrs.get('shape'))
+    mean = op.attrs.get('mean', 0.0)
+    std = op.attrs.get('std', 1.0)
+    seed = op.attrs.get('seed', 0)
+    key = jax.random.PRNGKey(seed) if seed else ctx.next_rng()
+    ctx.set(op, 'Out',
+            (mean +
+             std * jax.random.normal(key, shape, dtype=jnp.float32)).astype(
+                 dtype))
+
+
+@register_lowering('truncated_gaussian_random')
+def _truncated_gaussian_random(ctx, op):
+    dtype = _np_dtype(op.attrs.get('dtype'))
+    shape = tuple(op.attrs.get('shape'))
+    mean = op.attrs.get('mean', 0.0)
+    std = op.attrs.get('std', 1.0)
+    seed = op.attrs.get('seed', 0)
+    key = jax.random.PRNGKey(seed) if seed else ctx.next_rng()
+    ctx.set(op, 'Out',
+            (mean + std * jax.random.truncated_normal(
+                key, -2.0, 2.0, shape, dtype=jnp.float32)).astype(dtype))
+
+
+@register_lowering('uniform_random_batch_size_like')
+def _uniform_random_bsl(ctx, op):
+    ref = ctx.get(op, 'Input')
+    dtype = _np_dtype(op.attrs.get('dtype'))
+    shape = list(op.attrs.get('shape'))
+    shape[op.attrs.get('output_dim_idx', 0)] = ref.shape[op.attrs.get(
+        'input_dim_idx', 0)]
+    seed = op.attrs.get('seed', 0)
+    key = jax.random.PRNGKey(seed) if seed else ctx.next_rng()
+    ctx.set(op, 'Out',
+            jax.random.uniform(
+                key,
+                tuple(shape),
+                dtype=jnp.float32,
+                minval=op.attrs.get('min', -1.0),
+                maxval=op.attrs.get('max', 1.0)).astype(dtype))
+
+
+@register_lowering('gaussian_random_batch_size_like')
+def _gaussian_random_bsl(ctx, op):
+    ref = ctx.get(op, 'Input')
+    dtype = _np_dtype(op.attrs.get('dtype'))
+    shape = list(op.attrs.get('shape'))
+    shape[op.attrs.get('output_dim_idx', 0)] = ref.shape[op.attrs.get(
+        'input_dim_idx', 0)]
+    seed = op.attrs.get('seed', 0)
+    key = jax.random.PRNGKey(seed) if seed else ctx.next_rng()
+    ctx.set(op, 'Out',
+            (op.attrs.get('mean', 0.0) + op.attrs.get('std', 1.0) *
+             jax.random.normal(key, tuple(shape),
+                               dtype=jnp.float32)).astype(dtype))
+
+
+@register_lowering('cast')
+def _cast(ctx, op):
+    x = ctx.get(op, 'X')
+    dtype = _np_dtype(op.attrs.get('out_dtype'))
+    ctx.set(op, 'Out', x.astype(dtype))
+
+
+def _infer_reshape(x, shape):
+    shape = list(shape)
+    for i, s in enumerate(shape):
+        if s == 0:  # 0 means "copy from input dim i"
+            shape[i] = x.shape[i]
+    return jnp.reshape(x, tuple(shape))
+
+
+@register_lowering('reshape')
+def _reshape(ctx, op):
+    x = ctx.get(op, 'X')
+    shape_in = ctx.get(op, 'Shape')
+    shape = None
+    if shape_in is not None:
+        # XLA needs static shapes: a concrete Shape tensor wins, a traced one
+        # falls back to the compile-time attr (the reference's runtime
+        # actual_shape override has no static-shape analog)
+        try:
+            shape = [int(s) for s in np.asarray(shape_in)]
+        except Exception:
+            shape = None
+    if shape is None:
+        shape = op.attrs['shape']
+    ctx.set(op, 'Out', _infer_reshape(x, shape))
+
+
+@register_lowering('reshape2')
+def _reshape2(ctx, op):
+    x = ctx.get(op, 'X')
+    shape = op.attrs['shape']
+    ctx.set(op, 'Out', _infer_reshape(x, shape))
+    ctx.set(op, 'XShape', jnp.zeros((0, ) + x.shape, x.dtype))
+
+
+@register_lowering('transpose')
+def _transpose(ctx, op):
+    x = ctx.get(op, 'X')
+    ctx.set(op, 'Out', jnp.transpose(x, op.attrs['axis']))
+
+
+@register_lowering('transpose2')
+def _transpose2(ctx, op):
+    x = ctx.get(op, 'X')
+    ctx.set(op, 'Out', jnp.transpose(x, op.attrs['axis']))
+    ctx.set(op, 'XShape', jnp.zeros((0, ) + x.shape, x.dtype))
+
+
+@register_lowering('squeeze')
+def _squeeze(ctx, op):
+    x = ctx.get(op, 'X')
+    axes = op.attrs.get('axes', [])
+    if axes:
+        out = jnp.squeeze(x, tuple(a for a in axes if x.shape[a] == 1))
+    else:
+        out = jnp.squeeze(x)
+    ctx.set(op, 'Out', out)
+
+
+@register_lowering('unsqueeze')
+def _unsqueeze(ctx, op):
+    x = ctx.get(op, 'X')
+    out = x
+    for a in sorted(op.attrs['axes']):
+        out = jnp.expand_dims(out, a)
+    ctx.set(op, 'Out', out)
+
+
+@register_lowering('concat')
+def _concat(ctx, op):
+    xs = ctx.get_list(op, 'X')
+    ctx.set(op, 'Out', jnp.concatenate(xs, axis=op.attrs.get('axis', 0)))
+
+
+@register_lowering('split')
+def _split(ctx, op):
+    x = ctx.get(op, 'X')
+    axis = op.attrs.get('axis', 0)
+    num = op.attrs.get('num', 0)
+    sections = op.attrs.get('sections', [])
+    if num:
+        outs = jnp.split(x, num, axis=axis)
+    else:
+        idx = np.cumsum(sections)[:-1]
+        outs = jnp.split(x, idx, axis=axis)
+    ctx.set_list(op, 'Out', outs)
+
+
+@register_lowering('assign')
+def _assign(ctx, op):
+    ctx.set(op, 'Out', ctx.get(op, 'X'))
+
+
+@register_lowering('assign_value')
+def _assign_value(ctx, op):
+    vals = np.asarray(op.attrs['values'])
+    dtype = _np_dtype(op.attrs.get('dtype'))
+    ctx.set(op, 'Out',
+            jnp.asarray(vals.reshape(tuple(op.attrs['shape'])), dtype=dtype))
+
+
+@register_lowering('shape')
+def _shape(ctx, op):
+    x = ctx.get(op, 'Input')
+    ctx.set(op, 'Out', jnp.asarray(x.shape, dtype=jnp.int32))
+
+
+@register_lowering('slice')
+def _slice(ctx, op):
+    x = ctx.get(op, 'Input')
+    axes = op.attrs['axes']
+    starts = op.attrs['starts']
+    ends = op.attrs['ends']
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = slice(st, en)
+    ctx.set(op, 'Out', x[tuple(idx)])
+
+
+@register_lowering('expand')
+def _expand(ctx, op):
+    x = ctx.get(op, 'X')
+    times = op.attrs['expand_times']
+    ctx.set(op, 'Out', jnp.tile(x, tuple(times)))
+
+
+@register_lowering('stack')
+def _stack(ctx, op):
+    xs = ctx.get_list(op, 'X')
+    ctx.set(op, 'Y', jnp.stack(xs, axis=op.attrs.get('axis', 0)))
+
+
+@register_lowering('unstack')
+def _unstack(ctx, op):
+    x = ctx.get(op, 'X')
+    axis = op.attrs.get('axis', 0)
+    outs = [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis)]
+    ctx.set_list(op, 'Y', outs)
+
+
+@register_lowering('gather')
+def _gather(ctx, op):
+    x = ctx.get(op, 'X')
+    index = ctx.get(op, 'Index')
+    ctx.set(op, 'Out', jnp.take(x, jnp.reshape(index, (-1, )), axis=0))
+
+
+@register_lowering('scatter')
+def _scatter(ctx, op):
+    x = ctx.get(op, 'X')
+    ids = jnp.reshape(ctx.get(op, 'Ids'), (-1, ))
+    updates = ctx.get(op, 'Updates')
+    ctx.set(op, 'Out', x.at[ids].set(updates))
+
+
+@register_lowering('one_hot')
+def _one_hot(ctx, op):
+    x = ctx.get(op, 'X')
+    depth = op.attrs['depth']
+    flat = jnp.reshape(x, x.shape[:-1] if x.shape and x.shape[-1] == 1 else
+                       x.shape)
+    ctx.set(op, 'Out', jax.nn.one_hot(flat, depth, dtype=jnp.float32))
+
+
+@register_lowering('reverse')
+def _reverse(ctx, op):
+    x = ctx.get(op, 'X')
+    axes = op.attrs['axis']
+    if isinstance(axes, int):
+        axes = [axes]
+    out = x
+    for a in axes:
+        out = jnp.flip(out, a)
+    ctx.set(op, 'Out', out)
+
+
+@register_lowering('pad')
+def _pad(ctx, op):
+    x = ctx.get(op, 'X')
+    paddings = op.attrs['paddings']
+    pad_value = op.attrs.get('pad_value', 0.0)
+    cfg = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    ctx.set(op, 'Out', jnp.pad(x, cfg, constant_values=pad_value))
+
+
+@register_lowering('pad2d')
+def _pad2d(ctx, op):
+    x = ctx.get(op, 'X')  # NCHW
+    p = op.attrs['paddings']  # [top, bottom, left, right]
+    mode = op.attrs.get('mode', 'constant')
+    value = op.attrs.get('pad_value', 0.0)
+    cfg = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if mode == 'constant':
+        ctx.set(op, 'Out', jnp.pad(x, cfg, constant_values=value))
+    else:
+        jmode = {'reflect': 'reflect', 'edge': 'edge'}[mode]
+        ctx.set(op, 'Out', jnp.pad(x, cfg, mode=jmode))
+
+
+@register_lowering('multiplex')
+def _multiplex(ctx, op):
+    ids = jnp.reshape(ctx.get(op, 'Ids'), (-1, ))
+    xs = jnp.stack(ctx.get_list(op, 'X'), axis=0)  # (K, N, D)
+    rows = jnp.arange(xs.shape[1])
+    ctx.set(op, 'Out', xs[ids, rows])
+
+
+@register_lowering('label_smooth')
+def _label_smooth(ctx, op):
+    x = ctx.get(op, 'X')
+    eps = op.attrs.get('epsilon', 0.0)
+    dist = ctx.get(op, 'PriorDist')
+    k = x.shape[-1]
+    if dist is not None:
+        out = (1 - eps) * x + eps * jnp.reshape(dist, (1, -1))
+    else:
+        out = (1 - eps) * x + eps / k
+    ctx.set(op, 'Out', out)
+
+
+@register_lowering('argmax')
+def _argmax(ctx, op):
+    x = ctx.get(op, 'X')
+    ctx.set(op, 'Out', jnp.argmax(x, axis=op.attrs.get('axis', 0)))
+
+
+@register_lowering('argmin')
+def _argmin(ctx, op):
+    x = ctx.get(op, 'X')
+    ctx.set(op, 'Out', jnp.argmin(x, axis=op.attrs.get('axis', 0)))
+
+
+@register_lowering('argsort')
+def _argsort(ctx, op):
+    x = ctx.get(op, 'X')
+    axis = op.attrs.get('axis', -1)
+    idx = jnp.argsort(x, axis=axis)
+    ctx.set(op, 'Indices', idx.astype(jnp.int64))
+    ctx.set(op, 'Out', jnp.sort(x, axis=axis))
+
+
+@register_lowering('top_k')
+def _top_k(ctx, op):
+    x = ctx.get(op, 'X')
+    k = op.attrs['k']
+    vals, idx = jax.lax.top_k(x, k)
+    ctx.set(op, 'Out', vals)
+    ctx.set(op, 'Indices', idx.astype(jnp.int64))
+
+
+@register_lowering('crop')
+def _crop(ctx, op):
+    x = ctx.get(op, 'X')
+    offsets = op.attrs.get('offsets')
+    shape = op.attrs.get('shape')
+    y = ctx.get(op, 'Y')
+    if y is not None:
+        shape = y.shape
+    idx = tuple(
+        slice(o, o + s) for o, s in zip(offsets, shape))
+    ctx.set(op, 'Out', x[idx])
+
+
+@register_lowering('random_crop')
+def _random_crop(ctx, op):
+    x = ctx.get(op, 'X')
+    shape = op.attrs['shape']  # crop shape for trailing dims
+    key = ctx.next_rng()
+    nlead = x.ndim - len(shape)
+    starts = []
+    for i, s in enumerate(shape):
+        key, k = jax.random.split(key)
+        limit = x.shape[nlead + i] - s
+        starts.append(
+            jax.random.randint(k, (), 0, max(limit, 0) + 1))
+    start_idx = [jnp.zeros((), jnp.int32)] * nlead + [
+        s.astype(jnp.int32) for s in starts
+    ]
+    sizes = list(x.shape[:nlead]) + list(shape)
+    ctx.set(op, 'Out', jax.lax.dynamic_slice(x, start_idx, sizes))
+
+
+@register_lowering('lod_reset')
+def _lod_reset(ctx, op):
+    # LoD metadata is carried outside the traced values (§5.7 lowering);
+    # the dense payload passes through unchanged.
+    ctx.set(op, 'Out', ctx.get(op, 'X'))
+
+
+@register_lowering('increment')
+def _increment(ctx, op):
+    x = ctx.get(op, 'X')
+    step = op.attrs.get('step', 1.0)
+    ctx.set(op, 'Out', x + jnp.asarray(step, x.dtype))
+
+
+def _register_compare(name, fn):
+    @register_lowering(name)
+    def _lower(ctx, op, fn=fn):
+        x = ctx.get(op, 'X')
+        y = ctx.get(op, 'Y')
+        ctx.set(op, 'Out', fn(x, y))
+
+
+_register_compare('less_than', jnp.less)
+_register_compare('less_equal', jnp.less_equal)
+_register_compare('greater_than', jnp.greater)
+_register_compare('greater_equal', jnp.greater_equal)
+_register_compare('equal', jnp.equal)
+_register_compare('not_equal', jnp.not_equal)
+_register_compare('logical_and', jnp.logical_and)
+_register_compare('logical_or', jnp.logical_or)
+_register_compare('logical_xor', jnp.logical_xor)
+
+
+@register_lowering('logical_not')
+def _logical_not(ctx, op):
+    ctx.set(op, 'Out', jnp.logical_not(ctx.get(op, 'X')))
+
+
+@register_lowering('isfinite')
+def _isfinite(ctx, op):
+    x = ctx.get(op, 'X')
+    ctx.set(op, 'Out', jnp.reshape(jnp.all(jnp.isfinite(x)), (1, )))

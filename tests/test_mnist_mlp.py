@@ -1,0 +1,93 @@
+"""End-to-end minimum slice: MNIST MLP trains via fluid.Executor
+(reference parity: python/paddle/fluid/tests/book/test_recognize_digits.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.dataset.mnist as mnist
+
+
+def _build_mlp():
+    img = fluid.layers.data(name='img', shape=[784], dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    hidden = fluid.layers.fc(input=img, size=128, act='relu')
+    hidden = fluid.layers.fc(input=hidden, size=64, act='relu')
+    prediction = fluid.layers.fc(input=hidden, size=10, act='softmax')
+    loss = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_loss = fluid.layers.mean(loss)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    return img, label, prediction, avg_loss, acc
+
+
+def test_mnist_mlp_trains():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img, label, prediction, avg_loss, acc = _build_mlp()
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(avg_loss)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+
+        reader = mnist.train(num_samples=64 * 20)
+        batch = []
+        losses = []
+        for sample in reader():
+            batch.append(sample)
+            if len(batch) == 64:
+                imgs = np.stack([b[0] for b in batch]).astype('float32')
+                labels = np.array([[b[1]] for b in batch]).astype('int64')
+                loss_v, acc_v = exe.run(
+                    main,
+                    feed={'img': imgs,
+                          'label': labels},
+                    fetch_list=[avg_loss, acc])
+                losses.append(float(loss_v[0]))
+                batch = []
+        assert len(losses) >= 10
+        # loss must decrease substantially on the synthetic digits
+        assert losses[-1] < losses[0] * 0.5, losses
+        assert np.isfinite(losses[-1])
+
+
+def test_mnist_mlp_test_program_and_accuracy():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img, label, prediction, avg_loss, acc = _build_mlp()
+        test_program = main.clone(for_test=True)
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(avg_loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        reader = mnist.train(num_samples=64 * 30)
+        batch = []
+        for sample in reader():
+            batch.append(sample)
+            if len(batch) == 64:
+                imgs = np.stack([b[0] for b in batch]).astype('float32')
+                labels = np.array([[b[1]] for b in batch]).astype('int64')
+                exe.run(main,
+                        feed={'img': imgs,
+                              'label': labels},
+                        fetch_list=[])
+                batch = []
+        # evaluate
+        test_reader = mnist.test(num_samples=256)
+        samples = list(test_reader())
+        imgs = np.stack([s[0] for s in samples]).astype('float32')
+        labels = np.array([[s[1]] for s in samples]).astype('int64')
+        acc_v, = exe.run(
+            test_program,
+            feed={'img': imgs,
+                  'label': labels},
+            fetch_list=[acc])
+        assert acc_v[0] > 0.7, acc_v
