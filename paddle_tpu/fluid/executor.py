@@ -65,6 +65,59 @@ def as_numpy(value):
     return np.asarray(value)
 
 
+def prepare_feed_arrays(feed):
+    """Normalize a user feed dict: LoD feeds lower to padded [B, T, ...]
+    plus a ``<name>@SEQLEN`` lengths entry (SURVEY §5.7); device arrays
+    pass through untouched.  Shared by Executor and ParallelExecutor."""
+    import jax
+    feed_arrays = {}
+    for name, value in feed.items():
+        if isinstance(value, core.LoDTensor) and value.lod():
+            padded, lengths = _lod_to_padded(value)
+            feed_arrays[name] = padded
+            feed_arrays[name + registry.SEQLEN_SUFFIX] = lengths
+        elif isinstance(value, (core.LoDTensor, jax.Array)):
+            feed_arrays[name] = value
+        else:
+            feed_arrays[name] = np.asarray(value)
+    return feed_arrays
+
+
+def feed_signature(feed_arrays):
+    import jax
+
+    def _sig_of(v):
+        if isinstance(v, jax.Array):
+            return tuple(v.shape), str(v.dtype)
+        a = as_numpy(v)
+        return tuple(np.shape(a)), str(a.dtype)
+
+    return tuple((n, ) + _sig_of(v) for n, v in sorted(feed_arrays.items()))
+
+
+_SEQ_BUCKET = 16
+
+
+def _lod_to_padded(lt, bucket=_SEQ_BUCKET):
+    """Concatenated LoD tensor -> (padded [B, T, ...], lengths [B]).
+
+    T is bucketed to a multiple of ``bucket`` so recompiles are bounded
+    (the static-shape answer to LoD's no-padding design, SURVEY §5.7)."""
+    data = lt.numpy()
+    offsets = lt.lod()[-1]
+    lengths = np.asarray(
+        [offsets[i + 1] - offsets[i] for i in range(len(offsets) - 1)],
+        np.int32)
+    b = len(lengths)
+    max_len = int(lengths.max()) if b else 0
+    t = max(((max_len + bucket - 1) // bucket) * bucket, bucket)
+    out = np.zeros((b, t) + data.shape[1:], data.dtype)
+    for i in range(b):
+        s, e = offsets[i], offsets[i + 1]
+        out[i, :e - s] = data[s:e]
+    return out, lengths
+
+
 def _to_device_value(value, var_desc, device):
     import jax
     if isinstance(value, jax.Array):
@@ -130,13 +183,19 @@ class _CompiledBlock(object):
                     defined.add(name)
         self.state_in = state_in
         self.state_out = state_out
+        # split read-write from read-only: only RW buffers may be donated,
+        # otherwise XLA can alias a read-only input (e.g. the LR scalar) to
+        # an output and delete the buffer the scope still references
+        self.state_rw = [n for n in state_in if n in set(state_out)]
+        self.state_ro = [n for n in state_in if n not in set(state_out)]
 
         fetch_names_ = self.fetch_names
         state_out_ = state_out
 
-        def fn(state, feeds, rng):
+        def fn(state_rw, state_ro, feeds, rng):
             env = {}
-            env.update(state)
+            env.update(state_rw)
+            env.update(state_ro)
             env.update(feeds)
             ctx = registry.LoweringContext(block, env, rng_key=rng,
                                            place=place)
@@ -147,16 +206,15 @@ class _CompiledBlock(object):
             return new_state, fetches
 
         self._fn = fn
-        # donate state buffers only when the block actually updates state
-        # (in-place param update semantics without the copy)
-        donate = (0, ) if state_out else ()
+        donate = (0, ) if self.state_rw else ()
         self._jit = jax.jit(fn, donate_argnums=donate)
 
-    def _run_eager(self, scope, state, feeds, rng):
+    def _run_eager(self, scope, state_rw, state_ro, feeds, rng):
         """Unfused op-by-op execution for blocks containing host ops
         (save/load/print/readers) — identical semantics, no jit."""
         env = {}
-        env.update(state)
+        env.update(state_rw)
+        env.update(state_ro)
         env.update(feeds)
         ctx = registry.LoweringContext(
             self.block, env, rng_key=rng, place=self.place)
@@ -171,25 +229,32 @@ class _CompiledBlock(object):
         fetches = [env[n] for n in self.fetch_names]
         return new_state, fetches
 
-    def run(self, scope, feed_values, rng_key, eager=False):
-        device = self.place.jax_device()
+    def _state_from_scope(self, scope, names, to_value):
         state = {}
-        for name in self.state_in:
+        for name in names:
             var = scope.find_var(name)
             if var is None or var.value() is None:
                 raise RuntimeError(
                     'persistable var %r is not initialized in scope — '
                     'did you run the startup program?' % name)
-            state[name] = _to_device_value(
-                var.value(), self.block._find_var_recursive(name), device)
+            state[name] = to_value(var.value(),
+                                   self.block._find_var_recursive(name))
+        return state
+
+    def run(self, scope, feed_values, rng_key, eager=False):
+        device = self.place.jax_device()
+        to_value = lambda v, desc: _to_device_value(v, desc, device)
+        state_rw = self._state_from_scope(scope, self.state_rw, to_value)
+        state_ro = self._state_from_scope(scope, self.state_ro, to_value)
         feeds = {
             n: _to_device_value(v, self.block._find_var_recursive(n), device)
             for n, v in feed_values.items()
         }
         if eager:
-            new_state, fetches = self._run_eager(scope, state, feeds, rng_key)
+            new_state, fetches = self._run_eager(scope, state_rw, state_ro,
+                                                 feeds, rng_key)
         else:
-            new_state, fetches = self._jit(state, feeds, rng_key)
+            new_state, fetches = self._jit(state_rw, state_ro, feeds, rng_key)
         for name, val in new_state.items():
             scope.var(name).set_value(val)
         return fetches
@@ -237,16 +302,8 @@ class Executor(object):
         fetch_names = [
             f.name if isinstance(f, Variable) else str(f) for f in fetch_list
         ]
-        feed_arrays = {}
-        for name, value in feed.items():
-            if isinstance(value, core.LoDTensor):
-                feed_arrays[name] = value
-            else:
-                feed_arrays[name] = np.asarray(value)
-
-        sig = tuple(
-            (n, tuple(np.shape(as_numpy(v))), str(as_numpy(v).dtype))
-            for n, v in sorted(feed_arrays.items()))
+        feed_arrays = prepare_feed_arrays(feed)
+        sig = feed_signature(feed_arrays)
         key = (id(program), program._version, tuple(fetch_names), sig,
                self.place, id(scope))
         compiled = self._cache.get(key)

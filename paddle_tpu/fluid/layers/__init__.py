@@ -10,6 +10,8 @@ from . import ops
 from .ops import *
 from . import metric_op
 from .metric_op import *
+from . import sequence
+from .sequence import *
 from . import math_op_patch  # installs Variable operator overloads
 
 __all__ = []
@@ -18,3 +20,4 @@ __all__ += io.__all__
 __all__ += tensor.__all__
 __all__ += ops.__all__
 __all__ += metric_op.__all__
+__all__ += sequence.__all__

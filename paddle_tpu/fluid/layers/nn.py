@@ -101,6 +101,7 @@ def embedding(input,
         tmp.shape = in_shape[:-1] + (size[1], )
     else:
         tmp.shape = in_shape + (size[1], )
+    tmp.lod_level = input.lod_level
     padding_idx = -1 if padding_idx is None else (
         padding_idx if padding_idx >= 0 else size[0] + padding_idx)
     helper.append_op(

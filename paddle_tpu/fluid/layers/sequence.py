@@ -1,0 +1,337 @@
+"""Sequence layers (reference: python/paddle/fluid/layers/nn.py — the
+sequence_* / dynamic_lstm / dynamic_gru family).
+
+LoD inputs lower to padded [B, T, ...] + carried lengths (SURVEY §5.7);
+every layer here emits the masked-dense ops from
+paddle_tpu.ops.sequence_ops.
+"""
+
+from ..layer_helper import LayerHelper
+from ..framework import Variable
+from ..initializer import Constant
+
+__all__ = [
+    'dynamic_lstm', 'dynamic_gru', 'gru_unit', 'sequence_conv',
+    'sequence_pool', 'sequence_softmax', 'sequence_first_step',
+    'sequence_last_step', 'sequence_expand', 'sequence_concat',
+    'sequence_reshape', 'sequence_enumerate', 'sequence_erase',
+    'sequence_slice', 'row_conv', 'sequence_pad',
+]
+
+
+def dynamic_lstm(input,
+                 size,
+                 h_0=None,
+                 c_0=None,
+                 param_attr=None,
+                 bias_attr=None,
+                 use_peepholes=True,
+                 is_reverse=False,
+                 gate_activation='sigmoid',
+                 cell_activation='tanh',
+                 candidate_activation='tanh',
+                 dtype='float32',
+                 name=None):
+    """LSTM over a whole (variable-length) batch: input is the
+    pre-projected gate sequence [*, 4D] (reference nn.py dynamic_lstm,
+    operators/lstm_op.cc); lowered to lax.scan."""
+    helper = LayerHelper('lstm', **locals())
+    hidden_dim = size // 4
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[hidden_dim, 4 * hidden_dim],
+        dtype=dtype)
+    bias_size = [1, 7 * hidden_dim if use_peepholes else 4 * hidden_dim]
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=bias_size, dtype=dtype, is_bias=True)
+
+    hidden = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    batch_gate = helper.create_variable_for_type_inference(dtype)
+    batch_cell_pre_act = helper.create_variable_for_type_inference(dtype)
+    hidden.shape = tuple(input.shape[:-1]) + (hidden_dim, )
+    cell.shape = hidden.shape
+    hidden.lod_level = input.lod_level
+    cell.lod_level = input.lod_level
+    inputs = {'Input': [input], 'Weight': [weight], 'Bias': [bias]}
+    if h_0 is not None:
+        inputs['H0'] = [h_0]
+    if c_0 is not None:
+        inputs['C0'] = [c_0]
+    helper.append_op(
+        type='lstm',
+        inputs=inputs,
+        outputs={
+            'Hidden': [hidden],
+            'Cell': [cell],
+            'BatchGate': [batch_gate],
+            'BatchCellPreAct': [batch_cell_pre_act]
+        },
+        attrs={
+            'use_peepholes': use_peepholes,
+            'is_reverse': is_reverse,
+            'gate_activation': gate_activation,
+            'cell_activation': cell_activation,
+            'candidate_activation': candidate_activation
+        })
+    return hidden, cell
+
+
+def dynamic_gru(input,
+                size,
+                param_attr=None,
+                bias_attr=None,
+                is_reverse=False,
+                gate_activation='sigmoid',
+                candidate_activation='tanh',
+                h_0=None):
+    """GRU over a batch: input pre-projected [*, 3D]
+    (reference nn.py dynamic_gru, operators/gru_op.cc)."""
+    helper = LayerHelper('gru', **locals())
+    dtype = helper.input_dtype()
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[size, 3 * size], dtype=dtype)
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=[1, 3 * size], dtype=dtype,
+        is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    hidden.shape = tuple(input.shape[:-1]) + (size, )
+    hidden.lod_level = input.lod_level
+    batch_gate = helper.create_variable_for_type_inference(dtype)
+    batch_reset = helper.create_variable_for_type_inference(dtype)
+    batch_hidden = helper.create_variable_for_type_inference(dtype)
+    inputs = {'Input': [input], 'Weight': [weight], 'Bias': [bias]}
+    if h_0 is not None:
+        inputs['H0'] = [h_0]
+    helper.append_op(
+        type='gru',
+        inputs=inputs,
+        outputs={
+            'Hidden': [hidden],
+            'BatchGate': [batch_gate],
+            'BatchResetHiddenPrev': [batch_reset],
+            'BatchHidden': [batch_hidden]
+        },
+        attrs={
+            'is_reverse': is_reverse,
+            'gate_activation': gate_activation,
+            'activation': candidate_activation
+        })
+    return hidden
+
+
+def gru_unit(input,
+             hidden,
+             size,
+             param_attr=None,
+             bias_attr=None,
+             activation='tanh',
+             gate_activation='sigmoid'):
+    """Single GRU step (reference nn.py gru_unit)."""
+    activation_dict = dict(identity=0, sigmoid=1, tanh=2, relu=3)
+    helper = LayerHelper('gru_unit', **locals())
+    dtype = helper.input_dtype()
+    size = size // 3
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[size, 3 * size], dtype=dtype)
+    gate = helper.create_variable_for_type_inference(dtype)
+    reset_hidden_pre = helper.create_variable_for_type_inference(dtype)
+    updated_hidden = helper.create_variable_for_type_inference(dtype)
+    updated_hidden.shape = hidden.shape
+    inputs = {'Input': [input], 'HiddenPrev': [hidden], 'Weight': [weight]}
+    if helper.bias_attr:
+        bias = helper.create_parameter(
+            attr=helper.bias_attr, shape=[1, 3 * size], dtype=dtype,
+            is_bias=True)
+        inputs['Bias'] = [bias]
+    helper.append_op(
+        type='gru_unit',
+        inputs=inputs,
+        outputs={
+            'Gate': [gate],
+            'ResetHiddenPrev': [reset_hidden_pre],
+            'Hidden': [updated_hidden],
+        },
+        attrs={
+            'activation': activation_dict[activation],
+            'gate_activation': activation_dict[gate_activation],
+        })
+    return updated_hidden, reset_hidden_pre, gate
+
+
+def sequence_conv(input,
+                  num_filters,
+                  filter_size=3,
+                  filter_stride=1,
+                  padding=None,
+                  bias_attr=None,
+                  param_attr=None,
+                  act=None):
+    """Context-window conv over time (reference nn.py sequence_conv)."""
+    helper = LayerHelper('sequence_conv', **locals())
+    dtype = helper.input_dtype()
+    filter_shape = [filter_size * input.shape[-1], num_filters]
+    filter_param = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype)
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    pre_bias.shape = tuple(input.shape[:-1]) + (num_filters, )
+    pre_bias.lod_level = input.lod_level
+    helper.append_op(
+        type='sequence_conv',
+        inputs={
+            'X': [input],
+            'Filter': [filter_param],
+        },
+        outputs={'Out': [pre_bias]},
+        attrs={
+            'contextStride': filter_stride,
+            'contextStart': -int(filter_size // 2),
+            'contextLength': filter_size
+        })
+    pre_act = helper.append_bias_op(pre_bias,
+                                    dim_start=len(pre_bias.shape) - 1)
+    return helper.append_activation(pre_act)
+
+
+def sequence_pool(input, pool_type):
+    """Pool each sequence to one vector (reference nn.py sequence_pool;
+    pool_type: sum/average/sqrt/max/last/first)."""
+    helper = LayerHelper('sequence_pool', **locals())
+    dtype = helper.input_dtype()
+    pool_out = helper.create_variable_for_type_inference(dtype)
+    max_index = helper.create_variable_for_type_inference(dtype='int32')
+    pool_out.shape = (input.shape[0], input.shape[-1])
+    helper.append_op(
+        type='sequence_pool',
+        inputs={'X': [input]},
+        outputs={'Out': [pool_out],
+                 'MaxIndex': [max_index]},
+        attrs={'pooltype': pool_type.upper()})
+    if pool_type == 'max':
+        max_index.stop_gradient = True
+    return pool_out
+
+
+def sequence_first_step(input):
+    return sequence_pool(input=input, pool_type='first')
+
+
+def sequence_last_step(input):
+    return sequence_pool(input=input, pool_type='last')
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    helper = LayerHelper('sequence_softmax', **locals())
+    dtype = helper.input_dtype()
+    softmax_out = helper.create_variable_for_type_inference(dtype)
+    softmax_out.shape = input.shape
+    softmax_out.lod_level = input.lod_level
+    helper.append_op(
+        type='sequence_softmax',
+        inputs={'X': [input]},
+        outputs={'Out': [softmax_out]})
+    return softmax_out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    helper = LayerHelper('sequence_expand', **locals())
+    dtype = helper.input_dtype('x')
+    tmp = helper.create_variable_for_type_inference(dtype)
+    tmp.lod_level = y.lod_level
+    helper.append_op(
+        type='sequence_expand',
+        inputs={'X': [x],
+                'Y': [y]},
+        outputs={'Out': [tmp]},
+        attrs={'ref_level': ref_level})
+    return tmp
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper('sequence_concat', **locals())
+    out = helper.create_variable_for_type_inference(
+        dtype=helper.input_dtype())
+    helper.append_op(
+        type='sequence_concat',
+        inputs={'X': input},
+        outputs={'Out': [out]})
+    return out
+
+
+def sequence_reshape(input, new_dim):
+    helper = LayerHelper('sequence_reshape', **locals())
+    out = helper.create_variable_for_type_inference(
+        dtype=helper.input_dtype())
+    helper.append_op(
+        type='sequence_reshape',
+        inputs={'X': [input]},
+        outputs={'Out': [out]},
+        attrs={'new_dim': new_dim})
+    return out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    helper = LayerHelper('sequence_enumerate', **locals())
+    out = helper.create_variable_for_type_inference(
+        helper.input_dtype(), stop_gradient=True)
+    helper.append_op(
+        type='sequence_enumerate',
+        inputs={'X': [input]},
+        outputs={'Out': [out]},
+        attrs={'win_size': win_size,
+               'pad_value': pad_value})
+    return out
+
+
+def sequence_erase(input, tokens, name=None):
+    helper = LayerHelper('sequence_erase', **locals())
+    out = helper.create_variable_for_type_inference(helper.input_dtype())
+    helper.append_op(
+        type='sequence_erase',
+        inputs={'X': [input]},
+        outputs={'Out': [out]},
+        attrs={'tokens': list(tokens)})
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper('sequence_slice', **locals())
+    out = helper.create_variable_for_type_inference(helper.input_dtype())
+    helper.append_op(
+        type='sequence_slice',
+        inputs={'X': [input],
+                'Offset': [offset],
+                'Length': [length]},
+        outputs={'Out': [out]})
+    return out
+
+
+def sequence_pad(x, pad_value, maxlen=None):
+    helper = LayerHelper('sequence_pad', **locals())
+    out = helper.create_variable_for_type_inference(helper.input_dtype('x'))
+    length = helper.create_variable_for_type_inference('int64')
+    helper.append_op(
+        type='sequence_pad',
+        inputs={'X': [x],
+                'PadValue': [pad_value]},
+        outputs={'Out': [out],
+                 'Length': [length]},
+        attrs={'padded_length': maxlen if maxlen is not None else -1})
+    return out, length
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead convolution (reference nn.py row_conv)."""
+    helper = LayerHelper('row_conv', **locals())
+    dtype = helper.input_dtype()
+    filter_shape = [future_context_size + 1, input.shape[-1]]
+    filter_param = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = input.shape
+    out.lod_level = input.lod_level
+    helper.append_op(
+        type='row_conv',
+        inputs={'X': [input],
+                'Filter': [filter_param]},
+        outputs={'Out': [out]})
+    return helper.append_activation(out)

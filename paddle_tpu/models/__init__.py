@@ -1,0 +1,8 @@
+"""Benchmark/book model zoo (reference: benchmark/fluid/models/ and
+python/paddle/fluid/tests/book/)."""
+
+from . import mnist  # noqa: F401
+from . import resnet  # noqa: F401
+from . import vgg  # noqa: F401
+
+__all__ = ['mnist', 'resnet', 'vgg']

@@ -15,3 +15,4 @@ from . import optimizer_ops  # noqa: F401
 from . import nn_ops  # noqa: F401
 from . import metric_ops  # noqa: F401
 from . import host_ops  # noqa: F401
+from . import sequence_ops  # noqa: F401

@@ -25,10 +25,11 @@ def _cross_entropy(ctx, op):
                         keepdims=True)
     else:
         idx = _index_label(label)
-        picked = jnp.take_along_axis(x, idx[:, None], axis=-1)
+        picked = jnp.take_along_axis(x, idx[..., None], axis=-1)
         loss = -jnp.log(jnp.maximum(picked, _EPS))
         ignore = op.attrs.get('ignore_index', -100)
-        loss = jnp.where(idx[:, None] == ignore, jnp.zeros_like(loss), loss)
+        loss = jnp.where(idx[..., None] == ignore, jnp.zeros_like(loss),
+                         loss)
     ctx.set(op, 'Y', loss)
 
 
@@ -42,9 +43,10 @@ def _softmax_with_cross_entropy(ctx, op):
         loss = -jnp.sum(label * log_p, axis=-1, keepdims=True)
     else:
         idx = _index_label(label)
-        loss = -jnp.take_along_axis(log_p, idx[:, None], axis=-1)
+        loss = -jnp.take_along_axis(log_p, idx[..., None], axis=-1)
         ignore = op.attrs.get('ignore_index', -100)
-        loss = jnp.where(idx[:, None] == ignore, jnp.zeros_like(loss), loss)
+        loss = jnp.where(idx[..., None] == ignore, jnp.zeros_like(loss),
+                         loss)
     ctx.set(op, 'Softmax', softmax)
     ctx.set(op, 'Loss', loss)
 

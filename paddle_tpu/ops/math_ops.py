@@ -28,10 +28,22 @@ def _mul(ctx, op):
     y = ctx.get(op, 'Y')
     xn = op.attrs.get('x_num_col_dims', 1)
     yn = op.attrs.get('y_num_col_dims', 1)
-    x2 = _flatten_2d(x, xn)
     y2 = _flatten_2d(y, yn)
+    k = y2.shape[0]
+    # choose x's split point from the right so trailing dims contract with k;
+    # handles LoD tensors whose padded runtime rank exceeds the desc rank
+    # (a (B,T,D) @ (D,M) per-token projection where the graph said (N,D))
+    split = x.ndim
+    acc = 1
+    while split > 0 and acc != k:
+        split -= 1
+        acc *= x.shape[split]
+    if acc != k:
+        split = xn  # fall back to declared semantics (will raise clearly)
+    x2 = jnp.reshape(x, (-1, int(np.prod(x.shape[split:], dtype=np.int64))
+                         if split < x.ndim else 1))
     out = x2 @ y2
-    out_shape = tuple(x.shape[:xn]) + tuple(y.shape[yn:])
+    out_shape = tuple(x.shape[:split]) + tuple(y.shape[yn:])
     ctx.set(op, 'Out', jnp.reshape(out, out_shape))
 
 
@@ -66,16 +78,31 @@ def _matmul(ctx, op):
 
 def _bcast_y(x, y, axis):
     """Reference broadcast: Y's shape aligns into X starting at `axis`
-    (elementwise_op_function.h); axis=-1 aligns trailing dims."""
+    (elementwise_op_function.h); axis=-1 aligns trailing dims.  If the
+    requested axis does not fit (e.g. LoD tensors lowered to padded rank-3
+    where the graph assumed rank-2), fall back to trailing alignment."""
     if x.shape == y.shape:
         return y
     # trim trailing 1s of y (fluid allows y shape (C,1,1) matching mid dims)
     yshape = list(y.shape)
     while yshape and yshape[-1] == 1 and len(yshape) > 1:
         yshape = yshape[:-1]
+
+    def _aligned(ax):
+        if ax < 0 or ax + len(yshape) > x.ndim:
+            return None
+        if any(ys not in (1, x.shape[ax + i])
+               for i, ys in enumerate(yshape)):
+            return None
+        return [1] * ax + yshape + [1] * (x.ndim - ax - len(yshape))
+
     if axis == -1 or axis is None:
         axis = x.ndim - len(yshape)
-    new_shape = [1] * axis + yshape + [1] * (x.ndim - axis - len(yshape))
+    new_shape = _aligned(axis)
+    if new_shape is None:
+        new_shape = _aligned(x.ndim - len(yshape))
+    if new_shape is None:
+        return y  # let jnp's own broadcasting rules apply (or raise)
     return jnp.reshape(y, new_shape)
 
 
@@ -85,6 +112,14 @@ def _register_elementwise(name, fn):
         x = ctx.get(op, 'X')
         y = ctx.get(op, 'Y')
         axis = op.attrs.get('axis', -1)
+        # the axis attr was chosen for X's DECLARED rank; when the runtime
+        # rank differs (LoD tensor lowered to padded [B,T,...]) the only
+        # meaningful alignment is trailing — never trust the stale axis
+        xnames = op.input('X')
+        if xnames:
+            xd = ctx.var_desc(xnames[0])
+            if xd is not None and xd.shape and len(xd.shape) != x.ndim:
+                axis = -1
         y = _bcast_y(x, y, axis)
         ctx.set(op, 'Out', fn(x, y))
 

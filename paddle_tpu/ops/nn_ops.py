@@ -300,13 +300,13 @@ def _im2sequence(ctx, op):
 @register_lowering('lstm_unit')
 def _lstm_unit(ctx, op):
     """One LSTM cell step on pre-computed gate activations
-    (reference operators/lstm_unit_op.cc; gate order i, j, f, o)."""
+    (reference operators/lstm_unit_op.h:61-70; gate order i, f, o, g)."""
     x = ctx.get(op, 'X')  # (N, 4D)
     c_prev = ctx.get(op, 'C_prev')
     forget_bias = op.attrs.get('forget_bias', 0.0)
-    i, j, f, o = jnp.split(x, 4, axis=1)
+    i, f, o, g = jnp.split(x, 4, axis=1)
     c = c_prev * jax.nn.sigmoid(f + forget_bias) + \
-        jax.nn.sigmoid(i) * jnp.tanh(j)
+        jax.nn.sigmoid(i) * jnp.tanh(g)
     h = jnp.tanh(c) * jax.nn.sigmoid(o)
     ctx.set(op, 'C', c)
     ctx.set(op, 'H', h)

@@ -143,9 +143,32 @@ class LoweringContext(object):
             place=self.place)
 
 
+SEQLEN_SUFFIX = '@SEQLEN'
+# ops that consume sequence structure and emit dense outputs — sequence
+# lengths must NOT propagate through them
+_SEQ_CONSUMERS = {
+    'sequence_pool', 'sequence_last_step', 'sequence_first_step',
+}
+
+
 def run_op(ctx, op):
-    """Lower one op into the trace."""
+    """Lower one op into the trace, propagating sequence-length metadata
+    (the static-shape stand-in for LoD, SURVEY §5.7)."""
     get_lowering(op.type)(ctx, op)
+    if op.type in _SEQ_CONSUMERS or op.type.endswith('_grad'):
+        return
+    seqlen = None
+    for names in op.inputs.values():
+        for n in names:
+            if (n + SEQLEN_SUFFIX) in ctx.env:
+                seqlen = ctx.env[n + SEQLEN_SUFFIX]
+                break
+        if seqlen is not None:
+            break
+    if seqlen is not None:
+        for names in op.outputs.values():
+            for n in names:
+                ctx.env.setdefault(n + SEQLEN_SUFFIX, seqlen)
 
 
 GRAD_SUFFIX = '@GRAD'
@@ -199,15 +222,25 @@ def _make_generic_grad(fwd_type):
             slot: [ctx.lookup(n) for n in names]
             for slot, names in fwd_inputs.items()
         }
-        out_slots = list(fwd_outputs.keys())
+        # only outputs the forward pass actually produced (some lowerings
+        # write optional outputs conditionally, e.g. sequence_pool MaxIndex)
+        out_names = [(slot, n) for slot in fwd_outputs
+                     for n in fwd_outputs[slot] if ctx.has(n)]
         faux = Operator(
             ctx.block, fwd_type,
             inputs={s: list(n) for s, n in fwd_inputs.items()},
             outputs={s: list(n) for s, n in fwd_outputs.items()},
             attrs=fwd_attrs)
+        # sequence-length side-band entries the lowering may consult
+        seq_entries = {}
+        for names in fwd_inputs.values():
+            for n in names:
+                key = n + SEQLEN_SUFFIX
+                if ctx.has(key):
+                    seq_entries[key] = ctx.lookup(key)
 
         def primal(*diff_vals):
-            env2 = {}
+            env2 = dict(seq_entries)
             vals = {s: list(v) for s, v in fwd_input_vals.items()}
             for (slot, i, _), v in zip(diff_specs, diff_vals):
                 vals[slot][i] = v
@@ -216,25 +249,21 @@ def _make_generic_grad(fwd_type):
                     env2[n] = v
             sub = ctx.sub_context(env=env2)
             fwd_lower(sub, faux)
-            return tuple(env2[n] for slot in out_slots
-                         for n in fwd_outputs[slot])
+            return tuple(env2[n] for _, n in out_names)
 
         diff_vals = [fwd_input_vals[s][i] for s, i, _ in diff_specs]
         primal_outs, vjp_fn = jax.vjp(primal, *diff_vals)
 
         cotangents = []
-        k = 0
-        for slot in out_slots:
-            for n in fwd_outputs[slot]:
-                gname = n + GRAD_SUFFIX
-                if ctx.has(gname):
-                    ct = ctx.lookup(gname)
-                    if ct.dtype != primal_outs[k].dtype:
-                        ct = ct.astype(primal_outs[k].dtype)
-                    cotangents.append(ct)
-                else:
-                    cotangents.append(jnp.zeros_like(primal_outs[k]))
-                k += 1
+        for k, (_, n) in enumerate(out_names):
+            gname = n + GRAD_SUFFIX
+            if ctx.has(gname):
+                ct = ctx.lookup(gname)
+                if ct.dtype != primal_outs[k].dtype:
+                    ct = ct.astype(primal_outs[k].dtype)
+                cotangents.append(ct)
+            else:
+                cotangents.append(jnp.zeros_like(primal_outs[k]))
         grads = vjp_fn(tuple(cotangents))
         for (slot, i, gname), g in zip(diff_specs, grads):
             if ctx.has(gname):  # accumulate if a rename pass didn't split it

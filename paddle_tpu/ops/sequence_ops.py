@@ -1,0 +1,411 @@
+"""Sequence op lowerings: LoD semantics on static shapes.
+
+The reference stores variable-length batches concatenated with LoD offset
+tables and runs LoD-aware kernels (framework/lod_tensor.h:58,
+operators/sequence_*); dynamic RNNs reorder via math/sequence2batch.h.
+XLA needs static shapes, so (SURVEY §5.7) LoD feeds are lowered to padded
+``[B, T, ...]`` tensors plus an int32 ``lengths[B]`` carried in the env
+under ``<name>@SEQLEN`` (propagated by registry.run_op).  Every sequence op
+is a masked dense op; RNNs are ``lax.scan`` over the time axis — which is
+exactly the TPU-friendly formulation (big batched matmuls per step).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import (register_lowering, register_grad_lowering,
+                       fwd_structure, SEQLEN_SUFFIX)
+
+
+def _seqlen(ctx, op, slot='X'):
+    names = op.input(slot)
+    if not names:
+        return None
+    return ctx.env.get(names[0] + SEQLEN_SUFFIX)
+
+
+def _mask(x, lengths, dtype=None):
+    """[B, T] validity mask broadcastable against x [B, T, ...]."""
+    t = x.shape[1]
+    m = jnp.arange(t)[None, :] < lengths[:, None]
+    if dtype is not None:
+        m = m.astype(dtype)
+    return m
+
+
+def _expand_mask(m, x):
+    return jnp.reshape(m, m.shape + (1, ) * (x.ndim - 2))
+
+
+@register_lowering('sequence_pool')
+def _sequence_pool(ctx, op):
+    x = ctx.get(op, 'X')  # [B, T, ...]
+    lengths = _seqlen(ctx, op)
+    ptype = op.attrs.get('pooltype', 'AVERAGE').upper()
+    if lengths is None:
+        lengths = jnp.full((x.shape[0], ), x.shape[1], jnp.int32)
+    m = _expand_mask(_mask(x, lengths, x.dtype), x)
+    lens = jnp.maximum(lengths, 1).astype(x.dtype)
+    lens = jnp.reshape(lens, (x.shape[0], ) + (1, ) * (x.ndim - 2))
+    if ptype == 'SUM':
+        out = jnp.sum(x * m, axis=1)
+    elif ptype == 'AVERAGE':
+        out = jnp.sum(x * m, axis=1) / lens
+    elif ptype == 'SQRT':
+        out = jnp.sum(x * m, axis=1) / jnp.sqrt(lens)
+    elif ptype == 'MAX':
+        neg = jnp.full_like(x, -jnp.inf)
+        out = jnp.max(jnp.where(m > 0, x, neg), axis=1)
+        out = jnp.where(jnp.reshape(lengths, lens.shape) > 0, out,
+                        jnp.zeros_like(out))
+    elif ptype == 'LAST':
+        idx = jnp.maximum(lengths - 1, 0)
+        out = jnp.take_along_axis(
+            x, jnp.reshape(idx, (-1, 1) + (1, ) * (x.ndim - 2)),
+            axis=1)[:, 0]
+    elif ptype == 'FIRST':
+        out = x[:, 0]
+    else:
+        raise NotImplementedError('sequence_pool type %r' % ptype)
+    ctx.set(op, 'Out', out)
+    if ptype == 'MAX':
+        ctx.set(op, 'MaxIndex',
+                jnp.zeros(out.shape, jnp.int32))  # index output (unused)
+
+
+@register_lowering('sequence_last_step')
+def _sequence_last_step(ctx, op):
+    op.attrs['pooltype'] = 'LAST'
+    _sequence_pool(ctx, op)
+
+
+@register_lowering('sequence_first_step')
+def _sequence_first_step(ctx, op):
+    op.attrs['pooltype'] = 'FIRST'
+    _sequence_pool(ctx, op)
+
+
+@register_lowering('sequence_softmax')
+def _sequence_softmax(ctx, op):
+    x = ctx.get(op, 'X')  # [B, T] or [B, T, 1]
+    lengths = _seqlen(ctx, op)
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    v = x[..., 0] if squeeze else x
+    if lengths is None:
+        out = jax.nn.softmax(v, axis=1)
+    else:
+        m = _mask(v, lengths)
+        out = jax.nn.softmax(jnp.where(m, v, -1e30), axis=1)
+        out = jnp.where(m, out, jnp.zeros_like(out))
+    ctx.set(op, 'Out', out[..., None] if squeeze else out)
+
+
+@register_lowering('sequence_expand')
+def _sequence_expand(ctx, op):
+    """Broadcast each batch row of X across its ref sequence's steps
+    (reference sequence_expand_op.cc, level-1 semantics on padded form)."""
+    x = ctx.get(op, 'X')  # [B, D] or [B, 1, D]
+    y = ctx.get(op, 'Y')  # [B, T, ...] provides the target lengths
+    if x.ndim == y.ndim:  # already time-major: tile per-step
+        ctx.set(op, 'Out', x)
+        return
+    t = y.shape[1]
+    out = jnp.repeat(x[:, None], t, axis=1)
+    ctx.set(op, 'Out', out)
+    ynames = op.input('Y')
+    if ynames and (ynames[0] + SEQLEN_SUFFIX) in ctx.env:
+        for n in op.output('Out'):
+            ctx.env[n + SEQLEN_SUFFIX] = ctx.env[ynames[0] + SEQLEN_SUFFIX]
+
+
+@register_lowering('sequence_concat')
+def _sequence_concat(ctx, op):
+    """Per-instance TIME concatenation with summed lengths (reference
+    sequence_concat_op default axis=0 semantics, on padded form)."""
+    xs = ctx.get_list(op, 'X')
+    names = op.input('X')
+    lens = []
+    for name, x in zip(names, xs):
+        l = ctx.env.get(name + SEQLEN_SUFFIX)
+        if l is None:
+            l = jnp.full((x.shape[0], ), x.shape[1], jnp.int32)
+        lens.append(l)
+    total_t = sum(x.shape[1] for x in xs)
+    b = xs[0].shape[0]
+    out = jnp.zeros((b, total_t) + xs[0].shape[2:], xs[0].dtype)
+    pos = jnp.arange(total_t)[None, :]  # [1, total_t]
+    offset = jnp.zeros((b, ), jnp.int32)
+    for x, l in zip(xs, lens):
+        # place x[b, 0:l_b] at out[b, offset_b:offset_b+l_b]
+        j = pos - offset[:, None]
+        valid = (j >= 0) & (j < l[:, None])
+        j_cl = jnp.clip(j, 0, x.shape[1] - 1)
+        gathered = jnp.take_along_axis(
+            x, jnp.reshape(j_cl, (b, total_t) + (1, ) * (x.ndim - 2)),
+            axis=1)
+        mask = jnp.reshape(valid, (b, total_t) + (1, ) * (x.ndim - 2))
+        out = jnp.where(mask, gathered, out)
+        offset = offset + l
+    ctx.set(op, 'Out', out)
+    for n in op.output('Out'):
+        ctx.env[n + SEQLEN_SUFFIX] = offset
+
+
+@register_lowering('sequence_reshape')
+def _sequence_reshape(ctx, op):
+    x = ctx.get(op, 'X')  # [B, T, D]
+    new_dim = op.attrs['new_dim']
+    b, t, d = x.shape
+    ctx.set(op, 'Out', jnp.reshape(x, (b, t * d // new_dim, new_dim)))
+    # lengths rescale by d/new_dim (reference sequence_reshape_op.cc)
+    lengths = _seqlen(ctx, op)
+    if lengths is not None:
+        for n in op.output('Out'):
+            ctx.env[n + SEQLEN_SUFFIX] = lengths * d // new_dim
+
+
+@register_lowering('sequence_conv')
+def _sequence_conv(ctx, op):
+    """Context-window projection over time
+    (reference operators/sequence_conv_op.cc + math/context_project.h)."""
+    x = ctx.get(op, 'X')  # [B, T, D]
+    w = ctx.get(op, 'Filter')  # [ctx_len * D, M]
+    lengths = _seqlen(ctx, op)
+    ctx_len = op.attrs.get('contextLength', 3)
+    ctx_start = op.attrs.get('contextStart', -(ctx_len // 2))
+    b, t, d = x.shape
+    if lengths is not None:
+        x = x * _expand_mask(_mask(x, lengths, x.dtype), x)
+    # pad time so every window is in-bounds, then gather shifted views
+    pad_lo = max(-ctx_start, 0)
+    pad_hi = max(ctx_start + ctx_len - 1, 0)
+    xp = jnp.pad(x, ((0, 0), (pad_lo, pad_hi), (0, 0)))
+    views = [
+        xp[:, pad_lo + ctx_start + i:pad_lo + ctx_start + i + t]
+        for i in range(ctx_len)
+    ]
+    ctx_mat = jnp.concatenate(views, axis=-1)  # [B, T, ctx_len*D]
+    ctx.set(op, 'Out', jnp.einsum('btc,cm->btm', ctx_mat, w))
+
+
+@register_lowering('sequence_slice')
+def _sequence_slice(ctx, op):
+    x = ctx.get(op, 'X')
+    offset = ctx.get(op, 'Offset')
+    length = ctx.get(op, 'Length')
+    # static-shape approximation: same offset/length per batch row
+    off = int(np.asarray(offset).flatten()[0])
+    ln = int(np.asarray(length).flatten()[0])
+    ctx.set(op, 'Out', x[:, off:off + ln])
+
+
+@register_lowering('sequence_enumerate')
+def _sequence_enumerate(ctx, op):
+    x = ctx.get(op, 'X')  # [B, T] or [B, T, 1] int ids
+    win = op.attrs['win_size']
+    pad_value = op.attrs.get('pad_value', 0)
+    squeeze = x.ndim == 3
+    v = x[..., 0] if squeeze else x
+    b, t = v.shape
+    vp = jnp.pad(v, ((0, 0), (0, win - 1)), constant_values=pad_value)
+    out = jnp.stack([vp[:, i:i + t] for i in range(win)], axis=-1)
+    ctx.set(op, 'Out', out)
+
+
+@register_lowering('sequence_erase')
+def _sequence_erase(ctx, op):
+    # static shapes forbid true erasure; mask erased tokens to 0 instead
+    x = ctx.get(op, 'X')
+    tokens = op.attrs.get('tokens', [])
+    keep = jnp.ones(x.shape, bool)
+    for tok in tokens:
+        keep = keep & (x != tok)
+    ctx.set(op, 'Out', jnp.where(keep, x, jnp.zeros_like(x)))
+
+
+@register_lowering('sequence_pad')
+def _sequence_pad(ctx, op):
+    # inputs are already padded in this lowering scheme
+    x = ctx.get(op, 'X')
+    ctx.set(op, 'Out', x)
+    lengths = _seqlen(ctx, op)
+    if lengths is not None:
+        ctx.set(op, 'Length', lengths.astype(jnp.int64))
+
+
+@register_lowering('sequence_unpad')
+def _sequence_unpad(ctx, op):
+    ctx.set(op, 'Out', ctx.get(op, 'X'))
+
+
+# ----------------------------------------------------------------------------
+# Recurrent nets: lax.scan over the time axis
+# ----------------------------------------------------------------------------
+def _act(name):
+    return {
+        'sigmoid': jax.nn.sigmoid,
+        'tanh': jnp.tanh,
+        'relu': jax.nn.relu,
+        'identity': lambda v: v,
+    }[name or 'tanh']
+
+
+@register_lowering('lstm')
+def _lstm(ctx, op):
+    """Dynamic LSTM (reference operators/lstm_op.cc).  Input is the
+    pre-projected gate matrix [B, T, 4D]; the op runs the recurrence
+    h_t = f(x_t + h_{t-1} W + b) with per-step masking replacing the
+    reference's sequence2batch reordering.  Gate order: i, f, c, o."""
+    x = ctx.get(op, 'Input')  # [B, T, 4D]
+    w = ctx.get(op, 'Weight')  # [D, 4D]
+    bias = ctx.get(op, 'Bias')  # [1, 4D] (+ [1, 3D] peephole tail)
+    h0 = ctx.get(op, 'H0')
+    c0 = ctx.get(op, 'C0')
+    lengths = _seqlen(ctx, op, 'Input')
+    use_peepholes = op.attrs.get('use_peepholes', False)
+    is_reverse = op.attrs.get('is_reverse', False)
+    gate_act = _act(op.attrs.get('gate_activation', 'sigmoid'))
+    cell_act = _act(op.attrs.get('cell_activation', 'tanh'))
+    cand_act = _act(op.attrs.get('candidate_activation', 'tanh'))
+
+    b_sz, t, d4 = x.shape
+    d = d4 // 4
+    gate_bias = bias[:, :4 * d] if bias is not None else 0.0
+    if use_peepholes and bias is not None:
+        w_ic = bias[0, 4 * d:5 * d]
+        w_fc = bias[0, 5 * d:6 * d]
+        w_oc = bias[0, 6 * d:7 * d]
+    h_prev = h0 if h0 is not None else jnp.zeros((b_sz, d), x.dtype)
+    c_prev = c0 if c0 is not None else jnp.zeros((b_sz, d), x.dtype)
+
+    xs = jnp.swapaxes(x, 0, 1)  # [T, B, 4D]
+    if is_reverse:
+        xs = jnp.flip(xs, 0)
+    if lengths is None:
+        step_mask = jnp.ones((t, b_sz), x.dtype)
+    else:
+        step_mask = _mask(x, lengths, x.dtype).T  # [T, B]
+        if is_reverse:
+            step_mask = jnp.flip(step_mask, 0)
+
+    def step(carry, inp):
+        h, c = carry
+        x_t, m_t = inp
+        gates = x_t + h @ w + gate_bias
+        # reference gate layout: [candidate(in), input, forget, output]
+        # (math/detail/lstm_cpu_kernel.h:44-47)
+        gc, gi, gf, go = jnp.split(gates, 4, axis=1)
+        if use_peepholes:
+            gi = gi + c * w_ic
+            gf = gf + c * w_fc
+        i = gate_act(gi)
+        f = gate_act(gf)
+        c_new = f * c + i * cand_act(gc)
+        if use_peepholes:
+            go = go + c_new * w_oc
+        o = gate_act(go)
+        h_new = o * cell_act(c_new)
+        m = m_t[:, None]
+        h_out = m * h_new + (1 - m) * h
+        c_out = m * c_new + (1 - m) * c
+        return (h_out, c_out), (h_out, c_out)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h_prev, c_prev), (xs, step_mask))
+    if is_reverse:
+        hs = jnp.flip(hs, 0)
+        cs = jnp.flip(cs, 0)
+    ctx.set(op, 'Hidden', jnp.swapaxes(hs, 0, 1))
+    ctx.set(op, 'Cell', jnp.swapaxes(cs, 0, 1))
+    ctx.set(op, 'BatchGate', x)
+    ctx.set(op, 'BatchCellPreAct', jnp.swapaxes(cs, 0, 1))
+
+
+@register_lowering('gru')
+def _gru(ctx, op):
+    """Dynamic GRU (reference operators/gru_op.cc).  Input [B, T, 3D]
+    pre-projected; weight [D, 3D] = [W_update | W_reset | W_candidate]."""
+    x = ctx.get(op, 'Input')
+    w = ctx.get(op, 'Weight')
+    bias = ctx.get(op, 'Bias')
+    h0 = ctx.get(op, 'H0')
+    lengths = _seqlen(ctx, op, 'Input')
+    is_reverse = op.attrs.get('is_reverse', False)
+    gate_act = _act(op.attrs.get('gate_activation', 'sigmoid'))
+    cand_act = _act(op.attrs.get('activation', 'tanh'))
+
+    b_sz, t, d3 = x.shape
+    d = d3 // 3
+    w_g = w[:, :2 * d]  # update+reset recurrent weights
+    w_c = w[:, 2 * d:]
+    if bias is not None:
+        x = x + bias
+    h_prev = h0 if h0 is not None else jnp.zeros((b_sz, d), x.dtype)
+
+    xs = jnp.swapaxes(x, 0, 1)
+    if is_reverse:
+        xs = jnp.flip(xs, 0)
+    if lengths is None:
+        step_mask = jnp.ones((t, b_sz), x.dtype)
+    else:
+        step_mask = _mask(x, lengths, x.dtype).T
+        if is_reverse:
+            step_mask = jnp.flip(step_mask, 0)
+
+    def step(h, inp):
+        x_t, m_t = inp
+        gu_gr = gate_act(x_t[:, :2 * d] + h @ w_g)
+        u, r = jnp.split(gu_gr, 2, axis=1)
+        c = cand_act(x_t[:, 2 * d:] + (r * h) @ w_c)
+        # reference: h = (1-u)*h_prev + u*c (math/detail/gru_kernel.h:62)
+        h_new = (1 - u) * h + u * c
+        m = m_t[:, None]
+        h_out = m * h_new + (1 - m) * h
+        return h_out, h_out
+
+    _, hs = jax.lax.scan(step, h_prev, (xs, step_mask))
+    if is_reverse:
+        hs = jnp.flip(hs, 0)
+    out = jnp.swapaxes(hs, 0, 1)
+    ctx.set(op, 'Hidden', out)
+    ctx.set(op, 'BatchGate', x)
+    ctx.set(op, 'BatchResetHiddenPrev', out)
+    ctx.set(op, 'BatchHidden', out)
+
+
+@register_lowering('gru_unit')
+def _gru_unit(ctx, op):
+    """Single GRU step (reference operators/gru_unit_op.cc)."""
+    x = ctx.get(op, 'Input')  # [B, 3D]
+    h_prev = ctx.get(op, 'HiddenPrev')
+    w = ctx.get(op, 'Weight')  # [D, 3D]
+    bias = ctx.get(op, 'Bias')
+    gate_act = _act({1: 'sigmoid', 0: 'identity', 2: 'tanh',
+                     3: 'relu'}.get(op.attrs.get('gate_activation', 1)))
+    cand_act = _act({1: 'sigmoid', 0: 'identity', 2: 'tanh',
+                     3: 'relu'}.get(op.attrs.get('activation', 2)))
+    d = h_prev.shape[1]
+    if bias is not None:
+        x = x + bias
+    w_g = w[:, :2 * d]
+    w_c = w[:, 2 * d:]
+    g = gate_act(x[:, :2 * d] + h_prev @ w_g)
+    u, r = jnp.split(g, 2, axis=1)
+    c = cand_act(x[:, 2 * d:] + (r * h_prev) @ w_c)
+    # reference: h = u*(c - h_prev) + h_prev (gru_unit_op.h:116)
+    h = (1 - u) * h_prev + u * c
+    ctx.set(op, 'Gate', jnp.concatenate([g, c], axis=1))
+    ctx.set(op, 'ResetHiddenPrev', r * h_prev)
+    ctx.set(op, 'Hidden', h)
+
+
+@register_lowering('row_conv')
+def _row_conv(ctx, op):
+    """Lookahead row convolution (reference operators/row_conv_op.cc)."""
+    x = ctx.get(op, 'X')  # [B, T, D]
+    w = ctx.get(op, 'Filter')  # [future_ctx, D]
+    k = w.shape[0]
+    b, t, d = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
+    out = sum(xp[:, i:i + t] * w[i][None, None, :] for i in range(k))
+    ctx.set(op, 'Out', out)

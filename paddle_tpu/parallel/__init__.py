@@ -1,0 +1,22 @@
+"""SPMD parallelism over jax.sharding meshes.
+
+This package replaces the reference's entire multi-device machinery —
+ParallelExecutor's per-GPU SSA graphs with NCCL AllReduce op-handles
+(paddle/fluid/framework/details/multi_devices_graph_pass.cc:529,
+all_reduce_op_handle.cc:48) and the gRPC parameter-server transpile
+(transpiler/distribute_transpiler.py:180) — with XLA GSPMD: ONE program,
+sharding annotations, compiler-inserted collectives riding ICI/DCN.
+
+Axes convention: 'dp' data parallel, 'tp' tensor/model parallel, 'pp'
+pipeline stages, 'sp' sequence/context parallel, 'ep' expert parallel.
+"""
+
+import numpy as np
+
+from .mesh import make_mesh, mesh_axes, DeviceMesh
+from .api import shard, sharding_of, PartitionSpec
+
+__all__ = [
+    'make_mesh', 'mesh_axes', 'DeviceMesh', 'shard', 'sharding_of',
+    'PartitionSpec',
+]

@@ -1,0 +1,29 @@
+"""Sharding annotations on program variables.
+
+The reference expresses placement by *rewriting programs* (transpilers) or
+building per-device graphs; TPU-natively, placement is a property: annotate
+a Variable with a PartitionSpec and the SPMD executor lays it out, letting
+GSPMD insert collectives.
+"""
+
+from jax.sharding import PartitionSpec
+
+__all__ = ['shard', 'sharding_of', 'PartitionSpec']
+
+_ATTR = '_sharding_spec'
+
+
+def shard(var, *spec):
+    """Annotate a program Variable (or Parameter) with a PartitionSpec.
+
+    Example: shard(w, None, 'tp') — shard w's dim1 over the 'tp' mesh axis.
+    """
+    if len(spec) == 1 and isinstance(spec[0], PartitionSpec):
+        setattr(var, _ATTR, spec[0])
+    else:
+        setattr(var, _ATTR, PartitionSpec(*spec))
+    return var
+
+
+def sharding_of(var, default=None):
+    return getattr(var, _ATTR, default)

@@ -3,8 +3,15 @@ without TPU hardware (the driver separately dry-runs multi-chip compile)."""
 
 import os
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# force (not setdefault): the ambient env points JAX at the real TPU chip
+# (the axon sitecustomize overrides JAX_PLATFORMS via jax.config), but the
+# suite must run on the deterministic 8-device virtual CPU mesh
+os.environ['JAX_PLATFORMS'] = 'cpu'
 flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (
         flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')

@@ -1,0 +1,130 @@
+"""Sequence stack tests: LoD feeds lowered to padded+mask, masked sequence
+ops, scan-based dynamic LSTM/GRU, stacked-LSTM IMDB model
+(reference parity: test_lstm_op.py / test_seq_pool.py / book IMDB)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.dataset.imdb as imdb
+
+
+def _lod_feed(rows, dtype, dim=1):
+    """rows: list of per-sequence lists -> LoDTensor."""
+    flat = np.concatenate([np.asarray(r, dtype).reshape(-1, dim)
+                           for r in rows])
+    lt = fluid.core.LoDTensor(flat)
+    lt.set_recursive_sequence_lengths([[len(r) for r in rows]])
+    return lt
+
+
+def test_sequence_pool_matches_numpy():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(
+            name='x', shape=[3], dtype='float32', lod_level=1)
+        avg = fluid.layers.sequence_pool(x, 'average')
+        smax = fluid.layers.sequence_pool(x, 'max')
+        last = fluid.layers.sequence_last_step(x)
+        first = fluid.layers.sequence_first_step(x)
+    rows = [np.arange(6, dtype='float32').reshape(2, 3),
+            np.arange(9, dtype='float32').reshape(3, 3) + 1]
+    lt = _lod_feed([r.tolist() for r in rows], 'float32', dim=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        a, m, l, f = exe.run(
+            prog, feed={'x': lt}, fetch_list=[avg, smax, last, first])
+    np.testing.assert_allclose(a, np.stack([r.mean(0) for r in rows]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(m, np.stack([r.max(0) for r in rows]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(l, np.stack([r[-1] for r in rows]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(f, np.stack([r[0] for r in rows]),
+                               rtol=1e-5)
+
+
+def test_sequence_softmax_masks_padding():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(
+            name='x', shape=[1], dtype='float32', lod_level=1)
+        sm = fluid.layers.sequence_softmax(x)
+    rows = [[[0.5], [0.5]], [[1.0], [2.0], [3.0]]]
+    lt = _lod_feed(rows, 'float32')
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        out, = exe.run(prog, feed={'x': lt}, fetch_list=[sm])
+    # each sequence sums to 1 within its true length; padding is 0
+    assert out.shape[0] == 2
+    np.testing.assert_allclose(out[0, :2, 0].sum(), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(out[1, :3, 0].sum(), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(out[0, 2:], 0.0, atol=1e-7)
+
+
+def test_dynamic_lstm_shapes_and_grad():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(
+            name='x', shape=[8], dtype='float32', lod_level=1)
+        proj = fluid.layers.fc(input=x, size=16 * 4)
+        h, c = fluid.layers.dynamic_lstm(input=proj, size=16 * 4)
+        pooled = fluid.layers.sequence_pool(h, 'last')
+        loss = fluid.layers.mean(fluid.layers.reduce_sum(pooled, dim=[1]))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    rows = [np.random.RandomState(0).randn(l, 8).tolist() for l in (3, 5)]
+    lt = _lod_feed(rows, 'float32', dim=8)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        l1, = exe.run(prog, feed={'x': lt}, fetch_list=[loss])
+        l2, = exe.run(prog, feed={'x': lt}, fetch_list=[loss])
+    assert np.isfinite(l1).all() and np.isfinite(l2).all()
+    assert abs(float(l2[0])) != abs(float(l1[0]))  # params moved
+
+
+def test_dynamic_gru_runs():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(
+            name='x', shape=[6], dtype='float32', lod_level=1)
+        proj = fluid.layers.fc(input=x, size=12 * 3)
+        h = fluid.layers.dynamic_gru(input=proj, size=12)
+        pooled = fluid.layers.sequence_pool(h, 'average')
+    rows = [np.random.RandomState(1).randn(l, 6).tolist() for l in (2, 4)]
+    lt = _lod_feed(rows, 'float32', dim=6)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        out, = exe.run(prog, feed={'x': lt}, fetch_list=[pooled])
+    assert out.shape == (2, 12)
+    assert np.isfinite(out).all()
+
+
+def test_stacked_lstm_imdb_trains():
+    from paddle_tpu.models import stacked_lstm
+    model = stacked_lstm.build(dict_dim=200, hid_dim=32, emb_dim=32,
+                               stacked_num=2, lr=0.01)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feeder = fluid.DataFeeder(
+        feed_list=['words', 'label'], place=fluid.CPUPlace(),
+        program=model['main'])
+    reader = imdb.train(word_idx={i: i for i in range(200)}, n=16 * 8)
+    losses = []
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(model['startup'])
+        batch = []
+        for words, label in reader():
+            batch.append(([w % 200 for w in words], [label]))
+            if len(batch) == 16:
+                feed = feeder.feed(batch)
+                lv, = exe.run(model['main'], feed=feed,
+                              fetch_list=[model['loss']])
+                losses.append(float(lv[0]))
+                batch = []
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], losses
