@@ -1,0 +1,91 @@
+// Bounded blocking queue of byte buffers for reader prefetch.
+//
+// TPU-native equivalent of the reference's LoDTensorBlockingQueue
+// (paddle/fluid/operators/reader/lod_tensor_blocking_queue.h) +
+// BlockingQueue (operators/reader/blocking_queue.h): producer threads push
+// serialized minibatches, the executor pops them ahead of each compiled
+// step.  C ABI for ctypes; payload framing is the caller's business.
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+
+namespace {
+
+struct Queue {
+  std::mutex mu;
+  std::condition_variable not_full;
+  std::condition_variable not_empty;
+  std::deque<std::string> items;
+  size_t capacity;
+  bool closed = false;
+  std::string front_hold;  // keeps popped bytes alive for the caller
+};
+
+}  // namespace
+
+extern "C" {
+
+void* bq_create(uint64_t capacity) {
+  auto* q = new Queue;
+  q->capacity = capacity ? capacity : 1;
+  return q;
+}
+
+// 0 on success, -1 if closed.
+int bq_push(void* handle, const char* data, uint64_t len) {
+  auto* q = static_cast<Queue*>(handle);
+  std::unique_lock<std::mutex> lock(q->mu);
+  q->not_full.wait(lock, [q] {
+    return q->closed || q->items.size() < q->capacity;
+  });
+  if (q->closed) return -1;
+  q->items.emplace_back(data, len);
+  q->not_empty.notify_one();
+  return 0;
+}
+
+// Returns length (>0), 0 when closed+drained.  *data valid until next pop.
+int64_t bq_pop(void* handle, const char** data) {
+  auto* q = static_cast<Queue*>(handle);
+  std::unique_lock<std::mutex> lock(q->mu);
+  q->not_empty.wait(lock, [q] { return q->closed || !q->items.empty(); });
+  if (q->items.empty()) return 0;  // closed and drained
+  q->front_hold = std::move(q->items.front());
+  q->items.pop_front();
+  q->not_full.notify_one();
+  *data = q->front_hold.data();
+  return static_cast<int64_t>(q->front_hold.size());
+}
+
+uint64_t bq_size(void* handle) {
+  auto* q = static_cast<Queue*>(handle);
+  std::lock_guard<std::mutex> lock(q->mu);
+  return q->items.size();
+}
+
+void bq_close(void* handle) {
+  auto* q = static_cast<Queue*>(handle);
+  std::lock_guard<std::mutex> lock(q->mu);
+  q->closed = true;
+  q->not_empty.notify_all();
+  q->not_full.notify_all();
+}
+
+// Reopen after a reset (reference queue ReOpen()).
+void bq_reopen(void* handle) {
+  auto* q = static_cast<Queue*>(handle);
+  std::lock_guard<std::mutex> lock(q->mu);
+  q->closed = false;
+  q->items.clear();
+}
+
+void bq_destroy(void* handle) {
+  bq_close(handle);
+  delete static_cast<Queue*>(handle);
+}
+
+}  // extern "C"

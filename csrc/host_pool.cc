@@ -1,0 +1,101 @@
+// Pooled, size-bucketed host staging allocator.
+//
+// TPU-native analog of the reference's buddy allocator over pinned host
+// memory (paddle/fluid/memory/detail/buddy_allocator.h:34,
+// system_allocator.h CUDAPinnedAllocator): device memory belongs to PJRT,
+// but feed staging buffers churn every step — this pool recycles aligned
+// host blocks per power-of-two bucket with bounded cache, and reports
+// usage like memory::memory_usage().
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+constexpr size_t kAlignment = 64;  // cacheline; also XLA-friendly
+constexpr size_t kMaxCachedPerBucket = 8;
+
+struct Pool {
+  std::mutex mu;
+  std::map<size_t, std::vector<void*>> free_lists;  // bucket -> blocks
+  size_t in_use = 0;
+  size_t cached = 0;
+  size_t peak = 0;
+};
+
+Pool g_pool;
+
+size_t bucket_of(size_t n) {
+  size_t b = 64;
+  while (b < n) b <<= 1;
+  return b;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* hp_alloc(uint64_t size) {
+  size_t b = bucket_of(size);
+  {
+    std::lock_guard<std::mutex> lock(g_pool.mu);
+    auto it = g_pool.free_lists.find(b);
+    if (it != g_pool.free_lists.end() && !it->second.empty()) {
+      void* p = it->second.back();
+      it->second.pop_back();
+      g_pool.cached -= b;
+      g_pool.in_use += b;
+      if (g_pool.in_use > g_pool.peak) g_pool.peak = g_pool.in_use;
+      return p;
+    }
+    g_pool.in_use += b;
+    if (g_pool.in_use > g_pool.peak) g_pool.peak = g_pool.in_use;
+  }
+  void* p = nullptr;
+  if (posix_memalign(&p, kAlignment, b) != 0) return nullptr;
+  return p;
+}
+
+void hp_free(void* p, uint64_t size) {
+  if (!p) return;
+  size_t b = bucket_of(size);
+  std::lock_guard<std::mutex> lock(g_pool.mu);
+  g_pool.in_use -= b;
+  auto& fl = g_pool.free_lists[b];
+  if (fl.size() < kMaxCachedPerBucket) {
+    fl.push_back(p);
+    g_pool.cached += b;
+  } else {
+    free(p);
+  }
+}
+
+uint64_t hp_in_use() {
+  std::lock_guard<std::mutex> lock(g_pool.mu);
+  return g_pool.in_use;
+}
+
+uint64_t hp_cached() {
+  std::lock_guard<std::mutex> lock(g_pool.mu);
+  return g_pool.cached;
+}
+
+uint64_t hp_peak() {
+  std::lock_guard<std::mutex> lock(g_pool.mu);
+  return g_pool.peak;
+}
+
+void hp_release_all() {
+  std::lock_guard<std::mutex> lock(g_pool.mu);
+  for (auto& kv : g_pool.free_lists) {
+    for (void* p : kv.second) free(p);
+    kv.second.clear();
+  }
+  g_pool.cached = 0;
+}
+
+}  // extern "C"

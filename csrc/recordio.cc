@@ -1,0 +1,194 @@
+// RecordIO: chunked, optionally zlib-compressed record file format with a
+// CRC32-checked header per chunk.
+//
+// TPU-native re-design of the reference's paddle/fluid/recordio/
+// (chunk.h:27, header.h:27-34, writer.h, scanner.h): same capabilities —
+// append-only writer with chunked framing, sequential scanner, per-chunk
+// compression + checksum — exposed through a C ABI for ctypes instead of
+// pybind.  Layout per chunk:
+//   magic(u32)=0x0col0cec | compressor(u32) | num_records(u32) |
+//   raw_len(u32) | stored_len(u32) | crc32(u32 of stored payload) |
+//   payload[stored_len]
+// payload (after decompression) = num_records x { len(u32) | bytes }.
+
+#include <zlib.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x0c010cec;
+
+enum Compressor : uint32_t { kNone = 0, kZlib = 1 };
+
+struct Writer {
+  FILE* f = nullptr;
+  std::vector<std::string> pending;
+  size_t pending_bytes = 0;
+  size_t max_chunk_bytes;
+  uint32_t compressor;
+};
+
+struct Scanner {
+  FILE* f = nullptr;
+  std::vector<std::string> records;  // current chunk, decoded
+  size_t next = 0;
+};
+
+bool flush_chunk(Writer* w) {
+  if (w->pending.empty()) return true;
+  std::string raw;
+  raw.reserve(w->pending_bytes + 4 * w->pending.size());
+  for (const auto& r : w->pending) {
+    uint32_t len = static_cast<uint32_t>(r.size());
+    raw.append(reinterpret_cast<const char*>(&len), 4);
+    raw.append(r);
+  }
+  std::string stored;
+  if (w->compressor == kZlib) {
+    uLongf bound = compressBound(raw.size());
+    stored.resize(bound);
+    if (compress2(reinterpret_cast<Bytef*>(&stored[0]), &bound,
+                  reinterpret_cast<const Bytef*>(raw.data()), raw.size(),
+                  Z_BEST_SPEED) != Z_OK) {
+      return false;
+    }
+    stored.resize(bound);
+  } else {
+    stored = raw;
+  }
+  uint32_t header[6] = {
+      kMagic,
+      w->compressor,
+      static_cast<uint32_t>(w->pending.size()),
+      static_cast<uint32_t>(raw.size()),
+      static_cast<uint32_t>(stored.size()),
+      static_cast<uint32_t>(
+          crc32(0, reinterpret_cast<const Bytef*>(stored.data()),
+                stored.size())),
+  };
+  if (fwrite(header, sizeof(header), 1, w->f) != 1) return false;
+  if (!stored.empty() &&
+      fwrite(stored.data(), stored.size(), 1, w->f) != 1) {
+    return false;
+  }
+  w->pending.clear();
+  w->pending_bytes = 0;
+  return true;
+}
+
+bool load_chunk(Scanner* s) {
+  uint32_t header[6];
+  if (fread(header, sizeof(header), 1, s->f) != 1) return false;
+  if (header[0] != kMagic) return false;
+  const uint32_t compressor = header[1];
+  const uint32_t num_records = header[2];
+  const uint32_t raw_len = header[3];
+  const uint32_t stored_len = header[4];
+  const uint32_t want_crc = header[5];
+  std::string stored(stored_len, '\0');
+  if (stored_len && fread(&stored[0], stored_len, 1, s->f) != 1) {
+    return false;
+  }
+  if (crc32(0, reinterpret_cast<const Bytef*>(stored.data()),
+            stored.size()) != want_crc) {
+    return false;
+  }
+  std::string raw;
+  if (compressor == kZlib) {
+    raw.resize(raw_len);
+    uLongf out_len = raw_len;
+    if (uncompress(reinterpret_cast<Bytef*>(&raw[0]), &out_len,
+                   reinterpret_cast<const Bytef*>(stored.data()),
+                   stored.size()) != Z_OK ||
+        out_len != raw_len) {
+      return false;
+    }
+  } else {
+    raw = std::move(stored);
+  }
+  s->records.clear();
+  s->records.reserve(num_records);
+  size_t off = 0;
+  for (uint32_t i = 0; i < num_records; ++i) {
+    if (off + 4 > raw.size()) return false;
+    uint32_t len;
+    memcpy(&len, raw.data() + off, 4);
+    off += 4;
+    if (off + len > raw.size()) return false;
+    s->records.emplace_back(raw.data() + off, len);
+    off += len;
+  }
+  s->next = 0;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* recordio_writer_create(const char* path, int compressor,
+                             uint64_t max_chunk_bytes) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  auto* w = new Writer;
+  w->f = f;
+  w->compressor = compressor ? kZlib : kNone;
+  w->max_chunk_bytes = max_chunk_bytes ? max_chunk_bytes : (1 << 20);
+  return w;
+}
+
+int recordio_writer_write(void* handle, const char* data, uint64_t len) {
+  auto* w = static_cast<Writer*>(handle);
+  w->pending.emplace_back(data, len);
+  w->pending_bytes += len;
+  if (w->pending_bytes >= w->max_chunk_bytes) {
+    return flush_chunk(w) ? 0 : -1;
+  }
+  return 0;
+}
+
+int recordio_writer_close(void* handle) {
+  auto* w = static_cast<Writer*>(handle);
+  bool ok = flush_chunk(w);
+  fclose(w->f);
+  delete w;
+  return ok ? 0 : -1;
+}
+
+void* recordio_scanner_create(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  auto* s = new Scanner;
+  s->f = f;
+  return s;
+}
+
+// Status: 1 = record available, 0 = EOF, -1 = corruption.  The record
+// bytes stay valid until the next call; *data/*len describe them (a
+// zero-length record is a valid record, hence the separate status).
+int recordio_scanner_next(void* handle, const char** data, uint64_t* len) {
+  auto* s = static_cast<Scanner*>(handle);
+  while (s->next >= s->records.size()) {
+    if (feof(s->f)) return 0;
+    if (!load_chunk(s)) {
+      return feof(s->f) ? 0 : -1;
+    }
+  }
+  const std::string& r = s->records[s->next++];
+  *data = r.data();
+  *len = r.size();
+  return 1;
+}
+
+void recordio_scanner_destroy(void* handle) {
+  auto* s = static_cast<Scanner*>(handle);
+  fclose(s->f);
+  delete s;
+}
+
+}  // extern "C"

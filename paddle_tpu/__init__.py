@@ -10,5 +10,26 @@ __version__ = '0.1.0'
 from . import fluid  # noqa: F401
 from . import reader  # noqa: F401
 from . import dataset  # noqa: F401
+from . import parallel  # noqa: F401
+from . import inference  # noqa: F401
 
-__all__ = ['fluid', 'reader', 'dataset']
+
+def batch(reader_creator, batch_size, drop_last=False):
+    """Group a sample reader into a batched reader
+    (reference: python/paddle/batch.py)."""
+
+    def batch_reader():
+        r = reader_creator()
+        b = []
+        for instance in r:
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader
+
+
+__all__ = ['fluid', 'reader', 'dataset', 'parallel', 'inference', 'batch']

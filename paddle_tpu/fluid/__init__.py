@@ -36,6 +36,17 @@ from .io import (save_vars, save_params, save_persistables, load_vars,
                  load_inference_model, get_inference_program)
 from . import metrics
 from . import profiler
+from . import lod_tensor
+from .lod_tensor import create_lod_tensor, create_random_int_lodtensor
+from . import recordio_writer
+from . import transpiler
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, \
+    memory_optimize, release_memory, InferenceTranspiler
+from . import evaluator
+from . import debugger
+from .trainer import (Trainer, BeginEpochEvent, EndEpochEvent,
+                      BeginStepEvent, EndStepEvent, CheckpointConfig)
+from .inferencer import Inferencer
 
 Tensor = LoDTensor
 

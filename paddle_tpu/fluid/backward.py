@@ -39,8 +39,15 @@ def _creates_subblock(op):
     return op.type in ('while', 'conditional_block', 'recurrent')
 
 
+# op types that never get grad ops, regardless of connectivity
+_NO_GRAD_OP_TYPES = {'read', 'feed', 'fetch', 'while', 'print',
+                     'listen_and_serv'}
+
+
 def _make_grad_op_spec(block, op, grad_known, no_grad):
     """Plan one grad op: (inputs, outputs, attrs) or None."""
+    if op.type in _NO_GRAD_OP_TYPES:
+        return None
     out_grad_names = [n + GRAD for n in op.output_arg_names]
     if not any(g in grad_known for g in out_grad_names):
         return None

@@ -20,8 +20,14 @@ import numpy as np
 __all__ = [
     'CPUPlace', 'TPUPlace', 'CUDAPlace', 'Place', 'VarDesc', 'LoDTensor',
     'Scope', 'is_compiled_with_tpu', 'is_compiled_with_cuda',
-    'get_tpu_device_count',
+    'get_tpu_device_count', 'EOFException',
 ]
+
+
+class EOFException(Exception):
+    """Raised by Executor.run when a program's reader is exhausted
+    (reference: the C++ EOFException thrown by reader ops)."""
+    pass
 
 _jax = None
 _jax_lock = threading.Lock()

@@ -1,0 +1,45 @@
+"""Program debugging helpers (reference: python/paddle/fluid/debugger.py).
+
+``pprint_program_codes`` renders programs as pseudo-code;
+``draw_block_graphviz`` emits a .dot file of the op/var graph.
+"""
+
+__all__ = ['pprint_program_codes', 'pprint_block_codes',
+           'draw_block_graphviz']
+
+
+def pprint_program_codes(program):
+    return '\n'.join(
+        pprint_block_codes(blk) for blk in program.blocks)
+
+
+def pprint_block_codes(block):
+    lines = ['# block %d (parent %d)' % (block.idx, block.parent_idx)]
+    for v in block.vars.values():
+        tags = []
+        if v.persistable:
+            tags.append('persistable')
+        if getattr(v, 'trainable', False):
+            tags.append('trainable')
+        lines.append('var %s : shape=%s dtype=%s %s' %
+                     (v.name, list(v.shape), v.dtype, ','.join(tags)))
+    for op in block.ops:
+        outs = ', '.join('%s=%s' % (k, v) for k, v in op.outputs.items())
+        ins = ', '.join('%s=%s' % (k, v) for k, v in op.inputs.items())
+        lines.append('%s = %s(%s)' % (outs, op.type, ins))
+    return '\n'.join(lines)
+
+
+def draw_block_graphviz(block, highlights=None, path='./temp.dot'):
+    with open(path, 'w') as f:
+        f.write('digraph G {\n')
+        f.write('  rankdir=TB;\n')
+        for i, op in enumerate(block.ops):
+            f.write('  op_%d [label="%s", shape=box, style=filled, '
+                    'fillcolor="#a0cbe2"];\n' % (i, op.type))
+            for n in op.input_arg_names:
+                f.write('  "%s" -> op_%d;\n' % (n, i))
+            for n in op.output_arg_names:
+                f.write('  op_%d -> "%s";\n' % (i, n))
+        f.write('}\n')
+    return path

@@ -1,0 +1,103 @@
+"""Graph-state evaluators (reference: python/paddle/fluid/evaluator.py).
+
+An Evaluator owns persistable state vars updated by graph ops each
+minibatch plus an ``eval`` program reading them — the reference pattern.
+"""
+
+import numpy as np
+
+from . import layers
+from .framework import Program, Variable, program_guard
+from .layer_helper import LayerHelper
+from .initializer import Constant
+from .executor import global_scope
+
+__all__ = ['Accuracy', 'ChunkEvaluator', 'Evaluator']
+
+
+class Evaluator(object):
+    def __init__(self, name, **kwargs):
+        self.helper = LayerHelper(name, **kwargs)
+        self.states = []
+        self.metrics = []
+
+    def reset(self, executor, reset_program=None):
+        scope = global_scope()
+        for var in self.states:
+            v = scope.find_var(var.name)
+            if v is not None and v.value() is not None:
+                import numpy as _np
+                old = v.value()
+                arr = old.numpy() if hasattr(old, 'numpy') else \
+                    _np.asarray(old)
+                v.set_value(_np.zeros_like(arr))
+
+    def eval(self, executor, eval_program=None):
+        raise NotImplementedError()
+
+    def _create_state(self, suffix, dtype, shape):
+        state = self.helper.create_global_variable(
+            name='_'.join([unique_name(self.helper.name), suffix]),
+            persistable=True,
+            dtype=dtype,
+            shape=shape)
+        self.helper.set_variable_initializer(state, Constant(0.0))
+        self.states.append(state)
+        return state
+
+
+def unique_name(prefix):
+    from . import unique_name as un
+    return un.generate(prefix)
+
+
+class Accuracy(Evaluator):
+    """Streaming accuracy (reference evaluator.py Accuracy)."""
+
+    def __init__(self, input, label, k=1, **kwargs):
+        super(Accuracy, self).__init__('accuracy', **kwargs)
+        main_program = self.helper.main_program
+        if main_program.current_block().idx != 0:
+            raise ValueError('You can only invoke Evaluator in root block')
+
+        self.total = self._create_state(dtype='int64', shape=[1],
+                                        suffix='total')
+        self.correct = self._create_state(dtype='int64', shape=[1],
+                                          suffix='correct')
+        total = self.helper.create_variable_for_type_inference(dtype='int64')
+        correct = self.helper.create_variable_for_type_inference(
+            dtype='int64')
+        acc = layers.accuracy(
+            input=input, label=label, k=k, correct=correct, total=total)
+        layers.sums(input=[self.total, total], out=self.total)
+        layers.sums(input=[self.correct, correct], out=self.correct)
+        self.metrics.append(acc)
+
+    def eval(self, executor, eval_program=None):
+        if eval_program is None:
+            eval_program = Program()
+        block = eval_program.global_block()
+        with program_guard(main_program=eval_program):
+            total = layers.cast(_clone_var(block, self.total), 'float32')
+            correct = layers.cast(_clone_var(block, self.correct), 'float32')
+            out = layers.elementwise_div(x=correct, y=total)
+        return np.array(executor.run(eval_program, fetch_list=[out])[0])
+
+
+class ChunkEvaluator(Evaluator):
+    """Streaming chunk F1 (reference evaluator.py ChunkEvaluator) — state
+    accumulators over chunk_eval op outputs; the op lands with the NLP tail."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None):
+        raise NotImplementedError(
+            'chunk_eval op lands with the NLP parity tail; use '
+            'fluid.metrics.ChunkEvaluator for host-side accumulation')
+
+
+def _clone_var(block, var):
+    return block.create_var(
+        name=var.name,
+        shape=var.shape,
+        dtype=var.dtype,
+        persistable=True)

@@ -28,8 +28,13 @@ from ..ops import registry
 
 __all__ = ['Executor', 'global_scope', 'scope_guard', '_switch_scope']
 
-global_scope = core.global_scope
 _scope_stack = [core.global_scope()]
+
+
+def global_scope():
+    """The active scope: scope_guard swaps it, like the reference's
+    _switch_scope (python/paddle/fluid/executor.py:41-63)."""
+    return _scope_stack[-1]
 
 
 def _current_scope():
@@ -63,6 +68,29 @@ def as_numpy(value):
     if isinstance(value, core.LoDTensor):
         return value.numpy()
     return np.asarray(value)
+
+
+def _pop_readers_into_feed(program, feed):
+    """For each read op, pop one minibatch from its py_reader queue and
+    inject it as feeds (reference: reader ops produce LoDTensors inside the
+    interpreter loop; here data stays ahead of the compiled step).  Raises
+    core.EOFException when a reader is exhausted."""
+    for op in program.global_block().ops:
+        if op.type != 'read':
+            continue
+        from .layers import io as layers_io
+        reader_name = op.input('Reader')[0]
+        feeder = layers_io.get_reader_feeder(reader_name)
+        if feeder is None:
+            raise RuntimeError('no py_reader registered for %r' %
+                               reader_name)
+        batch = feeder.pop()
+        if batch is None:
+            raise core.EOFException(
+                'reader %r is exhausted — call reader.reset() and '
+                'reader.start() for the next pass' % reader_name)
+        for name, value in zip(op.output('Out'), batch):
+            feed[name] = value
 
 
 def prepare_feed_arrays(feed):
@@ -153,7 +181,10 @@ class _CompiledBlock(object):
         self.place = place
         block = self.block
 
-        ops = [op for op in block.ops if op.type not in ('feed', 'fetch')]
+        # read ops are satisfied on the host before the jitted call (their
+        # outputs arrive as feeds), keeping the compute path fully fused
+        ops = [op for op in block.ops
+               if op.type not in ('feed', 'fetch', 'read')]
         self.ops = ops
 
         # Walk program order to find which persistable vars must come from
@@ -302,6 +333,8 @@ class Executor(object):
         fetch_names = [
             f.name if isinstance(f, Variable) else str(f) for f in fetch_list
         ]
+        feed = dict(feed)
+        _pop_readers_into_feed(program, feed)
         feed_arrays = prepare_feed_arrays(feed)
         sig = feed_signature(feed_arrays)
         key = (id(program), program._version, tuple(fetch_names), sig,

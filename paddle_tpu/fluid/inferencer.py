@@ -1,0 +1,47 @@
+"""High-level Inferencer (reference: python/paddle/fluid/inferencer.py:31)."""
+
+import contextlib
+
+from . import core
+from .framework import Program, program_guard
+from .executor import Executor, scope_guard
+from . import io as fluid_io
+from . import unique_name
+
+__all__ = ['Inferencer']
+
+
+class Inferencer(object):
+    def __init__(self, infer_func, param_path, place=None, parallel=False):
+        """infer_func rebuilds the inference program; param_path holds the
+        persistables saved by Trainer.save_params."""
+        self.param_path = param_path
+        self.scope = core.Scope()
+        self.parallel = parallel
+        self.place = place if place is not None else core.CPUPlace()
+
+        self.startup_program = Program()
+        self.inference_program = Program()
+        with program_guard(self.inference_program, self.startup_program):
+            with unique_name.guard():
+                self.predict_var = infer_func()
+
+        self.exe = Executor(self.place)
+        with scope_guard(self.scope):
+            self.exe.run(self.startup_program)
+            fluid_io.load_persistables(
+                self.exe, param_path,
+                main_program=self.inference_program)
+
+        self.inference_program = self.inference_program.clone(for_test=True)
+
+    def infer(self, inputs, return_numpy=True):
+        if not isinstance(inputs, dict):
+            raise ValueError('inputs should be a dict of {name: data}')
+        with scope_guard(self.scope):
+            results = self.exe.run(
+                self.inference_program,
+                feed=inputs,
+                fetch_list=[self.predict_var.name],
+                return_numpy=return_numpy)
+        return results

@@ -12,6 +12,10 @@ from . import metric_op
 from .metric_op import *
 from . import sequence
 from .sequence import *
+from . import control_flow
+from .control_flow import *
+from . import learning_rate_scheduler
+from .learning_rate_scheduler import *
 from . import math_op_patch  # installs Variable operator overloads
 
 __all__ = []
@@ -21,3 +25,5 @@ __all__ += tensor.__all__
 __all__ += ops.__all__
 __all__ += metric_op.__all__
 __all__ += sequence.__all__
+__all__ += control_flow.__all__
+__all__ += learning_rate_scheduler.__all__

@@ -1,0 +1,577 @@
+"""Control-flow layers (reference: python/paddle/fluid/layers/control_flow.py).
+
+The reference's While/StaticRNN/DynamicRNN re-enter the C++ Executor per
+step with step-scopes (operators/while_op.cc:36-66, recurrent_op.cc:47-135).
+Here each construct builds a sub-block that lowers ONCE into a functional
+``lax.scan`` / ``lax.while_loop`` — compiler-friendly control flow with
+explicit carried state, per SURVEY §7 guiding decision 4.
+"""
+
+import contextlib
+
+from .. import core
+from ..framework import Variable, Operator, default_main_program
+from ..layer_helper import LayerHelper
+from ..initializer import Constant
+from .tensor import fill_constant
+
+__all__ = [
+    'While', 'StaticRNN', 'DynamicRNN', 'increment', 'array_write',
+    'array_read', 'array_length', 'less_than', 'equal', 'Switch', 'IfElse',
+    'zeros_like',
+]
+
+
+def less_than(x, y, cond=None, **ignored):
+    helper = LayerHelper('less_than', **locals())
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(dtype='bool')
+        cond.stop_gradient = True
+    helper.append_op(
+        type='less_than',
+        inputs={'X': [x],
+                'Y': [y]},
+        outputs={'Out': [cond]})
+    return cond
+
+
+def equal(x, y, cond=None, **ignored):
+    helper = LayerHelper('equal', **locals())
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(dtype='bool')
+        cond.stop_gradient = True
+    helper.append_op(
+        type='equal', inputs={'X': [x],
+                              'Y': [y]}, outputs={'Out': [cond]})
+    return cond
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper('increment', **locals())
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type='increment',
+        inputs={'X': [x]},
+        outputs={'Out': [out]},
+        attrs={'step': float(value)})
+    return out
+
+
+def zeros_like(x, out=None):
+    helper = LayerHelper('zeros_like', **locals())
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type='fill_zeros_like', inputs={'X': [x]}, outputs={'Out': [out]})
+    return out
+
+
+def array_write(x, i, array=None):
+    """Write x into a tensor array at index i (reference control_flow.py
+    array_write; operators/tensor_array_read_write.cc)."""
+    helper = LayerHelper('array_write', **locals())
+    if array is None:
+        array = helper.create_variable(
+            name='{0}.out'.format(helper.name),
+            type=core.VarDesc.VarType.LOD_TENSOR_ARRAY,
+            dtype=x.dtype)
+    helper.append_op(
+        type='write_to_array',
+        inputs={'X': [x],
+                'I': [i]},
+        outputs={'Out': [array]})
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper('array_read', **locals())
+    out = helper.create_variable_for_type_inference(dtype=array.dtype)
+    helper.append_op(
+        type='read_from_array',
+        inputs={'X': [array],
+                'I': [i]},
+        outputs={'Out': [out]})
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper('array_length', **locals())
+    tmp = helper.create_variable_for_type_inference(
+        dtype='int64', stop_gradient=True)
+    helper.append_op(
+        type='lod_array_length',
+        inputs={'X': [array]},
+        outputs={'Out': [tmp]})
+    return tmp
+
+
+def _external_reads(sub_block, exclude=()):
+    """Vars a sub-block reads from enclosing blocks (weights, globals).
+    Declared as explicit op inputs so the executor threads them into the
+    compiled state and backward can produce their gradients — the analog
+    of the reference while_op's X input list."""
+    exclude = set(exclude)
+    local_writes = set()
+    names = []
+    for op in sub_block.ops:
+        for n in op.input_arg_names:
+            if (n not in sub_block.vars and n not in local_writes and
+                    n not in exclude and n not in names):
+                names.append(n)
+        for n in op.output_arg_names:
+            local_writes.add(n)
+    return names
+
+
+class BlockGuard(object):
+    def __init__(self, main_program):
+        self.main_program = main_program
+
+    def __enter__(self):
+        self.main_program.create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.main_program.rollback()
+        return exc_type is None
+
+
+class While(object):
+    """while (cond) { sub-block } lowered to lax.while_loop
+    (reference control_flow.py:655).  Carried state = every parent var the
+    sub-block writes; tensor-array appends are supported when the loop
+    runs a statically-bounded counter (the common fluid pattern)."""
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.helper = LayerHelper('while', name=name)
+        if cond.dtype != core.VarDesc.VarType.BOOL:
+            raise TypeError('condition should be a bool variable')
+        self.cond_var = cond
+
+    @contextlib.contextmanager
+    def block(self):
+        main_program = self.helper.main_program
+        parent_idx = main_program.current_block_idx
+        sub_block = main_program.create_block()
+        try:
+            yield
+        finally:
+            main_program.rollback()
+        parent_block = main_program.block(parent_idx)
+        # vars the body writes that exist in an enclosing block = loop state
+        inner = sub_block
+        mod_names = []
+        for op in inner.ops:
+            for n in op.output_arg_names:
+                if n not in inner.vars and n not in mod_names:
+                    mod_names.append(n)
+        parent_block.append_op(
+            type='while',
+            inputs={
+                'Condition': [self.cond_var],
+                'X': _external_reads(sub_block, [self.cond_var.name]),
+            },
+            outputs={'Out': mod_names},
+            attrs={'sub_block': sub_block})
+
+
+class StaticRNN(object):
+    """Uniform-length RNN over time-major slices
+    (reference control_flow.py:430; operators/recurrent_op.cc).  Lowered to
+    one lax.scan."""
+
+    BEFORE_RNN_BLOCK = 0
+    IN_RNN_BLOCK = 1
+    AFTER_RNN_BLOCK = 2
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper('static_rnn', name=name)
+        self.memories = {}  # in-block mem var name -> (init name, update name)
+        self.inputs = []  # (seq var, in-block var)
+        self.outputs = []
+        self.status = StaticRNN.BEFORE_RNN_BLOCK
+        self.sub_block = None
+        self.parent_idx = None
+
+    @contextlib.contextmanager
+    def step(self):
+        main_program = self.helper.main_program
+        self.parent_idx = main_program.current_block_idx
+        self.sub_block = main_program.create_block()
+        self.status = StaticRNN.IN_RNN_BLOCK
+        try:
+            yield
+        finally:
+            main_program.rollback()
+            self.status = StaticRNN.AFTER_RNN_BLOCK
+            self._complete_op()
+
+    def _assert_in_rnn_block_(self, method):
+        if self.status != StaticRNN.IN_RNN_BLOCK:
+            raise ValueError('You must invoke {0} in rnn.step()'.format(
+                method))
+
+    def memory(self,
+               init=None,
+               shape=None,
+               batch_ref=None,
+               init_value=0.0,
+               init_batch_dim_idx=0,
+               ref_batch_dim_idx=1):
+        self._assert_in_rnn_block_('memory')
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError(
+                    'if init is None, memory at least need shape and '
+                    'batch_ref')
+            parent_block = self.helper.main_program.block(self.parent_idx)
+            ref_name = batch_ref.name
+            dim_idx = ref_batch_dim_idx
+            # batch_ref may be an in-block step input; the init op lives in
+            # the parent block, so reference the parent sequence var
+            # instead (its batch dim is axis 1, time-major)
+            for seq_name, step_name in self.inputs:
+                if step_name == ref_name:
+                    ref_name = seq_name
+                    dim_idx = ref_batch_dim_idx + 1
+                    break
+            init = parent_block.create_var(
+                name='{}.init'.format(self.helper.name),
+                dtype='float32',
+                shape=[-1] + list(shape))
+            parent_block.append_op(
+                type='fill_constant_batch_size_like',
+                inputs={'Input': [ref_name]},
+                outputs={'Out': [init]},
+                attrs={
+                    'shape': [-1] + list(shape),
+                    'value': float(init_value),
+                    'input_dim_idx': dim_idx,
+                    'dtype': init.dtype,
+                })
+        mem = self.sub_block.create_var(
+            name='{}.mem'.format(self.helper.name),
+            dtype=init.dtype,
+            shape=init.shape)
+        self.memories[mem.name] = [init.name, None]
+        return mem
+
+    def step_input(self, x):
+        # StaticRNN is time-major: x is [T, B, ...], each step sees [B, ...]
+        self._assert_in_rnn_block_('step_input')
+        ipt = self.sub_block.create_var(
+            name=x.name + '@step', dtype=x.dtype, shape=tuple(x.shape[1:]))
+        self.inputs.append((x.name, ipt.name))
+        return ipt
+
+    def step_output(self, o):
+        self._assert_in_rnn_block_('step_output')
+        self.outputs.append(o.name)
+
+    def output(self, *outputs):
+        for each in outputs:
+            self.step_output(each)
+
+    def update_memory(self, mem, var):
+        self._assert_in_rnn_block_('update_memory')
+        if mem.name not in self.memories:
+            raise ValueError('unknown memory %s' % mem.name)
+        self.memories[mem.name][1] = var.name
+
+    def _complete_op(self):
+        main_program = self.helper.main_program
+        parent_block = main_program.block(self.parent_idx)
+        out_vars = []
+        for name in self.outputs:
+            ov = parent_block.create_var(
+                name=name + '@rnn_out', dtype='float32')
+            out_vars.append(ov)
+        self._out_vars = out_vars
+        exclude = [i for _, i in self.inputs] + list(self.memories.keys())
+        parent_block.append_op(
+            type='recurrent',
+            inputs={
+                'SeqInputs': [n for n, _ in self.inputs],
+                'MemInits': [v[0] for v in self.memories.values()],
+                'ClosureInputs': _external_reads(self.sub_block, exclude),
+            },
+            outputs={'Out': out_vars},
+            attrs={
+                'sub_block': self.sub_block,
+                'step_input_names': [i for _, i in self.inputs],
+                'mem_names': list(self.memories.keys()),
+                'mem_update_names': [v[1] for v in self.memories.values()],
+                'output_names': list(self.outputs),
+                'time_major': True,
+                'masked': False,
+            })
+
+    def __call__(self, *args, **kwargs):
+        if self.status != StaticRNN.AFTER_RNN_BLOCK:
+            raise ValueError('RNN output can only be retrieved after the '
+                             'step block')
+        if len(self._out_vars) == 1:
+            return self._out_vars[0]
+        return self._out_vars
+
+
+class DynamicRNN(object):
+    """Variable-length RNN (reference control_flow.py:1542).
+
+    The reference sorts sequences by length into a LoDRankTable, shards
+    timesteps into a LoDTensorArray, and drives a while-op with shrinking
+    batch (lod_rank_table_op, shrink_rnn_memory_op).  Lowered here as one
+    masked lax.scan over the padded batch — same results, no reordering."""
+
+    BEFORE_RNN = 0
+    IN_RNN = 1
+    AFTER_RNN = 2
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper('dynamic_rnn', name=name)
+        self.status = DynamicRNN.BEFORE_RNN
+        self.memories = {}
+        self.inputs = []
+        self.static_inputs = []
+        self.outputs = []
+        self.sub_block = None
+        self.parent_idx = None
+
+    @contextlib.contextmanager
+    def block(self):
+        main_program = self.helper.main_program
+        self.parent_idx = main_program.current_block_idx
+        self.sub_block = main_program.create_block()
+        self.status = DynamicRNN.IN_RNN
+        try:
+            yield
+        finally:
+            main_program.rollback()
+            self.status = DynamicRNN.AFTER_RNN
+            self._complete_op()
+
+    def step_input(self, x, level=0):
+        if self.status != DynamicRNN.IN_RNN:
+            raise ValueError('step_input must be called in block()')
+        # x's desc shape is the concatenated LoD form (total, ...), which is
+        # already time-free: the per-step batch slice has the same rank
+        ipt = self.sub_block.create_var(
+            name=x.name + '@step', dtype=x.dtype, shape=tuple(x.shape))
+        self.inputs.append((x.name, ipt.name))
+        return ipt
+
+    def static_input(self, x):
+        if self.status != DynamicRNN.IN_RNN:
+            raise ValueError('static_input must be called in block()')
+        # visible unchanged every step (closure)
+        self.static_inputs.append(x.name)
+        return x
+
+    def memory(self,
+               init=None,
+               shape=None,
+               value=0.0,
+               need_reorder=False,
+               dtype='float32'):
+        if self.status != DynamicRNN.IN_RNN:
+            raise ValueError('memory must be called in block()')
+        if init is None:
+            if shape is None:
+                raise ValueError('memory needs init or shape')
+            parent_block = self.helper.main_program.block(self.parent_idx)
+            first_seq = self.inputs[0][0] if self.inputs else None
+            init = parent_block.create_var(
+                name='{}.mem_init'.format(self.helper.name),
+                dtype=dtype,
+                shape=[-1] + list(shape))
+            parent_block.append_op(
+                type='fill_constant_batch_size_like',
+                inputs={'Input': [first_seq]},
+                outputs={'Out': [init]},
+                attrs={
+                    'shape': [-1] + list(shape),
+                    'value': float(value),
+                    'dtype': init.dtype,
+                })
+        mem = self.sub_block.create_var(
+            name='{}.mem.{}'.format(self.helper.name, len(self.memories)),
+            dtype=init.dtype,
+            shape=init.shape)
+        self.memories[mem.name] = [init.name, None]
+        return mem
+
+    def update_memory(self, ex_mem, new_mem):
+        if ex_mem.name not in self.memories:
+            raise ValueError('unknown memory %s' % ex_mem.name)
+        self.memories[ex_mem.name][1] = new_mem.name
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.outputs.append(o.name)
+
+    def _complete_op(self):
+        main_program = self.helper.main_program
+        parent_block = main_program.block(self.parent_idx)
+        out_vars = []
+        for name in self.outputs:
+            ov = parent_block.create_var(
+                name=name + '@rnn_out', dtype='float32', lod_level=1)
+            out_vars.append(ov)
+        self._out_vars = out_vars
+        exclude = [i for _, i in self.inputs] + list(self.memories.keys())
+        parent_block.append_op(
+            type='recurrent',
+            inputs={
+                'SeqInputs': [n for n, _ in self.inputs],
+                'MemInits': [v[0] for v in self.memories.values()],
+                'StaticInputs': list(self.static_inputs),
+                'ClosureInputs': _external_reads(
+                    self.sub_block, exclude + list(self.static_inputs)),
+            },
+            outputs={'Out': out_vars},
+            attrs={
+                'sub_block': self.sub_block,
+                'step_input_names': [i for _, i in self.inputs],
+                'mem_names': list(self.memories.keys()),
+                'mem_update_names': [v[1] for v in self.memories.values()],
+                'output_names': list(self.outputs),
+                'time_major': False,
+                'masked': True,
+            })
+
+    def __call__(self, *args, **kwargs):
+        if self.status != DynamicRNN.AFTER_RNN:
+            raise ValueError(
+                'Output of the dynamic RNN can only be visited outside the '
+                'rnn block')
+        if len(self._out_vars) == 1:
+            return self._out_vars[0]
+        return self._out_vars
+
+
+class Switch(object):
+    """Piecewise case construct (reference control_flow.py:1286).  Each
+    case's sub-block is lowered and blended with jnp.where — all branches
+    execute (XLA-friendly select), semantics match when branches are
+    side-effect-free (the LR-scheduler use)."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper('switch', name=name)
+        self.cases = []  # (cond name or None, sub_block)
+        self.parent_idx = None
+
+    @contextlib.contextmanager
+    def case(self, condition):
+        main_program = self.helper.main_program
+        if self.parent_idx is None:
+            self.parent_idx = main_program.current_block_idx
+        sub_block = main_program.create_block()
+        try:
+            yield
+        finally:
+            main_program.rollback()
+        self.cases.append((condition.name, sub_block))
+
+    @contextlib.contextmanager
+    def default(self):
+        main_program = self.helper.main_program
+        sub_block = main_program.create_block()
+        try:
+            yield
+        finally:
+            main_program.rollback()
+        self.cases.append((None, sub_block))
+
+    @contextlib.contextmanager
+    def block(self):
+        try:
+            yield self
+        finally:
+            parent_block = self.helper.main_program.block(
+                self.parent_idx if self.parent_idx is not None else
+                self.helper.main_program.current_block_idx)
+            written = []
+            for _, sb in self.cases:
+                for op in sb.ops:
+                    for n in op.output_arg_names:
+                        if n not in sb.vars and n not in written:
+                            written.append(n)
+            parent_block.append_op(
+                type='switch_case',
+                inputs={
+                    'Conditions':
+                    [c for c, _ in self.cases if c is not None]
+                },
+                outputs={'Out': written},
+                attrs={
+                    'case_conds': [c for c, _ in self.cases],
+                    'case_blocks': [sb for _, sb in self.cases],
+                })
+
+
+class IfElse(object):
+    """Two-branch conditional (reference control_flow.py:1412).  Both
+    branches lower; outputs select elementwise on the condition."""
+
+    OUT_IF_ELSE_BLOCKS = 2
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper('ifelse', name=name)
+        self.cond = cond
+        self.blocks = {}  # True/False -> sub_block
+        self.outputs = {True: [], False: []}
+        self.parent_idx = None
+        self._out_vars = None
+
+    @contextlib.contextmanager
+    def true_block(self):
+        with self._block(True):
+            yield
+
+    @contextlib.contextmanager
+    def false_block(self):
+        with self._block(False):
+            yield
+
+    @contextlib.contextmanager
+    def _block(self, branch):
+        main_program = self.helper.main_program
+        if self.parent_idx is None:
+            self.parent_idx = main_program.current_block_idx
+        sub_block = main_program.create_block()
+        self._current_branch = branch
+        try:
+            yield
+        finally:
+            main_program.rollback()
+            self.blocks[branch] = sub_block
+
+    def input(self, x):
+        return x
+
+    def output(self, *outs):
+        self.outputs[self._current_branch].extend([o.name for o in outs])
+
+    def __call__(self):
+        if len(self.outputs[True]) != len(self.outputs[False]):
+            raise ValueError('true/false branches must output equally')
+        parent_block = self.helper.main_program.block(self.parent_idx)
+        out_vars = []
+        for t_name in self.outputs[True]:
+            ov = parent_block.create_var(
+                name=t_name + '@ifelse', dtype='float32')
+            out_vars.append(ov)
+        parent_block.append_op(
+            type='ifelse',
+            inputs={'Cond': [self.cond]},
+            outputs={'Out': out_vars},
+            attrs={
+                'true_block': self.blocks.get(True),
+                'false_block': self.blocks.get(False),
+                'true_out': list(self.outputs[True]),
+                'false_out': list(self.outputs[False]),
+            })
+        return out_vars

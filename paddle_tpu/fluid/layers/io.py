@@ -1,11 +1,36 @@
-"""Input layers (reference: python/paddle/fluid/layers/io.py)."""
+"""Input layers + reader pipeline (reference: python/paddle/fluid/layers/io.py).
+
+py_reader (reference io.py:474) feeds minibatches through the native
+blocking queue (csrc/blocking_queue.cc) from a background thread; the
+executor pops each batch on the host and feeds the compiled XLA step —
+double-buffering comes from the queue plus JAX's async dispatch rather
+than a device-side double_buffer reader op.
+"""
+
+import pickle
+import threading
+
+import numpy as np
 
 from .. import core
+from .. import unique_name
 from ..framework import default_main_program, default_startup_program, \
     Variable
 from ..layer_helper import LayerHelper
 
-__all__ = ['data']
+__all__ = ['data', 'py_reader', 'read_file', 'batch', 'double_buffer',
+           'open_recordio_file', 'shuffle', 'Preprocessor']
+
+# reader var name -> _PyReaderFeeder.  Weak values: the strong reference
+# lives on the reader Variable (program lifetime), so discarding a program
+# frees its feeder/queue instead of leaking per py_reader() call.
+import weakref
+
+_READER_REGISTRY = weakref.WeakValueDictionary()
+
+
+def get_reader_feeder(name):
+    return _READER_REGISTRY.get(name)
 
 
 def data(name,
@@ -33,3 +58,219 @@ def data(name,
         is_data=True,
         persistable=False)
     return data_var
+
+
+class _PyReaderFeeder(object):
+    """Producer side of a py_reader: background thread -> native queue."""
+
+    def __init__(self, capacity, shapes, dtypes, lod_levels):
+        from ...runtime import NativeBlockingQueue
+        self.queue = NativeBlockingQueue(capacity)
+        self.shapes = shapes
+        self.dtypes = dtypes
+        self.lod_levels = lod_levels or [0] * len(shapes)
+        self._provider = None
+        self._thread = None
+        self._exhausted = False
+        self._error = None
+        self._shuffle_buffer = 0
+
+    def decorate_paddle_reader(self, reader, places=None):
+        """reader yields per-sample tuples; batches are assembled with
+        DataFeeder semantics by the caller via paddle.batch-style readers
+        that already yield lists of samples."""
+        from ..data_feeder import DataToLoDTensorConverter
+
+        def provider():
+            for batch_rows in reader():
+                converters = [
+                    DataToLoDTensorConverter(None, lod, shape, dtype)
+                    for lod, shape, dtype in zip(
+                        self.lod_levels, self.shapes, self.dtypes)
+                ]
+                for row in batch_rows:
+                    for conv, slot in zip(converters, row):
+                        conv.feed(slot)
+                yield tuple(c.done() for c in converters)
+
+        self._provider = provider
+
+    def decorate_tensor_provider(self, provider):
+        """provider yields tuples of numpy arrays / LoDTensors directly."""
+
+        def gen():
+            for item in provider():
+                yield tuple(item)
+
+        self._provider = gen
+
+    def start(self):
+        if self._provider is None:
+            raise RuntimeError('decorate a data source before start()')
+        self.queue.reopen()
+        self._exhausted = False
+        self._error = None
+
+        provider = self._provider
+        if self._shuffle_buffer > 1:
+            provider = _shuffled_provider(provider, self._shuffle_buffer)
+
+        def work():
+            try:
+                for batch in provider():
+                    # in-process framing only (never persisted to disk)
+                    if not self.queue.push(pickle.dumps(batch, protocol=4)):
+                        return
+            except BaseException as e:  # surface to the consumer, not EOF
+                self._error = e
+            finally:
+                self.queue.close()
+
+        self._thread = threading.Thread(target=work, daemon=True)
+        self._thread.start()
+
+    def pop(self):
+        data = self.queue.pop()
+        if data is None:
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise RuntimeError(
+                    'py_reader data provider failed: %r' % (err, )) from err
+            self._exhausted = True
+            return None
+        return pickle.loads(data)
+
+    def reset(self):
+        self.queue.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._thread = None
+
+
+def py_reader(capacity,
+              shapes,
+              dtypes,
+              lod_levels=None,
+              name=None,
+              use_double_buffer=True):
+    """Create a feedable reader (reference layers/io.py:474).
+
+    Returns a reader Variable with ``decorate_paddle_reader`` /
+    ``decorate_tensor_provider`` / ``start`` / ``reset`` methods; pair with
+    :func:`read_file` to get the data variables."""
+    helper = LayerHelper('py_reader', name=name)
+    reader = helper.create_global_variable(
+        name=unique_name.generate('create_py_reader'),
+        type=core.VarDesc.VarType.READER,
+        persistable=True)
+    feeder = _PyReaderFeeder(capacity, list(shapes), list(dtypes),
+                             lod_levels)
+    reader._feeder = feeder  # strong ref: feeder lives as long as the var
+    _READER_REGISTRY[reader.name] = feeder
+    reader._shapes = list(shapes)
+    reader._dtypes = list(dtypes)
+    reader._lod_levels = lod_levels or [0] * len(shapes)
+    reader.decorate_paddle_reader = feeder.decorate_paddle_reader
+    reader.decorate_tensor_provider = feeder.decorate_tensor_provider
+    reader.start = feeder.start
+    reader.reset = feeder.reset
+    return reader
+
+
+def read_file(reader):
+    """Emit the read op producing this reader's data vars
+    (reference layers/io.py read_file)."""
+    helper = LayerHelper('read_file')
+    out = []
+    for shape, dtype, lod in zip(reader._shapes, reader._dtypes,
+                                 reader._lod_levels):
+        v = helper.create_variable_for_type_inference(
+            dtype, stop_gradient=True)
+        v.shape = tuple(shape)
+        v.lod_level = lod
+        v.is_data = True
+        out.append(v)
+    helper.append_op(
+        type='read',
+        inputs={'Reader': [reader]},
+        outputs={'Out': out})
+    if len(out) == 1:
+        return out[0]
+    return out
+
+
+def batch(reader, batch_size):
+    """Kept for reader-pipeline API parity; batching happens host-side."""
+    return reader
+
+
+def double_buffer(reader, place=None, name=None):
+    """Device prefetch is provided by the queue + async dispatch; identity
+    for API parity (reference layers/io.py:891)."""
+    return reader
+
+
+def _shuffled_provider(provider, buffer_size):
+    import random
+
+    def gen():
+        buf = []
+        for item in provider():
+            buf.append(item)
+            if len(buf) >= buffer_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        random.shuffle(buf)
+        for b in buf:
+            yield b
+
+    return gen
+
+
+def shuffle(reader, buffer_size):
+    """Shuffle a py_reader's batches through a host-side reservoir
+    (reference layers/io.py shuffle created a shuffle-reader op)."""
+    feeder = get_reader_feeder(reader.name)
+    if feeder is not None:
+        feeder._shuffle_buffer = int(buffer_size)
+    return reader
+
+
+def open_recordio_file(filename,
+                       shapes,
+                       dtypes,
+                       lod_levels=None,
+                       pass_num=1,
+                       for_parallel=True):
+    """Reader over a recordio file written by
+    paddle_tpu.recordio / fluid.recordio_writer (reference
+    operators/reader/create_recordio_file_reader_op.cc)."""
+    rd = py_reader(64, shapes, dtypes, lod_levels)
+
+    def provider():
+        import io as _io
+        from ...runtime import RecordIOScanner
+        for _ in range(pass_num):
+            scanner = RecordIOScanner(filename)
+            for rec in scanner:
+                # records are npz-framed (data-only, no code execution)
+                with np.load(_io.BytesIO(rec), allow_pickle=False) as z:
+                    yield tuple(z['arr_%d' % i]
+                                for i in range(len(z.files)))
+            scanner.close()
+
+    rd.decorate_tensor_provider(provider)
+    return rd
+
+
+class Preprocessor(object):
+    """Reference layers/io.py Preprocessor: custom reader transform blocks.
+    Host-side transforms belong in paddle_tpu.reader decorators; kept as a
+    documented stub for API parity."""
+
+    def __init__(self, reader, name=None):
+        raise NotImplementedError(
+            'use paddle_tpu.reader.map_readers/xmap_readers for host-side '
+            'preprocessing')

@@ -1,0 +1,154 @@
+"""Learning-rate decay schedules
+(reference: python/paddle/fluid/layers/learning_rate_scheduler.py).
+
+Each schedule appends ops computing the decayed LR from the global step
+counter — graph ops, so the whole schedule compiles into the training step.
+"""
+
+import math
+
+from ..framework import default_main_program
+from ..initializer import Constant
+from ..layer_helper import LayerHelper
+from . import nn
+from . import ops
+from . import tensor
+from . import control_flow
+
+__all__ = [
+    'exponential_decay', 'natural_exp_decay', 'inverse_time_decay',
+    'polynomial_decay', 'piecewise_decay', 'noam_decay', 'append_LARS',
+]
+
+
+def _decay_step_counter(begin=0):
+    global_step = nn.autoincreased_step_counter(
+        counter_name='@LR_DECAY_COUNTER@', begin=begin, step=1)
+    global_step = tensor.cast(global_step, 'float32')
+    return global_step
+
+
+def noam_decay(d_model, warmup_steps):
+    """lr = d_model^-0.5 * min(step^-0.5, step * warmup^-1.5)
+    (reference learning_rate_scheduler.py:36)."""
+    global_step = _decay_step_counter(1)
+    a = ops.pow(global_step, factor=-0.5)
+    b = ops.scale(global_step, scale=warmup_steps**-1.5)
+    lr_value = ops.scale(
+        nn.elementwise_min(a, b), scale=d_model**-0.5)
+    return lr_value
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """lr * decay_rate ^ (step / decay_steps)."""
+    global_step = _decay_step_counter()
+    div_res = ops.scale(global_step, scale=1.0 / decay_steps)
+    if staircase:
+        div_res = ops.floor(div_res)
+    # rate^x = exp(x * ln rate)
+    decayed = ops.exp(ops.scale(div_res, scale=math.log(decay_rate)))
+    return ops.scale(decayed, scale=float(learning_rate))
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """lr * exp(-decay_rate * step / decay_steps)."""
+    global_step = _decay_step_counter()
+    div_res = ops.scale(global_step, scale=1.0 / decay_steps)
+    if staircase:
+        div_res = ops.floor(div_res)
+    decayed = ops.exp(ops.scale(div_res, scale=-float(decay_rate)))
+    return ops.scale(decayed, scale=float(learning_rate))
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    """lr / (1 + decay_rate * step / decay_steps)."""
+    global_step = _decay_step_counter()
+    div_res = ops.scale(global_step, scale=1.0 / decay_steps)
+    if staircase:
+        div_res = ops.floor(div_res)
+    denom = ops.scale(div_res, scale=float(decay_rate), bias=1.0)
+    one = tensor.fill_constant(shape=[1], dtype='float32',
+                               value=float(learning_rate))
+    return nn.elementwise_div(one, denom)
+
+
+def polynomial_decay(learning_rate,
+                     decay_steps,
+                     end_learning_rate=0.0001,
+                     power=1.0,
+                     cycle=False):
+    """(lr - end) * (1 - step/decay_steps)^power + end."""
+    global_step = _decay_step_counter()
+    if cycle:
+        div_res = ops.ceil(
+            ops.scale(global_step, scale=1.0 / decay_steps))
+        # when step == 0, div_res should be 1
+        zero = tensor.fill_constant(shape=[1], dtype='float32', value=0.0)
+        one = tensor.fill_constant(shape=[1], dtype='float32', value=1.0)
+        div_res = nn.elementwise_max(div_res, one)
+        decay_steps_var = ops.scale(div_res, scale=float(decay_steps))
+        ratio = nn.elementwise_div(global_step, decay_steps_var)
+    else:
+        capped = nn.elementwise_min(
+            global_step,
+            tensor.fill_constant(
+                shape=[1], dtype='float32', value=float(decay_steps)))
+        ratio = ops.scale(capped, scale=1.0 / decay_steps)
+    base = ops.scale(ratio, scale=-1.0, bias=1.0)
+    powed = ops.pow(base, factor=float(power))
+    return ops.scale(
+        powed,
+        scale=float(learning_rate) - float(end_learning_rate),
+        bias=0.0) + float(end_learning_rate)
+
+
+def piecewise_decay(boundaries, values):
+    """Step-wise constant LR (reference learning_rate_scheduler.py
+    piecewise_decay) — lowered as a chain of selects instead of a Switch
+    block: lr = values[i] for boundaries[i-1] <= step < boundaries[i]."""
+    if len(values) - len(boundaries) != 1:
+        raise ValueError('len(values) must be len(boundaries) + 1')
+    global_step = _decay_step_counter()
+    lr = tensor.fill_constant(
+        shape=[1], dtype='float32', value=float(values[-1]))
+    # fold from the last boundary backwards with where-selects
+    for b, v in zip(reversed(boundaries), reversed(values[:-1])):
+        boundary = tensor.fill_constant(
+            shape=[1], dtype='float32', value=float(b))
+        cond = control_flow.less_than(global_step, boundary)
+        vconst = tensor.fill_constant(
+            shape=[1], dtype='float32', value=float(v))
+        helper = LayerHelper('piecewise_select')
+        out = helper.create_variable_for_type_inference('float32')
+        helper.append_op(
+            type='where_select',
+            inputs={'Cond': [cond],
+                    'X': [vconst],
+                    'Y': [lr]},
+            outputs={'Out': [out]})
+        lr = out
+    return lr
+
+
+def append_LARS(params_grads, learning_rate, weight_decay):
+    """LARS per-layer scaling (reference learning_rate_scheduler.py:312)."""
+
+    def _balanced_weight(param_norm, grad_norm):
+        if weight_decay == 1.0:
+            return grad_norm + param_norm
+        return grad_norm + weight_decay * param_norm
+
+    for param, grad in params_grads:
+        param_lr = param.optimize_attr['learning_rate']
+        param_norm = ops.sqrt(nn.reduce_sum(input=ops.square(param)))
+        grad_norm = ops.sqrt(nn.reduce_sum(input=ops.square(grad)))
+        if type(param_lr) == float and param_lr == 1.0:
+            decayed_lr = learning_rate * param_norm / _balanced_weight(
+                param_norm, grad_norm)
+        else:
+            decayed_lr = learning_rate * param_lr * param_norm / \
+                _balanced_weight(param_norm, grad_norm)
+        param.optimize_attr['learning_rate'] = decayed_lr

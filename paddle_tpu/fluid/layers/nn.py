@@ -742,7 +742,7 @@ def _reduce(op_type, input, dim=None, keep_dim=False, name=None):
     if dim is not None and not isinstance(dim, (list, tuple)):
         dim = [dim]
     shape = list(input.shape)
-    if dim is None:
+    if dim is None or not shape:
         out.shape = (1, )
     else:
         dims = sorted(d % len(shape) for d in dim)

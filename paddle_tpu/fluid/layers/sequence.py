@@ -199,7 +199,8 @@ def sequence_pool(input, pool_type):
     dtype = helper.input_dtype()
     pool_out = helper.create_variable_for_type_inference(dtype)
     max_index = helper.create_variable_for_type_inference(dtype='int32')
-    pool_out.shape = (input.shape[0], input.shape[-1])
+    if len(input.shape) >= 2:
+        pool_out.shape = (input.shape[0], input.shape[-1])
     helper.append_op(
         type='sequence_pool',
         inputs={'X': [input]},

@@ -1,0 +1,47 @@
+"""LoDTensor helpers (reference: python/paddle/fluid/lod_tensor.py)."""
+
+import numpy as np
+
+from . import core
+
+__all__ = ['create_lod_tensor', 'create_random_int_lodtensor']
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """Create a LoDTensor from numpy / list data + per-level lengths
+    (reference lod_tensor.py:24)."""
+    if isinstance(data, core.LoDTensor):
+        return create_lod_tensor(data.numpy(), recursive_seq_lens, place)
+    if isinstance(data, list):
+        flat = []
+
+        def _flatten(d, level):
+            if level == 0:
+                flat.append(np.asarray(d).reshape(-1, 1) if np.asarray(
+                    d).ndim <= 1 else np.asarray(d))
+            else:
+                for x in d:
+                    _flatten(x, level - 1)
+
+        total = sum(recursive_seq_lens[-1])
+        arrs = [np.asarray(row).reshape(len(row), -1) if not np.isscalar(
+            row) else np.asarray([[row]]) for row in data]
+        data = np.concatenate(arrs, axis=0)
+    data = np.asarray(data)
+    t = core.LoDTensor(data)
+    t.set_recursive_sequence_lengths(recursive_seq_lens)
+    assert t.has_valid_recursive_sequence_lengths(), \
+        'invalid recursive_seq_lens for data shape %s' % (data.shape, )
+    return t
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place, low,
+                                high):
+    assert isinstance(base_shape, list), 'base_shape should be a list'
+    converted_lod = recursive_seq_lens[-1]
+    total = sum(converted_lod)
+    shape = [total] + base_shape
+    data = np.random.random_integers(low, high, shape).astype('int64')
+    t = core.LoDTensor(data)
+    t.set_recursive_sequence_lengths(recursive_seq_lens)
+    return t

@@ -163,6 +163,9 @@ class ParallelExecutor(object):
         fetch_names = [
             f.name if isinstance(f, Variable) else str(f) for f in fetch_list
         ]
+        from .executor import _pop_readers_into_feed
+        feed = dict(feed)
+        _pop_readers_into_feed(program, feed)
         feed_arrays = prepare_feed_arrays(feed)
         sig = feed_signature(feed_arrays)
         key = (id(program), program._version, tuple(fetch_names), sig)

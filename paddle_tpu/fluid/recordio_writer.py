@@ -1,0 +1,88 @@
+"""Convert python readers to recordio files
+(reference: python/paddle/fluid/recordio_writer.py).
+
+Records are npz-framed numpy tuples (data-only) inside the chunked
+recordio container implemented in csrc/recordio.cc.
+"""
+
+import contextlib
+import io as _io
+
+import numpy as np
+
+from ..runtime import RecordIOWriter
+from . import core
+
+__all__ = ['convert_reader_to_recordio_file',
+           'convert_reader_to_recordio_files']
+
+
+def _serialize_batch(arrays):
+    buf = _io.BytesIO()
+    np.savez(buf, *[np.asarray(a if not isinstance(a, core.LoDTensor)
+                               else a.numpy()) for a in arrays])
+    return buf.getvalue()
+
+
+def convert_reader_to_recordio_file(filename,
+                                    reader_creator,
+                                    feeder,
+                                    compressor='zlib',
+                                    max_num_records=1000,
+                                    feed_order=None):
+    """Drain a batched reader through a DataFeeder into one recordio file;
+    returns the record count (reference recordio_writer.py:36)."""
+    if feed_order is None:
+        feed_order = feeder.feed_names
+    counter = 0
+    with contextlib.closing(_WriterCM(filename, compressor)) as w:
+        for batch in reader_creator():
+            feed_dict = feeder.feed(batch)
+            arrays = [feed_dict[name] for name in feed_order]
+            w.write(_serialize_batch(arrays))
+            counter += 1
+            if counter >= max_num_records:
+                break
+    return counter
+
+
+def convert_reader_to_recordio_files(filename,
+                                     batch_per_file,
+                                     reader_creator,
+                                     feeder,
+                                     compressor='zlib',
+                                     max_num_records=1000,
+                                     feed_order=None):
+    if feed_order is None:
+        feed_order = feeder.feed_names
+    f_name, f_ext = filename.rsplit('.', 1)
+    files = []
+    batch_id = 0
+    w = None
+    for batch in reader_creator():
+        if batch_id % batch_per_file == 0:
+            if w is not None:
+                w.close()
+            name = '%s-%05d.%s' % (f_name, batch_id // batch_per_file,
+                                   f_ext)
+            files.append(name)
+            w = _WriterCM(name, compressor)
+        feed_dict = feeder.feed(batch)
+        w.write(_serialize_batch([feed_dict[n] for n in feed_order]))
+        batch_id += 1
+        if batch_id >= max_num_records:
+            break
+    if w is not None:
+        w.close()
+    return files
+
+
+class _WriterCM(object):
+    def __init__(self, filename, compressor):
+        self._w = RecordIOWriter(filename, compressor=compressor)
+
+    def write(self, data):
+        self._w.write(data)
+
+    def close(self):
+        self._w.close()

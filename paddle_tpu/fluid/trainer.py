@@ -1,0 +1,209 @@
+"""High-level Trainer / event loop (reference: python/paddle/fluid/trainer.py).
+
+Same event-driven surface as the reference (BeginEpochEvent/EndStepEvent
+callbacks, trainer.py:40-83), checkpointing via CheckpointConfig
+(trainer.py:100), automatic resume from the newest checkpoint.  Distributed
+training maps to SPMD (ParallelExecutor) instead of the transpiled pserver
+path.
+"""
+
+import os
+import shutil
+
+from . import core
+from .framework import Program, program_guard, default_main_program, \
+    default_startup_program
+from .executor import Executor, scope_guard
+from . import io as fluid_io
+from .data_feeder import DataFeeder
+
+__all__ = [
+    'Trainer', 'BeginEpochEvent', 'EndEpochEvent', 'BeginStepEvent',
+    'EndStepEvent', 'CheckpointConfig',
+]
+
+
+class BeginEpochEvent(object):
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent(object):
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent(object):
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent(object):
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig(object):
+    """(reference trainer.py:100)"""
+
+    def __init__(self,
+                 checkpoint_dir=None,
+                 max_num_checkpoints=3,
+                 epoch_interval=1,
+                 step_interval=10):
+        self.checkpoint_dir = checkpoint_dir or os.path.join(
+            os.getcwd(), 'checkpoints')
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = max(epoch_interval, 1)
+        self.step_interval = max(step_interval, 1)
+        self.epoch_id = 0
+        self.step_id = 0
+        self.load_serial = None
+
+
+def _serial_dir(checkpoint_dir, serial):
+    return os.path.join(checkpoint_dir, str(serial))
+
+
+def _latest_serial(checkpoint_dir):
+    if not os.path.isdir(checkpoint_dir):
+        return None
+    serials = [int(d) for d in os.listdir(checkpoint_dir) if d.isdigit()]
+    return max(serials) if serials else None
+
+
+class Trainer(object):
+    """(reference trainer.py:169)
+
+    train_func must return [loss] (optionally [loss, *metrics])."""
+
+    def __init__(self,
+                 train_func,
+                 optimizer_func,
+                 param_path=None,
+                 place=None,
+                 parallel=False,
+                 checkpoint_config=None):
+        self.__stop = False
+        self.parallel = parallel
+        self.place = place if place is not None else core.CPUPlace()
+        self.checkpoint_cfg = checkpoint_config
+        if self.checkpoint_cfg is not None and not isinstance(
+                self.checkpoint_cfg, CheckpointConfig):
+            raise TypeError('checkpoint_config must be CheckpointConfig')
+
+        self.scope = core.Scope()
+        self.startup_program = Program()
+        self.train_program = Program()
+
+        with program_guard(self.train_program, self.startup_program):
+            program_func_outs = train_func()
+            self.train_func_outputs = program_func_outs if isinstance(
+                program_func_outs, list) else [program_func_outs]
+            self.test_program = self.train_program.clone(for_test=True)
+            optimizer = optimizer_func()
+            loss = self.train_func_outputs[0]
+            optimizer.minimize(loss)
+
+        self.exe = Executor(self.place)
+        with scope_guard(self.scope):
+            self.exe.run(self.startup_program)
+
+        if param_path and os.path.isdir(param_path):
+            with scope_guard(self.scope):
+                fluid_io.load_persistables(
+                    self.exe, dirname=param_path,
+                    main_program=self.startup_program)
+
+        if self.checkpoint_cfg is not None:
+            serial = _latest_serial(self.checkpoint_cfg.checkpoint_dir)
+            if serial is not None:
+                self.checkpoint_cfg.load_serial = serial
+                with scope_guard(self.scope):
+                    fluid_io.load_persistables(
+                        self.exe,
+                        _serial_dir(self.checkpoint_cfg.checkpoint_dir,
+                                    serial),
+                        main_program=self.train_program)
+
+    def stop(self):
+        self.__stop = True
+
+    def train(self, num_epochs, event_handler, reader=None, feed_order=None):
+        with scope_guard(self.scope):
+            feeder = DataFeeder(
+                feed_list=feed_order, place=self.place,
+                program=self.train_program)
+            for epoch_id in range(num_epochs):
+                event_handler(BeginEpochEvent(epoch_id))
+                for step_id, data in enumerate(reader()):
+                    if self.__stop:
+                        return
+                    begin_event = BeginStepEvent(epoch_id, step_id)
+                    event_handler(begin_event)
+                    fetch_list = self.train_func_outputs \
+                        if begin_event.fetch_metrics else []
+                    metrics = self.exe.run(
+                        self.train_program,
+                        feed=feeder.feed(data),
+                        fetch_list=fetch_list)
+                    if self.checkpoint_cfg is not None:
+                        self._save_checkpoint(epoch_id, step_id)
+                    event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                event_handler(EndEpochEvent(epoch_id))
+
+    def test(self, reader, feed_order):
+        with scope_guard(self.scope):
+            feeder = DataFeeder(
+                feed_list=feed_order, place=self.place,
+                program=self.test_program)
+            accumulated = [0.0] * len(self.train_func_outputs)
+            count = 0
+            for data in reader():
+                outs = self.exe.run(
+                    self.test_program,
+                    feed=feeder.feed(data),
+                    fetch_list=self.train_func_outputs)
+                accumulated = [
+                    a + float(o.flatten()[0])
+                    for a, o in zip(accumulated, outs)
+                ]
+                count += 1
+            return [a / max(count, 1) for a in accumulated]
+
+    def save_params(self, param_path):
+        with scope_guard(self.scope):
+            fluid_io.save_persistables(
+                self.exe, dirname=param_path,
+                main_program=self.train_program)
+
+    def save_inference_model(self, param_path, feeded_var_names,
+                             target_var_indexes):
+        with scope_guard(self.scope):
+            target_vars = [
+                self.train_func_outputs[i] for i in target_var_indexes
+            ]
+            fluid_io.save_inference_model(param_path, feeded_var_names,
+                                          target_vars, self.exe,
+                                          self.train_program)
+
+    def _save_checkpoint(self, epoch_id, step_id):
+        cfg = self.checkpoint_cfg
+        if epoch_id % cfg.epoch_interval != 0 or \
+                step_id % cfg.step_interval != 0:
+            return
+        serial = (cfg.load_serial or 0) + epoch_id * 100000 + step_id + 1
+        dirname = _serial_dir(cfg.checkpoint_dir, serial)
+        fluid_io.save_persistables(
+            self.exe, dirname=dirname, main_program=self.train_program)
+        serials = sorted(
+            int(d) for d in os.listdir(cfg.checkpoint_dir) if d.isdigit())
+        while len(serials) > cfg.max_num_checkpoints:
+            victim = serials.pop(0)
+            shutil.rmtree(
+                _serial_dir(cfg.checkpoint_dir, victim),
+                ignore_errors=True)

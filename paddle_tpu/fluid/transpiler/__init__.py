@@ -1,0 +1,12 @@
+"""Program-to-program transpilers
+(reference: python/paddle/fluid/transpiler/)."""
+
+from .distribute_transpiler import DistributeTranspiler, \
+    DistributeTranspilerConfig
+from .memory_optimization_transpiler import memory_optimize, release_memory
+from .inference_transpiler import InferenceTranspiler
+
+__all__ = [
+    'DistributeTranspiler', 'DistributeTranspilerConfig', 'memory_optimize',
+    'release_memory', 'InferenceTranspiler',
+]

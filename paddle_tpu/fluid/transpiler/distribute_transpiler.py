@@ -1,0 +1,84 @@
+"""DistributeTranspiler (reference: transpiler/distribute_transpiler.py:132).
+
+Capability mapping (SURVEY §2.5, BASELINE north star): the reference
+rewrites one program into trainer programs (send/recv ops) plus pserver
+programs (listen_and_serv with per-param optimize blocks) over gRPC.  On
+TPU the dense synchronous path is *replaced* by SPMD — one program, batch
+sharded over the mesh, XLA cross-replica sums over ICI — so
+``get_trainer_program`` returns the original program annotated for
+ParallelExecutor, and multi-host scale-out uses the same program via
+``jax.distributed`` (rendezvous owned by the TPU runtime, replacing
+gen_nccl_id_op).  The pserver program surface is kept for API parity;
+sparse/CTR models shard their embeddings with
+``paddle_tpu.parallel.shard`` instead of remote prefetch.
+"""
+
+from ..framework import default_main_program, Program
+
+__all__ = ['DistributeTranspiler', 'DistributeTranspilerConfig']
+
+
+class DistributeTranspilerConfig(object):
+    """(reference distribute_transpiler.py:116)"""
+
+    slice_var_up = True
+    split_method = None
+    min_block_size = 8192
+
+
+class DistributeTranspiler(object):
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        self._transpiled = False
+
+    def transpile(self,
+                  trainer_id,
+                  program=None,
+                  pservers='127.0.0.1:6174',
+                  trainers=1,
+                  sync_mode=True,
+                  startup_program=None):
+        if program is None:
+            program = default_main_program()
+        if not sync_mode:
+            raise NotImplementedError(
+                'async parameter-server updates have no TPU analog; the '
+                'dense path is synchronous SPMD (SURVEY §2.5 row "async")')
+        self.trainer_id = trainer_id
+        self.trainers = trainers
+        self.pserver_endpoints = [
+            ep.strip() for ep in pservers.split(',') if ep.strip()
+        ]
+        self.origin_program = program
+        program._is_distributed = True
+        program._trainers = trainers
+        program._trainer_id = trainer_id
+        self._transpiled = True
+
+    def get_trainer_program(self):
+        """The SPMD trainer program IS the original program: run it with
+        fluid.ParallelExecutor over a mesh; gradient averaging happens via
+        compiler-inserted collectives rather than send/recv ops."""
+        if not self._transpiled:
+            raise RuntimeError('call transpile() first')
+        return self.origin_program
+
+    def get_pserver_program(self, endpoint):
+        """Dense pserver serving is intentionally bypassed on TPU
+        (BASELINE.json north star).  Returns a stub program whose single
+        listen_and_serv op documents the mapping."""
+        if not self._transpiled:
+            raise RuntimeError('call transpile() first')
+        prog = Program()
+        prog.global_block().append_op(
+            type='listen_and_serv',
+            inputs={},
+            outputs={},
+            attrs={
+                'endpoint': endpoint,
+                'note': 'dense sync-SGD is SPMD on TPU; no pserver needed',
+            })
+        return prog
+
+    def get_startup_program(self, endpoint, pserver_program=None):
+        return Program()

@@ -1,0 +1,69 @@
+"""InferenceTranspiler (reference: transpiler/inference_transpiler.py:24).
+
+Folds batch_norm into the preceding conv2d for inference: adjusts the conv
+filter and bias with the BN statistics in the scope, then removes the
+batch_norm op — the same w' = w * gamma/sqrt(var+eps) rewrite as the
+reference.  (XLA would fuse the arithmetic anyway; folding still removes
+the op and its params from the serialized model.)
+"""
+
+import numpy as np
+
+from .. import core
+from ..executor import global_scope
+
+__all__ = ['InferenceTranspiler']
+
+
+class InferenceTranspiler(object):
+    def transpile(self, program, place=None, scope=None):
+        if scope is None:
+            scope = global_scope()
+        self._fuse_batch_norm(program, scope)
+        return program
+
+    def _scope_np(self, scope, name):
+        var = scope.find_var(name)
+        if var is None or var.value() is None:
+            return None
+        val = var.value()
+        return val.numpy() if isinstance(val, core.LoDTensor) else \
+            np.asarray(val)
+
+    def _fuse_batch_norm(self, program, scope):
+        block = program.global_block()
+        i = 0
+        while i < len(block.ops) - 1:
+            op = block.ops[i]
+            next_op = block.ops[i + 1]
+            if op.type in ('conv2d', 'depthwise_conv2d') and \
+                    next_op.type == 'batch_norm' and \
+                    next_op.input('X') == op.output('Output'):
+                scale = self._scope_np(scope, next_op.input('Scale')[0])
+                bias = self._scope_np(scope, next_op.input('Bias')[0])
+                mean = self._scope_np(scope, next_op.input('Mean')[0])
+                var = self._scope_np(scope, next_op.input('Variance')[0])
+                w_name = op.input('Filter')[0]
+                w = self._scope_np(scope, w_name)
+                if any(v is None for v in (scale, bias, mean, var, w)):
+                    i += 1
+                    continue
+                eps = next_op.attrs.get('epsilon', 1e-5)
+                inv_std = 1.0 / np.sqrt(var + eps)
+                factor = (scale * inv_std).astype(w.dtype)
+                scope.var(w_name).set_value(
+                    w * factor[:, None, None, None])
+                new_bias = (bias - mean * scale * inv_std).astype(w.dtype)
+                # rewrite: conv Output feeds where BN's Y went, plus an
+                # elementwise bias add
+                bn_out = next_op.output('Y')[0]
+                bias_name = next_op.input('Bias')[0]
+                scope.var(bias_name).set_value(new_bias)
+                block.ops[i + 1] = type(next_op)(
+                    block, 'elementwise_add',
+                    inputs={'X': op.output('Output'),
+                            'Y': [bias_name]},
+                    outputs={'Out': [bn_out]},
+                    attrs={'axis': 1})
+                program._bump_version()
+            i += 1

@@ -1,0 +1,60 @@
+"""memory_optimize (reference: transpiler/memory_optimization_transpiler.py).
+
+The reference runs liveness analysis over the program and rewrites var
+names to reuse buffers (ControlFlowGraph:47, memory_optimize:381).  Under
+whole-block XLA compilation the compiler's buffer assignment already does
+exactly this (and better, with operator fusion), so the pass reduces to a
+liveness *report*: it computes the same live-range statistics the reference
+used and stores them on the program for inspection — no rewrite needed.
+"""
+
+import collections
+
+from ..framework import default_main_program
+
+__all__ = ['memory_optimize', 'release_memory']
+
+
+def _liveness(program):
+    block = program.global_block()
+    last_use = {}
+    first_def = {}
+    for idx, op in enumerate(block.ops):
+        for name in op.input_arg_names:
+            last_use[name] = idx
+        for name in op.output_arg_names:
+            first_def.setdefault(name, idx)
+            last_use[name] = idx
+    return first_def, last_use
+
+
+def memory_optimize(input_program=None,
+                    skip_opt_set=None,
+                    print_log=False,
+                    level=0):
+    program = input_program or default_main_program()
+    first_def, last_use = _liveness(program)
+    stats = {
+        'num_vars': len(first_def),
+        'reusable_pairs': 0,
+    }
+    # count reuse opportunities the XLA buffer assigner will exploit
+    dead_at = collections.defaultdict(list)
+    for name, idx in last_use.items():
+        dead_at[idx].append(name)
+    for name, def_idx in first_def.items():
+        for d in range(def_idx):
+            if dead_at.get(d):
+                stats['reusable_pairs'] += 1
+                break
+    program._memory_optimize_stats = stats
+    if print_log:
+        print('memory_optimize: %(num_vars)d vars, %(reusable_pairs)d '
+              'reusable (buffer reuse performed by XLA)' % stats)
+    return program
+
+
+def release_memory(input_program=None, skip_opt_set=None):
+    """No-op under XLA: buffers are freed by the runtime at donation
+    points (reference release_memory inserted delete_var ops)."""
+    return input_program or default_main_program()

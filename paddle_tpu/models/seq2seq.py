@@ -1,0 +1,115 @@
+"""Seq2seq NMT with attention
+(reference: benchmark/fluid/machine_translation.py and
+tests/book/test_machine_translation.py).
+
+Encoder: embedding -> per-token fc -> dynamic LSTM.
+Decoder: DynamicRNN over target tokens with Bahdanau-style attention over
+the encoder states — the attention is plain sequence ops (expand, softmax,
+pool) inside the RNN block, lowered to one masked lax.scan whose inner ops
+are batched matmuls on the MXU.
+"""
+
+import paddle_tpu.fluid as fluid
+
+__all__ = ['build']
+
+
+def encoder(src_word_id, src_dict_dim, embedding_dim, encoder_size):
+    src_embedding = fluid.layers.embedding(
+        input=src_word_id, size=[src_dict_dim, embedding_dim])
+    fc1 = fluid.layers.fc(input=src_embedding, size=encoder_size * 4,
+                          act='tanh')
+    lstm_hidden, lstm_cell = fluid.layers.dynamic_lstm(
+        input=fc1, size=encoder_size * 4)
+    return lstm_hidden
+
+
+def simple_attention(encoder_vec, encoder_proj, decoder_state,
+                     decoder_size):
+    """(reference machine_translation.py simple_attention)"""
+    decoder_state_proj = fluid.layers.fc(
+        input=decoder_state, size=decoder_size, bias_attr=False)
+    decoder_state_expand = fluid.layers.sequence_expand(
+        x=decoder_state_proj, y=encoder_proj)
+    concated = fluid.layers.elementwise_add(encoder_proj,
+                                            decoder_state_expand)
+    concated = fluid.layers.tanh(concated)
+    attention_weights = fluid.layers.fc(
+        input=concated, size=1, act=None, bias_attr=False)
+    attention_weights = fluid.layers.sequence_softmax(
+        input=attention_weights)
+    scaled = fluid.layers.elementwise_mul(
+        x=encoder_vec, y=attention_weights, axis=0)
+    context = fluid.layers.sequence_pool(input=scaled, pool_type='sum')
+    return context
+
+
+def train_decoder(context_boot, encoder_vec, encoder_proj, trg_word_id,
+                  trg_dict_dim, embedding_dim, decoder_size):
+    trg_embedding = fluid.layers.embedding(
+        input=trg_word_id, size=[trg_dict_dim, embedding_dim])
+
+    rnn = fluid.layers.DynamicRNN()
+    with rnn.block():
+        current_word = rnn.step_input(trg_embedding)
+        vec = rnn.static_input(encoder_vec)
+        proj = rnn.static_input(encoder_proj)
+        hidden_mem = rnn.memory(init=context_boot)
+        context = simple_attention(vec, proj, hidden_mem, decoder_size)
+        decoder_inputs = fluid.layers.fc(
+            input=[context, current_word],
+            size=decoder_size * 3,
+            bias_attr=False)
+        h, _, _ = fluid.layers.gru_unit(
+            input=decoder_inputs, hidden=hidden_mem, size=decoder_size * 3)
+        rnn.update_memory(hidden_mem, h)
+        out = fluid.layers.fc(
+            input=h, size=trg_dict_dim, act='softmax')
+        rnn.output(out)
+    return rnn()
+
+
+def build(src_dict_dim=1000,
+          trg_dict_dim=1000,
+          embedding_dim=64,
+          encoder_size=64,
+          decoder_size=64,
+          lr=0.001):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = fluid.layers.data(
+            name='src_word_id', shape=[1], dtype='int64', lod_level=1)
+        trg = fluid.layers.data(
+            name='target_language_word', shape=[1], dtype='int64',
+            lod_level=1)
+        label = fluid.layers.data(
+            name='target_language_next_word', shape=[1], dtype='int64',
+            lod_level=1)
+
+        encoder_out = encoder(src, src_dict_dim, embedding_dim,
+                              encoder_size)
+        encoder_proj = fluid.layers.fc(
+            input=encoder_out, size=decoder_size, bias_attr=False)
+        encoder_last = fluid.layers.sequence_last_step(input=encoder_out)
+        decoder_boot = fluid.layers.fc(
+            input=encoder_last, size=decoder_size, act='tanh')
+
+        prediction = train_decoder(decoder_boot, encoder_out, encoder_proj,
+                                   trg, trg_dict_dim, embedding_dim,
+                                   decoder_size)
+        cost = fluid.layers.cross_entropy(input=prediction, label=label)
+        # per-sentence sum over true length, then batch mean (padding is
+        # masked by the carried lengths)
+        sent_cost = fluid.layers.sequence_pool(input=cost, pool_type='sum')
+        avg_cost = fluid.layers.mean(sent_cost)
+        test_program = main.clone(for_test=True)
+        fluid.optimizer.Adam(learning_rate=lr).minimize(avg_cost)
+    return dict(
+        main=main,
+        startup=startup,
+        test=test_program,
+        feeds=['src_word_id', 'target_language_word',
+               'target_language_next_word'],
+        prediction=prediction,
+        loss=avg_cost)

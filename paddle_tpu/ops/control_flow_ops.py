@@ -1,0 +1,276 @@
+"""Control-flow op lowerings: sub-blocks -> lax.scan / lax.while_loop.
+
+The reference's while_op re-enters the interpreter per iteration with
+step-scopes (operators/while_op.cc:50-66) and recurrent_op manages its own
+scope stack (recurrent_op.cc).  Here a sub-block lowers exactly once into a
+functional body; carried state is explicit — the design SURVEY §7 calls out
+as the core control-flow translation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import (register_lowering, LoweringContext, run_op,
+                       SEQLEN_SUFFIX)
+
+
+def _block_reads_writes(block):
+    reads, writes = [], []
+    seen_r, seen_w = set(), set()
+    for op in block.ops:
+        for n in op.input_arg_names:
+            if n not in seen_r:
+                seen_r.add(n)
+                reads.append(n)
+        for n in op.output_arg_names:
+            if n not in seen_w:
+                seen_w.add(n)
+                writes.append(n)
+    return reads, writes
+
+
+def _run_block(ctx, block, env):
+    sub = LoweringContext(block, env, rng_key=None, is_test=ctx.is_test,
+                          place=ctx.place)
+    for op in block.ops:
+        run_op(sub, op)
+    return env
+
+
+@register_lowering('while')
+def _while(ctx, op):
+    """lax.while_loop over the sub-block; carry = condition + every parent
+    var the body writes (reference while_op.cc RunImpl)."""
+    block = op.attrs['sub_block']
+    cond_name = op.input('Condition')[0]
+    reads, writes = _block_reads_writes(block)
+    carry_names = [cond_name] + [
+        n for n in writes if ctx.has(n) and n != cond_name
+    ]
+    closure = {
+        n: ctx.lookup(n)
+        for n in reads if ctx.has(n) and n not in carry_names
+    }
+
+    def cond_fn(carry):
+        return jnp.reshape(carry[cond_name], ()).astype(bool)
+
+    def body_fn(carry):
+        env = dict(closure)
+        env.update(carry)
+        _run_block(ctx, block, env)
+        return {n: env[n] for n in carry_names}
+
+    init = {n: ctx.lookup(n) for n in carry_names}
+    final = jax.lax.while_loop(cond_fn, body_fn, init)
+    for n, v in final.items():
+        ctx.store(n, v)
+
+
+@register_lowering('recurrent')
+def _recurrent(ctx, op):
+    """StaticRNN / DynamicRNN: one lax.scan over the time axis.
+
+    Sequence inputs arrive padded [B, T, ...]; memories carry across steps;
+    with attrs['masked'] the carry only advances within each sequence's
+    true length (replacing shrink_rnn_memory_op's shrinking batch)."""
+    block = op.attrs['sub_block']
+    seq_names = op.input('SeqInputs')
+    step_names = op.attrs['step_input_names']
+    mem_names = op.attrs['mem_names']
+    mem_update_names = op.attrs['mem_update_names']
+    mem_init_names = op.input('MemInits')
+    out_names = op.attrs['output_names']
+    masked = op.attrs.get('masked', False)
+
+    time_major = op.attrs.get('time_major', False)
+    seqs = [ctx.lookup(n) for n in seq_names]
+    if time_major:
+        t, b = seqs[0].shape[0], seqs[0].shape[1]
+        xs = list(seqs)  # already [T, B, ...]
+    else:
+        t, b = seqs[0].shape[1], seqs[0].shape[0]
+        xs = [jnp.swapaxes(s, 0, 1) for s in seqs]  # [T, B, ...]
+
+    lengths = None
+    if masked:
+        for n in seq_names:
+            if (n + SEQLEN_SUFFIX) in ctx.env:
+                lengths = ctx.env[n + SEQLEN_SUFFIX]
+                break
+    if lengths is not None:
+        step_mask = (jnp.arange(t)[None, :] <
+                     lengths[:, None]).astype(seqs[0].dtype).T  # [T, B]
+    else:
+        step_mask = jnp.ones((t, b), seqs[0].dtype)
+
+    reads, _ = _block_reads_writes(block)
+    closure = {}
+    for n in reads:
+        if n in step_names or n in mem_names:
+            continue
+        if ctx.has(n):
+            closure[n] = ctx.lookup(n)
+        key = n + SEQLEN_SUFFIX
+        if key in ctx.env:
+            closure[key] = ctx.env[key]
+
+    mem_init = {
+        m: ctx.lookup(init)
+        for m, init in zip(mem_names, mem_init_names)
+    }
+
+    def step(carry, inp):
+        x_ts, m_t = inp
+        env = dict(closure)
+        env.update({sn: x for sn, x in zip(step_names, x_ts)})
+        env.update(carry)
+        _run_block(ctx, block, env)
+        new_carry = {}
+        for m, upd in zip(mem_names, mem_update_names):
+            new_val = env[upd] if upd is not None else env[m]
+            old_val = carry[m]
+            mm = jnp.reshape(m_t, (b, ) + (1, ) * (new_val.ndim - 1))
+            new_carry[m] = mm * new_val + (1 - mm) * old_val
+        outs = []
+        for on in out_names:
+            o = env[on]
+            mm = jnp.reshape(m_t, (b, ) + (1, ) * (o.ndim - 1))
+            outs.append(o * mm)
+        return new_carry, tuple(outs)
+
+    _, collected = jax.lax.scan(step, mem_init, (tuple(xs), step_mask))
+    for out_var_name, col in zip(op.output('Out'), collected):
+        out = col if time_major else jnp.swapaxes(col, 0, 1)
+        ctx.store(out_var_name, out)
+        if lengths is not None:
+            ctx.env[out_var_name + SEQLEN_SUFFIX] = lengths
+
+
+@register_lowering('switch_case')
+def _switch_case(ctx, op):
+    """All case blocks execute; written vars blend by the first matching
+    condition (XLA select semantics; side-effect-free cases only)."""
+    case_conds = op.attrs['case_conds']
+    case_blocks = op.attrs['case_blocks']
+    written = op.output('Out')
+    results = []  # per case: dict of written var values
+    for blk in case_blocks:
+        env = dict(ctx.env)
+        _run_block(ctx, blk, env)
+        results.append({n: env[n] for n in written if n in env})
+    # fold from the last (default) case backwards
+    final = {}
+    for n in written:
+        val = None
+        for cond_name, res in zip(reversed(case_conds), reversed(results)):
+            if n not in res:
+                continue
+            if val is None or cond_name is None:
+                val = res[n]
+            else:
+                c = jnp.reshape(ctx.lookup(cond_name), ()).astype(bool)
+                val = jnp.where(c, res[n], val)
+        if val is not None:
+            ctx.store(n, val)
+
+
+@register_lowering('ifelse')
+def _ifelse(ctx, op):
+    cond = ctx.get(op, 'Cond')
+    true_block = op.attrs['true_block']
+    false_block = op.attrs['false_block']
+    true_out = op.attrs['true_out']
+    false_out = op.attrs['false_out']
+    env_t = dict(ctx.env)
+    env_f = dict(ctx.env)
+    if true_block is not None:
+        _run_block(ctx, true_block, env_t)
+    if false_block is not None:
+        _run_block(ctx, false_block, env_f)
+    c = jnp.reshape(cond, (-1, ))
+    for out_name, tn, fn_ in zip(op.output('Out'), true_out, false_out):
+        tv, fv = env_t[tn], env_f[fn_]
+        cc = jnp.reshape(c, (c.shape[0], ) + (1, ) * (tv.ndim - 1)) \
+            if tv.ndim > 1 and c.shape[0] == tv.shape[0] else \
+            jnp.reshape(cond, ()).astype(bool)
+        ctx.store(out_name, jnp.where(cc, tv, fv))
+
+
+@register_lowering('conditional_block')
+def _conditional_block(ctx, op):
+    """Reference conditional_block_op.cc: run sub-block if cond; written
+    vars keep old values otherwise (select blend)."""
+    conds = ctx.get_list(op, 'X') if op.input('X') else ctx.get_list(
+        op, 'Cond')
+    block = op.attrs['sub_block']
+    c = jnp.reshape(conds[0], ()).astype(bool)
+    env = dict(ctx.env)
+    _run_block(ctx, block, env)
+    _, writes = _block_reads_writes(block)
+    for n in writes:
+        if n in block.vars:
+            continue  # block-local temp
+        new = env[n]
+        old = ctx.lookup(n) if ctx.has(n) else jnp.zeros_like(new)
+        ctx.store(n, jnp.where(c, new, old))
+
+
+# ---- tensor-array ops (statically indexed inside lowered loops) ----
+@register_lowering('write_to_array')
+def _write_to_array(ctx, op):
+    x = ctx.get(op, 'X')
+    i = jnp.reshape(ctx.get(op, 'I'), ()).astype(jnp.int32)
+    name = op.output('Out')[0]
+    arr = ctx.env.get(name)
+    if arr is None or not isinstance(arr, jnp.ndarray) or \
+            arr.shape[1:] != x.shape:
+        # array state: python list when index is concrete, else preallocated
+        arr = ctx.env.get(name)
+    if isinstance(arr, list):
+        lst = arr
+    elif arr is None:
+        lst = []
+    else:
+        lst = [arr[j] for j in range(arr.shape[0])]
+    try:
+        idx = int(i)
+        while len(lst) <= idx:
+            lst.append(jnp.zeros_like(x))
+        lst[idx] = x
+        ctx.store(name, lst)
+        return
+    except Exception:
+        pass
+    # traced index: stack and dynamic-update
+    stacked = jnp.stack(lst) if lst else jnp.zeros((0, ) + x.shape, x.dtype)
+    ctx.store(name, stacked.at[i].set(x) if stacked.shape[0] else
+              x[None])
+
+
+@register_lowering('read_from_array')
+def _read_from_array(ctx, op):
+    arr = ctx.get(op, 'X')
+    i = ctx.get(op, 'I')
+    if isinstance(arr, list):
+        try:
+            ctx.set(op, 'Out', arr[int(np.asarray(i).flatten()[0])])
+            return
+        except Exception:
+            arr = jnp.stack(arr)
+    idx = jnp.reshape(i, ()).astype(jnp.int32)
+    ctx.set(op, 'Out', arr[idx])
+
+
+@register_lowering('lod_array_length')
+def _lod_array_length(ctx, op):
+    arr = ctx.get(op, 'X')
+    n = len(arr) if isinstance(arr, list) else arr.shape[0]
+    ctx.set(op, 'Out', jnp.asarray([n], jnp.int64))
+
+
+@register_lowering('max_sequence_len')
+def _max_sequence_len(ctx, op):
+    rank_table = ctx.get(op, 'RankTable')
+    ctx.set(op, 'Out', jnp.asarray([rank_table.shape[0]], jnp.int64))

@@ -445,6 +445,14 @@ def _logical_not(ctx, op):
     ctx.set(op, 'Out', jnp.logical_not(ctx.get(op, 'X')))
 
 
+@register_lowering('where_select')
+def _where_select(ctx, op):
+    cond = ctx.get(op, 'Cond')
+    x = ctx.get(op, 'X')
+    y = ctx.get(op, 'Y')
+    ctx.set(op, 'Out', jnp.where(jnp.reshape(cond, ()).astype(bool), x, y))
+
+
 @register_lowering('isfinite')
 def _isfinite(ctx, op):
     x = ctx.get(op, 'X')

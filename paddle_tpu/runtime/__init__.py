@@ -1,0 +1,16 @@
+"""Native runtime bindings (C++ via ctypes).
+
+The reference's runtime around the compute path is C++ (recordio, reader
+queues, buddy allocator — SURVEY §2.1); this package binds the TPU-native
+equivalents built from ``csrc/``.  If the shared library is missing it is
+built on first use with the in-image toolchain; pure-Python fallbacks keep
+everything working without a compiler.
+"""
+
+from .native import (lib_available, RecordIOWriter, RecordIOScanner,
+                     NativeBlockingQueue, host_pool_stats)
+
+__all__ = [
+    'lib_available', 'RecordIOWriter', 'RecordIOScanner',
+    'NativeBlockingQueue', 'host_pool_stats',
+]

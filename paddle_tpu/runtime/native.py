@@ -1,0 +1,284 @@
+"""ctypes bindings over libpaddle_tpu_rt.so (csrc/)."""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_HERE, 'libpaddle_tpu_rt.so')
+_CSRC = os.path.normpath(os.path.join(_HERE, '..', '..', 'csrc'))
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _try_build():
+    if not os.path.isdir(_CSRC):
+        return False
+    try:
+        subprocess.run(['make'], cwd=_CSRC, check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_SO_PATH)
+    except Exception:
+        return False
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO_PATH):
+            if not _try_build():
+                return None
+        lib = ctypes.CDLL(_SO_PATH)
+        # recordio
+        lib.recordio_writer_create.restype = ctypes.c_void_p
+        lib.recordio_writer_create.argtypes = [ctypes.c_char_p,
+                                               ctypes.c_int,
+                                               ctypes.c_uint64]
+        lib.recordio_writer_write.restype = ctypes.c_int
+        lib.recordio_writer_write.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_char_p,
+                                              ctypes.c_uint64]
+        lib.recordio_writer_close.restype = ctypes.c_int
+        lib.recordio_writer_close.argtypes = [ctypes.c_void_p]
+        lib.recordio_scanner_create.restype = ctypes.c_void_p
+        lib.recordio_scanner_create.argtypes = [ctypes.c_char_p]
+        lib.recordio_scanner_next.restype = ctypes.c_int
+        lib.recordio_scanner_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_uint64)
+        ]
+        lib.recordio_scanner_destroy.argtypes = [ctypes.c_void_p]
+        # blocking queue
+        lib.bq_create.restype = ctypes.c_void_p
+        lib.bq_create.argtypes = [ctypes.c_uint64]
+        lib.bq_push.restype = ctypes.c_int
+        lib.bq_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_uint64]
+        lib.bq_pop.restype = ctypes.c_int64
+        lib.bq_pop.argtypes = [ctypes.c_void_p,
+                               ctypes.POINTER(ctypes.c_char_p)]
+        lib.bq_size.restype = ctypes.c_uint64
+        lib.bq_size.argtypes = [ctypes.c_void_p]
+        lib.bq_close.argtypes = [ctypes.c_void_p]
+        lib.bq_reopen.argtypes = [ctypes.c_void_p]
+        lib.bq_destroy.argtypes = [ctypes.c_void_p]
+        # host pool
+        lib.hp_in_use.restype = ctypes.c_uint64
+        lib.hp_cached.restype = ctypes.c_uint64
+        lib.hp_peak.restype = ctypes.c_uint64
+        _lib = lib
+        return _lib
+
+
+def lib_available():
+    return _load() is not None
+
+
+class RecordIOWriter(object):
+    """(reference recordio/writer.h)"""
+
+    def __init__(self, path, compressor='zlib', max_chunk_bytes=1 << 20):
+        lib = _load()
+        self._lib = lib
+        self._py_records = None
+        self._path = path
+        if lib is None:
+            self._py_records = []
+            self._compressor = compressor
+            return
+        self._h = lib.recordio_writer_create(
+            path.encode(), 1 if compressor == 'zlib' else 0,
+            max_chunk_bytes)
+        if not self._h:
+            raise IOError('cannot open %s for writing' % path)
+
+    def write(self, data):
+        if isinstance(data, str):
+            data = data.encode()
+        if self._py_records is not None:
+            self._py_records.append(bytes(data))
+            return
+        if self._lib.recordio_writer_write(self._h, data, len(data)) != 0:
+            raise IOError('recordio write failed')
+
+    def close(self):
+        if self._py_records is not None:
+            _py_write_recordio(self._path, self._py_records,
+                               self._compressor)
+            return
+        if self._lib.recordio_writer_close(self._h) != 0:
+            raise IOError('recordio close/flush failed')
+        self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+class RecordIOScanner(object):
+    """(reference recordio/scanner.h)"""
+
+    def __init__(self, path):
+        lib = _load()
+        self._lib = lib
+        if lib is None:
+            self._records = iter(_py_read_recordio(path))
+            self._h = None
+            return
+        self._h = lib.recordio_scanner_create(path.encode())
+        if not self._h:
+            raise IOError('cannot open %s' % path)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._h is None:
+            return next(self._records)
+        buf = ctypes.c_char_p()
+        length = ctypes.c_uint64()
+        status = self._lib.recordio_scanner_next(self._h, ctypes.byref(buf),
+                                                 ctypes.byref(length))
+        if status == 0:
+            raise StopIteration
+        if status < 0:
+            raise IOError('corrupt recordio chunk (crc/format)')
+        return ctypes.string_at(buf, length.value)
+
+    def close(self):
+        if self._h is not None:
+            self._lib.recordio_scanner_destroy(self._h)
+            self._h = None
+
+
+# --- pure-python fallback implementing the same on-disk format ---
+def _py_write_recordio(path, records, compressor='zlib'):
+    import struct
+    import zlib as _z
+    with open(path, 'wb') as f:
+        raw = b''.join(
+            struct.pack('<I', len(r)) + r for r in records)
+        stored = _z.compress(raw, 1) if compressor == 'zlib' else raw
+        comp = 1 if compressor == 'zlib' else 0
+        f.write(
+            struct.pack('<6I', 0x0c010cec, comp, len(records), len(raw),
+                        len(stored), _z.crc32(stored) & 0xffffffff))
+        f.write(stored)
+
+
+def _py_read_recordio(path):
+    import struct
+    import zlib as _z
+    out = []
+    with open(path, 'rb') as f:
+        while True:
+            hdr = f.read(24)
+            if len(hdr) < 24:
+                break
+            magic, comp, n, raw_len, stored_len, crc = struct.unpack(
+                '<6I', hdr)
+            if magic != 0x0c010cec:
+                raise IOError('bad recordio magic')
+            stored = f.read(stored_len)
+            if _z.crc32(stored) & 0xffffffff != crc:
+                raise IOError('recordio crc mismatch')
+            raw = _z.decompress(stored) if comp else stored
+            off = 0
+            for _ in range(n):
+                (l, ) = struct.unpack_from('<I', raw, off)
+                off += 4
+                out.append(raw[off:off + l])
+                off += l
+    return out
+
+
+class NativeBlockingQueue(object):
+    """Bounded producer/consumer byte queue
+    (reference operators/reader/lod_tensor_blocking_queue.h)."""
+
+    def __init__(self, capacity):
+        lib = _load()
+        self._lib = lib
+        if lib is None:
+            import queue as _q
+            self._q = _q.Queue(maxsize=capacity)
+            self._closed = False
+            return
+        self._q = None
+        self._h = lib.bq_create(capacity)
+
+    def push(self, data):
+        if self._q is not None:
+            import queue as _q
+            # bounded wait so close() interrupts a blocked producer like
+            # the native bq_push does
+            while not self._closed:
+                try:
+                    self._q.put(bytes(data), timeout=0.05)
+                    return True
+                except _q.Full:
+                    continue
+            return False
+        return self._lib.bq_push(self._h, bytes(data), len(data)) == 0
+
+    def pop(self):
+        """bytes, or None when closed + drained."""
+        if self._q is not None:
+            import queue as _q
+            while True:
+                try:
+                    return self._q.get(timeout=0.05)
+                except _q.Empty:
+                    if self._closed:
+                        return None
+        buf = ctypes.c_char_p()
+        n = self._lib.bq_pop(self._h, ctypes.byref(buf))
+        if n == 0:
+            return None
+        return ctypes.string_at(buf, n)
+
+    def size(self):
+        if self._q is not None:
+            return self._q.qsize()
+        return self._lib.bq_size(self._h)
+
+    def close(self):
+        if self._q is not None:
+            self._closed = True
+            return
+        self._lib.bq_close(self._h)
+
+    def reopen(self):
+        if self._q is not None:
+            import queue as _q
+            self._q = _q.Queue(maxsize=self._q.maxsize)
+            self._closed = False
+            return
+        self._lib.bq_reopen(self._h)
+
+    def __del__(self):
+        try:
+            if self._q is None and self._lib is not None:
+                self._lib.bq_destroy(self._h)
+        except Exception:
+            pass
+
+
+def host_pool_stats():
+    lib = _load()
+    if lib is None:
+        return {'in_use': 0, 'cached': 0, 'peak': 0, 'native': False}
+    return {
+        'in_use': int(lib.hp_in_use()),
+        'cached': int(lib.hp_cached()),
+        'peak': int(lib.hp_peak()),
+        'native': True,
+    }

@@ -1,0 +1,162 @@
+"""Aux subsystem tests: LR schedulers, Trainer/Inferencer with checkpoints,
+transpilers, io round trips, profiler, metrics
+(reference parity: test_learning_rate_scheduler.py, trainer tests,
+test_memory_optimization_transpiler.py)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def test_exponential_decay_values():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        lr = fluid.layers.exponential_decay(
+            learning_rate=1.0, decay_steps=10, decay_rate=0.5,
+            staircase=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        vals = [float(exe.run(prog, feed={}, fetch_list=[lr])[0][0])
+                for _ in range(12)]
+    # steps 0..9 -> 1.0 ; steps 10,11 -> 0.5
+    np.testing.assert_allclose(vals[:10], [1.0] * 10, rtol=1e-5)
+    np.testing.assert_allclose(vals[10:], [0.5] * 2, rtol=1e-5)
+
+
+def test_piecewise_decay_values():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        lr = fluid.layers.piecewise_decay(
+            boundaries=[3, 6], values=[1.0, 0.5, 0.1])
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        vals = [float(exe.run(prog, feed={}, fetch_list=[lr])[0][0])
+                for _ in range(8)]
+    np.testing.assert_allclose(vals, [1.0, 1.0, 1.0, 0.5, 0.5, 0.5, 0.1,
+                                      0.1], rtol=1e-5)
+
+
+def test_optimizer_with_lr_scheduler_trains():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data('x', [4])
+        y = fluid.layers.data('y', [1])
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        lr = fluid.layers.exponential_decay(0.1, decay_steps=5,
+                                            decay_rate=0.9)
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(10):
+            xb = rng.randn(16, 4).astype('float32')
+            yb = (xb.sum(1, keepdims=True) * 0.5).astype('float32')
+            lv, = exe.run(prog, feed={'x': xb, 'y': yb},
+                          fetch_list=[loss])
+            losses.append(float(lv[0]))
+    assert losses[-1] < losses[0]
+
+
+def test_trainer_inferencer_roundtrip(tmp_path):
+    import paddle_tpu.dataset.uci_housing as uci
+
+    def train_func():
+        x = fluid.layers.data('x', [13])
+        y = fluid.layers.data('y', [1])
+        pred = fluid.layers.fc(x, 1, name='uci_fc')
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        return [loss]
+
+    def optimizer_func():
+        return fluid.optimizer.SGD(learning_rate=0.01)
+
+    trainer = fluid.Trainer(
+        train_func=train_func,
+        optimizer_func=optimizer_func,
+        place=fluid.CPUPlace())
+    seen = []
+
+    def batch_reader():
+        data = list(uci.train(64)())
+        for i in range(0, 64, 16):
+            yield data[i:i + 16]
+
+    def handler(event):
+        if isinstance(event, fluid.EndStepEvent):
+            seen.append(float(np.asarray(event.metrics[0]).flatten()[0]))
+
+    trainer.train(
+        num_epochs=3, event_handler=handler, reader=batch_reader,
+        feed_order=['x', 'y'])
+    assert len(seen) == 12
+    assert seen[-1] < seen[0]
+
+    param_dir = str(tmp_path / 'params')
+    trainer.save_params(param_dir)
+
+    def infer_func():
+        x = fluid.layers.data('x', [13])
+        return fluid.layers.fc(x, 1, name='uci_fc')
+
+    inferencer = fluid.Inferencer(
+        infer_func=infer_func, param_path=param_dir,
+        place=fluid.CPUPlace())
+    out = inferencer.infer({'x': np.zeros((4, 13), 'float32')})
+    assert out[0].shape == (4, 1)
+
+
+def test_distribute_transpiler_api():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data('x', [4])
+        loss = fluid.layers.mean(fluid.layers.fc(x, 1))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=prog,
+                pservers='1.1.1.1:6174,1.1.1.2:6174', trainers=2)
+    trainer_prog = t.get_trainer_program()
+    assert trainer_prog is prog
+    assert prog._is_distributed
+    ps = t.get_pserver_program('1.1.1.1:6174')
+    assert ps.global_block().ops[0].type == 'listen_and_serv'
+
+
+def test_memory_optimize_reports():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data('x', [4])
+        h = fluid.layers.fc(x, 8, act='relu')
+        loss = fluid.layers.mean(fluid.layers.fc(h, 1))
+    out = fluid.memory_optimize(prog)
+    assert out is prog
+    assert prog._memory_optimize_stats['num_vars'] > 0
+
+
+def test_profiler_records():
+    with tempfile.NamedTemporaryFile(mode='r', suffix='.prof') as f:
+        with fluid.profiler.profiler('CPU', profile_path=f.name):
+            with fluid.profiler.record_block('myblock'):
+                pass
+        content = open(f.name).read()
+    assert 'myblock' in content
+
+
+def test_metrics_accuracy_accumulator():
+    m = fluid.metrics.Accuracy()
+    m.update(value=0.5, weight=10)
+    m.update(value=1.0, weight=10)
+    assert abs(m.eval() - 0.75) < 1e-9

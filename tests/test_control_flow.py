@@ -1,0 +1,138 @@
+"""Control-flow tests: While->while_loop, StaticRNN/DynamicRNN->scan,
+seq2seq NMT model with attention
+(reference parity: test_while_op.py, test_recurrent_op.py, test_dyn_rnn.py,
+book test_machine_translation.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def test_while_loop_counts():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        i = fluid.layers.fill_constant(shape=[1], dtype='float32', value=0.0)
+        limit = fluid.layers.fill_constant(
+            shape=[1], dtype='float32', value=5.0)
+        total = fluid.layers.fill_constant(
+            shape=[1], dtype='float32', value=0.0)
+        cond = fluid.layers.less_than(x=i, y=limit)
+        w = fluid.layers.While(cond=cond)
+        with w.block():
+            new_total = fluid.layers.elementwise_add(total, i)
+            fluid.layers.assign(new_total, total)
+            fluid.layers.increment(x=i, value=1.0, in_place=True)
+            fluid.layers.less_than(x=i, y=limit, cond=cond)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        out, iv = exe.run(prog, feed={}, fetch_list=[total, i])
+    assert float(out[0]) == 10.0  # 0+1+2+3+4
+    assert float(iv[0]) == 5.0
+
+
+def test_static_rnn_sums_sequence():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        # time-major [T, B, D]
+        x = fluid.layers.data(
+            name='x', shape=[4, 3, 2], dtype='float32',
+            append_batch_size=False)
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            mem = rnn.memory(shape=[2], batch_ref=x_t, init_value=0.0,
+                             ref_batch_dim_idx=0)
+            acc = fluid.layers.elementwise_add(mem, x_t)
+            rnn.update_memory(mem, acc)
+            rnn.output(acc)
+        out = rnn()
+    data = np.arange(24, dtype='float32').reshape(4, 3, 2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        res, = exe.run(prog, feed={'x': data}, fetch_list=[out])
+    np.testing.assert_allclose(res, np.cumsum(data, axis=0), rtol=1e-5)
+
+
+def test_dynamic_rnn_with_memory_trains():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(
+            name='x', shape=[4], dtype='float32', lod_level=1)
+        rnn = fluid.layers.DynamicRNN()
+        with rnn.block():
+            x_t = rnn.step_input(x)
+            mem = rnn.memory(shape=[8], value=0.0)
+            new_mem = fluid.layers.fc(
+                input=[x_t, mem], size=8, act='tanh')
+            rnn.update_memory(mem, new_mem)
+            rnn.output(new_mem)
+        out = rnn()
+        last = fluid.layers.sequence_last_step(out)
+        loss = fluid.layers.mean(
+            fluid.layers.reduce_sum(
+                fluid.layers.square(last), dim=[1]))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    def _feed():
+        rows = [np.random.RandomState(3).randn(l, 4).tolist()
+                for l in (2, 5, 3)]
+        flat = np.concatenate(
+            [np.asarray(r, 'float32') for r in rows])
+        lt = fluid.core.LoDTensor(flat)
+        lt.set_recursive_sequence_lengths([[len(r) for r in rows]])
+        return lt
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        l1, = exe.run(prog, feed={'x': _feed()}, fetch_list=[loss])
+        for _ in range(10):
+            l2, = exe.run(prog, feed={'x': _feed()}, fetch_list=[loss])
+    assert np.isfinite(l1).all() and np.isfinite(l2).all()
+    assert float(l2[0]) < float(l1[0])  # minimizing ||h_last||^2
+
+
+def _nmt_feed(batch, vocab, rng):
+    def mk(rows):
+        flat = np.concatenate(
+            [np.asarray(r, 'int64').reshape(-1, 1) for r in rows])
+        lt = fluid.core.LoDTensor(flat)
+        lt.set_recursive_sequence_lengths([[len(r) for r in rows]])
+        return lt
+
+    # copy task: target = source (learnable signal for a tiny model)
+    src, trg, nxt = [], [], []
+    for _ in range(batch):
+        ls = int(rng.randint(3, 9))
+        s = rng.randint(1, vocab, ls).tolist()
+        src.append(s)
+        trg.append(s)
+        nxt.append(s[1:] + [0])
+    return {
+        'src_word_id': mk(src),
+        'target_language_word': mk(trg),
+        'target_language_next_word': mk(nxt),
+    }
+
+
+def test_seq2seq_attention_trains():
+    from paddle_tpu.models import seq2seq
+    model = seq2seq.build(
+        src_dict_dim=50, trg_dict_dim=50, embedding_dim=16,
+        encoder_size=16, decoder_size=16, lr=0.02)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    feed = _nmt_feed(8, 50, rng)  # one fixed batch, must overfit
+    losses = []
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(model['startup'])
+        for _ in range(15):
+            lv, = exe.run(model['main'], feed=feed,
+                          fetch_list=[model['loss']])
+            losses.append(float(lv[0]))
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])

@@ -1,0 +1,155 @@
+"""Data layer tests: native recordio round trip, blocking queue, py_reader
+training loop with EOF semantics, reader decorators
+(reference parity: test_recordio_reader.py, test_py_reader_push_pop.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.reader as reader_mod
+from paddle_tpu.runtime import (RecordIOWriter, RecordIOScanner,
+                                NativeBlockingQueue, lib_available,
+                                host_pool_stats)
+
+
+def test_native_lib_builds():
+    assert lib_available()
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / 'data.recordio')
+    records = [b'hello', b'world' * 100, b'', b'\x00\x01\x02']
+    with RecordIOWriter(path, compressor='zlib') as w:
+        for r in records:
+            w.write(r)
+    scanner = RecordIOScanner(path)
+    got = list(scanner)
+    scanner.close()
+    assert got == records
+
+
+def test_recordio_detects_corruption(tmp_path):
+    path = str(tmp_path / 'bad.recordio')
+    with RecordIOWriter(path) as w:
+        w.write(b'x' * 1000)
+    raw = bytearray(open(path, 'rb').read())
+    raw[-3] ^= 0xFF  # flip a payload byte -> crc must fail
+    open(path, 'wb').write(bytes(raw))
+    with pytest.raises((IOError, OSError)):
+        list(RecordIOScanner(path))
+
+
+def test_blocking_queue_producer_consumer():
+    import threading
+    q = NativeBlockingQueue(4)
+    items = [b'%d' % i for i in range(100)]
+
+    def produce():
+        for it in items:
+            q.push(it)
+        q.close()
+
+    t = threading.Thread(target=produce)
+    t.start()
+    got = []
+    while True:
+        d = q.pop()
+        if d is None:
+            break
+        got.append(d)
+    t.join()
+    assert got == items
+
+
+def test_py_reader_trains_with_eof(tmp_path):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        rd = fluid.layers.py_reader(
+            capacity=8, shapes=[[-1, 8], [-1, 1]],
+            dtypes=['float32', 'int64'])
+        img, label = fluid.layers.read_file(rd)
+        pred = fluid.layers.fc(img, 4, act='softmax')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    rng = np.random.RandomState(0)
+
+    def provider():
+        for _ in range(5):
+            yield (rng.standard_normal((16, 8)).astype('float32'),
+                   rng.randint(0, 4, (16, 1)).astype('int64'))
+
+    rd.decorate_tensor_provider(provider)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        for epoch in range(2):
+            rd.start()
+            steps = 0
+            while True:
+                try:
+                    lv, = exe.run(main, fetch_list=[loss])
+                    steps += 1
+                except fluid.core.EOFException:
+                    rd.reset()
+                    break
+            assert steps == 5, steps
+
+
+def test_recordio_file_reader_pipeline(tmp_path):
+    path = str(tmp_path / 'train.recordio')
+    # write via the fluid API
+    place = fluid.CPUPlace()
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = fluid.layers.data('x', [4])
+        y = fluid.layers.data('y', [1], dtype='int64')
+    feeder = fluid.DataFeeder(feed_list=['x', 'y'], place=place,
+                              program=prog)
+
+    def batched():
+        rng = np.random.RandomState(1)
+        for _ in range(3):
+            yield [(rng.standard_normal(4).astype('float32'), [1])
+                   for _ in range(8)]
+
+    n = fluid.recordio_writer.convert_reader_to_recordio_file(
+        path, batched, feeder)
+    assert n == 3
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        rd = fluid.layers.open_recordio_file(
+            path, shapes=[[-1, 4], [-1, 1]], dtypes=['float32', 'int64'])
+        x_var, y_var = fluid.layers.read_file(rd)
+        s = fluid.layers.mean(x_var)
+    exe = fluid.Executor(place)
+    with fluid.scope_guard(fluid.core.Scope()):
+        rd.start()
+        count = 0
+        while True:
+            try:
+                exe.run(main, fetch_list=[s])
+                count += 1
+            except fluid.core.EOFException:
+                break
+        assert count == 3
+
+
+def test_reader_decorators():
+    def r():
+        return iter(range(10))
+
+    assert list(reader_mod.firstn(r, 3)()) == [0, 1, 2]
+    mapped = reader_mod.map_readers(lambda a: a * 2, r)
+    assert list(mapped())[:3] == [0, 2, 4]
+    buffered = reader_mod.buffered(r, 2)
+    assert sorted(buffered()) == list(range(10))
+    composed = reader_mod.compose(r, r)
+    assert list(composed())[0] == (0, 0)
+    shuffled = reader_mod.shuffle(r, 5)
+    assert sorted(shuffled()) == list(range(10))

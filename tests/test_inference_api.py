@@ -1,0 +1,55 @@
+"""Predictor API test (reference parity: inference/api tests +
+book save/load inference flows)."""
+
+import numpy as np
+
+import paddle_tpu
+import paddle_tpu.fluid as fluid
+from paddle_tpu.inference import (NativeConfig, PaddleTensor,
+                                  create_paddle_predictor)
+
+
+def test_predictor_roundtrip(tmp_path):
+    model_dir = str(tmp_path / 'model')
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', [8])
+        label = fluid.layers.data('label', [1], dtype='int64')
+        pred = fluid.layers.fc(x, 4, act='softmax')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main,
+                feed={'x': np.random.randn(4, 8).astype('float32'),
+                      'label': np.zeros((4, 1), 'int64')},
+                fetch_list=[loss])
+        fluid.io.save_inference_model(model_dir, ['x'], [pred], exe, main)
+        expected, = exe.run(
+            main.prune([pred]).inference_optimize(),
+            feed={'x': np.ones((2, 8), 'float32')},
+            fetch_list=[pred.name])
+
+    config = NativeConfig(model_dir=model_dir, use_tpu=False)
+    predictor = create_paddle_predictor(config)
+    assert predictor.feed_names == ['x']
+    outs = predictor.run([PaddleTensor(data=np.ones((2, 8), 'float32'))])
+    assert outs[0].data.shape == (2, 4)
+    np.testing.assert_allclose(outs[0].data, expected, rtol=1e-5)
+
+    clone = predictor.clone()
+    outs2 = clone.run({'x': np.ones((2, 8), 'float32')})
+    np.testing.assert_allclose(outs2[0].data, expected, rtol=1e-5)
+
+
+def test_paddle_batch():
+    def r():
+        return iter(range(10))
+
+    batches = list(paddle_tpu.batch(r, 4)())
+    assert batches == [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9]]
+    batches = list(paddle_tpu.batch(r, 4, drop_last=True)())
+    assert batches == [[0, 1, 2, 3], [4, 5, 6, 7]]
