@@ -5,25 +5,62 @@
 // BlockingQueue (operators/reader/blocking_queue.h): producer threads push
 // serialized minibatches, the executor pops them ahead of each compiled
 // step.  C ABI for ctypes; payload framing is the caller's business.
+//
+// Buffers are carried by the pooled host staging allocator (host_pool.cc)
+// so per-step minibatch churn recycles blocks instead of hitting malloc.
 
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
 #include <deque>
 #include <mutex>
-#include <string>
+
+extern "C" {
+void* hp_alloc(uint64_t size);
+void hp_free(void* p, uint64_t size);
+}
 
 namespace {
+
+struct Buf {
+  char* ptr = nullptr;
+  uint64_t len = 0;
+};
 
 struct Queue {
   std::mutex mu;
   std::condition_variable not_full;
   std::condition_variable not_empty;
-  std::deque<std::string> items;
+  std::condition_variable drained;  // destroy handshake
+  std::deque<Buf> items;
   size_t capacity;
+  int waiters = 0;
   bool closed = false;
-  std::string front_hold;  // keeps popped bytes alive for the caller
+  Buf front_hold;  // keeps popped bytes alive for the caller
 };
+
+// RAII waiter count so bq_destroy can wait for blocked threads to leave
+// before freeing the Queue (lock must be held at ctor/dtor).
+struct WaiterGuard {
+  Queue* q;
+  explicit WaiterGuard(Queue* queue) : q(queue) { ++q->waiters; }
+  ~WaiterGuard() {
+    if (--q->waiters == 0) q->drained.notify_all();
+  }
+};
+
+void release(Buf* b) {
+  if (b->ptr) {
+    hp_free(b->ptr, b->len);
+    b->ptr = nullptr;
+    b->len = 0;
+  }
+}
+
+void drain(Queue* q) {
+  for (auto& b : q->items) release(&b);
+  q->items.clear();
+}
 
 }  // namespace
 
@@ -35,15 +72,27 @@ void* bq_create(uint64_t capacity) {
   return q;
 }
 
-// 0 on success, -1 if closed.
+// 0 on success, -1 if closed or out of memory.
 int bq_push(void* handle, const char* data, uint64_t len) {
   auto* q = static_cast<Queue*>(handle);
+  Buf b;
+  b.ptr = static_cast<char*>(hp_alloc(len ? len : 1));
+  if (!b.ptr) return -1;
+  b.len = len;
+  std::memcpy(b.ptr, data, len);
   std::unique_lock<std::mutex> lock(q->mu);
-  q->not_full.wait(lock, [q] {
-    return q->closed || q->items.size() < q->capacity;
-  });
-  if (q->closed) return -1;
-  q->items.emplace_back(data, len);
+  {
+    WaiterGuard guard(q);
+    q->not_full.wait(lock, [q] {
+      return q->closed || q->items.size() < q->capacity;
+    });
+  }
+  if (q->closed) {
+    lock.unlock();
+    release(&b);
+    return -1;
+  }
+  q->items.push_back(b);
   q->not_empty.notify_one();
   return 0;
 }
@@ -52,13 +101,17 @@ int bq_push(void* handle, const char* data, uint64_t len) {
 int64_t bq_pop(void* handle, const char** data) {
   auto* q = static_cast<Queue*>(handle);
   std::unique_lock<std::mutex> lock(q->mu);
-  q->not_empty.wait(lock, [q] { return q->closed || !q->items.empty(); });
+  {
+    WaiterGuard guard(q);
+    q->not_empty.wait(lock, [q] { return q->closed || !q->items.empty(); });
+  }
   if (q->items.empty()) return 0;  // closed and drained
-  q->front_hold = std::move(q->items.front());
+  release(&q->front_hold);
+  q->front_hold = q->items.front();
   q->items.pop_front();
   q->not_full.notify_one();
-  *data = q->front_hold.data();
-  return static_cast<int64_t>(q->front_hold.size());
+  *data = q->front_hold.ptr;
+  return static_cast<int64_t>(q->front_hold.len);
 }
 
 uint64_t bq_size(void* handle) {
@@ -80,12 +133,23 @@ void bq_reopen(void* handle) {
   auto* q = static_cast<Queue*>(handle);
   std::lock_guard<std::mutex> lock(q->mu);
   q->closed = false;
-  q->items.clear();
+  drain(q);
 }
 
 void bq_destroy(void* handle) {
-  bq_close(handle);
-  delete static_cast<Queue*>(handle);
+  auto* q = static_cast<Queue*>(handle);
+  {
+    std::unique_lock<std::mutex> lock(q->mu);
+    q->closed = true;
+    q->not_empty.notify_all();
+    q->not_full.notify_all();
+    // wait until every thread blocked in bq_push/bq_pop has left the
+    // wait loop, otherwise `delete q` frees a mutex they still hold
+    q->drained.wait(lock, [q] { return q->waiters == 0; });
+    drain(q);
+    release(&q->front_hold);
+  }
+  delete q;
 }
 
 }  // extern "C"

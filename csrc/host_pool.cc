@@ -56,7 +56,11 @@ void* hp_alloc(uint64_t size) {
     if (g_pool.in_use > g_pool.peak) g_pool.peak = g_pool.in_use;
   }
   void* p = nullptr;
-  if (posix_memalign(&p, kAlignment, b) != 0) return nullptr;
+  if (posix_memalign(&p, kAlignment, b) != 0) {
+    std::lock_guard<std::mutex> lock(g_pool.mu);
+    g_pool.in_use -= b;
+    return nullptr;
+  }
   return p;
 }
 

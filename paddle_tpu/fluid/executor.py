@@ -28,6 +28,25 @@ from ..ops import registry
 
 __all__ = ['Executor', 'global_scope', 'scope_guard', '_switch_scope']
 
+
+def _fetch_var(name, scope=None, return_numpy=True):
+    """Fetch a (typically persistable) variable's value straight from a
+    scope without running a program (reference executor.py:174)."""
+    assert isinstance(name, str)
+    if scope is None:
+        scope = global_scope()
+    var = scope.find_var(name)
+    assert var is not None, (
+        'Cannot find ' + name + ' in scope. Perhaps you need to make the'
+        ' variable persistable by using var.persistable = True in your'
+        ' program.')
+    value = var.value()
+    if return_numpy:
+        return as_numpy(value)
+    if not isinstance(value, core.LoDTensor):
+        value = core.LoDTensor(np.asarray(value))
+    return value
+
 _scope_stack = [core.global_scope()]
 
 

@@ -59,6 +59,10 @@ class LayerHelper(object):
 
     def multiple_param_attr(self, length):
         param_attr = self.param_attr
+        if param_attr is False:
+            # param_attr=False: parameter exists but is frozen (bias_attr
+            # =False is handled earlier by append_bias_op skipping the op)
+            param_attr = ParamAttr(trainable=False)
         if isinstance(param_attr, ParamAttr):
             param_attr = [param_attr]
         if len(param_attr) != 1 and len(param_attr) != length:
@@ -94,6 +98,10 @@ class LayerHelper(object):
                          default_initializer=None):
         """Create a Parameter in the main program and its init op in the
         startup program (the two-program design of the reference)."""
+        if attr is False:
+            # layers that create their params directly (batch_norm scale/
+            # bias etc.) treat attr=False as a frozen parameter
+            attr = ParamAttr(trainable=False)
         attr = copy.deepcopy(attr) if attr is not None else ParamAttr()
         if default_initializer is None:
             if is_bias:

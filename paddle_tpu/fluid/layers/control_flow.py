@@ -239,7 +239,8 @@ class StaticRNN(object):
                     dim_idx = ref_batch_dim_idx + 1
                     break
             init = parent_block.create_var(
-                name='{}.init'.format(self.helper.name),
+                name='{}.init.{}'.format(self.helper.name,
+                                         len(self.memories)),
                 dtype='float32',
                 shape=[-1] + list(shape))
             parent_block.append_op(
@@ -253,7 +254,7 @@ class StaticRNN(object):
                     'dtype': init.dtype,
                 })
         mem = self.sub_block.create_var(
-            name='{}.mem'.format(self.helper.name),
+            name='{}.mem.{}'.format(self.helper.name, len(self.memories)),
             dtype=init.dtype,
             shape=init.shape)
         self.memories[mem.name] = [init.name, None]
@@ -384,7 +385,8 @@ class DynamicRNN(object):
             parent_block = self.helper.main_program.block(self.parent_idx)
             first_seq = self.inputs[0][0] if self.inputs else None
             init = parent_block.create_var(
-                name='{}.mem_init'.format(self.helper.name),
+                name='{}.mem_init.{}'.format(self.helper.name,
+                                             len(self.memories)),
                 dtype=dtype,
                 shape=[-1] + list(shape))
             parent_block.append_op(

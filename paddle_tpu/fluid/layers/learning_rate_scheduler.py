@@ -35,7 +35,7 @@ def noam_decay(d_model, warmup_steps):
     a = ops.pow(global_step, factor=-0.5)
     b = ops.scale(global_step, scale=warmup_steps**-1.5)
     lr_value = ops.scale(
-        nn.elementwise_min(a, b), scale=d_model**-0.5)
+        ops.elementwise_min(a, b), scale=d_model**-0.5)
     return lr_value
 
 
@@ -72,7 +72,7 @@ def inverse_time_decay(learning_rate, decay_steps, decay_rate,
     denom = ops.scale(div_res, scale=float(decay_rate), bias=1.0)
     one = tensor.fill_constant(shape=[1], dtype='float32',
                                value=float(learning_rate))
-    return nn.elementwise_div(one, denom)
+    return ops.elementwise_div(one, denom)
 
 
 def polynomial_decay(learning_rate,
@@ -88,11 +88,11 @@ def polynomial_decay(learning_rate,
         # when step == 0, div_res should be 1
         zero = tensor.fill_constant(shape=[1], dtype='float32', value=0.0)
         one = tensor.fill_constant(shape=[1], dtype='float32', value=1.0)
-        div_res = nn.elementwise_max(div_res, one)
+        div_res = ops.elementwise_max(div_res, one)
         decay_steps_var = ops.scale(div_res, scale=float(decay_steps))
-        ratio = nn.elementwise_div(global_step, decay_steps_var)
+        ratio = ops.elementwise_div(global_step, decay_steps_var)
     else:
-        capped = nn.elementwise_min(
+        capped = ops.elementwise_min(
             global_step,
             tensor.fill_constant(
                 shape=[1], dtype='float32', value=float(decay_steps)))

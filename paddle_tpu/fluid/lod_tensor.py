@@ -13,19 +13,13 @@ def create_lod_tensor(data, recursive_seq_lens, place=None):
     if isinstance(data, core.LoDTensor):
         return create_lod_tensor(data.numpy(), recursive_seq_lens, place)
     if isinstance(data, list):
-        flat = []
-
-        def _flatten(d, level):
-            if level == 0:
-                flat.append(np.asarray(d).reshape(-1, 1) if np.asarray(
-                    d).ndim <= 1 else np.asarray(d))
-            else:
-                for x in d:
-                    _flatten(x, level - 1)
-
-        total = sum(recursive_seq_lens[-1])
+        # flatten through all LoD nesting levels down to per-sequence rows
+        # (reference lod_tensor.py:24 accepts arbitrarily nested lists)
+        rows = data
+        for _ in range(len(recursive_seq_lens) - 1):
+            rows = [seq for group in rows for seq in group]
         arrs = [np.asarray(row).reshape(len(row), -1) if not np.isscalar(
-            row) else np.asarray([[row]]) for row in data]
+            row) else np.asarray([[row]]) for row in rows]
         data = np.concatenate(arrs, axis=0)
     data = np.asarray(data)
     t = core.LoDTensor(data)

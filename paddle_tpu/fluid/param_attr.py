@@ -48,8 +48,9 @@ class ParamAttr(object):
         elif isinstance(arg, Initializer):
             return ParamAttr(initializer=arg)
         elif isinstance(arg, bool):
-            return ParamAttr._to_attr(None) if arg else ParamAttr(
-                trainable=False)
+            # False disables the parameter entirely (reference
+            # param_attr.py:147-148: append_bias_op sees falsy and skips)
+            return ParamAttr._to_attr(None) if arg else False
         else:
             raise TypeError('invalid param_attr %r' % (arg, ))
 

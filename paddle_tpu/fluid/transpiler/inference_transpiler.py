@@ -31,39 +31,65 @@ class InferenceTranspiler(object):
             np.asarray(val)
 
     def _fuse_batch_norm(self, program, scope):
+        """Match conv2d [+ elementwise_add bias] + batch_norm and fold the
+        BN statistics into the conv filter (and bias, when present) — the
+        reference's two patterns, transpiler/inference_transpiler.py:40-58."""
         block = program.global_block()
         i = 0
         while i < len(block.ops) - 1:
-            op = block.ops[i]
-            next_op = block.ops[i + 1]
-            if op.type in ('conv2d', 'depthwise_conv2d') and \
-                    next_op.type == 'batch_norm' and \
-                    next_op.input('X') == op.output('Output'):
-                scale = self._scope_np(scope, next_op.input('Scale')[0])
-                bias = self._scope_np(scope, next_op.input('Bias')[0])
-                mean = self._scope_np(scope, next_op.input('Mean')[0])
-                var = self._scope_np(scope, next_op.input('Variance')[0])
-                w_name = op.input('Filter')[0]
-                w = self._scope_np(scope, w_name)
-                if any(v is None for v in (scale, bias, mean, var, w)):
+            conv_op = block.ops[i]
+            if conv_op.type not in ('conv2d', 'depthwise_conv2d'):
+                i += 1
+                continue
+            j = i + 1
+            bias_add = None
+            if block.ops[j].type == 'elementwise_add' and \
+                    block.ops[j].input('X') == conv_op.output('Output') and \
+                    j + 1 < len(block.ops):
+                bias_add = block.ops[j]
+                j += 1
+            bn = block.ops[j]
+            prev_out = (bias_add.output('Out') if bias_add is not None
+                        else conv_op.output('Output'))
+            if bn.type != 'batch_norm' or bn.input('X') != prev_out:
+                i += 1
+                continue
+            scale = self._scope_np(scope, bn.input('Scale')[0])
+            bias = self._scope_np(scope, bn.input('Bias')[0])
+            mean = self._scope_np(scope, bn.input('Mean')[0])
+            var = self._scope_np(scope, bn.input('Variance')[0])
+            w_name = conv_op.input('Filter')[0]
+            w = self._scope_np(scope, w_name)
+            if any(v is None for v in (scale, bias, mean, var, w)):
+                i += 1
+                continue
+            eps = bn.attrs.get('epsilon', 1e-5)
+            inv_std = 1.0 / np.sqrt(var + eps)
+            factor = (scale * inv_std).astype(w.dtype)
+            scope.var(w_name).set_value(w * factor[:, None, None, None])
+            if bias_add is not None:
+                # BN(conv + b) = conv' + factor*b + (bias - factor*mean):
+                # scale the existing conv bias by factor too.  If Y is not
+                # a scope param the add is a residual/skip connection —
+                # undo the filter rescale and skip the fusion entirely.
+                b_name = bias_add.input('Y')[0]
+                b = self._scope_np(scope, b_name)
+                if b is None or b.size != factor.size:
+                    scope.var(w_name).set_value(w)
                     i += 1
                     continue
-                eps = next_op.attrs.get('epsilon', 1e-5)
-                inv_std = 1.0 / np.sqrt(var + eps)
-                factor = (scale * inv_std).astype(w.dtype)
-                scope.var(w_name).set_value(
-                    w * factor[:, None, None, None])
-                new_bias = (bias - mean * scale * inv_std).astype(w.dtype)
-                # rewrite: conv Output feeds where BN's Y went, plus an
-                # elementwise bias add
-                bn_out = next_op.output('Y')[0]
-                bias_name = next_op.input('Bias')[0]
-                scope.var(bias_name).set_value(new_bias)
-                block.ops[i + 1] = type(next_op)(
-                    block, 'elementwise_add',
-                    inputs={'X': op.output('Output'),
-                            'Y': [bias_name]},
-                    outputs={'Out': [bn_out]},
-                    attrs={'axis': 1})
-                program._bump_version()
+                scope.var(b_name).set_value(
+                    (b * factor.reshape(b.shape)).astype(b.dtype))
+            new_bias = (bias - mean * scale * inv_std).astype(w.dtype)
+            # the BN op becomes a bias add: prev_out + new_bias -> BN's Y
+            bn_out = bn.output('Y')[0]
+            bias_name = bn.input('Bias')[0]
+            scope.var(bias_name).set_value(new_bias)
+            block.ops[j] = type(bn)(
+                block, 'elementwise_add',
+                inputs={'X': prev_out,
+                        'Y': [bias_name]},
+                outputs={'Out': [bn_out]},
+                attrs={'axis': 1})
+            program._bump_version()
             i += 1

@@ -1,0 +1,238 @@
+"""OpTest harness: the main per-op test surface, mirroring the reference's
+python/paddle/fluid/tests/unittests/op_test.py:134.
+
+A test declares ``self.op_type``, ``self.inputs``, ``self.attrs``,
+``self.outputs`` (numpy values).  ``check_output()`` builds a one-op program,
+runs it through the real Executor (whole-block XLA compile on the CPU backend)
+and compares against the declared numpy reference (op_test.py:371 analog).
+``check_grad()`` compares the analytic gradient produced by
+``append_backward`` against a central finite difference of a scalar
+projection of the outputs (op_test.py:43,403 analog).
+
+Input/output slot values are either a bare ndarray, a (ndarray, lod) tuple
+for LoD inputs, or a list of (name, ndarray) pairs for duplicable slots.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from paddle_tpu.fluid.backward import append_backward
+
+GRAD_SUFFIX = '@GRAD'
+
+
+def _as_pairs(slot, value):
+    """Normalize a slot's declared value to [(var_name, ndarray, lod)]."""
+    if isinstance(value, list):
+        out = []
+        for item in value:
+            name, arr = item[0], item[1]
+            if isinstance(arr, tuple):
+                out.append((name, np.asarray(arr[0]), arr[1]))
+            else:
+                out.append((name, np.asarray(arr), None))
+        return out
+    if isinstance(value, tuple):
+        return [(slot, np.asarray(value[0]), value[1])]
+    return [(slot, np.asarray(value), None)]
+
+
+class OpTest(object):
+    """Subclass and define setup() (or set attributes in the test fn)."""
+
+    op_type = None
+    inputs = None
+    attrs = None
+    outputs = None
+
+    # ---------------- program construction ----------------
+
+    def _build(self, with_loss=False, loss_weights=None):
+        main = fluid.Program()
+        startup = fluid.Program()
+        feed = {}
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            in_args = {}
+            for slot, value in (self.inputs or {}).items():
+                names = []
+                for name, arr, lod in _as_pairs(slot, value):
+                    v = block.create_var(
+                        name=name, shape=arr.shape, dtype=arr.dtype,
+                        is_data=True)
+                    v.stop_gradient = False
+                    if lod is not None:
+                        lt = core.LoDTensor(arr)
+                        lt.set_lod(lod)
+                        feed[name] = lt
+                    else:
+                        feed[name] = arr
+                    names.append(name)
+                in_args[slot] = names
+            out_args = {}
+            out_names = []
+            for slot, value in (self.outputs or {}).items():
+                names = []
+                for name, arr, _ in _as_pairs(slot, value):
+                    block.create_var(name=name,
+                                     shape=np.asarray(arr).shape,
+                                     dtype=np.asarray(arr).dtype)
+                    names.append(name)
+                    out_names.append((slot, name, np.asarray(arr)))
+                out_args[slot] = names
+            block.append_op(type=self.op_type, inputs=in_args,
+                            outputs=out_args, attrs=dict(self.attrs or {}))
+            loss = None
+            if with_loss:
+                # scalar projection: sum_i w_i * out_i over the checked
+                # float outputs, analog of the reference's appended mean op
+                parts = []
+                for (slot, name, ref) in out_names:
+                    if loss_weights is not None and name not in loss_weights:
+                        continue
+                    if not np.issubdtype(ref.dtype, np.floating):
+                        continue
+                    v = block.var(name)
+                    w = self._proj_weight(name, ref)
+                    wv = block.create_var(name=name + '@proj_w',
+                                          shape=ref.shape, dtype=ref.dtype,
+                                          is_data=True)
+                    feed[name + '@proj_w'] = w
+                    prod = block.create_var(name=name + '@proj',
+                                            shape=ref.shape, dtype=ref.dtype)
+                    block.append_op(type='elementwise_mul',
+                                    inputs={'X': [name],
+                                            'Y': [name + '@proj_w']},
+                                    outputs={'Out': [name + '@proj']},
+                                    attrs={'axis': -1})
+                    red = block.create_var(name=name + '@proj_sum',
+                                           shape=(1, ), dtype=ref.dtype)
+                    block.append_op(type='reduce_sum',
+                                    inputs={'X': [name + '@proj']},
+                                    outputs={'Out': [name + '@proj_sum']},
+                                    attrs={'reduce_all': True,
+                                           'keep_dim': False})
+                    parts.append(name + '@proj_sum')
+                assert parts, 'no float output to differentiate'
+                loss_name = '@loss'
+                block.create_var(name=loss_name, shape=(1, ),
+                                 dtype='float32')
+                block.append_op(type='sum',
+                                inputs={'X': parts},
+                                outputs={'Out': [loss_name]},
+                                attrs={})
+                loss = block.var(loss_name)
+                loss.shape = (1, )
+        return main, startup, feed, out_names, loss
+
+    def _proj_weight(self, name, ref):
+        import zlib
+        rng = np.random.RandomState(zlib.crc32(name.encode()) % (2**31))
+        return rng.uniform(0.5, 1.5, size=ref.shape).astype(ref.dtype)
+
+    # ---------------- checks ----------------
+
+    def check_output(self, atol=1e-5, rtol=1e-4, no_check_set=None):
+        main, startup, feed, out_names, _ = self._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = core.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            fetch = [n for _, n, _ in out_names
+                     if not (no_check_set and n in no_check_set)]
+            vals = exe.run(main, feed=dict(feed), fetch_list=fetch)
+        got = dict(zip(fetch, vals))
+        for slot, name, ref in out_names:
+            if no_check_set and name in no_check_set:
+                continue
+            actual = np.asarray(got[name])
+            assert actual.shape == tuple(ref.shape) or (
+                ref.size == actual.size), (
+                    '%s/%s shape %s vs ref %s' %
+                    (self.op_type, name, actual.shape, ref.shape))
+            if np.issubdtype(ref.dtype, np.floating):
+                np.testing.assert_allclose(
+                    actual.reshape(ref.shape), ref, atol=atol, rtol=rtol,
+                    err_msg='%s output %s mismatch' % (self.op_type, name))
+            else:
+                np.testing.assert_array_equal(
+                    actual.reshape(ref.shape), ref,
+                    err_msg='%s output %s mismatch' % (self.op_type, name))
+
+    def check_grad(self,
+                   inputs_to_check,
+                   output_names=None,
+                   max_relative_error=1e-2,
+                   numeric_delta=5e-3,
+                   no_grad_set=None):
+        """Analytic (append_backward) vs central finite difference."""
+        loss_weights = None
+        if output_names is not None:
+            if isinstance(output_names, str):
+                output_names = [output_names]
+            loss_weights = set(output_names)
+        main, startup, feed, out_names, loss = self._build(
+            with_loss=True, loss_weights=loss_weights)
+        # forward-only clone for the FD loop, before grad ops are appended
+        fwd_prog = main.clone()
+        with fluid.program_guard(main, startup):
+            append_backward(loss, no_grad_set=no_grad_set)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = core.Scope()
+        grad_names = [n + GRAD_SUFFIX for n in inputs_to_check]
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            vals = exe.run(main, feed=dict(feed),
+                           fetch_list=grad_names + [loss.name])
+        analytic = dict(zip(grad_names, vals[:-1]))
+
+        # numeric: central differences of the same scalar loss
+        fwd_exe = fluid.Executor(fluid.CPUPlace())
+        fwd_scope = core.Scope()
+
+        def run_loss(cur_feed):
+            with fluid.scope_guard(fwd_scope):
+                out = fwd_exe.run(fwd_prog, feed=cur_feed,
+                                  fetch_list=[loss.name])
+            return float(np.asarray(out[0]).reshape(()))
+
+        with fluid.scope_guard(fwd_scope):
+            fwd_exe.run(startup)
+        for vname in inputs_to_check:
+            base = feed[vname]
+            if isinstance(base, core.LoDTensor):
+                arr = base.numpy().copy()
+                lod = base.lod()
+            else:
+                arr = np.asarray(base).astype(np.float64).copy()
+                lod = None
+            numeric = np.zeros_like(arr, dtype=np.float64)
+            flat = arr.reshape(-1)
+            num = np.zeros(flat.shape, np.float64)
+            for i in range(flat.size):
+                orig = flat[i]
+                for sign in (+1, -1):
+                    flat[i] = orig + sign * numeric_delta
+                    cur = dict(feed)
+                    if lod is not None:
+                        lt = core.LoDTensor(arr.astype(
+                            np.asarray(base.numpy()).dtype))
+                        lt.set_lod(lod)
+                        cur[vname] = lt
+                    else:
+                        cur[vname] = arr.astype(
+                            np.asarray(feed[vname]).dtype)
+                    val = run_loss(cur)
+                    num[i] += sign * val
+                flat[i] = orig
+            numeric = (num / (2.0 * numeric_delta)).reshape(arr.shape)
+            got = np.asarray(analytic[vname + GRAD_SUFFIX],
+                             dtype=np.float64).reshape(arr.shape)
+            abs_max = max(np.abs(numeric).max(), np.abs(got).max(), 1e-3)
+            diff = np.abs(numeric - got).max() / abs_max
+            assert diff <= max_relative_error, (
+                '%s grad wrt %s: max rel diff %.3g > %.3g\nnumeric=%s\n'
+                'analytic=%s' % (self.op_type, vname, diff,
+                                 max_relative_error, numeric, got))

@@ -1,0 +1,262 @@
+"""Per-op tests for math/elementwise/reduce ops via the OpTest harness
+(reference pattern: tests/unittests/test_elementwise_add_op.py etc.)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+RNG = np.random.RandomState(7)
+
+
+def _t(op_type, inputs, outputs, attrs=None):
+    t = OpTest()
+    t.op_type = op_type
+    t.inputs = inputs
+    t.outputs = outputs
+    t.attrs = attrs or {}
+    return t
+
+
+class TestElementwiseAdd:
+    def test_same_shape(self):
+        x = RNG.uniform(0.1, 1, (3, 4)).astype('float32')
+        y = RNG.uniform(0.1, 1, (3, 4)).astype('float32')
+        t = _t('elementwise_add', {'X': x, 'Y': y}, {'Out': x + y})
+        t.check_output()
+        t.check_grad(['X', 'Y'])
+
+    def test_broadcast_axis(self):
+        # reference broadcast: Y's dims align to X starting at `axis`
+        x = RNG.uniform(0.1, 1, (2, 3, 4)).astype('float32')
+        y = RNG.uniform(0.1, 1, (3, )).astype('float32')
+        out = x + y.reshape(1, 3, 1)
+        t = _t('elementwise_add', {'X': x, 'Y': y}, {'Out': out},
+               {'axis': 1})
+        t.check_output()
+        t.check_grad(['X', 'Y'])
+
+
+class TestElementwiseOthers:
+    def test_sub(self):
+        x = RNG.uniform(0.1, 1, (3, 4)).astype('float32')
+        y = RNG.uniform(0.1, 1, (3, 4)).astype('float32')
+        _t('elementwise_sub', {'X': x, 'Y': y}, {'Out': x - y}) \
+            .check_output()
+
+    def test_mul_broadcast(self):
+        x = RNG.uniform(0.1, 1, (2, 3, 4)).astype('float32')
+        y = RNG.uniform(0.5, 1, (2, 3)).astype('float32')
+        out = x * y.reshape(2, 3, 1)
+        t = _t('elementwise_mul', {'X': x, 'Y': y}, {'Out': out},
+               {'axis': 0})
+        t.check_output()
+        t.check_grad(['X', 'Y'])
+
+    def test_div(self):
+        x = RNG.uniform(0.5, 1, (3, 4)).astype('float32')
+        y = RNG.uniform(0.5, 1, (3, 4)).astype('float32')
+        t = _t('elementwise_div', {'X': x, 'Y': y}, {'Out': x / y})
+        t.check_output()
+        t.check_grad(['X', 'Y'], max_relative_error=2e-2)
+
+    def test_max_min_pow(self):
+        x = RNG.uniform(0.5, 1.5, (3, 4)).astype('float32')
+        y = RNG.uniform(0.5, 1.5, (3, 4)).astype('float32')
+        _t('elementwise_max', {'X': x, 'Y': y},
+           {'Out': np.maximum(x, y)}).check_output()
+        _t('elementwise_min', {'X': x, 'Y': y},
+           {'Out': np.minimum(x, y)}).check_output()
+        _t('elementwise_pow', {'X': x, 'Y': y},
+           {'Out': np.power(x, y)}).check_output()
+
+
+class TestMulMatmul:
+    def test_mul(self):
+        x = RNG.uniform(-1, 1, (4, 5)).astype('float32')
+        y = RNG.uniform(-1, 1, (5, 3)).astype('float32')
+        t = _t('mul', {'X': x, 'Y': y}, {'Out': x.dot(y)},
+               {'x_num_col_dims': 1, 'y_num_col_dims': 1})
+        t.check_output()
+        t.check_grad(['X', 'Y'])
+
+    def test_mul_flatten(self):
+        # x_num_col_dims flattens trailing dims (mul_op.cc semantics)
+        x = RNG.uniform(-1, 1, (2, 3, 4)).astype('float32')
+        y = RNG.uniform(-1, 1, (12, 5)).astype('float32')
+        out = x.reshape(2, 12).dot(y).reshape(2, 5)
+        t = _t('mul', {'X': x, 'Y': y}, {'Out': out},
+               {'x_num_col_dims': 1, 'y_num_col_dims': 1})
+        t.check_output()
+
+    def test_matmul_transpose(self):
+        x = RNG.uniform(-1, 1, (3, 5)).astype('float32')
+        y = RNG.uniform(-1, 1, (4, 5)).astype('float32')
+        t = _t('matmul', {'X': x, 'Y': y}, {'Out': x.dot(y.T)},
+               {'transpose_X': False, 'transpose_Y': True})
+        t.check_output()
+        t.check_grad(['X', 'Y'])
+
+    def test_matmul_batched(self):
+        x = RNG.uniform(-1, 1, (2, 3, 5)).astype('float32')
+        y = RNG.uniform(-1, 1, (2, 5, 4)).astype('float32')
+        _t('matmul', {'X': x, 'Y': y}, {'Out': np.matmul(x, y)},
+           {'transpose_X': False, 'transpose_Y': False}).check_output()
+
+
+class TestReduce:
+    def test_reduce_sum_dim(self):
+        x = RNG.uniform(-1, 1, (3, 4, 5)).astype('float32')
+        t = _t('reduce_sum', {'X': x}, {'Out': x.sum(axis=1)},
+               {'dim': [1], 'keep_dim': False, 'reduce_all': False})
+        t.check_output()
+        t.check_grad(['X'])
+
+    def test_reduce_mean_keepdim(self):
+        x = RNG.uniform(-1, 1, (3, 4)).astype('float32')
+        t = _t('reduce_mean', {'X': x},
+               {'Out': x.mean(axis=0, keepdims=True)},
+               {'dim': [0], 'keep_dim': True, 'reduce_all': False})
+        t.check_output()
+        t.check_grad(['X'])
+
+    def test_reduce_max_all(self):
+        x = RNG.uniform(-1, 1, (3, 4)).astype('float32')
+        _t('reduce_max', {'X': x}, {'Out': np.asarray(x.max())},
+           {'reduce_all': True, 'keep_dim': False}).check_output()
+
+    def test_mean(self):
+        x = RNG.uniform(-1, 1, (3, 4)).astype('float32')
+        t = _t('mean', {'X': x}, {'Out': np.asarray(x.mean())})
+        t.check_output()
+        t.check_grad(['X'])
+
+    def test_sum_of_list(self):
+        a = RNG.uniform(-1, 1, (3, 4)).astype('float32')
+        b = RNG.uniform(-1, 1, (3, 4)).astype('float32')
+        c = RNG.uniform(-1, 1, (3, 4)).astype('float32')
+        _t('sum', {'X': [('a', a), ('b', b), ('c', c)]},
+           {'Out': a + b + c}).check_output()
+
+
+class TestActivations:
+    def _check(self, op_type, fn, lo=-1.0, hi=1.0, grad=True, attrs=None,
+               tol=1e-2):
+        x = RNG.uniform(lo, hi, (3, 4)).astype('float32')
+        t = _t(op_type, {'X': x}, {'Out': fn(x)}, attrs)
+        t.check_output()
+        if grad:
+            t.check_grad(['X'], max_relative_error=tol)
+
+    def test_relu(self):
+        self._check('relu', lambda x: np.maximum(x, 0), grad=False)
+
+    def test_sigmoid(self):
+        self._check('sigmoid', lambda x: 1 / (1 + np.exp(-x)))
+
+    def test_tanh(self):
+        self._check('tanh', np.tanh)
+
+    def test_exp_log_sqrt(self):
+        self._check('exp', np.exp)
+        self._check('log', np.log, lo=0.2, hi=2.0)
+        self._check('sqrt', np.sqrt, lo=0.2, hi=2.0)
+
+    def test_square_abs_reciprocal(self):
+        self._check('square', np.square)
+        self._check('abs', np.abs, grad=False)
+        self._check('reciprocal', lambda x: 1 / x, lo=0.5, hi=1.5)
+
+    def test_softplus_softsign(self):
+        self._check('softplus', lambda x: np.log1p(np.exp(x)))
+        self._check('softsign', lambda x: x / (1 + np.abs(x)))
+
+    def test_leaky_relu_elu(self):
+        self._check('leaky_relu', lambda x: np.where(x > 0, x, 0.02 * x),
+                    grad=False, attrs={'alpha': 0.02})
+        self._check('elu',
+                    lambda x: np.where(x > 0, x, 1.0 * (np.exp(x) - 1)),
+                    grad=False, attrs={'alpha': 1.0})
+
+    def test_pow_scale(self):
+        self._check('pow', lambda x: np.power(x, 2.0), lo=0.2, hi=1.5,
+                    attrs={'factor': 2.0})
+        self._check('scale', lambda x: 3.0 * x + 0.0,
+                    attrs={'scale': 3.0, 'bias': 0.0,
+                           'bias_after_scale': True})
+
+
+class TestSoftmaxAndLosses:
+    def test_softmax(self):
+        x = RNG.uniform(-2, 2, (4, 7)).astype('float32')
+        e = np.exp(x - x.max(-1, keepdims=True))
+        t = _t('softmax', {'X': x}, {'Out': e / e.sum(-1, keepdims=True)})
+        t.check_output()
+        t.check_grad(['X'])
+
+    def test_softmax_with_cross_entropy(self):
+        logits = RNG.uniform(-2, 2, (5, 7)).astype('float32')
+        label = RNG.randint(0, 7, (5, 1)).astype('int64')
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        softmax = e / e.sum(-1, keepdims=True)
+        loss = -np.log(softmax[np.arange(5), label.ravel()])[:, None]
+        t = _t('softmax_with_cross_entropy',
+               {'Logits': logits, 'Label': label},
+               {'Softmax': softmax, 'Loss': loss.astype('float32')})
+        t.check_output()
+        t.check_grad(['Logits'], output_names=['Loss'])
+
+    def test_cross_entropy(self):
+        probs = RNG.uniform(0.05, 1, (4, 6)).astype('float32')
+        probs /= probs.sum(-1, keepdims=True)
+        label = RNG.randint(0, 6, (4, 1)).astype('int64')
+        loss = -np.log(probs[np.arange(4), label.ravel()])[:, None]
+        t = _t('cross_entropy', {'X': probs, 'Label': label},
+               {'Y': loss.astype('float32')})
+        t.check_output()
+        t.check_grad(['X'], output_names=['Y'], max_relative_error=2e-2)
+
+    def test_sigmoid_ce_logits(self):
+        x = RNG.uniform(-2, 2, (4, 5)).astype('float32')
+        lbl = RNG.randint(0, 2, (4, 5)).astype('float32')
+        ref = np.maximum(x, 0) - x * lbl + np.log1p(np.exp(-np.abs(x)))
+        t = _t('sigmoid_cross_entropy_with_logits',
+               {'X': x, 'Label': lbl}, {'Out': ref})
+        t.check_output()
+        t.check_grad(['X'])
+
+    def test_huber_loss(self):
+        x = RNG.uniform(-1, 1, (5, 1)).astype('float32')
+        y = RNG.uniform(-1, 1, (5, 1)).astype('float32')
+        d = 0.5
+        r = y - x
+        loss = np.where(np.abs(r) <= d, 0.5 * r * r, d * (np.abs(r) - 0.5 * d))
+        t = _t('huber_loss', {'X': x, 'Y': y},
+               {'Out': loss.astype('float32'), 'Residual': r},
+               {'delta': d})
+        t.check_output()
+
+    def test_squared_l2_norm_and_distance(self):
+        x = RNG.uniform(-1, 1, (3, 4)).astype('float32')
+        _t('squared_l2_norm', {'X': x},
+           {'Out': np.asarray((x * x).sum())}).check_output()
+
+
+class TestClipCast:
+    def test_clip(self):
+        x = RNG.uniform(-2, 2, (3, 4)).astype('float32')
+        t = _t('clip', {'X': x}, {'Out': np.clip(x, -0.5, 0.5)},
+               {'min': -0.5, 'max': 0.5})
+        t.check_output()
+
+    def test_clip_by_norm(self):
+        x = RNG.uniform(-2, 2, (3, 4)).astype('float32')
+        norm = np.sqrt((x * x).sum())
+        ref = x * (1.0 / max(norm, 1.0)) if norm > 1.0 else x
+        _t('clip_by_norm', {'X': x}, {'Out': ref.astype('float32')},
+           {'max_norm': 1.0}).check_output()
+
+    def test_cast(self):
+        x = RNG.uniform(-2, 2, (3, 4)).astype('float32')
+        _t('cast', {'X': x}, {'Out': x.astype('int32')},
+           {'in_dtype': 5, 'out_dtype': 2}).check_output()

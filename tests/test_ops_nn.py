@@ -1,0 +1,273 @@
+"""Per-op tests: conv/pool/norm/embedding/tensor-manipulation/optimizer ops
+(reference pattern: test_conv2d_op.py, test_batch_norm_op.py, test_sgd_op.py)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+RNG = np.random.RandomState(11)
+
+
+def _t(op_type, inputs, outputs, attrs=None):
+    t = OpTest()
+    t.op_type = op_type
+    t.inputs = inputs
+    t.attrs = attrs or {}
+    t.outputs = outputs
+    return t
+
+
+def _conv2d_ref(x, w, stride, pad):
+    n, c, h, wd = x.shape
+    oc, ic, kh, kw = w.shape
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    out = np.zeros((n, oc, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh,
+                       j * stride:j * stride + kw]
+            out[:, :, i, j] = np.tensordot(patch, w, axes=([1, 2, 3],
+                                                           [1, 2, 3]))
+    return out
+
+
+class TestConvPool:
+    def test_conv2d(self):
+        x = RNG.uniform(-1, 1, (2, 3, 7, 7)).astype('float32')
+        w = RNG.uniform(-1, 1, (4, 3, 3, 3)).astype('float32')
+        ref = _conv2d_ref(x, w, 2, 1)
+        t = _t('conv2d', {'Input': x, 'Filter': w}, {'Output': ref},
+               {'strides': [2, 2], 'paddings': [1, 1], 'groups': 1,
+                'dilations': [1, 1]})
+        t.check_output(atol=1e-4)
+        t.check_grad(['Input', 'Filter'], max_relative_error=2e-2)
+
+    def test_depthwise_conv2d(self):
+        x = RNG.uniform(-1, 1, (2, 3, 6, 6)).astype('float32')
+        w = RNG.uniform(-1, 1, (3, 1, 3, 3)).astype('float32')
+        # groups == channels: per-channel conv
+        out = np.zeros((2, 3, 4, 4), np.float32)
+        for c in range(3):
+            out[:, c:c + 1] = _conv2d_ref(x[:, c:c + 1], w[c:c + 1], 1, 0)
+        _t('depthwise_conv2d', {'Input': x, 'Filter': w}, {'Output': out},
+           {'strides': [1, 1], 'paddings': [0, 0], 'groups': 3,
+            'dilations': [1, 1]}).check_output(atol=1e-4)
+
+    def test_pool2d_max(self):
+        x = RNG.uniform(-1, 1, (2, 3, 6, 6)).astype('float32')
+        ref = x.reshape(2, 3, 3, 2, 3, 2).max(axis=(3, 5))
+        t = _t('pool2d', {'X': x}, {'Out': ref},
+               {'pooling_type': 'max', 'ksize': [2, 2], 'strides': [2, 2],
+                'paddings': [0, 0], 'global_pooling': False})
+        t.check_output()
+        # no FD grad check: max-pool is non-differentiable at argmax ties
+
+    def test_pool2d_avg_global(self):
+        x = RNG.uniform(-1, 1, (2, 3, 5, 5)).astype('float32')
+        ref = x.mean(axis=(2, 3), keepdims=True)
+        t = _t('pool2d', {'X': x}, {'Out': ref},
+               {'pooling_type': 'avg', 'ksize': [1, 1], 'strides': [1, 1],
+                'paddings': [0, 0], 'global_pooling': True})
+        t.check_output()
+        t.check_grad(['X'], max_relative_error=2e-2)
+
+
+class TestNorms:
+    def test_batch_norm_inference(self):
+        x = RNG.uniform(-1, 1, (4, 3, 2, 2)).astype('float32')
+        scale = RNG.uniform(0.5, 1.5, (3, )).astype('float32')
+        bias = RNG.uniform(-0.5, 0.5, (3, )).astype('float32')
+        mean = RNG.uniform(-0.2, 0.2, (3, )).astype('float32')
+        var = RNG.uniform(0.5, 1.5, (3, )).astype('float32')
+        eps = 1e-5
+        ref = (x - mean.reshape(1, 3, 1, 1)) / np.sqrt(
+            var.reshape(1, 3, 1, 1) + eps)
+        ref = ref * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+        t = _t('batch_norm',
+               {'X': x, 'Scale': scale, 'Bias': bias, 'Mean': mean,
+                'Variance': var},
+               {'Y': ref},
+               {'is_test': True, 'epsilon': eps, 'momentum': 0.9,
+                'data_layout': 'NCHW'})
+        t.check_output(atol=1e-4)
+
+    def test_layer_norm(self):
+        x = RNG.uniform(-1, 1, (4, 6)).astype('float32')
+        scale = RNG.uniform(0.5, 1.5, (6, )).astype('float32')
+        bias = RNG.uniform(-0.5, 0.5, (6, )).astype('float32')
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        ref = (x - mu) / np.sqrt(var + 1e-5) * scale + bias
+        t = _t('layer_norm', {'X': x, 'Scale': scale, 'Bias': bias},
+               {'Y': ref, 'Mean': mu.ravel(), 'Variance': var.ravel()},
+               {'epsilon': 1e-5, 'begin_norm_axis': 1})
+        t.check_output(atol=1e-4)
+        t.check_grad(['X', 'Scale', 'Bias'], output_names=['Y'],
+                     max_relative_error=3e-2)
+
+    def test_lrn(self):
+        x = RNG.uniform(0.1, 1, (2, 6, 3, 3)).astype('float32')
+        n, k, alpha, beta = 5, 2.0, 1e-4, 0.75
+        sq = np.zeros_like(x)
+        half = n // 2
+        for c in range(6):
+            lo, hi = max(0, c - half), min(6, c + half + 1)
+            sq[:, c] = (x[:, lo:hi]**2).sum(axis=1)
+        ref = x / (k + alpha * sq)**beta
+        _t('lrn', {'X': x}, {'Out': ref, 'MidOut': k + alpha * sq},
+           {'n': n, 'k': k, 'alpha': alpha, 'beta': beta}) \
+            .check_output(atol=1e-4)
+
+
+class TestEmbedding:
+    def test_lookup_table(self):
+        table = RNG.uniform(-1, 1, (10, 4)).astype('float32')
+        ids = RNG.randint(0, 10, (5, 1)).astype('int64')
+        ref = table[ids.ravel()]
+        t = _t('lookup_table', {'W': table, 'Ids': ids},
+               {'Out': ref.reshape(5, 4)}, {'is_sparse': False,
+                                            'padding_idx': -1})
+        t.check_output()
+        t.check_grad(['W'], max_relative_error=2e-2)
+
+    def test_one_hot(self):
+        ids = np.array([[1], [3], [0]]).astype('int64')
+        ref = np.zeros((3, 5), np.float32)
+        ref[np.arange(3), ids.ravel()] = 1
+        _t('one_hot', {'X': ids}, {'Out': ref},
+           {'depth': 5}).check_output()
+
+
+class TestTensorManip:
+    def test_concat_split(self):
+        a = RNG.uniform(-1, 1, (2, 3)).astype('float32')
+        b = RNG.uniform(-1, 1, (2, 5)).astype('float32')
+        _t('concat', {'X': [('a', a), ('b', b)]},
+           {'Out': np.concatenate([a, b], axis=1)},
+           {'axis': 1}).check_output()
+        x = RNG.uniform(-1, 1, (2, 6)).astype('float32')
+        _t('split', {'X': x},
+           {'Out': [('o0', x[:, :3]), ('o1', x[:, 3:])]},
+           {'axis': 1, 'num': 2, 'sections': []}).check_output()
+
+    def test_reshape_transpose(self):
+        x = RNG.uniform(-1, 1, (2, 6)).astype('float32')
+        _t('reshape2', {'X': x}, {'Out': x.reshape(3, 4),
+                                  'XShape': np.zeros((0, ), 'float32')},
+           {'shape': [3, 4]}).check_output(no_check_set={'XShape'})
+        _t('transpose2', {'X': x}, {'Out': x.T,
+                                    'XShape': np.zeros((0, ), 'float32')},
+           {'axis': [1, 0]}).check_output(no_check_set={'XShape'})
+
+    def test_slice_gather_scatter(self):
+        x = RNG.uniform(-1, 1, (4, 5)).astype('float32')
+        _t('slice', {'Input': x}, {'Out': x[1:3, :]},
+           {'axes': [0], 'starts': [1], 'ends': [3]}).check_output()
+        idx = np.array([0, 2]).astype('int32')
+        _t('gather', {'X': x, 'Index': idx}, {'Out': x[[0, 2]]}) \
+            .check_output()
+        upd = RNG.uniform(-1, 1, (2, 5)).astype('float32')
+        ref = x.copy()
+        ref[[0, 2]] = upd
+        _t('scatter', {'X': x, 'Ids': idx, 'Updates': upd},
+           {'Out': ref}).check_output()
+
+    def test_pad_expand_stack(self):
+        x = RNG.uniform(-1, 1, (2, 3)).astype('float32')
+        _t('pad', {'X': x}, {'Out': np.pad(x, ((1, 0), (0, 2)))},
+           {'paddings': [1, 0, 0, 2], 'pad_value': 0.0}).check_output()
+        _t('expand', {'X': x}, {'Out': np.tile(x, (2, 1))},
+           {'expand_times': [2, 1]}).check_output()
+        y = RNG.uniform(-1, 1, (2, 3)).astype('float32')
+        _t('stack', {'X': [('a', x), ('b', y)]},
+           {'Y': np.stack([x, y], axis=0)}, {'axis': 0}).check_output()
+
+    def test_squeeze_topk_argsort(self):
+        x = RNG.uniform(-1, 1, (3, 1, 4)).astype('float32')
+        _t('squeeze', {'X': x}, {'Out': x.squeeze(1)},
+           {'axes': [1]}).check_output()
+        z = RNG.uniform(-1, 1, (3, 6)).astype('float32')
+        k = 2
+        idx = np.argsort(-z, axis=1)[:, :k]
+        vals = np.take_along_axis(z, idx, axis=1)
+        _t('top_k', {'X': z}, {'Out': vals, 'Indices': idx.astype('int64')},
+           {'k': k}).check_output()
+        si = np.argsort(z, axis=1)
+        _t('argsort', {'X': z},
+           {'Out': np.sort(z, axis=1), 'Indices': si.astype('int64')},
+           {'axis': 1}).check_output()
+
+    def test_fill_constant_assign(self):
+        ref = np.full((2, 3), 3.5, 'float32')
+        _t('fill_constant', {}, {'Out': ref},
+           {'shape': [2, 3], 'value': 3.5, 'dtype': 5}).check_output()
+        x = RNG.uniform(-1, 1, (2, 3)).astype('float32')
+        _t('assign', {'X': x}, {'Out': x}).check_output()
+
+
+class TestOptimizerOps:
+    def test_sgd(self):
+        p = RNG.uniform(-1, 1, (3, 4)).astype('float32')
+        g = RNG.uniform(-1, 1, (3, 4)).astype('float32')
+        lr = np.array([0.1], 'float32')
+        _t('sgd', {'Param': p, 'Grad': g, 'LearningRate': lr},
+           {'ParamOut': p - 0.1 * g}).check_output()
+
+    def test_momentum(self):
+        p = RNG.uniform(-1, 1, (3, 4)).astype('float32')
+        g = RNG.uniform(-1, 1, (3, 4)).astype('float32')
+        v = RNG.uniform(-1, 1, (3, 4)).astype('float32')
+        lr = np.array([0.1], 'float32')
+        mu = 0.9
+        v_new = mu * v + g
+        p_new = p - 0.1 * v_new
+        _t('momentum',
+           {'Param': p, 'Grad': g, 'Velocity': v, 'LearningRate': lr},
+           {'ParamOut': p_new, 'VelocityOut': v_new},
+           {'mu': mu, 'use_nesterov': False}).check_output()
+
+    def test_adam(self):
+        p = RNG.uniform(-1, 1, (3, )).astype('float32')
+        g = RNG.uniform(-1, 1, (3, )).astype('float32')
+        m = RNG.uniform(-0.5, 0.5, (3, )).astype('float32')
+        v = RNG.uniform(0.1, 0.5, (3, )).astype('float32')
+        lr = np.array([0.01], 'float32')
+        b1p = np.array([0.9], 'float32')
+        b2p = np.array([0.999], 'float32')
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        m_new = b1 * m + (1 - b1) * g
+        v_new = b2 * v + (1 - b2) * g * g
+        lr_t = 0.01 * np.sqrt(1 - b2p) / (1 - b1p)
+        p_new = p - lr_t * m_new / (np.sqrt(v_new) + eps)
+        _t('adam',
+           {'Param': p, 'Grad': g, 'Moment1': m, 'Moment2': v,
+            'LearningRate': lr, 'Beta1Pow': b1p, 'Beta2Pow': b2p},
+           {'ParamOut': p_new.astype('float32'), 'Moment1Out': m_new,
+            'Moment2Out': v_new},
+           {'beta1': b1, 'beta2': b2, 'epsilon': eps}).check_output(
+               atol=1e-5)
+
+
+class TestMetrics:
+    def test_accuracy(self):
+        pred = RNG.uniform(0, 1, (6, 5)).astype('float32')
+        label = RNG.randint(0, 5, (6, 1)).astype('int64')
+        correct = (pred.argmax(-1) == label.ravel()).sum()
+        top1 = pred.argmax(-1)[:, None].astype('int64')
+        t = _t('accuracy', {'Out': np.take_along_axis(pred, top1, axis=1),
+                            'Label': label, 'Indices': top1},
+               {'Accuracy': np.asarray([correct / 6.0], 'float32'),
+                'Correct': np.asarray([correct], 'int32'),
+                'Total': np.asarray([6], 'int32')})
+        t.check_output()
+
+    def test_dropout_is_test(self):
+        x = RNG.uniform(-1, 1, (3, 4)).astype('float32')
+        # reference "downgrade_in_infer": scale by (1-p) at inference
+        _t('dropout', {'X': x},
+           {'Out': x * np.float32(0.7), 'Mask': np.ones_like(x)},
+           {'dropout_prob': 0.3, 'is_test': True}).check_output(
+               no_check_set={'Mask'})
