@@ -36,7 +36,6 @@ struct Queue {
   size_t capacity;
   int waiters = 0;
   bool closed = false;
-  Buf front_hold;  // keeps popped bytes alive for the caller
 };
 
 // RAII waiter count so bq_destroy can wait for blocked threads to leave
@@ -97,21 +96,29 @@ int bq_push(void* handle, const char* data, uint64_t len) {
   return 0;
 }
 
-// Returns length (>0), 0 when closed+drained.  *data valid until next pop.
-int64_t bq_pop(void* handle, const char** data) {
+// Copies the front item into out (caller-owned, cap bytes) under the lock,
+// so the returned bytes stay valid regardless of concurrent push/destroy.
+//   ret >= 0 : popped, ret = payload length (0 = empty payload)
+//   ret == -1: closed and drained
+//   ret <= -2: out too small; item needs -(ret+2) bytes and was NOT popped
+int64_t bq_pop(void* handle, char* out, uint64_t cap) {
   auto* q = static_cast<Queue*>(handle);
   std::unique_lock<std::mutex> lock(q->mu);
   {
     WaiterGuard guard(q);
     q->not_empty.wait(lock, [q] { return q->closed || !q->items.empty(); });
   }
-  if (q->items.empty()) return 0;  // closed and drained
-  release(&q->front_hold);
-  q->front_hold = q->items.front();
+  if (q->items.empty()) return -1;  // closed and drained
+  Buf& front = q->items.front();
+  if (front.len > cap) return -static_cast<int64_t>(front.len) - 2;
+  Buf b = front;
   q->items.pop_front();
   q->not_full.notify_one();
-  *data = q->front_hold.ptr;
-  return static_cast<int64_t>(q->front_hold.len);
+  lock.unlock();
+  const int64_t len = static_cast<int64_t>(b.len);
+  std::memcpy(out, b.ptr, b.len);
+  release(&b);
+  return len;
 }
 
 uint64_t bq_size(void* handle) {
@@ -147,7 +154,6 @@ void bq_destroy(void* handle) {
     // wait loop, otherwise `delete q` frees a mutex they still hold
     q->drained.wait(lock, [q] { return q->waiters == 0; });
     drain(q);
-    release(&q->front_hold);
   }
   delete q;
 }

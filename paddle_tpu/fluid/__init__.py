@@ -13,7 +13,7 @@ from .framework import (Program, Operator, Variable, Parameter,
                         default_main_program, default_startup_program,
                         program_guard, name_scope)
 from . import executor
-from .executor import Executor, global_scope, scope_guard
+from .executor import Executor, global_scope, scope_guard, fetch_var
 from . import parallel_executor
 from .parallel_executor import ParallelExecutor, ExecutionStrategy, \
     BuildStrategy

@@ -26,10 +26,11 @@ from . import core
 from .framework import default_main_program, Variable
 from ..ops import registry
 
-__all__ = ['Executor', 'global_scope', 'scope_guard', '_switch_scope']
+__all__ = ['Executor', 'global_scope', 'scope_guard', '_switch_scope',
+           'fetch_var']
 
 
-def _fetch_var(name, scope=None, return_numpy=True):
+def fetch_var(name, scope=None, return_numpy=True):
     """Fetch a (typically persistable) variable's value straight from a
     scope without running a program (reference executor.py:174)."""
     assert isinstance(name, str)
@@ -128,6 +129,37 @@ def prepare_feed_arrays(feed):
         else:
             feed_arrays[name] = np.asarray(value)
     return feed_arrays
+
+
+def validate_feed(program, feed_arrays):
+    """Fail fast with the var name and dims when a feed does not match its
+    data-layer declaration (the analog of the reference DataFeeder checks,
+    data_feeder.py:29)."""
+    block = program.block(0)
+    for name, value in feed_arrays.items():
+        if name.endswith(registry.SEQLEN_SUFFIX):
+            continue
+        var = block.vars.get(name)
+        if var is None or not getattr(var, 'shape', None):
+            continue
+        shape = tuple(var.shape)
+        got = tuple(np.shape(as_numpy(value)))
+        lod = getattr(var, 'lod_level', 0) or 0
+        ranks = (len(shape), ) if not lod else (len(shape) + 1, len(shape))
+        if len(got) not in ranks:
+            raise ValueError(
+                'feed %r: expected rank %s (declared shape %s%s), got '
+                'shape %s' % (name, ranks[0], shape,
+                              ', lod_level=%d' % lod if lod else '', got))
+        # declared dims must match aligned from the right (leading
+        # batch/time dims are free; -1 dims are wildcards)
+        for want, have in zip(reversed(shape), reversed(got)):
+            if want is not None and want > 0 and want != have:
+                raise ValueError(
+                    'feed %r: dim mismatch, declared shape %s%s but got '
+                    'shape %s' % (name, shape,
+                                  ' (lod_level=%d)' % lod if lod else '',
+                                  got))
 
 
 def feed_signature(feed_arrays):
@@ -355,6 +387,7 @@ class Executor(object):
         feed = dict(feed)
         _pop_readers_into_feed(program, feed)
         feed_arrays = prepare_feed_arrays(feed)
+        validate_feed(program, feed_arrays)
         sig = feed_signature(feed_arrays)
         key = (id(program), program._version, tuple(fetch_names), sig,
                self.place, id(scope))

@@ -287,8 +287,10 @@ class StaticRNN(object):
         parent_block = main_program.block(self.parent_idx)
         out_vars = []
         for name in self.outputs:
+            step_var = self.sub_block._find_var_recursive(name)
             ov = parent_block.create_var(
-                name=name + '@rnn_out', dtype='float32')
+                name=name + '@rnn_out',
+                dtype=step_var.dtype if step_var is not None else 'float32')
             out_vars.append(ov)
         self._out_vars = out_vars
         exclude = [i for _, i in self.inputs] + list(self.memories.keys())

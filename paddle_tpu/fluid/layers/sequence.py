@@ -15,7 +15,8 @@ __all__ = [
     'sequence_pool', 'sequence_softmax', 'sequence_first_step',
     'sequence_last_step', 'sequence_expand', 'sequence_concat',
     'sequence_reshape', 'sequence_enumerate', 'sequence_erase',
-    'sequence_slice', 'row_conv', 'sequence_pad',
+    'sequence_slice', 'row_conv', 'sequence_pad', 'sequence_mask',
+    'beam_search', 'beam_search_decode', 'beam_expand', 'beam_init_scores',
 ]
 
 
@@ -336,3 +337,98 @@ def row_conv(input, future_context_size, param_attr=None, act=None):
                 'Filter': [filter_param]},
         outputs={'Out': [out]})
     return helper.append_activation(out)
+
+
+def sequence_mask(x, maxlen=None, dtype='int64', name=None):
+    """lengths tensor [B] -> 0/1 mask [B, maxlen] (reference
+    layers sequence_mask / math/sequence_padding.h)."""
+    helper = LayerHelper('sequence_mask', **locals())
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type='sequence_mask',
+        inputs={'X': [x]},
+        outputs={'Out': [out]},
+        attrs={'maxlen': maxlen if maxlen is not None else -1,
+               'out_dtype': dtype})
+    return out
+
+
+def beam_expand(x, beam_size):
+    """Tile per-sentence rows to per-beam rows [B,...] -> [B*K,...]
+    (dense analog of the reference decoder's LoD beam expansion)."""
+    helper = LayerHelper('beam_expand', **locals())
+    out = helper.create_variable_for_type_inference(
+        helper.input_dtype('x'))
+    out.shape = tuple(x.shape)
+    out.lod_level = x.lod_level
+    helper.append_op(
+        type='beam_expand',
+        inputs={'X': [x]},
+        outputs={'Out': [out]},
+        attrs={'beam_size': beam_size})
+    return out
+
+
+def beam_init_scores(ref, beam_size):
+    """Initial accumulated scores [B*K, 1]: 0 for beam 0, -1e9 others."""
+    helper = LayerHelper('beam_init_scores', **locals())
+    out = helper.create_variable_for_type_inference('float32')
+    out.shape = (-1, 1)
+    helper.append_op(
+        type='beam_init_scores',
+        inputs={'X': [ref]},
+        outputs={'Out': [out]},
+        attrs={'beam_size': beam_size})
+    return out
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, name=None):
+    """One beam-search step (reference layers beam_search,
+    operators/beam_search_op.cc) on the static [B*K] beam layout.
+    Returns (selected_ids, selected_scores, parent_idx)."""
+    if level != 0:
+        raise NotImplementedError(
+            'beam_search level != 0: nested-LoD candidate levels are '
+            'subsumed by the static [B*K] beam layout')
+    helper = LayerHelper('beam_search', **locals())
+    selected_ids = helper.create_variable_for_type_inference('int64')
+    selected_scores = helper.create_variable_for_type_inference('float32')
+    parent_idx = helper.create_variable_for_type_inference('int32')
+    helper.append_op(
+        type='beam_search',
+        inputs={
+            'pre_ids': [pre_ids],
+            'pre_scores': [pre_scores],
+            'ids': [ids],
+            'scores': [scores],
+        },
+        outputs={
+            'selected_ids': [selected_ids],
+            'selected_scores': [selected_scores],
+            'parent_idx': [parent_idx],
+        },
+        attrs={'beam_size': beam_size,
+               'end_id': end_id,
+               'level': level})
+    return selected_ids, selected_scores, parent_idx
+
+
+def beam_search_decode(ids, scores, parent_idx, beam_size, end_id,
+                       name=None):
+    """Backtrack stacked per-step beams into sentences (reference layers
+    beam_search_decode, operators/beam_search_decode_op.cc).
+    Returns (sentence_ids [B,K,T], sentence_scores [B,K])."""
+    helper = LayerHelper('beam_search_decode', **locals())
+    sentence_ids = helper.create_variable_for_type_inference('int64')
+    sentence_scores = helper.create_variable_for_type_inference('float32')
+    helper.append_op(
+        type='beam_search_decode',
+        inputs={'Ids': [ids],
+                'Scores': [scores],
+                'ParentIdx': [parent_idx]},
+        outputs={'SentenceIds': [sentence_ids],
+                 'SentenceScores': [sentence_scores]},
+        attrs={'beam_size': beam_size,
+               'end_id': end_id})
+    return sentence_ids, sentence_scores

@@ -11,7 +11,7 @@ are batched matmuls on the MXU.
 
 import paddle_tpu.fluid as fluid
 
-__all__ = ['build']
+__all__ = ['build', 'build_decode']
 
 
 def encoder(src_word_id, src_dict_dim, embedding_dim, encoder_size):
@@ -113,3 +113,87 @@ def build(src_dict_dim=1000,
                'target_language_next_word'],
         prediction=prediction,
         loss=avg_cost)
+
+
+def build_decode(src_dict_dim=1000,
+                 trg_dict_dim=1000,
+                 embedding_dim=64,
+                 encoder_size=64,
+                 decoder_size=64,
+                 beam_size=4,
+                 max_length=16,
+                 start_id=0,
+                 end_id=1):
+    """Beam-search inference program (reference:
+    tests/book/test_machine_translation.py decode()).
+
+    The reference drives a while-op whose beams grow through nested LoD;
+    here the beam dim is static [B*K] and the loop is a StaticRNN (one
+    lax.scan of max_length steps) carrying (ids, scores, hidden) with the
+    beam_search op doing per-step selection and beam_search_decode
+    backtracking parent pointers at the end.
+    """
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = fluid.layers.data(
+            name='src_word_id', shape=[1], dtype='int64', lod_level=1)
+        encoder_out = encoder(src, src_dict_dim, embedding_dim,
+                              encoder_size)
+        encoder_proj = fluid.layers.fc(
+            input=encoder_out, size=decoder_size, bias_attr=False)
+        encoder_last = fluid.layers.sequence_last_step(input=encoder_out)
+        decoder_boot = fluid.layers.fc(
+            input=encoder_last, size=decoder_size, act='tanh')
+
+        # tile per-sentence state to per-beam rows [B*K, ...]
+        vec = fluid.layers.beam_expand(encoder_out, beam_size)
+        proj = fluid.layers.beam_expand(encoder_proj, beam_size)
+        boot = fluid.layers.beam_expand(decoder_boot, beam_size)
+        init_ids = fluid.layers.fill_constant_batch_size_like(
+            input=boot, shape=[-1, 1], value=float(start_id), dtype='int64')
+        init_scores = fluid.layers.beam_init_scores(decoder_boot, beam_size)
+        # dummy step input just drives the scan for max_length steps
+        ticker = fluid.layers.fill_constant_batch_size_like(
+            input=boot, shape=[max_length, -1, 1], value=0.0,
+            dtype='float32', input_dim_idx=0, output_dim_idx=1)
+
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            rnn.step_input(ticker)
+            pre_ids = rnn.memory(init=init_ids)
+            pre_scores = rnn.memory(init=init_scores)
+            hidden_mem = rnn.memory(init=boot)
+            context = simple_attention(vec, proj, hidden_mem, decoder_size)
+            pre_word = fluid.layers.embedding(
+                input=pre_ids, size=[trg_dict_dim, embedding_dim])
+            decoder_inputs = fluid.layers.fc(
+                input=[context, pre_word],
+                size=decoder_size * 3,
+                bias_attr=False)
+            h, _, _ = fluid.layers.gru_unit(
+                input=decoder_inputs, hidden=hidden_mem,
+                size=decoder_size * 3)
+            prob = fluid.layers.fc(
+                input=h, size=trg_dict_dim, act='softmax')
+            topk_scores, topk_indices = fluid.layers.topk(prob, beam_size)
+            accu_scores = fluid.layers.elementwise_add(
+                fluid.layers.log(topk_scores), pre_scores)
+            sel_ids, sel_scores, parent_idx = fluid.layers.beam_search(
+                pre_ids, pre_scores, topk_indices, accu_scores,
+                beam_size, end_id)
+            new_h = fluid.layers.gather(h, parent_idx)
+            rnn.update_memory(pre_ids, sel_ids)
+            rnn.update_memory(pre_scores, sel_scores)
+            rnn.update_memory(hidden_mem, new_h)
+            rnn.output(sel_ids, sel_scores, parent_idx)
+
+        ids_arr, scores_arr, parents_arr = rnn()
+        sent_ids, sent_scores = fluid.layers.beam_search_decode(
+            ids_arr, scores_arr, parents_arr, beam_size, end_id)
+    return dict(
+        main=main,
+        startup=startup,
+        feeds=['src_word_id'],
+        sentence_ids=sent_ids,
+        sentence_scores=sent_scores)

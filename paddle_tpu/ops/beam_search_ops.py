@@ -1,0 +1,135 @@
+"""Beam-search decoding ops, TPU-native.
+
+The reference implements beam search over nested LoD tensors whose beam
+dimension *grows* per step (operators/beam_search_op.cc selects items per
+source sentence from candidate LoD level 0, beam_search_decode_op.cc walks
+the sentence->candidate LoD levels to backtrack).  LoD growth is dynamic
+shape — poison for XLA — so here the beam dimension is STATIC: every beam
+tensor has leading dim ``B*K`` (batch x beam, row-major by sentence) and
+dead beams are represented by masked -1e9 scores instead of absent rows.
+Backtracking is one reverse ``lax.scan`` over explicit parent pointers
+(the dense analog of the reference's LoD parent encoding).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_lowering, SEQLEN_SUFFIX
+
+NEG_INF = -1e9
+
+
+@register_lowering('beam_expand')
+def _beam_expand(ctx, op):
+    """Tile a per-sentence tensor to per-beam rows: [B, ...] -> [B*K, ...]
+    (dense analog of the LoD expansion the reference decoder does with
+    sequence_expand over the beam LoD level)."""
+    x = ctx.get(op, 'X')
+    k = int(op.attrs['beam_size'])
+    out = jnp.repeat(x, k, axis=0)
+    name = op.output('Out')[0]
+    ctx.store(name, out)
+    xname = op.input('X')[0]
+    seq = ctx.env.get(xname + SEQLEN_SUFFIX)
+    if seq is not None:
+        ctx.env[name + SEQLEN_SUFFIX] = jnp.repeat(seq, k, axis=0)
+
+
+@register_lowering('beam_init_scores')
+def _beam_init_scores(ctx, op):
+    """Initial accumulated log-probs: 0 for beam 0 of each sentence, -1e9
+    for the rest, so step 1 top-k picks K *distinct* continuations of the
+    single start token (the job LoD growth does in the reference: it
+    starts with one beam per sentence and only widens after step 1)."""
+    x = ctx.get(op, 'X')  # [B, ...]: batch-size reference
+    k = int(op.attrs['beam_size'])
+    b = x.shape[0]
+    row = jnp.full((k, ), NEG_INF, jnp.float32).at[0].set(0.0)
+    ctx.set(op, 'Out', jnp.tile(row, (b, ))[:, None])
+
+
+@register_lowering('beam_search')
+def _beam_search(ctx, op):
+    """One beam-search selection step (reference beam_search_op.cc).
+
+    Inputs (all leading dim B*K, sentence-major):
+      pre_ids    [B*K, 1] int   previous chosen token per beam
+      pre_scores [B*K, 1] float accumulated log-prob per beam
+      ids        [B*K, C] int   candidate token ids (top-C of the softmax)
+      scores     [B*K, C] float accumulated log-prob of each candidate
+    Outputs:
+      selected_ids    [B*K, 1], selected_scores [B*K, 1]
+      parent_idx      [B*K] int32 global row index of each survivor's parent
+    A finished beam (pre_id == end_id) contributes exactly one candidate —
+    itself, score unchanged — mirroring the reference's handling where
+    finished hypotheses are carried through.
+    """
+    pre_ids = ctx.get(op, 'pre_ids')
+    pre_scores = ctx.get(op, 'pre_scores')
+    ids = ctx.get(op, 'ids')
+    scores = ctx.get(op, 'scores')
+    k = int(op.attrs['beam_size'])
+    end_id = int(op.attrs['end_id'])
+
+    bk, c = scores.shape
+    b = bk // k
+    finished = (pre_ids.reshape(bk) == end_id)  # [B*K]
+
+    # finished beams: candidate 0 = (end_id, pre_score), rest masked out
+    keep0 = jnp.zeros((bk, c), bool).at[:, 0].set(True)
+    cand_scores = jnp.where(finished[:, None],
+                            jnp.where(keep0, pre_scores.reshape(bk, 1),
+                                      NEG_INF), scores)
+    cand_ids = jnp.where(finished[:, None], end_id, ids)
+
+    flat_scores = cand_scores.reshape(b, k * c)
+    top_scores, top_idx = jax.lax.top_k(flat_scores, k)  # [B, K]
+    parent_local = top_idx // c  # beam index within sentence
+    parent_idx = (jnp.arange(b, dtype=jnp.int32)[:, None] * k +
+                  parent_local.astype(jnp.int32))  # global rows
+    sel_ids = jnp.take_along_axis(
+        cand_ids.reshape(b, k * c), top_idx, axis=1)
+
+    ctx.set(op, 'selected_ids', sel_ids.reshape(bk, 1))
+    ctx.set(op, 'selected_scores', top_scores.reshape(bk, 1))
+    ctx.set(op, 'parent_idx', parent_idx.reshape(bk))
+
+
+@register_lowering('beam_search_decode')
+def _beam_search_decode(ctx, op):
+    """Backtrack beams into sentences (reference beam_search_decode_op.cc).
+
+    Inputs: Ids [T, B*K, 1], ParentIdx [T, B*K], Scores [T, B*K, 1] — the
+    stacked per-step outputs of beam_search (a lowered TensorArray).
+    Outputs: SentenceIds [B, K, T] (end_id padded), SentenceScores [B, K].
+    The reference walks two LoD levels; here it is one reverse scan over
+    parent pointers.
+    """
+    ids = ctx.get(op, 'Ids')
+    parents = ctx.get(op, 'ParentIdx')
+    scores = ctx.get(op, 'Scores')
+    if isinstance(ids, list):
+        ids = jnp.stack(ids)
+    if isinstance(parents, list):
+        parents = jnp.stack(parents)
+    if isinstance(scores, list):
+        scores = jnp.stack(scores)
+    k = int(op.attrs['beam_size'])
+    t, bk = ids.shape[0], ids.shape[1]
+    b = bk // k
+    ids2 = ids.reshape(t, bk)
+    parents2 = parents.reshape(t, bk).astype(jnp.int32)
+
+    def back(rows, step):
+        step_ids, step_parents = step
+        tok = step_ids[rows]
+        return step_parents[rows], tok
+
+    rows0 = jnp.arange(bk, dtype=jnp.int32)
+    _, toks_rev = jax.lax.scan(back, rows0, (ids2[::-1], parents2[::-1]))
+    sent = toks_rev[::-1].T.reshape(b, k, t)  # [B, K, T]
+    final_scores = scores.reshape(t, bk)[-1].reshape(b, k)
+    ctx.set(op, 'SentenceIds', sent)
+    ctx.set(op, 'SentenceScores', final_scores)
+
+

@@ -101,9 +101,9 @@ def _recurrent(ctx, op):
                 break
     if lengths is not None:
         step_mask = (jnp.arange(t)[None, :] <
-                     lengths[:, None]).astype(seqs[0].dtype).T  # [T, B]
+                     lengths[:, None]).T  # [T, B] bool
     else:
-        step_mask = jnp.ones((t, b), seqs[0].dtype)
+        step_mask = jnp.ones((t, b), bool)
 
     reads, _ = _block_reads_writes(block)
     closure = {}
@@ -132,12 +132,13 @@ def _recurrent(ctx, op):
             new_val = env[upd] if upd is not None else env[m]
             old_val = carry[m]
             mm = jnp.reshape(m_t, (b, ) + (1, ) * (new_val.ndim - 1))
-            new_carry[m] = mm * new_val + (1 - mm) * old_val
+            # boolean select keeps integer memories (e.g. beam ids) exact
+            new_carry[m] = jnp.where(mm, new_val, old_val)
         outs = []
         for on in out_names:
             o = env[on]
             mm = jnp.reshape(m_t, (b, ) + (1, ) * (o.ndim - 1))
-            outs.append(o * mm)
+            outs.append(jnp.where(mm, o, jnp.zeros_like(o)))
         return new_carry, tuple(outs)
 
     _, collected = jax.lax.scan(step, mem_init, (tuple(xs), step_mask))

@@ -409,3 +409,18 @@ def _row_conv(ctx, op):
     xp = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
     out = sum(xp[:, i:i + t] * w[i][None, None, :] for i in range(k))
     ctx.set(op, 'Out', out)
+
+
+@register_lowering('sequence_mask')
+def _sequence_mask_op(ctx, op):
+    """lengths [B] -> mask [B, maxlen] (reference sequence_mask op /
+    math/sequence_padding.h mask generation)."""
+    lengths = ctx.get(op, 'X').reshape(-1)
+    maxlen = int(op.attrs.get('maxlen', -1))
+    if maxlen <= 0:
+        raise NotImplementedError(
+            'sequence_mask needs a static maxlen attr under XLA '
+            '(dynamic maxlen = data-dependent shape)')
+    dummy = jnp.zeros((lengths.shape[0], maxlen))
+    out_dtype = op.attrs.get('out_dtype', 'int64')
+    ctx.set(op, 'Out', _mask(dummy, lengths, dtype=jnp.dtype(out_dtype)))

@@ -61,8 +61,8 @@ def _load():
         lib.bq_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                 ctypes.c_uint64]
         lib.bq_pop.restype = ctypes.c_int64
-        lib.bq_pop.argtypes = [ctypes.c_void_p,
-                               ctypes.POINTER(ctypes.c_char_p)]
+        lib.bq_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_uint64]
         lib.bq_size.restype = ctypes.c_uint64
         lib.bq_size.argtypes = [ctypes.c_void_p]
         lib.bq_close.argtypes = [ctypes.c_void_p]
@@ -214,6 +214,7 @@ class NativeBlockingQueue(object):
             return
         self._q = None
         self._h = lib.bq_create(capacity)
+        self._pop_buf = ctypes.create_string_buffer(1 << 16)
 
     def push(self, data):
         if self._q is not None:
@@ -239,11 +240,15 @@ class NativeBlockingQueue(object):
                 except _q.Empty:
                     if self._closed:
                         return None
-        buf = ctypes.c_char_p()
-        n = self._lib.bq_pop(self._h, ctypes.byref(buf))
-        if n == 0:
-            return None
-        return ctypes.string_at(buf, n)
+        while True:
+            n = self._lib.bq_pop(self._h, self._pop_buf,
+                                 len(self._pop_buf))
+            if n == -1:
+                return None
+            if n <= -2:  # buffer too small: grow and retry
+                self._pop_buf = ctypes.create_string_buffer(-(n + 2))
+                continue
+            return self._pop_buf.raw[:n]
 
     def size(self):
         if self._q is not None:

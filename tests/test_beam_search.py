@@ -1,0 +1,148 @@
+"""Beam search decoding: per-step selection op vs numpy reference, parent
+backtracking, and the full seq2seq beam decode program (reference parity:
+test_beam_search_op.py, test_beam_search_decode_op.py,
+tests/book/test_machine_translation.py decode path)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.models import seq2seq
+
+
+def _run(prog, feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        return exe.run(prog, feed=feed, fetch_list=fetch)
+
+
+def test_beam_search_step_selects_topk_per_sentence():
+    B, K, C = 2, 2, 3
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        pre_ids = fluid.layers.data(name='pre_ids', shape=[1],
+                                    dtype='int64')
+        pre_scores = fluid.layers.data(name='pre_scores', shape=[1],
+                                       dtype='float32')
+        ids = fluid.layers.data(name='ids', shape=[C], dtype='int64')
+        scores = fluid.layers.data(name='scores', shape=[C],
+                                   dtype='float32')
+        sel_ids, sel_scores, parent = fluid.layers.beam_search(
+            pre_ids, pre_scores, ids, scores, beam_size=K, end_id=0)
+    # sentence 0: beam0 cands (5:-1.0, 6:-2.0, 7:-5.0), beam1 (8:-1.5 ...)
+    pre_ids_v = np.array([[2], [3], [2], [3]], np.int64)
+    pre_scores_v = np.array([[-0.5], [-0.6], [-0.5], [-0.6]], np.float32)
+    ids_v = np.array([[5, 6, 7], [8, 9, 10],
+                      [5, 6, 7], [8, 9, 10]], np.int64)
+    scores_v = np.array([[-1.0, -2.0, -5.0], [-1.5, -3.0, -6.0],
+                         [-4.0, -5.0, -6.0], [-1.2, -1.3, -9.0]],
+                        np.float32)
+    si, ss, p = _run(prog, {
+        'pre_ids': pre_ids_v, 'pre_scores': pre_scores_v,
+        'ids': ids_v, 'scores': scores_v}, [sel_ids, sel_scores, parent])
+    # sentence 0 top-2: (5,-1.0) from beam 0, (8,-1.5) from beam 1
+    np.testing.assert_array_equal(si[:2].flatten(), [5, 8])
+    np.testing.assert_allclose(ss[:2].flatten(), [-1.0, -1.5], rtol=1e-6)
+    np.testing.assert_array_equal(p[:2], [0, 1])
+    # sentence 1 top-2: (8,-1.2),(9,-1.3) both from beam 1 (global row 3)
+    np.testing.assert_array_equal(si[2:].flatten(), [8, 9])
+    np.testing.assert_allclose(ss[2:].flatten(), [-1.2, -1.3], rtol=1e-6)
+    np.testing.assert_array_equal(p[2:], [3, 3])
+
+
+def test_beam_search_finished_beam_carried_through():
+    K, C = 2, 2
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        pre_ids = fluid.layers.data(name='pre_ids', shape=[1],
+                                    dtype='int64')
+        pre_scores = fluid.layers.data(name='pre_scores', shape=[1],
+                                       dtype='float32')
+        ids = fluid.layers.data(name='ids', shape=[C], dtype='int64')
+        scores = fluid.layers.data(name='scores', shape=[C],
+                                   dtype='float32')
+        sel_ids, sel_scores, parent = fluid.layers.beam_search(
+            pre_ids, pre_scores, ids, scores, beam_size=K, end_id=1)
+    # beam 0 already ended (id==1, score -0.1): must survive unchanged
+    pre_ids_v = np.array([[1], [3]], np.int64)
+    pre_scores_v = np.array([[-0.1], [-0.2]], np.float32)
+    ids_v = np.array([[4, 5], [6, 7]], np.int64)
+    scores_v = np.array([[-9.0, -9.5], [-0.5, -0.6]], np.float32)
+    si, ss, p = _run(prog, {
+        'pre_ids': pre_ids_v, 'pre_scores': pre_scores_v,
+        'ids': ids_v, 'scores': scores_v}, [sel_ids, sel_scores, parent])
+    np.testing.assert_array_equal(si.flatten(), [1, 6])
+    np.testing.assert_allclose(ss.flatten(), [-0.1, -0.5], rtol=1e-6)
+    np.testing.assert_array_equal(p.flatten(), [0, 1])
+
+
+def test_beam_search_decode_backtracks_parents():
+    # B=1, K=2, T=3; construct known parent chains:
+    # step0: beams choose tokens [3, 4], parents [0, 0]
+    # step1: tokens [5, 6], parents [0, 1]  (beam1 descends from old beam1)
+    # step2: tokens [7, 8], parents [1, 0]  -> final beam0 path: 4,6,7
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        ids = fluid.layers.data(name='ids', shape=[2, 1], dtype='int64')
+        scores = fluid.layers.data(name='scores', shape=[2, 1],
+                                   dtype='float32')
+        parents = fluid.layers.data(name='parents', shape=[2],
+                                    dtype='int32')
+        # feed as [T, B*K, ...] stacked arrays
+        sent, sscores = fluid.layers.beam_search_decode(
+            ids, scores, parents, beam_size=2, end_id=1)
+    ids_v = np.array([[[3], [4]], [[5], [6]], [[7], [8]]], np.int64)
+    parents_v = np.array([[0, 0], [0, 1], [1, 0]], np.int32)
+    scores_v = np.array([[[-1.], [-2.]], [[-1.5], [-2.5]],
+                         [[-3.], [-4.]]], np.float32)
+    s, sc = _run(prog, {'ids': ids_v, 'scores': scores_v,
+                        'parents': parents_v}, [sent, sscores])
+    assert s.shape == (1, 2, 3)
+    np.testing.assert_array_equal(s[0, 0], [4, 6, 7])
+    np.testing.assert_array_equal(s[0, 1], [3, 5, 8])
+    np.testing.assert_allclose(sc[0], [-3., -4.], rtol=1e-6)
+
+
+def test_sequence_mask():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = fluid.layers.data(name='x', shape=[1], dtype='int64')
+        m = fluid.layers.sequence_mask(x, maxlen=5, dtype='float32')
+    out, = _run(prog, {'x': np.array([[2], [4]], np.int64)}, [m])
+    np.testing.assert_array_equal(
+        out, [[1, 1, 0, 0, 0], [1, 1, 1, 1, 0]])
+
+
+def test_seq2seq_beam_decode_runs():
+    """Full NMT inference program: beams stay sorted, sequences end with
+    end_id once finished."""
+    K, T = 3, 5
+    model = seq2seq.build_decode(
+        src_dict_dim=40, trg_dict_dim=40, embedding_dim=8,
+        encoder_size=8, decoder_size=8, beam_size=K, max_length=T,
+        start_id=0, end_id=1)
+    rows = [[2, 3, 4], [5, 6, 7, 8]]
+    flat = np.concatenate([np.asarray(r, np.int64).reshape(-1, 1)
+                           for r in rows])
+    lt = fluid.core.LoDTensor(flat)
+    lt.set_recursive_sequence_lengths([[len(r) for r in rows]])
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(model['startup'])
+        sent, scores = exe.run(
+            model['main'], feed={'src_word_id': lt},
+            fetch_list=[model['sentence_ids'], model['sentence_scores']])
+    assert sent.shape == (2, K, T)
+    assert scores.shape == (2, K)
+    assert np.all(np.isfinite(scores))
+    # beams are returned best-first per sentence
+    assert np.all(np.diff(scores, axis=1) <= 1e-6)
+    # once a sequence emits end_id it stays end_id
+    for b in range(2):
+        for k in range(K):
+            seq = sent[b, k]
+            ended = False
+            for tok in seq:
+                if ended:
+                    assert tok == 1
+                if tok == 1:
+                    ended = True
