@@ -1,0 +1,64 @@
+"""imikolov-shaped PTB language-model dataset
+(reference: python/paddle/dataset/imikolov.py).
+
+Deterministic synthetic corpus (no network egress): sentences drawn from a
+zipf-ish distribution; the same reader contract — N-gram tuples or
+sequence pairs."""
+
+import numpy as np
+
+__all__ = ['build_dict', 'train', 'test', 'NGram']
+
+_VOCAB = 200
+_SENTENCES = 500
+
+
+def _corpus(seed):
+    rng = np.random.RandomState(seed)
+    sents = []
+    for _ in range(_SENTENCES):
+        n = rng.randint(5, 15)
+        # zipf-flavored draw bounded to vocab
+        words = (rng.zipf(1.3, size=n) % (_VOCAB - 2)) + 2
+        sents.append([int(w) for w in words])
+    return sents
+
+
+def build_dict(min_word_freq=0):
+    """word -> id map; ids 0..N-1.  <s>=0, <e>=1 by convention here."""
+    return {('w%d' % i): i for i in range(_VOCAB)}
+
+
+def _ngram_reader(seed, n):
+    def reader():
+        for sent in _corpus(seed):
+            if len(sent) < n:
+                continue
+            for i in range(n, len(sent) + 1):
+                yield tuple(sent[i - n:i])
+
+    return reader
+
+
+def _seq_reader(seed):
+    def reader():
+        for sent in _corpus(seed):
+            yield sent[:-1], sent[1:]
+
+    return reader
+
+
+def train(word_idx=None, n=5, data_type='NGRAM'):
+    if data_type == 'NGRAM':
+        return _ngram_reader(11, n)
+    return _seq_reader(11)
+
+
+def test(word_idx=None, n=5, data_type='NGRAM'):
+    if data_type == 'NGRAM':
+        return _ngram_reader(13, n)
+    return _seq_reader(13)
+
+
+class NGram(object):
+    pass

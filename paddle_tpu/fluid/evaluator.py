@@ -85,14 +85,52 @@ class Accuracy(Evaluator):
 
 
 class ChunkEvaluator(Evaluator):
-    """Streaming chunk F1 (reference evaluator.py ChunkEvaluator) — state
-    accumulators over chunk_eval op outputs; the op lands with the NLP tail."""
+    """Streaming chunk F1 (reference evaluator.py ChunkEvaluator):
+    accumulates chunk_eval op counts in persistable state and recomputes
+    precision/recall/F1 at eval()."""
 
     def __init__(self, input, label, chunk_scheme, num_chunk_types,
                  excluded_chunk_types=None):
-        raise NotImplementedError(
-            'chunk_eval op lands with the NLP parity tail; use '
-            'fluid.metrics.ChunkEvaluator for host-side accumulation')
+        super(ChunkEvaluator, self).__init__('chunk_eval')
+        main_program = self.helper.main_program
+        if main_program.current_block().idx != 0:
+            raise ValueError('You can only invoke Evaluator in root block')
+
+        self.num_infer_chunks = self._create_state(
+            dtype='int64', shape=[1], suffix='num_infer_chunks')
+        self.num_label_chunks = self._create_state(
+            dtype='int64', shape=[1], suffix='num_label_chunks')
+        self.num_correct_chunks = self._create_state(
+            dtype='int64', shape=[1], suffix='num_correct_chunks')
+        (precision, recall, f1_score, num_infer_chunks, num_label_chunks,
+         num_correct_chunks) = layers.chunk_eval(
+             input=input,
+             label=label,
+             chunk_scheme=chunk_scheme,
+             num_chunk_types=num_chunk_types,
+             excluded_chunk_types=excluded_chunk_types)
+        layers.sums(input=[self.num_infer_chunks, num_infer_chunks],
+                    out=self.num_infer_chunks)
+        layers.sums(input=[self.num_label_chunks, num_label_chunks],
+                    out=self.num_label_chunks)
+        layers.sums(input=[self.num_correct_chunks, num_correct_chunks],
+                    out=self.num_correct_chunks)
+        self.metrics.extend([precision, recall, f1_score])
+
+    def eval(self, executor, eval_program=None):
+        scope = global_scope()
+        num_infer = float(np.asarray(
+            scope.find_var(self.num_infer_chunks.name).value()).flatten()[0])
+        num_label = float(np.asarray(
+            scope.find_var(self.num_label_chunks.name).value()).flatten()[0])
+        num_correct = float(np.asarray(
+            scope.find_var(
+                self.num_correct_chunks.name).value()).flatten()[0])
+        precision = num_correct / num_infer if num_infer else 0.0
+        recall = num_correct / num_label if num_label else 0.0
+        f1 = (2 * precision * recall / (precision + recall)
+              if num_correct else 0.0)
+        return np.array([precision, recall, f1], dtype='float32')
 
 
 def _clone_var(block, var):

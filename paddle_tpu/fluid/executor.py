@@ -143,7 +143,9 @@ def validate_feed(program, feed_arrays):
         if var is None or not getattr(var, 'shape', None):
             continue
         shape = tuple(var.shape)
-        got = tuple(np.shape(as_numpy(value)))
+        got = getattr(value, 'shape', None)  # no device->host copy
+        got = tuple(got) if got is not None else tuple(
+            np.shape(as_numpy(value)))
         lod = getattr(var, 'lod_level', 0) or 0
         ranks = (len(shape), ) if not lod else (len(shape) + 1, len(shape))
         if len(got) not in ranks:

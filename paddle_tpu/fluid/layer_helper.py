@@ -103,6 +103,17 @@ class LayerHelper(object):
             # bias etc.) treat attr=False as a frozen parameter
             attr = ParamAttr(trainable=False)
         attr = copy.deepcopy(attr) if attr is not None else ParamAttr()
+        # explicit shared name: reuse the existing parameter (the reference
+        # shares e.g. word2vec's 'shared_w' / SRL's 'crfw' this way)
+        if attr.name is not None and \
+                self.main_program.global_block().has_var(attr.name):
+            existing = self.main_program.global_block().var(attr.name)
+            if isinstance(existing, Parameter):
+                if tuple(existing.shape) != tuple(shape):
+                    raise ValueError(
+                        'shared parameter %r shape mismatch: %s vs %s' %
+                        (attr.name, existing.shape, shape))
+                return existing
         if default_initializer is None:
             if is_bias:
                 attr._set_default_bias_initializer()

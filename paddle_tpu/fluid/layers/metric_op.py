@@ -3,7 +3,7 @@
 from ..layer_helper import LayerHelper
 from ..framework import Variable
 
-__all__ = ['accuracy', 'auc']
+__all__ = ['accuracy', 'auc', 'chunk_eval']
 
 
 def accuracy(input, label, k=1, correct=None, total=None):
@@ -51,3 +51,36 @@ def auc(input, label, curve='ROC', num_thresholds=200, topk=1):
                'num_thresholds': num_thresholds})
     auc_out.stop_gradient = True
     return auc_out
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None):
+    """Chunk detection precision/recall/F1 over tagged sequences
+    (reference layers/nn.py chunk_eval; operators/chunk_eval_op.cc).
+    Returns (precision, recall, f1, num_infer, num_label, num_correct)."""
+    helper = LayerHelper('chunk_eval', **locals())
+    precision = helper.create_variable_for_type_inference('float32')
+    recall = helper.create_variable_for_type_inference('float32')
+    f1_score = helper.create_variable_for_type_inference('float32')
+    num_infer_chunks = helper.create_variable_for_type_inference('int64')
+    num_label_chunks = helper.create_variable_for_type_inference('int64')
+    num_correct_chunks = helper.create_variable_for_type_inference('int64')
+    helper.append_op(
+        type='chunk_eval',
+        inputs={'Inference': [input],
+                'Label': [label]},
+        outputs={
+            'Precision': [precision],
+            'Recall': [recall],
+            'F1-Score': [f1_score],
+            'NumInferChunks': [num_infer_chunks],
+            'NumLabelChunks': [num_label_chunks],
+            'NumCorrectChunks': [num_correct_chunks],
+        },
+        attrs={
+            'chunk_scheme': chunk_scheme,
+            'num_chunk_types': num_chunk_types,
+            'excluded_chunk_types': excluded_chunk_types or [],
+        })
+    return (precision, recall, f1_score, num_infer_chunks,
+            num_label_chunks, num_correct_chunks)

@@ -27,6 +27,7 @@ __all__ = [
     'sigmoid_cross_entropy_with_logits', 'smooth_l1', 'log_loss', 'maxout',
     'prelu', 'leaky_relu', 'soft_relu', 'flatten', 'random_crop', 'im2sequence',
     'hsigmoid', 'nce', 'multiplex', 'dropout', 'layer_norm', 'lstm_unit',
+    'linear_chain_crf', 'crf_decoding', 'cos_sim',
 ]
 
 
@@ -1326,3 +1327,80 @@ def lstm_unit(x_t,
                  'H': [h]},
         attrs={'forget_bias': forget_bias})
     return h, c
+
+
+def linear_chain_crf(input, label, param_attr=None):
+    """Linear-chain CRF negative log-likelihood per sequence
+    (reference layers/nn.py linear_chain_crf;
+    operators/linear_chain_crf_op.cc).  Creates the [size+2, size]
+    transition parameter (row 0 start, row 1 end weights)."""
+    helper = LayerHelper('linear_chain_crf', **locals())
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr, shape=[size + 2, size], dtype='float32')
+    alpha = helper.create_variable_for_type_inference('float32')
+    emission_exps = helper.create_variable_for_type_inference('float32')
+    transition_exps = helper.create_variable_for_type_inference('float32')
+    log_likelihood = helper.create_variable_for_type_inference('float32')
+    helper.append_op(
+        type='linear_chain_crf',
+        inputs={'Emission': [input],
+                'Transition': [transition],
+                'Label': [label]},
+        outputs={
+            'Alpha': [alpha],
+            'EmissionExps': [emission_exps],
+            'TransitionExps': [transition_exps],
+            'LogLikelihood': [log_likelihood],
+        })
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr, label=None):
+    """Viterbi decode with the CRF transition parameter (reference
+    layers/nn.py crf_decoding; operators/crf_decoding_op.cc).  With a
+    label input, emits the per-token correctness indicator instead."""
+    helper = LayerHelper('crf_decoding', **locals())
+    try:
+        transition = helper.get_parameter(param_attr.name)
+    except ValueError:
+        # decoding-only program (built fresh, weights loaded afterwards by
+        # name): create the slot zero-initialized — deterministic garbage
+        # until load_persistables fills it, never silent random output
+        import warnings
+        warnings.warn(
+            "crf_decoding: transition parameter %r does not exist in this "
+            "program; creating it zero-initialized (expecting "
+            "load_persistables to fill it)" % param_attr.name)
+        size = input.shape[-1]
+        transition = helper.create_parameter(
+            attr=helper.param_attr, shape=[size + 2, size],
+            dtype='float32', default_initializer=Constant(0.0))
+    viterbi_path = helper.create_variable_for_type_inference('int64')
+    viterbi_path.lod_level = input.lod_level
+    inputs = {'Emission': [input], 'Transition': [transition]}
+    if label is not None:
+        inputs['Label'] = [label]
+    helper.append_op(
+        type='crf_decoding',
+        inputs=inputs,
+        outputs={'ViterbiPath': [viterbi_path]})
+    return viterbi_path
+
+
+def cos_sim(X, Y):
+    """Row-wise cosine similarity [B, 1] (reference layers/nn.py cos_sim;
+    operators/cos_sim_op.cc)."""
+    helper = LayerHelper('cos_sim', **locals())
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xnorm = helper.create_variable_for_type_inference(X.dtype)
+    ynorm = helper.create_variable_for_type_inference(X.dtype)
+    out.shape = (X.shape[0], 1)
+    helper.append_op(
+        type='cos_sim',
+        inputs={'X': [X],
+                'Y': [Y]},
+        outputs={'Out': [out],
+                 'XNorm': [xnorm],
+                 'YNorm': [ynorm]})
+    return out

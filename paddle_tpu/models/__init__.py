@@ -4,5 +4,14 @@ python/paddle/fluid/tests/book/)."""
 from . import mnist  # noqa: F401
 from . import resnet  # noqa: F401
 from . import vgg  # noqa: F401
+from . import seq2seq  # noqa: F401
+from . import stacked_lstm  # noqa: F401
+from . import fit_a_line  # noqa: F401
+from . import word2vec  # noqa: F401
+from . import recommender  # noqa: F401
+from . import label_semantic_roles  # noqa: F401
 
-__all__ = ['mnist', 'resnet', 'vgg']
+__all__ = [
+    'mnist', 'resnet', 'vgg', 'seq2seq', 'stacked_lstm', 'fit_a_line',
+    'word2vec', 'recommender', 'label_semantic_roles'
+]

@@ -18,3 +18,4 @@ from . import host_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import control_flow_ops  # noqa: F401
 from . import beam_search_ops  # noqa: F401
+from . import crf_ops  # noqa: F401

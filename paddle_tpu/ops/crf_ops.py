@@ -1,0 +1,139 @@
+"""Linear-chain CRF ops, TPU-native.
+
+The reference computes the CRF forward algorithm sequence-by-sequence on
+CPU only (operators/linear_chain_crf_op.cc, crf_decoding_op.cc — both
+CPU-kernel-only, with explicit Alpha/EmissionExps caches for the
+hand-written gradient).  Here both the forward algorithm and Viterbi run
+as batched ``lax.scan`` over the padded time axis in log space; the
+gradient comes from ``jax.vjp`` of the (differentiable) logsumexp
+recursion, so no Alpha caching is needed.
+
+Transition layout matches the reference (linear_chain_crf_op.cc comments):
+row 0 = start weights, row 1 = end weights, rows 2.. = [D, D] transition
+matrix w[i, j] = score of moving from tag i to tag j.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_lowering, SEQLEN_SUFFIX
+
+
+def _emission_label_lengths(ctx, op, em_slot, label_slot):
+    emission = ctx.get(op, em_slot)  # [B, T, D]
+    label = ctx.get(op, label_slot, default=None)
+    if label is not None and label.ndim == 3:
+        label = label[..., 0]  # [B, T]
+    lengths = ctx.env.get(op.input(em_slot)[0] + SEQLEN_SUFFIX)
+    b, t = emission.shape[0], emission.shape[1]
+    if lengths is None:
+        lengths = jnp.full((b, ), t, jnp.int32)
+    return emission, label, lengths
+
+
+@register_lowering('linear_chain_crf')
+def _linear_chain_crf(ctx, op):
+    """Negative log-likelihood of the gold path per sequence [B, 1].
+
+    (The reference's LogLikelihood output is also the negated
+    log-likelihood — see linear_chain_crf_op.h ForwardOneSequence.)
+    """
+    emission, label, lengths = _emission_label_lengths(
+        ctx, op, 'Emission', 'Label')
+    transition = ctx.get(op, 'Transition')  # [D+2, D]
+    b, t, d = emission.shape
+    w_start, w_end, w = transition[0], transition[1], transition[2:]
+    steps = jnp.arange(t)
+
+    # ---- partition function: alpha recursion in log space ----
+    def alpha_step(alpha, x):
+        e_t, t_idx = x  # e_t: [B, D]
+        # logsumexp_i(alpha[i] + w[i, j]) + e_t[j]
+        scores = alpha[:, :, None] + w[None, :, :]  # [B, D, D]
+        new = jax.nn.logsumexp(scores, axis=1) + e_t
+        alive = (t_idx < lengths)[:, None]
+        return jnp.where(alive, new, alpha), None
+
+    alpha0 = w_start[None, :] + emission[:, 0]  # [B, D]
+    alpha, _ = jax.lax.scan(
+        alpha_step, alpha0,
+        (jnp.swapaxes(emission, 0, 1)[1:], steps[1:]))
+    log_z = jax.nn.logsumexp(alpha + w_end[None, :], axis=1)  # [B]
+
+    # ---- gold path score ----
+    valid = steps[None, :] < lengths[:, None]  # [B, T]
+    lab = jnp.where(valid, label, 0).astype(jnp.int32)
+    em_scores = jnp.take_along_axis(emission, lab[:, :, None],
+                                    axis=2)[..., 0]  # [B, T]
+    em_sum = jnp.sum(jnp.where(valid, em_scores, 0.0), axis=1)
+    trans_scores = w[lab[:, :-1], lab[:, 1:]]  # [B, T-1]
+    trans_valid = valid[:, 1:]
+    trans_sum = jnp.sum(jnp.where(trans_valid, trans_scores, 0.0), axis=1)
+    last_lab = jnp.take_along_axis(
+        lab, jnp.maximum(lengths - 1, 0)[:, None].astype(jnp.int32),
+        axis=1)[:, 0]
+    score = (em_sum + trans_sum + w_start[lab[:, 0]] + w_end[last_lab])
+
+    ctx.set(op, 'LogLikelihood', (log_z - score)[:, None])
+
+
+@register_lowering('crf_decoding')
+def _crf_decoding(ctx, op):
+    """Viterbi decode (reference crf_decoding_op.h Decode): forward max
+    scan storing argmax pointers, then a reverse scan backtracks.  With a
+    Label input the output is the per-token correctness indicator, like
+    the reference."""
+    emission, label, lengths = _emission_label_lengths(
+        ctx, op, 'Emission', 'Label')
+    transition = ctx.get(op, 'Transition')
+    b, t, d = emission.shape
+    w_start, w_end, w = transition[0], transition[1], transition[2:]
+    steps = jnp.arange(t)
+
+    def viterbi_step(v, x):
+        e_t, t_idx = x
+        scores = v[:, :, None] + w[None, :, :]  # [B, D(from), D(to)]
+        best = jnp.max(scores, axis=1) + e_t
+        ptr = jnp.argmax(scores, axis=1).astype(jnp.int32)  # [B, D]
+        alive = (t_idx < lengths)[:, None]
+        v_new = jnp.where(alive, best, v)
+        return v_new, (ptr, v_new)
+
+    v0 = w_start[None, :] + emission[:, 0]
+    v_last, (ptrs, _) = jax.lax.scan(
+        viterbi_step, v0, (jnp.swapaxes(emission, 0, 1)[1:], steps[1:]))
+    # ptrs[k] holds the back-pointer for timestep k+1; v_last is v at L-1
+    # because dead steps carry v through unchanged.
+    last_state = jnp.argmax(v_last + w_end[None, :], axis=1) \
+        .astype(jnp.int32)  # [B]
+
+    # pad pointers so index t reads the back-pointer INTO step t
+    ptrs_full = jnp.concatenate(
+        [jnp.zeros((1, b, d), jnp.int32), ptrs], axis=0)  # [T, B, D]
+
+    def back_step(state, x):
+        ptr_next, t_idx = x  # ptr_next = ptrs_full[t+1]
+        prev = jnp.take_along_axis(ptr_next, state[:, None],
+                                   axis=1)[:, 0]  # state at t from t+1
+        s_t = jnp.where(t_idx == lengths - 1, last_state,
+                        jnp.where(t_idx < lengths - 1, prev, 0))
+        # carry must hold the state at t for the next (earlier) step
+        carry = jnp.where(t_idx <= lengths - 1, s_t, last_state)
+        return carry, s_t
+
+    ptr_shift = jnp.concatenate(
+        [ptrs_full[1:], jnp.zeros((1, b, d), jnp.int32)], axis=0)
+    _, path_rev = jax.lax.scan(
+        back_step, last_state, (ptr_shift[::-1], steps[::-1]))
+    path = jnp.swapaxes(path_rev[::-1], 0, 1)  # [B, T]
+    valid = steps[None, :] < lengths[:, None]
+    path = jnp.where(valid, path, 0).astype(jnp.int64)
+
+    if label is not None:
+        out = (path == label.astype(path.dtype)) & valid
+        out = out.astype(jnp.int64)
+    else:
+        out = path
+    name = op.output('ViterbiPath')[0]
+    ctx.store(name, out[:, :, None])
+    ctx.env[name + SEQLEN_SUFFIX] = lengths

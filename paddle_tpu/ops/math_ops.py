@@ -265,3 +265,18 @@ def _norm(ctx, op):
     norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
     ctx.set(op, 'Norm', norm)
     ctx.set(op, 'Out', x / norm)
+
+
+@register_lowering('cos_sim')
+def _cos_sim(ctx, op):
+    """Row-wise cosine similarity (reference operators/cos_sim_op.cc);
+    Y broadcasts when it has one row."""
+    x = ctx.get(op, 'X')
+    y = ctx.get(op, 'Y')
+    eps = 1e-12
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1, keepdims=True))
+    dot = jnp.sum(x * y, axis=-1, keepdims=True)  # broadcasts [1,D] y
+    ctx.set(op, 'Out', dot / jnp.maximum(xn * yn, eps))
+    ctx.set(op, 'XNorm', xn)
+    ctx.set(op, 'YNorm', yn)

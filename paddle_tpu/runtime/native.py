@@ -214,7 +214,7 @@ class NativeBlockingQueue(object):
             return
         self._q = None
         self._h = lib.bq_create(capacity)
-        self._pop_buf = ctypes.create_string_buffer(1 << 16)
+        self._pop_cap = 1 << 16  # size hint only; buffers are per-call
 
     def push(self, data):
         if self._q is not None:
@@ -240,15 +240,18 @@ class NativeBlockingQueue(object):
                 except _q.Empty:
                     if self._closed:
                         return None
+        cap = self._pop_cap
         while True:
-            n = self._lib.bq_pop(self._h, self._pop_buf,
-                                 len(self._pop_buf))
+            # per-call buffer: concurrent consumers never share bytes
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.bq_pop(self._h, buf, cap)
             if n == -1:
                 return None
             if n <= -2:  # buffer too small: grow and retry
-                self._pop_buf = ctypes.create_string_buffer(-(n + 2))
+                cap = -(n + 2)
+                self._pop_cap = max(self._pop_cap, cap)
                 continue
-            return self._pop_buf.raw[:n]
+            return buf.raw[:n]
 
     def size(self):
         if self._q is not None:

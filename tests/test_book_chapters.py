@@ -1,0 +1,101 @@
+"""Book-chapter parity: fit_a_line, word2vec, recommender_system train on
+their datasets and the loss falls; save/load inference round trip on
+fit_a_line (reference parity: tests/book/test_fit_a_line.py,
+test_word2vec.py, test_recommender_system.py)."""
+
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.dataset.uci_housing as uci_housing
+import paddle_tpu.dataset.imikolov as imikolov
+import paddle_tpu.dataset.movielens as movielens
+import paddle_tpu.reader as preader
+from paddle_tpu.models import fit_a_line, word2vec, recommender
+
+
+def _lod_feed(rows, dtype, dim=1):
+    flat = np.concatenate(
+        [np.asarray(r, dtype).reshape(-1, dim) for r in rows])
+    lt = fluid.core.LoDTensor(flat)
+    lt.set_recursive_sequence_lengths([[len(r) for r in rows]])
+    return lt
+
+
+def test_fit_a_line_trains_and_infers():
+    model = fit_a_line.build(lr=0.05)
+    batch = list(preader.firstn(uci_housing.train(), 64)())
+    x = np.stack([b[0] for b in batch])
+    y = np.stack([b[1] for b in batch])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(model['startup'])
+        losses = []
+        for _ in range(30):
+            l, = exe.run(model['main'], feed={'x': x, 'y': y},
+                         fetch_list=[model['loss']])
+            losses.append(float(l[0]))
+        assert losses[-1] < losses[0] * 0.5
+        # save/load inference round trip
+        with tempfile.TemporaryDirectory() as d:
+            fluid.io.save_inference_model(d, ['x'],
+                                          [model['prediction']], exe,
+                                          main_program=model['main'])
+            infer_prog, feed_names, fetch_targets = \
+                fluid.io.load_inference_model(d, exe)
+            want, = exe.run(model['test'], feed={'x': x, 'y': y},
+                            fetch_list=[model['prediction']])
+            got, = exe.run(infer_prog, feed={feed_names[0]: x},
+                           fetch_list=fetch_targets)
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_word2vec_trains():
+    model = word2vec.build(dict_size=200, embed_size=16, hidden_size=32,
+                           lr=0.05)
+    grams = list(preader.firstn(imikolov.train(n=5), 128)())
+    cols = [np.asarray([g[i] for g in grams], np.int64).reshape(-1, 1)
+            for i in range(5)]
+    feed = dict(zip(model['feeds'], cols))
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(model['startup'])
+        losses = []
+        for _ in range(15):
+            l, = exe.run(model['main'], feed=feed,
+                         fetch_list=[model['loss']])
+            losses.append(float(l[0]))
+    assert losses[-1] < losses[0]
+    # the 4 context embeddings share ONE table
+    params = [p.name for p in model['main'].all_parameters()]
+    assert params.count('shared_w') == 1
+
+
+def test_recommender_trains():
+    model = recommender.build(lr=0.1)
+    records = list(preader.firstn(movielens.train(), 64)())
+    feed = {
+        'user_id': np.asarray([[r[0]] for r in records], np.int64),
+        'gender_id': np.asarray([[r[1]] for r in records], np.int64),
+        'age_id': np.asarray([[r[2]] for r in records], np.int64),
+        'job_id': np.asarray([[r[3]] for r in records], np.int64),
+        'movie_id': np.asarray([[r[4]] for r in records], np.int64),
+        'category_id': _lod_feed([[[c] for c in r[5]] for r in records],
+                                 'int64'),
+        'movie_title': _lod_feed([[[t] for t in r[6]] for r in records],
+                                 'int64'),
+        'score': np.asarray([[r[7]] for r in records], np.float32),
+    }
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(model['startup'])
+        losses = []
+        for _ in range(12):
+            l, = exe.run(model['main'], feed=feed,
+                         fetch_list=[model['loss']])
+            losses.append(float(l[0]))
+    assert np.all(np.isfinite(losses))
+    assert losses[-1] < losses[0]

@@ -1,0 +1,226 @@
+"""Linear-chain CRF: nll and Viterbi vs numpy brute-force path
+enumeration, gradient flow, and the SRL book model (reference parity:
+test_linear_chain_crf_op.py, test_crf_decoding_op.py,
+tests/book/test_label_semantic_roles.py)."""
+
+import itertools
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.models import label_semantic_roles
+
+
+def _lod_feed(rows, dtype, dim=1):
+    flat = np.concatenate(
+        [np.asarray(r, dtype).reshape(-1, dim) for r in rows])
+    lt = fluid.core.LoDTensor(flat)
+    lt.set_recursive_sequence_lengths([[len(r) for r in rows]])
+    return lt
+
+
+def _brute_force(emission, transition, label):
+    """Enumerate all paths of one sequence: returns (nll_of_label,
+    best_path)."""
+    t, d = emission.shape
+    w_start, w_end, w = transition[0], transition[1], transition[2:]
+
+    def path_score(path):
+        s = w_start[path[0]] + w_end[path[-1]] + emission[0, path[0]]
+        for i in range(1, t):
+            s += w[path[i - 1], path[i]] + emission[i, path[i]]
+        return s
+
+    scores = {p: path_score(p) for p in itertools.product(range(d),
+                                                          repeat=t)}
+    all_s = np.array(list(scores.values()))
+    m = all_s.max()
+    log_z = m + np.log(np.exp(all_s - m).sum())
+    best = max(scores, key=scores.get)
+    return log_z - path_score(tuple(label)), list(best)
+
+
+def test_linear_chain_crf_matches_brute_force():
+    rng = np.random.RandomState(7)
+    d = 3
+    seq_lens = [3, 4]
+    emissions = [rng.standard_normal((l, d)).astype('float32')
+                 for l in seq_lens]
+    labels = [rng.randint(0, d, size=l).tolist() for l in seq_lens]
+    transition = rng.standard_normal((d + 2, d)).astype('float32')
+
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        em = fluid.layers.data(name='em', shape=[d], dtype='float32',
+                               lod_level=1)
+        lab = fluid.layers.data(name='lab', shape=[1], dtype='int64',
+                                lod_level=1)
+        nll = fluid.layers.linear_chain_crf(
+            input=em, label=lab,
+            param_attr=fluid.ParamAttr(name='crfw_t1'))
+        decode = fluid.layers.crf_decoding(
+            input=em, param_attr=fluid.ParamAttr(name='crfw_t1'))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        scope.find_var('crfw_t1').set_value(transition)
+        out, dec = exe.run(
+            prog,
+            feed={'em': _lod_feed([e.tolist() for e in emissions],
+                                  'float32', dim=d),
+                  'lab': _lod_feed([[[v] for v in l] for l in labels],
+                                   'int64')},
+            fetch_list=[nll, decode])
+    for i, (e, l) in enumerate(zip(emissions, labels)):
+        want_nll, want_path = _brute_force(e, transition, l)
+        np.testing.assert_allclose(out[i, 0], want_nll, rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_array_equal(
+            dec[i, :len(want_path), 0], want_path)
+        assert np.all(dec[i, len(want_path):] == 0)  # padding
+
+
+def test_crf_decoding_with_label_marks_correct_tokens():
+    rng = np.random.RandomState(3)
+    d = 4
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        em = fluid.layers.data(name='em', shape=[d], dtype='float32',
+                               lod_level=1)
+        lab = fluid.layers.data(name='lab', shape=[1], dtype='int64',
+                                lod_level=1)
+        decode = fluid.layers.crf_decoding(
+            input=em, param_attr=fluid.ParamAttr(name='crfw_t2'))
+        correct = fluid.layers.crf_decoding(
+            input=em, param_attr=fluid.ParamAttr(name='crfw_t2'),
+            label=lab)
+    emission = rng.standard_normal((5, d)).astype('float32')
+    transition = rng.standard_normal((d + 2, d)).astype('float32')
+    labels = rng.randint(0, d, size=5)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        scope.find_var('crfw_t2').set_value(transition)
+        dec, cor = exe.run(
+            prog,
+            feed={'em': _lod_feed([emission.tolist()], 'float32', dim=d),
+                  'lab': _lod_feed([[[v] for v in labels]], 'int64')},
+            fetch_list=[decode, correct])
+    np.testing.assert_array_equal(
+        cor[0, :5, 0], (dec[0, :5, 0] == labels).astype('int64'))
+
+
+def test_crf_gradient_trains():
+    """CRF nll falls when trained on a fixed tiny dataset."""
+    rng = np.random.RandomState(0)
+    d = 3
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        em = fluid.layers.data(name='em', shape=[d], dtype='float32',
+                               lod_level=1)
+        lab = fluid.layers.data(name='lab', shape=[1], dtype='int64',
+                                lod_level=1)
+        feat = fluid.layers.fc(input=em, size=d)
+        nll = fluid.layers.linear_chain_crf(
+            input=feat, label=lab,
+            param_attr=fluid.ParamAttr(name='crfw_t3'))
+        loss = fluid.layers.mean(nll)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    em_rows = [rng.standard_normal((4, d)).tolist() for _ in range(2)]
+    lab_rows = [[[int(i % d)] for i in range(4)] for _ in range(2)]
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(15):
+            l, = exe.run(prog,
+                         feed={'em': _lod_feed(em_rows, 'float32', dim=d),
+                               'lab': _lod_feed(lab_rows, 'int64')},
+                         fetch_list=[loss])
+            losses.append(float(l[0]))
+    assert losses[-1] < losses[0]
+
+
+def test_srl_model_trains():
+    model = label_semantic_roles.build(
+        word_dict_len=30, pred_dict_len=10, mark_dict_len=2,
+        label_dict_len=5, word_dim=4, hidden_dim=8, depth=2, lr=0.05)
+    rng = np.random.RandomState(1)
+    lens = [3, 5]
+
+    def int_feed(hi):
+        return _lod_feed([[[int(rng.randint(hi))] for _ in range(l)]
+                          for l in lens], 'int64')
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(model['startup'])
+        losses = []
+        feed = {}
+        for name in model['feeds'][:7]:
+            feed[name] = int_feed(10)
+        feed['mark_data'] = int_feed(2)
+        feed['target'] = int_feed(5)
+        for _ in range(8):
+            l, dec = exe.run(
+                model['main'], feed=feed,
+                fetch_list=[model['loss'], model['crf_decode']])
+            losses.append(float(l[0]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+        assert dec.shape[0] == 2  # [B, T, 1] viterbi paths
+
+
+def test_chunk_eval_iob():
+    # tags: B-0=0, I-0=1, B-1=2, I-1=3, O=4
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        inf = fluid.layers.data(name='inf', shape=[1], dtype='int64',
+                                lod_level=1)
+        lab = fluid.layers.data(name='lab', shape=[1], dtype='int64',
+                                lod_level=1)
+        outs = fluid.layers.chunk_eval(
+            input=inf, label=lab, chunk_scheme='IOB', num_chunk_types=2)
+    infer_seq = [[0], [1], [4], [2], [4]]   # chunks (0,2,0), (3,4,1)
+    label_seq = [[0], [1], [4], [2], [3]]   # chunks (0,2,0), (3,5,1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        p, r, f1, ni, nl, nc = exe.run(
+            prog,
+            feed={'inf': _lod_feed([infer_seq], 'int64'),
+                  'lab': _lod_feed([label_seq], 'int64')},
+            fetch_list=list(outs))
+    assert (ni[0], nl[0], nc[0]) == (2, 2, 1)
+    np.testing.assert_allclose([p[0], r[0], f1[0]], [0.5, 0.5, 0.5],
+                               rtol=1e-6)
+
+
+def test_chunk_evaluator_streams():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        inf = fluid.layers.data(name='inf', shape=[1], dtype='int64',
+                                lod_level=1)
+        lab = fluid.layers.data(name='lab', shape=[1], dtype='int64',
+                                lod_level=1)
+        ev = fluid.evaluator.ChunkEvaluator(
+            input=inf, label=lab, chunk_scheme='IOB', num_chunk_types=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(2):  # two identical minibatches accumulate
+            exe.run(prog,
+                    feed={'inf': _lod_feed([[[0], [1], [4], [2], [4]]],
+                                           'int64'),
+                          'lab': _lod_feed([[[0], [1], [4], [2], [3]]],
+                                           'int64')},
+                    fetch_list=[])
+        p, r, f1 = ev.eval(exe)
+    np.testing.assert_allclose([p, r, f1], [0.5, 0.5, 0.5], rtol=1e-6)
