@@ -10,5 +10,7 @@ from . import mnist  # noqa: F401
 from . import uci_housing  # noqa: F401
 from . import imdb  # noqa: F401
 from . import cifar  # noqa: F401
+from . import imikolov  # noqa: F401
+from . import movielens  # noqa: F401
 
-__all__ = ['mnist', 'uci_housing', 'imdb', 'cifar']
+__all__ = ['mnist', 'uci_housing', 'imdb', 'cifar', 'imikolov', 'movielens']

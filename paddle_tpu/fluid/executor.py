@@ -144,6 +144,8 @@ def validate_feed(program, feed_arrays):
             continue
         shape = tuple(var.shape)
         got = getattr(value, 'shape', None)  # no device->host copy
+        if callable(got):  # core.LoDTensor exposes shape() as a method
+            got = got()
         got = tuple(got) if got is not None else tuple(
             np.shape(as_numpy(value)))
         lod = getattr(var, 'lod_level', 0) or 0

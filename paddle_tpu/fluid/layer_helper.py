@@ -113,6 +113,11 @@ class LayerHelper(object):
                     raise ValueError(
                         'shared parameter %r shape mismatch: %s vs %s' %
                         (attr.name, existing.shape, shape))
+                if core.convert_np_dtype_to_dtype_(existing.dtype) != \
+                        core.convert_np_dtype_to_dtype_(dtype):
+                    raise ValueError(
+                        'shared parameter %r dtype mismatch: %s vs %s' %
+                        (attr.name, existing.dtype, dtype))
                 return existing
         if default_initializer is None:
             if is_bias:

@@ -1,0 +1,14 @@
+"""Shared test helpers."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def lod_feed(rows, dtype, dim=1):
+    """rows: list of per-sequence lists -> LoDTensor."""
+    flat = np.concatenate([np.asarray(r, dtype).reshape(-1, dim)
+                           for r in rows])
+    lt = fluid.core.LoDTensor(flat)
+    lt.set_recursive_sequence_lengths([[len(r) for r in rows]])
+    return lt

@@ -16,12 +16,7 @@ import paddle_tpu.reader as preader
 from paddle_tpu.models import fit_a_line, word2vec, recommender
 
 
-def _lod_feed(rows, dtype, dim=1):
-    flat = np.concatenate(
-        [np.asarray(r, dtype).reshape(-1, dim) for r in rows])
-    lt = fluid.core.LoDTensor(flat)
-    lt.set_recursive_sequence_lengths([[len(r) for r in rows]])
-    return lt
+from helpers import lod_feed as _lod_feed  # noqa: E402
 
 
 def test_fit_a_line_trains_and_infers():

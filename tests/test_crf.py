@@ -11,12 +11,7 @@ import paddle_tpu.fluid as fluid
 from paddle_tpu.models import label_semantic_roles
 
 
-def _lod_feed(rows, dtype, dim=1):
-    flat = np.concatenate(
-        [np.asarray(r, dtype).reshape(-1, dim) for r in rows])
-    lt = fluid.core.LoDTensor(flat)
-    lt.set_recursive_sequence_lengths([[len(r) for r in rows]])
-    return lt
+from helpers import lod_feed as _lod_feed  # noqa: E402
 
 
 def _brute_force(emission, transition, label):

@@ -9,13 +9,7 @@ import paddle_tpu.fluid as fluid
 import paddle_tpu.dataset.imdb as imdb
 
 
-def _lod_feed(rows, dtype, dim=1):
-    """rows: list of per-sequence lists -> LoDTensor."""
-    flat = np.concatenate([np.asarray(r, dtype).reshape(-1, dim)
-                           for r in rows])
-    lt = fluid.core.LoDTensor(flat)
-    lt.set_recursive_sequence_lengths([[len(r) for r in rows]])
-    return lt
+from helpers import lod_feed as _lod_feed  # noqa: E402
 
 
 def test_sequence_pool_matches_numpy():
