@@ -1,0 +1,43 @@
+"""CTR (click-through-rate) dataset, criteo-display-ads shaped
+(reference capability: the distributed-lookup-table / CTR config in
+BASELINE.json; Paddle's classic CTR demo feeds 13 dense "I" features and
+26 categorical "C" features hashed into a large sparse id space).
+
+Deterministic synthetic generator (zero network egress): each sample is
+(dense[13] float, sparse ids[26] int64 in [0, sparse_dim), label {0,1}),
+with the label correlated to both dense and sparse features so models can
+actually learn.
+"""
+
+import numpy as np
+
+__all__ = ['train', 'test', 'DENSE_DIM', 'SPARSE_SLOTS', 'SPARSE_DIM']
+
+DENSE_DIM = 13
+SPARSE_SLOTS = 26
+SPARSE_DIM = 10000
+
+
+def _reader(seed, n):
+    def reader():
+        rng = np.random.RandomState(seed)
+        # a fixed per-id weight makes sparse features informative
+        id_w = np.sin(np.arange(SPARSE_DIM) * 0.37)
+        w_dense = rng.standard_normal(DENSE_DIM)
+        for _ in range(n):
+            dense = rng.standard_normal(DENSE_DIM).astype('float32')
+            ids = (rng.zipf(1.2, size=SPARSE_SLOTS) % SPARSE_DIM).astype(
+                'int64')
+            logit = dense @ w_dense * 0.5 + id_w[ids].sum() * 0.8
+            label = np.int64(1 / (1 + np.exp(-logit)) > rng.rand())
+            yield dense, ids, label
+
+    return reader
+
+
+def train(n=4096, seed=0):
+    return _reader(seed, n)
+
+
+def test(n=512, seed=1):
+    return _reader(seed + 10007, n)

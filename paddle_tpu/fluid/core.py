@@ -257,6 +257,45 @@ class LoDTensor(object):
 
 
 # ----------------------------------------------------------------------------
+# SelectedRows (paddle/fluid/framework/selected_rows.h:32)
+# ----------------------------------------------------------------------------
+class SelectedRows(object):
+    """Row-subset tensor {rows, value, height} — the host-side mirror of a
+    sparse gradient (pybind.cc:233 surface: rows/set_rows/height/
+    set_height/get_tensor)."""
+
+    def __init__(self, rows=None, height=0):
+        self._rows = list(rows) if rows is not None else []
+        self._height = int(height)
+        self._tensor = LoDTensor()
+
+    def rows(self):
+        return self._rows
+
+    def set_rows(self, rows):
+        self._rows = list(rows)
+
+    def height(self):
+        return self._height
+
+    def set_height(self, height):
+        self._height = int(height)
+
+    def get_tensor(self):
+        return self._tensor
+
+    def to_dense(self):
+        vals = self._tensor.numpy()
+        out = np.zeros((self._height, ) + vals.shape[1:], vals.dtype)
+        np.add.at(out, np.asarray(self._rows, np.int64), vals)
+        return out
+
+    def __repr__(self):
+        return 'SelectedRows(n=%d, height=%d)' % (len(self._rows),
+                                                  self._height)
+
+
+# ----------------------------------------------------------------------------
 # Scope (paddle/fluid/framework/scope.h:39)
 # ----------------------------------------------------------------------------
 class _ScopeVariable(object):

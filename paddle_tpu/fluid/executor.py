@@ -124,7 +124,8 @@ def prepare_feed_arrays(feed):
             padded, lengths = _lod_to_padded(value)
             feed_arrays[name] = padded
             feed_arrays[name + registry.SEQLEN_SUFFIX] = lengths
-        elif isinstance(value, (core.LoDTensor, jax.Array)):
+        elif isinstance(value,
+                        (core.LoDTensor, core.SelectedRows, jax.Array)):
             feed_arrays[name] = value
         else:
             feed_arrays[name] = np.asarray(value)
@@ -139,6 +140,8 @@ def validate_feed(program, feed_arrays):
     for name, value in feed_arrays.items():
         if name.endswith(registry.SEQLEN_SUFFIX):
             continue
+        if isinstance(value, core.SelectedRows):
+            continue  # row-subset feeds carry their own height metadata
         var = block.vars.get(name)
         if var is None or not getattr(var, 'shape', None):
             continue
@@ -172,6 +175,9 @@ def feed_signature(feed_arrays):
     def _sig_of(v):
         if isinstance(v, jax.Array):
             return tuple(v.shape), str(v.dtype)
+        if isinstance(v, core.SelectedRows):
+            t = v.get_tensor().numpy()
+            return ('sr', ) + tuple(np.shape(t)), str(t.dtype)
         a = as_numpy(v)
         return tuple(np.shape(a)), str(a.dtype)
 
@@ -203,6 +209,8 @@ def _lod_to_padded(lt, bucket=_SEQ_BUCKET):
 
 def _to_device_value(value, var_desc, device):
     import jax
+    if isinstance(value, core.SelectedRows):
+        return value  # host-domain value; consumed by host ops as-is
     if isinstance(value, jax.Array):
         # already on device (the common case for state after step 1):
         # avoid the device->host->device round trip
@@ -247,24 +255,30 @@ class _CompiledBlock(object):
         defined = set(self.feed_names)
         state_in = []
         state_out = []
+
+        def threadable(v):
+            # SELECTED_ROWS-typed vars (sparse tables, row-subset grads)
+            # live in the host domain: host ops manage them via the scope
+            # directly, never as threaded jit state
+            return (v is not None and v.persistable and
+                    v.type != core.VarDesc.VarType.SELECTED_ROWS)
+
         for op in ops:
             for name in op.input_arg_names:
                 if name in defined or name in state_in:
                     continue
-                v = block._find_var_recursive(name)
-                if v is not None and v.persistable:
+                if threadable(block._find_var_recursive(name)):
                     state_in.append(name)
                     defined.add(name)
             for name in op.output_arg_names:
                 v = block._find_var_recursive(name)
-                if v is not None and v.persistable and name not in state_out:
+                if threadable(v) and name not in state_out:
                     state_out.append(name)
                 defined.add(name)
         # fetching a persistable var that no op writes still needs its value
         for name in self.fetch_names:
             if name not in defined:
-                v = block._find_var_recursive(name)
-                if v is not None and v.persistable:
+                if threadable(block._find_var_recursive(name)):
                     state_in.append(name)
                     defined.add(name)
         self.state_in = state_in
@@ -408,9 +422,20 @@ class Executor(object):
         eager = any(_is_host_op(op) for op in compiled.ops)
         rng = self._next_rng(program)
         fetches = compiled.run(scope, feed_arrays, rng, eager=eager)
-        if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return [core.LoDTensor(np.asarray(f)) for f in fetches]
+
+        def convert(f):
+            from ..ops.sparse import SparseRows
+            if isinstance(f, core.SelectedRows):
+                return f
+            if isinstance(f, SparseRows):
+                sr = core.SelectedRows(
+                    rows=np.asarray(f.rows).tolist(), height=f.height)
+                sr.get_tensor().set(np.asarray(f.values))
+                return sr
+            return np.asarray(f) if return_numpy else core.LoDTensor(
+                np.asarray(f))
+
+        return [convert(f) for f in fetches]
 
     def close(self):
         """Reference Executor.Close() notifies pservers (executor.h:51); here

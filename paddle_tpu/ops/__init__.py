@@ -19,3 +19,11 @@ from . import sequence_ops  # noqa: F401
 from . import control_flow_ops  # noqa: F401
 from . import beam_search_ops  # noqa: F401
 from . import crf_ops  # noqa: F401
+from . import sparse  # noqa: F401
+
+# wrap every optimizer lowering with SelectedRows (SparseRows) handling —
+# the analog of the reference's separate SelectedRows optimizer kernels
+for _opt in ('sgd', 'momentum', 'adam', 'adamax', 'adagrad',
+             'decayed_adagrad', 'rmsprop', 'adadelta', 'ftrl'):
+    sparse.sparsify_optimizer(_opt)
+del _opt

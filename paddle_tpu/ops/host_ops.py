@@ -161,3 +161,110 @@ def _chunk_eval(ctx, op, scope):
     ctx.set(op, 'NumInferChunks', np.array([n_infer], np.int64))
     ctx.set(op, 'NumLabelChunks', np.array([n_label], np.int64))
     ctx.set(op, 'NumCorrectChunks', np.array([n_correct], np.int64))
+
+
+# ---------------------------------------------------------------------------
+# Distributed-sparse plumbing ops (reference: operators/split_ids_op.cc,
+# merge_ids_op.cc, split_selected_rows_op.cc, lookup_sparse_table_op.cc).
+# These drive the sharded-embedding path: ids are routed to table shards,
+# rows fetched, and reassembled in original order.  They are control-plane
+# host work in the reference too (CPU-only kernels).
+# ---------------------------------------------------------------------------
+@register_host_op('split_ids')
+def _split_ids(ctx, op, scope):
+    ids = np.asarray(ctx.get(op, 'Ids')).reshape(-1)
+    outs = op.output('Out')
+    n = len(outs)
+    for k, name in enumerate(outs):
+        shard = np.unique(ids[ids % n == k])
+        ctx.store(name, shard.reshape(-1, 1).astype(ids.dtype))
+
+
+@register_host_op('merge_ids')
+def _merge_ids(ctx, op, scope):
+    """Reassemble per-shard embedding rows into original id order."""
+    ids = np.asarray(ctx.get(op, 'Ids')).reshape(-1)
+    shard_ids = [np.asarray(ctx.lookup(n)).reshape(-1)
+                 for n in op.input('Rows')]
+    shard_vals = [np.asarray(ctx.lookup(n)) for n in op.input('X')]
+    dim = shard_vals[0].shape[-1]
+    lut = {}
+    for sid, sval in zip(shard_ids, shard_vals):
+        for j, i in enumerate(sid):
+            lut[int(i)] = sval[j]
+    out = np.stack([lut[int(i)] for i in ids]).reshape(len(ids), dim)
+    ctx.set(op, 'Out', out)
+
+
+@register_host_op('split_selected_rows')
+def _split_selected_rows(ctx, op, scope):
+    from ..fluid import core
+    from .sparse import SparseRows
+    x = ctx.get(op, 'X')
+    if isinstance(x, SparseRows):
+        rows = np.asarray(x.rows)
+        vals = np.asarray(x.values)
+        height = x.height
+    else:
+        rows = np.asarray(x.rows())
+        vals = x.get_tensor().numpy()
+        height = x.height()
+    sections = list(op.attrs['height_sections'])
+    offsets = np.cumsum([0] + sections)
+    for k, name in enumerate(op.output('Out')):
+        lo, hi = offsets[k], offsets[k + 1]
+        sel = (rows >= lo) & (rows < hi)
+        sr = core.SelectedRows(rows=(rows[sel] - lo).tolist(),
+                               height=sections[k])
+        sr.get_tensor().set(vals[sel])
+        ctx.store(name, sr)
+
+
+@register_host_op('lookup_sparse_table')
+def _lookup_sparse_table(ctx, op, scope):
+    """Auto-growing sparse table lookup: the table lives host-side as an
+    id->row dict (the analog of the pserver's SelectedRows table); unseen
+    ids are initialized uniform(-init_range, init_range)."""
+    w_name = op.input('W')[0]
+    var = scope.var(w_name)
+    table = var.value()
+    if not isinstance(table, dict):
+        table = {}
+        var.set_value(table)
+    ids = np.asarray(ctx.get(op, 'Ids')).reshape(-1)
+    dim = int(op.attrs['embedding_dim'])
+    init_range = float(op.attrs.get('init_range', 0.05))
+    seed = int(op.attrs.get('seed', 0))
+    out = np.empty((len(ids), dim), np.float32)
+    for j, i in enumerate(ids):
+        i = int(i)
+        if i not in table:
+            if not op.attrs.get('auto_grown_table', True):
+                raise KeyError('id %d not in sparse table %r' % (i, w_name))
+            rng = np.random.RandomState((seed + i) % (2**31))
+            table[i] = rng.uniform(-init_range, init_range,
+                                   dim).astype(np.float32)
+        out[j] = table[i]
+    ctx.set(op, 'Out', out)
+
+
+@register_host_op('sparse_table_apply_grad')
+def _sparse_table_apply_grad(ctx, op, scope):
+    """Apply a SelectedRows gradient to a host sparse table with SGD —
+    the pserver-side optimize block for the distributed lookup table
+    (listen_and_serv optimize sub-blocks, SURVEY §3.3)."""
+    from ..fluid import core
+    from .sparse import SparseRows
+    w_name = op.input('W')[0]
+    table = scope.var(w_name).value()
+    assert isinstance(table, dict), 'run lookup_sparse_table first'
+    g = ctx.get(op, 'Grad')
+    lr = float(np.asarray(ctx.get(op, 'LearningRate')).reshape(()))
+    if isinstance(g, SparseRows):
+        rows, vals = np.asarray(g.rows), np.asarray(g.values)
+    elif isinstance(g, core.SelectedRows):
+        rows, vals = np.asarray(g.rows()), g.get_tensor().numpy()
+    else:
+        raise TypeError('sparse_table_apply_grad needs a SelectedRows grad')
+    for j, i in enumerate(rows):
+        table[int(i)] = table[int(i)] - lr * vals[j]

@@ -137,16 +137,26 @@ _register_elementwise('floordiv', jnp.floor_divide)
 
 @register_lowering('sum')
 def _sum(ctx, op):
+    from .sparse import sparse_add
     xs = ctx.get_list(op, 'X')
     out = xs[0]
     for x in xs[1:]:
-        out = out + x
+        out = sparse_add(out, x)
     ctx.set(op, 'Out', out)
 
 
 @register_lowering('scale')
 def _scale(ctx, op):
+    from .sparse import SparseRows
     x = ctx.get(op, 'X')
+    if isinstance(x, SparseRows):
+        # SelectedRows scale (math/selected_rows_functor.cc) — loss-grad
+        # 1/N scaling reaches sparse grads through this path
+        if op.attrs.get('bias', 0.0) != 0.0:
+            raise NotImplementedError(
+                'scale with bias!=0 on a SelectedRows value')
+        ctx.set(op, 'Out', x.scale(op.attrs.get('scale', 1.0)))
+        return
     scale = jnp.asarray(op.attrs.get('scale', 1.0), x.dtype)
     bias = jnp.asarray(op.attrs.get('bias', 0.0), x.dtype)
     if op.attrs.get('bias_after_scale', True):

@@ -1,0 +1,169 @@
+"""SelectedRows / sparse-gradient support, TPU-native.
+
+Reference surface: ``SelectedRows`` (framework/selected_rows.h:32) — a
+row-subset tensor {rows, value, height} used chiefly for embedding
+gradients (operators/lookup_table_op.cc grad with ``is_sparse``), with
+optimizer kernels that update only the touched rows
+(operators/sgd_op.h SelectedRows branch, operators/adam_op.h
+SparseAdamFunctor, merge/scale math in math/selected_rows_functor.cc).
+
+TPU-native design: inside a compiled block a sparse gradient is a
+``SparseRows`` pytree — rows (int32 [N]) + values ([N, D]) + static
+height — so the [V, D] dense gradient is never materialized.  The SGD
+update lowers to one XLA scatter-add; adaptive optimizers (adam/adagrad/
+momentum/…) reproduce the reference's *lazy* row-subset semantics by
+merging duplicate rows with a scatter and masking untouched rows.
+Everything stays jit-compatible: rows/values have static shapes (one row
+per looked-up id), duplicates are resolved by scatter addition.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import (GRAD_SUFFIX, fwd_structure, register_grad_lowering,
+                       register_lowering)
+
+
+@jax.tree_util.register_pytree_node_class
+class SparseRows(object):
+    """Traced stand-in for the reference SelectedRows."""
+
+    def __init__(self, rows, values, height):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+
+    def tree_flatten(self):
+        return (self.rows, self.values), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        rows, values = children
+        return cls(rows, values, height)
+
+    @property
+    def dense_shape(self):
+        return (self.height, ) + tuple(self.values.shape[1:])
+
+    def to_dense(self):
+        """Scatter-add into the dense [height, D] gradient (duplicate rows
+        accumulate, matching math/selected_rows_functor.cc MergeAdd)."""
+        zeros = jnp.zeros(self.dense_shape, self.values.dtype)
+        return zeros.at[self.rows].add(self.values)
+
+    def touched_mask(self):
+        """Boolean [height] mask of rows present in this gradient."""
+        m = jnp.zeros((self.height, ), jnp.bool_)
+        return m.at[self.rows].set(True)
+
+    def scale(self, s):
+        return SparseRows(self.rows, self.values * s, self.height)
+
+    def __repr__(self):
+        return 'SparseRows(n=%s, height=%d, dim=%s)' % (
+            self.values.shape[0], self.height, self.values.shape[1:])
+
+
+def sparse_add(a, b):
+    """Gradient accumulation closed over {dense, SparseRows} operands."""
+    a_sparse = isinstance(a, SparseRows)
+    b_sparse = isinstance(b, SparseRows)
+    if a_sparse and b_sparse:
+        return SparseRows(
+            jnp.concatenate([a.rows, b.rows]),
+            jnp.concatenate([a.values, b.values]), a.height)
+    if a_sparse:
+        return b + a.to_dense()
+    if b_sparse:
+        return a + b.to_dense()
+    return a + b
+
+
+# ----------------------------------------------------------------------------
+# lookup_table grad: dense scatter-add or SparseRows depending on is_sparse
+# (reference lookup_table_op.cc LookupTableGradKernel / ..GradCUDAKernel)
+# ----------------------------------------------------------------------------
+@register_grad_lowering('lookup_table')
+def _lookup_table_grad(ctx, op):
+    fwd_inputs, fwd_outputs, fwd_attrs = fwd_structure(op)
+    gnames = op.output('W' + GRAD_SUFFIX)
+    if not gnames or not gnames[0]:
+        return
+    gname = gnames[0]
+    w = ctx.lookup(fwd_inputs['W'][0])
+    ids = ctx.lookup(fwd_inputs['Ids'][0])
+    gout = ctx.lookup(fwd_outputs['Out'][0] + GRAD_SUFFIX)
+    flat = jnp.reshape(ids, (-1, )).astype(jnp.int32)
+    vals = jnp.reshape(gout, (flat.shape[0], w.shape[-1]))
+    padding_idx = fwd_attrs.get('padding_idx', -1)
+    if padding_idx is not None and padding_idx >= 0:
+        vals = jnp.where((flat == padding_idx)[:, None],
+                         jnp.zeros_like(vals), vals)
+    if fwd_attrs.get('is_sparse', False):
+        g = SparseRows(flat, vals, w.shape[0])
+    else:
+        g = jnp.zeros_like(w).at[flat].add(vals)
+    if ctx.has(gname):
+        g = sparse_add(ctx.lookup(gname), g)
+    ctx.store(gname, g)
+
+
+# ----------------------------------------------------------------------------
+# Optimizer wrapping: lazy row-subset updates for SparseRows grads
+# ----------------------------------------------------------------------------
+def sparse_sgd_update(p, g, lr):
+    """Exact sparse SGD: one scatter-add, no dense grad materialized
+    (reference sgd_op.h SelectedRows branch)."""
+    return p.at[g.rows].add((-lr * g.values).astype(p.dtype))
+
+
+def lazy_apply(ctx, op, dense_fn):
+    """Run a dense optimizer lowering against the merged dense gradient,
+    then keep untouched rows unchanged in every row-shaped output slot —
+    the reference's lazy SelectedRows optimizer semantics
+    (adam_op.h SparseAdamFunctor: update only rows present in the grad)."""
+    g = ctx.get(op, 'Grad')
+    if not isinstance(g, SparseRows):
+        return dense_fn(ctx, op)
+    grad_name = op.input('Grad')[0]
+    # inputs an output may alias (ParamOut<-Param etc.) for masking
+    in_by_slot = {s: [ctx.lookup(n) for n in op.input(s)]
+                  for s in op.inputs if all(ctx.has(n) for n in op.input(s))}
+    ctx.store(grad_name, g.to_dense())
+    try:
+        dense_fn(ctx, op)
+    finally:
+        ctx.store(grad_name, g)
+    touched = g.touched_mask()
+    for out_slot in op.outputs:
+        in_slot = out_slot[:-3] if out_slot.endswith('Out') else None
+        if in_slot is None or in_slot not in in_by_slot:
+            continue
+        olds = in_by_slot[in_slot]
+        for n, old in zip(op.output(out_slot), olds):
+            if not ctx.has(n):
+                continue
+            new = ctx.lookup(n)
+            shape = jnp.shape(new)
+            if not shape or shape[0] != g.height or shape != jnp.shape(old):
+                continue  # scalar slots (Beta1Pow etc.) update densely
+            mask = jnp.reshape(touched, (g.height, ) + (1, ) *
+                               (len(shape) - 1))
+            ctx.store(n, jnp.where(mask, new, old))
+
+
+def sparsify_optimizer(op_type):
+    """Re-register ``op_type``'s lowering wrapped with SparseRows handling."""
+    from . import registry
+    dense_fn = registry._LOWERINGS[op_type]
+
+    def wrapped(ctx, op):
+        g = ctx.get(op, 'Grad')
+        if isinstance(g, SparseRows) and op_type == 'sgd':
+            p = ctx.get(op, 'Param')
+            lr = jnp.reshape(ctx.get(op, 'LearningRate'), ())
+            ctx.set(op, 'ParamOut', sparse_sgd_update(p, g, lr))
+            return
+        lazy_apply(ctx, op, dense_fn)
+
+    register_lowering(op_type)(wrapped)

@@ -1,0 +1,285 @@
+"""SelectedRows / sparse-gradient tests (reference parity:
+test_lookup_table_op.py sparse grad, SelectedRows optimizer kernels,
+split_ids / merge_ids / split_selected_rows / lookup_sparse_table ops)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.ops.sparse import SparseRows
+
+
+def _embedding_prog(is_sparse, optimizer, vocab=50, dim=4, shared=False):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name='ids', shape=[3], dtype='int64')
+        emb = fluid.layers.embedding(
+            ids, size=[vocab, dim], is_sparse=is_sparse,
+            param_attr=fluid.ParamAttr(name='emb_w'))
+        feats = [emb]
+        if shared:  # second lookup on the same table -> grad accumulation
+            ids2 = fluid.layers.data(name='ids2', shape=[2], dtype='int64')
+            feats.append(fluid.layers.embedding(
+                ids2, size=[vocab, dim], is_sparse=is_sparse,
+                param_attr=fluid.ParamAttr(name='emb_w')))
+        flat = fluid.layers.concat(
+            [fluid.layers.reshape(f, shape=[0, -1]) for f in feats], axis=1)
+        loss = fluid.layers.mean(
+            fluid.layers.reduce_sum(fluid.layers.square(flat), dim=-1))
+        optimizer().minimize(loss)
+    return main, startup, loss
+
+
+def _train_table(is_sparse, optimizer, steps=3, shared=False):
+    main, startup, loss = _embedding_prog(is_sparse, optimizer,
+                                          shared=shared)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            feed = {'ids': rng.randint(0, 50, (8, 3)).astype('int64')}
+            if shared:
+                feed['ids2'] = rng.randint(0, 50, (8, 2)).astype('int64')
+            exe.run(main, feed=feed, fetch_list=[loss])
+    return np.array(scope.find_var('emb_w').value())
+
+
+def test_sparse_sgd_matches_dense():
+    w_dense = _train_table(False, lambda: fluid.optimizer.SGD(0.1))
+    w_sparse = _train_table(True, lambda: fluid.optimizer.SGD(0.1))
+    np.testing.assert_allclose(w_sparse, w_dense, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_grad_accumulation_shared_table():
+    """Two lookups of one table: sparse grads concat-accumulate through
+    the synthesized sum op and still match the dense result (sgd)."""
+    w_dense = _train_table(False, lambda: fluid.optimizer.SGD(0.1),
+                           shared=True)
+    w_sparse = _train_table(True, lambda: fluid.optimizer.SGD(0.1),
+                            shared=True)
+    np.testing.assert_allclose(w_sparse, w_dense, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_adam_is_lazy():
+    """Adam with a sparse grad must update ONLY touched rows (the
+    reference SparseAdamFunctor semantics) — untouched rows keep their
+    initial values, unlike dense adam where moments decay everywhere."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name='ids', shape=[2], dtype='int64')
+        emb = fluid.layers.embedding(
+            ids, size=[10, 3], is_sparse=True,
+            param_attr=fluid.ParamAttr(name='emb_lazy'))
+        loss = fluid.layers.mean(fluid.layers.square(emb))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w0 = np.array(scope.find_var('emb_lazy').value()).copy()
+        exe.run(main,
+                feed={'ids': np.array([[1, 3], [3, 5]], 'int64')},
+                fetch_list=[loss])
+        w1 = np.array(scope.find_var('emb_lazy').value())
+    touched = sorted({1, 3, 5})
+    untouched = [i for i in range(10) if i not in touched]
+    assert not np.allclose(w1[touched], w0[touched])
+    np.testing.assert_array_equal(w1[untouched], w0[untouched])
+
+
+def test_fetch_sparse_grad_returns_selected_rows():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name='ids', shape=[2], dtype='int64')
+        emb = fluid.layers.embedding(
+            ids, size=[20, 4], is_sparse=True,
+            param_attr=fluid.ParamAttr(name='emb_f'))
+        loss = fluid.layers.mean(emb)
+        fluid.backward.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        g, = exe.run(main,
+                     feed={'ids': np.array([[2, 7], [7, 2]], 'int64')},
+                     fetch_list=['emb_f@GRAD'])
+    assert isinstance(g, fluid.core.SelectedRows)
+    assert g.height() == 20
+    dense = g.to_dense()
+    # d(mean)/d(emb) spread over 2x2x4 entries; rows 2 and 7 touched twice
+    np.testing.assert_allclose(dense[2], np.full(4, 2 / 16), rtol=1e-6)
+    np.testing.assert_allclose(dense[7], np.full(4, 2 / 16), rtol=1e-6)
+    assert np.all(dense[[0, 1, 3, 4, 5, 6] + list(range(8, 20))] == 0)
+
+
+def test_ctr_model_trains_sparse():
+    from paddle_tpu.models import ctr as ctr_model
+    from paddle_tpu.dataset import ctr as ctr_data
+    m = ctr_model.build(is_sparse=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    losses = []
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(m['startup'])
+        batch = []
+        for sample in ctr_data.train(n=1024)():
+            batch.append(sample)
+            if len(batch) == 128:
+                l, = exe.run(m['main'],
+                             feed={'dense': np.stack([b[0] for b in batch]),
+                                   'sparse_ids': np.stack(
+                                       [b[1] for b in batch]),
+                                   'label': np.array([[b[2]] for b in batch],
+                                                     'int64')},
+                             fetch_list=[m['loss']])
+                losses.append(float(l.flatten()[0]))
+                batch = []
+    assert losses[-1] < losses[0]
+
+
+def _run_host_program(prog, scope, feed, fetch_list):
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        return exe.run(prog, feed=feed, fetch_list=fetch_list,
+                       return_numpy=False)
+
+
+def test_split_ids_and_merge_ids_roundtrip():
+    prog = fluid.Program()
+    block = prog.global_block()
+    ids_var = block.create_var(name='Ids', shape=[-1, 1], dtype='int64')
+    n_shard = 3
+    outs, row_names = [], []
+    for k in range(n_shard):
+        outs.append(block.create_var(name='shard_%d' % k, shape=[-1, 1],
+                                     dtype='int64'))
+    block.append_op(type='split_ids', inputs={'Ids': [ids_var]},
+                    outputs={'Out': outs}, attrs={})
+    # per-shard "embedding fetch": rows = shard ids, value = id * [1,1]
+    emb_outs = []
+    for k in range(n_shard):
+        ev = block.create_var(name='emb_%d' % k, shape=[-1, 2],
+                              dtype='float32')
+        emb_outs.append(ev)
+        block.append_op(
+            type='lookup_sparse_table',
+            inputs={'W': [block.create_var(
+                name='table_%d' % k, shape=[-1], dtype='float32',
+                persistable=True,
+                type=fluid.core.VarDesc.VarType.SELECTED_ROWS)],
+                    'Ids': [outs[k]]},
+            outputs={'Out': [ev]},
+            attrs={'embedding_dim': 2, 'init_range': 0.0, 'seed': k})
+    merged = block.create_var(name='merged', shape=[-1, 2], dtype='float32')
+    block.append_op(type='merge_ids',
+                    inputs={'Ids': [ids_var], 'Rows': outs, 'X': emb_outs},
+                    outputs={'Out': [merged]}, attrs={})
+
+    scope = fluid.core.Scope()
+    ids = np.array([[5], [2], [9], [5], [0]], 'int64')
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        shards = exe.run(prog, feed={'Ids': ids},
+                         fetch_list=['shard_0', 'shard_1', 'shard_2',
+                                     'merged'],
+                         return_numpy=False)
+    s0, s1, s2 = [np.asarray(s.numpy()).reshape(-1) for s in shards[:3]]
+    assert sorted(s0.tolist()) == [0, 9]   # ids % 3 == 0
+    assert sorted(s1.tolist()) == []       # none
+    assert sorted(s2.tolist()) == [2, 5]   # ids % 3 == 2
+    merged_v = shards[3].numpy()
+    assert merged_v.shape == (5, 2)  # reassembled in original order
+    # init_range=0 -> all-zero rows; merely check order-preserving shape
+
+
+def test_split_selected_rows():
+    prog = fluid.Program()
+    block = prog.global_block()
+    sr = fluid.core.SelectedRows(rows=[1, 4, 7], height=9)
+    sr.get_tensor().set(np.arange(6, dtype='float32').reshape(3, 2))
+    x = block.create_var(name='X', shape=[-1, 2], dtype='float32')
+    outs = [block.create_var(name='out_%d' % k, shape=[-1, 2],
+                             dtype='float32') for k in range(2)]
+    block.append_op(type='split_selected_rows', inputs={'X': [x]},
+                    outputs={'Out': outs},
+                    attrs={'height_sections': [5, 4]})
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        a, b = exe.run(prog, feed={'X': sr}, fetch_list=['out_0', 'out_1'],
+                       return_numpy=False)
+    assert a.rows() == [1, 4] and a.height() == 5
+    assert b.rows() == [2] and b.height() == 4  # 7 - 5
+    np.testing.assert_array_equal(b.get_tensor().numpy(),
+                                  [[4.0, 5.0]])
+
+
+def test_sparse_table_apply_grad():
+    prog = fluid.Program()
+    block = prog.global_block()
+    w = block.create_var(name='tbl', shape=[-1], dtype='float32',
+                         persistable=True,
+                         type=fluid.core.VarDesc.VarType.SELECTED_ROWS)
+    ids = block.create_var(name='Ids', shape=[-1, 1], dtype='int64')
+    out = block.create_var(name='Out', shape=[-1, 2], dtype='float32')
+    block.append_op(type='lookup_sparse_table',
+                    inputs={'W': [w], 'Ids': [ids]},
+                    outputs={'Out': [out]},
+                    attrs={'embedding_dim': 2, 'init_range': 0.0})
+    g = block.create_var(name='G', shape=[-1, 2], dtype='float32')
+    lr = block.create_var(name='LR', shape=[1], dtype='float32')
+    block.append_op(type='sparse_table_apply_grad',
+                    inputs={'W': [w], 'Grad': [g], 'LearningRate': [lr]},
+                    outputs={}, attrs={})
+    grad = fluid.core.SelectedRows(rows=[3, 8], height=100)
+    grad.get_tensor().set(np.ones((2, 2), 'float32'))
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(prog,
+                feed={'Ids': np.array([[3], [8]], 'int64'), 'G': grad,
+                      'LR': np.array([0.5], 'float32')},
+                fetch_list=[])
+        table = scope.find_var('tbl').value()
+    np.testing.assert_allclose(table[3], [-0.5, -0.5])
+    np.testing.assert_allclose(table[8], [-0.5, -0.5])
+
+
+def test_spmd_row_sharded_embedding():
+    """CTR embedding table row-sharded over an 'mp' mesh axis: the SPMD
+    executor lays the table out over devices and GSPMD inserts the gather/
+    scatter collectives (the TPU-native replacement for the distributed
+    lookup table, SURVEY §2.5 sparse row)."""
+    from paddle_tpu import parallel
+    from paddle_tpu.models import ctr as ctr_model
+    from paddle_tpu.dataset import ctr as ctr_data
+    import jax
+
+    mesh = parallel.make_mesh({'dp': 4, 'mp': 2}, jax.devices()[:8])
+    m = ctr_model.build(is_sparse=False,
+                        optimizer=fluid.optimizer.SGD(learning_rate=0.05))
+    emb = m['main'].global_block().var('ctr_embedding')
+    parallel.shard(emb, 'mp', None)  # rows over 'mp'
+
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(m['startup'])
+        pe = fluid.ParallelExecutor(
+            loss_name=m['loss'].name, main_program=m['main'], scope=scope,
+            mesh=mesh)
+        losses = []
+        batch = []
+        for sample in ctr_data.train(n=1024)():
+            batch.append(sample)
+            if len(batch) == 64:
+                lv, = pe.run(
+                    [m['loss'].name],
+                    feed={'dense': np.stack([b[0] for b in batch]),
+                          'sparse_ids': np.stack([b[1] for b in batch]),
+                          'label': np.array([[b[2]] for b in batch],
+                                            'int64')})
+                losses.append(float(np.asarray(lv).flatten()[0]))
+                batch = []
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
