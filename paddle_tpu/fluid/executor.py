@@ -291,6 +291,10 @@ class _CompiledBlock(object):
 
         fetch_names_ = self.fetch_names
         state_out_ = state_out
+        # filled by _SpmdCompiledBlock before its first trace; consulted by
+        # mesh-aware lowerings (ring attention) at trace time
+        self._spmd_ref = {'mesh': None, 'batch_axis': None}
+        spmd_ref = self._spmd_ref
 
         def fn(state_rw, state_ro, feeds, rng):
             env = {}
@@ -298,7 +302,9 @@ class _CompiledBlock(object):
             env.update(state_ro)
             env.update(feeds)
             ctx = registry.LoweringContext(block, env, rng_key=rng,
-                                           place=place)
+                                           place=place,
+                                           mesh=spmd_ref['mesh'],
+                                           batch_axis=spmd_ref['batch_axis'])
             for op in ops:
                 registry.run_op(ctx, op)
             new_state = {n: env[n] for n in state_out_ if n in env}

@@ -27,7 +27,7 @@ __all__ = [
     'sigmoid_cross_entropy_with_logits', 'smooth_l1', 'log_loss', 'maxout',
     'prelu', 'leaky_relu', 'soft_relu', 'flatten', 'random_crop', 'im2sequence',
     'hsigmoid', 'nce', 'multiplex', 'dropout', 'layer_norm', 'lstm_unit',
-    'linear_chain_crf', 'crf_decoding', 'cos_sim',
+    'linear_chain_crf', 'crf_decoding', 'cos_sim', 'flash_attention',
 ]
 
 
@@ -1403,4 +1403,47 @@ def cos_sim(X, Y):
         outputs={'Out': [out],
                  'XNorm': [xnorm],
                  'YNorm': [ynorm]})
+    return out
+
+
+def flash_attention(q, k, v, num_heads=None, causal=False, scale=None,
+                    impl='auto', sp_axis='sp', name=None):
+    """Fused scaled-dot-product attention (TPU-native extension).
+
+    The reference builds attention out of matmul/softmax primitives
+    (nets.py scaled_dot_product_attention) with no sequence parallelism;
+    here ONE op lowers to ring attention over a context-parallel 'sp' mesh
+    axis, a Pallas flash kernel on a single TPU chip, or dense XLA —
+    see ops/attention_ops.py.
+
+    q, k, v: [batch, seq, heads, head_dim] Variables, or
+             [batch, seq, heads*head_dim] with num_heads given.
+    impl: 'auto' | 'ring' | 'ulysses' | 'pallas' | 'dense'.
+    Returns a Variable with q's shape.
+    """
+    helper = LayerHelper('flash_attention', **locals())
+    squeeze_back = False
+    if len(q.shape) == 3:
+        if not num_heads:
+            raise ValueError('3-D q/k/v need num_heads to split the fused '
+                             'head dim')
+        squeeze_back = True
+        hidden = q.shape[-1]
+        q = reshape(q, [0, 0, num_heads, hidden // num_heads])
+        k = reshape(k, [0, 0, num_heads, k.shape[-1] // num_heads])
+        v = reshape(v, [0, 0, num_heads, v.shape[-1] // num_heads])
+    out = helper.create_variable_for_type_inference(q.dtype)
+    out.shape = tuple(q.shape)
+    helper.append_op(
+        type='flash_attention',
+        inputs={'Q': [q], 'K': [k], 'V': [v]},
+        outputs={'Out': [out]},
+        attrs={
+            'causal': bool(causal),
+            'scale': float(scale) if scale else -1.0,
+            'impl': impl,
+            'sp_axis': sp_axis,
+        })
+    if squeeze_back:
+        out = reshape(out, [0, 0, hidden])
     return out

@@ -61,6 +61,9 @@ class _SpmdCompiledBlock(_CompiledBlock):
         super(_SpmdCompiledBlock, self).__init__(
             program, block_idx, feed_names, fetch_names, place, scope)
         self.mesh = mesh
+        # expose to mesh-aware lowerings (ring attention) at trace time
+        self._spmd_ref['mesh'] = mesh
+        self._spmd_ref['batch_axis'] = batch_axis
         self.batch_axis = batch_axis
         from ..parallel.api import sharding_of
 
@@ -76,7 +79,9 @@ class _SpmdCompiledBlock(_CompiledBlock):
             v = self.block._find_var_recursive(n)
             spec = sharding_of(v)
             if spec is None:
-                spec = P(batch_axis)  # shard batch dim over data parallel
+                # shard batch dim over data parallel when the mesh has it
+                spec = P(batch_axis) if batch_axis in mesh.axis_names \
+                    else P()
             feed_shardings[n] = NamedSharding(mesh, spec)
         out_state_shardings = {
             n: var_sharding(n)

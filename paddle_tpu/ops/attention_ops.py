@@ -1,0 +1,94 @@
+"""Fused scaled-dot-product attention op with context-parallel lowering.
+
+The reference composes attention from primitive ops (matmul + softmax +
+dropout, python/paddle/fluid/nets.py scaled_dot_product_attention) and has
+no sequence parallelism (SURVEY §5.7).  TPU-natively attention is the hot
+op of every transformer, so it gets ONE op whose lowering picks the best
+implementation for where it runs:
+
+- SPMD executor with an 'sp' (sequence/context parallel) mesh axis:
+  **ring attention** (K/V blocks rotate on ICI neighbor links) or
+  **Ulysses** all-to-all head resharding, per the ``impl`` attr;
+- single device on TPU: Pallas flash-attention kernel (VMEM-blocked online
+  softmax — never materialises the [L, L] score matrix in HBM);
+- otherwise: dense XLA attention.
+
+Layout: Q, K, V are [batch, seq, heads, head_dim].  Variable-length
+batches feed through the LoD sideband (``@SEQLEN``) and mask K/V columns
+past each row's length, matching LoD semantics on static shapes.
+"""
+
+import jax.numpy as jnp
+
+from . import registry
+from .registry import register_lowering
+
+
+def _pick_impl(ctx, op):
+    impl = op.attrs.get('impl', 'auto')
+    mesh = ctx.mesh
+    sp = op.attrs.get('sp_axis', 'sp')
+    has_sp = (mesh is not None and sp in getattr(mesh, 'axis_names', ())
+              and mesh.shape[sp] > 1)
+    if impl == 'auto':
+        if has_sp:
+            return 'ring'
+        try:
+            on_tpu = (ctx.place is not None and
+                      ctx.place.jax_device().platform != 'cpu')
+        except Exception:
+            on_tpu = False
+        if on_tpu:
+            return 'pallas'
+        return 'dense'
+    if impl in ('ring', 'ulysses') and not has_sp:
+        return 'dense'
+    return impl
+
+
+@register_lowering('flash_attention')
+def flash_attention_lowering(ctx, op):
+    from ..parallel import context_parallel as cp
+    q = ctx.get(op, 'Q')
+    k = ctx.get(op, 'K')
+    v = ctx.get(op, 'V')
+    causal = bool(op.attrs.get('causal', False))
+    scale = op.attrs.get('scale', None)
+    if scale is not None and scale <= 0:
+        scale = None
+    # LoD sideband: lengths of the K/V sequences (same var fed as LoD)
+    lens = None
+    for slot in ('K', 'Q'):
+        names = op.input(slot)
+        if names and ctx.has(names[0] + registry.SEQLEN_SUFFIX):
+            lens = ctx.lookup(names[0] + registry.SEQLEN_SUFFIX)
+            break
+    impl = _pick_impl(ctx, op)
+    if impl in ('ring', 'ulysses'):
+        sp = op.attrs.get('sp_axis', 'sp')
+        mesh = ctx.mesh
+        batch_axis = ctx.batch_axis
+        if batch_axis not in mesh.axis_names or mesh.shape[batch_axis] <= 1:
+            batch_axis = None
+        fn = cp.ring_attention if impl == 'ring' else cp.ulysses_attention
+        out = fn(q, k, v, mesh, axis=sp, causal=causal, scale=scale,
+                 seq_lengths=lens, batch_axis=batch_axis)
+    elif impl == 'pallas':
+        try:
+            from .pallas import flash_attention as pl_fa
+        except ImportError:
+            pl_fa = None
+        if pl_fa is None:
+            import warnings
+            warnings.warn('flash_attention: Pallas kernel unavailable, '
+                          'falling back to dense XLA attention '
+                          '(materialises the [L, L] score matrix)')
+            out = cp.dense_attention(q, k, v, causal=causal, scale=scale,
+                                     seq_lengths=lens)
+        else:
+            out = pl_fa.flash_attention(q, k, v, causal=causal, scale=scale,
+                                        seq_lengths=lens)
+    else:
+        out = cp.dense_attention(q, k, v, causal=causal, scale=scale,
+                                 seq_lengths=lens)
+    ctx.set(op, 'Out', out.astype(q.dtype))

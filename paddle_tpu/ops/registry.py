@@ -87,12 +87,19 @@ class LoweringContext(object):
     compiled functions stay pure.
     """
 
-    def __init__(self, block, env, rng_key=None, is_test=False, place=None):
+    def __init__(self, block, env, rng_key=None, is_test=False, place=None,
+                 mesh=None, batch_axis=None):
         self.block = block
         self.env = env
         self._rng = rng_key
         self.is_test = is_test
         self.place = place
+        # the SPMD executor's device mesh (None single-device) and the mesh
+        # axis the batch dim is sharded over: lowerings with a sharded
+        # implementation (ring attention over 'sp') consult these at trace
+        # time
+        self.mesh = mesh
+        self.batch_axis = batch_axis
 
     # ---- value access ----
     def get(self, op, slot, default=None):
@@ -140,7 +147,9 @@ class LoweringContext(object):
             env if env is not None else self.env,
             rng_key=None,
             is_test=self.is_test,
-            place=self.place)
+            place=self.place,
+            mesh=self.mesh,
+            batch_axis=self.batch_axis)
 
 
 SEQLEN_SUFFIX = '@SEQLEN'

@@ -15,8 +15,11 @@ import numpy as np
 
 from .mesh import make_mesh, mesh_axes, DeviceMesh
 from .api import shard, sharding_of, PartitionSpec
+from .context_parallel import (ring_attention, ulysses_attention,
+                               dense_attention)
 
 __all__ = [
     'make_mesh', 'mesh_axes', 'DeviceMesh', 'shard', 'sharding_of',
-    'PartitionSpec',
+    'PartitionSpec', 'ring_attention', 'ulysses_attention',
+    'dense_attention',
 ]

@@ -1,0 +1,195 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference (2018) has no sequence parallelism — long sequences are
+handled by LoD + DynamicRNN (SURVEY §5.7).  A TPU-native framework must
+scale attention past one chip's HBM, so context parallelism is first-class
+here:
+
+- **Ring attention** (`ring_attention`): Q stays put, K/V blocks rotate
+  around the 'sp' mesh axis via `lax.ppermute` while each step folds its
+  block into a blockwise online softmax (running max / running sum), so no
+  device ever materialises the full [L, L] score matrix or the full K/V.
+  Collectives ride ICI neighbor links — the cheapest possible pattern.
+- **Ulysses** (`ulysses_attention`): two `lax.all_to_all`s reshard
+  [B, L/n, H, D] -> [B, L, H/n, D] and back, computing full-sequence
+  attention per head shard.  Cheaper compute bookkeeping than the ring when
+  H is divisible by the axis size and L fits per-device after the gather of
+  scores is avoided per-head; costlier bandwidth (all-to-all vs neighbor).
+
+Both are pure-JAX, differentiable (reverse-mode AD transposes the
+ppermutes/all_to_alls), and compose with 'dp' batch sharding in the same
+`shard_map`.  Tensor layout: [batch, seq, heads, head_dim].
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ['ring_attention', 'ulysses_attention', 'dense_attention']
+
+_NEG_INF = -1e30
+
+
+def _attend_block(q, k, v, scale, mask):
+    """Scores for one (Q local, KV block) pair + masked blockwise softmax
+    pieces.  q: [B,Lq,H,D], k/v: [B,Lk,H,D], mask: [B,1,Lq,Lk] or None.
+    Returns (m, l, acc): running max [B,H,Lq], sum [B,H,Lq],
+    numerator [B,Lq,H,D]."""
+    s = jnp.einsum('bqhd,bkhd->bhqk', q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1)
+    # guard all-masked rows: exp(-inf - (-inf)) = nan
+    m_safe = jnp.maximum(m, _NEG_INF / 2)
+    p = jnp.exp(s - m_safe[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum('bhqk,bkhd->bqhd', p, v)
+    return m_safe, l, acc
+
+
+def _merge(m1, l1, acc1, m2, l2, acc2):
+    """Fold two blockwise-softmax partials into one (online softmax)."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    l = l1 * a1 + l2 * a2
+    # acc is [B,Lq,H,D]; alphas are [B,H,Lq] -> [B,Lq,H,1]
+    t1 = jnp.transpose(a1, (0, 2, 1))[..., None]
+    t2 = jnp.transpose(a2, (0, 2, 1))[..., None]
+    return m, l, acc1 * t1 + acc2 * t2
+
+
+def _block_mask(q_pos, k_pos, causal, batch_lens):
+    """[B,1,Lq,Lk] boolean mask (True = attend) from global positions.
+    batch_lens: [B] valid K lengths (global) or None."""
+    mask = None
+    if causal:
+        mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+    if batch_lens is not None:
+        valid = k_pos[None, :] < batch_lens[:, None]  # [B, Lk]
+        valid = valid[:, None, None, :]  # [B,1,1,Lk]
+        mask = valid if mask is None else jnp.logical_and(mask, valid)
+    if mask is not None:
+        mask = jnp.broadcast_to(
+            mask, (mask.shape[0], 1, q_pos.shape[0], k_pos.shape[0]))
+    return mask
+
+
+def dense_attention(q, k, v, causal=False, scale=None, seq_lengths=None):
+    """Single-device reference: softmax(QK^T * scale [+mask]) V.
+    q,k,v: [B,L,H,D]; seq_lengths: [B] optional valid K/V lengths."""
+    scale = scale if scale is not None else q.shape[-1]**-0.5
+    lq, lk = q.shape[1], k.shape[1]
+    mask = _block_mask(
+        jnp.arange(lq), jnp.arange(lk), causal,
+        None if seq_lengths is None else jnp.asarray(seq_lengths))
+    m, l, acc = _attend_block(q, k, v, scale, mask)
+    l = jnp.transpose(l, (0, 2, 1))[..., None]
+    return acc / jnp.maximum(l, 1e-20)
+
+
+def _ring_local(q, k, v, lens, axis_name, n_steps, causal, scale):
+    """Per-shard ring attention body (runs under shard_map).
+
+    q,k,v: local [B, Lc, H, D] chunks of the 'sp'-sharded sequence;
+    lens: [B] global valid lengths or None."""
+    idx = jax.lax.axis_index(axis_name)
+    b, lq, h, d = q.shape
+    lkv = k.shape[1]  # cross-attention: K/V chunk length may differ from Q's
+    q_pos = idx * lq + jnp.arange(lq)
+
+    m0 = jnp.full((b, h, lq), _NEG_INF / 2, q.dtype)
+    l0 = jnp.zeros((b, h, lq), q.dtype)
+    acc0 = jnp.zeros_like(q)
+
+    def step(carry, t):
+        k_blk, v_blk, m, l, acc = carry
+        # block held at step t originated on device (idx - t) mod n
+        src = (idx - t) % n_steps
+        k_pos = src * lkv + jnp.arange(lkv)
+        mask = _block_mask(q_pos, k_pos, causal, lens)
+        bm, bl, bacc = _attend_block(q, k_blk, v_blk, scale, mask)
+        m, l, acc = _merge(m, l, acc, bm, bl, bacc)
+        perm = [(i, (i + 1) % n_steps) for i in range(n_steps)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, m, l, acc), None
+
+    (k, v, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(n_steps))
+    l = jnp.transpose(l, (0, 2, 1))[..., None]  # [B,Lc,H,1]
+    return acc / jnp.maximum(l, 1e-20)
+
+
+def ring_attention(q, k, v, mesh, axis='sp', causal=False, scale=None,
+                   seq_lengths=None, batch_axis=None):
+    """Ring attention over the ``axis`` mesh dimension.
+
+    q,k,v: [B, L, H, D] with L divisible by the axis size (global view —
+    under jit the arrays may already be sharded; shard_map just binds the
+    per-device view).  batch_axis: mesh axis B is sharded over ('dp') or
+    None.  Returns [B, L, H, D].
+    """
+    scale = scale if scale is not None else q.shape[-1]**-0.5
+    n = mesh.shape[axis]
+    body = functools.partial(_ring_local, axis_name=axis, n_steps=n,
+                             causal=causal, scale=scale)
+    return _sharded_call(body, q, k, v, seq_lengths, mesh, axis, batch_axis)
+
+
+def _ulysses_local(q, k, v, lens, axis_name, n, causal, scale):
+    """Per-shard Ulysses body: all_to_all seq->head reshard, dense local
+    attention over the FULL sequence for H/n heads, reshard back.
+
+    tiled all_to_all: [B, L/n, H, D] -(split H, concat L)-> [B, L, H/n, D];
+    device j keeps head group j, receives every device's sequence chunk in
+    ring order so the concatenated L axis is the global sequence."""
+
+    def seq_to_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = dense_attention(q, k, v, causal=causal, scale=scale,
+                          seq_lengths=lens)
+    return heads_to_seq(out)
+
+
+def ulysses_attention(q, k, v, mesh, axis='sp', causal=False, scale=None,
+                      seq_lengths=None, batch_axis=None):
+    """DeepSpeed-Ulysses-style attention: two all-to-alls swap the sharded
+    dimension from sequence to heads so each device runs full-sequence
+    attention on H/n heads.  Requires H % axis_size == 0."""
+    scale = scale if scale is not None else q.shape[-1]**-0.5
+    n = mesh.shape[axis]
+    if q.shape[2] % n:
+        raise ValueError('ulysses needs heads (%d) divisible by %s=%d' %
+                         (q.shape[2], axis, n))
+    body = functools.partial(_ulysses_local, axis_name=axis, n=n,
+                             causal=causal, scale=scale)
+    return _sharded_call(body, q, k, v, seq_lengths, mesh, axis, batch_axis)
+
+
+def _sharded_call(body, q, k, v, seq_lengths, mesh, axis, batch_axis):
+    """shard_map a local attention body over (sp [, dp]) with optional
+    replicated-over-sp per-batch lengths."""
+    qkv_spec = P(batch_axis, axis, None, None)
+    if seq_lengths is None:
+        return jax.shard_map(
+            lambda a, b, c: body(a, b, c, None),
+            mesh=mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec),
+            out_specs=qkv_spec, check_vma=False)(q, k, v)
+    return jax.shard_map(
+        lambda a, b, c, sl: body(a, b, c, sl),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, P(batch_axis)),
+        out_specs=qkv_spec, check_vma=False)(
+            q, k, v, jnp.asarray(seq_lengths, jnp.int32))
