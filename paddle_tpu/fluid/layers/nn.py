@@ -1428,12 +1428,12 @@ def flash_attention(q, k, v, num_heads=None, causal=False, scale=None,
             raise ValueError('3-D q/k/v need num_heads to split the fused '
                              'head dim')
         squeeze_back = True
-        hidden = q.shape[-1]
-        q = reshape(q, [0, 0, num_heads, hidden // num_heads])
+        q = reshape(q, [0, 0, num_heads, q.shape[-1] // num_heads])
         k = reshape(k, [0, 0, num_heads, k.shape[-1] // num_heads])
         v = reshape(v, [0, 0, num_heads, v.shape[-1] // num_heads])
     out = helper.create_variable_for_type_inference(q.dtype)
-    out.shape = tuple(q.shape)
+    # attention output carries V's head_dim (may differ from Q's)
+    out.shape = tuple(q.shape[:-1]) + (v.shape[-1], )
     helper.append_op(
         type='flash_attention',
         inputs={'Q': [q], 'K': [k], 'V': [v]},
@@ -1445,5 +1445,5 @@ def flash_attention(q, k, v, num_heads=None, causal=False, scale=None,
             'sp_axis': sp_axis,
         })
     if squeeze_back:
-        out = reshape(out, [0, 0, hidden])
+        out = reshape(out, [0, 0, int(num_heads) * int(v.shape[-1])])
     return out

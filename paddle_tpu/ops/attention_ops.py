@@ -18,8 +18,6 @@ batches feed through the LoD sideband (``@SEQLEN``) and mask K/V columns
 past each row's length, matching LoD semantics on static shapes.
 """
 
-import jax.numpy as jnp
-
 from . import registry
 from .registry import register_lowering
 
@@ -42,6 +40,12 @@ def _pick_impl(ctx, op):
             return 'pallas'
         return 'dense'
     if impl in ('ring', 'ulysses') and not has_sp:
+        import warnings
+        warnings.warn(
+            'flash_attention: impl=%r requested but the executor mesh has '
+            'no %r axis (mesh=%s) — falling back to dense XLA attention, '
+            'which materialises the full [L, L] score matrix' %
+            (impl, sp, None if mesh is None else dict(mesh.shape)))
         return 'dense'
     return impl
 
@@ -56,13 +60,13 @@ def flash_attention_lowering(ctx, op):
     scale = op.attrs.get('scale', None)
     if scale is not None and scale <= 0:
         scale = None
-    # LoD sideband: lengths of the K/V sequences (same var fed as LoD)
+    # LoD sideband: valid lengths of the K/V sequences.  Only K's own
+    # sideband applies — Q's lengths describe the query sequence and must
+    # NOT mask encoder memory in cross-attention
     lens = None
-    for slot in ('K', 'Q'):
-        names = op.input(slot)
-        if names and ctx.has(names[0] + registry.SEQLEN_SUFFIX):
-            lens = ctx.lookup(names[0] + registry.SEQLEN_SUFFIX)
-            break
+    names = op.input('K')
+    if names and ctx.has(names[0] + registry.SEQLEN_SUFFIX):
+        lens = ctx.lookup(names[0] + registry.SEQLEN_SUFFIX)
     impl = _pick_impl(ctx, op)
     if impl in ('ring', 'ulysses'):
         sp = op.attrs.get('sp_axis', 'sp')

@@ -104,7 +104,7 @@ def _ring_local(q, k, v, lens, axis_name, n_steps, causal, scale):
 
     m0 = jnp.full((b, h, lq), _NEG_INF / 2, q.dtype)
     l0 = jnp.zeros((b, h, lq), q.dtype)
-    acc0 = jnp.zeros_like(q)
+    acc0 = jnp.zeros((b, lq, h, v.shape[-1]), q.dtype)
 
     def step(carry, t):
         k_blk, v_blk, m, l, acc = carry
