@@ -16,6 +16,8 @@ from . import control_flow
 from .control_flow import *
 from . import learning_rate_scheduler
 from .learning_rate_scheduler import *
+from . import detection
+from .detection import *
 from . import math_op_patch  # installs Variable operator overloads
 
 __all__ = []
@@ -27,3 +29,4 @@ __all__ += metric_op.__all__
 __all__ += sequence.__all__
 __all__ += control_flow.__all__
 __all__ += learning_rate_scheduler.__all__
+__all__ += detection.__all__

@@ -82,11 +82,15 @@ def flash_attention_lowering(ctx, op):
             from .pallas import flash_attention as pl_fa
         except ImportError:
             pl_fa = None
+        if pl_fa is not None and v.shape[-1] != q.shape[-1]:
+            # the Pallas kernel tiles one head_dim for Q/K/V; mixed
+            # Dv != Dq cross-attention runs on the dense path instead
+            pl_fa = None
         if pl_fa is None:
             import warnings
-            warnings.warn('flash_attention: Pallas kernel unavailable, '
-                          'falling back to dense XLA attention '
-                          '(materialises the [L, L] score matrix)')
+            warnings.warn('flash_attention: Pallas kernel unavailable or '
+                          'shapes unsupported, falling back to dense XLA '
+                          'attention (materialises the [L, L] score matrix)')
             out = cp.dense_attention(q, k, v, causal=causal, scale=scale,
                                      seq_lengths=lens)
         else:

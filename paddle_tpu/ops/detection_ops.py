@@ -1,0 +1,793 @@
+"""Detection op lowerings (reference: paddle/fluid/operators/detection/).
+
+TPU-first design notes:
+
+* ``prior_box`` / ``anchor_generator`` / ``box_coder`` / ``iou_similarity`` /
+  ``polygon_box_transform`` are pure static-shape math and lower straight into
+  the XLA trace (reference files: prior_box_op.cc, anchor_generator_op.cc,
+  box_coder_op.{cc,h}, iou_similarity_op.{cc,h}, polygon_box_transform_op.cc).
+* ``bipartite_match`` / ``target_assign`` / ``mine_hard_examples`` are
+  CPU-only kernels in the reference (bipartite_match_op.cc:15 registers CPU
+  only); here they are compiled lowerings over *batched, padded* inputs:
+  ground-truth LoD rows become a dense (B, G, ...) tensor with a per-instance
+  valid count side-band (``@SEQLEN``, SURVEY §5.7), and the greedy match runs
+  as a ``lax.fori_loop`` so the whole SSD loss stays on-device.
+* ``multiclass_nms`` and ``detection_map`` keep the reference's host
+  placement (CPU-only kernels with variable-size LoD outputs:
+  multiclass_nms_op.cc, detection_map_op.cc) and run as host ops.
+* ``ssd_loss`` additionally exists as ONE fused lowering: on TPU the
+  match/assign/mine pipeline is fused into the loss computation instead of
+  materializing LoD index lists (layers/detection.py ssd_loss composes the
+  same steps op-by-op in the reference).
+"""
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import (register_lowering, register_host_op, SEQLEN_SUFFIX)
+
+
+# ---------------------------------------------------------------------------
+# pure static-shape geometry ops
+# ---------------------------------------------------------------------------
+
+
+def _expand_aspect_ratios(aspect_ratios, flip):
+    """ExpandAspectRatios (reference prior_box_op.h): dedup, keep 1.0 first,
+    optionally add reciprocals."""
+    out = [1.0]
+    for ar in aspect_ratios:
+        exists = any(abs(ar - o) < 1e-6 for o in out)
+        if not exists:
+            out.append(float(ar))
+            if flip:
+                out.append(1.0 / float(ar))
+    return out
+
+
+@register_lowering('prior_box')
+def _prior_box(ctx, op):
+    x = ctx.get(op, 'Input')  # (N, C, H, W) feature map
+    image = ctx.get(op, 'Image')  # (N, C, Him, Wim)
+    min_sizes = [float(s) for s in op.attrs['min_sizes']]
+    max_sizes = [float(s) for s in op.attrs.get('max_sizes', []) or []]
+    aspect_ratios = op.attrs.get('aspect_ratios', [1.0]) or [1.0]
+    variances = op.attrs.get('variances', [0.1, 0.1, 0.2, 0.2])
+    flip = op.attrs.get('flip', False)
+    clip = op.attrs.get('clip', False)
+    step_w = float(op.attrs.get('step_w', 0.0) or 0.0)
+    step_h = float(op.attrs.get('step_h', 0.0) or 0.0)
+    offset = float(op.attrs.get('offset', 0.5))
+
+    feat_h, feat_w = int(x.shape[2]), int(x.shape[3])
+    img_h, img_w = int(image.shape[2]), int(image.shape[3])
+    if step_w == 0.0:
+        step_w = float(img_w) / feat_w
+    if step_h == 0.0:
+        step_h = float(img_h) / feat_h
+
+    ars = _expand_aspect_ratios(aspect_ratios, flip)
+    # per-cell (w, h) box sizes in pixels, reference iteration order
+    # (prior_box_op.h: min box, then sqrt(min*max) box, then ar != 1 boxes)
+    whs = []
+    for k, ms in enumerate(min_sizes):
+        whs.append((ms, ms))
+        if max_sizes:
+            s = math.sqrt(ms * max_sizes[k])
+            whs.append((s, s))
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+    num_priors = len(whs)
+
+    cx = (jnp.arange(feat_w, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(feat_h, dtype=jnp.float32) + offset) * step_h
+    cx = jnp.broadcast_to(cx[None, :, None], (feat_h, feat_w, num_priors))
+    cy = jnp.broadcast_to(cy[:, None, None], (feat_h, feat_w, num_priors))
+    bw = jnp.asarray([w / 2.0 for w, _ in whs], jnp.float32)
+    bh = jnp.asarray([h / 2.0 for _, h in whs], jnp.float32)
+    boxes = jnp.stack(
+        [(cx - bw) / img_w, (cy - bh) / img_h, (cx + bw) / img_w,
+         (cy + bh) / img_h],
+        axis=-1)  # (H, W, P, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), boxes.shape)
+    ctx.set(op, 'Boxes', boxes)
+    ctx.set(op, 'Variances', var)
+
+
+@register_lowering('anchor_generator')
+def _anchor_generator(ctx, op):
+    """Unnormalized RPN anchors (reference anchor_generator_op.h): for each
+    aspect ratio r and size s: area = stride_w*stride_h, w0 = sqrt(area/r),
+    anchor half-sizes scaled by s/stride."""
+    x = ctx.get(op, 'Input')
+    anchor_sizes = [float(s) for s in op.attrs['anchor_sizes']]
+    aspect_ratios = [float(a) for a in op.attrs['aspect_ratios']]
+    variances = op.attrs.get('variances', [0.1, 0.1, 0.2, 0.2])
+    stride = [float(s) for s in op.attrs['stride']]
+    offset = float(op.attrs.get('offset', 0.5))
+    feat_h, feat_w = int(x.shape[2]), int(x.shape[3])
+    stride_w, stride_h = stride[0], stride[1]
+
+    whs = []
+    for ar in aspect_ratios:
+        area = stride_w * stride_h
+        base_w = round(math.sqrt(area / ar))
+        base_h = round(base_w * ar)
+        for s in anchor_sizes:
+            scale_w = s / stride_w
+            scale_h = s / stride_h
+            whs.append((scale_w * base_w, scale_h * base_h))
+    num_anchors = len(whs)
+
+    cx = (jnp.arange(feat_w, dtype=jnp.float32) + offset) * stride_w
+    cy = (jnp.arange(feat_h, dtype=jnp.float32) + offset) * stride_h
+    cx = jnp.broadcast_to(cx[None, :, None], (feat_h, feat_w, num_anchors))
+    cy = jnp.broadcast_to(cy[:, None, None], (feat_h, feat_w, num_anchors))
+    hw = jnp.asarray([w / 2.0 for w, _ in whs], jnp.float32)
+    hh = jnp.asarray([h / 2.0 for _, h in whs], jnp.float32)
+    anchors = jnp.stack([cx - hw, cy - hh, cx + hw, cy + hh], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), anchors.shape)
+    ctx.set(op, 'Anchors', anchors)
+    ctx.set(op, 'Variances', var)
+
+
+def _box_wh(box, normalized):
+    extra = 0.0 if normalized else 1.0
+    w = box[..., 2] - box[..., 0] + extra
+    h = box[..., 3] - box[..., 1] + extra
+    return w, h
+
+
+@register_lowering('box_coder')
+def _box_coder(ctx, op):
+    prior = ctx.get(op, 'PriorBox')  # (M, 4)
+    prior_var = ctx.get(op, 'PriorBoxVar')  # optional (M, 4)
+    target = ctx.get(op, 'TargetBox')
+    code_type = op.attrs.get('code_type', 'encode_center_size')
+    normalized = op.attrs.get('box_normalized', True)
+
+    pw, ph = _box_wh(prior, normalized)
+    pcx = (prior[..., 2] + prior[..., 0]) / 2.0
+    pcy = (prior[..., 3] + prior[..., 1]) / 2.0
+
+    if code_type == 'encode_center_size':
+        # target (N, 4) x prior (M, 4) -> (N, M, 4)  (box_coder_op.h
+        # EncodeCenterSize)
+        tw, th = _box_wh(target, normalized)
+        tcx = (target[..., 2] + target[..., 0]) / 2.0
+        tcy = (target[..., 3] + target[..., 1]) / 2.0
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        oh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+        if prior_var is not None:
+            out = out / prior_var[None, :, :]
+    else:
+        # decode: target (N, M, 4) against prior (M, 4) (DecodeCenterSize)
+        if target.ndim == 2:
+            target = target[None, :, :]
+        t = target
+        if prior_var is not None:
+            t = t * prior_var[None, :, :]
+        w = jnp.exp(t[..., 2]) * pw[None, :]
+        h = jnp.exp(t[..., 3]) * ph[None, :]
+        cx = t[..., 0] * pw[None, :] + pcx[None, :]
+        cy = t[..., 1] * ph[None, :] + pcy[None, :]
+        extra = 0.0 if normalized else 1.0
+        out = jnp.stack(
+            [cx - w / 2.0, cy - h / 2.0, cx + w / 2.0 - extra,
+             cy + h / 2.0 - extra],
+            axis=-1)
+    ctx.set(op, 'OutputBox', out)
+
+
+def _iou_matrix(x, y, normalized=True):
+    """Pairwise IoU (reference iou_similarity_op.h IOUSimilarityFunctor):
+    x (..., N, 4), y (M, 4) -> (..., N, M)."""
+    extra = 0.0 if normalized else 1.0
+    area_x = (x[..., 2] - x[..., 0] + extra) * (x[..., 3] - x[..., 1] + extra)
+    area_y = (y[..., 2] - y[..., 0] + extra) * (y[..., 3] - y[..., 1] + extra)
+    xmin = jnp.maximum(x[..., :, None, 0], y[..., None, :, 0])
+    ymin = jnp.maximum(x[..., :, None, 1], y[..., None, :, 1])
+    xmax = jnp.minimum(x[..., :, None, 2], y[..., None, :, 2])
+    ymax = jnp.minimum(x[..., :, None, 3], y[..., None, :, 3])
+    iw = jnp.maximum(xmax - xmin + extra, 0.0)
+    ih = jnp.maximum(ymax - ymin + extra, 0.0)
+    inter = iw * ih
+    union = area_x[..., :, None] + area_y[..., None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+@register_lowering('iou_similarity')
+def _iou_similarity(ctx, op):
+    x = ctx.get(op, 'X')  # (N, 4) or (B, G, 4) padded gt
+    y = ctx.get(op, 'Y')  # (M, 4)
+    ctx.set(op, 'Out', _iou_matrix(x, y))
+
+
+@register_lowering('polygon_box_transform')
+def _polygon_box_transform(ctx, op):
+    """(reference polygon_box_transform_op.cc): input (N, K*2, H, W) of
+    offsets; even channels add column index * 4, odd channels add row
+    index * 4 (EAST-style geometry maps)."""
+    x = ctx.get(op, 'Input')
+    n, c, h, w = x.shape
+    col = jnp.broadcast_to(jnp.arange(w, dtype=x.dtype)[None, :], (h, w))
+    row = jnp.broadcast_to(jnp.arange(h, dtype=x.dtype)[:, None], (h, w))
+    is_x = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+    base = jnp.where(is_x, col[None, None], row[None, None]) * 4.0
+    ctx.set(op, 'Output', base - x)
+
+
+# ---------------------------------------------------------------------------
+# matching / assignment / mining — compiled over batched padded gt
+# ---------------------------------------------------------------------------
+
+
+def _batched_gt(ctx, op, slot):
+    """Return (value, valid_counts) for a ground-truth style input: a padded
+    (B, G, ...) tensor plus per-instance valid row counts from the @SEQLEN
+    side-band (or all-G when absent).  2-D inputs get a singleton batch."""
+    names = op.input(slot)
+    val = ctx.get(op, slot)
+    if val is None:
+        return None, None
+    squeeze = val.ndim == 2 and names and (
+        names[0] + SEQLEN_SUFFIX) not in ctx.env
+    if squeeze:
+        val = val[None]
+    lens = None
+    if names and (names[0] + SEQLEN_SUFFIX) in ctx.env:
+        lens = ctx.env[names[0] + SEQLEN_SUFFIX]
+    if lens is None:
+        lens = jnp.full((val.shape[0], ), val.shape[1], jnp.int32)
+    return val, lens.astype(jnp.int32)
+
+
+def _bipartite_match_one(dist, valid_g, match_type, overlap_threshold):
+    """Greedy global-max bipartite matching on one (G, M) distance matrix
+    (reference bipartite_match_op.cc BipartiteMatch): repeatedly take the
+    largest remaining entry, bind its row+col, until rows are exhausted."""
+    g, m = dist.shape
+    row_valid = jnp.arange(g) < valid_g
+    masked = jnp.where(row_valid[:, None], dist, -jnp.inf)
+
+    def body(_, carry):
+        match_idx, match_dist, row_used, col_used = carry
+        cur = jnp.where(row_used[:, None] | col_used[None, :], -jnp.inf,
+                        masked)
+        flat = jnp.argmax(cur)
+        r, c = flat // m, flat % m
+        best = cur[r, c]
+        ok = jnp.isfinite(best)
+        match_idx = jnp.where(
+            ok, match_idx.at[c].set(r.astype(jnp.int32)), match_idx)
+        match_dist = jnp.where(ok, match_dist.at[c].set(best), match_dist)
+        row_used = jnp.where(ok, row_used.at[r].set(True), row_used)
+        col_used = jnp.where(ok, col_used.at[c].set(True), col_used)
+        return match_idx, match_dist, row_used, col_used
+
+    init = (jnp.full((m, ), -1, jnp.int32), jnp.zeros((m, ), dist.dtype),
+            jnp.zeros((g, ), bool), jnp.zeros((m, ), bool))
+    match_idx, match_dist, _, col_used = jax.lax.fori_loop(0, g, body, init)
+
+    if match_type == 'per_prediction':
+        # unmatched cols additionally match their argmax row when the
+        # distance clears the threshold (bipartite_match_op.cc:
+        # ArgMaxMatch)
+        best_row = jnp.argmax(masked, axis=0).astype(jnp.int32)
+        best_val = jnp.max(masked, axis=0)
+        extra = (~col_used) & (best_val >= overlap_threshold)
+        match_idx = jnp.where(extra, best_row, match_idx)
+        match_dist = jnp.where(extra, best_val, match_dist)
+    return match_idx, match_dist
+
+
+@register_lowering('bipartite_match')
+def _bipartite_match(ctx, op):
+    dist, lens = _batched_gt(ctx, op, 'DistMat')  # (B, G, M)
+    match_type = op.attrs.get('match_type', 'bipartite')
+    thr = float(op.attrs.get('dist_threshold', 0.5))
+    match_idx, match_dist = jax.vmap(
+        lambda d, l: _bipartite_match_one(d, l, match_type, thr))(dist, lens)
+    ctx.set(op, 'ColToRowMatchIndices', match_idx)
+    ctx.set(op, 'ColToRowMatchDist', match_dist)
+
+
+@register_lowering('target_assign')
+def _target_assign(ctx, op):
+    x, _ = _batched_gt(ctx, op, 'X')  # (B, G, K)
+    match = ctx.get(op, 'MatchIndices')  # (B, M) int32, -1 = unmatched
+    neg = ctx.get(op, 'NegIndices')  # optional (B, M) negative mask
+    mismatch_value = op.attrs.get('mismatch_value', 0)
+    b, m = match.shape
+    safe = jnp.maximum(match, 0)
+    gathered = jax.vmap(lambda xb, ib: xb[ib])(x, safe)  # (B, M, K)
+    matched = (match >= 0)[:, :, None]
+    out = jnp.where(matched, gathered,
+                    jnp.asarray(mismatch_value, x.dtype))
+    w = matched.astype(jnp.float32)
+    if neg is not None:
+        w = jnp.maximum(w, (neg > 0)[:, :, None].astype(jnp.float32))
+    ctx.set(op, 'Out', out)
+    ctx.set(op, 'OutWeight', w)
+
+
+def _mine_negatives(cls_loss, loc_loss, match, match_dist, neg_pos_ratio,
+                    neg_dist_threshold, sample_size, mining_type):
+    """max_negative mining (reference mine_hard_examples_op.cc): negatives
+    are unmatched priors with match overlap below neg_dist_threshold; keep
+    the top (neg_pos_ratio * num_pos) by confidence loss.  Returns a (B, M)
+    bool mask — the static-shape stand-in for the reference's NegIndices
+    LoD index list."""
+    loss = cls_loss
+    if mining_type == 'hard_example' and loc_loss is not None:
+        loss = cls_loss + loc_loss
+    is_neg_cand = (match < 0) & (match_dist < neg_dist_threshold)
+    num_pos = jnp.sum((match >= 0).astype(jnp.int32), axis=1)  # (B,)
+    num_neg = (num_pos.astype(jnp.float32) * neg_pos_ratio).astype(jnp.int32)
+    if sample_size:
+        num_neg = jnp.minimum(num_neg, sample_size)
+    masked_loss = jnp.where(is_neg_cand, loss, -jnp.inf)
+    # rank of each candidate by loss, descending; keep rank < num_neg
+    order = jnp.argsort(-masked_loss, axis=1)
+    ranks = jnp.argsort(order, axis=1)
+    keep = (ranks < num_neg[:, None]) & is_neg_cand
+    return keep
+
+
+@register_lowering('mine_hard_examples')
+def _mine_hard_examples(ctx, op):
+    cls_loss = ctx.get(op, 'ClsLoss')
+    loc_loss = ctx.get(op, 'LocLoss')
+    match = ctx.get(op, 'MatchIndices')
+    match_dist = ctx.get(op, 'MatchDist')
+    if cls_loss.ndim == 3:
+        cls_loss = cls_loss[..., 0]
+    if loc_loss is not None and loc_loss.ndim == 3:
+        loc_loss = loc_loss[..., 0]
+    neg_mask = _mine_negatives(
+        cls_loss, loc_loss, match, match_dist,
+        float(op.attrs.get('neg_pos_ratio', 1.0)),
+        float(op.attrs.get('neg_dist_threshold', 0.5)),
+        int(op.attrs.get('sample_size', 0)),
+        op.attrs.get('mining_type', 'max_negative'))
+    ctx.set(op, 'NegIndices', neg_mask.astype(jnp.int32))
+    ctx.set(op, 'UpdatedMatchIndices', match)
+
+
+@register_lowering('ssd_loss')
+def _ssd_loss(ctx, op):
+    """Fused SSD multibox loss — the whole match/assign/mine pipeline in one
+    XLA computation (reference composes it from 11 ops in
+    layers/detection.py ssd_loss:563; here fusion keeps every intermediate
+    in VMEM/registers and avoids LoD index materialization)."""
+    loc = ctx.get(op, 'Location')  # (B, M, 4)
+    conf = ctx.get(op, 'Confidence')  # (B, M, C)
+    gt_box, lens = _batched_gt(ctx, op, 'GtBox')  # (B, G, 4)
+    gt_label, _ = _batched_gt(ctx, op, 'GtLabel')  # (B, G, 1)
+    prior_box = ctx.get(op, 'PriorBox')  # (M, 4)
+    prior_var = ctx.get(op, 'PriorBoxVar')  # optional
+
+    a = op.attrs
+    background_label = int(a.get('background_label', 0))
+    overlap_threshold = float(a.get('overlap_threshold', 0.5))
+    neg_pos_ratio = float(a.get('neg_pos_ratio', 3.0))
+    neg_overlap = float(a.get('neg_overlap', 0.5))
+    loc_w = float(a.get('loc_loss_weight', 1.0))
+    conf_w = float(a.get('conf_loss_weight', 1.0))
+    match_type = a.get('match_type', 'per_prediction')
+    mining_type = a.get('mining_type', 'max_negative')
+    normalize = a.get('normalize', True)
+    sample_size = int(a.get('sample_size', 0) or 0)
+
+    if gt_label.ndim == 3:
+        gt_label = gt_label[..., 0]
+    gt_label = gt_label.astype(jnp.int32)
+    b, m = loc.shape[:2]
+    g = gt_box.shape[1]
+
+    # 1. match priors to gt by IoU
+    iou = _iou_matrix(gt_box, prior_box)  # (B, G, M)
+    match, match_dist = jax.vmap(
+        lambda d, l: _bipartite_match_one(d, l, match_type,
+                                          overlap_threshold))(iou, lens)
+
+    safe = jnp.maximum(match, 0)
+    matched = match >= 0
+
+    # 2. targets: conf label per prior, encoded loc offsets per prior
+    tgt_label = jnp.where(matched,
+                          jax.vmap(lambda lb, ib: lb[ib])(gt_label, safe),
+                          background_label)  # (B, M)
+    matched_box = jax.vmap(lambda bx, ib: bx[ib])(gt_box, safe)  # (B, M, 4)
+
+    pw, ph = _box_wh(prior_box, True)
+    pcx = (prior_box[:, 2] + prior_box[:, 0]) / 2.0
+    pcy = (prior_box[:, 3] + prior_box[:, 1]) / 2.0
+    tcx = (matched_box[..., 2] + matched_box[..., 0]) / 2.0
+    tcy = (matched_box[..., 3] + matched_box[..., 1]) / 2.0
+    tw = matched_box[..., 2] - matched_box[..., 0]
+    th = matched_box[..., 3] - matched_box[..., 1]
+    eps = 1e-10
+    enc = jnp.stack(
+        [(tcx - pcx[None]) / pw[None], (tcy - pcy[None]) / ph[None],
+         jnp.log(jnp.maximum(jnp.abs(tw / pw[None]), eps)),
+         jnp.log(jnp.maximum(jnp.abs(th / ph[None]), eps))],
+        axis=-1)  # (B, M, 4)
+    if prior_var is not None:
+        enc = enc / prior_var[None, :, :]
+
+    # 3. confidence loss (softmax CE) for mining + final loss
+    logp = jax.nn.log_softmax(conf, axis=-1)
+    conf_loss = -jnp.take_along_axis(
+        logp, tgt_label[..., None], axis=-1)[..., 0]  # (B, M)
+
+    # 4. hard negative mining
+    neg_mask = _mine_negatives(conf_loss, None, match, match_dist,
+                               neg_pos_ratio, neg_overlap, sample_size,
+                               mining_type)
+
+    # 5. localization smooth-L1 on positives
+    diff = loc - jax.lax.stop_gradient(enc)
+    abs_diff = jnp.abs(diff)
+    smooth = jnp.where(abs_diff < 1.0, 0.5 * diff * diff, abs_diff - 0.5)
+    loc_loss = jnp.sum(smooth, axis=-1) * matched.astype(loc.dtype)
+
+    conf_weight = (matched | neg_mask).astype(conf.dtype)
+    tgt_label = jax.lax.stop_gradient(tgt_label)
+    loss = (loc_w * loc_loss +
+            conf_w * conf_loss * conf_weight)  # (B, M)
+    if normalize:
+        num_pos = jnp.sum(matched.astype(loss.dtype))
+        loss = loss / jnp.maximum(num_pos, 1.0)
+        out = jnp.sum(loss, axis=1, keepdims=True)  # (B, 1)
+    else:
+        out = jnp.sum(loss, axis=1, keepdims=True)
+    ctx.set(op, 'Loss', out)
+
+
+# ---------------------------------------------------------------------------
+# host post-processing (CPU-only kernels in the reference, too)
+# ---------------------------------------------------------------------------
+
+
+def _nms_one_class(boxes, scores, score_threshold, nms_top_k, nms_threshold,
+                   nms_eta):
+    """Greedy NMS over one class (reference multiclass_nms_op.cc
+    NMSFast): returns kept indices into `boxes`."""
+    idx = np.where(scores > score_threshold)[0]
+    if idx.size == 0:
+        return []
+    idx = idx[np.argsort(-scores[idx], kind='stable')]
+    if nms_top_k > -1 and idx.size > nms_top_k:
+        idx = idx[:nms_top_k]
+    keep = []
+    adaptive_threshold = nms_threshold
+    while idx.size > 0:
+        i = idx[0]
+        keep.append(int(i))
+        if idx.size == 1:
+            break
+        rest = idx[1:]
+        bi = boxes[i]
+        area_i = max(bi[2] - bi[0], 0) * max(bi[3] - bi[1], 0)
+        br = boxes[rest]
+        iw = np.maximum(
+            np.minimum(bi[2], br[:, 2]) - np.maximum(bi[0], br[:, 0]), 0)
+        ih = np.maximum(
+            np.minimum(bi[3], br[:, 3]) - np.maximum(bi[1], br[:, 1]), 0)
+        inter = iw * ih
+        area_r = np.maximum(br[:, 2] - br[:, 0], 0) * np.maximum(
+            br[:, 3] - br[:, 1], 0)
+        union = area_i + area_r - inter
+        iou = np.where(union > 0, inter / np.maximum(union, 1e-10), 0)
+        idx = rest[iou <= adaptive_threshold]
+        if nms_eta < 1.0 and adaptive_threshold > 0.5:
+            adaptive_threshold *= nms_eta
+    return keep
+
+
+@register_host_op('multiclass_nms')
+def _multiclass_nms(ctx, op, scope):
+    from ..fluid import core
+    bboxes = np.asarray(ctx.get(op, 'BBoxes'))  # (B, M, 4)
+    scores = np.asarray(ctx.get(op, 'Scores'))  # (B, C, M)
+    a = op.attrs
+    background_label = int(a.get('background_label', 0))
+    score_threshold = float(a['score_threshold'])
+    nms_top_k = int(a.get('nms_top_k', -1))
+    nms_threshold = float(a.get('nms_threshold', 0.3))
+    nms_eta = float(a.get('nms_eta', 1.0))
+    keep_top_k = int(a.get('keep_top_k', -1))
+
+    all_out = []
+    lod = [0]
+    for b in range(bboxes.shape[0]):
+        dets = []  # (label, score, box idx)
+        for c in range(scores.shape[1]):
+            if c == background_label:
+                continue
+            keep = _nms_one_class(bboxes[b], scores[b, c], score_threshold,
+                                  nms_top_k, nms_threshold, nms_eta)
+            for i in keep:
+                dets.append((c, scores[b, c, i], i))
+        if keep_top_k > -1 and len(dets) > keep_top_k:
+            dets.sort(key=lambda d: -d[1])
+            dets = dets[:keep_top_k]
+        for c, s, i in dets:
+            all_out.append([float(c), float(s)] + list(bboxes[b, i]))
+        lod.append(len(all_out))
+    if all_out:
+        arr = np.asarray(all_out, np.float32)
+    else:
+        # reference emits a (1, 1) tensor holding -1 when nothing is kept
+        arr = np.full((1, 1), -1.0, np.float32)
+        lod = [0, 1]
+    out_name = op.output('Out')[0]
+    lt = core.LoDTensor(arr, [lod])
+    scope.var(out_name).set_value(lt)
+    ctx.store(out_name, arr)
+    ctx.env[out_name + SEQLEN_SUFFIX] = np.diff(np.asarray(lod))
+
+
+def _average_precision(tp, fp, num_gt, ap_type):
+    """AP from sorted tp/fp flags (reference detection_map_op.h)."""
+    if num_gt == 0 or len(tp) == 0:
+        return None
+    tp_cum = np.cumsum(tp)
+    fp_cum = np.cumsum(fp)
+    precision = tp_cum / np.maximum(tp_cum + fp_cum, 1e-10)
+    recall = tp_cum / num_gt
+    if ap_type == '11point':
+        ap = 0.0
+        for t in np.arange(0.0, 1.1, 0.1):
+            p = precision[recall >= t].max() if np.any(recall >= t) else 0.0
+            ap += p / 11.0
+        return ap
+    # integral
+    ap = 0.0
+    prev_recall = 0.0
+    for p, r in zip(precision, recall):
+        ap += p * (r - prev_recall)
+        prev_recall = r
+    return ap
+
+
+@register_host_op('detection_map')
+def _detection_map(ctx, op, scope):
+    """mAP over one batch (reference detection_map_op.cc — CPU only).
+    DetectRes: LoD (Nd, 6) [label, score, x1, y1, x2, y2]; Label: LoD
+    (Ng, 6) [label, x1, y1, x2, y2, difficult] or (Ng, 5) w/o difficult.
+
+    Cross-batch accumulation (reference PosCount/TruePos/FalsePos state
+    tensors): when the op declares Accum* outputs, per-class gt counts and
+    scored tp/fp entries are merged with any previous state found in those
+    scope vars and written back, and MAP is computed over the accumulated
+    state."""
+    det = np.asarray(ctx.get(op, 'DetectRes'))
+    gt = np.asarray(ctx.get(op, 'Label'))
+    det_names = op.input('DetectRes')
+    gt_names = op.input('Label')
+    det_lens = ctx.env.get(det_names[0] + SEQLEN_SUFFIX)
+    gt_lens = ctx.env.get(gt_names[0] + SEQLEN_SUFFIX)
+    overlap_threshold = float(op.attrs.get('overlap_threshold', 0.5))
+    evaluate_difficult = op.attrs.get('evaluate_difficult', True)
+    ap_type = op.attrs.get('ap_type', 'integral')
+    background_label = int(op.attrs.get('background_label', -1))
+
+    def to_lod_list(arr, lens):
+        if arr.ndim == 3:  # padded batch (B, K, D): lens gives valid rows
+            if lens is None:
+                lens = [arr.shape[1]] * arr.shape[0]
+            return [arr[i, :int(l)] for i, l in enumerate(lens)]
+        if lens is None:
+            return [arr]
+        out, ofs = [], 0
+        for l in lens:
+            out.append(arr[ofs:ofs + int(l)])
+            ofs += int(l)
+        return out
+
+    if det.ndim < 2 or det.shape[-1] < 6:
+        # multiclass_nms empty-result sentinel: (1, 1) tensor holding -1
+        det_per_img = []
+    else:
+        det_per_img = to_lod_list(det, det_lens)
+    gt_per_img = to_lod_list(gt, gt_lens)
+
+    num_gt = {}
+    for g in gt_per_img:
+        for row in g:
+            label = int(row[0])
+            if label == background_label:
+                continue
+            difficult = row[5] if row.shape[0] >= 6 else 0.0
+            if evaluate_difficult or not difficult:
+                num_gt[label] = num_gt.get(label, 0) + 1
+
+    scored = {}  # label -> list of (score, tp, fp)
+    for img, d in enumerate(det_per_img):
+        g = gt_per_img[img] if img < len(gt_per_img) else np.zeros((0, 6))
+        by_label = {}
+        for row in g:
+            by_label.setdefault(int(row[0]), []).append(row)
+        for label in sorted(set(int(r[0]) for r in d)):
+            if label == background_label:
+                continue
+            rows = [r for r in d if int(r[0]) == label]
+            rows.sort(key=lambda r: -r[1])
+            gt_rows = by_label.get(label, [])
+            used = [False] * len(gt_rows)
+            for r in rows:
+                best_iou, best_j = 0.0, -1
+                for j, grow in enumerate(gt_rows):
+                    gb = grow[1:5]
+                    iw = min(r[4], gb[2]) - max(r[2], gb[0])
+                    ih = min(r[5], gb[3]) - max(r[3], gb[1])
+                    inter = max(iw, 0) * max(ih, 0)
+                    area_d = max(r[4] - r[2], 0) * max(r[5] - r[3], 0)
+                    area_g = max(gb[2] - gb[0], 0) * max(gb[3] - gb[1], 0)
+                    union = area_d + area_g - inter
+                    iou = inter / union if union > 0 else 0.0
+                    if iou > best_iou:
+                        best_iou, best_j = iou, j
+                entry = scored.setdefault(label, [])
+                if best_iou > overlap_threshold:
+                    difficult = (gt_rows[best_j][5]
+                                 if gt_rows[best_j].shape[0] >= 6 else 0.0)
+                    if not evaluate_difficult and difficult:
+                        continue  # ignored: neither tp nor fp
+                    if not used[best_j]:
+                        used[best_j] = True
+                        entry.append((float(r[1]), 1, 0))
+                    else:
+                        entry.append((float(r[1]), 0, 1))
+                else:
+                    entry.append((float(r[1]), 0, 1))
+
+    # ---- merge with accumulated state (AccumPosCount: (C, 2) rows of
+    # [label, count]; AccumTruePos/AccumFalsePos: (N, 3) rows of
+    # [label, score, flag]) ----
+    def _accum_name(slot):
+        names = op.output(slot)
+        return names[0] if names else None
+
+    pos_name = _accum_name('AccumPosCount')
+    tp_name = _accum_name('AccumTruePos')
+    fp_name = _accum_name('AccumFalsePos')
+    has_state = ctx.get(op, 'HasState')
+    use_state = (has_state is not None and
+                 int(np.asarray(has_state).flatten()[0]) > 0)
+    if use_state:
+        prev = scope.find_var(pos_name) if pos_name else None
+        if prev is not None and prev.value() is not None:
+            for label, count in np.asarray(prev.value()).reshape(-1, 2):
+                num_gt[int(label)] = num_gt.get(int(label), 0) + int(count)
+        for state_name, flag_col in ((tp_name, 1), (fp_name, 2)):
+            var = scope.find_var(state_name) if state_name else None
+            if var is not None and var.value() is not None:
+                for label, score, flag in np.asarray(
+                        var.value()).reshape(-1, 3):
+                    e = [0.0, 0, 0]
+                    e[0] = float(score)
+                    e[flag_col] = int(flag)
+                    scored.setdefault(int(label), []).append(tuple(e))
+
+    aps = []
+    for label in sorted(num_gt):
+        entries = sorted(scored.get(label, []), key=lambda e: -e[0])
+        tp = np.asarray([e[1] for e in entries], np.float64)
+        fp = np.asarray([e[2] for e in entries], np.float64)
+        ap = _average_precision(tp, fp, num_gt.get(label, 0), ap_type)
+        if ap is not None:
+            aps.append(ap)
+    m_ap = float(np.mean(aps)) if aps else 0.0
+    out_name = op.output('MAP')[0]
+    val = np.asarray([m_ap], np.float32)
+    scope.var(out_name).set_value(val)
+    ctx.store(out_name, val)
+
+    if pos_name:
+        pos_rows = np.asarray(
+            [[l, c] for l, c in sorted(num_gt.items())], np.float32).reshape(
+                -1, 2)
+        scope.var(pos_name).set_value(pos_rows)
+        ctx.store(pos_name, pos_rows)
+    for state_name, flag_col in ((tp_name, 1), (fp_name, 2)):
+        if not state_name:
+            continue
+        rows = []
+        for label in sorted(scored):
+            for e in scored[label]:
+                if e[flag_col]:
+                    rows.append([label, e[0], e[flag_col]])
+        arr = np.asarray(rows, np.float32).reshape(-1, 3)
+        scope.var(state_name).set_value(arr)
+        ctx.store(state_name, arr)
+
+
+@register_host_op('rpn_target_assign')
+def _rpn_target_assign(ctx, op, scope):
+    """Sample anchors for RPN training (reference
+    rpn_target_assign_op.cc — CPU kernel with random subsampling).  Static
+    deviation: emits fixed-size index arrays padded with -1 instead of LoD
+    lists.  Accepts a single-instance (G, A) IoU matrix or the batched
+    padded (B, G, A) form produced for LoD ground-truth; batched instances
+    contribute indices offset by b * A (the reference flattens per-image
+    index lists the same way, rpn_target_assign_op.cc)."""
+    iou = np.asarray(ctx.get(op, 'DistMat'))
+    dist_names = op.input('DistMat')
+    lens = ctx.env.get(dist_names[0] + SEQLEN_SUFFIX)
+    a = op.attrs
+    rpn_batch_size = int(a.get('rpn_batch_size_per_im', 256))
+    fg_fraction = float(a.get('rpn_fg_fraction', 0.25))
+    pos_thr = float(a.get('rpn_positive_overlap', 0.7))
+    neg_thr = float(a.get('rpn_negative_overlap', 0.3))
+    fix_seed = a.get('fix_seed', False)
+    seed = int(a.get('seed', 0))
+    rng = np.random.RandomState(seed if fix_seed else None)
+
+    if iou.ndim == 2:
+        iou = iou[None]
+    if lens is None:
+        lens = [iou.shape[1]] * iou.shape[0]
+
+    def sample_one(iou_i):
+        num_a = iou_i.shape[1]
+        anchor_best = iou_i.max(axis=0) if iou_i.size else np.zeros((num_a, ))
+        anchor_argbest = iou_i.argmax(axis=0) if iou_i.size else np.zeros(
+            (num_a, ), np.int64)
+        fg = set(np.where(anchor_best >= pos_thr)[0].tolist())
+        # each gt's best anchor is positive regardless of threshold
+        if iou_i.size:
+            fg.update(iou_i.argmax(axis=1).tolist())
+        fg = np.asarray(sorted(fg), np.int64)
+        num_fg = min(int(rpn_batch_size * fg_fraction), fg.size)
+        if fg.size > num_fg:
+            fg = rng.choice(fg, size=num_fg, replace=False)
+        bg_cand = np.where(anchor_best < neg_thr)[0]
+        bg_cand = np.setdiff1d(bg_cand, fg)
+        num_bg = min(rpn_batch_size - num_fg, bg_cand.size)
+        bg = rng.choice(bg_cand, size=num_bg,
+                        replace=False) if bg_cand.size > num_bg else bg_cand
+        return fg, bg, anchor_argbest
+
+    num_anchors = iou.shape[2]
+    loc_parts, score_parts, lbl_parts, bbox_parts = [], [], [], []
+    for b in range(iou.shape[0]):
+        fg, bg, anchor_argbest = sample_one(iou[b, :int(lens[b])])
+        loc_i = np.sort(fg).astype(np.int64)
+        score_i = np.sort(np.concatenate([fg, bg])).astype(np.int64)
+        lbl_parts.append(np.isin(score_i, fg).astype(np.int64))
+        bbox_parts.append(anchor_argbest[loc_i].astype(np.int64))
+        loc_parts.append(loc_i + b * num_anchors)
+        score_parts.append(score_i + b * num_anchors)
+    loc_index = np.concatenate(loc_parts) if loc_parts else np.zeros(
+        (0, ), np.int64)
+    score_index = np.concatenate(score_parts) if score_parts else np.zeros(
+        (0, ), np.int64)
+    tgt_lbl = (np.concatenate(lbl_parts) if lbl_parts else np.zeros(
+        (0, ), np.int64)).reshape(-1, 1)
+    anchor_argbest_all = np.concatenate(bbox_parts) if bbox_parts else (
+        np.zeros((0, ), np.int64))
+    for slot, val in (('LocationIndex', loc_index),
+                      ('ScoreIndex', score_index), ('TargetLabel', tgt_lbl)):
+        names = op.output(slot)
+        if names:
+            scope.var(names[0]).set_value(val)
+            ctx.store(names[0], val)
+    names = op.output('TargetBBox')
+    if names:
+        tgt_bbox = anchor_argbest_all.reshape(-1, 1)
+        scope.var(names[0]).set_value(tgt_bbox)
+        ctx.store(names[0], tgt_bbox)

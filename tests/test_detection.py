@@ -1,0 +1,431 @@
+"""Detection stack tests (reference parity:
+python/paddle/fluid/tests/unittests/test_prior_box_op.py,
+test_box_coder_op.py, test_iou_similarity_op.py, test_bipartite_match_op.py,
+test_target_assign_op.py, test_multiclass_nms_op.py, test_detection_map_op.py
+and tests/test_detection.py layer tests)."""
+
+import math
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+from helpers import lod_feed
+
+
+def _run(prog, feed, fetch_list, startup=None):
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        if startup is not None:
+            exe.run(startup)
+        return exe.run(prog, feed=feed, fetch_list=fetch_list)
+
+
+def test_iou_similarity():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[4], dtype='float32')
+        out = fluid.layers.iou_similarity(x=x, y=y)
+    bx = np.array([[0., 0., 2., 2.], [1., 1., 3., 3.]], np.float32)
+    by = np.array([[0., 0., 2., 2.], [2., 2., 4., 4.], [10., 10., 11., 11.]],
+                  np.float32)
+    iou, = _run(prog, {'x': bx, 'y': by}, [out])
+    assert iou.shape == (2, 3)
+    np.testing.assert_allclose(iou[0, 0], 1.0, rtol=1e-5)
+    np.testing.assert_allclose(iou[0, 1], 0.0, atol=1e-6)  # touch only
+    np.testing.assert_allclose(iou[1, 1], 1.0 / 7.0, rtol=1e-5)
+    np.testing.assert_allclose(iou[:, 2], 0.0, atol=1e-6)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.RandomState(7)
+    # sorted along axis 1 -> [xmin, ymin] <= [xmax, ymax] elementwise
+    prior = np.sort(rng.rand(5, 2, 2), axis=1).reshape(5, 4).astype(
+        np.float32)
+    pvar = np.full((5, 4), 0.5, np.float32)
+    target = np.sort(rng.rand(3, 2, 2), axis=1).reshape(3, 4).astype(
+        np.float32)
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        p = fluid.layers.data(name='p', shape=[4], dtype='float32')
+        pv = fluid.layers.data(name='pv', shape=[4], dtype='float32')
+        t = fluid.layers.data(name='t', shape=[4], dtype='float32')
+        enc = fluid.layers.box_coder(p, pv, t, 'encode_center_size')
+        dec = fluid.layers.box_coder(p, pv, enc, 'decode_center_size')
+    enc_v, dec_v = _run(prog, {'p': prior, 'pv': pvar, 't': target},
+                        [enc, dec])
+    assert enc_v.shape == (3, 5, 4)
+    # decode(encode(t)) reproduces the target box against every prior
+    for j in range(5):
+        np.testing.assert_allclose(dec_v[:, j], target, rtol=1e-4, atol=1e-5)
+
+    # encode against numpy reference (box_coder_op.h EncodeCenterSize)
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = (prior[:, 2] + prior[:, 0]) / 2
+    pcy = (prior[:, 3] + prior[:, 1]) / 2
+    tw = target[:, 2] - target[:, 0]
+    th = target[:, 3] - target[:, 1]
+    tcx = (target[:, 2] + target[:, 0]) / 2
+    tcy = (target[:, 3] + target[:, 1]) / 2
+    want = np.stack(
+        [(tcx[:, None] - pcx[None]) / pw[None],
+         (tcy[:, None] - pcy[None]) / ph[None],
+         np.log(np.abs(tw[:, None] / pw[None])),
+         np.log(np.abs(th[:, None] / ph[None]))],
+        axis=-1) / pvar[None]
+    np.testing.assert_allclose(enc_v, want, rtol=1e-4, atol=1e-5)
+
+
+def test_prior_box_values():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        feat = fluid.layers.data(name='feat', shape=[8, 4, 4],
+                                 dtype='float32')
+        img = fluid.layers.data(name='img', shape=[3, 32, 32],
+                                dtype='float32')
+        box, var = fluid.layers.prior_box(
+            input=feat, image=img, min_sizes=[8.0], max_sizes=[16.0],
+            aspect_ratios=[2.0], flip=True, clip=True, variance=[0.1] * 4)
+    fv = np.zeros((1, 8, 4, 4), np.float32)
+    iv = np.zeros((1, 3, 32, 32), np.float32)
+    b, v = _run(prog, {'feat': fv, 'img': iv}, [box, var])
+    # priors per cell: ar-1 + sqrt(min*max) + ar2 + 1/ar2 = 4
+    assert b.shape == (4, 4, 4, 4) and v.shape == b.shape
+    np.testing.assert_allclose(v[0, 0, 0], [0.1] * 4, rtol=1e-6)
+    # cell (0,0): center = (0+0.5)*8 = 4 px; min box half-size 4 px
+    np.testing.assert_allclose(b[0, 0, 0], [0.0, 0.0, 8 / 32., 8 / 32.],
+                               atol=1e-6)
+    # sqrt box: sqrt(8*16)/2 = ~5.657 px half-size
+    s = math.sqrt(8 * 16) / 2
+    np.testing.assert_allclose(
+        b[0, 0, 1], [max(0, (4 - s) / 32.), max(0, (4 - s) / 32.),
+                     (4 + s) / 32., (4 + s) / 32.], rtol=1e-5)
+    assert (b >= 0).all() and (b <= 1).all()
+
+
+def test_bipartite_match_greedy():
+    dist = np.array(
+        [[0.1, 0.9, 0.3, 0.2],
+         [0.8, 0.2, 0.4, 0.1],
+         [0.2, 0.3, 0.7, 0.6]], np.float32)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        d = fluid.layers.data(name='d', shape=[4], dtype='float32',
+                              lod_level=1)
+        idx, md = fluid.layers.bipartite_match(d)
+        idx2, md2 = fluid.layers.bipartite_match(
+            d, match_type='per_prediction', dist_threshold=0.55)
+    lt = lod_feed([dist.tolist()], 'float32', dim=4)
+    i, m, i2, m2 = _run(prog, {'d': lt}, [idx, md, idx2, md2])
+    # greedy global max: (0,1)=0.9 -> (1,0)=0.8 -> (2,2)=0.7
+    np.testing.assert_array_equal(i[0], [1, 0, 2, -1])
+    np.testing.assert_allclose(m[0], [0.8, 0.9, 0.7, 0.0], rtol=1e-5)
+    # per_prediction: col 3 best row is 2 with 0.6 >= 0.55
+    np.testing.assert_array_equal(i2[0], [1, 0, 2, 2])
+    np.testing.assert_allclose(m2[0], [0.8, 0.9, 0.7, 0.6], rtol=1e-5)
+
+
+def test_bipartite_match_batched_padding():
+    # two instances with different gt counts: padding rows must never match
+    rows1 = [[0.9, 0.1], [0.2, 0.8]]
+    rows2 = [[0.3, 0.6]]
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        d = fluid.layers.data(name='d', shape=[2], dtype='float32',
+                              lod_level=1)
+        idx, md = fluid.layers.bipartite_match(d)
+    lt = lod_feed([rows1, rows2], 'float32', dim=2)
+    i, m = _run(prog, {'d': lt}, [idx, md])
+    np.testing.assert_array_equal(i[0], [0, 1])
+    # instance 2 has ONE gt row: only one column may match
+    np.testing.assert_array_equal(i[1], [-1, 0])
+    np.testing.assert_allclose(m[1], [0.0, 0.6], rtol=1e-5)
+
+
+def test_target_assign():
+    gt = [[[1.], [2.]], [[3.]]]  # per-image gt labels
+    match = np.array([[0, -1, 1], [-1, 0, -1]], np.int32)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[1], dtype='float32',
+                              lod_level=1)
+        mi = fluid.layers.data(name='mi', shape=[3], dtype='int32')
+        out, w = fluid.layers.target_assign(x, mi, mismatch_value=0)
+    lt = lod_feed(gt, 'float32')
+    o, wv = _run(prog, {'x': lt, 'mi': match}, [out, w])
+    np.testing.assert_allclose(o[0, :, 0], [1., 0., 2.], rtol=1e-6)
+    np.testing.assert_allclose(o[1, :, 0], [0., 3., 0.], rtol=1e-6)
+    np.testing.assert_allclose(wv[0, :, 0], [1., 0., 1.], rtol=1e-6)
+    np.testing.assert_allclose(wv[1, :, 0], [0., 1., 0.], rtol=1e-6)
+
+
+def test_multiclass_nms_host():
+    # 1 image, 2 classes (0 = background), 4 boxes; two heavily overlapping
+    boxes = np.array(
+        [[[0., 0., 1., 1.], [0., 0., 1.05, 1.05], [2., 2., 3., 3.],
+          [0.5, 0.5, 1.5, 1.5]]], np.float32)
+    scores = np.zeros((1, 2, 4), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7, 0.05]
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        b = fluid.layers.data(name='b', shape=[4, 4], dtype='float32')
+        s = fluid.layers.data(name='s', shape=[2, 4], dtype='float32')
+        out = fluid.layers.multiclass_nms(
+            b, s, score_threshold=0.1, nms_top_k=10, keep_top_k=5,
+            nms_threshold=0.5)
+    o, = _run(prog, {'b': boxes, 's': scores}, [out])
+    o = np.asarray(o)
+    # box 1 suppressed by box 0 (IoU ~0.9); box 3 below score threshold
+    assert o.shape == (2, 6)
+    np.testing.assert_allclose(o[0, :2], [1.0, 0.9], rtol=1e-5)
+    np.testing.assert_allclose(o[1, :2], [1.0, 0.7], rtol=1e-5)
+    np.testing.assert_allclose(o[0, 2:], [0., 0., 1., 1.], atol=1e-6)
+
+
+def test_detection_map_perfect():
+    # detections == ground truth -> mAP = 1
+    det = [[[1., 0.9, 0., 0., 1., 1.], [2., 0.8, 2., 2., 3., 3.]]]
+    gt = [[[1., 0., 0., 1., 1., 0.], [2., 2., 2., 3., 3., 0.]]]
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        d = fluid.layers.data(name='d', shape=[6], dtype='float32',
+                              lod_level=1)
+        g = fluid.layers.data(name='g', shape=[6], dtype='float32',
+                              lod_level=1)
+        m = fluid.layers.detection_map(d, g, class_num=3,
+                                       overlap_threshold=0.5)
+    mv, = _run(prog, {'d': lod_feed(det, 'float32', dim=6),
+                      'g': lod_feed(gt, 'float32', dim=6)}, [m])
+    np.testing.assert_allclose(np.asarray(mv)[0], 1.0, rtol=1e-5)
+
+
+def test_detection_map_with_miss():
+    # one correct detection, one false positive, one missed gt
+    det = [[[1., 0.9, 0., 0., 1., 1.], [1., 0.8, 5., 5., 6., 6.]]]
+    gt = [[[1., 0., 0., 1., 1., 0.], [1., 2., 2., 3., 3., 0.]]]
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        d = fluid.layers.data(name='d', shape=[6], dtype='float32',
+                              lod_level=1)
+        g = fluid.layers.data(name='g', shape=[6], dtype='float32',
+                              lod_level=1)
+        m = fluid.layers.detection_map(d, g, class_num=2,
+                                       overlap_threshold=0.5)
+    mv, = _run(prog, {'d': lod_feed(det, 'float32', dim=6),
+                      'g': lod_feed(gt, 'float32', dim=6)}, [m])
+    # AP(integral): 1 tp @0.9 (p=1, r=.5), 1 fp @0.8 -> ap = 1*0.5 = 0.5
+    np.testing.assert_allclose(np.asarray(mv)[0], 0.5, rtol=1e-4)
+
+
+def test_ssd_loss_trains():
+    rng = np.random.RandomState(0)
+    num_priors, num_classes = 8, 4
+    prior = np.zeros((num_priors, 4), np.float32)
+    centers = (np.arange(num_priors, dtype=np.float32) + 0.5) / num_priors
+    prior[:, 0] = centers - 0.1
+    prior[:, 1] = 0.3
+    prior[:, 2] = centers + 0.1
+    prior[:, 3] = 0.7
+    pvar = np.tile(np.array([0.1, 0.1, 0.2, 0.2], np.float32),
+                   (num_priors, 1))
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        feat = fluid.layers.data(name='feat', shape=[16], dtype='float32')
+        gtb = fluid.layers.data(name='gtb', shape=[4], dtype='float32',
+                                lod_level=1)
+        gtl = fluid.layers.data(name='gtl', shape=[1], dtype='int64',
+                                lod_level=1)
+        pb = fluid.layers.data(name='pb', shape=[4], dtype='float32')
+        pbv = fluid.layers.data(name='pbv', shape=[4], dtype='float32')
+        loc = fluid.layers.fc(feat, size=num_priors * 4)
+        loc = fluid.layers.reshape(loc, shape=[0, num_priors, 4])
+        conf = fluid.layers.fc(feat, size=num_priors * num_classes)
+        conf = fluid.layers.reshape(conf, shape=[0, num_priors, num_classes])
+        loss = fluid.layers.ssd_loss(loc, conf, gtb, gtl, pb, pbv)
+        avg = fluid.layers.mean(loss)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(avg)
+
+    feats = rng.standard_normal((2, 16)).astype(np.float32)
+    gt_boxes = [[[0.05, 0.3, 0.3, 0.7]], [[0.55, 0.3, 0.8, 0.7],
+                                          [0.05, 0.3, 0.2, 0.7]]]
+    gt_labels = [[[1]], [[2], [3]]]
+    feed = {
+        'feat': feats,
+        'gtb': lod_feed(gt_boxes, 'float32', dim=4),
+        'gtl': lod_feed(gt_labels, 'int64'),
+        'pb': prior,
+        'pbv': pvar,
+    }
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(12):
+            lv, = exe.run(prog, feed=feed, fetch_list=[avg])
+            losses.append(float(np.asarray(lv).flatten()[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_multi_box_head_and_detection_output():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        img = fluid.layers.data(name='img', shape=[3, 32, 32],
+                                dtype='float32')
+        f1 = fluid.layers.conv2d(img, num_filters=8, filter_size=3,
+                                 stride=4, padding=1)
+        f2 = fluid.layers.conv2d(f1, num_filters=8, filter_size=3,
+                                 stride=2, padding=1)
+        locs, confs, box, var = fluid.layers.multi_box_head(
+            inputs=[f1, f2], image=img, base_size=32, num_classes=3,
+            aspect_ratios=[[2.0], [2.0]], min_ratio=20, max_ratio=90,
+            flip=True, clip=True, offset=0.5)
+        nmsed = fluid.layers.detection_output(
+            locs, confs, box, var, nms_threshold=0.45)
+    rng = np.random.RandomState(3)
+    iv = rng.standard_normal((2, 3, 32, 32)).astype(np.float32)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        lv, cv, bv, vv, nv = exe.run(
+            prog, feed={'img': iv},
+            fetch_list=[locs, confs, box, var, nmsed])
+    # f1 is 8x8, f2 4x4; priors/cell = 1 + 1 + 2 = 4
+    want_priors = 8 * 8 * 4 + 4 * 4 * 4
+    assert lv.shape == (2, want_priors, 4)
+    assert cv.shape == (2, want_priors, 3)
+    assert bv.shape == (want_priors, 4)
+    assert vv.shape == (want_priors, 4)
+    nv = np.asarray(nv)
+    assert nv.ndim == 2 and nv.shape[1] in (1, 6)
+
+
+def test_anchor_generator_and_polygon_box_transform():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        feat = fluid.layers.data(name='feat', shape=[8, 4, 4],
+                                 dtype='float32')
+        anchors, avar = fluid.layers.anchor_generator(
+            feat, anchor_sizes=[32.0, 64.0], aspect_ratios=[1.0],
+            stride=[16.0, 16.0])
+        geo = fluid.layers.data(name='geo', shape=[4, 4, 4],
+                                dtype='float32')
+        poly = fluid.layers.polygon_box_transform(geo)
+    fv = np.zeros((1, 8, 4, 4), np.float32)
+    gv = np.ones((1, 4, 4, 4), np.float32)
+    av, vv, pv = _run(prog, {'feat': fv, 'geo': gv}, [anchors, avar, poly])
+    assert av.shape == (4, 4, 2, 4)
+    # cell (0,0), size 32: center (8, 8), half 16 -> [-8, -8, 24, 24]
+    np.testing.assert_allclose(av[0, 0, 0], [-8., -8., 24., 24.], atol=1e-4)
+    assert vv.shape == av.shape
+    # even channels: col*4 - x ; odd channels: row*4 - x
+    np.testing.assert_allclose(pv[0, 0, 0], np.arange(4) * 4.0 - 1.0,
+                               atol=1e-5)
+    np.testing.assert_allclose(pv[0, 1, :, 0], np.arange(4) * 4.0 - 1.0,
+                               atol=1e-5)
+
+
+def test_rpn_target_assign_host():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        loc = fluid.layers.data(name='loc', shape=[4], dtype='float32')
+        score = fluid.layers.data(name='score', shape=[1], dtype='float32')
+        anchor = fluid.layers.data(name='anchor', shape=[4],
+                                   dtype='float32')
+        gt = fluid.layers.data(name='gt', shape=[4], dtype='float32')
+        li, si, tl, tb = fluid.layers.rpn_target_assign(
+            loc, score, anchor, gt, rpn_batch_size_per_im=4,
+            fg_fraction=0.5, rpn_positive_overlap=0.6,
+            rpn_negative_overlap=0.3, fix_seed=True)
+    anchors = np.array(
+        [[0., 0., 1., 1.], [0., 0., 0.9, 0.9], [5., 5., 6., 6.],
+         [8., 8., 9., 9.]], np.float32)
+    gts = np.array([[0., 0., 1., 1.]], np.float32)
+    lv, sv, tlv = _run(
+        prog, {'loc': anchors, 'score': np.zeros((4, 1), np.float32),
+               'anchor': anchors, 'gt': gts}, [li, si, tl])
+    lv, sv, tlv = np.asarray(lv), np.asarray(sv), np.asarray(tlv)
+    assert 0 in lv  # anchor 0 IoU 1.0 -> positive
+    assert set(np.asarray(tlv).flatten()) <= {0, 1}
+    # negatives sampled from anchors 2/3 (IoU 0)
+    assert all(s in (0, 1, 2, 3) for s in sv.flatten())
+
+
+def test_detection_map_accumulates_state():
+    det1 = [[[1., 0.9, 0., 0., 1., 1.]]]
+    gt1 = [[[1., 0., 0., 1., 1., 0.]]]
+    det2 = [[[1., 0.8, 5., 5., 6., 6.]]]  # false positive vs gt2
+    gt2 = [[[1., 2., 2., 3., 3., 0.]]]
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        d = fluid.layers.data(name='d', shape=[6], dtype='float32',
+                              lod_level=1)
+        g = fluid.layers.data(name='g', shape=[6], dtype='float32',
+                              lod_level=1)
+        hs = fluid.layers.data(name='hs', shape=[1], dtype='int32')
+        states = [
+            fluid.default_main_program().global_block().create_var(
+                name='st_%d' % i, persistable=True) for i in range(3)
+        ]
+        m = fluid.layers.detection_map(
+            d, g, class_num=3, overlap_threshold=0.5, has_state=hs,
+            input_states=states, out_states=states)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        m1, = exe.run(prog, feed={
+            'd': lod_feed(det1, 'float32', dim=6),
+            'g': lod_feed(gt1, 'float32', dim=6),
+            'hs': np.zeros((1, 1), np.int32)}, fetch_list=[m])
+        m2, = exe.run(prog, feed={
+            'd': lod_feed(det2, 'float32', dim=6),
+            'g': lod_feed(gt2, 'float32', dim=6),
+            'hs': np.ones((1, 1), np.int32)}, fetch_list=[m])
+    np.testing.assert_allclose(np.asarray(m1)[0], 1.0, rtol=1e-5)
+    # accumulated: 1 tp @0.9 + 1 fp @0.8 over 2 gt -> AP = 0.5
+    np.testing.assert_allclose(np.asarray(m2)[0], 0.5, rtol=1e-4)
+
+
+def test_detection_map_empty_detections():
+    # multiclass_nms empty sentinel (1,1) of -1 must not crash detection_map
+    gt = [[[1., 0., 0., 1., 1., 0.]]]
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        d = fluid.layers.data(name='d', shape=[1], dtype='float32')
+        g = fluid.layers.data(name='g', shape=[6], dtype='float32',
+                              lod_level=1)
+        m = fluid.layers.detection_map(d, g, class_num=2)
+    mv, = _run(prog, {'d': np.full((1, 1), -1.0, np.float32),
+                      'g': lod_feed(gt, 'float32', dim=6)}, [m])
+    np.testing.assert_allclose(np.asarray(mv)[0], 0.0, atol=1e-6)
+
+
+def test_rpn_target_assign_batched_lod_gt():
+    # gt with lod -> (B, G, 4) padded -> iou (B, G, A); indices offset by b*A
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        loc = fluid.layers.data(name='loc', shape=[4], dtype='float32')
+        score = fluid.layers.data(name='score', shape=[1], dtype='float32')
+        anchor = fluid.layers.data(name='anchor', shape=[4],
+                                   dtype='float32')
+        gt = fluid.layers.data(name='gt', shape=[4], dtype='float32',
+                               lod_level=1)
+        li, si, tl, tb = fluid.layers.rpn_target_assign(
+            loc, score, anchor, gt, rpn_batch_size_per_im=4,
+            fg_fraction=0.5, rpn_positive_overlap=0.6,
+            rpn_negative_overlap=0.3, fix_seed=True)
+    anchors = np.array(
+        [[0., 0., 1., 1.], [5., 5., 6., 6.], [8., 8., 9., 9.]], np.float32)
+    gt_rows = [[[0., 0., 1., 1.]], [[5., 5., 6., 6.], [8., 8., 9., 9.]]]
+    lv, sv, tlv = _run(
+        prog, {'loc': anchors, 'score': np.zeros((3, 1), np.float32),
+               'anchor': anchors, 'gt': lod_feed(gt_rows, 'float32', dim=4)},
+        [li, si, tl])
+    lv = np.asarray(lv).flatten()
+    # image 0 positive: anchor 0 -> global 0; image 1: anchors 1,2 -> 4,5
+    assert 0 in lv
+    assert {4, 5} & set(lv.tolist())
+    assert all(v < 6 for v in np.asarray(sv).flatten())
