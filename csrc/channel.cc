@@ -1,0 +1,133 @@
+// CSP channels for program-level concurrency.
+//
+// TPU-native equivalent of the reference's Go-style channels
+// (paddle/fluid/framework/channel.h + channel_impl.h): bounded buffered
+// channels plus capacity-0 rendezvous semantics, blocking and try variants
+// (the try forms back the select op), close-with-drain.  C ABI for ctypes;
+// payloads are opaque byte buffers (serialized tensors).
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Chan {
+  std::mutex mu;
+  std::condition_variable send_cv;   // space available / receiver arrived
+  std::condition_variable recv_cv;   // item available
+  std::condition_variable taken_cv;  // rendezvous pickup confirmation
+  std::deque<std::vector<char>> items;
+  uint64_t capacity = 0;  // 0 = unbuffered rendezvous
+  int recv_waiters = 0;
+  uint64_t taken_seq = 0;  // count of items ever received
+  uint64_t sent_seq = 0;   // count of items ever queued
+  bool closed = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ch_create(uint64_t capacity) { return new Chan{.capacity = capacity}; }
+
+void ch_destroy(void* h) { delete static_cast<Chan*>(h); }
+
+uint64_t ch_size(void* h) {
+  Chan* c = static_cast<Chan*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  return c->items.size();
+}
+
+int ch_is_closed(void* h) {
+  Chan* c = static_cast<Chan*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  return c->closed ? 1 : 0;
+}
+
+void ch_close(void* h) {
+  Chan* c = static_cast<Chan*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  c->closed = true;
+  c->send_cv.notify_all();
+  c->recv_cv.notify_all();
+  c->taken_cv.notify_all();
+}
+
+// 0 = ok, -1 = closed
+int ch_send(void* h, const char* buf, uint64_t len) {
+  Chan* c = static_cast<Chan*>(h);
+  std::unique_lock<std::mutex> g(c->mu);
+  uint64_t effective_cap = c->capacity ? c->capacity : 1;
+  c->send_cv.wait(g, [&] {
+    return c->closed || c->items.size() < effective_cap;
+  });
+  if (c->closed) return -1;
+  c->items.emplace_back(buf, buf + len);
+  uint64_t my_seq = ++c->sent_seq;
+  c->recv_cv.notify_one();
+  if (c->capacity == 0) {
+    // rendezvous: wait until a receiver picked this item up
+    c->taken_cv.wait(g, [&] { return c->closed || c->taken_seq >= my_seq; });
+    if (c->taken_seq < my_seq) return -1;  // closed before pickup
+  }
+  return 0;
+}
+
+// 0 = ok, -1 = closed, -2 = would block
+int ch_try_send(void* h, const char* buf, uint64_t len) {
+  Chan* c = static_cast<Chan*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  if (c->closed) return -1;
+  if (c->capacity == 0) {
+    // succeeds only when a receiver is already waiting
+    if (c->recv_waiters <= 0 || !c->items.empty()) return -2;
+  } else if (c->items.size() >= c->capacity) {
+    return -2;
+  }
+  c->items.emplace_back(buf, buf + len);
+  ++c->sent_seq;
+  // taken_seq advances only at pickup (pop_locked) — double counting here
+  // would let a later blocking ch_send skip its rendezvous wait
+  c->recv_cv.notify_one();
+  return 0;
+}
+
+static int pop_locked(Chan* c, char* buf, uint64_t cap) {
+  const std::vector<char>& item = c->items.front();
+  if (item.size() > cap) {
+    return -(static_cast<int>(item.size()) + 3);  // -(n+3): need n bytes
+  }
+  std::memcpy(buf, item.data(), item.size());
+  int n = static_cast<int>(item.size());
+  c->items.pop_front();
+  ++c->taken_seq;
+  c->taken_cv.notify_all();
+  c->send_cv.notify_one();
+  return n;
+}
+
+// >=0 bytes received, -1 = closed and drained, -(n+3) = buffer too small
+int ch_recv(void* h, char* buf, uint64_t cap) {
+  Chan* c = static_cast<Chan*>(h);
+  std::unique_lock<std::mutex> g(c->mu);
+  ++c->recv_waiters;
+  c->send_cv.notify_one();  // a rendezvous try_send may now proceed
+  c->recv_cv.wait(g, [&] { return c->closed || !c->items.empty(); });
+  --c->recv_waiters;
+  if (c->items.empty()) return -1;  // closed + drained
+  return pop_locked(c, buf, cap);
+}
+
+// >=0 ok, -1 closed+drained, -2 would block, -(n+3) buffer too small
+int ch_try_recv(void* h, char* buf, uint64_t cap) {
+  Chan* c = static_cast<Chan*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  if (c->items.empty()) return c->closed ? -1 : -2;
+  return pop_locked(c, buf, cap);
+}
+
+}  // extern "C"

@@ -43,6 +43,9 @@ from . import transpiler
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, \
     memory_optimize, release_memory, InferenceTranspiler
 from . import evaluator
+from . import concurrency
+from .concurrency import (Go, make_channel, channel_send, channel_recv,
+                          channel_close, Select)
 from . import debugger
 from .trainer import (Trainer, BeginEpochEvent, EndEpochEvent,
                       BeginStepEvent, EndStepEvent, CheckpointConfig)

@@ -1,0 +1,160 @@
+"""CSP concurrency: Go routines, channels, Select
+(reference: python/paddle/fluid/concurrency.py; C++ side
+framework/channel.h + operators/concurrency/channel_*_op.cc, go_op.cc,
+select_op.cc).
+
+The channel runtime is native (csrc/channel.cc via runtime.native
+.NativeChannel): bounded buffered channels and capacity-0 rendezvous,
+blocking + try variants.  Programs using these ops execute on the host
+eager path (they are inherently sequential control constructs); compute
+inside Go blocks still lowers per-op to XLA.
+"""
+
+import contextlib
+import io
+import threading
+
+import numpy as np
+
+from .framework import default_main_program
+from .layer_helper import LayerHelper
+from . import core
+
+__all__ = [
+    'Go', 'make_channel', 'channel_send', 'channel_recv', 'channel_close',
+    'Select'
+]
+
+
+def _serialize(arr):
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(arr), allow_pickle=False)
+    return buf.getvalue()
+
+
+def _deserialize(data):
+    return np.load(io.BytesIO(bytes(data)), allow_pickle=False)
+
+
+class Go(object):
+    """``with fluid.Go():`` runs the enclosed ops on their own thread
+    (reference concurrency.py:28 Go(BlockGuard) emitting go_op)."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper('go', name=name)
+
+    def __enter__(self):
+        self.main_program = self.helper.main_program
+        self.parent_idx = self.main_program.current_block_idx
+        self.sub_block = self.main_program.create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        self.main_program.rollback()
+        parent_block = self.main_program.block(self.parent_idx)
+        parent_block.append_op(
+            type='go', inputs={}, outputs={},
+            attrs={'sub_block': self.sub_block})
+        return True
+
+
+def make_channel(dtype, capacity=0):
+    """Create a channel variable (reference concurrency.py:282;
+    channel_create_op).  dtype is accepted for API parity; payloads carry
+    their own dtype."""
+    helper = LayerHelper('channel_create')
+    ch = helper.create_variable_for_type_inference(dtype='float32')
+    ch.stop_gradient = True
+    helper.append_op(
+        type='channel_create',
+        outputs={'Out': [ch]},
+        attrs={'capacity': capacity,
+               'data_type': str(dtype)})
+    return ch
+
+
+def channel_send(channel, value, is_copy=False):
+    """Blocking send (reference concurrency.py:338; channel_send_op).
+    Returns a bool status variable."""
+    helper = LayerHelper('channel_send')
+    status = helper.create_variable_for_type_inference(dtype='bool')
+    status.stop_gradient = True
+    helper.append_op(
+        type='channel_send',
+        inputs={'Channel': [channel],
+                'X': [value]},
+        outputs={'Status': [status]})
+    return status
+
+
+def channel_recv(channel, return_value):
+    """Blocking receive into return_value (reference concurrency.py:388;
+    channel_recv_op).  Returns (return_value, status)."""
+    helper = LayerHelper('channel_recv')
+    status = helper.create_variable_for_type_inference(dtype='bool')
+    status.stop_gradient = True
+    helper.append_op(
+        type='channel_recv',
+        inputs={'Channel': [channel]},
+        outputs={'Out': [return_value],
+                 'Status': [status]})
+    return return_value, status
+
+
+def channel_close(channel):
+    """(reference concurrency.py:432; channel_close_op)"""
+    helper = LayerHelper('channel_close')
+    helper.append_op(type='channel_close', inputs={'Channel': [channel]})
+
+
+class Select(object):
+    """Go-style select over channel operations (reference
+    concurrency.py:196; select_op).  Cases are tried in order; the first
+    ready channel op runs its block; ``default()`` runs when none is
+    ready (without it, select blocks until one becomes ready)."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper('select', name=name)
+        self.cases = []  # (kind, channel_name, value_name, sub_block)
+        self.has_default = False
+
+    def __enter__(self):
+        self.main_program = self.helper.main_program
+        self.parent_idx = self.main_program.current_block_idx
+        return self
+
+    @contextlib.contextmanager
+    def case(self, channel_action_fn, channel, value, is_copy=False):
+        kind = ('send' if channel_action_fn is channel_send else 'recv')
+        sub_block = self.main_program.create_block()
+        try:
+            yield
+        finally:
+            self.main_program.rollback()
+        self.cases.append((kind, channel.name, value.name, sub_block))
+
+    @contextlib.contextmanager
+    def default(self):
+        sub_block = self.main_program.create_block()
+        try:
+            yield
+        finally:
+            self.main_program.rollback()
+        self.has_default = True
+        self.cases.append(('default', '', '', sub_block))
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        parent_block = self.main_program.block(self.parent_idx)
+        parent_block.append_op(
+            type='select', inputs={}, outputs={},
+            attrs={
+                'case_kinds': [c[0] for c in self.cases],
+                'case_channels': [c[1] for c in self.cases],
+                'case_values': [c[2] for c in self.cases],
+                'sub_blocks': [c[3] for c in self.cases],
+            })
+        return True

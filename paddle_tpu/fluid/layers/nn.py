@@ -28,6 +28,7 @@ __all__ = [
     'prelu', 'leaky_relu', 'soft_relu', 'flatten', 'random_crop', 'im2sequence',
     'hsigmoid', 'nce', 'multiplex', 'dropout', 'layer_norm', 'lstm_unit',
     'linear_chain_crf', 'crf_decoding', 'cos_sim', 'flash_attention',
+    'warpctc', 'ctc_greedy_decoder', 'edit_distance', 'roi_pool',
 ]
 
 
@@ -1446,4 +1447,94 @@ def flash_attention(q, k, v, num_heads=None, causal=False, scale=None,
         })
     if squeeze_back:
         out = reshape(out, [0, 0, int(num_heads) * int(v.shape[-1])])
+    return out
+
+
+def warpctc(input, label, blank=0, norm_by_times=False):
+    """CTC loss over a LoD batch of logit sequences (reference nn.py
+    warpctc; operators/warpctc_op.cc).  Computed natively as a lax.scan
+    alpha recursion (ops/ctc_ops.py) instead of wrapping warp-ctc; the
+    gradient comes from autodiff rather than the WarpCTCGrad side tensor.
+    Returns per-sequence loss (N, 1)."""
+    helper = LayerHelper('warpctc', **locals())
+    loss_out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    loss_out.shape = (-1, 1)
+    helper.append_op(
+        type='warpctc',
+        inputs={'Logits': [input],
+                'Label': [label]},
+        outputs={'Loss': [loss_out]},
+        attrs={'blank': blank,
+               'norm_by_times': norm_by_times})
+    return loss_out
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """Best-path CTC decode: argmax per step, merge repeats, drop blanks
+    (reference nn.py ctc_greedy_decoder = top_k + ctc_align)."""
+    helper = LayerHelper('ctc_greedy_decoder', **locals())
+    argmax_out = helper.create_variable_for_type_inference(dtype='int64')
+    argmax_out.shape = tuple(input.shape[:-1])
+    helper.append_op(
+        type='argmax',
+        inputs={'X': [input]},
+        outputs={'Out': [argmax_out]},
+        attrs={'axis': -1})
+    out = helper.create_variable_for_type_inference(dtype='int64')
+    helper.append_op(
+        type='ctc_align',
+        inputs={'Input': [argmax_out]},
+        outputs={'Output': [out]},
+        attrs={'blank': blank,
+               'merge_repeated': True})
+    out.stop_gradient = True
+    return out
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  name=None):
+    """Levenshtein distance between hypothesis and reference sequences
+    (reference nn.py edit_distance; operators/edit_distance_op.cc).
+    Returns (distance (N, 1), sequence_num (1,))."""
+    from .sequence import sequence_erase
+    helper = LayerHelper('edit_distance', **locals())
+    if ignored_tokens is not None and len(ignored_tokens) > 0:
+        input = sequence_erase(input, ignored_tokens)
+        label = sequence_erase(label, ignored_tokens)
+    edit_distance_out = helper.create_variable_for_type_inference(
+        dtype='float32')
+    sequence_num = helper.create_variable_for_type_inference(dtype='int64')
+    helper.append_op(
+        type='edit_distance',
+        inputs={'Hyps': [input],
+                'Refs': [label]},
+        outputs={'Out': [edit_distance_out],
+                 'SequenceNum': [sequence_num]},
+        attrs={'normalized': normalized})
+    edit_distance_out.stop_gradient = True
+    sequence_num.stop_gradient = True
+    return edit_distance_out, sequence_num
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0):
+    """Max-pool features inside each region of interest (reference nn.py
+    roi_pool; operators/roi_pool_op.cc).  rois: LoD (num_rois, 4) boxes
+    per image."""
+    helper = LayerHelper('roi_pool', **locals())
+    dtype = helper.input_dtype()
+    out = helper.create_variable_for_type_inference(dtype)
+    argmaxes = helper.create_variable_for_type_inference(dtype='int32')
+    out.shape = (-1, input.shape[1], pooled_height, pooled_width)
+    helper.append_op(
+        type='roi_pool',
+        inputs={'X': [input],
+                'ROIs': [rois]},
+        outputs={'Out': [out],
+                 'Argmax': [argmaxes]},
+        attrs={
+            'pooled_height': pooled_height,
+            'pooled_width': pooled_width,
+            'spatial_scale': spatial_scale
+        })
     return out

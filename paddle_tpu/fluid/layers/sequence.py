@@ -15,6 +15,7 @@ __all__ = [
     'sequence_pool', 'sequence_softmax', 'sequence_first_step',
     'sequence_last_step', 'sequence_expand', 'sequence_concat',
     'sequence_reshape', 'sequence_enumerate', 'sequence_erase',
+    'dynamic_lstmp',
     'sequence_slice', 'row_conv', 'sequence_pad', 'sequence_mask',
     'beam_search', 'beam_search_decode', 'beam_expand', 'beam_init_scores',
 ]
@@ -432,3 +433,60 @@ def beam_search_decode(ids, scores, parent_idx, beam_size, end_id,
         attrs={'beam_size': beam_size,
                'end_id': end_id})
     return sentence_ids, sentence_scores
+
+
+def dynamic_lstmp(input,
+                  size,
+                  proj_size,
+                  param_attr=None,
+                  bias_attr=None,
+                  use_peepholes=True,
+                  is_reverse=False,
+                  gate_activation='sigmoid',
+                  cell_activation='tanh',
+                  candidate_activation='tanh',
+                  proj_activation='tanh',
+                  dtype='float32',
+                  name=None):
+    """Projected LSTM (reference nn.py dynamic_lstmp;
+    operators/lstmp_op.cc).  Returns (projection, cell)."""
+    helper = LayerHelper('lstmp', **locals())
+    hidden_dim = size // 4
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[proj_size, 4 * hidden_dim],
+        dtype=dtype)
+    proj_weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[hidden_dim, proj_size], dtype=dtype)
+    bias_size = [1, 7 * hidden_dim if use_peepholes else 4 * hidden_dim]
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=bias_size, dtype=dtype, is_bias=True)
+
+    projection = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    batch_gate = helper.create_variable_for_type_inference(dtype)
+    batch_hidden = helper.create_variable_for_type_inference(dtype)
+    projection.shape = tuple(input.shape[:-1]) + (proj_size, )
+    cell.shape = tuple(input.shape[:-1]) + (hidden_dim, )
+    projection.lod_level = input.lod_level
+    cell.lod_level = input.lod_level
+    helper.append_op(
+        type='lstmp',
+        inputs={'Input': [input],
+                'Weight': [weight],
+                'ProjWeight': [proj_weight],
+                'Bias': [bias]},
+        outputs={
+            'Projection': [projection],
+            'Cell': [cell],
+            'BatchGate': [batch_gate],
+            'BatchHidden': [batch_hidden]
+        },
+        attrs={
+            'use_peepholes': use_peepholes,
+            'is_reverse': is_reverse,
+            'gate_activation': gate_activation,
+            'cell_activation': cell_activation,
+            'candidate_activation': candidate_activation,
+            'proj_activation': proj_activation
+        })
+    return projection, cell

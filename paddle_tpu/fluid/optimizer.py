@@ -22,7 +22,8 @@ __all__ = [
     'Ftrl', 'SGDOptimizer', 'MomentumOptimizer', 'AdagradOptimizer',
     'AdamOptimizer', 'AdamaxOptimizer', 'DecayedAdagradOptimizer',
     'RMSPropOptimizer', 'FtrlOptimizer', 'Adadelta', 'AdadeltaOptimizer',
-    'ModelAverage', 'Optimizer',
+    'ModelAverage', 'Optimizer', 'ProximalGD', 'ProximalGDOptimizer',
+    'ProximalAdagrad', 'ProximalAdagradOptimizer',
 ]
 
 
@@ -535,6 +536,63 @@ class FtrlOptimizer(Optimizer):
             })
 
 
+class ProximalGDOptimizer(Optimizer):
+    """Proximal gradient descent with L1/L2 shrinkage (reference
+    optimizer.py-era operators/proximal_gd_op.cc)."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, **kwargs):
+        super(ProximalGDOptimizer, self).__init__(
+            learning_rate=learning_rate, **kwargs)
+        self.type = 'proximal_gd'
+        self._l1 = l1
+        self._l2 = l2
+
+    def _append_optimize_op(self, block, param_and_grad):
+        return block.append_op(
+            type=self.type,
+            inputs={
+                'Param': [param_and_grad[0]],
+                'Grad': [param_and_grad[1]],
+                'LearningRate': [self._create_param_lr(param_and_grad)]
+            },
+            outputs={'ParamOut': [param_and_grad[0]]},
+            attrs={'l1': self._l1,
+                   'l2': self._l2})
+
+
+class ProximalAdagradOptimizer(Optimizer):
+    """Adagrad with proximal L1/L2 shrinkage (reference
+    operators/proximal_adagrad_op.cc)."""
+    _moment_acc_str = 'moment'
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, **kwargs):
+        super(ProximalAdagradOptimizer, self).__init__(
+            learning_rate=learning_rate, **kwargs)
+        self.type = 'proximal_adagrad'
+        self._l1 = l1
+        self._l2 = l2
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        moment_acc = self._get_accumulator(self._moment_acc_str,
+                                           param_and_grad[0])
+        return block.append_op(
+            type=self.type,
+            inputs={
+                'Param': [param_and_grad[0]],
+                'Grad': [param_and_grad[1]],
+                'Moment': [moment_acc],
+                'LearningRate': [self._create_param_lr(param_and_grad)]
+            },
+            outputs={'ParamOut': [param_and_grad[0]],
+                     'MomentOut': [moment_acc]},
+            attrs={'l1': self._l1,
+                   'l2': self._l2})
+
+
 SGD = SGDOptimizer
 Momentum = MomentumOptimizer
 Adagrad = AdagradOptimizer
@@ -544,6 +602,8 @@ DecayedAdagrad = DecayedAdagradOptimizer
 Adadelta = AdadeltaOptimizer
 RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
+ProximalGD = ProximalGDOptimizer
+ProximalAdagrad = ProximalAdagradOptimizer
 
 
 class ModelAverage(Optimizer):

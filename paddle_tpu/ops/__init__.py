@@ -21,6 +21,9 @@ from . import beam_search_ops  # noqa: F401
 from . import crf_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
+from . import ctc_ops  # noqa: F401
+from . import quantize_ops  # noqa: F401
+from . import concurrency_ops  # noqa: F401
 from . import sparse  # noqa: F401
 
 # wrap every optimizer lowering with SelectedRows (SparseRows) handling —

@@ -1,0 +1,205 @@
+"""Host implementations of the CSP ops: channel_create/send/recv/close,
+go, select (reference: paddle/fluid/operators/concurrency/channel_*_op.cc,
+go_op.cc, select_op.cc over framework/channel.h).
+
+Channels are native (csrc/channel.cc).  go spawns a Python thread that
+executes its sub-block eagerly against the shared scope — the analog of
+go_op.cc launching the sub-program on a framework thread; per-op compute
+still lowers through the XLA registry (eager-executed with concrete
+values)."""
+
+import threading
+import time
+
+import numpy as np
+
+from .registry import (register_host_op, get_host_op, run_op,
+                       LoweringContext)
+
+
+class _ScopeEnv(dict):
+    """env dict that falls back to scope-held values — Go sub-blocks read
+    parent program state the way reference sub-scopes chain to parents."""
+
+    def __init__(self, scope, *a, **kw):
+        super(_ScopeEnv, self).__init__(*a, **kw)
+        self._scope = scope
+
+    def _from_scope(self, key):
+        var = self._scope.find_var(key)
+        if var is None:
+            return None, False
+        v = var.value()
+        if v is None:
+            return None, False
+        return v, True
+
+    def __missing__(self, key):
+        v, ok = self._from_scope(key)
+        if not ok:
+            raise KeyError(key)
+        self[key] = v
+        return v
+
+    def __contains__(self, key):
+        if super(_ScopeEnv, self).__contains__(key):
+            return True
+        return self._from_scope(key)[1]
+
+    def get(self, key, default=None):
+        if super(_ScopeEnv, self).__contains__(key):
+            return super(_ScopeEnv, self).get(key)
+        v, ok = self._from_scope(key)
+        return v if ok else default
+
+
+def _run_block_eager(block, scope, env):
+    """Execute a block's ops sequentially with concrete values (the host
+    fallback interpreter — reference Executor::Run over a sub-block)."""
+    from ..fluid import core
+    ctx = LoweringContext(block, env, rng_key=None, place=core.CPUPlace())
+    ctx.scope = scope
+    for op in block.ops:
+        host_impl = get_host_op(op.type)
+        if host_impl is not None:
+            host_impl(ctx, op, scope)
+        else:
+            run_op(ctx, op)
+    return ctx
+
+
+@register_host_op('channel_create')
+def _channel_create(ctx, op, scope):
+    from ..runtime.native import NativeChannel
+    ch = NativeChannel(int(op.attrs.get('capacity', 0)))
+    name = op.output('Out')[0]
+    scope.var(name).set_value(ch)
+    ctx.store(name, ch)
+
+
+def _get_channel(ctx, op, scope, slot='Channel'):
+    ch = ctx.get(op, slot)
+    if ch is None:
+        names = op.input(slot)
+        var = scope.find_var(names[0]) if names else None
+        ch = var.value() if var is not None else None
+    return ch
+
+
+@register_host_op('channel_send')
+def _channel_send(ctx, op, scope):
+    from ..fluid.concurrency import _serialize
+    ch = _get_channel(ctx, op, scope)
+    x = ctx.get(op, 'X')
+    ok = ch.send(_serialize(np.asarray(x)))
+    names = op.output('Status')
+    if names:
+        st = np.asarray([ok])
+        scope.var(names[0]).set_value(st)
+        ctx.store(names[0], st)
+
+
+@register_host_op('channel_recv')
+def _channel_recv(ctx, op, scope):
+    from ..fluid.concurrency import _deserialize
+    from ..runtime.native import NativeChannel
+    ch = _get_channel(ctx, op, scope)
+    data = ch.recv()
+    out_name = op.output('Out')[0]
+    if data is NativeChannel.CLOSED:
+        ok = False
+        # zero value with the return variable's own shape/dtype (Go
+        # semantics); Out is an output slot, so read its current value
+        prev = ctx.env.get(out_name)
+        if prev is None:
+            var = scope.find_var(out_name)
+            prev = var.value() if var is not None else None
+        out = (np.zeros_like(np.asarray(prev))
+               if prev is not None else np.zeros((1, ), np.float32))
+    else:
+        ok = True
+        out = _deserialize(data)
+    scope.var(out_name).set_value(out)
+    ctx.store(out_name, out)
+    names = op.output('Status')
+    if names:
+        st = np.asarray([ok])
+        scope.var(names[0]).set_value(st)
+        ctx.store(names[0], st)
+
+
+@register_host_op('channel_close')
+def _channel_close(ctx, op, scope):
+    ch = _get_channel(ctx, op, scope)
+    ch.close()
+
+
+@register_host_op('go')
+def _go(ctx, op, scope):
+    sub_block = op.attrs['sub_block']
+    snapshot = _ScopeEnv(scope, dict(ctx.env))
+
+    def body():
+        _run_block_eager(sub_block, scope, snapshot)
+
+    t = threading.Thread(target=body, daemon=True)
+    t.start()
+
+
+@register_host_op('select')
+def _select(ctx, op, scope):
+    from ..fluid.concurrency import _serialize, _deserialize
+    from ..runtime.native import NativeChannel
+    kinds = op.attrs['case_kinds']
+    channels = op.attrs['case_channels']
+    values = op.attrs['case_values']
+    blocks = op.attrs['sub_blocks']
+    env = _ScopeEnv(scope, dict(ctx.env))
+
+    def chan(name):
+        v = env.get(name)
+        if v is None:
+            var = scope.find_var(name)
+            v = var.value() if var is not None else None
+        return v
+
+    def finish(blk):
+        _run_block_eager(blk, scope, env)
+        # select runs on a scope-backed env copy; surface its writes to the
+        # enclosing block so later ops / fetches observe case results
+        for k, v in env.items():
+            ctx.env[k] = v
+
+    while True:
+        default_block = None
+        for kind, ch_name, val_name, blk in zip(kinds, channels, values,
+                                                blocks):
+            if kind == 'default':
+                default_block = blk
+                continue
+            ch = chan(ch_name)
+            if kind == 'send':
+                r = ch.try_send(_serialize(np.asarray(env[val_name])))
+                if r is True:
+                    finish(blk)
+                    return
+            else:  # recv
+                r = ch.try_recv()
+                if r is not NativeChannel.WOULD_BLOCK:
+                    if r is NativeChannel.CLOSED:
+                        # recv-from-closed is immediately ready with the
+                        # zero value (Go semantics; matches _channel_recv)
+                        prev = env.get(val_name)
+                        out = (np.zeros_like(np.asarray(prev))
+                               if prev is not None
+                               else np.zeros((1, ), np.float32))
+                    else:
+                        out = _deserialize(r)
+                    env[val_name] = out
+                    scope.var(val_name).set_value(out)
+                    finish(blk)
+                    return
+        if default_block is not None:
+            finish(default_block)
+            return
+        time.sleep(0.001)

@@ -1,0 +1,198 @@
+"""CTC family: warpctc loss, ctc_align, edit_distance.
+
+Reference kernels: paddle/fluid/operators/warpctc_op.{cc,h} (wraps the
+dynloaded warp-ctc library), ctc_align_op.{cc,h}, edit_distance_op.{cc,h}.
+
+TPU-first design: the CTC log-likelihood is computed directly in the XLA
+trace as a ``lax.scan`` over time with the standard interleaved-blank alpha
+recursion in log space — no external warp-ctc library, and the gradient
+falls out of autodiff through the scan (the reference stores an explicit
+WarpCTCGrad tensor instead).  Padded (B, T, C) logits + (B, L) labels with
+``@SEQLEN`` side-bands replace the reference's LoD layout (SURVEY §5.7).
+ctc_align and edit_distance keep the reference's CPU-only placement as host
+ops (variable-size LoD outputs / sequential DP).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import (register_lowering, register_host_op, SEQLEN_SUFFIX)
+
+_NEG_INF = -1e30
+
+
+def _seqlen_of(ctx, op, slot, default_len, batch):
+    from .sequence_ops import _seqlen  # single home of the side-band idiom
+    lens = _seqlen(ctx, op, slot)
+    if lens is None:
+        return jnp.full((batch, ), default_len, jnp.int32)
+    return lens.astype(jnp.int32)
+
+
+def _ctc_loss_one(logp, label, t_len, l_len, blank):
+    """Negative log-likelihood of one (T, C) log-prob sequence against one
+    padded (L,) label row. Standard CTC alpha recursion over the
+    blank-interleaved label z of static length S = 2L+1."""
+    t_total, _ = logp.shape
+    l_pad = label.shape[0]
+    s_pad = 2 * l_pad + 1
+
+    s_idx = jnp.arange(s_pad)
+    is_lbl = (s_idx % 2) == 1
+    lbl_pos = jnp.where(is_lbl, (s_idx - 1) // 2, 0)
+    z = jnp.where(is_lbl, label[lbl_pos], blank)  # (S,)
+    s_valid = s_idx < (2 * l_len + 1)
+    # skip connection allowed when z[s] != blank and z[s] != z[s-2]
+    z_m2 = jnp.concatenate([jnp.full((2, ), -1, z.dtype), z[:-2]])
+    skip_ok = is_lbl & (z != z_m2)
+
+    def emis(t):
+        e = logp[t][z]  # (S,)
+        return jnp.where(s_valid, e, _NEG_INF)
+
+    alpha0 = jnp.where((s_idx < 2) & s_valid, emis(0), _NEG_INF)
+
+    def step(alpha, t):
+        prev1 = jnp.concatenate([jnp.array([_NEG_INF]), alpha[:-1]])
+        prev2 = jnp.concatenate([jnp.full((2, ), _NEG_INF), alpha[:-2]])
+        prev2 = jnp.where(skip_ok, prev2, _NEG_INF)
+        stacked = jnp.stack([alpha, prev1, prev2])
+        m = jnp.max(stacked, axis=0)
+        cand = m + jnp.log(
+            jnp.sum(jnp.exp(stacked - m[None]), axis=0) + 1e-37)
+        new = cand + emis(t)
+        # timesteps beyond the valid length carry alpha through unchanged
+        new = jnp.where(t < t_len, new, alpha)
+        return new, None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, t_total))
+    # final: logsumexp of alpha[S_valid-1], alpha[S_valid-2]
+    last = 2 * l_len  # index of final blank
+    a1 = alpha[last]
+    a2 = jnp.where(l_len > 0, alpha[jnp.maximum(last - 1, 0)], _NEG_INF)
+    m = jnp.maximum(a1, a2)
+    ll = m + jnp.log(jnp.exp(a1 - m) + jnp.exp(a2 - m) + 1e-37)
+    return -ll
+
+
+@register_lowering('warpctc')
+def _warpctc(ctx, op):
+    logits = ctx.get(op, 'Logits')  # (B, T, C) padded
+    label = ctx.get(op, 'Label')  # (B, L) or (B, L, 1) padded int
+    blank = int(op.attrs.get('blank', 0))
+    norm_by_times = bool(op.attrs.get('norm_by_times', False))
+    if label.ndim == 3:
+        label = label[..., 0]
+    label = label.astype(jnp.int32)
+    b, t, _ = logits.shape
+    t_lens = _seqlen_of(ctx, op, 'Logits', t, b)
+    l_lens = _seqlen_of(ctx, op, 'Label', label.shape[1], b)
+
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    loss = jax.vmap(
+        lambda lp, lb, tl, ll: _ctc_loss_one(lp, lb, tl, ll, blank))(
+            logp, label, t_lens, l_lens)
+    if norm_by_times:
+        loss = loss / jnp.maximum(t_lens.astype(loss.dtype), 1.0)
+    ctx.set(op, 'Loss', loss[:, None].astype(logits.dtype))
+
+
+def _rows_of(ctx, op, slot):
+    """Host-side view of a sequence input: list of per-instance 1-D numpy
+    rows (from a padded batch + lengths side-band, or a single row)."""
+    arr = np.asarray(ctx.get(op, slot))
+    names = op.input(slot)
+    lens = ctx.env.get(names[0] + SEQLEN_SUFFIX) if names else None
+    if arr.ndim >= 2 and arr.shape[-1] == 1:
+        arr = arr[..., 0]
+    if arr.ndim == 1:
+        if lens is not None and np.ndim(lens) and len(lens) > 1:
+            # concatenated LoD rows
+            out, ofs = [], 0
+            for l in np.asarray(lens).astype(int):
+                out.append(arr[ofs:ofs + l])
+                ofs += l
+            return out
+        return [arr]
+    lens = (np.asarray(lens).astype(int)
+            if lens is not None else [arr.shape[1]] * arr.shape[0])
+    return [arr[i, :lens[i]] for i in range(arr.shape[0])]
+
+
+@register_host_op('ctc_align')
+def _ctc_align(ctx, op, scope):
+    """Merge repeated tokens, drop blanks (reference ctc_align_op.h — the
+    decode side of CTC).  Variable-length output rows -> LoD host op."""
+    from ..fluid import core
+    blank = int(op.attrs.get('blank', 0))
+    merge_repeated = bool(op.attrs.get('merge_repeated', True))
+    rows = _rows_of(ctx, op, 'Input')
+    out_rows = []
+    for r in rows:
+        r = np.asarray(r).astype(np.int64).reshape(-1)
+        kept = []
+        prev = None
+        for v in r:
+            if merge_repeated and prev is not None and v == prev:
+                prev = v
+                continue
+            prev = v
+            if v != blank:
+                kept.append(int(v))
+        out_rows.append(kept)
+    lod = [0]
+    flat = []
+    for kr in out_rows:
+        flat.extend(kr)
+        lod.append(len(flat))
+    arr = np.asarray(flat, np.int64).reshape(-1, 1)
+    if arr.size == 0:
+        # reference pads a single -1 so downstream shapes stay non-empty
+        arr = np.full((1, 1), -1, np.int64)
+        lod = [0, 1]
+    out_name = op.output('Output')[0]
+    lt = core.LoDTensor(arr, [lod])
+    scope.var(out_name).set_value(lt)
+    ctx.store(out_name, arr)
+    ctx.env[out_name + SEQLEN_SUFFIX] = np.diff(np.asarray(lod))
+
+
+@register_host_op('edit_distance')
+def _edit_distance(ctx, op, scope):
+    """Levenshtein distance per (hyp, ref) sequence pair (reference
+    edit_distance_op.h — O(|h|*|r|) DP, CPU only)."""
+    normalized = bool(op.attrs.get('normalized', True))
+    hyps = _rows_of(ctx, op, 'Hyps')
+    refs = _rows_of(ctx, op, 'Refs')
+    out = np.zeros((len(hyps), 1), np.float32)
+    for i, (h, r) in enumerate(zip(hyps, refs)):
+        h = [int(v) for v in np.asarray(h).reshape(-1)]
+        r = [int(v) for v in np.asarray(r).reshape(-1)]
+        m, n = len(h), len(r)
+        if n == 0:
+            dist = float(m)
+        elif m == 0:
+            dist = float(n)
+        else:
+            dp = np.arange(n + 1, dtype=np.float32)
+            for x in range(1, m + 1):
+                prev_diag = dp[0]
+                dp[0] = x
+                for y in range(1, n + 1):
+                    cur = dp[y]
+                    cost = 0.0 if h[x - 1] == r[y - 1] else 1.0
+                    dp[y] = min(dp[y] + 1, dp[y - 1] + 1, prev_diag + cost)
+                    prev_diag = cur
+            dist = float(dp[n])
+        if normalized:
+            dist = dist / max(n, 1)
+        out[i, 0] = dist
+    out_name = op.output('Out')[0]
+    scope.var(out_name).set_value(out)
+    ctx.store(out_name, out)
+    seq_names = op.output('SequenceNum')
+    if seq_names:
+        sn = np.asarray([len(hyps)], np.int64)
+        scope.var(seq_names[0]).set_value(sn)
+        ctx.store(seq_names[0], sn)

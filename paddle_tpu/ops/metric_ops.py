@@ -60,3 +60,31 @@ def _precision_recall(ctx, op):
     f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-12)
     ctx.set(op, 'BatchMetrics',
             jnp.stack([jnp.mean(precision), jnp.mean(recall), jnp.mean(f1)]))
+
+
+@register_lowering('positive_negative_pair')
+def _positive_negative_pair(ctx, op):
+    """Ranking pair statistics within query groups (reference
+    operators/positive_negative_pair_op.cc): over all item pairs sharing a
+    QueryID with different labels, count score-order agreements (positive),
+    disagreements (negative) and ties (neutral); supports running
+    accumulation via the Accumulate* inputs."""
+    score = jnp.reshape(ctx.get(op, 'Score'), (-1, ))
+    label = jnp.reshape(ctx.get(op, 'Label'), (-1, ))
+    qid = jnp.reshape(ctx.get(op, 'QueryID'), (-1, ))
+    same_q = qid[:, None] == qid[None, :]
+    upper = jnp.triu(jnp.ones(same_q.shape, bool), k=1)
+    ldiff = label[:, None] - label[None, :]
+    sdiff = score[:, None] - score[None, :]
+    cand = same_q & upper & (ldiff != 0)
+    pos = jnp.sum((cand & (ldiff * sdiff > 0)).astype(jnp.float32))
+    neg = jnp.sum((cand & (ldiff * sdiff < 0)).astype(jnp.float32))
+    neu = jnp.sum((cand & (sdiff == 0)).astype(jnp.float32))
+    for in_slot, out_slot, v in (
+            ('AccumulatePositivePair', 'PositivePair', pos),
+            ('AccumulateNegativePair', 'NegativePair', neg),
+            ('AccumulateNeutralPair', 'NeutralPair', neu)):
+        prev = ctx.get(op, in_slot)
+        if prev is not None:
+            v = v + jnp.reshape(prev, ())
+        ctx.set(op, out_slot, jnp.reshape(v, (1, )))

@@ -437,3 +437,127 @@ def _nearest_interp(ctx, op):
     ow = op.attrs['out_w']
     ctx.set(op, 'Out',
             jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), 'nearest'))
+
+
+@register_lowering('roi_pool')
+def _roi_pool(ctx, op):
+    """Max pooling over regions of interest (reference
+    operators/roi_pool_op.{cc,h}): integer roi coords scaled by
+    spatial_scale; bin [i,j] maxes over its sub-window, empty bins emit 0.
+    ROIs arrive as an (R, 4) tensor (single image) or padded (B, R, 4) with
+    an @SEQLEN side-band mapping rois to batch images."""
+    x = ctx.get(op, 'X')  # (N, C, H, W)
+    rois = ctx.get(op, 'ROIs')
+    ph = int(op.attrs['pooled_height'])
+    pw = int(op.attrs['pooled_width'])
+    scale = float(op.attrs.get('spatial_scale', 1.0))
+    n, c, h, w = x.shape
+
+    from .sequence_ops import _seqlen
+    lens = _seqlen(ctx, op, 'ROIs')
+    if rois.ndim == 3:
+        batch_of_roi = jnp.repeat(jnp.arange(rois.shape[0]), rois.shape[1])
+        valid = (jnp.arange(rois.shape[1])[None, :] <
+                 (lens[:, None] if lens is not None
+                  else jnp.full((rois.shape[0], 1), rois.shape[1])))
+        valid = valid.reshape(-1)
+        rois = rois.reshape(-1, 4)
+    else:
+        if lens is not None and lens.shape[0] > 1:
+            # a concatenated 2-D roi layout with a multi-image LoD cannot
+            # be mapped to images under static shapes — feed rois as a
+            # lod_level=1 input (padded 3-D) instead of failing silently
+            # with every roi pooled from image 0
+            raise NotImplementedError(
+                'roi_pool: 2-D ROIs with a multi-image LoD side-band; '
+                'feed ROIs as a lod_level=1 input (padded per image)')
+        batch_of_roi = jnp.zeros((rois.shape[0], ), jnp.int32)
+        valid = jnp.ones((rois.shape[0], ), bool)
+
+    def pool_one(roi, img_idx):
+        img = x[img_idx]  # (C, H, W)
+        x1 = jnp.round(roi[0] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        i = jnp.arange(ph)
+        j = jnp.arange(pw)
+        hstart = jnp.clip((i * rh) // ph + y1, 0, h)
+        hend = jnp.clip(-((-(i + 1) * rh) // ph) + y1, 0, h)
+        wstart = jnp.clip((j * rw) // pw + x1, 0, w)
+        wend = jnp.clip(-((-(j + 1) * rw) // pw) + x1, 0, w)
+        ys = jnp.arange(h)
+        xsr = jnp.arange(w)
+        mask_h = (ys[None, :] >= hstart[:, None]) & (
+            ys[None, :] < hend[:, None])  # (ph, H)
+        mask_w = (xsr[None, :] >= wstart[:, None]) & (
+            xsr[None, :] < wend[:, None])  # (pw, W)
+        m = mask_h[:, None, :, None] & mask_w[None, :, None, :]  # ph pw H W
+        vals = jnp.where(m[None], img[:, None, None, :, :], -jnp.inf)
+        out = jnp.max(vals, axis=(3, 4))  # (C, ph, pw)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    out = jax.vmap(pool_one)(rois, batch_of_roi)  # (R, C, ph, pw)
+    out = jnp.where(valid[:, None, None, None], out, 0.0)
+    ctx.set(op, 'Out', out.astype(x.dtype))
+
+
+@register_lowering('unpool')
+def _unpool(ctx, op):
+    """Max unpooling (reference operators/unpool_op.cc): scatter each input
+    value to the flat spatial index recorded by the paired max-pool."""
+    x = ctx.get(op, 'X')  # (N, C, H, W)
+    idx = ctx.get(op, 'Indices')  # (N, C, H, W) flat indices into Ho*Wo
+    ksize = op.attrs['ksize']
+    strides = op.attrs.get('strides', [1, 1])
+    paddings = op.attrs.get('paddings', [0, 0])
+    n, c, h, w = x.shape
+    ho = (h - 1) * strides[0] - 2 * paddings[0] + ksize[0]
+    wo = (w - 1) * strides[1] - 2 * paddings[1] + ksize[1]
+    flat = jnp.zeros((n, c, ho * wo), x.dtype)
+    idx2 = idx.reshape(n, c, h * w).astype(jnp.int32)
+    vals = x.reshape(n, c, h * w)
+    ni = jnp.arange(n)[:, None, None]
+    ci = jnp.arange(c)[None, :, None]
+    flat = flat.at[ni, ci, idx2].set(vals)
+    ctx.set(op, 'Out', flat.reshape(n, c, ho, wo))
+
+
+@register_lowering('spp')
+def _spp(ctx, op):
+    """Spatial pyramid pooling (reference operators/spp_op.cc): levels
+    0..L-1 pool the feature map into (2^l x 2^l) adaptive bins, flattened
+    and concatenated to a fixed-length vector regardless of input H, W."""
+    x = ctx.get(op, 'X')  # (N, C, H, W)
+    levels = int(op.attrs['pyramid_height'])
+    ptype = op.attrs.get('pooling_type', 'max')
+    n, c, h, w = x.shape
+    outs = []
+    for l in range(levels):
+        bins = 2 ** l
+        i = jnp.arange(bins)
+        hstart = (i * h) // bins
+        hend = -((-(i + 1) * h) // bins)
+        wstart = (i * w) // bins
+        wend = -((-(i + 1) * w) // bins)
+        ys = jnp.arange(h)
+        xsr = jnp.arange(w)
+        mask_h = (ys[None, :] >= hstart[:, None]) & (
+            ys[None, :] < hend[:, None])  # (bins, H)
+        mask_w = (xsr[None, :] >= wstart[:, None]) & (
+            xsr[None, :] < wend[:, None])  # (bins, W)
+        m = mask_h[:, None, :, None] & mask_w[None, :, None, :]
+        mx = m[None, None]  # (1, 1, bins, bins, H, W)
+        xv = x[:, :, None, None, :, :]
+        if ptype == 'max':
+            pooled = jnp.max(jnp.where(mx, xv, -jnp.inf), axis=(4, 5))
+            # bins can be empty when 2^level exceeds H or W
+            pooled = jnp.where(jnp.isfinite(pooled), pooled, 0.0)
+        else:
+            cnt = jnp.sum(m, axis=(2, 3)).astype(x.dtype)  # (bins, bins)
+            pooled = jnp.sum(jnp.where(mx, xv, 0.0),
+                             axis=(4, 5)) / jnp.maximum(cnt[None, None], 1)
+        outs.append(pooled.reshape(n, -1))
+    ctx.set(op, 'Out', jnp.concatenate(outs, axis=1))

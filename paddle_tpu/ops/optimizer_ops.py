@@ -161,3 +161,41 @@ def _ftrl(ctx, op):
     ctx.set(op, 'ParamOut', p_out)
     ctx.set(op, 'SquaredAccumOut', new_accum)
     ctx.set(op, 'LinearAccumOut', lin_out)
+
+
+@register_lowering('proximal_gd')
+def _proximal_gd(ctx, op):
+    """(reference operators/proximal_gd_op.cc): prox step with L1/L2:
+    prox = param - lr * grad; out = sign(prox) * max(|prox| - lr*l1, 0)
+    / (1 + lr*l2)."""
+    p = ctx.get(op, 'Param')
+    g = ctx.get(op, 'Grad')
+    lr = _lr(ctx, op)
+    l1 = op.attrs.get('l1', 0.0)
+    l2 = op.attrs.get('l2', 0.0)
+    prox = p - lr * g
+    out = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+           / (1.0 + lr * l2))
+    ctx.set(op, 'ParamOut', out)
+
+
+@register_lowering('proximal_adagrad')
+def _proximal_adagrad(ctx, op):
+    """(reference operators/proximal_adagrad_op.cc): adagrad moment then
+    the same prox-l1/l2 shrinkage with per-element effective lr."""
+    p = ctx.get(op, 'Param')
+    g = ctx.get(op, 'Grad')
+    m = ctx.get(op, 'Moment')
+    lr = _lr(ctx, op)
+    l1 = op.attrs.get('l1', 0.0)
+    l2 = op.attrs.get('l2', 0.0)
+    m_out = m + g * g
+    # elements with zero accumulated moment (never any gradient) must not
+    # update: 1/sqrt(0) would blow up eff_lr and the l1 shrink would zero
+    # the parameter (the reference kernel NaNs here)
+    eff_lr = lr / (jnp.sqrt(m_out) + 1e-10)
+    prox = p - eff_lr * g
+    out = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - eff_lr * l1, 0.0)
+           / (1.0 + eff_lr * l2))
+    ctx.set(op, 'ParamOut', jnp.where(m_out > 0, out, p))
+    ctx.set(op, 'MomentOut', m_out)

@@ -1,0 +1,56 @@
+"""Fake quantization ops (reference: paddle/fluid/operators/fake_quantize_op.cc,
+fake_dequantize_op.cc) — simulate int8/intN inference during fp training.
+
+Gradients use the straight-through estimator written as
+``x + stop_gradient(q - x)`` so autodiff yields the identity pass-through the
+reference implements with a dedicated grad kernel.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_lowering
+
+
+def _quant(x, scale, bit_length):
+    bound = float((1 << (bit_length - 1)) - 1)
+    s = jnp.maximum(scale, 1e-10)
+    q = jnp.round(jnp.clip(x, -s, s) / s * bound)
+    # straight-through: forward = q, backward = identity
+    return x + jax.lax.stop_gradient(q - x)
+
+
+@register_lowering('fake_quantize_abs_max')
+def _fake_quantize_abs_max(ctx, op):
+    x = ctx.get(op, 'X')
+    bit_length = int(op.attrs.get('bit_length', 8))
+    scale = jnp.max(jnp.abs(x))
+    ctx.set(op, 'Out', _quant(x, scale, bit_length))
+    ctx.set(op, 'OutScale', jnp.reshape(scale, (1, )))
+
+
+@register_lowering('fake_quantize_range_abs_max')
+def _fake_quantize_range_abs_max(ctx, op):
+    """Training keeps a running max-abs scale over a window (reference
+    FakeQuantizeRangeAbsMaxOp); inference (is_test) freezes InScale."""
+    x = ctx.get(op, 'X')
+    in_scale = ctx.get(op, 'InScale')
+    bit_length = int(op.attrs.get('bit_length', 8))
+    is_test = bool(op.attrs.get('is_test', False)) or ctx.is_test
+    cur = jnp.max(jnp.abs(x))
+    if is_test and in_scale is not None:
+        scale = jnp.reshape(in_scale, ())
+    elif in_scale is not None:
+        scale = jnp.maximum(cur, jnp.reshape(in_scale, ()))
+    else:
+        scale = cur
+    ctx.set(op, 'Out', _quant(x, scale, bit_length))
+    ctx.set(op, 'OutScale', jnp.reshape(scale, (1, )))
+
+
+@register_lowering('fake_dequantize_max_abs')
+def _fake_dequantize_max_abs(ctx, op):
+    x = ctx.get(op, 'X')
+    scale = jnp.reshape(ctx.get(op, 'Scale'), ())
+    max_range = float(op.attrs['max_range'])
+    ctx.set(op, 'Out', x.astype(jnp.float32) * scale / max_range)

@@ -215,13 +215,41 @@ def _sequence_enumerate(ctx, op):
 
 @register_lowering('sequence_erase')
 def _sequence_erase(ctx, op):
-    # static shapes forbid true erasure; mask erased tokens to 0 instead
+    """Remove listed tokens (reference sequence_erase_op.cc shrinks the LoD
+    rows).  Static shapes forbid true erasure, so kept tokens are compacted
+    to the front of the padded buffer and the @SEQLEN side-band shrinks to
+    the new per-row counts — downstream sequence ops see the same semantics
+    as the reference's re-lodded output."""
     x = ctx.get(op, 'X')
     tokens = op.attrs.get('tokens', [])
-    keep = jnp.ones(x.shape, bool)
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    xv = x[..., 0] if squeeze else x
+    if xv.ndim == 1:
+        xv = xv[None]
+        batchless = True
+    else:
+        batchless = False
+    b, t = xv.shape[0], xv.shape[1]
+    lens = _seqlen(ctx, op)
+    if lens is None:
+        lens = jnp.full((b, ), t, jnp.int32)
+    valid = jnp.arange(t)[None, :] < lens[:, None]
+    keep = valid
     for tok in tokens:
-        keep = keep & (x != tok)
-    ctx.set(op, 'Out', jnp.where(keep, x, jnp.zeros_like(x)))
+        keep = keep & (xv != tok)
+    dest = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    # route dropped entries to a scratch column, then slice it off
+    dest = jnp.where(keep, dest, t)
+    out = jnp.zeros((b, t + 1), xv.dtype)
+    out = out.at[jnp.arange(b)[:, None], dest].set(xv)[:, :t]
+    new_lens = jnp.sum(keep.astype(jnp.int32), axis=1)
+    if batchless:
+        out = out[0]
+    if squeeze:
+        out = out[..., None]
+    ctx.set(op, 'Out', out)
+    for n in op.output('Out'):
+        ctx.env[n + SEQLEN_SUFFIX] = new_lens
 
 
 @register_lowering('sequence_pad')
@@ -424,3 +452,75 @@ def _sequence_mask_op(ctx, op):
     dummy = jnp.zeros((lengths.shape[0], maxlen))
     out_dtype = op.attrs.get('out_dtype', 'int64')
     ctx.set(op, 'Out', _mask(dummy, lengths, dtype=jnp.dtype(out_dtype)))
+
+
+@register_lowering('lstmp')
+def _lstmp(ctx, op):
+    """LSTM with recurrent projection (reference operators/lstmp_op.cc):
+    the recurrence feeds the projected state r_t = proj_act(h_t @ P) back
+    into the gates instead of h_t, shrinking the recurrent matmul for
+    large-vocab speech models.  Outputs Projection [B, T, P], Cell."""
+    x = ctx.get(op, 'Input')  # [B, T, 4D]
+    w = ctx.get(op, 'Weight')  # [P, 4D]
+    w_proj = ctx.get(op, 'ProjWeight')  # [D, P]
+    bias = ctx.get(op, 'Bias')
+    h0 = ctx.get(op, 'H0')  # [B, P] projected initial state
+    c0 = ctx.get(op, 'C0')  # [B, D]
+    lengths = _seqlen(ctx, op, 'Input')
+    use_peepholes = op.attrs.get('use_peepholes', False)
+    is_reverse = op.attrs.get('is_reverse', False)
+    gate_act = _act(op.attrs.get('gate_activation', 'sigmoid'))
+    cell_act = _act(op.attrs.get('cell_activation', 'tanh'))
+    cand_act = _act(op.attrs.get('candidate_activation', 'tanh'))
+    proj_act = _act(op.attrs.get('proj_activation', 'tanh'))
+
+    b_sz, t, d4 = x.shape
+    d = d4 // 4
+    p_dim = w_proj.shape[1]
+    gate_bias = bias[:, :4 * d] if bias is not None else 0.0
+    if use_peepholes and bias is not None:
+        w_ic = bias[0, 4 * d:5 * d]
+        w_fc = bias[0, 5 * d:6 * d]
+        w_oc = bias[0, 6 * d:7 * d]
+    r_prev = h0 if h0 is not None else jnp.zeros((b_sz, p_dim), x.dtype)
+    c_prev = c0 if c0 is not None else jnp.zeros((b_sz, d), x.dtype)
+
+    xs = jnp.swapaxes(x, 0, 1)
+    if is_reverse:
+        xs = jnp.flip(xs, 0)
+    if lengths is None:
+        step_mask = jnp.ones((t, b_sz), x.dtype)
+    else:
+        step_mask = _mask(x, lengths, x.dtype).T
+        if is_reverse:
+            step_mask = jnp.flip(step_mask, 0)
+
+    def step(carry, inp):
+        r, c = carry
+        x_t, m_t = inp
+        gates = x_t + r @ w + gate_bias
+        gc, gi, gf, go = jnp.split(gates, 4, axis=1)
+        if use_peepholes:
+            gi = gi + c * w_ic
+            gf = gf + c * w_fc
+        i = gate_act(gi)
+        f = gate_act(gf)
+        c_new = f * c + i * cand_act(gc)
+        if use_peepholes:
+            go = go + c_new * w_oc
+        o = gate_act(go)
+        h_new = o * cell_act(c_new)
+        r_new = proj_act(h_new @ w_proj)
+        m = m_t[:, None]
+        r_out = m * r_new + (1 - m) * r
+        c_out = m * c_new + (1 - m) * c
+        return (r_out, c_out), (r_out, c_out)
+
+    (_, _), (rs, cs) = jax.lax.scan(step, (r_prev, c_prev), (xs, step_mask))
+    if is_reverse:
+        rs = jnp.flip(rs, 0)
+        cs = jnp.flip(cs, 0)
+    ctx.set(op, 'Projection', jnp.swapaxes(rs, 0, 1))
+    ctx.set(op, 'Cell', jnp.swapaxes(cs, 0, 1))
+    ctx.set(op, 'BatchGate', x)
+    ctx.set(op, 'BatchHidden', jnp.swapaxes(rs, 0, 1))

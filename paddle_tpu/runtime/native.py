@@ -72,6 +72,27 @@ def _load():
         lib.hp_in_use.restype = ctypes.c_uint64
         lib.hp_cached.restype = ctypes.c_uint64
         lib.hp_peak.restype = ctypes.c_uint64
+        # CSP channels
+        lib.ch_create.restype = ctypes.c_void_p
+        lib.ch_create.argtypes = [ctypes.c_uint64]
+        lib.ch_destroy.argtypes = [ctypes.c_void_p]
+        lib.ch_size.restype = ctypes.c_uint64
+        lib.ch_size.argtypes = [ctypes.c_void_p]
+        lib.ch_is_closed.restype = ctypes.c_int
+        lib.ch_is_closed.argtypes = [ctypes.c_void_p]
+        lib.ch_close.argtypes = [ctypes.c_void_p]
+        lib.ch_send.restype = ctypes.c_int
+        lib.ch_send.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_uint64]
+        lib.ch_try_send.restype = ctypes.c_int
+        lib.ch_try_send.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_uint64]
+        lib.ch_recv.restype = ctypes.c_int
+        lib.ch_recv.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_uint64]
+        lib.ch_try_recv.restype = ctypes.c_int
+        lib.ch_try_recv.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_uint64]
         _lib = lib
         return _lib
 
@@ -290,3 +311,162 @@ def host_pool_stats():
         'peak': int(lib.hp_peak()),
         'native': True,
     }
+
+
+class _PyChan(object):
+    """Pure-Python mirror of csrc/channel.cc — same rendezvous, try and
+    close-drain semantics, used when the native lib is unavailable."""
+
+    def __init__(self, capacity):
+        self.capacity = capacity
+        self._cond = threading.Condition()
+        self._items = []
+        self._recv_waiters = 0
+        self._sent_seq = 0
+        self._taken_seq = 0
+        self._closed = False
+
+    def send(self, data):
+        with self._cond:
+            eff = self.capacity or 1
+            self._cond.wait_for(
+                lambda: self._closed or len(self._items) < eff)
+            if self._closed:
+                return False
+            self._items.append(bytes(data))
+            self._sent_seq += 1
+            my_seq = self._sent_seq
+            self._cond.notify_all()
+            if self.capacity == 0:
+                self._cond.wait_for(
+                    lambda: self._closed or self._taken_seq >= my_seq)
+                if self._taken_seq < my_seq:
+                    return False
+            return True
+
+    def try_send(self, data):
+        with self._cond:
+            if self._closed:
+                return NativeChannel.CLOSED
+            if self.capacity == 0:
+                if self._recv_waiters <= 0 or self._items:
+                    return NativeChannel.WOULD_BLOCK
+            elif len(self._items) >= self.capacity:
+                return NativeChannel.WOULD_BLOCK
+            self._items.append(bytes(data))
+            self._sent_seq += 1
+            self._cond.notify_all()
+            return True
+
+    def _pop_locked(self):
+        item = self._items.pop(0)
+        self._taken_seq += 1
+        self._cond.notify_all()
+        return item
+
+    def recv(self):
+        with self._cond:
+            self._recv_waiters += 1
+            self._cond.notify_all()
+            self._cond.wait_for(lambda: self._closed or self._items)
+            self._recv_waiters -= 1
+            if not self._items:
+                return NativeChannel.CLOSED
+            return self._pop_locked()
+
+    def try_recv(self):
+        with self._cond:
+            if not self._items:
+                return (NativeChannel.CLOSED
+                        if self._closed else NativeChannel.WOULD_BLOCK)
+            return self._pop_locked()
+
+    def close(self):
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def size(self):
+        with self._cond:
+            return len(self._items)
+
+
+class NativeChannel(object):
+    """CSP channel over the native runtime (csrc/channel.cc), with a pure
+    Python fallback (_PyChan) implementing the same semantics.
+    capacity=0 means unbuffered rendezvous (reference framework/channel.h
+    MakeChannel semantics)."""
+
+    WOULD_BLOCK = object()
+    CLOSED = object()
+
+    def __init__(self, capacity=0):
+        self.capacity = capacity
+        lib = _load()
+        self._lib = lib
+        if lib is None:
+            self._q = _PyChan(capacity)
+            self._cap = 1 << 12
+            return
+        self._q = None
+        self._h = lib.ch_create(capacity)
+        self._cap = 1 << 12
+
+    # payloads are opaque bytes; serialization lives in fluid.concurrency
+    def send(self, data):
+        """True on delivery, False if the channel is/was closed."""
+        if self._q is not None:
+            return self._q.send(data)
+        return self._lib.ch_send(self._h, bytes(data), len(data)) == 0
+
+    def try_send(self, data):
+        if self._q is not None:
+            return self._q.try_send(data)
+        r = self._lib.ch_try_send(self._h, bytes(data), len(data))
+        if r == 0:
+            return True
+        return self.CLOSED if r == -1 else self.WOULD_BLOCK
+
+    def _recv_native(self, fn):
+        cap = self._cap
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = fn(self._h, buf, cap)
+            if n == -1:
+                return self.CLOSED
+            if n == -2:
+                return self.WOULD_BLOCK
+            if n <= -3:
+                cap = -(n + 3)
+                self._cap = max(self._cap, cap)
+                continue
+            return buf.raw[:n]
+
+    def recv(self):
+        """bytes, or CLOSED after close+drain."""
+        if self._q is not None:
+            return self._q.recv()
+        return self._recv_native(self._lib.ch_recv)
+
+    def try_recv(self):
+        if self._q is not None:
+            return self._q.try_recv()
+        return self._recv_native(self._lib.ch_try_recv)
+
+    def close(self):
+        if self._q is not None:
+            self._q.close()
+            return
+        self._lib.ch_close(self._h)
+
+    def size(self):
+        if self._q is not None:
+            return self._q.size()
+        return int(self._lib.ch_size(self._h))
+
+    def __del__(self):
+        try:
+            if self._q is None and self._lib is not None:
+                self._lib.ch_destroy(self._h)
+        except Exception:
+            pass

@@ -1,0 +1,179 @@
+"""CSP concurrency tests (reference parity:
+python/paddle/fluid/tests/no_test_concurrency.py and
+framework/channel_test.cc): goroutine send/recv, buffered fan-in,
+close-drain semantics, select with ready case and default."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def test_go_channel_roundtrip():
+    """Goroutine computes and sends; main program receives (reference
+    no_test_concurrency.py simple Go/channel example)."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        ch = fluid.make_channel(dtype='float32')
+        x = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                      value=10.0)
+        with fluid.Go():
+            doubled = fluid.layers.scale(x, scale=2.0)
+            fluid.channel_send(ch, doubled)
+        result = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                            value=0.0)
+        result, status = fluid.channel_recv(ch, result)
+        fluid.channel_close(ch)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        rv, sv = exe.run(prog, feed={}, fetch_list=[result, status])
+    assert float(np.asarray(rv).flatten()[0]) == 20.0
+    assert bool(np.asarray(sv).flatten()[0])
+
+
+def test_buffered_channel_multiple_sends():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        ch = fluid.make_channel(dtype='float32', capacity=4)
+        vals = []
+        for i in range(3):
+            v = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                           value=float(i + 1))
+            fluid.channel_send(ch, v)
+        fluid.channel_close(ch)
+        outs = []
+        stats = []
+        for i in range(4):  # one more recv than sends: last sees closed
+            r = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                           value=-1.0)
+            r, st = fluid.channel_recv(ch, r)
+            outs.append(r)
+            stats.append(st)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        fetched = exe.run(prog, feed={}, fetch_list=outs + stats)
+    got = [float(np.asarray(v).flatten()[0]) for v in fetched[:4]]
+    oks = [bool(np.asarray(v).flatten()[0]) for v in fetched[4:]]
+    assert got[:3] == [1.0, 2.0, 3.0]
+    assert oks == [True, True, True, False]
+    assert got[3] == 0.0  # zero value after close+drain
+
+
+def test_select_ready_recv_and_default():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        ch = fluid.make_channel(dtype='float32', capacity=1)
+        v = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                       value=7.0)
+        fluid.channel_send(ch, v)
+        got = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                         value=0.0)
+        marker = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                            value=0.0)
+        with fluid.Select() as sel:
+            with sel.case(fluid.channel_recv, ch, got):
+                fluid.layers.assign(
+                    fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                               value=1.0), marker)
+            with sel.default():
+                fluid.layers.assign(
+                    fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                               value=2.0), marker)
+        # second select: channel now empty -> default fires
+        marker2 = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                             value=0.0)
+        got2 = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                          value=0.0)
+        with fluid.Select() as sel2:
+            with sel2.case(fluid.channel_recv, ch, got2):
+                fluid.layers.assign(
+                    fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                               value=1.0), marker2)
+            with sel2.default():
+                fluid.layers.assign(
+                    fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                               value=2.0), marker2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        gv, mv, m2 = exe.run(prog, feed={},
+                             fetch_list=[got, marker, marker2])
+    assert float(np.asarray(gv).flatten()[0]) == 7.0
+    assert float(np.asarray(mv).flatten()[0]) == 1.0
+    assert float(np.asarray(m2).flatten()[0]) == 2.0
+
+
+def test_go_pipeline_unbuffered():
+    """Two chained goroutines over unbuffered channels (rendezvous)."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        ch1 = fluid.make_channel(dtype='float32')
+        ch2 = fluid.make_channel(dtype='float32')
+        x = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                       value=3.0)
+        with fluid.Go():
+            fluid.channel_send(ch1, x)
+        with fluid.Go():
+            mid = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                             value=0.0)
+            mid, _ = fluid.channel_recv(ch1, mid)
+            out_v = fluid.layers.scale(mid, scale=5.0)
+            fluid.channel_send(ch2, out_v)
+        final = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                           value=0.0)
+        final, _ = fluid.channel_recv(ch2, final)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        fv, = exe.run(prog, feed={}, fetch_list=[final])
+    assert float(np.asarray(fv).flatten()[0]) == 15.0
+
+
+def test_select_on_closed_channel_is_ready():
+    """recv-from-closed is immediately ready with the zero value (Go
+    semantics) — select must not spin."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        ch = fluid.make_channel(dtype='float32', capacity=1)
+        fluid.channel_close(ch)
+        got = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                         value=-1.0)
+        marker = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                            value=0.0)
+        with fluid.Select() as sel:
+            with sel.case(fluid.channel_recv, ch, got):
+                fluid.layers.assign(
+                    fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                               value=1.0), marker)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        gv, mv = exe.run(prog, feed={}, fetch_list=[got, marker])
+    assert float(np.asarray(gv).flatten()[0]) == 0.0  # zero value
+    assert float(np.asarray(mv).flatten()[0]) == 1.0  # case ran
+
+
+def test_rendezvous_after_try_send():
+    """Mixing try_send (select) and blocking send must preserve the
+    sender-blocks-until-pickup invariant (csrc/channel.cc taken_seq)."""
+    import threading
+    import time
+    from paddle_tpu.runtime.native import NativeChannel
+    ch = NativeChannel(0)
+    got = []
+    t = threading.Thread(target=lambda: got.append(ch.recv()))
+    t.start()
+    time.sleep(0.05)  # receiver waiting
+    assert ch.try_send(b'a') is True
+    t.join()
+    assert got == [b'a']
+    # now: blocking send must NOT return before a receiver picks it up
+    state = {'sent': False}
+
+    def sender():
+        ch.send(b'b')
+        state['sent'] = True
+
+    ts = threading.Thread(target=sender, daemon=True)
+    ts.start()
+    time.sleep(0.1)
+    assert not state['sent'], 'send returned with no receiver (rendezvous broken)'
+    assert ch.recv() == b'b'
+    ts.join(timeout=2)
+    assert state['sent']

@@ -1,0 +1,161 @@
+"""CTC stack tests (reference parity: test_warpctc_op.py,
+test_ctc_align_op.py, test_edit_distance_op.py)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+from helpers import lod_feed
+
+
+def _run(prog, feed, fetch_list):
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        return exe.run(prog, feed=feed, fetch_list=fetch_list)
+
+
+def _np_ctc_loss(logits, labels, blank=0):
+    """Brute-force CTC -log p by summing over all alignments (tiny T)."""
+    t, c = logits.shape
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = probs / probs.sum(-1, keepdims=True)
+
+    def collapse(path):
+        out = []
+        prev = None
+        for p in path:
+            if p != prev:
+                prev = p
+                if p != blank:
+                    out.append(p)
+            prev = p
+        return tuple(out)
+
+    import itertools
+    total = 0.0
+    for path in itertools.product(range(c), repeat=t):
+        if collapse(path) == tuple(labels):
+            pr = 1.0
+            for step, sym in enumerate(path):
+                pr *= probs[step, sym]
+            total += pr
+    return -np.log(total)
+
+
+def test_warpctc_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    t, c = 4, 3  # tiny enough for exhaustive alignment enumeration
+    logits_rows = [rng.standard_normal((t, c)).astype(np.float32),
+                   rng.standard_normal((t - 1, c)).astype(np.float32)]
+    label_rows = [[[1], [2]], [[2]]]
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        lg = fluid.layers.data(name='lg', shape=[c], dtype='float32',
+                               lod_level=1)
+        lb = fluid.layers.data(name='lb', shape=[1], dtype='int64',
+                               lod_level=1)
+        loss = fluid.layers.warpctc(lg, lb, blank=0)
+    lv, = _run(prog, {
+        'lg': lod_feed([r.tolist() for r in logits_rows], 'float32', dim=c),
+        'lb': lod_feed(label_rows, 'int64'),
+    }, [loss])
+    want0 = _np_ctc_loss(logits_rows[0], [1, 2])
+    want1 = _np_ctc_loss(logits_rows[1], [2])
+    np.testing.assert_allclose(np.asarray(lv).flatten(), [want0, want1],
+                               rtol=1e-4)
+
+
+def test_warpctc_trains():
+    rng = np.random.RandomState(1)
+    t, c, b = 6, 5, 3
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[8], dtype='float32',
+                              lod_level=1)
+        lb = fluid.layers.data(name='lb', shape=[1], dtype='int64',
+                               lod_level=1)
+        logits = fluid.layers.fc(x, size=c)
+        loss = fluid.layers.mean(fluid.layers.warpctc(logits, lb, blank=0))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    x_rows = [rng.standard_normal((t, 8)).astype(np.float32).tolist()
+              for _ in range(b)]
+    lbl_rows = [[[1], [2]], [[3]], [[2], [4], [1]]]
+    feed = {'x': lod_feed(x_rows, 'float32', dim=8),
+            'lb': lod_feed(lbl_rows, 'int64')}
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(25):
+            lv, = exe.run(prog, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).flatten()[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_ctc_align():
+    from paddle_tpu.fluid.layer_helper import LayerHelper
+    rows = [[[0], [1], [1], [0], [2], [2]], [[2], [0], [0], [3]]]
+    # direct op path (align an int sequence, no argmax)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[1], dtype='int64',
+                              lod_level=1)
+        helper = LayerHelper('ctc_align')
+        aligned = helper.create_variable_for_type_inference('int64')
+        helper.append_op(type='ctc_align', inputs={'Input': [x]},
+                         outputs={'Output': [aligned]},
+                         attrs={'blank': 0, 'merge_repeated': True})
+    ov, = _run(prog, {'x': lod_feed(rows, 'int64')}, [aligned])
+    np.testing.assert_array_equal(np.asarray(ov).flatten(), [1, 2, 2, 3])
+
+
+def test_ctc_greedy_decoder():
+    # probs (2 seqs): argmax path [1,1,0,2] -> [1,2]; [0,3] -> [3]
+    seq1 = [[0.1, 0.8, 0.05, 0.05], [0.1, 0.7, 0.1, 0.1],
+            [0.9, 0.05, 0.03, 0.02], [0.05, 0.05, 0.8, 0.1]]
+    seq2 = [[0.9, 0.0, 0.05, 0.05], [0.1, 0.1, 0.1, 0.7]]
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32',
+                              lod_level=1)
+        dec = fluid.layers.ctc_greedy_decoder(x, blank=0)
+    dv, = _run(prog, {'x': lod_feed([seq1, seq2], 'float32', dim=4)}, [dec])
+    np.testing.assert_array_equal(np.asarray(dv).flatten(), [1, 2, 3])
+
+
+def test_edit_distance():
+    hyp = [[[1], [2], [3]], [[5], [6]]]
+    ref = [[[1], [3], [3]], [[6], [5], [7]]]
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        h = fluid.layers.data(name='h', shape=[1], dtype='int64',
+                              lod_level=1)
+        r = fluid.layers.data(name='r', shape=[1], dtype='int64',
+                              lod_level=1)
+        dist, seq_num = fluid.layers.edit_distance(h, r, normalized=False)
+        dist_n, _ = fluid.layers.edit_distance(h, r, normalized=True)
+    dv, nv, sn = _run(prog, {'h': lod_feed(hyp, 'int64'),
+                             'r': lod_feed(ref, 'int64')},
+                      [dist, dist_n, seq_num])
+    np.testing.assert_allclose(np.asarray(dv).flatten(), [1.0, 2.0])
+    np.testing.assert_allclose(np.asarray(nv).flatten(),
+                               [1.0 / 3.0, 2.0 / 3.0], rtol=1e-5)
+    assert int(np.asarray(sn).flatten()[0]) == 2
+
+
+def test_edit_distance_ignored_tokens():
+    hyp = [[[1], [9], [2]]]
+    ref = [[[1], [2], [9]]]
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        h = fluid.layers.data(name='h', shape=[1], dtype='int64',
+                              lod_level=1)
+        r = fluid.layers.data(name='r', shape=[1], dtype='int64',
+                              lod_level=1)
+        dist, _ = fluid.layers.edit_distance(h, r, normalized=False,
+                                             ignored_tokens=[9])
+    dv, = _run(prog, {'h': lod_feed(hyp, 'int64'),
+                      'r': lod_feed(ref, 'int64')}, [dist])
+    np.testing.assert_allclose(np.asarray(dv).flatten(), [0.0])

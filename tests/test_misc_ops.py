@@ -1,0 +1,320 @@
+"""Quantization / roi_pool / unpool / spp / lstmp / proximal optimizers /
+positive_negative_pair (reference parity: test_fake_quantize_op.py,
+test_fake_dequantize_op.py, test_roi_pool_op.py, test_unpool_op.py,
+test_spp_op.py, test_lstmp_op.py, test_proximal_gd_op.py,
+test_proximal_adagrad_op.py, test_positive_negative_pair_op.py)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+from op_test import OpTest
+from helpers import lod_feed
+
+
+def test_fake_quantize_abs_max():
+    rng = np.random.RandomState(0)
+    x = rng.standard_normal((8, 16)).astype(np.float32)
+    scale = np.abs(x).max()
+    t = OpTest()
+    t.op_type = 'fake_quantize_abs_max'
+    t.inputs = {'X': x}
+    t.attrs = {'bit_length': 8}
+    t.outputs = {
+        'Out': np.round(x / scale * 127),
+        'OutScale': np.asarray([scale], np.float32),
+    }
+    t.check_output()
+
+
+def test_fake_dequantize_max_abs():
+    rng = np.random.RandomState(1)
+    x = np.round(rng.standard_normal((4, 4)) * 100).astype(np.float32)
+    scale = np.asarray([7.5], np.float32)
+    t = OpTest()
+    t.op_type = 'fake_dequantize_max_abs'
+    t.inputs = {'X': x, 'Scale': scale}
+    t.attrs = {'max_range': 127.0}
+    t.outputs = {'Out': x * 7.5 / 127.0}
+    t.check_output()
+
+
+def test_fake_quantize_straight_through_gradient():
+    """STE: gradient through quantization must be identity, so a quantized
+    linear model still trains."""
+    rng = np.random.RandomState(2)
+    prog, startup = fluid.Program(), fluid.Program()
+    from paddle_tpu.fluid.layer_helper import LayerHelper
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        yt = fluid.layers.data(name='yt', shape=[1], dtype='float32')
+        h = fluid.layers.fc(x, size=8, act='relu')
+        helper = LayerHelper('fake_quantize_abs_max')
+        q = helper.create_variable_for_type_inference('float32')
+        s = helper.create_variable_for_type_inference('float32')
+        helper.append_op(type='fake_quantize_abs_max',
+                         inputs={'X': [h]},
+                         outputs={'Out': [q], 'OutScale': [s]},
+                         attrs={'bit_length': 8})
+        q.shape = h.shape
+        deq = helper.create_variable_for_type_inference('float32')
+        helper.append_op(type='fake_dequantize_max_abs',
+                         inputs={'X': [q], 'Scale': [s]},
+                         outputs={'Out': [deq]},
+                         attrs={'max_range': 127.0})
+        deq.shape = h.shape
+        pred = fluid.layers.fc(deq, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, yt))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    xv = rng.standard_normal((16, 4)).astype(np.float32)
+    yv = (xv.sum(1, keepdims=True) * 0.5).astype(np.float32)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(30):
+            lv, = exe.run(prog, feed={'x': xv, 'yt': yv},
+                          fetch_list=[loss])
+            losses.append(float(np.asarray(lv).flatten()[0]))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def _np_roi_pool(x, rois, batch_idx, ph, pw, scale):
+    r_out = np.zeros((rois.shape[0], x.shape[1], ph, pw), np.float32)
+    for ri, roi in enumerate(rois):
+        img = x[batch_idx[ri]]
+        x1, y1, x2, y2 = [int(round(v * scale)) for v in roi]
+        rw = max(x2 - x1 + 1, 1)
+        rh = max(y2 - y1 + 1, 1)
+        for i in range(ph):
+            hs = min(max(y1 + (i * rh) // ph, 0), x.shape[2])
+            he = min(max(y1 - ((-(i + 1) * rh) // ph), 0), x.shape[2])
+            for j in range(pw):
+                ws = min(max(x1 + (j * rw) // pw, 0), x.shape[3])
+                we = min(max(x1 - ((-(j + 1) * rw) // pw), 0), x.shape[3])
+                region = img[:, hs:he, ws:we]
+                if region.size:
+                    r_out[ri, :, i, j] = region.reshape(
+                        x.shape[1], -1).max(axis=1)
+    return r_out
+
+
+def test_roi_pool_matches_numpy():
+    rng = np.random.RandomState(3)
+    x = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    rois_rows = [[[0., 0., 7., 7.]], [[2., 2., 6., 5.], [0., 0., 3., 3.]]]
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        xv = fluid.layers.data(name='x', shape=[3, 8, 8], dtype='float32')
+        rois = fluid.layers.data(name='rois', shape=[4], dtype='float32',
+                                 lod_level=1)
+        out = fluid.layers.roi_pool(xv, rois, pooled_height=2,
+                                    pooled_width=2, spatial_scale=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        ov, = exe.run(prog, feed={
+            'x': x, 'rois': lod_feed(rois_rows, 'float32', dim=4)},
+            fetch_list=[out])
+    flat_rois = np.asarray([r for rows in rois_rows for r in rows])
+    batch_idx = [0, 1, 1]
+    want = _np_roi_pool(x, flat_rois, batch_idx, 2, 2, 1.0)
+    got = np.asarray(ov).reshape(-1, 3, 2, 2)
+    # rois are padded per image to a bucketed row count; valid rows sit at
+    # [img * rmax + k]
+    rmax = got.shape[0] // 2
+    np.testing.assert_allclose(got[0], want[0], rtol=1e-5)
+    np.testing.assert_allclose(got[rmax], want[1], rtol=1e-5)
+    np.testing.assert_allclose(got[rmax + 1], want[2], rtol=1e-5)
+    # padding rows are zeroed
+    np.testing.assert_allclose(got[1], 0.0, atol=1e-6)
+
+
+def test_unpool_roundtrip():
+    from paddle_tpu.fluid.layer_helper import LayerHelper
+    x = np.array([[[[5., 9.], [3., 7.]]]], np.float32)
+    # indices into the 4x4 unpooled map
+    idx = np.array([[[[0, 3], [10, 15]]]], np.int32)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        xv = fluid.layers.data(name='x', shape=[1, 2, 2], dtype='float32')
+        iv = fluid.layers.data(name='i', shape=[1, 2, 2], dtype='int32')
+        helper = LayerHelper('unpool')
+        out = helper.create_variable_for_type_inference('float32')
+        helper.append_op(type='unpool',
+                         inputs={'X': [xv], 'Indices': [iv]},
+                         outputs={'Out': [out]},
+                         attrs={'ksize': [2, 2], 'strides': [2, 2],
+                                'paddings': [0, 0],
+                                'unpooling_type': 'max'})
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        ov, = exe.run(prog, feed={'x': x, 'i': idx}, fetch_list=[out])
+    ov = np.asarray(ov)
+    assert ov.shape == (1, 1, 4, 4)
+    want = np.zeros((4, 4), np.float32)
+    want[0, 0], want[0, 3], want[2, 2], want[3, 3] = 5., 9., 3., 7.
+    np.testing.assert_allclose(ov[0, 0], want)
+
+
+def test_spp_shapes_and_values():
+    from paddle_tpu.fluid.layer_helper import LayerHelper
+    rng = np.random.RandomState(4)
+    x = rng.standard_normal((2, 3, 6, 6)).astype(np.float32)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        xv = fluid.layers.data(name='x', shape=[3, 6, 6], dtype='float32')
+        helper = LayerHelper('spp')
+        out = helper.create_variable_for_type_inference('float32')
+        helper.append_op(type='spp', inputs={'X': [xv]},
+                         outputs={'Out': [out]},
+                         attrs={'pyramid_height': 2,
+                                'pooling_type': 'max'})
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        ov, = exe.run(prog, feed={'x': x}, fetch_list=[out])
+    ov = np.asarray(ov)
+    # level 0: 1x1 bins (3 ch), level 1: 2x2 bins (12 ch) -> 15 per image
+    assert ov.shape == (2, 15)
+    np.testing.assert_allclose(ov[:, :3], x.max(axis=(2, 3)), rtol=1e-5)
+    np.testing.assert_allclose(ov[0, 3], x[0, 0, :3, :3].max(), rtol=1e-5)
+
+
+def test_proximal_gd_matches_numpy():
+    rng = np.random.RandomState(5)
+    p = rng.standard_normal((4, 3)).astype(np.float32)
+    g = rng.standard_normal((4, 3)).astype(np.float32)
+    lr, l1, l2 = 0.1, 0.05, 0.02
+    prox = p - lr * g
+    want = np.sign(prox) * np.maximum(np.abs(prox) - lr * l1, 0) / (
+        1 + lr * l2)
+    t = OpTest()
+    t.op_type = 'proximal_gd'
+    t.inputs = {'Param': p, 'Grad': g,
+                'LearningRate': np.asarray([lr], np.float32)}
+    t.attrs = {'l1': l1, 'l2': l2}
+    t.outputs = {'ParamOut': want}
+    t.check_output()
+
+
+def test_proximal_adagrad_matches_numpy():
+    rng = np.random.RandomState(6)
+    p = rng.standard_normal((4, 3)).astype(np.float32)
+    g = rng.standard_normal((4, 3)).astype(np.float32)
+    m = np.abs(rng.standard_normal((4, 3))).astype(np.float32)
+    lr, l1, l2 = 0.1, 0.05, 0.02
+    m_out = m + g * g
+    eff = lr / np.sqrt(m_out)
+    prox = p - eff * g
+    want = np.sign(prox) * np.maximum(np.abs(prox) - eff * l1, 0) / (
+        1 + eff * l2)
+    t = OpTest()
+    t.op_type = 'proximal_adagrad'
+    t.inputs = {'Param': p, 'Grad': g, 'Moment': m,
+                'LearningRate': np.asarray([lr], np.float32)}
+    t.attrs = {'l1': l1, 'l2': l2}
+    t.outputs = {'ParamOut': want, 'MomentOut': m_out}
+    t.check_output()
+
+
+def test_proximal_optimizers_train():
+    rng = np.random.RandomState(7)
+    for opt in (fluid.optimizer.ProximalGD(learning_rate=0.1, l1=1e-4),
+                fluid.optimizer.ProximalAdagrad(learning_rate=0.5,
+                                                l1=1e-4)):
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+            y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+            pred = fluid.layers.fc(x, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            opt.minimize(loss)
+        xv = rng.standard_normal((16, 4)).astype(np.float32)
+        yv = (xv @ np.asarray([1., -2., 0.5, 3.],
+                              np.float32)[:, None]).astype(np.float32)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.core.Scope()):
+            exe.run(startup)
+            losses = []
+            for _ in range(30):
+                lv, = exe.run(prog, feed={'x': xv, 'y': yv},
+                              fetch_list=[loss])
+                losses.append(float(np.asarray(lv).flatten()[0]))
+        assert losses[-1] < losses[0] * 0.5, (type(opt), losses[0],
+                                              losses[-1])
+
+
+def test_positive_negative_pair():
+    from paddle_tpu.fluid.layer_helper import LayerHelper
+    score = np.asarray([[0.8], [0.2], [0.5], [0.9]], np.float32)
+    label = np.asarray([[1.], [0.], [1.], [0.]], np.float32)
+    qid = np.asarray([[0], [0], [1], [1]], np.int64)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        s = fluid.layers.data(name='s', shape=[1], dtype='float32')
+        l = fluid.layers.data(name='l', shape=[1], dtype='float32')
+        q = fluid.layers.data(name='q', shape=[1], dtype='int64')
+        helper = LayerHelper('positive_negative_pair')
+        pos = helper.create_variable_for_type_inference('float32')
+        neg = helper.create_variable_for_type_inference('float32')
+        neu = helper.create_variable_for_type_inference('float32')
+        helper.append_op(type='positive_negative_pair',
+                         inputs={'Score': [s], 'Label': [l],
+                                 'QueryID': [q]},
+                         outputs={'PositivePair': [pos],
+                                  'NegativePair': [neg],
+                                  'NeutralPair': [neu]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        pv, nv, uv = exe.run(prog, feed={'s': score, 'l': label, 'q': qid},
+                             fetch_list=[pos, neg, neu])
+    # query 0: (0.8 vs 0.2) label (1 vs 0): agree -> positive
+    # query 1: (0.5 vs 0.9) label (1 vs 0): disagree -> negative
+    assert float(np.asarray(pv)[0]) == 1.0
+    assert float(np.asarray(nv)[0]) == 1.0
+    assert float(np.asarray(uv)[0]) == 0.0
+
+
+def test_dynamic_lstmp_trains():
+    rng = np.random.RandomState(8)
+    d, p_dim = 8, 4
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[6], dtype='float32',
+                              lod_level=1)
+        yl = fluid.layers.data(name='yl', shape=[1], dtype='int64')
+        proj_in = fluid.layers.fc(x, size=4 * d)
+        proj, cell = fluid.layers.dynamic_lstmp(
+            proj_in, size=4 * d, proj_size=p_dim, use_peepholes=False)
+        last = fluid.layers.sequence_last_step(proj)
+        pred = fluid.layers.fc(last, size=3, act='softmax')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, yl))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    rows = [rng.standard_normal((t, 6)).astype(np.float32).tolist()
+            for t in (3, 5, 4)]
+    labels = np.asarray([[0], [1], [2]], np.int64)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(25):
+            lv, = exe.run(prog, feed={
+                'x': lod_feed(rows, 'float32', dim=6), 'yl': labels},
+                fetch_list=[loss])
+            losses.append(float(np.asarray(lv).flatten()[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_proximal_adagrad_zero_gradient_no_nan():
+    p = np.ones((2, 2), np.float32)
+    g = np.zeros((2, 2), np.float32)  # dead units: moment stays 0
+    m = np.zeros((2, 2), np.float32)
+    t = OpTest()
+    t.op_type = 'proximal_adagrad'
+    t.inputs = {'Param': p, 'Grad': g, 'Moment': m,
+                'LearningRate': np.asarray([0.1], np.float32)}
+    t.attrs = {'l1': 0.01, 'l2': 0.0}
+    t.outputs = {'ParamOut': p - 0.0, 'MomentOut': m}
+    t.check_output(atol=1e-5)
