@@ -1,0 +1,252 @@
+// Fault-tolerant dataset task queue.
+//
+// TPU-native equivalent of the reference's Go master service
+// (go/master/service.go): data chunks are partitioned into tasks; trainers
+// claim tasks (GetTask), report TaskFinished / TaskFailed; claimed tasks
+// carry a deadline and are silently re-dispatched when their owner dies
+// (timeout), and tasks failing more than failure_max times are discarded
+// (service.go:56-140).  Queue state serializes to an opaque snapshot blob
+// the Python side persists to disk — the stand-in for the reference's etcd
+// store (go/master/etcd_client.go) in a filesystem-coordinated deployment.
+//
+// C ABI for ctypes.  All calls are thread-safe.
+
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Task {
+  int64_t id = 0;
+  int failures = 0;
+  std::string payload;
+};
+
+struct Master {
+  std::mutex mu;
+  std::deque<Task> todo;
+  std::map<int64_t, std::pair<Task, Clock::time_point>> pending;
+  std::vector<Task> done;
+  int64_t discarded = 0;
+  double timeout_secs = 60.0;
+  int failure_max = 3;
+  int64_t next_id = 1;
+
+  void requeue_timed_out() {
+    auto now = Clock::now();
+    for (auto it = pending.begin(); it != pending.end();) {
+      if (it->second.second <= now) {
+        Task t = it->second.first;
+        t.failures += 1;  // a timeout counts as a failure (service.go:140)
+        it = pending.erase(it);
+        if (t.failures >= failure_max) {
+          ++discarded;
+        } else {
+          todo.push_back(std::move(t));
+        }
+      } else {
+        ++it;
+      }
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ms_create(double timeout_secs, int failure_max) {
+  Master* m = new Master();
+  m->timeout_secs = timeout_secs;
+  m->failure_max = failure_max;
+  return m;
+}
+
+void ms_destroy(void* h) { delete static_cast<Master*>(h); }
+
+int64_t ms_add_task(void* h, const char* payload, uint64_t len) {
+  Master* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  Task t;
+  t.id = m->next_id++;
+  t.payload.assign(payload, len);
+  int64_t id = t.id;
+  m->todo.push_back(std::move(t));
+  return id;
+}
+
+// >=0: payload bytes written, *id_out set; -1: pass finished (todo and
+// pending both empty); -2: no task ready (all claimed, none timed out);
+// -(n+3): buffer too small, need n bytes
+int ms_get_task(void* h, char* buf, uint64_t cap, int64_t* id_out) {
+  Master* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  m->requeue_timed_out();
+  if (m->todo.empty()) {
+    return m->pending.empty() ? -1 : -2;
+  }
+  Task& t = m->todo.front();
+  if (t.payload.size() > cap) {
+    return -(static_cast<int>(t.payload.size()) + 3);
+  }
+  std::memcpy(buf, t.payload.data(), t.payload.size());
+  int n = static_cast<int>(t.payload.size());
+  *id_out = t.id;
+  auto deadline = Clock::now() + std::chrono::microseconds(
+      static_cast<int64_t>(m->timeout_secs * 1e6));
+  m->pending.emplace(t.id, std::make_pair(std::move(t), deadline));
+  m->todo.pop_front();
+  return n;
+}
+
+// 0 ok; -1 unknown task id (already finished/requeued — benign)
+int ms_task_finished(void* h, int64_t id) {
+  Master* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  auto it = m->pending.find(id);
+  if (it == m->pending.end()) return -1;
+  m->done.push_back(std::move(it->second.first));
+  m->pending.erase(it);
+  return 0;
+}
+
+// 0 requeued; 1 discarded (failure cap); -1 unknown id
+int ms_task_failed(void* h, int64_t id) {
+  Master* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  auto it = m->pending.find(id);
+  if (it == m->pending.end()) return -1;
+  Task t = std::move(it->second.first);
+  m->pending.erase(it);
+  t.failures += 1;
+  if (t.failures >= m->failure_max) {
+    ++m->discarded;
+    return 1;
+  }
+  m->todo.push_back(std::move(t));
+  return 0;
+}
+
+// recycle finished tasks for the next dataset pass (service.go new pass)
+void ms_new_pass(void* h) {
+  Master* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  for (auto& t : m->done) {
+    t.failures = 0;
+    m->todo.push_back(std::move(t));
+  }
+  m->done.clear();
+}
+
+// counts[0..3] = todo, pending, done, discarded
+void ms_counts(void* h, int64_t* counts) {
+  Master* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  m->requeue_timed_out();
+  counts[0] = static_cast<int64_t>(m->todo.size());
+  counts[1] = static_cast<int64_t>(m->pending.size());
+  counts[2] = static_cast<int64_t>(m->done.size());
+  counts[3] = m->discarded;
+}
+
+namespace {
+
+constexpr int64_t kSnapshotMagic = 0x301076736d;  // "msv1" + version tag
+
+void put64(std::string* s, int64_t v) {
+  s->append(reinterpret_cast<const char*>(&v), 8);
+}
+
+// bounds-checked reads: snapshots come off disk and may be truncated or a
+// different format entirely (e.g. the Python fallback's JSON)
+bool get64(const char** p, const char* end, int64_t* out) {
+  if (end - *p < 8) return false;
+  std::memcpy(out, *p, 8);
+  *p += 8;
+  return true;
+}
+
+void put_task(std::string* s, const Task& t) {
+  put64(s, t.id);
+  put64(s, t.failures);
+  put64(s, static_cast<int64_t>(t.payload.size()));
+  s->append(t.payload);
+}
+
+bool get_task_blob(const char** p, const char* end, Task* t) {
+  int64_t id, failures, n;
+  if (!get64(p, end, &id) || !get64(p, end, &failures) ||
+      !get64(p, end, &n)) {
+    return false;
+  }
+  if (n < 0 || end - *p < n) return false;
+  t->id = id;
+  t->failures = static_cast<int>(failures);
+  t->payload.assign(*p, n);
+  *p += n;
+  return true;
+}
+
+}  // namespace
+
+// snapshot format: [n_todo(+pending)][tasks...][n_done][tasks...][next_id]
+// pending tasks snapshot as todo — their claimants are presumed dead on
+// recovery, exactly the reference's recover semantics (service.go:166,207)
+int64_t ms_snapshot(void* h, char* buf, uint64_t cap) {
+  Master* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  std::string s;
+  put64(&s, kSnapshotMagic);
+  put64(&s, static_cast<int64_t>(m->todo.size() + m->pending.size()));
+  for (const auto& t : m->todo) put_task(&s, t);
+  for (const auto& kv : m->pending) put_task(&s, kv.second.first);
+  put64(&s, static_cast<int64_t>(m->done.size()));
+  for (const auto& t : m->done) put_task(&s, t);
+  put64(&s, m->next_id);
+  put64(&s, m->discarded);
+  if (s.size() > cap) return -(static_cast<int64_t>(s.size()) + 3);
+  std::memcpy(buf, s.data(), s.size());
+  return static_cast<int64_t>(s.size());
+}
+
+// 0 ok; -1 malformed (wrong magic, truncated, or negative sizes) with the
+// queues left untouched
+int ms_restore(void* h, const char* buf, uint64_t len) {
+  Master* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t magic, n_todo, n_done, next_id, discarded;
+  if (!get64(&p, end, &magic) || magic != kSnapshotMagic) return -1;
+  if (!get64(&p, end, &n_todo) || n_todo < 0) return -1;
+  std::deque<Task> todo;
+  std::vector<Task> done;
+  for (int64_t i = 0; i < n_todo; ++i) {
+    Task t;
+    if (!get_task_blob(&p, end, &t)) return -1;
+    todo.push_back(std::move(t));
+  }
+  if (!get64(&p, end, &n_done) || n_done < 0) return -1;
+  for (int64_t i = 0; i < n_done; ++i) {
+    Task t;
+    if (!get_task_blob(&p, end, &t)) return -1;
+    done.push_back(std::move(t));
+  }
+  if (!get64(&p, end, &next_id) || !get64(&p, end, &discarded)) return -1;
+  m->todo = std::move(todo);
+  m->pending.clear();
+  m->done = std::move(done);
+  m->next_id = next_id;
+  m->discarded = discarded;
+  return 0;
+}
+
+}  // extern "C"

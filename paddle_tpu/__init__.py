@@ -32,4 +32,9 @@ def batch(reader_creator, batch_size, drop_last=False):
     return batch_reader
 
 
-__all__ = ['fluid', 'reader', 'dataset', 'parallel', 'inference', 'batch']
+# imported after `batch` exists: v2 re-exports it
+from . import v2  # noqa: F401,E402
+from . import distributed  # noqa: F401,E402
+
+__all__ = ['fluid', 'reader', 'dataset', 'parallel', 'inference', 'batch',
+           'v2', 'distributed']

@@ -1,0 +1,4 @@
+"""Distributed/EDL runtime pieces outside the SPMD compute path
+(reference: go/ — master task queue, pserver; SURVEY §2.2)."""
+
+from .master import Master, TaskQueuePyFallback, cloud_reader  # noqa: F401

@@ -1,0 +1,375 @@
+"""Fault-tolerant dataset task-queue master + trainer client.
+
+Reference: the Go master (go/master/service.go — task partition,
+GetTask/TaskFinished/TaskFailed, timeouts, failureMax, snapshot/recover;
+go/master/etcd_client.go — etcd lock + state store; consumed from Python by
+python/paddle/v2/master/client.py and v2/reader/creator.py cloud_reader).
+
+TPU-native deployment: the queue engine is native C++ (csrc/master.cc via
+ctypes); coordination runs over a shared filesystem — a pidfile lock
+replaces the etcd distributed master lock, and the snapshot blob persists
+to a file instead of an etcd key.  Trainers remain stateless: a dead
+trainer's claimed task times out and is re-dispatched; a restarted master
+recovers the queue from the last snapshot with claimed tasks returned to
+the todo queue.
+"""
+
+import ctypes
+import json
+import os
+import time
+
+from ..runtime import native
+
+
+class TaskQueuePyFallback(object):
+    """Pure-Python queue engine with the semantics of csrc/master.cc, used
+    when the native lib is unavailable."""
+
+    def __init__(self, timeout_secs, failure_max):
+        self.timeout_secs = timeout_secs
+        self.failure_max = failure_max
+        self.todo = []  # (id, failures, payload)
+        self.pending = {}  # id -> (failures, payload, deadline)
+        self.done = []
+        self.discarded = 0
+        self.next_id = 1
+
+    def _requeue(self):
+        now = time.monotonic()
+        for tid in list(self.pending):
+            failures, payload, deadline = self.pending[tid]
+            if deadline <= now:
+                del self.pending[tid]
+                failures += 1
+                if failures >= self.failure_max:
+                    self.discarded += 1
+                else:
+                    self.todo.append((tid, failures, payload))
+
+    def add_task(self, payload):
+        tid = self.next_id
+        self.next_id += 1
+        self.todo.append((tid, 0, payload))
+        return tid
+
+    def get_task(self):
+        self._requeue()
+        if not self.todo:
+            return (None, None) if self.pending else (-1, None)
+        tid, failures, payload = self.todo.pop(0)
+        self.pending[tid] = (failures, payload,
+                             time.monotonic() + self.timeout_secs)
+        return tid, payload
+
+    def task_finished(self, tid):
+        if tid in self.pending:
+            failures, payload, _ = self.pending.pop(tid)
+            self.done.append((tid, failures, payload))
+
+    def task_failed(self, tid):
+        if tid not in self.pending:
+            return -1
+        failures, payload, _ = self.pending.pop(tid)
+        failures += 1
+        if failures >= self.failure_max:
+            self.discarded += 1
+            return 1
+        self.todo.append((tid, failures, payload))
+        return 0
+
+    def new_pass(self):
+        self.todo.extend((tid, 0, payload) for tid, _, payload in self.done)
+        self.done = []
+
+    def counts(self):
+        self._requeue()
+        return (len(self.todo), len(self.pending), len(self.done),
+                self.discarded)
+
+    def snapshot(self):
+        self._requeue()
+        state = {
+            'todo': [(t, f, p.decode('latin-1'))
+                     for t, f, p in self.todo] +
+                    [(t, f, p.decode('latin-1'))
+                     for t, (f, p, _) in self.pending.items()],
+            'done': [(t, f, p.decode('latin-1')) for t, f, p in self.done],
+            'next_id': self.next_id,
+            'discarded': self.discarded,
+        }
+        return json.dumps(state).encode()
+
+    def restore(self, blob):
+        state = json.loads(bytes(blob).decode())
+        self.todo = [(t, f, p.encode('latin-1'))
+                     for t, f, p in state['todo']]
+        self.pending = {}
+        self.done = [(t, f, p.encode('latin-1'))
+                     for t, f, p in state['done']]
+        self.next_id = state['next_id']
+        self.discarded = state['discarded']
+
+
+class _NativeQueue(object):
+    """ctypes façade over csrc/master.cc with the fallback's interface."""
+
+    def __init__(self, lib, timeout_secs, failure_max):
+        self._lib = lib
+        self._h = lib.ms_create(float(timeout_secs), int(failure_max))
+        self._cap = 1 << 12
+
+    def add_task(self, payload):
+        return int(self._lib.ms_add_task(self._h, bytes(payload),
+                                         len(payload)))
+
+    def get_task(self):
+        cap = self._cap
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            tid = ctypes.c_int64()
+            n = self._lib.ms_get_task(self._h, buf, cap,
+                                      ctypes.byref(tid))
+            if n == -1:
+                return -1, None  # pass finished
+            if n == -2:
+                return None, None  # wait: tasks claimed elsewhere
+            if n <= -3:
+                cap = -(n + 3)
+                self._cap = max(self._cap, cap)
+                continue
+            return int(tid.value), buf.raw[:n]
+
+    def task_finished(self, tid):
+        self._lib.ms_task_finished(self._h, tid)
+
+    def task_failed(self, tid):
+        return int(self._lib.ms_task_failed(self._h, tid))
+
+    def new_pass(self):
+        self._lib.ms_new_pass(self._h)
+
+    def counts(self):
+        arr = (ctypes.c_int64 * 4)()
+        self._lib.ms_counts(self._h, arr)
+        return tuple(int(v) for v in arr)
+
+    def snapshot(self):
+        cap = self._cap
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.ms_snapshot(self._h, buf, cap)
+            if n <= -3:
+                cap = -(n + 3)
+                self._cap = max(self._cap, cap)
+                continue
+            return buf.raw[:n]
+
+    def restore(self, blob):
+        if self._lib.ms_restore(self._h, bytes(blob), len(blob)) != 0:
+            raise IOError('corrupt master snapshot blob')
+
+    def __del__(self):
+        try:
+            self._lib.ms_destroy(self._h)
+        except Exception:
+            pass
+
+
+class Master(object):
+    """The master service: dataset partition + claimable task queue +
+    snapshot persistence + single-active-master pidfile lock.
+
+    store_path: directory for the snapshot + lock (the etcd stand-in).
+    A restarted master recovers the queue from the last snapshot there.
+    """
+
+    def __init__(self, store_path=None, chunk_timeout_secs=60,
+                 failure_max=3):
+        lib = native._load()
+        if lib is not None:
+            self._q = _NativeQueue(lib, chunk_timeout_secs, failure_max)
+        else:
+            self._q = TaskQueuePyFallback(chunk_timeout_secs, failure_max)
+        self.store_path = store_path
+        self._lock_fd = None
+        if store_path:
+            os.makedirs(store_path, exist_ok=True)
+            self._acquire_lock()
+            snap = os.path.join(store_path, 'master_snapshot.bin')
+            if os.path.exists(snap):
+                with open(snap, 'rb') as f:
+                    self._restore_blob(f.read())
+
+    def _restore_blob(self, blob):
+        """Restore from either engine's snapshot format: the native engine
+        writes a magic-tagged binary blob, the fallback writes JSON.  A
+        snapshot from the *other* engine (e.g. a host without the native
+        lib wrote JSON, then a native master restarts) is translated by
+        re-enqueueing its tasks."""
+        try:
+            self._q.restore(blob)
+            return
+        except (IOError, ValueError, KeyError, UnicodeDecodeError):
+            pass
+        if not blob.lstrip()[:1] == b'{':
+            raise IOError(
+                'master snapshot is neither this engine\'s format nor '
+                'JSON — refusing to guess (delete %s to start fresh)' %
+                os.path.join(self.store_path or '', 'master_snapshot.bin'))
+        state = json.loads(bytes(blob).decode())
+        # done tasks first: claim+finish each so pass accounting survives
+        for _, _, payload in state.get('done', []):
+            tid = self._q.add_task(payload.encode('latin-1'))
+            got, _ = self._q.get_task()
+            self._q.task_finished(got if got is not None else tid)
+        for _, _, payload in state.get('todo', []):
+            self._q.add_task(payload.encode('latin-1'))
+
+    # -- etcd-lock analog: flock on a stable lockfile.  flock acquisition
+    # is atomic in the kernel and the lock dies with the holder, so there
+    # is no stale-pid read/steal window for two masters to race through --
+    def _acquire_lock(self):
+        import fcntl
+        path = os.path.join(self.store_path, 'master.lock')
+        fd = os.open(path, os.O_CREAT | os.O_WRONLY, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            try:
+                with open(path) as f:
+                    owner = f.read().strip()
+            except IOError:
+                owner = '?'
+            raise RuntimeError(
+                'another master (pid %s) holds the lock at %s' %
+                (owner or '?', path))
+        os.ftruncate(fd, 0)
+        os.write(fd, str(os.getpid()).encode())
+        self._lock_fd = fd
+        self._lock_path = path
+
+    def close(self):
+        if self._lock_fd is not None:
+            os.close(self._lock_fd)  # releases the flock
+            self._lock_fd = None
+
+    # -- dataset partitioning (go/master service.go partition()) --
+    def set_dataset(self, paths, records_per_task=64):
+        """Partition recordio files into record-range tasks.  No-op when a
+        recovered snapshot already holds tasks."""
+        if sum(self._q.counts()[:3]) > 0:
+            return
+        for path in paths:
+            n = 0
+            scanner = native.RecordIOScanner(path)
+            for _ in scanner:
+                n += 1
+            scanner.close()
+            for start in range(0, n, records_per_task):
+                payload = json.dumps({
+                    'path': path,
+                    'start': start,
+                    'count': min(records_per_task, n - start),
+                }).encode()
+                self._q.add_task(payload)
+        self.snapshot_to_store()
+
+    # -- queue API (service.go GetTask/TaskFinished/TaskFailed) --
+    def get_task(self):
+        """(task_id, task_dict); (-1, None) = pass finished; (None, None)
+        = nothing available right now (claimed elsewhere)."""
+        tid, payload = self._q.get_task()
+        if payload is None:
+            return tid, None
+        return tid, json.loads(payload.decode())
+
+    def task_finished(self, tid):
+        self._q.task_finished(tid)
+        self.snapshot_to_store()
+
+    def task_failed(self, tid):
+        r = self._q.task_failed(tid)
+        self.snapshot_to_store()
+        return r
+
+    def new_pass(self):
+        self._q.new_pass()
+
+    def counts(self):
+        """(todo, pending, done, discarded)"""
+        return self._q.counts()
+
+    def snapshot_to_store(self):
+        if not self.store_path:
+            return
+        snap = os.path.join(self.store_path, 'master_snapshot.bin')
+        tmp = snap + '.tmp'
+        with open(tmp, 'wb') as f:
+            f.write(self._q.snapshot())
+        os.replace(tmp, snap)  # atomic like the etcd transactional put
+
+
+def cloud_reader(master, pass_num=1, poll_interval=0.05):
+    """Record iterator over the master's task queue (reference
+    python/paddle/v2/reader/creator.py:91 cloud_reader): claims a task,
+    streams its record range, reports completion; failures (reader
+    exceptions) report task_failed so another trainer retries the chunk."""
+
+    def reader():
+        passes = 0
+        # per-file scanner cache with the current record position: tasks
+        # claimed in file order stream sequentially instead of rescanning
+        # from record 0 per task (only an out-of-order claim reopens)
+        open_scanners = {}  # path -> [scanner, next_record_index]
+
+        def read_range(path, start, count):
+            entry = open_scanners.get(path)
+            if entry is None or entry[1] > start:
+                if entry is not None:
+                    entry[0].close()
+                entry = [native.RecordIOScanner(path), 0]
+                open_scanners[path] = entry
+            scanner, pos = entry
+            records = []
+            try:
+                while pos < start + count:
+                    rec = next(scanner)
+                    if pos >= start:
+                        records.append(rec)
+                    pos += 1
+            finally:
+                entry[1] = pos
+            return records
+
+        try:
+            while passes < pass_num:
+                tid, task = master.get_task()
+                if tid == -1:
+                    passes += 1
+                    if passes < pass_num:
+                        master.new_pass()
+                    continue
+                if task is None:
+                    time.sleep(poll_interval)
+                    continue
+                try:
+                    records = read_range(task['path'], task['start'],
+                                         task['count'])
+                except Exception:
+                    # drop the (possibly corrupt) cached scanner before
+                    # another trainer retries the chunk
+                    entry = open_scanners.pop(task['path'], None)
+                    if entry is not None:
+                        entry[0].close()
+                    master.task_failed(tid)
+                    continue
+                for rec in records:
+                    yield rec
+                master.task_finished(tid)
+        finally:
+            for scanner, _ in open_scanners.values():
+                scanner.close()
+
+    return reader

@@ -35,6 +35,16 @@ def _load():
             if not _try_build():
                 return None
         lib = ctypes.CDLL(_SO_PATH)
+        # an on-disk .so from an older source tree may predate newly added
+        # symbols: rebuild once (make relinks when sources are newer) and
+        # reload instead of crashing every native consumer
+        if not hasattr(lib, 'ms_create'):
+            del lib
+            if not _try_build():
+                return None
+            lib = ctypes.CDLL(_SO_PATH)
+            if not hasattr(lib, 'ms_create'):
+                return None
         # recordio
         lib.recordio_writer_create.restype = ctypes.c_void_p
         lib.recordio_writer_create.argtypes = [ctypes.c_char_p,
@@ -93,6 +103,30 @@ def _load():
         lib.ch_try_recv.restype = ctypes.c_int
         lib.ch_try_recv.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                     ctypes.c_uint64]
+        # EDL master task queue
+        lib.ms_create.restype = ctypes.c_void_p
+        lib.ms_create.argtypes = [ctypes.c_double, ctypes.c_int]
+        lib.ms_destroy.argtypes = [ctypes.c_void_p]
+        lib.ms_add_task.restype = ctypes.c_int64
+        lib.ms_add_task.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_uint64]
+        lib.ms_get_task.restype = ctypes.c_int
+        lib.ms_get_task.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_uint64,
+                                    ctypes.POINTER(ctypes.c_int64)]
+        lib.ms_task_finished.restype = ctypes.c_int
+        lib.ms_task_finished.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.ms_task_failed.restype = ctypes.c_int
+        lib.ms_task_failed.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.ms_new_pass.argtypes = [ctypes.c_void_p]
+        lib.ms_counts.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_int64)]
+        lib.ms_snapshot.restype = ctypes.c_int64
+        lib.ms_snapshot.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_uint64]
+        lib.ms_restore.restype = ctypes.c_int
+        lib.ms_restore.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_uint64]
         _lib = lib
         return _lib
 

@@ -1,0 +1,41 @@
+"""paddle.v2 compatibility API (reference: python/paddle/v2/__init__.py).
+
+The reference ships two generations: the legacy v2 layer-DSL engine
+(GradientMachine / Layer / Matrix, paddle/legacy/) and Fluid.  This package
+keeps the v2 *API* alive — data_type/layer/parameters/trainer.SGD/event/
+inference — as a shim over the TPU fluid stack, so v2-era model scripts run
+unchanged while executing as compiled XLA programs (SURVEY §2.3/§2.4: the
+legacy engine's capabilities are carried by the new engine, not by a second
+interpreter)."""
+
+from . import data_type  # noqa: F401
+from . import activation  # noqa: F401
+from . import pooling  # noqa: F401
+from . import layer  # noqa: F401
+from . import topology  # noqa: F401
+from . import parameters  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import trainer  # noqa: F401
+from . import event  # noqa: F401
+from . import inference  # noqa: F401
+from .inference import infer  # noqa: F401
+
+from .. import dataset  # noqa: F401
+from .. import reader  # noqa: F401
+from ..import batch  # noqa: F401
+
+from . import minibatch  # noqa: F401
+
+__all__ = [
+    'init', 'data_type', 'activation', 'pooling', 'layer', 'topology',
+    'parameters', 'optimizer', 'trainer', 'event', 'inference', 'infer',
+    'dataset', 'reader', 'batch',
+]
+
+_init_kwargs = {}
+
+
+def init(**kwargs):
+    """(reference v2/__init__.py init — gflags bootstrap; the TPU build
+    has nothing to bootstrap, flags come from env at import)"""
+    _init_kwargs.update(kwargs)

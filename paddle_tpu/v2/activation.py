@@ -1,0 +1,45 @@
+"""v2 activation objects (reference: python/paddle/v2/activation.py over
+trainer_config_helpers/activations.py)."""
+
+
+class BaseActivation(object):
+    name = None
+
+    def __repr__(self):
+        return 'activation.%s' % type(self).__name__
+
+
+class Linear(BaseActivation):
+    name = None
+
+
+class Relu(BaseActivation):
+    name = 'relu'
+
+
+class Sigmoid(BaseActivation):
+    name = 'sigmoid'
+
+
+class Tanh(BaseActivation):
+    name = 'tanh'
+
+
+class Softmax(BaseActivation):
+    name = 'softmax'
+
+
+class Exp(BaseActivation):
+    name = 'exp'
+
+
+class Log(BaseActivation):
+    name = 'log'
+
+
+class Square(BaseActivation):
+    name = 'square'
+
+
+class SoftRelu(BaseActivation):
+    name = 'soft_relu'

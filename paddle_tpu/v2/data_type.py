@@ -1,0 +1,49 @@
+"""v2 input type declarations (reference: python/paddle/v2/data_type.py,
+backed by trainer/PyDataProvider2.py InputType).  Each declares how a
+column of a v2 data reader maps to a feed tensor."""
+
+
+class DataType(object):
+    Dense = 0
+    SparseNonValue = 1
+    SparseValue = 2
+    Index = 3
+
+
+class InputType(object):
+    def __init__(self, dim, seq_type, data_type):
+        self.dim = dim
+        self.seq_type = seq_type  # 0 = no sequence, 1 = sequence
+        self.type = data_type
+
+
+def dense_vector(dim):
+    return InputType(dim, 0, DataType.Dense)
+
+
+def dense_array(dim):
+    return InputType(dim, 0, DataType.Dense)
+
+
+def dense_vector_sequence(dim):
+    return InputType(dim, 1, DataType.Dense)
+
+
+def integer_value(value_range):
+    return InputType(value_range, 0, DataType.Index)
+
+
+def integer_value_sequence(value_range):
+    return InputType(value_range, 1, DataType.Index)
+
+
+def sparse_binary_vector(dim):
+    return InputType(dim, 0, DataType.SparseNonValue)
+
+
+def sparse_float_vector(dim):
+    return InputType(dim, 0, DataType.SparseValue)
+
+
+def sparse_binary_vector_sequence(dim):
+    return InputType(dim, 1, DataType.SparseNonValue)

@@ -1,0 +1,227 @@
+"""v2 layer DSL (reference: python/paddle/v2/layer.py, auto-generated from
+trainer_config_helpers/layers.py).
+
+v2 layers are *declarative nodes*: calling ``paddle.layer.fc(...)`` records
+a node in a DAG; nothing is built until ``topology.Topology`` materializes
+the DAG into a fluid Program (the reference analogously parses the config
+into a protobuf ModelConfig consumed by the C++ GradientMachine).  Here the
+"engine" under v2 is the same TPU fluid stack — one compiled XLA program
+instead of the legacy Layer/Matrix interpreter (legacy/gserver/)."""
+
+from . import data_type as _data_type
+from .activation import BaseActivation, Linear
+from .pooling import Max as _MaxPool
+
+from .. import fluid
+
+__all__ = [
+    'data', 'fc', 'embedding', 'img_conv', 'img_pool', 'dropout', 'concat',
+    'addto', 'classification_cost', 'cross_entropy_cost', 'mse_cost',
+    'square_error_cost', 'pooling', 'lstmemory_like', 'batch_norm',
+]
+
+
+class Layer(object):
+    """One node of the v2 DAG."""
+
+    _counter = [0]
+
+    def __init__(self, kind, parents, build_fn, name=None, size=None):
+        Layer._counter[0] += 1
+        self.kind = kind
+        self.name = name or ('__%s_%d__' % (kind, Layer._counter[0]))
+        self.parents = list(parents)
+        self._build_fn = build_fn
+        self.size = size
+
+    def to_fluid(self, ctx):
+        """Materialize (memoized per-build ctx dict) into a fluid var."""
+        if self.name in ctx:
+            return ctx[self.name]
+        parent_vars = [p.to_fluid(ctx) for p in self.parents]
+        var = self._build_fn(ctx, *parent_vars)
+        ctx[self.name] = var
+        return var
+
+    def __repr__(self):
+        return 'v2.layer.%s(%s)' % (self.kind, self.name)
+
+
+def data(name, type, **kwargs):
+    """Input declaration (reference layer.py data / data_layer)."""
+    t = type
+
+    def build(ctx):
+        if t.type == _data_type.DataType.Index:
+            return fluid.layers.data(
+                name=name, shape=[1], dtype='int64',
+                lod_level=1 if t.seq_type else 0)
+        return fluid.layers.data(
+            name=name, shape=[t.dim], dtype='float32',
+            lod_level=1 if t.seq_type else 0)
+
+    layer = Layer('data', [], build, name=name, size=t.dim)
+    layer.data_type = t
+    return layer
+
+
+def _act_name(act):
+    if act is None:
+        return None
+    if isinstance(act, BaseActivation):
+        return act.name
+    return act
+
+
+def fc(input, size, act=None, name=None, **kwargs):
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    def build(ctx, *parent_vars):
+        out = None
+        for v in parent_vars:
+            term = fluid.layers.fc(v, size=size)
+            out = term if out is None else fluid.layers.elementwise_add(
+                out, term)
+        a = _act_name(act if act is not None else Linear())
+        if a == 'softmax':
+            return fluid.layers.softmax(out)
+        if a:
+            return getattr(fluid.layers, a)(out)
+        return out
+
+    return Layer('fc', inputs, build, name=name, size=size)
+
+
+def embedding(input, size, name=None, **kwargs):
+    def build(ctx, parent_var):
+        vocab = input.size
+        return fluid.layers.embedding(parent_var, size=[vocab, size])
+
+    return Layer('embedding', [input], build, name=name, size=size)
+
+
+def img_conv(input, filter_size, num_filters, num_channels=None, stride=1,
+             padding=0, act=None, name=None, **kwargs):
+    def build(ctx, parent_var):
+        a = _act_name(act)
+        return fluid.layers.conv2d(
+            parent_var, num_filters=num_filters, filter_size=filter_size,
+            stride=stride, padding=padding, act=a)
+
+    return Layer('img_conv', [input], build, name=name, size=num_filters)
+
+
+def img_pool(input, pool_size, stride=1, padding=0, pool_type=None,
+             name=None, **kwargs):
+    ptype = (pool_type or _MaxPool()).name
+
+    def build(ctx, parent_var):
+        return fluid.layers.pool2d(
+            parent_var, pool_size=pool_size, pool_type=ptype,
+            pool_stride=stride, pool_padding=padding)
+
+    return Layer('img_pool', [input], build, name=name)
+
+
+def batch_norm(input, act=None, name=None, **kwargs):
+    def build(ctx, parent_var):
+        return fluid.layers.batch_norm(parent_var, act=_act_name(act))
+
+    return Layer('batch_norm', [input], build, name=name)
+
+
+def dropout(input, dropout_rate, name=None, **kwargs):
+    def build(ctx, parent_var):
+        return fluid.layers.dropout(parent_var, dropout_prob=dropout_rate)
+
+    return Layer('dropout', [input], build, name=name)
+
+
+def concat(input, name=None, **kwargs):
+    def build(ctx, *parent_vars):
+        return fluid.layers.concat(list(parent_vars), axis=1)
+
+    return Layer('concat', list(input), build, name=name)
+
+
+def addto(input, act=None, name=None, **kwargs):
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    def build(ctx, *parent_vars):
+        out = parent_vars[0]
+        for v in parent_vars[1:]:
+            out = fluid.layers.elementwise_add(out, v)
+        a = _act_name(act)
+        if a:
+            out = getattr(fluid.layers, a)(out)
+        return out
+
+    return Layer('addto', inputs, build, name=name)
+
+
+def pooling(input, pooling_type=None, name=None, **kwargs):
+    """Sequence pooling (reference layer.py pooling over sequence
+    input)."""
+    ptype = (pooling_type or _MaxPool()).name
+
+    def build(ctx, parent_var):
+        return fluid.layers.sequence_pool(parent_var, ptype)
+
+    return Layer('pooling', [input], build, name=name)
+
+
+def lstmemory_like(input, size, name=None, **kwargs):
+    """Simple LSTM block: gate projection + dynamic_lstm (the v2
+    simple_lstm network; reference networks.py simple_lstm)."""
+
+    def build(ctx, parent_var):
+        proj = fluid.layers.fc(parent_var, size=size * 4)
+        hidden, _ = fluid.layers.dynamic_lstm(proj, size=size * 4)
+        return hidden
+
+    return Layer('lstmemory', [input], build, name=name, size=size)
+
+
+def classification_cost(input, label, name=None, **kwargs):
+    def build(ctx, input_var, label_var):
+        ce = fluid.layers.cross_entropy(input_var, label_var)
+        return fluid.layers.mean(ce)
+
+    layer = Layer('classification_cost', [input, label], build, name=name)
+    layer.is_cost = True
+    layer.prediction_parent = input
+    return layer
+
+
+def cross_entropy_cost(input, label, name=None, **kwargs):
+    return classification_cost(input, label, name=name)
+
+
+def square_error_cost(input, label, name=None, **kwargs):
+    def build(ctx, input_var, label_var):
+        se = fluid.layers.square_error_cost(input_var, label_var)
+        return fluid.layers.mean(se)
+
+    layer = Layer('square_error_cost', [input, label], build, name=name)
+    layer.is_cost = True
+    layer.prediction_parent = input
+    return layer
+
+
+mse_cost = square_error_cost
+
+
+def parse_network(*outputs):
+    """Collect the input data layers reachable from outputs in
+    declaration order (reference topology.py get_layer traversal)."""
+    seen = []
+
+    def walk(layer):
+        for p in layer.parents:
+            walk(p)
+        if layer.kind == 'data' and layer not in seen:
+            seen.append(layer)
+
+    for out in outputs:
+        walk(out)
+    return seen

@@ -1,0 +1,5 @@
+"""(reference: python/paddle/v2/minibatch.py)"""
+
+from .. import batch  # noqa: F401
+
+__all__ = ['batch']

@@ -1,0 +1,91 @@
+"""v2 optimizers (reference: python/paddle/v2/optimizer.py) mapped onto
+the fluid optimizer family."""
+
+from .. import fluid
+
+__all__ = ['Momentum', 'Adam', 'Adamax', 'AdaGrad', 'DecayedAdaGrad',
+           'AdaDelta', 'RMSProp', 'ModelAverage', 'L2Regularization']
+
+
+class L2Regularization(object):
+    def __init__(self, rate):
+        self.rate = rate
+
+
+class ModelAverage(object):
+    def __init__(self, average_window, **kwargs):
+        self.average_window = average_window
+
+
+class Optimizer(object):
+    def __init__(self, **kwargs):
+        self.kwargs = kwargs
+
+    def _regularization(self):
+        reg = self.kwargs.get('regularization')
+        if isinstance(reg, L2Regularization):
+            return fluid.regularizer.L2Decay(reg.rate)
+        return None
+
+    def to_fluid(self):
+        raise NotImplementedError
+
+
+class Momentum(Optimizer):
+    def to_fluid(self):
+        return fluid.optimizer.Momentum(
+            learning_rate=self.kwargs.get('learning_rate', 0.001),
+            momentum=self.kwargs.get('momentum', 0.9),
+            regularization=self._regularization())
+
+
+class Adam(Optimizer):
+    def to_fluid(self):
+        return fluid.optimizer.Adam(
+            learning_rate=self.kwargs.get('learning_rate', 0.001),
+            beta1=self.kwargs.get('beta1', 0.9),
+            beta2=self.kwargs.get('beta2', 0.999),
+            epsilon=self.kwargs.get('epsilon', 1e-8),
+            regularization=self._regularization())
+
+
+class Adamax(Optimizer):
+    def to_fluid(self):
+        return fluid.optimizer.Adamax(
+            learning_rate=self.kwargs.get('learning_rate', 0.001),
+            beta1=self.kwargs.get('beta1', 0.9),
+            beta2=self.kwargs.get('beta2', 0.999),
+            regularization=self._regularization())
+
+
+class AdaGrad(Optimizer):
+    def to_fluid(self):
+        return fluid.optimizer.Adagrad(
+            learning_rate=self.kwargs.get('learning_rate', 0.001),
+            regularization=self._regularization())
+
+
+class DecayedAdaGrad(Optimizer):
+    def to_fluid(self):
+        return fluid.optimizer.DecayedAdagrad(
+            learning_rate=self.kwargs.get('learning_rate', 0.001),
+            decay=self.kwargs.get('rho', 0.95),
+            regularization=self._regularization())
+
+
+class AdaDelta(Optimizer):
+    def to_fluid(self):
+        return fluid.optimizer.Adadelta(
+            learning_rate=self.kwargs.get('learning_rate', 1.0),
+            rho=self.kwargs.get('rho', 0.95),
+            epsilon=self.kwargs.get('epsilon', 1e-6),
+            regularization=self._regularization())
+
+
+class RMSProp(Optimizer):
+    def to_fluid(self):
+        return fluid.optimizer.RMSProp(
+            learning_rate=self.kwargs.get('learning_rate', 0.001),
+            rho=self.kwargs.get('rho', 0.95),
+            epsilon=self.kwargs.get('epsilon', 1e-6),
+            regularization=self._regularization())

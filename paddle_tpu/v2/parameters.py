@@ -1,0 +1,84 @@
+"""v2 Parameters: name->ndarray view over a fluid Scope
+(reference: python/paddle/v2/parameters.py — there a gradient-machine
+parameter pool with to_tar/from_tar; here the pool is the Scope the
+compiled program trains in)."""
+
+import tarfile
+import io
+
+import numpy as np
+
+from .. import fluid
+from .topology import Topology
+
+
+class Parameters(object):
+    def __init__(self, topology):
+        self.topology = topology
+        self.scope = fluid.core.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(self.scope):
+            exe.run(topology.startup_program)
+
+    def names(self):
+        return [p.name for p in
+                self.topology.main_program.global_block().all_parameters()]
+
+    def keys(self):
+        return self.names()
+
+    def __iter__(self):
+        return iter(self.names())
+
+    def __getitem__(self, name):
+        var = self.scope.find_var(name)
+        if var is None or var.value() is None:
+            raise KeyError(name)
+        return np.asarray(var.value())
+
+    def __setitem__(self, name, value):
+        var = self.scope.find_var(name)
+        if var is None:
+            raise KeyError(name)
+        var.set_value(np.asarray(value))
+
+    def get(self, name):
+        return self[name]
+
+    def set(self, name, value):
+        self[name] = value
+
+    # --- serialization (reference parameters.py to_tar/from_tar) ---
+    def to_tar(self, f):
+        with tarfile.open(fileobj=f, mode='w') as tar:
+            for name in self.names():
+                buf = io.BytesIO()
+                np.save(buf, self[name], allow_pickle=False)
+                data = buf.getvalue()
+                info = tarfile.TarInfo(name=name + '.npy')
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+
+    def from_tar(self, f):
+        with tarfile.open(fileobj=f, mode='r') as tar:
+            for member in tar.getmembers():
+                name = member.name[:-4]  # strip .npy
+                # tarfile's file objects lack fileno(); buffer through
+                # BytesIO for np.load
+                arr = np.load(io.BytesIO(tar.extractfile(member).read()),
+                              allow_pickle=False)
+                if self.scope.find_var(name) is not None:
+                    self[name] = arr
+        return self
+
+    @staticmethod
+    def from_tar_new(topology, f):
+        p = Parameters(topology)
+        p.from_tar(f)
+        return p
+
+
+def create(cost):
+    """(reference parameters.py create(topology))"""
+    topo = cost if isinstance(cost, Topology) else Topology(cost)
+    return Parameters(topo)

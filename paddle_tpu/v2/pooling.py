@@ -1,0 +1,21 @@
+"""v2 pooling objects (reference: python/paddle/v2/pooling.py)."""
+
+
+class BasePool(object):
+    name = None
+
+
+class Max(BasePool):
+    name = 'max'
+
+
+class Avg(BasePool):
+    name = 'average'
+
+
+class Sum(BasePool):
+    name = 'sum'
+
+
+class SqrtAvg(BasePool):
+    name = 'sqrt'

@@ -1,0 +1,39 @@
+"""v2 Topology: materializes the layer DAG into fluid Programs
+(reference: python/paddle/v2/topology.py serializing to a protobuf
+ModelConfig; here the artifact is a fluid Program compiled to XLA)."""
+
+from . import layer as v2_layer
+from .. import fluid
+
+
+class Topology(object):
+    def __init__(self, cost):
+        costs = cost if isinstance(cost, (list, tuple)) else [cost]
+        self.costs = list(costs)
+        self.data_layers = v2_layer.parse_network(*self.costs)
+        self.main_program = fluid.Program()
+        self.startup_program = fluid.Program()
+        self._ctx = {}
+        with fluid.program_guard(self.main_program, self.startup_program):
+            cost_vars = [c.to_fluid(self._ctx) for c in self.costs]
+            self.cost_var = cost_vars[0]
+            if len(cost_vars) > 1:
+                total = cost_vars[0]
+                for v in cost_vars[1:]:
+                    total = fluid.layers.elementwise_add(total, v)
+                self.cost_var = total
+        # prediction output (for inference) where declared
+        self.prediction_var = None
+        pred_parent = getattr(self.costs[0], 'prediction_parent', None)
+        if pred_parent is not None:
+            self.prediction_var = self._ctx.get(pred_parent.name)
+
+    def var_of(self, layer):
+        return self._ctx.get(layer.name)
+
+    def data_type(self):
+        return [(l.name, l.data_type) for l in self.data_layers]
+
+    def proto(self):
+        """Program-as-config (the reference returns ModelConfig proto)."""
+        return self.main_program

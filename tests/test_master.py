@@ -1,0 +1,157 @@
+"""EDL master task-queue tests (reference parity:
+go/master/service_internal_test.go, go/master/service.go semantics:
+partition, claim/finish/fail, timeout re-dispatch, failure cap,
+snapshot recovery, master lock)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import Master, cloud_reader
+from paddle_tpu.runtime import native
+
+
+def _write_dataset(tmp_path, name, n):
+    path = os.path.join(str(tmp_path), name)
+    with native.RecordIOWriter(path) as w:
+        for i in range(n):
+            w.write(('rec-%s-%03d' % (name, i)).encode())
+    return path
+
+
+def test_partition_and_full_pass(tmp_path):
+    p1 = _write_dataset(tmp_path, 'a.recordio', 10)
+    p2 = _write_dataset(tmp_path, 'b.recordio', 7)
+    m = Master(chunk_timeout_secs=60, failure_max=3)
+    m.set_dataset([p1, p2], records_per_task=4)
+    todo, pending, done, discarded = m.counts()
+    assert todo == 3 + 2  # ceil(10/4) + ceil(7/4)
+    seen = list(cloud_reader(m)())
+    assert len(seen) == 17
+    assert len(set(seen)) == 17  # every record exactly once
+    assert m.counts()[2] == 5  # all tasks done
+
+
+def test_two_clients_disjoint_tasks(tmp_path):
+    p = _write_dataset(tmp_path, 'c.recordio', 12)
+    m = Master(chunk_timeout_secs=60, failure_max=3)
+    m.set_dataset([p], records_per_task=3)
+    # two interleaved clients claim disjoint tasks
+    ids = []
+    while True:
+        tid, task = m.get_task()
+        if tid == -1 or task is None:
+            break
+        ids.append(tid)
+        m.task_finished(tid)
+    assert len(ids) == len(set(ids)) == 4
+
+
+def test_timeout_redispatch(tmp_path):
+    import time
+    p = _write_dataset(tmp_path, 'd.recordio', 4)
+    m = Master(chunk_timeout_secs=0.1, failure_max=5)
+    m.set_dataset([p], records_per_task=4)
+    tid1, task1 = m.get_task()
+    assert task1 is not None
+    # dead trainer: never reports. Next claim before timeout: nothing
+    tid2, task2 = m.get_task()
+    assert tid2 is None and task2 is None
+    time.sleep(0.15)
+    tid3, task3 = m.get_task()  # timed out -> re-dispatched
+    assert tid3 == tid1 and task3 == task1
+
+
+def test_failure_cap_discards(tmp_path):
+    p = _write_dataset(tmp_path, 'e.recordio', 2)
+    m = Master(chunk_timeout_secs=60, failure_max=2)
+    m.set_dataset([p], records_per_task=2)
+    tid, _ = m.get_task()
+    assert m.task_failed(tid) == 0  # requeued (1 failure)
+    tid2, _ = m.get_task()
+    assert tid2 == tid
+    assert m.task_failed(tid2) == 1  # discarded at failure_max
+    assert m.counts() == (0, 0, 0, 1)
+    tid3, _ = m.get_task()
+    assert tid3 == -1  # pass over (nothing left)
+
+
+def test_snapshot_recovery(tmp_path):
+    store = os.path.join(str(tmp_path), 'store')
+    p = _write_dataset(tmp_path, 'f.recordio', 8)
+    m1 = Master(store_path=store, chunk_timeout_secs=60, failure_max=3)
+    m1.set_dataset([p], records_per_task=2)
+    tid, task = m1.get_task()  # claimed, never finished
+    tid2, _ = m1.get_task()
+    m1.task_finished(tid2)
+    m1.snapshot_to_store()
+    m1.close()
+    del m1
+
+    # master restarts: recovers queue; the claimed (pending) task returns
+    # to todo because its claimant is presumed dead (service.go:166)
+    m2 = Master(store_path=store, chunk_timeout_secs=60, failure_max=3)
+    todo, pending, done, discarded = m2.counts()
+    assert pending == 0
+    assert todo == 3  # 4 tasks - 1 done
+    assert done == 1
+    # set_dataset after recovery must NOT re-partition
+    m2.set_dataset([p], records_per_task=2)
+    assert m2.counts()[0] == 3
+    seen = list(cloud_reader(m2)())
+    assert len(seen) == 6  # remaining 3 tasks x 2 records
+    m2.close()
+
+
+def test_master_lock_single_active(tmp_path):
+    store = os.path.join(str(tmp_path), 'store2')
+    m1 = Master(store_path=store)
+    with pytest.raises(RuntimeError):
+        Master(store_path=store)  # same pid is allowed to steal? no: alive
+    m1.close()
+    m2 = Master(store_path=store)  # lock released -> acquirable
+    m2.close()
+
+
+def test_new_pass_recycles(tmp_path):
+    p = _write_dataset(tmp_path, 'g.recordio', 4)
+    m = Master(chunk_timeout_secs=60, failure_max=3)
+    m.set_dataset([p], records_per_task=2)
+    seen = list(cloud_reader(m, pass_num=3)())
+    assert len(seen) == 12  # 3 passes over 4 records
+    assert len(set(seen)) == 4
+    assert all(seen.count(r) == 3 for r in set(seen))
+
+
+def test_corrupt_snapshot_rejected(tmp_path):
+    store = os.path.join(str(tmp_path), 'store3')
+    os.makedirs(store)
+    with open(os.path.join(store, 'master_snapshot.bin'), 'wb') as f:
+        f.write(b'\x00\x01garbage-not-a-snapshot')
+    with pytest.raises(IOError):
+        Master(store_path=store)
+
+
+def test_cross_engine_json_snapshot_restores(tmp_path):
+    """A JSON snapshot written by the Python fallback engine restores into
+    the native engine by re-enqueueing its tasks."""
+    import json
+    store = os.path.join(str(tmp_path), 'store4')
+    os.makedirs(store)
+    state = {
+        'todo': [[1, 0, json.dumps({'path': 'x', 'start': 0,
+                                    'count': 2})]],
+        'done': [[2, 0, json.dumps({'path': 'x', 'start': 2,
+                                    'count': 2})]],
+        'next_id': 3,
+        'discarded': 0,
+    }
+    with open(os.path.join(store, 'master_snapshot.bin'), 'wb') as f:
+        f.write(json.dumps(state).encode())
+    m = Master(store_path=store)
+    todo, pending, done, _ = m.counts()
+    assert (todo, pending, done) == (1, 0, 1)
+    tid, task = m.get_task()
+    assert task == {'path': 'x', 'start': 0, 'count': 2}
+    m.close()

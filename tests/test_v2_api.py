@@ -1,0 +1,143 @@
+"""v2 compatibility API tests (reference parity:
+python/paddle/v2/tests/test_layer.py, test_parameters.py, test_topology.py
+and the v2 book flow: layers -> parameters.create -> trainer.SGD.train ->
+infer)."""
+
+import io
+
+import numpy as np
+
+import paddle_tpu.v2 as paddle
+import paddle_tpu.v2.event as v2_event
+
+
+def _toy_classification(n=64, dim=16, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.standard_normal((classes, dim)).astype('float32') * 2
+    data = []
+    for i in range(n):
+        c = i % classes
+        x = centers[c] + 0.3 * rng.standard_normal(dim).astype('float32')
+        data.append((x, c))
+    return data
+
+
+def test_v2_train_and_infer():
+    images = paddle.layer.data(
+        name='pixel', type=paddle.data_type.dense_vector(16))
+    label = paddle.layer.data(
+        name='label', type=paddle.data_type.integer_value(4))
+    hidden = paddle.layer.fc(input=images, size=16,
+                             act=paddle.activation.Relu())
+    pred = paddle.layer.fc(input=hidden, size=4,
+                           act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=pred, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    assert len(parameters.names()) == 4  # 2 fc layers x (w, b)
+
+    optimizer = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+    trainer = paddle.trainer.SGD(cost=cost, parameters=parameters,
+                                 update_equation=optimizer)
+    data = _toy_classification()
+    costs = []
+
+    def handler(e):
+        if isinstance(e, v2_event.EndIteration):
+            costs.append(e.cost)
+
+    trainer.train(reader=paddle.batch(lambda: iter(data), 16),
+                  num_passes=10, event_handler=handler)
+    assert np.isfinite(costs).all()
+    assert costs[-1] < costs[0] * 0.5, (costs[0], costs[-1])
+
+    probs = paddle.infer(output_layer=pred, parameters=parameters,
+                         input=[(d[0], ) for d in data[:8]])
+    assert probs.shape == (8, 4)
+    np.testing.assert_allclose(probs.sum(1), 1.0, rtol=1e-4)
+    # trained model classifies most of its training points
+    acc = np.mean(probs.argmax(1) == [d[1] for d in data[:8]])
+    assert acc >= 0.75
+
+    result = trainer.test(reader=paddle.batch(lambda: iter(data), 16))
+    assert result.cost < costs[0]
+
+
+def test_v2_sequence_model():
+    """Embedding + sequence pooling over integer sequences (the v2 text
+    classification shape, reference v2 book ch.6)."""
+    words = paddle.layer.data(
+        name='words', type=paddle.data_type.integer_value_sequence(50))
+    label = paddle.layer.data(
+        name='label', type=paddle.data_type.integer_value(2))
+    emb = paddle.layer.embedding(input=words, size=8)
+    pooled = paddle.layer.pooling(input=emb,
+                                  pooling_type=paddle.pooling.Avg())
+    pred = paddle.layer.fc(input=pooled, size=2,
+                           act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=pred, label=label)
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.05))
+
+    rng = np.random.RandomState(1)
+    data = []
+    for i in range(48):
+        c = i % 2
+        length = rng.randint(3, 8)
+        base = 0 if c == 0 else 25
+        seq = (base + rng.randint(0, 20, size=length)).tolist()
+        data.append((seq, c))
+    costs = []
+    trainer.train(
+        reader=paddle.batch(lambda: iter(data), 12), num_passes=12,
+        event_handler=lambda e: costs.append(e.cost)
+        if isinstance(e, v2_event.EndIteration) else None)
+    assert costs[-1] < costs[0] * 0.6, (costs[0], costs[-1])
+
+
+def test_v2_parameters_tar_roundtrip():
+    x = paddle.layer.data(name='x',
+                          type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name='y',
+                          type=paddle.data_type.integer_value(2))
+    pred = paddle.layer.fc(input=x, size=2,
+                           act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=pred, label=y)
+    p1 = paddle.parameters.create(cost)
+    buf = io.BytesIO()
+    p1.to_tar(buf)
+    buf.seek(0)
+    p2 = paddle.parameters.Parameters(p1.topology)
+    p2.from_tar(buf)
+    for name in p1.names():
+        np.testing.assert_allclose(p2[name], p1[name])
+    # mutation through __setitem__ sticks
+    w = p1[p1.names()[0]]
+    p1[p1.names()[0]] = np.zeros_like(w)
+    np.testing.assert_allclose(p1[p1.names()[0]], 0.0)
+
+
+def test_v2_mse_regression():
+    x = paddle.layer.data(name='x',
+                          type=paddle.data_type.dense_vector(3))
+    y = paddle.layer.data(name='y',
+                          type=paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(input=x, size=1)
+    cost = paddle.layer.mse_cost(input=pred, label=y)
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.1))
+    rng = np.random.RandomState(2)
+    w_true = np.asarray([1.5, -2.0, 0.5], np.float32)
+    xs = rng.standard_normal((64, 3)).astype('float32')
+    ys = xs @ w_true[:, None]
+    data = [(xs[i], ys[i]) for i in range(64)]
+    costs = []
+    trainer.train(
+        reader=paddle.batch(lambda: iter(data), 16), num_passes=20,
+        event_handler=lambda e: costs.append(e.cost)
+        if isinstance(e, v2_event.EndIteration) else None)
+    assert costs[-1] < 0.05, (costs[0], costs[-1])
