@@ -1,0 +1,199 @@
+// C inference API.
+//
+// TPU-native equivalent of the reference's pure-C capi
+// (paddle/legacy/capi: paddle_matrix / paddle_gradient_machine_* for
+// embedding inference in C/C++ apps).  The TPU engine is Python/JAX, so
+// this library embeds CPython and drives paddle_tpu.capi_bridge; only raw
+// byte buffers + shapes cross the ABI.
+//
+// ABI (all functions return 0 on success, negative on error):
+//   ptc_init(python_path)           — bring up the interpreter (no-op when
+//                                     already embedded in a Python process)
+//   ptc_predictor_create(model_dir) — load a saved inference model
+//   ptc_set_input(h, name, data, byte_len, shape, ndim, dtype)
+//   ptc_run(h)                      — execute; returns #outputs
+//   ptc_get_output_shape(h, i, shape_out, ndim_out, dtype_out)
+//   ptc_get_output_data(h, i, buf, cap) — returns bytes written
+//   ptc_predictor_destroy(h)
+// dtype codes: 0=float32, 1=int64, 2=int32, 3=float64
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Predictor {
+  PyObject* obj = nullptr;  // capi_bridge.CApiPredictor
+};
+
+bool g_we_initialized = false;
+PyThreadState* g_saved_ts = nullptr;
+
+struct Gil {
+  PyGILState_STATE state;
+  Gil() { state = PyGILState_Ensure(); }
+  ~Gil() { PyGILState_Release(state); }
+};
+
+}  // namespace
+
+extern "C" {
+
+int ptc_init(const char* python_path) {
+  bool fresh = false;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = true;
+    fresh = true;
+  }
+  {
+    Gil gil;
+    if (python_path && *python_path) {
+      PyObject* sys_path = PySys_GetObject("path");  // borrowed
+      PyObject* p = PyUnicode_FromString(python_path);
+      if (sys_path && p) PyList_Insert(sys_path, 0, p);
+      Py_XDECREF(p);
+    }
+  }
+  if (fresh) {
+    // Py_InitializeEx leaves this thread holding the GIL; release it so
+    // other host threads' PyGILState_Ensure calls can proceed
+    g_saved_ts = PyEval_SaveThread();
+  }
+  return 0;
+}
+
+void ptc_finalize() {
+  if (g_we_initialized && Py_IsInitialized()) {
+    if (g_saved_ts) {
+      PyEval_RestoreThread(g_saved_ts);
+      g_saved_ts = nullptr;
+    }
+    Py_Finalize();
+    g_we_initialized = false;
+  }
+}
+
+void* ptc_predictor_create(const char* model_dir) {
+  Gil gil;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.capi_bridge");
+  if (!mod) {
+    PyErr_Print();
+    return nullptr;
+  }
+  PyObject* pred = PyObject_CallMethod(mod, "create", "s", model_dir);
+  Py_DECREF(mod);
+  if (!pred) {
+    PyErr_Print();
+    return nullptr;
+  }
+  Predictor* p = new Predictor();
+  p->obj = pred;
+  return p;
+}
+
+void ptc_predictor_destroy(void* h) {
+  if (!h) return;
+  Predictor* p = static_cast<Predictor*>(h);
+  {
+    Gil gil;
+    Py_XDECREF(p->obj);
+  }
+  delete p;
+}
+
+int ptc_set_input(void* h, const char* name, const char* data,
+                  uint64_t byte_len, const int64_t* shape, int ndim,
+                  int dtype) {
+  Predictor* p = static_cast<Predictor*>(h);
+  Gil gil;
+  PyObject* shape_list = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyList_SetItem(shape_list, i, PyLong_FromLongLong(shape[i]));
+  }
+  PyObject* r = PyObject_CallMethod(
+      p->obj, "set_input", "sy#Oi", name, data,
+      static_cast<Py_ssize_t>(byte_len), shape_list, dtype);
+  Py_DECREF(shape_list);
+  if (!r) {
+    PyErr_Print();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int ptc_run(void* h) {
+  Predictor* p = static_cast<Predictor*>(h);
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(p->obj, "run", nullptr);
+  if (!r) {
+    PyErr_Print();
+    return -1;
+  }
+  long n = PyLong_AsLong(r);
+  Py_DECREF(r);
+  return static_cast<int>(n);
+}
+
+static PyObject* get_output_tuple(Predictor* p, int i) {
+  return PyObject_CallMethod(p->obj, "get_output", "i", i);
+}
+
+// shape_cap = capacity of shape_out in elements; returns -2 (with
+// *ndim_out set to the required rank) when it is too small
+int ptc_get_output_shape(void* h, int i, int64_t* shape_out, int shape_cap,
+                         int* ndim_out, int* dtype_out) {
+  Predictor* p = static_cast<Predictor*>(h);
+  Gil gil;
+  PyObject* t = get_output_tuple(p, i);
+  if (!t) {
+    PyErr_Print();
+    return -1;
+  }
+  PyObject* shape = PyTuple_GetItem(t, 1);  // borrowed
+  Py_ssize_t n = PyList_Size(shape);
+  *ndim_out = static_cast<int>(n);
+  if (n > shape_cap) {
+    Py_DECREF(t);
+    return -2;
+  }
+  for (Py_ssize_t k = 0; k < n; ++k) {
+    shape_out[k] = PyLong_AsLongLong(PyList_GetItem(shape, k));
+  }
+  *dtype_out = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(t, 2)));
+  Py_DECREF(t);
+  return 0;
+}
+
+// returns bytes written, or -(needed+1) when cap is too small
+int64_t ptc_get_output_data(void* h, int i, char* buf, uint64_t cap) {
+  Predictor* p = static_cast<Predictor*>(h);
+  Gil gil;
+  PyObject* t = get_output_tuple(p, i);
+  if (!t) {
+    PyErr_Print();
+    return -1;
+  }
+  PyObject* data = PyTuple_GetItem(t, 0);  // borrowed bytes
+  char* raw;
+  Py_ssize_t len;
+  if (PyBytes_AsStringAndSize(data, &raw, &len) != 0) {
+    Py_DECREF(t);
+    return -1;
+  }
+  if (static_cast<uint64_t>(len) > cap) {
+    Py_DECREF(t);
+    return -(static_cast<int64_t>(len) + 1);
+  }
+  std::memcpy(buf, raw, len);
+  Py_DECREF(t);
+  return static_cast<int64_t>(len);
+}
+
+}  // extern "C"

@@ -1,0 +1,71 @@
+/* Pure-C inference demo against the paddle_tpu C API (csrc/capi.cc) —
+ * the analog of the reference's legacy/capi examples
+ * (paddle/legacy/capi/examples/model_inference/dense/main.c).
+ *
+ *   ./capi_demo <model_dir> <python_path> <input_dim>
+ *
+ * Feeds a ones batch of shape (2, input_dim) to the saved inference model
+ * and prints the first output row. */
+
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+extern int ptc_init(const char* python_path);
+extern void* ptc_predictor_create(const char* model_dir);
+extern int ptc_set_input(void* h, const char* name, const char* data,
+                         uint64_t byte_len, const int64_t* shape, int ndim,
+                         int dtype);
+extern int ptc_run(void* h);
+extern int ptc_get_output_shape(void* h, int i, int64_t* shape_out,
+                                int shape_cap, int* ndim_out,
+                                int* dtype_out);
+extern int64_t ptc_get_output_data(void* h, int i, char* buf, uint64_t cap);
+extern void ptc_predictor_destroy(void* h);
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr,
+            "usage: %s <model_dir> <python_path> <input_dim> [input_name]\n",
+            argv[0]);
+    return 2;
+  }
+  const char* model_dir = argv[1];
+  const char* python_path = argv[2];
+  int dim = atoi(argv[3]);
+  const char* input_name = argc > 4 ? argv[4] : "x";
+
+  if (ptc_init(python_path) != 0) return 1;
+  void* pred = ptc_predictor_create(model_dir);
+  if (!pred) return 1;
+
+  float* input = (float*)malloc(sizeof(float) * 2 * dim);
+  for (int i = 0; i < 2 * dim; ++i) input[i] = 1.0f;
+  int64_t shape[2] = {2, dim};
+  if (ptc_set_input(pred, input_name, (const char*)input,
+                    sizeof(float) * 2 * dim, shape, 2, 0) != 0) {
+    return 1;
+  }
+  int n_out = ptc_run(pred);
+  if (n_out < 1) return 1;
+
+  int64_t oshape[8];
+  int ondim, odtype;
+  if (ptc_get_output_shape(pred, 0, oshape, 8, &ondim, &odtype) != 0) return 1;
+  int64_t numel = 1;
+  for (int i = 0; i < ondim; ++i) numel *= oshape[i];
+  float* out = (float*)malloc(sizeof(float) * numel);
+  if (ptc_get_output_data(pred, 0, (char*)out, sizeof(float) * numel) < 0) {
+    return 1;
+  }
+  printf("output shape:");
+  for (int i = 0; i < ondim; ++i) printf(" %lld", (long long)oshape[i]);
+  printf("\nrow0:");
+  int row = ondim > 1 ? (int)oshape[ondim - 1] : (int)numel;
+  for (int i = 0; i < row; ++i) printf(" %.6f", out[i]);
+  printf("\n");
+  ptc_predictor_destroy(pred);
+  free(input);
+  free(out);
+  return 0;
+}

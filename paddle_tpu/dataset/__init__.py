@@ -12,5 +12,15 @@ from . import imdb  # noqa: F401
 from . import cifar  # noqa: F401
 from . import imikolov  # noqa: F401
 from . import movielens  # noqa: F401
+from . import ctr  # noqa: F401
+from . import flowers  # noqa: F401
+from . import conll05  # noqa: F401
+from . import sentiment  # noqa: F401
+from . import wmt14  # noqa: F401
+from . import wmt16  # noqa: F401
+from . import voc2012  # noqa: F401
+from . import mq2007  # noqa: F401
 
-__all__ = ['mnist', 'uci_housing', 'imdb', 'cifar', 'imikolov', 'movielens']
+__all__ = ['mnist', 'uci_housing', 'imdb', 'cifar', 'imikolov', 'movielens',
+           'ctr', 'flowers', 'conll05', 'sentiment', 'wmt14', 'wmt16',
+           'voc2012', 'mq2007']

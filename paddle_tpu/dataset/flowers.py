@@ -1,0 +1,37 @@
+"""Flowers-102-shaped image dataset (reference:
+python/paddle/dataset/flowers.py).  Synthetic (zero-egress): class-dependent
+color statistics so conv models genuinely separate classes.  Sample format
+matches the reference reader: (flat float32 image of 3*H*W, int label)."""
+
+import numpy as np
+
+__all__ = ['train', 'test', 'valid']
+
+CLASS_NUM = 102
+_SHAPE = (3, 64, 64)
+
+
+def _reader_creator(seed, n):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rng.randint(0, CLASS_NUM))
+            base = np.zeros(_SHAPE, np.float32)
+            base[label % 3] = (label / float(CLASS_NUM))
+            img = base + 0.1 * rng.standard_normal(_SHAPE).astype(
+                np.float32)
+            yield img.flatten(), label
+
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=False, n=1020):
+    return _reader_creator(31, n)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=False, n=510):
+    return _reader_creator(37, n)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=False, n=510):
+    return _reader_creator(41, n)

@@ -1,0 +1,50 @@
+"""MQ2007 LETOR ranking-shaped dataset (reference:
+python/paddle/dataset/mq2007.py).  Synthetic: 46-dim feature vectors whose
+first coordinate carries the relevance signal, so rank losses order pairs
+correctly.  Formats match the reference:
+
+* pairwise: yields (relevant_doc_vec, irrelevant_doc_vec)
+* listwise: yields (label_list, feature_matrix)
+* pointwise: yields (feature_vec, label)
+"""
+
+import numpy as np
+
+__all__ = ['train', 'test']
+
+_DIM = 46
+
+
+def _make_doc(rng, rel):
+    v = rng.standard_normal(_DIM).astype(np.float32) * 0.1
+    v[0] += rel
+    return v
+
+
+def _reader_creator(seed, n_queries, format):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n_queries):
+            n_docs = int(rng.randint(4, 10))
+            rels = rng.randint(0, 3, size=n_docs)
+            docs = [_make_doc(rng, r) for r in rels]
+            if format == 'pairwise':
+                for i in range(n_docs):
+                    for j in range(n_docs):
+                        if rels[i] > rels[j]:
+                            yield docs[i], docs[j]
+            elif format == 'listwise':
+                yield list(map(int, rels)), docs
+            else:  # pointwise
+                for d, r in zip(docs, rels):
+                    yield d, int(r)
+
+    return reader
+
+
+def train(format='pairwise', n_queries=200):
+    return _reader_creator(89, n_queries, format)
+
+
+def test(format='pairwise', n_queries=50):
+    return _reader_creator(97, n_queries, format)

@@ -1,0 +1,34 @@
+"""Movie-review sentiment dataset (reference:
+python/paddle/dataset/sentiment.py over nltk movie_reviews).  Synthetic;
+sample format matches: (list of word ids, label in {0, 1})."""
+
+import numpy as np
+
+__all__ = ['get_word_dict', 'train', 'test']
+
+_VOCAB = 2000
+
+
+def get_word_dict():
+    return {('w%d' % i): i for i in range(_VOCAB)}
+
+
+def _reader_creator(seed, n):
+    def reader():
+        rng = np.random.RandomState(seed)
+        half = _VOCAB // 2
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(10, 50))
+            lo, hi = (0, half) if label else (half, _VOCAB)
+            yield list(map(int, rng.randint(lo, hi, size=length))), label
+
+    return reader
+
+
+def train(n=1600):
+    return _reader_creator(43, n)
+
+
+def test(n=400):
+    return _reader_creator(47, n)

@@ -1,0 +1,38 @@
+"""VOC2012 segmentation-shaped dataset (reference:
+python/paddle/dataset/voc2012.py).  Synthetic; sample format matches the
+reference reader: (flat float32 image 3*H*W, flat int32 label mask H*W)."""
+
+import numpy as np
+
+__all__ = ['train', 'test', 'val']
+
+_SHAPE = (3, 32, 32)
+_CLASSES = 21
+
+
+def _reader_creator(seed, n):
+    def reader():
+        rng = np.random.RandomState(seed)
+        h, w = _SHAPE[1], _SHAPE[2]
+        for _ in range(n):
+            cls = int(rng.randint(1, _CLASSES))
+            mask = np.zeros((h, w), np.int32)
+            x0, y0 = rng.randint(0, w // 2), rng.randint(0, h // 2)
+            mask[y0:y0 + h // 2, x0:x0 + w // 2] = cls
+            img = 0.1 * rng.standard_normal(_SHAPE).astype(np.float32)
+            img[cls % 3] += (mask > 0).astype(np.float32) * 0.8
+            yield img.flatten(), mask.flatten()
+
+    return reader
+
+
+def train(n=800):
+    return _reader_creator(73, n)
+
+
+def test(n=200):
+    return _reader_creator(79, n)
+
+
+def val(n=200):
+    return _reader_creator(83, n)

@@ -1,0 +1,43 @@
+"""WMT14 en-fr-shaped translation dataset (reference:
+python/paddle/dataset/wmt14.py).  Synthetic parallel corpus: the "target"
+is a deterministic function of the source so a seq2seq model can actually
+drive its loss down.  Sample format matches the reference:
+(src_ids, trg_ids, trg_ids_next) with <s>=0, <e>=1, <unk>=2."""
+
+import numpy as np
+
+__all__ = ['train', 'test', 'get_dict']
+
+START, END, UNK = 0, 1, 2
+
+
+def get_dict(dict_size, reverse=False):
+    src = {('s%d' % i): i for i in range(dict_size)}
+    trg = {('t%d' % i): i for i in range(dict_size)}
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg
+
+
+def _reader_creator(seed, n, dict_size):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            length = int(rng.randint(3, 12))
+            src = rng.randint(3, dict_size, size=length)
+            # target: reversed source shifted by one vocab slot
+            trg = np.clip(src[::-1] + 1, 3, dict_size - 1)
+            trg_ids = [START] + list(map(int, trg))
+            trg_next = list(map(int, trg)) + [END]
+            yield list(map(int, src)), trg_ids, trg_next
+
+    return reader
+
+
+def train(dict_size, n=2000):
+    return _reader_creator(53, n, dict_size)
+
+
+def test(dict_size, n=400):
+    return _reader_creator(59, n, dict_size)

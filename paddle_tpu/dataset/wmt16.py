@@ -1,0 +1,24 @@
+"""WMT16 multimodal-task-shaped translation dataset (reference:
+python/paddle/dataset/wmt16.py).  Synthetic; sample format matches:
+(src_ids, trg_ids, trg_ids_next)."""
+
+from . import wmt14 as _wmt14
+
+__all__ = ['train', 'test', 'validation', 'get_dict']
+
+
+def get_dict(lang, dict_size, reverse=False):
+    src, trg = _wmt14.get_dict(dict_size, reverse)
+    return src if lang == 'en' else trg
+
+
+def train(src_dict_size, trg_dict_size, src_lang='en', n=2000):
+    return _wmt14._reader_creator(61, n, min(src_dict_size, trg_dict_size))
+
+
+def test(src_dict_size, trg_dict_size, src_lang='en', n=400):
+    return _wmt14._reader_creator(67, n, min(src_dict_size, trg_dict_size))
+
+
+def validation(src_dict_size, trg_dict_size, src_lang='en', n=400):
+    return _wmt14._reader_creator(71, n, min(src_dict_size, trg_dict_size))

@@ -285,14 +285,24 @@ class Master(object):
             return tid, None
         return tid, json.loads(payload.decode())
 
+    # snapshot throttling: timeout-redispatch already tolerates a stale
+    # snapshot (a recovered pending task just re-runs), so rewriting the
+    # whole blob on every completion would be O(tasks^2) disk traffic
+    SNAPSHOT_EVERY = 16
+
     def task_finished(self, tid):
         self._q.task_finished(tid)
-        self.snapshot_to_store()
+        self._maybe_snapshot()
 
     def task_failed(self, tid):
         r = self._q.task_failed(tid)
-        self.snapshot_to_store()
+        self._maybe_snapshot()
         return r
+
+    def _maybe_snapshot(self):
+        self._events = getattr(self, '_events', 0) + 1
+        if self._events % self.SNAPSHOT_EVERY == 0:
+            self.snapshot_to_store()
 
     def new_pass(self):
         self._q.new_pass()

@@ -34,17 +34,31 @@ def _load():
         if not os.path.exists(_SO_PATH):
             if not _try_build():
                 return None
-        lib = ctypes.CDLL(_SO_PATH)
         # an on-disk .so from an older source tree may predate newly added
-        # symbols: rebuild once (make relinks when sources are newer) and
-        # reload instead of crashing every native consumer
-        if not hasattr(lib, 'ms_create'):
-            del lib
-            if not _try_build():
+        # symbols.  Probe BEFORE dlopening the stale image into the
+        # process (a dlopen'd inode cannot be reloaded, and relinking it
+        # in place would corrupt the live mapping): rebuild to a temp
+        # path and atomically replace, then load once
+        probe = ctypes.CDLL(_SO_PATH)
+        if not hasattr(probe, 'ms_create'):
+            del probe  # note: the stale image stays mapped (no dlclose)
+            import tempfile
+            import subprocess as sp
+            try:
+                tmp = tempfile.NamedTemporaryFile(
+                    dir=os.path.dirname(_SO_PATH), suffix='.so',
+                    delete=False)
+                tmp.close()
+                sp.run(['make', '-B', 'OUT=%s' % tmp.name], cwd=_CSRC,
+                       check=True, capture_output=True, timeout=120)
+                os.replace(tmp.name, _SO_PATH)
+            except Exception:
                 return None
             lib = ctypes.CDLL(_SO_PATH)
             if not hasattr(lib, 'ms_create'):
                 return None
+        else:
+            lib = probe
         # recordio
         lib.recordio_writer_create.restype = ctypes.c_void_p
         lib.recordio_writer_create.argtypes = [ctypes.c_char_p,

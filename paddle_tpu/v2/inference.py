@@ -40,10 +40,14 @@ class Inference(object):
         self._exe = fluid.Executor(place)
 
     def infer(self, input, feeding=None, field='value'):
-        if len(input[0]) != len(self.data_layers):
+        # with an explicit feeding map, wider rows are fine — _build_feed
+        # selects the mapped columns; only the positional default needs
+        # the column count to match exactly
+        if feeding is None and len(input[0]) != len(self.data_layers):
             raise ValueError(
                 'infer input has %d columns but the output layer depends '
-                'on %d data layers (%s)' %
+                'on %d data layers (%s); pass feeding={name: column} for '
+                'wider rows' %
                 (len(input[0]), len(self.data_layers),
                  [l.name for l in self.data_layers]))
         feed = _build_feed(self.data_layers, input, feeding)

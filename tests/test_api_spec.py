@@ -1,0 +1,29 @@
+"""Public API surface regression gate (reference: tools/diff_api.py +
+paddle/fluid/API.spec — any public signature change must update the
+spec deliberately)."""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_api_spec_matches():
+    sys.path.insert(0, os.path.join(REPO, 'tools'))
+    try:
+        import gen_api_spec
+        current = gen_api_spec.generate()
+    finally:
+        sys.path.pop(0)
+    spec_path = os.path.join(REPO, 'paddle_tpu', 'API.spec')
+    with open(spec_path) as f:
+        pinned = [l.rstrip('\n') for l in f if l.strip()]
+    cur_set, pin_set = set(current), set(pinned)
+    removed = sorted(pin_set - cur_set)
+    added = sorted(cur_set - pin_set)
+    assert not removed and not added, (
+        'public API surface changed.\nRemoved/changed:\n  %s\n'
+        'Added/changed:\n  %s\n'
+        'If intentional, regenerate: python tools/gen_api_spec.py > '
+        'paddle_tpu/API.spec' %
+        ('\n  '.join(removed) or '-', '\n  '.join(added) or '-'))

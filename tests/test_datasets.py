@@ -1,0 +1,69 @@
+"""Dataset reader schemas (reference parity: python/paddle/dataset/tests).
+Each synthetic set must match the reference's per-sample tuple layout and
+be deterministic across calls."""
+
+import numpy as np
+
+import paddle_tpu.dataset as ds
+
+
+def _first(reader):
+    return next(iter(reader()))
+
+
+def test_flowers_schema():
+    img, label = _first(ds.flowers.train())
+    assert img.shape == (3 * 64 * 64, ) and img.dtype == np.float32
+    assert 0 <= label < ds.flowers.CLASS_NUM
+    assert np.allclose(img, _first(ds.flowers.train())[0])  # deterministic
+
+
+def test_conll05_schema():
+    sample = _first(ds.conll05.test())
+    assert len(sample) == 9
+    length = len(sample[0])
+    assert all(len(col) == length for col in sample)
+    word_dict, verb_dict, label_dict = ds.conll05.get_dict()
+    assert len(label_dict) == 59
+    emb = ds.conll05.get_embedding()
+    assert emb.shape == (len(word_dict), 32)
+
+
+def test_sentiment_schema():
+    words, label = _first(ds.sentiment.train())
+    assert label in (0, 1)
+    assert all(0 <= w < len(ds.sentiment.get_word_dict()) for w in words)
+
+
+def test_wmt14_schema():
+    dict_size = 30
+    src, trg, trg_next = _first(ds.wmt14.train(dict_size))
+    assert len(trg) == len(trg_next)
+    assert trg[0] == ds.wmt14.START
+    assert trg_next[-1] == ds.wmt14.END
+    assert all(0 <= w < dict_size for w in src + trg + trg_next)
+    sd, td = ds.wmt14.get_dict(dict_size)
+    assert len(sd) == len(td) == dict_size
+
+
+def test_wmt16_schema():
+    src, trg, trg_next = _first(ds.wmt16.train(40, 40))
+    assert trg[0] == 0 and trg_next[-1] == 1
+    d = ds.wmt16.get_dict('en', 40)
+    assert len(d) == 40
+
+
+def test_voc2012_schema():
+    img, mask = _first(ds.voc2012.train())
+    assert img.shape == (3 * 32 * 32, )
+    assert mask.shape == (32 * 32, )
+    assert mask.max() >= 1  # an object is present
+
+
+def test_mq2007_formats():
+    rel, irr = _first(ds.mq2007.train(format='pairwise'))
+    assert rel.shape == irr.shape == (46, )
+    labels, docs = _first(ds.mq2007.train(format='listwise'))
+    assert len(labels) == len(docs)
+    vec, label = _first(ds.mq2007.train(format='pointwise'))
+    assert vec.shape == (46, ) and label in (0, 1, 2)

@@ -1,0 +1,66 @@
+"""Generate API.spec: the pinned public Python API surface.
+
+Reference: tools/diff_api.py + paddle/fluid/API.spec — CI fails when a
+public signature changes without updating the spec.  Run:
+
+    python tools/gen_api_spec.py > paddle_tpu/API.spec
+"""
+
+import inspect
+import sys
+
+
+def _spec_of(fn):
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return '(unavailable)'
+    return str(sig)
+
+
+def _walk(prefix, mod, names):
+    lines = []
+    for name in names:
+        obj = getattr(mod, name, None)
+        if obj is None:
+            continue
+        full = '%s.%s' % (prefix, name)
+        if inspect.isclass(obj):
+            lines.append('%s.__init__ %s' % (full, _spec_of(obj.__init__)))
+            for mname, meth in sorted(vars(obj).items()):
+                if mname.startswith('_'):
+                    continue
+                if callable(meth):
+                    lines.append('%s.%s %s' % (full, mname, _spec_of(meth)))
+        elif callable(obj):
+            lines.append('%s %s' % (full, _spec_of(obj)))
+    return lines
+
+
+def generate():
+    import paddle_tpu.fluid as fluid
+
+    lines = []
+    lines += _walk('paddle_tpu.fluid.layers', fluid.layers,
+                   sorted(fluid.layers.__all__))
+    lines += _walk('paddle_tpu.fluid.optimizer', fluid.optimizer,
+                   sorted(fluid.optimizer.__all__))
+    lines += _walk('paddle_tpu.fluid', fluid, [
+        'Executor', 'ParallelExecutor', 'Program', 'DataFeeder',
+        'DistributeTranspiler', 'Trainer', 'Inferencer', 'scope_guard',
+        'program_guard', 'append_backward', 'Go', 'Select', 'make_channel',
+        'channel_send', 'channel_recv', 'channel_close',
+    ])
+    lines += _walk('paddle_tpu.fluid.io', fluid.io, sorted(
+        n for n in fluid.io.__all__ if not n.startswith('_')))
+    lines += _walk('paddle_tpu.fluid.metrics', fluid.metrics, [
+        'Accuracy', 'Auc', 'ChunkEvaluator', 'CompositeMetric',
+        'DetectionMAP', 'EditDistance', 'Precision', 'Recall',
+    ])
+    lines += _walk('paddle_tpu.fluid.nets', fluid.nets,
+                   sorted(fluid.nets.__all__))
+    return sorted(set(lines))
+
+
+if __name__ == '__main__':
+    sys.stdout.write('\n'.join(generate()) + '\n')
