@@ -193,6 +193,7 @@ class Master(object):
             self._q = TaskQueuePyFallback(chunk_timeout_secs, failure_max)
         self.store_path = store_path
         self._lock_fd = None
+        self._events = 0
         if store_path:
             os.makedirs(store_path, exist_ok=True)
             self._acquire_lock()
@@ -251,6 +252,7 @@ class Master(object):
         self._lock_path = path
 
     def close(self):
+        self.snapshot_to_store()  # final flush before releasing the lock
         if self._lock_fd is not None:
             os.close(self._lock_fd)  # releases the flock
             self._lock_fd = None
@@ -296,12 +298,14 @@ class Master(object):
 
     def task_failed(self, tid):
         r = self._q.task_failed(tid)
-        self._maybe_snapshot()
+        # a discard decision (failure cap reached) must be durable, or a
+        # restarted master re-dispatches the poisoned task forever
+        self._maybe_snapshot(force=(r == 1))
         return r
 
-    def _maybe_snapshot(self):
-        self._events = getattr(self, '_events', 0) + 1
-        if self._events % self.SNAPSHOT_EVERY == 0:
+    def _maybe_snapshot(self, force=False):
+        self._events += 1
+        if force or self._events % self.SNAPSHOT_EVERY == 0:
             self.snapshot_to_store()
 
     def new_pass(self):

@@ -44,6 +44,8 @@ from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, \
     memory_optimize, release_memory, InferenceTranspiler
 from . import evaluator
 from . import concurrency
+from . import amp
+from .amp import amp_guard, enable_amp
 from .concurrency import (Go, make_channel, channel_send, channel_recv,
                           channel_close, Select)
 from . import debugger

@@ -1,0 +1,39 @@
+"""Automatic mixed precision for TPU training.
+
+bf16 inputs to matmuls/convolutions (the MXU's native multiply format) with
+fp32 accumulation and fp32 master weights — everything else (batch norm
+statistics, softmax, optimizer state) stays fp32.  The reference-era
+analog is the float16 inference transpiler
+(paddle/contrib/float16/float16_transpiler.py); on TPU this is a
+trace-time mode rather than a program rewrite because XLA inserts the
+casts into the fused kernels.
+
+    with fluid.amp_guard():
+        exe.run(train_program, ...)
+
+or globally: fluid.enable_amp(True).
+"""
+
+import contextlib
+
+from ..ops import registry as _registry
+
+__all__ = ['amp_guard', 'enable_amp', 'amp_enabled']
+
+
+def enable_amp(enabled=True):
+    _registry.set_amp(enabled)
+
+
+def amp_enabled():
+    return _registry.amp_enabled()
+
+
+@contextlib.contextmanager
+def amp_guard(enable=True):
+    prev = _registry.amp_enabled()
+    _registry.set_amp(enable)
+    try:
+        yield
+    finally:
+        _registry.set_amp(prev)

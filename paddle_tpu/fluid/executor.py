@@ -414,7 +414,7 @@ class Executor(object):
         validate_feed(program, feed_arrays)
         sig = feed_signature(feed_arrays)
         key = (id(program), program._version, tuple(fetch_names), sig,
-               self.place, id(scope))
+               self.place, id(scope), registry.amp_enabled())
         compiled = self._cache.get(key)
         if compiled is None:
             compiled = _CompiledBlock(program, 0, [n for n, _, _ in sig],

@@ -173,7 +173,8 @@ class ParallelExecutor(object):
         _pop_readers_into_feed(program, feed)
         feed_arrays = prepare_feed_arrays(feed)
         sig = feed_signature(feed_arrays)
-        key = (id(program), program._version, tuple(fetch_names), sig)
+        key = (id(program), program._version, tuple(fetch_names), sig,
+               registry.amp_enabled())
         compiled = self._cache.get(key)
         if compiled is None:
             host = [op.type for op in program.global_block().ops

@@ -11,7 +11,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .registry import register_lowering, register_grad_lowering, fwd_structure
+from .registry import (register_lowering, register_grad_lowering,
+                       fwd_structure, amp_cast_in, amp_enabled)
 
 _CONV_DN = ('NCHW', 'OIHW', 'NCHW')
 
@@ -30,6 +31,7 @@ def _conv2d(ctx, op):
     paddings = _pair(op.attrs.get('paddings', [0, 0]))
     dilations = _pair(op.attrs.get('dilations', [1, 1]))
     groups = op.attrs.get('groups', 1) or 1
+    x, w = amp_cast_in(x, w)
     out = jax.lax.conv_general_dilated(
         x, w,
         window_strides=strides,
@@ -37,7 +39,10 @@ def _conv2d(ctx, op):
         rhs_dilation=dilations,
         dimension_numbers=_CONV_DN,
         feature_group_count=groups)
-    ctx.set(op, 'Output', out)
+    # conv VJP rejects mixed operand dtypes, so AMP convs run fully in
+    # bf16 (MXU accumulates fp32 internally) and upcast the result
+    ctx.set(op, 'Output', out.astype(jnp.float32)
+            if out.dtype == jnp.bfloat16 else out)
 
 
 @register_lowering('depthwise_conv2d')
@@ -47,6 +52,7 @@ def _depthwise_conv2d(ctx, op):
     strides = _pair(op.attrs.get('strides', [1, 1]))
     paddings = _pair(op.attrs.get('paddings', [0, 0]))
     dilations = _pair(op.attrs.get('dilations', [1, 1]))
+    x, w = amp_cast_in(x, w)
     out = jax.lax.conv_general_dilated(
         x, w,
         window_strides=strides,
@@ -54,7 +60,8 @@ def _depthwise_conv2d(ctx, op):
         rhs_dilation=dilations,
         dimension_numbers=_CONV_DN,
         feature_group_count=x.shape[1])
-    ctx.set(op, 'Output', out)
+    ctx.set(op, 'Output', out.astype(jnp.float32)
+            if out.dtype == jnp.bfloat16 else out)
 
 
 @register_lowering('conv2d_transpose')
@@ -65,6 +72,7 @@ def _conv2d_transpose(ctx, op):
     paddings = _pair(op.attrs.get('paddings', [0, 0]))
     dilations = _pair(op.attrs.get('dilations', [1, 1]))
     groups = op.attrs.get('groups', 1) or 1
+    x, w = amp_cast_in(x, w)
     # gradient-of-conv formulation (matches the reference's col2im path)
     out = jax.lax.conv_transpose(
         x, jnp.swapaxes(w, 0, 1) if groups == 1 else w,
@@ -73,7 +81,8 @@ def _conv2d_transpose(ctx, op):
         rhs_dilation=dilations,
         dimension_numbers=('NCHW', 'IOHW', 'NCHW'),
         transpose_kernel=True)
-    ctx.set(op, 'Output', out)
+    ctx.set(op, 'Output', out.astype(jnp.float32)
+            if out.dtype == jnp.bfloat16 else out)
 
 
 @register_lowering('conv3d')
@@ -84,6 +93,7 @@ def _conv3d(ctx, op):
     paddings = op.attrs.get('paddings', [0, 0, 0])
     dilations = op.attrs.get('dilations', [1, 1, 1])
     groups = op.attrs.get('groups', 1) or 1
+    x, w = amp_cast_in(x, w)
     out = jax.lax.conv_general_dilated(
         x, w,
         window_strides=list(strides),
@@ -91,7 +101,8 @@ def _conv3d(ctx, op):
         rhs_dilation=list(dilations),
         dimension_numbers=('NCDHW', 'OIDHW', 'NCDHW'),
         feature_group_count=groups)
-    ctx.set(op, 'Output', out)
+    ctx.set(op, 'Output', out.astype(jnp.float32)
+            if out.dtype == jnp.bfloat16 else out)
 
 
 def _pool(x, op, ndim):

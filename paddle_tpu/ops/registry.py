@@ -280,3 +280,31 @@ def _make_generic_grad(fwd_type):
             ctx.store(gname, g)
 
     return grad_lowering
+
+
+# ---- mixed precision (bf16 compute / fp32 master weights) ----
+# The reference era's float16 work is an inference-only transpiler
+# (paddle/contrib/float16/float16_transpiler.py); on TPU the right shape is
+# training-time bf16 matmul/conv inputs with fp32 accumulation on the MXU.
+_AMP = {'enabled': False}
+
+
+def set_amp(enabled):
+    _AMP['enabled'] = bool(enabled)
+
+
+def amp_enabled():
+    return _AMP['enabled']
+
+
+def amp_cast_in(*xs):
+    """Cast f32 operands to bf16 for an MXU op when AMP is on; leave
+    everything else untouched.  Pair with preferred_element_type=f32 so
+    accumulation stays fp32."""
+    import jax.numpy as jnp
+    if not _AMP['enabled']:
+        return xs
+    return tuple(
+        x.astype(jnp.bfloat16)
+        if x is not None and hasattr(x, 'dtype') and x.dtype == jnp.float32
+        else x for x in xs)

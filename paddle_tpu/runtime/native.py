@@ -44,6 +44,7 @@ def _load():
             del probe  # note: the stale image stays mapped (no dlclose)
             import tempfile
             import subprocess as sp
+            tmp = None
             try:
                 tmp = tempfile.NamedTemporaryFile(
                     dir=os.path.dirname(_SO_PATH), suffix='.so',
@@ -51,8 +52,14 @@ def _load():
                 tmp.close()
                 sp.run(['make', '-B', 'OUT=%s' % tmp.name], cwd=_CSRC,
                        check=True, capture_output=True, timeout=120)
+                os.chmod(tmp.name, 0o755)
                 os.replace(tmp.name, _SO_PATH)
             except Exception:
+                if tmp is not None:
+                    try:
+                        os.unlink(tmp.name)
+                    except OSError:
+                        pass
                 return None
             lib = ctypes.CDLL(_SO_PATH)
             if not hasattr(lib, 'ms_create'):

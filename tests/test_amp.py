@@ -1,0 +1,85 @@
+"""Mixed-precision (bf16 compute / fp32 master weights) tests.
+
+Reference-era analog: paddle/contrib/float16/float16_transpiler.py
+(inference-only fp16); here AMP is a trace-time training mode."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def _build_convnet():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[3, 16, 16], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='int64')
+        c = fluid.layers.conv2d(x, num_filters=8, filter_size=3,
+                                act='relu')
+        pred = fluid.layers.fc(c, size=4, act='softmax')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    return prog, startup, loss
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    xv = rng.standard_normal((16, 3, 16, 16)).astype('float32')
+    yv = (np.arange(16) % 4).astype('int64')[:, None]
+    return xv, yv
+
+
+def test_amp_training_converges():
+    prog, startup, loss = _build_convnet()
+    xv, yv = _data()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        with fluid.amp_guard():
+            losses = []
+            for _ in range(20):
+                lv, = exe.run(prog, feed={'x': xv, 'y': yv},
+                              fetch_list=[loss])
+                losses.append(float(np.asarray(lv).flatten()[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_amp_close_to_fp32_and_guard_restores():
+    # forward-only program: same weights in ONE scope, amp off vs on
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[3, 16, 16], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='int64')
+        c = fluid.layers.conv2d(x, num_filters=8, filter_size=3,
+                                act='relu')
+        pred = fluid.layers.fc(c, size=4, act='softmax')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+    xv, yv = _data()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        assert not fluid.amp.amp_enabled()
+        l_fp32, = exe.run(prog, feed={'x': xv, 'y': yv},
+                          fetch_list=[loss])
+        with fluid.amp_guard():
+            l_amp, = exe.run(prog, feed={'x': xv, 'y': yv},
+                             fetch_list=[loss])
+        assert not fluid.amp.amp_enabled()  # guard restored
+    # identical weights: bf16 rounding shifts the loss by well under 2%
+    np.testing.assert_allclose(
+        float(np.asarray(l_amp).flatten()[0]),
+        float(np.asarray(l_fp32).flatten()[0]), rtol=2e-2)
+
+
+def test_amp_master_weights_stay_fp32():
+    prog, startup, loss = _build_convnet()
+    xv, yv = _data()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with fluid.amp_guard():
+            exe.run(prog, feed={'x': xv, 'y': yv}, fetch_list=[loss])
+        for p in prog.global_block().all_parameters():
+            arr = np.asarray(scope.find_var(p.name).value())
+            assert arr.dtype == np.float32, (p.name, arr.dtype)
