@@ -140,6 +140,8 @@ def main():
     place = fluid.TPUPlace() if use_tpu else fluid.CPUPlace()
     exe = fluid.Executor(place)
     scope = fluid.core.Scope()
+    if args.iterations < 1:
+        raise SystemExit('--iterations must be >= 1')
     with fluid.scope_guard(scope), fluid.amp_guard(args.amp):
         exe.run(model['startup'])
         for _ in range(args.skip_batch_num):

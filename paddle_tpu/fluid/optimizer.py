@@ -7,6 +7,7 @@ the same fused XLA step as the rest of the block.
 """
 
 from collections import defaultdict
+from contextlib import contextmanager
 
 from . import framework
 from . import unique_name
@@ -607,13 +608,128 @@ ProximalAdagrad = ProximalAdagradOptimizer
 
 
 class ModelAverage(Optimizer):
-    """Running average of parameters (reference optimizer.py:1145).
-    Implemented in the aux phase; declared for API parity."""
+    """Running average of parameters (reference optimizer.py:1145 +
+    operators/average_accumulates_op.cc).  Construct AFTER the training
+    optimizer's minimize(): appends accumulate ops to the main program;
+    ``with model_average.apply(exe):`` swaps params for their windowed
+    average (inference/eval), restore() puts the live params back."""
 
     def __init__(self,
                  average_window_rate,
                  min_average_window=10000,
                  max_average_window=10000,
                  **kwargs):
-        raise NotImplementedError(
-            'ModelAverage lands with the aux subsystems phase')
+        super(ModelAverage, self).__init__(learning_rate=0.0, **kwargs)
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self.params = [
+            p for p in
+            framework.default_main_program().global_block()
+            .all_parameters() if p.trainable
+        ]
+        self.helper = LayerHelper('model_average')
+        with framework.program_guard(framework.default_main_program(),
+                                     framework.default_startup_program()):
+            for param in self.params:
+                self._append_average_accumulate_op(param)
+
+        self.apply_program = framework.Program()
+        self.restore_program = framework.Program()
+        with framework.program_guard(self.apply_program):
+            for param in self.params:
+                self._add_average_apply_op(param)
+        with framework.program_guard(self.restore_program):
+            for param in self.params:
+                self._add_average_restore_op(param)
+
+    def _append_average_accumulate_op(self, param):
+        self._add_accumulator('sum_1', param)
+        self._add_accumulator('sum_2', param)
+        self._add_accumulator('sum_3', param)
+        self._add_accumulator('num_accumulates', param, dtype='int64',
+                              shape=[1])
+        self._add_accumulator('old_num_accumulates', param, dtype='int64',
+                              shape=[1])
+        self._add_accumulator('num_updates', param, dtype='int64',
+                              shape=[1])
+        accs = {n: self._get_accumulator(n, param) for n in
+                ('sum_1', 'sum_2', 'sum_3', 'num_accumulates',
+                 'old_num_accumulates', 'num_updates')}
+        self.helper.append_op(
+            type='average_accumulates',
+            inputs={
+                'param': [param],
+                'in_sum_1': [accs['sum_1']],
+                'in_sum_2': [accs['sum_2']],
+                'in_sum_3': [accs['sum_3']],
+                'in_num_accumulates': [accs['num_accumulates']],
+                'in_old_num_accumulates': [accs['old_num_accumulates']],
+                'in_num_updates': [accs['num_updates']],
+            },
+            outputs={
+                'out_sum_1': [accs['sum_1']],
+                'out_sum_2': [accs['sum_2']],
+                'out_sum_3': [accs['sum_3']],
+                'out_num_accumulates': [accs['num_accumulates']],
+                'out_old_num_accumulates': [accs['old_num_accumulates']],
+                'out_num_updates': [accs['num_updates']],
+            },
+            attrs={
+                'average_window': self.average_window,
+                'min_average_window': self.min_average_window,
+                'max_average_window': self.max_average_window,
+            })
+
+    def _ref(self, program, var):
+        """Mirror a var of the training program into `program`."""
+        return program.global_block().create_var(
+            name=var.name, shape=var.shape, dtype=var.dtype,
+            persistable=True)
+
+    def _add_average_apply_op(self, param):
+        block = framework.default_main_program().global_block()
+        p = self._ref(block.program, param)
+        backup = block.create_var(
+            name=param.name + '@MA_BACKUP', shape=param.shape,
+            dtype=param.dtype, persistable=True)
+        sum_1 = self._ref(block.program,
+                          self._get_accumulator('sum_1', param))
+        sum_2 = self._ref(block.program,
+                          self._get_accumulator('sum_2', param))
+        sum_3 = self._ref(block.program,
+                          self._get_accumulator('sum_3', param))
+        num_acc = self._ref(
+            block.program, self._get_accumulator('num_accumulates', param))
+        old_num_acc = self._ref(
+            block.program,
+            self._get_accumulator('old_num_accumulates', param))
+        from . import layers
+        layers.assign(input=p, output=backup)
+        total = layers.sums([sum_1, sum_2, sum_3])
+        count = layers.cast(
+            layers.sums([num_acc, old_num_acc]), dtype=param.dtype)
+        avg = layers.elementwise_div(
+            x=total, y=layers.clip(count, min=1.0, max=1e30))
+        layers.assign(input=avg, output=p)
+
+    def _add_average_restore_op(self, param):
+        block = framework.default_main_program().global_block()
+        p = self._ref(block.program, param)
+        backup = block.create_var(
+            name=param.name + '@MA_BACKUP', shape=param.shape,
+            dtype=param.dtype, persistable=True)
+        from . import layers
+        layers.assign(input=backup, output=p)
+
+    @contextmanager
+    def apply(self, executor, need_restore=True):
+        executor.run(self.apply_program)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor):
+        executor.run(self.restore_program)

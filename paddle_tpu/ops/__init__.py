@@ -24,6 +24,7 @@ from . import detection_ops  # noqa: F401
 from . import ctc_ops  # noqa: F401
 from . import quantize_ops  # noqa: F401
 from . import concurrency_ops  # noqa: F401
+from . import misc_ops  # noqa: F401
 from . import sparse  # noqa: F401
 
 # wrap every optimizer lowering with SelectedRows (SparseRows) handling —

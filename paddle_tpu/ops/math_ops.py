@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .registry import register_lowering, amp_cast_in, amp_enabled
+from .registry import register_lowering, amp_matmul
 
 
 def _flatten_2d(x, num_col_dims):
@@ -42,11 +42,7 @@ def _mul(ctx, op):
         split = xn  # fall back to declared semantics (will raise clearly)
     x2 = jnp.reshape(x, (-1, int(np.prod(x.shape[split:], dtype=np.int64))
                          if split < x.ndim else 1))
-    x2, y2 = amp_cast_in(x2, y2)
-    out = jnp.matmul(
-        x2, y2,
-        preferred_element_type=jnp.float32
-        if (amp_enabled() and x2.dtype == jnp.bfloat16) else None)
+    out = amp_matmul(x2, y2)
     out_shape = tuple(x.shape[:split]) + tuple(y.shape[yn:])
     ctx.set(op, 'Out', jnp.reshape(out, out_shape))
 
@@ -70,11 +66,7 @@ def _matmul(ctx, op):
         x = jnp.swapaxes(x, -1, -2)
     if ty:
         y = jnp.swapaxes(y, -1, -2)
-    x, y = amp_cast_in(x, y)
-    out = jnp.matmul(
-        x, y,
-        preferred_element_type=jnp.float32
-        if (amp_enabled() and x.dtype == jnp.bfloat16) else None)
+    out = amp_matmul(x, y)
     if alpha != 1.0:
         out = out * jnp.asarray(alpha, out.dtype)
     if squeeze_front:

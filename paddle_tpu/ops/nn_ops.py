@@ -12,7 +12,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .registry import (register_lowering, register_grad_lowering,
-                       fwd_structure, amp_cast_in, amp_enabled)
+                       fwd_structure, amp_cast_in, amp_cast_out,
+                       amp_enabled)
 
 _CONV_DN = ('NCHW', 'OIHW', 'NCHW')
 
@@ -41,8 +42,7 @@ def _conv2d(ctx, op):
         feature_group_count=groups)
     # conv VJP rejects mixed operand dtypes, so AMP convs run fully in
     # bf16 (MXU accumulates fp32 internally) and upcast the result
-    ctx.set(op, 'Output', out.astype(jnp.float32)
-            if out.dtype == jnp.bfloat16 else out)
+    ctx.set(op, 'Output', amp_cast_out(out))
 
 
 @register_lowering('depthwise_conv2d')
@@ -60,8 +60,7 @@ def _depthwise_conv2d(ctx, op):
         rhs_dilation=dilations,
         dimension_numbers=_CONV_DN,
         feature_group_count=x.shape[1])
-    ctx.set(op, 'Output', out.astype(jnp.float32)
-            if out.dtype == jnp.bfloat16 else out)
+    ctx.set(op, 'Output', amp_cast_out(out))
 
 
 @register_lowering('conv2d_transpose')
@@ -81,8 +80,7 @@ def _conv2d_transpose(ctx, op):
         rhs_dilation=dilations,
         dimension_numbers=('NCHW', 'IOHW', 'NCHW'),
         transpose_kernel=True)
-    ctx.set(op, 'Output', out.astype(jnp.float32)
-            if out.dtype == jnp.bfloat16 else out)
+    ctx.set(op, 'Output', amp_cast_out(out))
 
 
 @register_lowering('conv3d')
@@ -101,8 +99,7 @@ def _conv3d(ctx, op):
         rhs_dilation=list(dilations),
         dimension_numbers=('NCDHW', 'OIDHW', 'NCDHW'),
         feature_group_count=groups)
-    ctx.set(op, 'Output', out.astype(jnp.float32)
-            if out.dtype == jnp.bfloat16 else out)
+    ctx.set(op, 'Output', amp_cast_out(out))
 
 
 def _pool(x, op, ndim):

@@ -199,3 +199,45 @@ def _proximal_adagrad(ctx, op):
            / (1.0 + eff_lr * l2))
     ctx.set(op, 'ParamOut', jnp.where(m_out > 0, out, p))
     ctx.set(op, 'MomentOut', m_out)
+
+
+@register_lowering('average_accumulates')
+def _average_accumulates(ctx, op):
+    """Accumulate parameter sums for ModelAverage (reference
+    operators/average_accumulates_op.{cc,h}): sum_1 collects every step,
+    rolls into sum_2 every kMaxNumAccumulates steps, and the whole window
+    rolls into sum_3 when the average window closes."""
+    p = ctx.get(op, 'param')
+    sum_1 = ctx.get(op, 'in_sum_1')
+    sum_2 = ctx.get(op, 'in_sum_2')
+    sum_3 = ctx.get(op, 'in_sum_3')
+    num_acc = jnp.reshape(ctx.get(op, 'in_num_accumulates'), ())
+    old_num_acc = jnp.reshape(ctx.get(op, 'in_old_num_accumulates'), ())
+    num_upd = jnp.reshape(ctx.get(op, 'in_num_updates'), ())
+    avg_window = op.attrs.get('average_window', 0.0)
+    min_avg = op.attrs.get('min_average_window', 10000)
+    max_avg = op.attrs.get('max_average_window', 10000)
+    k_max_acc = 16384  # kMaxNumAccumulates (average_accumulates_op.h)
+
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    sum_1 = sum_1 + p
+    roll2 = (num_upd % k_max_acc) == 0
+    sum_2 = jnp.where(roll2, sum_2 + sum_1, sum_2)
+    sum_1 = jnp.where(roll2, jnp.zeros_like(sum_1), sum_1)
+    window = jnp.minimum(
+        jnp.asarray(max_avg, jnp.float32),
+        num_upd.astype(jnp.float32) * avg_window)
+    close = (num_acc >= min_avg) & (num_acc.astype(jnp.float32) >= window)
+    sum_3 = jnp.where(close, sum_1 + sum_2, sum_3)
+    sum_1 = jnp.where(close, jnp.zeros_like(sum_1), sum_1)
+    sum_2 = jnp.where(close, jnp.zeros_like(sum_2), sum_2)
+    old_num_acc = jnp.where(close, num_acc, old_num_acc)
+    num_acc = jnp.where(close, jnp.zeros_like(num_acc), num_acc)
+
+    ctx.set(op, 'out_sum_1', sum_1)
+    ctx.set(op, 'out_sum_2', sum_2)
+    ctx.set(op, 'out_sum_3', sum_3)
+    ctx.set(op, 'out_num_accumulates', jnp.reshape(num_acc, (1, )))
+    ctx.set(op, 'out_old_num_accumulates', jnp.reshape(old_num_acc, (1, )))
+    ctx.set(op, 'out_num_updates', jnp.reshape(num_upd, (1, )))

@@ -308,3 +308,24 @@ def amp_cast_in(*xs):
         x.astype(jnp.bfloat16)
         if x is not None and hasattr(x, 'dtype') and x.dtype == jnp.float32
         else x for x in xs)
+
+
+def amp_cast_out(out):
+    """Inverse of amp_cast_in for op results: upcast the bf16 the AMP
+    casts introduced back to fp32.  Gated on the AMP flag so genuinely
+    bf16 (non-AMP) programs keep their declared dtype."""
+    import jax.numpy as jnp
+    if _AMP['enabled'] and out.dtype == jnp.bfloat16:
+        return out.astype(jnp.float32)
+    return out
+
+
+def amp_matmul(x, y):
+    """The one home of the AMP matmul policy: bf16 operands with fp32
+    accumulation (preferred_element_type) when AMP is on."""
+    import jax.numpy as jnp
+    x, y = amp_cast_in(x, y)
+    return jnp.matmul(
+        x, y,
+        preferred_element_type=jnp.float32
+        if (_AMP['enabled'] and x.dtype == jnp.bfloat16) else None)

@@ -1,0 +1,300 @@
+"""Op-coverage tail tests: fc op, flatten/squeeze2, fill, minus,
+pad_constant_like, mean_iou, bilinear_tensor_product, conv_shift,
+sampling_id, max_pool2d_with_index + unpool pairing, fused ops,
+ModelAverage (reference parity: test_fc_op.py, test_flatten_op.py,
+test_fill_op.py, test_mean_iou.py, test_bilinear_tensor_product_op.py,
+test_conv_shift_op.py, test_pool_max_op.py, test_fusion_lstm_op.py,
+test_model_average — reference tests/unittests)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+from op_test import OpTest
+from helpers import lod_feed
+
+
+def test_fc_op_direct():
+    rng = np.random.RandomState(0)
+    x = rng.standard_normal((4, 6)).astype(np.float32)
+    w = rng.standard_normal((6, 3)).astype(np.float32)
+    b = rng.standard_normal((3, )).astype(np.float32)
+    t = OpTest()
+    t.op_type = 'fc'
+    t.inputs = {'Input': x, 'W': w, 'Bias': b}
+    t.attrs = {'in_num_col_dims': 1}
+    t.outputs = {'Out': x @ w + b}
+    t.check_output()
+
+
+def test_flatten_and_squeeze2():
+    rng = np.random.RandomState(1)
+    x = rng.standard_normal((2, 3, 4, 5)).astype(np.float32)
+    t = OpTest()
+    t.op_type = 'flatten'
+    t.inputs = {'X': x}
+    t.attrs = {'axis': 2}
+    t.outputs = {'Out': x.reshape(6, 20)}
+    t.check_output()
+
+    x2 = rng.standard_normal((3, 1, 4)).astype(np.float32)
+    t = OpTest()
+    t.op_type = 'squeeze2'
+    t.inputs = {'X': x2}
+    t.attrs = {'axes': [1]}
+    t.outputs = {'Out': x2.reshape(3, 4)}
+    t.check_output(no_check_set=['XShape'])
+
+
+def test_fill_minus_is_empty():
+    t = OpTest()
+    t.op_type = 'fill'
+    t.inputs = {}
+    t.attrs = {'shape': [2, 2], 'value': [1., 2., 3., 4.],
+               'dtype': 'float32'}
+    t.outputs = {'Out': np.asarray([[1., 2.], [3., 4.]], np.float32)}
+    t.check_output()
+
+    rng = np.random.RandomState(2)
+    x = rng.standard_normal((3, 3)).astype(np.float32)
+    y = rng.standard_normal((3, 3)).astype(np.float32)
+    t = OpTest()
+    t.op_type = 'minus'
+    t.inputs = {'X': x, 'Y': y}
+    t.outputs = {'Out': x - y}
+    t.check_output()
+
+
+def test_pad_constant_like():
+    x = np.zeros((4, 5), np.float32)
+    y = np.ones((2, 3), np.float32)
+    want = np.full((4, 5), 7.0, np.float32)
+    want[:2, :3] = 1.0
+    t = OpTest()
+    t.op_type = 'pad_constant_like'
+    t.inputs = {'X': x, 'Y': y}
+    t.attrs = {'pad_value': 7.0}
+    t.outputs = {'Out': want}
+    t.check_output()
+
+
+def test_mean_iou():
+    pred = np.asarray([0, 1, 1, 2], np.int32)
+    label = np.asarray([0, 1, 2, 2], np.int32)
+    # class0: 1/1, class1: 1/2, class2: 1/2 -> mean 2/3
+    t = OpTest()
+    t.op_type = 'mean_iou'
+    t.inputs = {'Predictions': pred, 'Labels': label}
+    t.attrs = {'num_classes': 3}
+    t.outputs = {
+        'OutMeanIou': np.asarray([2.0 / 3.0], np.float32),
+        'OutWrong': np.asarray([1], np.int32),
+        'OutCorrect': np.asarray([3], np.int32),
+    }
+    t.check_output()
+
+
+def test_bilinear_tensor_product():
+    rng = np.random.RandomState(3)
+    x = rng.standard_normal((5, 3)).astype(np.float32)
+    y = rng.standard_normal((5, 4)).astype(np.float32)
+    w = rng.standard_normal((2, 3, 4)).astype(np.float32)
+    b = rng.standard_normal((1, 2)).astype(np.float32)
+    want = np.einsum('nd,kde,ne->nk', x, w, y) + b
+    t = OpTest()
+    t.op_type = 'bilinear_tensor_product'
+    t.inputs = {'X': x, 'Y': y, 'Weight': w, 'Bias': b}
+    t.outputs = {'Out': want}
+    t.check_output(atol=1e-5)
+
+
+def test_conv_shift():
+    rng = np.random.RandomState(4)
+    x = rng.standard_normal((2, 7)).astype(np.float32)
+    y = rng.standard_normal((2, 3)).astype(np.float32)
+    m, n = 7, 3
+    want = np.zeros_like(x)
+    for b in range(2):
+        for i in range(m):
+            for j in range(n):
+                want[b, i] += x[b, (i + j - n // 2) % m] * y[b, j]
+    t = OpTest()
+    t.op_type = 'conv_shift'
+    t.inputs = {'X': x, 'Y': y}
+    t.outputs = {'Out': want}
+    t.check_output(atol=1e-5)
+
+
+def test_sampling_id_distribution():
+    from paddle_tpu.fluid.layer_helper import LayerHelper
+    probs = np.asarray([[0.0, 1.0, 0.0], [1.0, 0.0, 0.0]], np.float32)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+        helper = LayerHelper('sampling_id')
+        out = helper.create_variable_for_type_inference('int64')
+        helper.append_op(type='sampling_id', inputs={'X': [x]},
+                         outputs={'Out': [out]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        ov, = exe.run(prog, feed={'x': probs}, fetch_list=[out])
+    np.testing.assert_array_equal(np.asarray(ov).flatten(), [1, 0])
+
+
+def test_max_pool_with_index_pairs_with_unpool():
+    from paddle_tpu.fluid.layer_helper import LayerHelper
+    rng = np.random.RandomState(5)
+    x = rng.standard_normal((1, 2, 4, 4)).astype(np.float32)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        xv = fluid.layers.data(name='x', shape=[2, 4, 4], dtype='float32')
+        helper = LayerHelper('max_pool2d_with_index')
+        out = helper.create_variable_for_type_inference('float32')
+        mask = helper.create_variable_for_type_inference('int32')
+        helper.append_op(type='max_pool2d_with_index',
+                         inputs={'X': [xv]},
+                         outputs={'Out': [out], 'Mask': [mask]},
+                         attrs={'ksize': [2, 2], 'strides': [2, 2],
+                                'paddings': [0, 0]})
+        unpooled = helper.create_variable_for_type_inference('float32')
+        helper.append_op(type='unpool',
+                         inputs={'X': [out], 'Indices': [mask]},
+                         outputs={'Out': [unpooled]},
+                         attrs={'ksize': [2, 2], 'strides': [2, 2],
+                                'paddings': [0, 0],
+                                'unpooling_type': 'max'})
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        ov, mv, uv = exe.run(prog, feed={'x': x},
+                             fetch_list=[out, mask, unpooled])
+    ov, mv, uv = map(np.asarray, (ov, mv, uv))
+    # pooled values match numpy block max
+    want = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(ov, want, rtol=1e-6)
+    # unpool scatters each max back to its original position
+    for c in range(2):
+        for i in range(2):
+            for j in range(2):
+                flat = mv[0, c, i, j]
+                assert uv[0, c, flat // 4, flat % 4] == ov[0, c, i, j]
+    assert (uv != 0).sum() <= 8  # only the max positions are populated
+
+
+def test_fused_elemwise_activation():
+    rng = np.random.RandomState(6)
+    x = rng.standard_normal((3, 4)).astype(np.float32)
+    y = rng.standard_normal((3, 4)).astype(np.float32)
+    # [binary, unary] -> Binary(X, Unary(Y)) (fused_elemwise_activation
+    # _op.cc composition rule)
+    t = OpTest()
+    t.op_type = 'fused_elemwise_activation'
+    t.inputs = {'X': x, 'Y': y}
+    t.attrs = {'functor_list': ['elementwise_add', 'relu'],
+               'scale': 1.0}
+    t.outputs = {'Out': x + np.maximum(y, 0)}
+    t.check_output()
+
+    # [unary, binary] -> Unary(Binary(X, Y))
+    t = OpTest()
+    t.op_type = 'fused_elemwise_activation'
+    t.inputs = {'X': x, 'Y': y}
+    t.attrs = {'functor_list': ['relu', 'elementwise_add'],
+               'scale': 1.0}
+    t.outputs = {'Out': np.maximum(x + y, 0)}
+    t.check_output()
+
+
+def test_fusion_lstm_matches_composition():
+    rng = np.random.RandomState(7)
+    from paddle_tpu.fluid.layer_helper import LayerHelper
+    b, t_len, d, h = 2, 5, 4, 3
+    x_rows = [rng.standard_normal((t_len, d)).tolist() for _ in range(b)]
+    wx = rng.standard_normal((d, 4 * h)).astype(np.float32)
+    wh = rng.standard_normal((h, 4 * h)).astype(np.float32)
+    bias = rng.standard_normal((1, 4 * h)).astype(np.float32)
+
+    def run(fused):
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            xv = fluid.layers.data(name='x', shape=[d], dtype='float32',
+                                   lod_level=1)
+            wxv = fluid.layers.data(name='wx', shape=[4 * h],
+                                    dtype='float32')
+            whv = fluid.layers.data(name='wh', shape=[4 * h],
+                                    dtype='float32')
+            bv = fluid.layers.data(name='b', shape=[4 * h],
+                                   dtype='float32')
+            helper = LayerHelper('t')
+            hid = helper.create_variable_for_type_inference('float32')
+            cell = helper.create_variable_for_type_inference('float32')
+            if fused:
+                xx = helper.create_variable_for_type_inference('float32')
+                helper.append_op(
+                    type='fusion_lstm',
+                    inputs={'X': [xv], 'WeightX': [wxv],
+                            'WeightH': [whv], 'Bias': [bv]},
+                    outputs={'Hidden': [hid], 'Cell': [cell], 'XX': [xx]},
+                    attrs={'use_peepholes': False})
+            else:
+                proj = helper.create_variable_for_type_inference(
+                    'float32')
+                helper.append_op(type='mul',
+                                 inputs={'X': [xv], 'Y': [wxv]},
+                                 outputs={'Out': [proj]},
+                                 attrs={'x_num_col_dims': 1,
+                                        'y_num_col_dims': 1})
+                bg = helper.create_variable_for_type_inference('float32')
+                bc = helper.create_variable_for_type_inference('float32')
+                helper.append_op(
+                    type='lstm',
+                    inputs={'Input': [proj], 'Weight': [whv],
+                            'Bias': [bv]},
+                    outputs={'Hidden': [hid], 'Cell': [cell],
+                             'BatchGate': [bg], 'BatchCellPreAct': [bc]},
+                    attrs={'use_peepholes': False})
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.core.Scope()):
+            hv, cv = exe.run(prog, feed={
+                'x': lod_feed(x_rows, 'float32', dim=d),
+                'wx': wx, 'wh': wh, 'b': bias}, fetch_list=[hid, cell])
+        return np.asarray(hv), np.asarray(cv)
+
+    h_f, c_f = run(True)
+    h_c, c_c = run(False)
+    np.testing.assert_allclose(h_f, h_c, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(c_f, c_c, rtol=1e-5, atol=1e-6)
+
+
+def test_model_average():
+    rng = np.random.RandomState(8)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(x, size=1, bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        # window never closes within 6 steps (rate 10): the average is the
+        # running mean of every post-update parameter value
+        ma = fluid.optimizer.ModelAverage(
+            average_window_rate=10.0, min_average_window=1,
+            max_average_window=100)
+    param_name = prog.global_block().all_parameters()[0].name
+    xv = rng.standard_normal((8, 4)).astype(np.float32)
+    yv = (xv.sum(1, keepdims=True)).astype(np.float32)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        snapshots = []
+        for _ in range(6):
+            exe.run(prog, feed={'x': xv, 'y': yv}, fetch_list=[loss])
+            snapshots.append(
+                np.asarray(scope.find_var(param_name).value()).copy())
+        live = snapshots[-1]
+        with ma.apply(exe):
+            averaged = np.asarray(scope.find_var(param_name).value())
+            np.testing.assert_allclose(
+                averaged, np.mean(snapshots, axis=0), rtol=1e-5)
+        restored = np.asarray(scope.find_var(param_name).value())
+        np.testing.assert_allclose(restored, live, rtol=1e-6)
