@@ -41,7 +41,8 @@ def _conv2d(ctx, op):
         dimension_numbers=_CONV_DN,
         feature_group_count=groups)
     # conv VJP rejects mixed operand dtypes, so AMP convs run fully in
-    # bf16 (MXU accumulates fp32 internally) and upcast the result
+    # bf16; outputs STAY bf16 (amp_cast_out policy) so activations cross
+    # HBM at half width — BN recovers fp32 statistics internally
     ctx.set(op, 'Output', amp_cast_out(out))
 
 
@@ -173,13 +174,16 @@ def _batch_norm(ctx, op):
     bshape = [1] * x.ndim
     bshape[1 if layout == 'NCHW' else x.ndim - 1] = -1
 
+    # bf16 activations (AMP) keep bf16 through BN, but the statistics
+    # must accumulate in fp32 or large batches lose the mean entirely
+    xs = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
     if is_test:
         mean, var = mean_in, var_in
         saved_mean, saved_var = mean_in, var_in
         mean_out, var_out = mean_in, var_in
     else:
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.mean(jnp.square(x), axis=axes) - jnp.square(mean)
+        mean = jnp.mean(xs, axis=axes)
+        var = jnp.mean(jnp.square(xs), axis=axes) - jnp.square(mean)
         # running stats do not take gradients
         m_s = jax.lax.stop_gradient(mean)
         v_s = jax.lax.stop_gradient(var)
@@ -187,9 +191,9 @@ def _batch_norm(ctx, op):
         var_out = momentum * var_in + (1 - momentum) * v_s
         saved_mean, saved_var = mean, var
     inv_std = jax.lax.rsqrt(jnp.reshape(var, bshape) + eps)
-    y = (x - jnp.reshape(mean, bshape)) * inv_std * jnp.reshape(
+    y = (xs - jnp.reshape(mean, bshape)) * inv_std * jnp.reshape(
         scale, bshape) + jnp.reshape(bias, bshape)
-    ctx.set(op, 'Y', y)
+    ctx.set(op, 'Y', y.astype(x.dtype))
     ctx.set(op, 'MeanOut', mean_out)
     ctx.set(op, 'VarianceOut', var_out)
     ctx.set(op, 'SavedMean', saved_mean)

@@ -311,12 +311,15 @@ def amp_cast_in(*xs):
 
 
 def amp_cast_out(out):
-    """Inverse of amp_cast_in for op results: upcast the bf16 the AMP
-    casts introduced back to fp32.  Gated on the AMP flag so genuinely
-    bf16 (non-AMP) programs keep their declared dtype."""
-    import jax.numpy as jnp
-    if _AMP['enabled'] and out.dtype == jnp.bfloat16:
-        return out.astype(jnp.float32)
+    """AMP output policy for convolutions: keep activations bf16.
+
+    Upcasting between convs doubles HBM read+write traffic for every
+    activation tensor — the dominant cost of a conv net on TPU.  bf16
+    activations flow through BN (which computes its statistics in fp32,
+    ops/nn_ops.py _batch_norm), relu, pooling and residual adds; matmul
+    outputs are fp32 via preferred_element_type; parameter gradients
+    arrive fp32 because the astype(bf16) cast's VJP converts cotangents
+    back.  Master weights and optimizer state stay fp32 throughout."""
     return out
 
 

@@ -17,9 +17,10 @@ from .mesh import make_mesh, mesh_axes, DeviceMesh
 from .api import shard, sharding_of, PartitionSpec
 from .context_parallel import (ring_attention, ulysses_attention,
                                dense_attention)
+from .multihost import init_distributed_env, parse_distributed_env
 
 __all__ = [
     'make_mesh', 'mesh_axes', 'DeviceMesh', 'shard', 'sharding_of',
     'PartitionSpec', 'ring_attention', 'ulysses_attention',
-    'dense_attention',
+    'dense_attention', 'init_distributed_env', 'parse_distributed_env',
 ]

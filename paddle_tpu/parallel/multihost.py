@@ -1,0 +1,71 @@
+"""Multi-host bootstrap: the TPU-native replacement for the reference's
+NCCL-id rendezvous.
+
+Reference mechanism: rank 0 creates an ``ncclUniqueId`` and RPCs it to
+peers under the ``NCCLID`` var (operators/gen_nccl_id_op.cc:31,
+platform/nccl_helper.h:81), with roles/endpoints wired through
+``PADDLE_*`` environment variables (trainer.py:324,
+benchmark/fluid/fluid_benchmark.py:62-101).
+
+TPU-native: the JAX distributed runtime owns rendezvous —
+``jax.distributed.initialize(coordinator, num_processes, process_id)``;
+after it, ``jax.devices()`` spans every host and one SPMD program over a
+global mesh scales across DCN with zero program changes.  This module
+keeps the reference's env-var contract:
+
+    PADDLE_TRAINER_ID        -> process_id
+    PADDLE_TRAINERS_NUM      -> num_processes
+    PADDLE_TRAINER_ENDPOINTS -> first endpoint = coordinator address
+    (or PADDLE_COORDINATOR   -> coordinator address directly)
+"""
+
+import os
+
+__all__ = ['init_distributed_env', 'parse_distributed_env']
+
+
+def parse_distributed_env(environ=None):
+    """Resolve (coordinator_address, num_processes, process_id) from the
+    PADDLE_* env contract; (None, 1, 0) when not configured."""
+    env = environ if environ is not None else os.environ
+    num = int(env.get('PADDLE_TRAINERS_NUM', env.get('PADDLE_TRAINERS',
+                                                     1)))
+    pid_raw = env.get('PADDLE_TRAINER_ID')
+    if num > 1 and pid_raw is None:
+        # defaulting to 0 would make every host claim process 0 and hang
+        # the coordinator waiting for the others — fail loudly instead
+        raise ValueError(
+            'PADDLE_TRAINERS_NUM=%d but PADDLE_TRAINER_ID is not set; '
+            'every host must export its unique trainer id' % num)
+    pid = int(pid_raw or 0)
+    coordinator = env.get('PADDLE_COORDINATOR')
+    if coordinator is None:
+        endpoints = env.get('PADDLE_TRAINER_ENDPOINTS', '')
+        first = endpoints.split(',')[0].strip()
+        coordinator = first or None
+    return coordinator, num, pid
+
+
+def init_distributed_env(coordinator_address=None, num_processes=None,
+                         process_id=None):
+    """Initialize the multi-host JAX runtime (no-op single-host).
+
+    Explicit args override the PADDLE_* env contract.  Returns
+    (num_processes, process_id)."""
+    env_coord, env_num, env_pid = parse_distributed_env()
+    coordinator_address = coordinator_address or env_coord
+    num_processes = num_processes if num_processes is not None else env_num
+    process_id = process_id if process_id is not None else env_pid
+    if num_processes <= 1:
+        return 1, 0
+    if coordinator_address is None:
+        raise ValueError(
+            'multi-host run (%d processes) needs a coordinator: set '
+            'PADDLE_COORDINATOR or PADDLE_TRAINER_ENDPOINTS' %
+            num_processes)
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    return num_processes, process_id

@@ -107,3 +107,37 @@ def test_tensor_parallel_annotation():
             lv, = pe.run([loss.name], feed={'img': x, 'label': y})
             losses.append(float(np.asarray(lv).flatten()[0]))
         assert all(np.isfinite(l) for l in losses)
+
+
+def test_multihost_env_contract():
+    """PADDLE_* env vars resolve to jax.distributed args (reference
+    trainer.py:324 / fluid_benchmark.py:62 env contract; gen_nccl_id's
+    rendezvous role is owned by the JAX runtime)."""
+    from paddle_tpu.parallel import (init_distributed_env,
+                                     parse_distributed_env)
+    env = {'PADDLE_TRAINERS_NUM': '4', 'PADDLE_TRAINER_ID': '2',
+           'PADDLE_TRAINER_ENDPOINTS':
+               '10.0.0.1:7164,10.0.0.2:7164,10.0.0.3:7164,10.0.0.4:7164'}
+    coord, num, pid = parse_distributed_env(env)
+    assert (coord, num, pid) == ('10.0.0.1:7164', 4, 2)
+    coord, num, pid = parse_distributed_env(
+        {'PADDLE_COORDINATOR': 'host0:1234', 'PADDLE_TRAINERS_NUM': '2',
+         'PADDLE_TRAINER_ID': '0'})
+    assert (coord, num, pid) == ('host0:1234', 2, 0)
+    # a multi-host env WITHOUT a unique trainer id must fail loudly, not
+    # let every host claim process 0 and hang the coordinator
+    with pytest.raises(ValueError):
+        parse_distributed_env({'PADDLE_TRAINERS_NUM': '2'})
+    # single host: no-op, no coordinator required
+    assert init_distributed_env(num_processes=1) == (1, 0)
+    import os as _os
+    import pytest as _pytest
+    saved = {k: _os.environ.pop(k, None) for k in
+             ('PADDLE_COORDINATOR', 'PADDLE_TRAINER_ENDPOINTS')}
+    try:
+        with _pytest.raises(ValueError):
+            init_distributed_env(num_processes=2)
+    finally:
+        for k, v in saved.items():
+            if v is not None:
+                _os.environ[k] = v
