@@ -17,6 +17,7 @@ __all__ = [
     'ssd_loss', 'detection_output', 'detection_map', 'iou_similarity',
     'box_coder', 'anchor_generator', 'rpn_target_assign',
     'polygon_box_transform', 'multiclass_nms',
+    'generate_proposals', 'generate_proposal_labels',
 ]
 
 
@@ -493,3 +494,102 @@ def multi_box_head(inputs,
     for v in (box_concat, var_concat):
         v.stop_gradient = True
     return mbox_locs_concat, mbox_confs_concat, box_concat, var_concat
+
+
+def generate_proposals(scores,
+                       bbox_deltas,
+                       im_info,
+                       anchors,
+                       variances,
+                       pre_nms_top_n=6000,
+                       post_nms_top_n=1000,
+                       nms_thresh=0.5,
+                       min_size=0.1,
+                       eta=1.0,
+                       name=None):
+    """RPN proposal generation (reference detection.py:1317;
+    generate_proposals_op.cc).  Returns (rpn_rois, rpn_roi_probs) LoD."""
+    helper = LayerHelper('generate_proposals', **locals())
+    rpn_rois = helper.create_variable_for_type_inference(
+        dtype=bbox_deltas.dtype)
+    rpn_roi_probs = helper.create_variable_for_type_inference(
+        dtype=scores.dtype)
+    helper.append_op(
+        type='generate_proposals',
+        inputs={
+            'Scores': [scores],
+            'BboxDeltas': [bbox_deltas],
+            'ImInfo': [im_info],
+            'Anchors': [anchors],
+            'Variances': [variances],
+        },
+        outputs={'RpnRois': [rpn_rois],
+                 'RpnRoiProbs': [rpn_roi_probs]},
+        attrs={
+            'pre_nms_topN': pre_nms_top_n,
+            'post_nms_topN': post_nms_top_n,
+            'nms_thresh': nms_thresh,
+            'min_size': min_size,
+            'eta': eta,
+        })
+    rpn_rois.stop_gradient = True
+    rpn_roi_probs.stop_gradient = True
+    return rpn_rois, rpn_roi_probs
+
+
+def generate_proposal_labels(rpn_rois,
+                             gt_classes,
+                             is_crowd,
+                             gt_boxes,
+                             im_info,
+                             batch_size_per_im=256,
+                             fg_fraction=0.25,
+                             fg_thresh=0.25,
+                             bg_thresh_hi=0.5,
+                             bg_thresh_lo=0.0,
+                             bbox_reg_weights=[0.1, 0.1, 0.2, 0.2],
+                             class_nums=None,
+                             use_random=True):
+    """Second-stage RoI sampling (reference detection.py:1259;
+    generate_proposal_labels_op.cc).  Returns (rois, labels_int32,
+    bbox_targets, bbox_inside_weights, bbox_outside_weights)."""
+    helper = LayerHelper('generate_proposal_labels', **locals())
+    rois = helper.create_variable_for_type_inference(dtype=rpn_rois.dtype)
+    labels_int32 = helper.create_variable_for_type_inference(dtype='int32')
+    bbox_targets = helper.create_variable_for_type_inference(
+        dtype=rpn_rois.dtype)
+    bbox_inside_weights = helper.create_variable_for_type_inference(
+        dtype=rpn_rois.dtype)
+    bbox_outside_weights = helper.create_variable_for_type_inference(
+        dtype=rpn_rois.dtype)
+    helper.append_op(
+        type='generate_proposal_labels',
+        inputs={
+            'RpnRois': [rpn_rois],
+            'GtClasses': [gt_classes],
+            'IsCrowd': [is_crowd],
+            'GtBoxes': [gt_boxes],
+            'ImInfo': [im_info],
+        },
+        outputs={
+            'Rois': [rois],
+            'LabelsInt32': [labels_int32],
+            'BboxTargets': [bbox_targets],
+            'BboxInsideWeights': [bbox_inside_weights],
+            'BboxOutsideWeights': [bbox_outside_weights],
+        },
+        attrs={
+            'batch_size_per_im': batch_size_per_im,
+            'fg_fraction': fg_fraction,
+            'fg_thresh': fg_thresh,
+            'bg_thresh_hi': bg_thresh_hi,
+            'bg_thresh_lo': bg_thresh_lo,
+            'bbox_reg_weights': bbox_reg_weights,
+            'class_nums': class_nums or 81,
+            'fix_seed': not use_random,
+        })
+    for v in (rois, labels_int32, bbox_targets, bbox_inside_weights,
+              bbox_outside_weights):
+        v.stop_gradient = True
+    return (rois, labels_int32, bbox_targets, bbox_inside_weights,
+            bbox_outside_weights)

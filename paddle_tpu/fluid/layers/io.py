@@ -19,7 +19,7 @@ from ..framework import default_main_program, default_startup_program, \
 from ..layer_helper import LayerHelper
 
 __all__ = ['data', 'py_reader', 'read_file', 'batch', 'double_buffer',
-           'open_recordio_file', 'shuffle', 'Preprocessor']
+           'open_recordio_file', 'open_files', 'shuffle', 'Preprocessor']
 
 # reader var name -> _PyReaderFeeder.  Weak values: the strong reference
 # lives on the reader Variable (program lifetime), so discarding a program
@@ -238,6 +238,24 @@ def shuffle(reader, buffer_size):
     return reader
 
 
+def _decode_npz_record(rec):
+    """recordio records are npz-framed numpy tuples (data-only, no code
+    execution) — shared by every recordio reader layer."""
+    import io as _io
+    with np.load(_io.BytesIO(rec), allow_pickle=False) as z:
+        return tuple(z['arr_%d' % i] for i in range(len(z.files)))
+
+
+def _scan_file(filename):
+    from ...runtime import RecordIOScanner
+    scanner = RecordIOScanner(filename)
+    try:
+        for rec in scanner:
+            yield _decode_npz_record(rec)
+    finally:
+        scanner.close()
+
+
 def open_recordio_file(filename,
                        shapes,
                        dtypes,
@@ -250,16 +268,75 @@ def open_recordio_file(filename,
     rd = py_reader(64, shapes, dtypes, lod_levels)
 
     def provider():
-        import io as _io
-        from ...runtime import RecordIOScanner
         for _ in range(pass_num):
-            scanner = RecordIOScanner(filename)
-            for rec in scanner:
-                # records are npz-framed (data-only, no code execution)
-                with np.load(_io.BytesIO(rec), allow_pickle=False) as z:
-                    yield tuple(z['arr_%d' % i]
-                                for i in range(len(z.files)))
-            scanner.close()
+            for item in _scan_file(filename):
+                yield item
+
+    rd.decorate_tensor_provider(provider)
+    return rd
+
+
+def open_files(filenames,
+               shapes,
+               lod_levels,
+               dtypes,
+               thread_num=None,
+               buffer_size=None,
+               pass_num=1,
+               is_test=None):
+    """Multi-file multi-thread recordio reader (reference layers/io.py:724;
+    operators/reader/open_files_op.cc).  is_test (or thread_num == 1)
+    preserves file order; otherwise reader threads interleave files."""
+    import queue as _queue
+
+    thread_num = (1 if is_test else
+                  min(thread_num or len(filenames), len(filenames)))
+    buffer_size = buffer_size or 3 * thread_num
+    rd = py_reader(buffer_size, shapes, dtypes, lod_levels)
+
+    def provider():
+        for _ in range(pass_num):
+            if thread_num == 1:
+                for fname in filenames:
+                    for item in _scan_file(fname):
+                        yield item
+                continue
+            q = _queue.Queue(maxsize=buffer_size)
+            done = object()
+            errors = []
+
+            def work(my_files):
+                try:
+                    for fname in my_files:
+                        for item in _scan_file(fname):
+                            q.put(item)
+                except BaseException as e:
+                    # surface reader failures to the consumer: silently
+                    # truncating the dataset would look like a clean EOF
+                    errors.append(e)
+                finally:
+                    q.put(done)
+
+            shards = [filenames[i::thread_num] for i in range(thread_num)]
+            workers = [
+                threading.Thread(target=work, args=(shard, ), daemon=True)
+                for shard in shards
+            ]
+            for w in workers:
+                w.start()
+            finished = 0
+            while finished < thread_num:
+                item = q.get()
+                if item is done:
+                    finished += 1
+                else:
+                    yield item
+            for w in workers:
+                w.join()
+            if errors:
+                raise RuntimeError(
+                    'open_files reader thread failed: %r' %
+                    (errors[0], )) from errors[0]
 
     rd.decorate_tensor_provider(provider)
     return rd

@@ -791,3 +791,231 @@ def _rpn_target_assign(ctx, op, scope):
         tgt_bbox = anchor_argbest_all.reshape(-1, 1)
         scope.var(names[0]).set_value(tgt_bbox)
         ctx.store(names[0], tgt_bbox)
+
+
+def _decode_proposals(anchors, deltas, variances):
+    """RPN box decode in pixel coords (reference
+    generate_proposals_op.cc BoxCoder): widths use the +1 convention."""
+    w = anchors[:, 2] - anchors[:, 0] + 1.0
+    h = anchors[:, 3] - anchors[:, 1] + 1.0
+    cx = anchors[:, 0] + 0.5 * w
+    cy = anchors[:, 1] + 0.5 * h
+    if variances is None:
+        variances = np.ones_like(deltas)
+    dx, dy, dw, dh = (deltas[:, 0] * variances[:, 0],
+                      deltas[:, 1] * variances[:, 1],
+                      deltas[:, 2] * variances[:, 2],
+                      deltas[:, 3] * variances[:, 3])
+    # clamp dw/dh like the reference (log(1000/16) cap)
+    cap = np.log(1000.0 / 16.0)
+    dw = np.minimum(dw, cap)
+    dh = np.minimum(dh, cap)
+    ncx = dx * w + cx
+    ncy = dy * h + cy
+    nw = np.exp(dw) * w
+    nh = np.exp(dh) * h
+    return np.stack([ncx - 0.5 * nw, ncy - 0.5 * nh,
+                     ncx + 0.5 * nw - 1.0, ncy + 0.5 * nh - 1.0], axis=1)
+
+
+@register_host_op('generate_proposals')
+def _generate_proposals(ctx, op, scope):
+    """RPN proposal generation (reference
+    detection/generate_proposals_op.cc — CPU kernel): per image, top
+    pre_nms_topN anchors by score, decode, clip, min-size filter, NMS,
+    keep post_nms_topN.  Outputs RpnRois LoD (sum, 4) + RpnRoiProbs."""
+    from ..fluid import core
+    scores = np.asarray(ctx.get(op, 'Scores'))  # (N, A, H, W)
+    deltas = np.asarray(ctx.get(op, 'BboxDeltas'))  # (N, 4A, H, W)
+    im_info = np.asarray(ctx.get(op, 'ImInfo'))  # (N, 3)
+    anchors = np.asarray(ctx.get(op, 'Anchors')).reshape(-1, 4)
+    variances = ctx.get(op, 'Variances')
+    if variances is not None:
+        variances = np.asarray(variances).reshape(-1, 4)
+    a = op.attrs
+    pre_n = int(a.get('pre_nms_topN', 6000))
+    post_n = int(a.get('post_nms_topN', 1000))
+    nms_thresh = float(a.get('nms_thresh', 0.5))
+    min_size = float(a.get('min_size', 0.1))
+
+    all_rois, all_probs, lod = [], [], [0]
+    n, num_a, fh, fw = scores.shape
+    for i in range(n):
+        # (A, H, W) -> (H, W, A) flattened to match anchors' (H, W, A, 4)
+        sc = scores[i].transpose(1, 2, 0).reshape(-1)
+        dl = deltas[i].reshape(num_a, 4, fh, fw).transpose(
+            2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-sc, kind='stable')[:pre_n]
+        props = _decode_proposals(
+            anchors[order], dl[order],
+            variances[order] if variances is not None else None)
+        imh, imw = im_info[i, 0], im_info[i, 1]
+        props[:, 0] = np.clip(props[:, 0], 0, imw - 1)
+        props[:, 1] = np.clip(props[:, 1], 0, imh - 1)
+        props[:, 2] = np.clip(props[:, 2], 0, imw - 1)
+        props[:, 3] = np.clip(props[:, 3], 0, imh - 1)
+        ws = props[:, 2] - props[:, 0] + 1
+        hs = props[:, 3] - props[:, 1] + 1
+        keep = (ws >= min_size) & (hs >= min_size)
+        props, probs = props[keep], sc[order][keep]
+        kept = _nms_one_class(props, probs, -np.inf, -1, nms_thresh,
+                              float(a.get('eta', 1.0)))
+        kept = kept[:post_n]
+        all_rois.append(props[kept])
+        all_probs.append(probs[kept].reshape(-1, 1))
+        lod.append(lod[-1] + len(kept))
+    rois = (np.concatenate(all_rois) if all_rois
+            else np.zeros((0, 4), np.float32)).astype(np.float32)
+    probs = (np.concatenate(all_probs) if all_probs
+             else np.zeros((0, 1), np.float32)).astype(np.float32)
+    for slot, arr in (('RpnRois', rois), ('RpnRoiProbs', probs)):
+        names = op.output(slot)
+        if names:
+            lt = core.LoDTensor(arr, [lod])
+            scope.var(names[0]).set_value(lt)
+            ctx.store(names[0], arr)
+            ctx.env[names[0] + SEQLEN_SUFFIX] = np.diff(np.asarray(lod))
+
+
+def _rows_per_image(ctx, op, slot, arr):
+    """Split a host-side array into per-image row lists using its LoD
+    side-band (padded 3-D batches use their seqlen; 2-D without a
+    side-band is a single image)."""
+    names = op.input(slot)
+    lens = ctx.env.get(names[0] + SEQLEN_SUFFIX) if names else None
+    if arr.ndim == 3:
+        if lens is None:
+            lens = [arr.shape[1]] * arr.shape[0]
+        return [arr[i, :int(l)] for i, l in enumerate(np.asarray(lens))]
+    if lens is None:
+        return [arr]
+    out, ofs = [], 0
+    for l in np.asarray(lens).astype(int):
+        out.append(arr[ofs:ofs + l])
+        ofs += l
+    return out
+
+
+def _sample_rois_one_image(rois, gt_boxes, gt_classes, is_crowd, im_scale,
+                           rng, batch_size_per_im, fg_fraction, fg_thresh,
+                           bg_hi, bg_lo, class_nums, weights):
+    """One image's RoI sampling (reference generate_proposal_labels_op.cc
+    SampleRoisForOneImage): rescale proposals to original coords, drop
+    crowd gt, label by IoU, sample fg/bg, build per-class targets."""
+    rois = rois.reshape(-1, 4) / max(float(im_scale), 1e-6)
+    not_crowd = (is_crowd.reshape(-1) == 0 if is_crowd is not None and
+                 is_crowd.size else np.ones(len(gt_boxes), bool))
+    gt_boxes = gt_boxes[not_crowd]
+    gt_classes = gt_classes[not_crowd]
+    rois2 = np.concatenate([rois, gt_boxes]) if gt_boxes.size else rois
+    ious = np.zeros((rois2.shape[0], max(gt_boxes.shape[0], 1)))
+    for j, gb in enumerate(gt_boxes):
+        iw = np.minimum(rois2[:, 2], gb[2]) - np.maximum(rois2[:, 0],
+                                                         gb[0]) + 1
+        ih = np.minimum(rois2[:, 3], gb[3]) - np.maximum(rois2[:, 1],
+                                                         gb[1]) + 1
+        inter = np.maximum(iw, 0) * np.maximum(ih, 0)
+        area_r = ((rois2[:, 2] - rois2[:, 0] + 1) *
+                  (rois2[:, 3] - rois2[:, 1] + 1))
+        area_g = (gb[2] - gb[0] + 1) * (gb[3] - gb[1] + 1)
+        ious[:, j] = inter / np.maximum(area_r + area_g - inter, 1e-10)
+    max_iou = ious.max(axis=1) if gt_boxes.size else np.zeros(
+        rois2.shape[0])
+    arg_gt = ious.argmax(axis=1) if gt_boxes.size else np.zeros(
+        rois2.shape[0], np.int64)
+
+    fg = np.where(max_iou >= fg_thresh)[0]
+    bg = np.where((max_iou < bg_hi) & (max_iou >= bg_lo))[0]
+    fg_num = min(int(batch_size_per_im * fg_fraction), fg.size)
+    if fg.size > fg_num:
+        fg = rng.choice(fg, size=fg_num, replace=False)
+    bg_num = min(batch_size_per_im - fg_num, bg.size)
+    if bg.size > bg_num:
+        bg = rng.choice(bg, size=bg_num, replace=False)
+    keep = np.concatenate([fg, bg]).astype(np.int64)
+
+    sampled = rois2[keep].astype(np.float32)
+    labels = np.zeros(keep.size, np.int32)
+    labels[:fg.size] = gt_classes[arg_gt[fg]] if gt_classes.size else 1
+
+    targets = np.zeros((keep.size, 4 * class_nums), np.float32)
+    inside = np.zeros_like(targets)
+    for k in range(fg.size):
+        gb = gt_boxes[arg_gt[fg[k]]]
+        rb = sampled[k]
+        w = rb[2] - rb[0] + 1
+        h = rb[3] - rb[1] + 1
+        gcx = (gb[0] + gb[2]) / 2
+        gcy = (gb[1] + gb[3]) / 2
+        rcx = (rb[0] + rb[2]) / 2
+        rcy = (rb[1] + rb[3]) / 2
+        t = np.asarray([(gcx - rcx) / w / weights[0],
+                        (gcy - rcy) / h / weights[1],
+                        np.log((gb[2] - gb[0] + 1) / w) / weights[2],
+                        np.log((gb[3] - gb[1] + 1) / h) / weights[3]],
+                       np.float32)
+        cls = int(labels[k])
+        targets[k, 4 * cls:4 * cls + 4] = t
+        inside[k, 4 * cls:4 * cls + 4] = 1.0
+    return sampled, labels, targets, inside
+
+
+@register_host_op('generate_proposal_labels')
+def _generate_proposal_labels(ctx, op, scope):
+    """Second-stage RoI sampling + bbox target assembly (reference
+    detection/generate_proposal_labels_op.cc): per image, label proposals
+    by IoU with (non-crowd) gt, sample batch_size_per_im RoIs at
+    fg_fraction, emit per-class regression targets and weights."""
+    from ..fluid import core
+    rois = np.asarray(ctx.get(op, 'RpnRois'))
+    gt_classes = np.asarray(ctx.get(op, 'GtClasses'))
+    gt_boxes = np.asarray(ctx.get(op, 'GtBoxes'))
+    crowd_in = ctx.get(op, 'IsCrowd')
+    im_info = np.asarray(ctx.get(op, 'ImInfo')).reshape(-1, 3)
+    a = op.attrs
+    batch_size_per_im = int(a.get('batch_size_per_im', 256))
+    fg_fraction = float(a.get('fg_fraction', 0.25))
+    fg_thresh = float(a.get('fg_thresh', 0.5))
+    bg_hi = float(a.get('bg_thresh_hi', 0.5))
+    bg_lo = float(a.get('bg_thresh_lo', 0.0))
+    class_nums = int(a.get('class_nums', 81))
+    weights = a.get('bbox_reg_weights', [0.1, 0.1, 0.2, 0.2])
+    fix_seed = a.get('fix_seed', False)
+    rng = np.random.RandomState(int(a.get('seed', 0))
+                                if fix_seed else None)
+
+    rois_per = _rows_per_image(ctx, op, 'RpnRois', rois)
+    gt_per = _rows_per_image(ctx, op, 'GtBoxes', gt_boxes)
+    cls_per = _rows_per_image(ctx, op, 'GtClasses', gt_classes)
+    crowd_per = (_rows_per_image(ctx, op, 'IsCrowd',
+                                 np.asarray(crowd_in))
+                 if crowd_in is not None else [None] * len(rois_per))
+
+    parts = {k: [] for k in ('Rois', 'LabelsInt32', 'BboxTargets',
+                             'BboxInsideWeights', 'BboxOutsideWeights')}
+    lod = [0]
+    for i, img_rois in enumerate(rois_per):
+        gt_b = gt_per[min(i, len(gt_per) - 1)].reshape(-1, 4)
+        gt_c = cls_per[min(i, len(cls_per) - 1)].reshape(-1)
+        crowd = crowd_per[min(i, len(crowd_per) - 1)]
+        scale = im_info[min(i, im_info.shape[0] - 1), 2]
+        sampled, labels, targets, inside = _sample_rois_one_image(
+            img_rois, gt_b, gt_c,
+            np.asarray(crowd) if crowd is not None else None, scale, rng,
+            batch_size_per_im, fg_fraction, fg_thresh, bg_hi, bg_lo,
+            class_nums, weights)
+        parts['Rois'].append(sampled)
+        parts['LabelsInt32'].append(labels.reshape(-1, 1))
+        parts['BboxTargets'].append(targets)
+        parts['BboxInsideWeights'].append(inside)
+        parts['BboxOutsideWeights'].append(inside.copy())
+        lod.append(lod[-1] + sampled.shape[0])
+    for slot, arrs in parts.items():
+        names = op.output(slot)
+        if names:
+            arr = np.concatenate(arrs) if arrs else np.zeros((0, 4),
+                                                             np.float32)
+            lt = core.LoDTensor(arr, [lod])
+            scope.var(names[0]).set_value(lt)
+            ctx.store(names[0], arr)
+            ctx.env[names[0] + SEQLEN_SUFFIX] = np.diff(np.asarray(lod))

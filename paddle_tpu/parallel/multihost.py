@@ -24,14 +24,16 @@ import os
 __all__ = ['init_distributed_env', 'parse_distributed_env']
 
 
-def parse_distributed_env(environ=None):
+def parse_distributed_env(environ=None, require_id=True):
     """Resolve (coordinator_address, num_processes, process_id) from the
-    PADDLE_* env contract; (None, 1, 0) when not configured."""
+    PADDLE_* env contract; (None, 1, 0) when not configured.  With
+    require_id, a multi-host env missing PADDLE_TRAINER_ID raises (the
+    caller has no other id source)."""
     env = environ if environ is not None else os.environ
     num = int(env.get('PADDLE_TRAINERS_NUM', env.get('PADDLE_TRAINERS',
                                                      1)))
     pid_raw = env.get('PADDLE_TRAINER_ID')
-    if num > 1 and pid_raw is None:
+    if require_id and num > 1 and pid_raw is None:
         # defaulting to 0 would make every host claim process 0 and hang
         # the coordinator waiting for the others — fail loudly instead
         raise ValueError(
@@ -52,7 +54,10 @@ def init_distributed_env(coordinator_address=None, num_processes=None,
 
     Explicit args override the PADDLE_* env contract.  Returns
     (num_processes, process_id)."""
-    env_coord, env_num, env_pid = parse_distributed_env()
+    # explicit args override the env: only require an env trainer id
+    # when the caller did not pass one
+    env_coord, env_num, env_pid = parse_distributed_env(
+        require_id=(process_id is None))
     coordinator_address = coordinator_address or env_coord
     num_processes = num_processes if num_processes is not None else env_num
     process_id = process_id if process_id is not None else env_pid

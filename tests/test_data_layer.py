@@ -153,3 +153,47 @@ def test_reader_decorators():
     assert list(composed())[0] == (0, 0)
     shuffled = reader_mod.shuffle(r, 5)
     assert sorted(shuffled()) == list(range(10))
+
+
+def test_open_files_multi_file_reader(tmp_path):
+    """open_files streams every record of multiple recordio files
+    (reference layers/io.py:724, operators/reader/open_files_op.cc)."""
+    import os
+    import paddle_tpu
+    rng = np.random.RandomState(0)
+    files = []
+    total = 0
+    for fi in range(3):
+        path = os.path.join(str(tmp_path), 'part-%d.recordio' % fi)
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+            y = fluid.layers.data(name='y', shape=[1], dtype='int64')
+        feeder = fluid.DataFeeder(feed_list=[x, y],
+                                  place=fluid.CPUPlace())
+        n = 4 + fi
+        total += n
+        data = [(rng.standard_normal(4).astype('float32'), fi)
+                for _ in range(n)]
+        fluid.recordio_writer.convert_reader_to_recordio_file(
+            path, paddle_tpu.batch(lambda d=data: iter(d), 2), feeder)
+        files.append(path)
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        reader = fluid.layers.open_files(
+            filenames=files, shapes=[[-1, 4], [-1, 1]],
+            lod_levels=[0, 0], dtypes=['float32', 'int64'], thread_num=2)
+        xv, yv = fluid.layers.read_file(reader)
+        s = fluid.layers.reduce_sum(xv)
+    exe = fluid.Executor(fluid.CPUPlace())
+    seen = 0
+    with fluid.scope_guard(fluid.core.Scope()):
+        reader.start()
+        while True:
+            try:
+                sv, yb = exe.run(prog, fetch_list=[s, yv])
+            except fluid.core.EOFException:
+                break
+            seen += np.asarray(yb).shape[0]
+    assert seen == total

@@ -429,3 +429,83 @@ def test_rpn_target_assign_batched_lod_gt():
     assert 0 in lv
     assert {4, 5} & set(lv.tolist())
     assert all(v < 6 for v in np.asarray(sv).flatten())
+
+
+def test_generate_proposals():
+    rng = np.random.RandomState(11)
+    fh = fw = 4
+    num_a = 3
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        feat = fluid.layers.data(name='feat', shape=[8, fh, fw],
+                                 dtype='float32')
+        anchors, avar = fluid.layers.anchor_generator(
+            feat, anchor_sizes=[16.0, 32.0, 64.0], aspect_ratios=[1.0],
+            stride=[8.0, 8.0])
+        scores = fluid.layers.data(name='scores', shape=[num_a, fh, fw],
+                                   dtype='float32')
+        deltas = fluid.layers.data(name='deltas',
+                                   shape=[4 * num_a, fh, fw],
+                                   dtype='float32')
+        im_info = fluid.layers.data(name='im_info', shape=[3],
+                                    dtype='float32')
+        rois, probs = fluid.layers.generate_proposals(
+            scores, deltas, im_info, anchors, avar,
+            pre_nms_top_n=40, post_nms_top_n=10, nms_thresh=0.7,
+            min_size=2.0)
+    sv = rng.rand(1, num_a, fh, fw).astype(np.float32)
+    dv = (0.05 * rng.standard_normal((1, 4 * num_a, fh, fw))).astype(
+        np.float32)
+    iv = np.asarray([[32.0, 32.0, 1.0]], np.float32)
+    fv = np.zeros((1, 8, fh, fw), np.float32)
+    rv, pv = _run(prog, {'feat': fv, 'scores': sv, 'deltas': dv,
+                         'im_info': iv}, [rois, probs])
+    rv, pv = np.asarray(rv), np.asarray(pv)
+    assert rv.shape[1] == 4 and 1 <= rv.shape[0] <= 10
+    assert pv.shape == (rv.shape[0], 1)
+    # rois clipped to the image and sorted by score
+    assert (rv >= 0).all() and (rv[:, 2] <= 31.0 + 1e-4).all()
+    assert (np.diff(pv[:, 0]) <= 1e-6).all()
+
+
+def test_generate_proposal_labels():
+    rng = np.random.RandomState(12)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        rpn_rois = fluid.layers.data(name='rois_in', shape=[4],
+                                     dtype='float32')
+        gt_classes = fluid.layers.data(name='gtc', shape=[1],
+                                       dtype='int32')
+        is_crowd = fluid.layers.data(name='crowd', shape=[1],
+                                     dtype='int32')
+        gt_boxes = fluid.layers.data(name='gtb', shape=[4],
+                                     dtype='float32')
+        im_info = fluid.layers.data(name='imi', shape=[3],
+                                    dtype='float32')
+        outs = fluid.layers.generate_proposal_labels(
+            rpn_rois, gt_classes, is_crowd, gt_boxes, im_info,
+            batch_size_per_im=8, fg_fraction=0.5, fg_thresh=0.5,
+            bg_thresh_hi=0.5, bg_thresh_lo=0.0, class_nums=4,
+            use_random=False)
+    gt = np.asarray([[2., 2., 10., 10.], [20., 20., 28., 28.]], np.float32)
+    rois_v = np.asarray(
+        [[2., 2., 9., 9.],      # high IoU with gt0 -> fg
+         [21., 21., 28., 28.],  # high IoU with gt1 -> fg
+         [0., 0., 4., 4.],      # low IoU -> bg
+         [12., 12., 18., 18.]], np.float32)  # no overlap -> bg
+    feed = {'rois_in': rois_v,
+            'gtc': np.asarray([[1], [3]], np.int32),
+            'crowd': np.zeros((2, 1), np.int32),
+            'gtb': gt,
+            'imi': np.asarray([[32., 32., 1.]], np.float32)}
+    rois, labels, targets, inw, outw = [np.asarray(v) for v in
+                                        _run(prog, feed, list(outs))]
+    assert rois.shape[1] == 4
+    assert labels.shape == (rois.shape[0], 1)
+    fg_labels = labels[labels > 0]
+    assert set(fg_labels.tolist()) <= {1, 3}
+    assert targets.shape == (rois.shape[0], 16)  # 4 classes x 4
+    # inside weights mark exactly the fg rows' class slots
+    assert (inw.sum(axis=1)[labels[:, 0] > 0] == 4).all()
+    assert (inw.sum(axis=1)[labels[:, 0] == 0] == 0).all()
+    np.testing.assert_allclose(inw, outw)
