@@ -1,0 +1,153 @@
+"""Numeric gradient checks for the newer op lowerings (the reference's
+core op-test pattern, op_test.py:403 check_grad): analytic grads from
+append_backward's synthesized grad ops vs central finite differences."""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+def test_bilinear_tensor_product_grad():
+    rng = np.random.RandomState(0)
+    x = rng.standard_normal((4, 3)).astype(np.float32)
+    y = rng.standard_normal((4, 2)).astype(np.float32)
+    w = rng.standard_normal((5, 3, 2)).astype(np.float32)
+    b = rng.standard_normal((1, 5)).astype(np.float32)
+    t = OpTest()
+    t.op_type = 'bilinear_tensor_product'
+    t.inputs = {'X': x, 'Y': y, 'Weight': w, 'Bias': b}
+    t.outputs = {'Out': np.einsum('nd,kde,ne->nk', x, w, y) + b}
+    t.check_grad(['X', 'Y', 'Weight'], max_relative_error=3e-2)
+
+
+def test_conv_shift_grad():
+    rng = np.random.RandomState(1)
+    x = rng.standard_normal((2, 6)).astype(np.float32)
+    y = rng.standard_normal((2, 3)).astype(np.float32)
+    m, n = 6, 3
+    want = np.zeros_like(x)
+    for b in range(2):
+        for i in range(m):
+            for j in range(n):
+                want[b, i] += x[b, (i + j - n // 2) % m] * y[b, j]
+    t = OpTest()
+    t.op_type = 'conv_shift'
+    t.inputs = {'X': x, 'Y': y}
+    t.outputs = {'Out': want}
+    t.check_grad(['X', 'Y'], max_relative_error=3e-2)
+
+
+def test_fused_elemwise_activation_grad():
+    rng = np.random.RandomState(2)
+    x = rng.standard_normal((3, 4)).astype(np.float32) + 2.0  # keep off 0
+    y = rng.standard_normal((3, 4)).astype(np.float32) + 2.0
+    t = OpTest()
+    t.op_type = 'fused_elemwise_activation'
+    t.inputs = {'X': x, 'Y': y}
+    t.attrs = {'functor_list': ['elementwise_add', 'sigmoid'],
+               'scale': 1.0}
+    t.outputs = {'Out': x + 1.0 / (1.0 + np.exp(-y))}
+    t.check_grad(['X', 'Y'], max_relative_error=3e-2)
+
+
+def test_mean_iou_inputs_have_no_grad():
+    # metric ops are grad-free by design: int inputs, no float path
+    pred = np.asarray([0, 1], np.int32)
+    label = np.asarray([0, 1], np.int32)
+    t = OpTest()
+    t.op_type = 'mean_iou'
+    t.inputs = {'Predictions': pred, 'Labels': label}
+    t.attrs = {'num_classes': 2}
+    t.outputs = {'OutMeanIou': np.asarray([1.0], np.float32),
+                 'OutWrong': np.asarray([0], np.int32),
+                 'OutCorrect': np.asarray([2], np.int32)}
+    t.check_output()
+
+
+def test_spp_grad():
+    rng = np.random.RandomState(3)
+    x = rng.standard_normal((2, 2, 4, 4)).astype(np.float32)
+    from paddle_tpu.fluid.layer_helper import LayerHelper
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.backward import append_backward
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        xv = fluid.layers.data(name='x', shape=[2, 4, 4], dtype='float32')
+        xv.stop_gradient = False
+        helper = LayerHelper('spp')
+        out = helper.create_variable_for_type_inference('float32')
+        helper.append_op(type='spp', inputs={'X': [xv]},
+                         outputs={'Out': [out]},
+                         attrs={'pyramid_height': 2,
+                                'pooling_type': 'average'})
+        loss = fluid.layers.mean(out)
+    fwd_prog = prog.clone()  # FD probes run forward-only (op_test.py:178)
+    with fluid.program_guard(prog, startup):
+        append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        g, = exe.run(prog, feed={'x': x}, fetch_list=['x@GRAD'])
+    g = np.asarray(g)
+
+    def scalar(v):
+        return float(np.asarray(v).reshape(()))
+
+    # numeric check on one element
+    eps = 1e-3
+    xp = x.copy()
+    xp[0, 0, 1, 1] += eps
+    xm = x.copy()
+    xm[0, 0, 1, 1] -= eps
+    with fluid.scope_guard(fluid.core.Scope()):
+        lp, = exe.run(fwd_prog, feed={'x': xp}, fetch_list=[loss.name])
+        lm, = exe.run(fwd_prog, feed={'x': xm}, fetch_list=[loss.name])
+    fd = (scalar(lp) - scalar(lm)) / (2 * eps)
+    np.testing.assert_allclose(g[0, 0, 1, 1], fd, rtol=5e-2, atol=1e-5)
+
+
+def test_warpctc_grad_matches_fd():
+    rng = np.random.RandomState(4)
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.backward import append_backward
+    from helpers import lod_feed
+    t_len, c = 5, 4
+    rows = [rng.standard_normal((t_len, c)).astype(np.float32)]
+    labels = [[[1], [2]]]
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        lg = fluid.layers.data(name='lg', shape=[c], dtype='float32',
+                               lod_level=1)
+        lg.stop_gradient = False
+        lb = fluid.layers.data(name='lb', shape=[1], dtype='int64',
+                               lod_level=1)
+        loss = fluid.layers.mean(fluid.layers.warpctc(lg, lb, blank=0))
+    fwd_prog = prog.clone()  # FD probes run forward-only
+    with fluid.program_guard(prog, startup):
+        append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    def run_on(program, logits_rows, fetch):
+        with fluid.scope_guard(fluid.core.Scope()):
+            return exe.run(program, feed={
+                'lg': lod_feed([r.tolist() for r in logits_rows],
+                               'float32', dim=c),
+                'lb': lod_feed(labels, 'int64')}, fetch_list=fetch)
+
+    def scalar(v):
+        return float(np.asarray(v).reshape(()))
+
+    g, = run_on(prog, rows, ['lg@GRAD'])
+    g = np.asarray(g)
+    eps = 1e-3
+    for (ti, ci) in [(0, 1), (2, 0), (4, 3)]:
+        rp = [rows[0].copy()]
+        rp[0][ti, ci] += eps
+        rm = [rows[0].copy()]
+        rm[0][ti, ci] -= eps
+        lp, = run_on(fwd_prog, rp, [loss.name])
+        lm, = run_on(fwd_prog, rm, [loss.name])
+        fd = (scalar(lp) - scalar(lm)) / (2 * eps)
+        np.testing.assert_allclose(g.reshape(-1, c)[ti, ci], fd,
+                                   rtol=5e-2, atol=1e-4)
