@@ -72,7 +72,18 @@ int ch_send(void* h, const char* buf, uint64_t len) {
   if (c->capacity == 0) {
     // rendezvous: wait until a receiver picked this item up
     c->taken_cv.wait(g, [&] { return c->closed || c->taken_seq >= my_seq; });
-    if (c->taken_seq < my_seq) return -1;  // closed before pickup
+    if (c->taken_seq < my_seq) {
+      // closed before pickup: withdraw the payload so a close-drain recv
+      // cannot deliver a message already reported as failed.  With
+      // capacity 0 at most one undelivered item can be queued (blocking
+      // sends wait for items.size()<1, try_send requires empty), so the
+      // back entry is necessarily ours.
+      if (!c->items.empty() && c->sent_seq == my_seq) {
+        c->items.pop_back();
+        --c->sent_seq;
+      }
+      return -1;  // closed before pickup
+    }
   }
   return 0;
 }

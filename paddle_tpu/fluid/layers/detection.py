@@ -140,6 +140,10 @@ def ssd_loss(location,
     (ops/detection_ops.py ssd_loss)."""
     if mining_type not in ('max_negative', 'hard_example'):
         raise ValueError('mining_type must be max_negative or hard_example')
+    if mining_type == 'hard_example' and not sample_size:
+        # reference enforce (mine_hard_examples_op.cc:238-240)
+        raise ValueError(
+            'sample_size must be greater than zero in hard_example mode')
     helper = LayerHelper('ssd_loss', **locals())
     loss = helper.create_variable_for_type_inference(dtype=location.dtype)
     inputs = {
@@ -369,10 +373,13 @@ def rpn_target_assign(loc,
     loc_index = helper.create_variable_for_type_inference(dtype='int64')
     score_index = helper.create_variable_for_type_inference(dtype='int64')
     target_label = helper.create_variable_for_type_inference(dtype='int64')
-    target_bbox = helper.create_variable_for_type_inference(dtype='int64')
+    target_bbox = helper.create_variable_for_type_inference(
+        dtype=anchor_box.dtype)
     helper.append_op(
         type='rpn_target_assign',
-        inputs={'DistMat': [iou]},
+        inputs={'DistMat': [iou],
+                'Anchor': [anchor_box],
+                'GtBox': [gt_box]},
         outputs={
             'LocationIndex': [loc_index],
             'ScoreIndex': [score_index],

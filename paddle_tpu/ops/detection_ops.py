@@ -71,17 +71,25 @@ def _prior_box(ctx, op):
 
     ars = _expand_aspect_ratios(aspect_ratios, flip)
     # per-cell (w, h) box sizes in pixels, reference iteration order
-    # (prior_box_op.h: min box, then sqrt(min*max) box, then ar != 1 boxes)
+    # (prior_box_op.h:113-170): with min_max_aspect_ratios_order=false
+    # (the reference default) the ar != 1 boxes come first and the
+    # sqrt(min*max) box last; with true, min then max then ar boxes.
+    mm_order = bool(op.attrs.get('min_max_aspect_ratios_order', False))
     whs = []
     for k, ms in enumerate(min_sizes):
+        ar_boxes = [(ms * math.sqrt(ar), ms / math.sqrt(ar))
+                    for ar in ars if abs(ar - 1.0) >= 1e-6]
         whs.append((ms, ms))
-        if max_sizes:
-            s = math.sqrt(ms * max_sizes[k])
-            whs.append((s, s))
-        for ar in ars:
-            if abs(ar - 1.0) < 1e-6:
-                continue
-            whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+        if mm_order:
+            if max_sizes:
+                s = math.sqrt(ms * max_sizes[k])
+                whs.append((s, s))
+            whs.extend(ar_boxes)
+        else:
+            whs.extend(ar_boxes)
+            if max_sizes:
+                s = math.sqrt(ms * max_sizes[k])
+                whs.append((s, s))
     num_priors = len(whs)
 
     cx = (jnp.arange(feat_w, dtype=jnp.float32) + offset) * step_w
@@ -329,20 +337,31 @@ def _mine_negatives(cls_loss, loc_loss, match, match_dist, neg_pos_ratio,
     the top (neg_pos_ratio * num_pos) by confidence loss.  Returns a (B, M)
     bool mask — the static-shape stand-in for the reference's NegIndices
     LoD index list."""
+    if mining_type == 'hard_example':
+        # reference mine_hard_examples_op.cc: IsEligibleMining (:34) makes
+        # ALL priors eligible, loss = cls + loc (:95-99), the cap is
+        # sample_size alone (:113), selected-but-unmatched become the
+        # negatives and matched-but-unselected are demoted to -1 (:125-132)
+        loss = cls_loss + (loc_loss if loc_loss is not None else 0.0)
+        num_neg = jnp.minimum(jnp.int32(sample_size), loss.shape[1])
+        order = jnp.argsort(-loss, axis=1)
+        ranks = jnp.argsort(order, axis=1)
+        sel = ranks < num_neg
+        keep = sel & (match < 0)
+        updated = jnp.where((match >= 0) & ~sel,
+                            jnp.full_like(match, -1), match)
+        return keep, updated
     loss = cls_loss
-    if mining_type == 'hard_example' and loc_loss is not None:
-        loss = cls_loss + loc_loss
     is_neg_cand = (match < 0) & (match_dist < neg_dist_threshold)
     num_pos = jnp.sum((match >= 0).astype(jnp.int32), axis=1)  # (B,)
-    num_neg = (num_pos.astype(jnp.float32) * neg_pos_ratio).astype(jnp.int32)
-    if sample_size:
-        num_neg = jnp.minimum(num_neg, sample_size)
+    num_neg = (num_pos.astype(jnp.float32) *
+               neg_pos_ratio).astype(jnp.int32)
     masked_loss = jnp.where(is_neg_cand, loss, -jnp.inf)
     # rank of each candidate by loss, descending; keep rank < num_neg
     order = jnp.argsort(-masked_loss, axis=1)
     ranks = jnp.argsort(order, axis=1)
     keep = (ranks < num_neg[:, None]) & is_neg_cand
-    return keep
+    return keep, match
 
 
 @register_lowering('mine_hard_examples')
@@ -355,14 +374,19 @@ def _mine_hard_examples(ctx, op):
         cls_loss = cls_loss[..., 0]
     if loc_loss is not None and loc_loss.ndim == 3:
         loc_loss = loc_loss[..., 0]
-    neg_mask = _mine_negatives(
+    mining_type = op.attrs.get('mining_type', 'max_negative')
+    sample_size = int(op.attrs.get('sample_size', 0))
+    if mining_type == 'hard_example' and sample_size <= 0:
+        # reference enforce (mine_hard_examples_op.cc:238-240)
+        raise ValueError(
+            'sample_size must be greater than zero in hard_example mode')
+    neg_mask, updated_match = _mine_negatives(
         cls_loss, loc_loss, match, match_dist,
         float(op.attrs.get('neg_pos_ratio', 1.0)),
         float(op.attrs.get('neg_dist_threshold', 0.5)),
-        int(op.attrs.get('sample_size', 0)),
-        op.attrs.get('mining_type', 'max_negative'))
+        sample_size, mining_type)
     ctx.set(op, 'NegIndices', neg_mask.astype(jnp.int32))
-    ctx.set(op, 'UpdatedMatchIndices', match)
+    ctx.set(op, 'UpdatedMatchIndices', updated_match)
 
 
 @register_lowering('ssd_loss')
@@ -432,16 +456,20 @@ def _ssd_loss(ctx, op):
     conf_loss = -jnp.take_along_axis(
         logp, tgt_label[..., None], axis=-1)[..., 0]  # (B, M)
 
-    # 4. hard negative mining
-    neg_mask = _mine_negatives(conf_loss, None, match, match_dist,
-                               neg_pos_ratio, neg_overlap, sample_size,
-                               mining_type)
-
-    # 5. localization smooth-L1 on positives
+    # 4. localization smooth-L1 per prior (before mining so hard_example
+    # mode can mine on cls+loc loss like the reference ssd_loss pipeline)
     diff = loc - jax.lax.stop_gradient(enc)
     abs_diff = jnp.abs(diff)
     smooth = jnp.where(abs_diff < 1.0, 0.5 * diff * diff, abs_diff - 0.5)
-    loc_loss = jnp.sum(smooth, axis=-1) * matched.astype(loc.dtype)
+    loc_loss_all = jnp.sum(smooth, axis=-1)  # (B, M)
+
+    # 5. hard negative mining; hard_example may demote matched priors
+    neg_mask, updated_match = _mine_negatives(
+        conf_loss, loc_loss_all if mining_type == 'hard_example' else None,
+        match, match_dist, neg_pos_ratio, neg_overlap, sample_size,
+        mining_type)
+    matched = updated_match >= 0
+    loc_loss = loc_loss_all * matched.astype(loc.dtype)
 
     conf_weight = (matched | neg_mask).astype(conf.dtype)
     tgt_label = jax.lax.stop_gradient(tgt_label)
@@ -762,6 +790,19 @@ def _rpn_target_assign(ctx, op, scope):
                         replace=False) if bg_cand.size > num_bg else bg_cand
         return fg, bg, anchor_argbest
 
+    anchor_boxes = None
+    if op.input('Anchor'):
+        anchor_boxes = np.asarray(ctx.get(op, 'Anchor'),
+                                  np.float32).reshape(-1, 4)
+    gt_rows = None
+    if op.input('GtBox'):
+        gt = np.asarray(ctx.get(op, 'GtBox'), np.float32)
+        # split per image like the reference's gt_bbox->Slice(lod[i],
+        # lod[i+1]) (rpn_target_assign_op.cc:115): padded (B, G, 4)
+        # batches and concatenated LoD rows both go through the seqlen
+        # side-band helper
+        gt_rows = _rows_per_image(ctx, op, 'GtBox', gt)
+
     num_anchors = iou.shape[2]
     loc_parts, score_parts, lbl_parts, bbox_parts = [], [], [], []
     for b in range(iou.shape[0]):
@@ -769,7 +810,16 @@ def _rpn_target_assign(ctx, op, scope):
         loc_i = np.sort(fg).astype(np.int64)
         score_i = np.sort(np.concatenate([fg, bg])).astype(np.int64)
         lbl_parts.append(np.isin(score_i, fg).astype(np.int64))
-        bbox_parts.append(anchor_argbest[loc_i].astype(np.int64))
+        if anchor_boxes is not None and gt_rows is not None:
+            # reference rpn_target_assign_op.cc:128-141: gather the fg
+            # anchors and their matched gt boxes, emit BoxToDelta-encoded
+            # (fg, 4) regression targets (bbox_util.h:23, normalized=false)
+            bbox_parts.append(
+                _box_to_delta(anchor_boxes[loc_i],
+                              gt_rows[b][anchor_argbest[loc_i]]))
+        else:
+            bbox_parts.append(
+                anchor_argbest[loc_i].astype(np.float32).reshape(-1, 1))
         loc_parts.append(loc_i + b * num_anchors)
         score_parts.append(score_i + b * num_anchors)
     loc_index = np.concatenate(loc_parts) if loc_parts else np.zeros(
@@ -778,19 +828,33 @@ def _rpn_target_assign(ctx, op, scope):
         (0, ), np.int64)
     tgt_lbl = (np.concatenate(lbl_parts) if lbl_parts else np.zeros(
         (0, ), np.int64)).reshape(-1, 1)
-    anchor_argbest_all = np.concatenate(bbox_parts) if bbox_parts else (
-        np.zeros((0, ), np.int64))
+    bbox_w = 4 if (anchor_boxes is not None and gt_rows is not None) else 1
+    tgt_bbox = (np.concatenate(bbox_parts) if bbox_parts else np.zeros(
+        (0, bbox_w), np.float32)).reshape(-1, bbox_w).astype(np.float32)
     for slot, val in (('LocationIndex', loc_index),
-                      ('ScoreIndex', score_index), ('TargetLabel', tgt_lbl)):
+                      ('ScoreIndex', score_index), ('TargetLabel', tgt_lbl),
+                      ('TargetBBox', tgt_bbox)):
         names = op.output(slot)
         if names:
             scope.var(names[0]).set_value(val)
             ctx.store(names[0], val)
-    names = op.output('TargetBBox')
-    if names:
-        tgt_bbox = anchor_argbest_all.reshape(-1, 1)
-        scope.var(names[0]).set_value(tgt_bbox)
-        ctx.store(names[0], tgt_bbox)
+
+
+def _box_to_delta(ex_boxes, gt_boxes):
+    """Encode gt boxes as regression deltas from anchor (ex) boxes —
+    reference bbox_util.h:23 BoxToDelta with normalized=false (+1 pixel
+    width convention) and no weights."""
+    ex_w = ex_boxes[:, 2] - ex_boxes[:, 0] + 1.0
+    ex_h = ex_boxes[:, 3] - ex_boxes[:, 1] + 1.0
+    ex_cx = ex_boxes[:, 0] + 0.5 * ex_w
+    ex_cy = ex_boxes[:, 1] + 0.5 * ex_h
+    gt_w = gt_boxes[:, 2] - gt_boxes[:, 0] + 1.0
+    gt_h = gt_boxes[:, 3] - gt_boxes[:, 1] + 1.0
+    gt_cx = gt_boxes[:, 0] + 0.5 * gt_w
+    gt_cy = gt_boxes[:, 1] + 0.5 * gt_h
+    return np.stack([(gt_cx - ex_cx) / ex_w, (gt_cy - ex_cy) / ex_h,
+                     np.log(gt_w / ex_w), np.log(gt_h / ex_h)],
+                    axis=1).astype(np.float32)
 
 
 def _decode_proposals(anchors, deltas, variances):
@@ -854,9 +918,15 @@ def _generate_proposals(ctx, op, scope):
         props[:, 1] = np.clip(props[:, 1], 0, imh - 1)
         props[:, 2] = np.clip(props[:, 2], 0, imw - 1)
         props[:, 3] = np.clip(props[:, 3], 0, imh - 1)
+        # reference FilterBoxes (generate_proposals_op.cc:155-175): min_size
+        # is in original-image units so it scales by im_scale, and the box
+        # center must lie inside the image
+        ms = min_size * float(im_info[i, 2])
         ws = props[:, 2] - props[:, 0] + 1
         hs = props[:, 3] - props[:, 1] + 1
-        keep = (ws >= min_size) & (hs >= min_size)
+        xc = props[:, 0] + ws / 2
+        yc = props[:, 1] + hs / 2
+        keep = (ws >= ms) & (hs >= ms) & (xc <= imw) & (yc <= imh)
         props, probs = props[keep], sc[order][keep]
         kept = _nms_one_class(props, probs, -np.inf, -1, nms_thresh,
                               float(a.get('eta', 1.0)))

@@ -396,6 +396,12 @@ class _PyChan(object):
                 self._cond.wait_for(
                     lambda: self._closed or self._taken_seq >= my_seq)
                 if self._taken_seq < my_seq:
+                    # closed before pickup: withdraw the payload so a
+                    # close-drain recv can't deliver a message already
+                    # reported as failed (mirrors csrc/channel.cc)
+                    if self._items and self._sent_seq == my_seq:
+                        self._items.pop()
+                        self._sent_seq -= 1
                     return False
             return True
 

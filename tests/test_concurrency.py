@@ -177,3 +177,34 @@ def test_rendezvous_after_try_send():
     assert ch.recv() == b'b'
     ts.join(timeout=2)
     assert state['sent']
+
+
+def test_rendezvous_close_race_no_double_delivery():
+    """A capacity-0 send that fails because the channel closed before
+    pickup must NOT leave its payload behind for a close-drain recv
+    (csrc/channel.cc close-before-pickup path): the message may be
+    reported failed or delivered, never both."""
+    import threading
+    from paddle_tpu.runtime.native import NativeChannel
+
+    for _ in range(20):
+        ch = NativeChannel(0)
+        send_result = []
+
+        def sender():
+            send_result.append(ch.send(b'payload'))
+
+        t = threading.Thread(target=sender)
+        t.start()
+        # let the sender queue its item and block on pickup, then close
+        import time
+        time.sleep(0.01)
+        ch.close()
+        t.join()
+        drained = ch.recv()
+        if send_result[0]:
+            # delivered: then it was picked up, not drained after failure
+            assert drained in (NativeChannel.CLOSED, b'payload')
+        else:
+            # reported failed: close-drain must not produce the payload
+            assert drained is NativeChannel.CLOSED

@@ -98,10 +98,17 @@ def test_prior_box_values():
     # cell (0,0): center = (0+0.5)*8 = 4 px; min box half-size 4 px
     np.testing.assert_allclose(b[0, 0, 0], [0.0, 0.0, 8 / 32., 8 / 32.],
                                atol=1e-6)
-    # sqrt box: sqrt(8*16)/2 = ~5.657 px half-size
+    # reference default order (min_max_aspect_ratios_order=false,
+    # prior_box_op.h:141-170): ar!=1 boxes next, sqrt(min*max) box last
+    hw = 8 * math.sqrt(2.0) / 2
+    hh = 8 / math.sqrt(2.0) / 2
+    np.testing.assert_allclose(
+        b[0, 0, 1], [max(0, (4 - hw) / 32.), max(0, (4 - hh) / 32.),
+                     (4 + hw) / 32., (4 + hh) / 32.], rtol=1e-5)
+    # sqrt box: sqrt(8*16)/2 = ~5.657 px half-size, at the last slot
     s = math.sqrt(8 * 16) / 2
     np.testing.assert_allclose(
-        b[0, 0, 1], [max(0, (4 - s) / 32.), max(0, (4 - s) / 32.),
+        b[0, 0, 3], [max(0, (4 - s) / 32.), max(0, (4 - s) / 32.),
                      (4 + s) / 32., (4 + s) / 32.], rtol=1e-5)
     assert (b >= 0).all() and (b <= 1).all()
 
@@ -345,14 +352,21 @@ def test_rpn_target_assign_host():
         [[0., 0., 1., 1.], [0., 0., 0.9, 0.9], [5., 5., 6., 6.],
          [8., 8., 9., 9.]], np.float32)
     gts = np.array([[0., 0., 1., 1.]], np.float32)
-    lv, sv, tlv = _run(
+    lv, sv, tlv, tbv = _run(
         prog, {'loc': anchors, 'score': np.zeros((4, 1), np.float32),
-               'anchor': anchors, 'gt': gts}, [li, si, tl])
-    lv, sv, tlv = np.asarray(lv), np.asarray(sv), np.asarray(tlv)
+               'anchor': anchors, 'gt': gts}, [li, si, tl, tb])
+    lv, sv, tlv, tbv = (np.asarray(lv), np.asarray(sv), np.asarray(tlv),
+                        np.asarray(tbv))
     assert 0 in lv  # anchor 0 IoU 1.0 -> positive
     assert set(np.asarray(tlv).flatten()) <= {0, 1}
     # negatives sampled from anchors 2/3 (IoU 0)
     assert all(s in (0, 1, 2, 3) for s in sv.flatten())
+    # TargetBBox is BoxToDelta-encoded (fg, 4) float regression targets
+    # (reference rpn_target_assign_op.cc:140); anchor 0 == its matched gt
+    # so its delta row is exactly zero
+    assert tbv.shape == (lv.size, 4) and tbv.dtype == np.float32
+    row0 = int(np.where(lv.flatten() == 0)[0][0])
+    np.testing.assert_allclose(tbv[row0], np.zeros(4), atol=1e-6)
 
 
 def test_detection_map_accumulates_state():
@@ -420,15 +434,20 @@ def test_rpn_target_assign_batched_lod_gt():
     anchors = np.array(
         [[0., 0., 1., 1.], [5., 5., 6., 6.], [8., 8., 9., 9.]], np.float32)
     gt_rows = [[[0., 0., 1., 1.]], [[5., 5., 6., 6.], [8., 8., 9., 9.]]]
-    lv, sv, tlv = _run(
+    lv, sv, tlv, tbv = _run(
         prog, {'loc': anchors, 'score': np.zeros((3, 1), np.float32),
                'anchor': anchors, 'gt': lod_feed(gt_rows, 'float32', dim=4)},
-        [li, si, tl])
+        [li, si, tl, tb])
     lv = np.asarray(lv).flatten()
     # image 0 positive: anchor 0 -> global 0; image 1: anchors 1,2 -> 4,5
     assert 0 in lv
     assert {4, 5} & set(lv.tolist())
     assert all(v < 6 for v in np.asarray(sv).flatten())
+    # every fg anchor coincides with its matched (per-image LoD-sliced) gt
+    # box, so all BoxToDelta rows are zero — catches mis-sliced gt rows
+    tbv = np.asarray(tbv)
+    assert tbv.shape == (lv.size, 4)
+    np.testing.assert_allclose(tbv, np.zeros_like(tbv), atol=1e-6)
 
 
 def test_generate_proposals():
