@@ -5,6 +5,11 @@ program-building API, but every program block compiles to XLA and runs on
 TPU (fluid.TPUPlace()) instead of per-op CPU/CUDA kernels.
 """
 
+from . import flags
+from .flags import FLAGS
+# env bootstrap first, so flags govern everything imported below
+# (reference __init__.py:121-141 init_gflags tryfromenv)
+flags.try_from_env(flags.TRYFROMENV)
 from . import core
 from .core import (CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace, LoDTensor,
                    Scope, is_compiled_with_tpu, is_compiled_with_cuda)
@@ -59,5 +64,5 @@ __all__ = framework.__all__ + executor.__all__ + [
     'io', 'initializer', 'layers', 'nets', 'optimizer', 'backward',
     'regularizer', 'LoDTensor', 'CPUPlace', 'TPUPlace', 'CUDAPlace',
     'CUDAPinnedPlace', 'Tensor', 'ParamAttr', 'WeightNormParamAttr',
-    'DataFeeder', 'clip', 'profiler', 'unique_name',
+    'DataFeeder', 'clip', 'profiler', 'unique_name', 'flags', 'FLAGS',
 ]

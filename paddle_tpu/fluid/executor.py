@@ -23,8 +23,24 @@ python/paddle/fluid/executor.py:256.
 import numpy as np
 
 from . import core
+from . import flags
 from .framework import default_main_program, Variable
 from ..ops import registry
+
+
+def _check_nan_inf(pairs, where):
+    """Post-execution NaN/Inf scan (reference FLAGS_check_nan_inf,
+    framework/operator.cc): raises naming the first offending variable.
+    The in-jit half is jax_debug_nans (toggled by the flag's setter),
+    which attributes failures to the producing primitive."""
+    for name, val in pairs:
+        try:
+            arr = np.asarray(val)
+        except Exception:
+            continue
+        if arr.dtype.kind == 'f' and not np.all(np.isfinite(arr)):
+            raise RuntimeError(
+                'check_nan_inf: %s %r contains NaN/Inf' % (where, name))
 
 __all__ = ['Executor', 'global_scope', 'scope_guard', '_switch_scope',
            'fetch_var']
@@ -325,12 +341,18 @@ class _CompiledBlock(object):
         ctx = registry.LoweringContext(
             self.block, env, rng_key=rng, place=self.place)
         ctx.scope = scope
+        check_nan = flags.FLAGS.check_nan_inf
         for op in self.ops:
             host_impl = registry.get_host_op(op.type)
             if host_impl is not None:
                 host_impl(ctx, op, scope)
             else:
                 registry.run_op(ctx, op)
+            if check_nan:
+                # eager path gets reference-style per-op attribution
+                _check_nan_inf(
+                    [(n, env[n]) for n in op.output_arg_names() if n in env],
+                    'output of op %r' % op.type)
         new_state = {n: env[n] for n in self.state_out if n in env}
         fetches = [env[n] for n in self.fetch_names]
         return new_state, fetches
@@ -361,6 +383,9 @@ class _CompiledBlock(object):
                                                  feeds, rng_key)
         else:
             new_state, fetches = self._jit(state_rw, state_ro, feeds, rng_key)
+            if flags.FLAGS.check_nan_inf:
+                _check_nan_inf(list(new_state.items()), 'state var')
+                _check_nan_inf(zip(self.fetch_names, fetches), 'fetch')
         for name, val in new_state.items():
             scope.var(name).set_value(val)
         return fetches
@@ -380,6 +405,17 @@ class Executor(object):
 
     def _next_rng(self, program):
         import jax
+        if flags.FLAGS.cpu_deterministic or flags.FLAGS.cudnn_deterministic:
+            # deterministic mode (reference FLAGS_cpu_deterministic,
+            # build_strategy.h:41): key depends only on (program seed,
+            # per-program step index), so streams are independent of what
+            # else this Executor has run
+            if not hasattr(self, '_det_steps'):
+                self._det_steps = {}
+            step = self._det_steps.get(id(program), 0)
+            self._det_steps[id(program)] = step + 1
+            return jax.random.fold_in(
+                jax.random.PRNGKey(program.random_seed or 0), step)
         if self._rng is None:
             self._rng = jax.random.PRNGKey(program.random_seed or 0)
         self._rng, key = jax.random.split(self._rng)
@@ -387,6 +423,27 @@ class Executor(object):
 
     def as_lodtensor(self, data):
         return core.LoDTensor(np.asarray(data))
+
+    def _pin_cache_lifetime(self, obj):
+        """Purge this executor's cache entries keyed by id(obj) when obj is
+        garbage-collected, so recycled ids can't alias stale compiles."""
+        import weakref
+        attr = '_ptpu_cache_final_%d' % id(self)
+        if getattr(obj, attr, None) is not None:
+            return
+        cache_ref = weakref.ref(self._cache)
+        oid = id(obj)
+
+        def _purge(cache_ref=cache_ref, oid=oid):
+            cache = cache_ref()
+            if cache is not None:
+                for k in [k for k in cache if oid in (k[0], k[5])]:
+                    del cache[k]
+
+        try:
+            setattr(obj, attr, weakref.finalize(obj, _purge))
+        except AttributeError:
+            pass  # object without a __dict__; fall back to LRU semantics
 
     def run(self,
             program=None,
@@ -415,6 +472,12 @@ class Executor(object):
         sig = feed_signature(feed_arrays)
         key = (id(program), program._version, tuple(fetch_names), sig,
                self.place, id(scope), registry.amp_enabled())
+        # id()-keyed entries are purged when the keyed object dies, so a
+        # recycled id can never alias a stale compile (the LRU alone can't
+        # guarantee this: evicting one entry may unpin a program whose id
+        # recurs while sibling entries survive)
+        self._pin_cache_lifetime(program)
+        self._pin_cache_lifetime(scope)
         compiled = self._cache.get(key)
         if compiled is None:
             compiled = _CompiledBlock(program, 0, [n for n, _, _ in sig],
@@ -427,7 +490,18 @@ class Executor(object):
 
         eager = any(_is_host_op(op) for op in compiled.ops)
         rng = self._next_rng(program)
-        fetches = compiled.run(scope, feed_arrays, rng, eager=eager)
+        if flags.FLAGS.benchmark:
+            import time as _time
+            t0 = _time.perf_counter()
+            fetches = compiled.run(scope, feed_arrays, rng, eager=eager)
+            fetches = [np.asarray(f) if not isinstance(
+                f, core.SelectedRows) else f for f in fetches]  # sync
+            import logging
+            logging.getLogger('paddle_tpu').info(
+                'FLAGS_benchmark: run %.3f ms, %d fetches',
+                (_time.perf_counter() - t0) * 1e3, len(fetches))
+        else:
+            fetches = compiled.run(scope, feed_arrays, rng, eager=eager)
 
         def convert(f):
             from ..ops.sparse import SparseRows
