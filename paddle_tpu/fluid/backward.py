@@ -47,7 +47,11 @@ _NO_GRAD_OP_TYPES = {'read', 'feed', 'fetch', 'while', 'print',
 def _make_grad_op_spec(block, op, grad_known, no_grad):
     """Plan one grad op: (inputs, outputs, attrs) or None."""
     if op.type in _NO_GRAD_OP_TYPES:
-        return None
+        # a bounded While lowers to lax.scan, which the generic vjp can
+        # reverse (the analog of the reference's WhileGradOpDescMaker,
+        # operators/while_op.cc bottom); unbounded While stays opaque
+        if not (op.type == 'while' and op.attrs.get('max_trip_count')):
+            return None
     out_grad_names = [n + GRAD for n in op.output_arg_names]
     if not any(g in grad_known for g in out_grad_names):
         return None
@@ -78,29 +82,47 @@ def _make_grad_op_spec(block, op, grad_known, no_grad):
 
 def _dedup_grad_outputs(specs):
     """Rename multiply-written grad outputs and plan sum ops after the last
-    contribution (reference _addup_repetitive_outputs_)."""
-    write_count = collections.Counter()
-    for _, _, outputs, _ in specs:
-        for names in outputs.values():
-            for n in names:
-                if n:
-                    write_count[n] += 1
-    renames = collections.defaultdict(list)  # base name -> renamed parts
-    sum_after = {}  # spec index -> list of (out_name, part_names)
-    seen = collections.Counter()
-    for idx, (_, _, outputs, _) in enumerate(specs):
+    contribution (reference _addup_repetitive_outputs_).
+
+    Writes are grouped into LIVE RANGES: maximal runs of consecutive
+    writes with no intervening reader of that grad name.  Only writes in
+    the same run are fork contributions to rename-and-sum; a reader in
+    between (e.g. a bounded While consuming its Out@GRAD before the
+    snapshot assign's backward re-populates the same name for the
+    pre-loop state) seals the run, and the next write is a fresh value,
+    not an accumulation."""
+    runs = collections.defaultdict(list)  # name -> list of runs
+    open_run = {}  # name -> the currently open run (list of (idx, slot, i))
+    for idx, (_, inputs, outputs, _) in enumerate(specs):
+        reads = {n for names in inputs.values() for n in names if n}
+        for n in reads:
+            open_run.pop(n, None)  # a read seals the open run
         for slot, names in outputs.items():
             for i, n in enumerate(names):
-                if not n or write_count[n] <= 1:
+                if not n:
                     continue
-                new_name = '%s@RENAME@%d' % (n, seen[n])
-                seen[n] += 1
-                names[i] = new_name
-                renames[n].append(new_name)
-                if seen[n] == write_count[n]:  # last write
-                    sum_after[idx] = sum_after.get(idx, []) + [
-                        (n, list(renames[n]))
-                    ]
+                run = open_run.get(n)
+                if run is None:
+                    run = []
+                    runs[n].append(run)
+                    open_run[n] = run
+                run.append((idx, slot, i))
+    sum_after = {}  # spec index -> list of (out_name, part_names)
+    serial = collections.Counter()
+    for name, run_list in runs.items():
+        for run in run_list:
+            if len(run) <= 1:
+                continue
+            parts = []
+            for idx, slot, i in run:
+                new_name = '%s@RENAME@%d' % (name, serial[name])
+                serial[name] += 1
+                specs[idx][2][slot][i] = new_name
+                parts.append(new_name)
+            last_idx = run[-1][0]
+            sum_after[last_idx] = sum_after.get(last_idx, []) + [
+                (name, parts)
+            ]
     return specs, sum_after
 
 

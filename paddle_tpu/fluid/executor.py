@@ -351,7 +351,7 @@ class _CompiledBlock(object):
             if check_nan:
                 # eager path gets reference-style per-op attribution
                 _check_nan_inf(
-                    [(n, env[n]) for n in op.output_arg_names() if n in env],
+                    [(n, env[n]) for n in op.output_arg_names if n in env],
                     'output of op %r' % op.type)
         new_state = {n: env[n] for n in self.state_out if n in env}
         fetches = [env[n] for n in self.fetch_names]
@@ -409,11 +409,16 @@ class Executor(object):
             # deterministic mode (reference FLAGS_cpu_deterministic,
             # build_strategy.h:41): key depends only on (program seed,
             # per-program step index), so streams are independent of what
-            # else this Executor has run
+            # else this Executor has run.  Weakref keys make entries die
+            # with their program — no unbounded growth, no recycled-id
+            # aliasing
+            import weakref
             if not hasattr(self, '_det_steps'):
                 self._det_steps = {}
-            step = self._det_steps.get(id(program), 0)
-            self._det_steps[id(program)] = step + 1
+            key = weakref.ref(program,
+                              lambda r: self._det_steps.pop(r, None))
+            step = self._det_steps.get(key, 0)
+            self._det_steps[key] = step + 1
             return jax.random.fold_in(
                 jax.random.PRNGKey(program.random_seed or 0), step)
         if self._rng is None:
@@ -494,8 +499,9 @@ class Executor(object):
             import time as _time
             t0 = _time.perf_counter()
             fetches = compiled.run(scope, feed_arrays, rng, eager=eager)
-            fetches = [np.asarray(f) if not isinstance(
-                f, core.SelectedRows) else f for f in fetches]  # sync
+            for f in fetches:  # sync without disturbing fetch types
+                if hasattr(f, 'block_until_ready'):
+                    f.block_until_ready()
             import logging
             logging.getLogger('paddle_tpu').info(
                 'FLAGS_benchmark: run %.3f ms, %d fetches',

@@ -10,6 +10,7 @@ explicit carried state, per SURVEY §7 guiding decision 4.
 import contextlib
 
 from .. import core
+from .. import unique_name
 from ..framework import Variable, Operator, default_main_program
 from ..layer_helper import LayerHelper
 from ..initializer import Constant
@@ -82,7 +83,10 @@ def array_write(x, i, array=None):
         type='write_to_array',
         inputs={'X': [x],
                 'I': [i]},
-        outputs={'Out': [array]})
+        outputs={'Out': [array]},
+        # correlates this op with its backward so trace-time concrete
+        # indices survive in-place index rewrites (ops/control_flow_ops.py)
+        attrs={'_array_op_id': unique_name.generate('awrite')})
     return array
 
 
@@ -93,7 +97,8 @@ def array_read(array, i):
         type='read_from_array',
         inputs={'X': [array],
                 'I': [i]},
-        outputs={'Out': [out]})
+        outputs={'Out': [out]},
+        attrs={'_array_op_id': unique_name.generate('aread')})
     return out
 
 
@@ -143,13 +148,20 @@ class While(object):
     """while (cond) { sub-block } lowered to lax.while_loop
     (reference control_flow.py:655).  Carried state = every parent var the
     sub-block writes; tensor-array appends are supported when the loop
-    runs a statically-bounded counter (the common fluid pattern)."""
+    runs a statically-bounded counter (the common fluid pattern).
 
-    def __init__(self, cond, is_test=False, name=None):
+    ``max_trip_count`` makes the loop differentiable (reference
+    while_grad, operators/while_op.cc:36): the loop lowers to a bounded
+    masked ``lax.scan`` whose residuals XLA stacks for the backward pass
+    — the functional replacement for the reference's step-scope stack.
+    Carried tensor arrays are preallocated to the trip bound."""
+
+    def __init__(self, cond, is_test=False, name=None, max_trip_count=0):
         self.helper = LayerHelper('while', name=name)
         if cond.dtype != core.VarDesc.VarType.BOOL:
             raise TypeError('condition should be a bool variable')
         self.cond_var = cond
+        self.max_trip_count = int(max_trip_count or 0)
 
     @contextlib.contextmanager
     def block(self):
@@ -168,14 +180,38 @@ class While(object):
             for n in op.output_arg_names:
                 if n not in inner.vars and n not in mod_names:
                     mod_names.append(n)
+        # snapshot every carried var's pre-loop value under a fresh name:
+        # the functional env holds one value per name, so without this the
+        # backward pass would recompute the loop from FINAL values (the
+        # reference keeps initials alive in the parent scope instead)
+        carry_names = [self.cond_var.name] + [
+            n for n in mod_names if n != self.cond_var.name
+        ]
+        init_names = []
+        for n in carry_names:
+            src = parent_block._find_var_recursive(n)
+            kwargs = {'name': unique_name.generate(n + '@WHILE_INIT')}
+            if src is not None:
+                kwargs['dtype'] = src.dtype
+                kwargs['type'] = src.type
+            snap = parent_block.create_var(**kwargs)
+            parent_block.append_op(
+                type='assign', inputs={'X': [n]}, outputs={'Out': [snap.name]},
+                attrs={})
+            init_names.append(snap.name)
         parent_block.append_op(
             type='while',
             inputs={
                 'Condition': [self.cond_var],
-                'X': _external_reads(sub_block, [self.cond_var.name]),
+                # carried vars are covered by Init snapshots; listing them
+                # in X too would add a dead (final-value) grad path
+                'X': _external_reads(sub_block, carry_names),
+                'Init': init_names,
             },
             outputs={'Out': mod_names},
-            attrs={'sub_block': sub_block})
+            attrs={'sub_block': sub_block,
+                   'carry_names': carry_names,
+                   'max_trip_count': self.max_trip_count})
 
 
 class StaticRNN(object):

@@ -11,8 +11,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .registry import (register_lowering, LoweringContext, run_op,
-                       SEQLEN_SUFFIX)
+from .registry import (register_lowering, register_grad_lowering,
+                       LoweringContext, run_op, fwd_structure,
+                       GRAD_SUFFIX, SEQLEN_SUFFIX)
 
 
 def _block_reads_writes(block):
@@ -40,18 +41,49 @@ def _run_block(ctx, block, env):
 
 @register_lowering('while')
 def _while(ctx, op):
-    """lax.while_loop over the sub-block; carry = condition + every parent
-    var the body writes (reference while_op.cc RunImpl)."""
+    """Reference while_op.cc RunImpl re-enters the interpreter per step
+    with step-scopes; here the body lowers once.  Two modes:
+
+    - default: ``lax.while_loop``; carry = condition + every parent var
+      the body writes.  Cheap (early exit) but not reverse-differentiable.
+    - ``max_trip_count`` attr set: a bounded ``lax.scan`` running the
+      bound with a pass-through blend once the condition goes false.
+      ``jax.vjp`` differentiates through it — the scan residual stack is
+      the functional analog of while_grad's step-scope stack
+      (while_op.cc:36, grad maker at the file bottom).  Carried tensor
+      arrays are preallocated to len+bound so traced-index writes land.
+
+    The 'Init' input slot (aligned with attr carry_names) carries
+    pre-loop snapshots of the carried vars, so a recomputation of this op
+    in the backward pass starts from initial, not final, values."""
     block = op.attrs['sub_block']
     cond_name = op.input('Condition')[0]
     reads, writes = _block_reads_writes(block)
-    carry_names = [cond_name] + [
-        n for n in writes if ctx.has(n) and n != cond_name
-    ]
+    attr_carry = op.attrs.get('carry_names')
+    init_names = op.input('Init') or []
+    if attr_carry:
+        carry_names = list(attr_carry)
+        snapshot = dict(zip(attr_carry, init_names))
+    else:
+        carry_names = [cond_name] + [
+            n for n in writes if ctx.has(n) and n != cond_name
+        ]
+        snapshot = {}
     closure = {
         n: ctx.lookup(n)
         for n in reads if ctx.has(n) and n not in carry_names
     }
+
+    def init_val(n):
+        s = snapshot.get(n)
+        return ctx.lookup(s) if s is not None and ctx.has(s) \
+            else ctx.lookup(n)
+
+    max_trip = int(op.attrs.get('max_trip_count', 0) or 0)
+    if max_trip > 0:
+        _while_scan(ctx, block, closure, carry_names, cond_name, init_val,
+                    max_trip)
+        return
 
     def cond_fn(carry):
         return jnp.reshape(carry[cond_name], ()).astype(bool)
@@ -62,8 +94,51 @@ def _while(ctx, op):
         _run_block(ctx, block, env)
         return {n: env[n] for n in carry_names}
 
-    init = {n: ctx.lookup(n) for n in carry_names}
+    init = {n: init_val(n) for n in carry_names}
     final = jax.lax.while_loop(cond_fn, body_fn, init)
+    for n, v in final.items():
+        ctx.store(n, v)
+
+
+def _while_scan(ctx, block, closure, carry_names, cond_name, init_val,
+                max_trip):
+    """Differentiable bounded While: run the body max_trip times under
+    lax.scan, blending each carried var with its previous value once the
+    condition is false (so post-exit iterations are identity)."""
+    init = {}
+    for n in carry_names:
+        v = init_val(n)
+        if isinstance(v, list):
+            if not v:
+                raise RuntimeError(
+                    'while(max_trip_count): carried tensor array %r is '
+                    'empty at loop entry; write its first element before '
+                    'the loop so the element shape is known' % n)
+            # preallocate so traced-index writes inside the body land
+            pads = [jnp.zeros_like(v[0])] * max_trip
+            v = jnp.stack(list(v) + pads)
+        init[n] = v
+
+    def step(carry, _):
+        alive = jnp.reshape(carry[cond_name], ()).astype(bool)
+        env = dict(closure)
+        env.update(carry)
+        _run_block(ctx, block, env)
+        new_carry = {}
+        for n in carry_names:
+            new = env[n]
+            if isinstance(new, list):  # body rebuilt an array statically
+                new = jnp.stack(new)
+            old = carry[n]
+            if new.shape != old.shape:
+                raise RuntimeError(
+                    'while(max_trip_count): carried var %r changed shape '
+                    '%s -> %s inside the body; bounded loops need '
+                    'fixed-shape carries' % (n, old.shape, new.shape))
+            new_carry[n] = jnp.where(alive, new, old)
+        return new_carry, ()
+
+    final, _ = jax.lax.scan(step, init, None, length=max_trip)
     for n, v in final.items():
         ctx.store(n, v)
 
@@ -221,33 +296,119 @@ def _conditional_block(ctx, op):
 # ---- tensor-array ops (statically indexed inside lowered loops) ----
 @register_lowering('write_to_array')
 def _write_to_array(ctx, op):
+    """Tensor-array write.  Concrete index: python-list state, growable.
+    Traced index (inside a lowered loop): the array must already be dense
+    (preallocated by while's max_trip_count mode) or a non-empty list —
+    a dynamic ``.at[i].set`` cannot invent storage, and XLA drops
+    out-of-bounds writes, so under-sized arrays lose elements."""
     x = ctx.get(op, 'X')
     i = jnp.reshape(ctx.get(op, 'I'), ()).astype(jnp.int32)
     name = op.output('Out')[0]
-    arr = ctx.env.get(name)
-    if arr is None or not isinstance(arr, jnp.ndarray) or \
-            arr.shape[1:] != x.shape:
-        # array state: python list when index is concrete, else preallocated
-        arr = ctx.env.get(name)
-    if isinstance(arr, list):
-        lst = arr
-    elif arr is None:
-        lst = []
+    prev = ctx.env.get(name)
+    lst = (list(prev) if isinstance(prev, list) else
+           [] if prev is None else [prev[j] for j in range(prev.shape[0])])
+    idx = ctx.concrete.get(op.input('I')[0])
+    if idx is not None:
+        idx = int(idx)
     else:
-        lst = [arr[j] for j in range(arr.shape[0])]
-    try:
-        idx = int(i)
+        try:
+            idx = int(i)  # concrete only when not traced
+        except Exception:
+            idx = None
+    op_id = op.attrs.get('_array_op_id')
+    if op_id is not None:
+        ctx.array_log[op_id] = idx
+    if idx is not None:
         while len(lst) <= idx:
             lst.append(jnp.zeros_like(x))
         lst[idx] = x
         ctx.store(name, lst)
         return
-    except Exception:
-        pass
-    # traced index: stack and dynamic-update
-    stacked = jnp.stack(lst) if lst else jnp.zeros((0, ) + x.shape, x.dtype)
-    ctx.store(name, stacked.at[i].set(x) if stacked.shape[0] else
-              x[None])
+    if not lst:
+        raise RuntimeError(
+            'write_to_array %r: traced index into an empty tensor array — '
+            'preallocate it (while max_trip_count mode does) or write a '
+            'first element with a concrete index before the loop' % name)
+    stacked = prev if not isinstance(prev, list) else jnp.stack(lst)
+    ctx.store(name, stacked.at[i].set(x))
+
+
+@register_grad_lowering('write_to_array')
+def _write_to_array_grad(ctx, op):
+    """Backward of a tensor-array write (reference
+    tensor_array_read_write_op.cc WriteToArrayGradMaker = a read at the
+    same index).  Tensor-array gradients share the array's own name +
+    @GRAD; each write's backward pops its slot's cotangent into X@GRAD
+    and zeroes the slot before earlier writes' backwards consume it."""
+    fwd_inputs, fwd_outputs, fwd_attrs = fwd_structure(op)
+    arr_name = fwd_outputs['Out'][0]
+    arr_gname = arr_name + GRAD_SUFFIX
+    logged_idx = ctx.array_log.get(fwd_attrs.get('_array_op_id'))
+    if not ctx.has(arr_gname):
+        return
+    g = ctx.lookup(arr_gname)
+    i = ctx.lookup(fwd_inputs['I'][0])
+    xg_names = op.output('X' + GRAD_SUFFIX)
+    if isinstance(g, list):
+        idx = logged_idx if logged_idx is not None else int(
+            np.asarray(i).flatten()[0])
+        if idx < len(g):
+            xg = g[idx]
+            rest = list(g)
+            rest[idx] = jnp.zeros_like(xg)
+        else:  # cotangent never covered this slot
+            xg = jnp.zeros_like(ctx.lookup(fwd_inputs['X'][0]))
+            rest = g
+    else:
+        ii = jnp.reshape(i, ()).astype(jnp.int32)
+        xg = g[ii]
+        rest = g.at[ii].set(jnp.zeros_like(xg))
+    if xg_names and xg_names[0]:
+        prev = ctx.lookup(xg_names[0]) if ctx.has(xg_names[0]) else None
+        ctx.store(xg_names[0], xg if prev is None else prev + xg)
+    ctx.store(arr_gname, rest)
+
+
+@register_grad_lowering('read_from_array')
+def _read_from_array_grad(ctx, op):
+    """Backward of a tensor-array read = scatter-add of the out-grad into
+    the array's grad at the same index (reference ReadFromArrayGradMaker
+    = a write).  The array grad is created dense (zeros shaped like the
+    final array) on first touch."""
+    fwd_inputs, fwd_outputs, fwd_attrs = fwd_structure(op)
+    arr_name = fwd_inputs['X'][0]
+    logged_idx = ctx.array_log.get(fwd_attrs.get('_array_op_id'))
+    og_name = fwd_outputs['Out'][0] + GRAD_SUFFIX
+    if not ctx.has(og_name):
+        return
+    og = ctx.lookup(og_name)
+    gnames = op.output('X' + GRAD_SUFFIX)
+    if not gnames or not gnames[0]:
+        return
+    gname = gnames[0]
+    i = ctx.lookup(fwd_inputs['I'][0])
+    if ctx.has(gname):
+        cur = ctx.lookup(gname)
+    else:
+        arr = ctx.lookup(arr_name)
+        cur = ([jnp.zeros_like(a) for a in arr] if isinstance(arr, list)
+               else jnp.zeros_like(arr))
+    if isinstance(cur, list):
+        idx = logged_idx
+        if idx is None:
+            try:
+                idx = int(np.asarray(i).flatten()[0])
+            except Exception:
+                idx = None
+        if idx is None:
+            cur = jnp.stack(cur)
+        else:
+            cur = list(cur)
+            cur[idx] = cur[idx] + og
+            ctx.store(gname, cur)
+            return
+    ii = jnp.reshape(i, ()).astype(jnp.int32)
+    ctx.store(gname, cur.at[ii].add(og))
 
 
 @register_lowering('read_from_array')
@@ -255,11 +416,19 @@ def _read_from_array(ctx, op):
     arr = ctx.get(op, 'X')
     i = ctx.get(op, 'I')
     if isinstance(arr, list):
-        try:
-            ctx.set(op, 'Out', arr[int(np.asarray(i).flatten()[0])])
+        idx = ctx.concrete.get(op.input('I')[0])
+        if idx is None:
+            try:
+                idx = int(np.asarray(i).flatten()[0])
+            except Exception:
+                idx = None
+        op_id = op.attrs.get('_array_op_id')
+        if op_id is not None:
+            ctx.array_log[op_id] = int(idx) if idx is not None else None
+        if idx is not None:
+            ctx.set(op, 'Out', arr[int(idx)])
             return
-        except Exception:
-            arr = jnp.stack(arr)
+        arr = jnp.stack(arr)
     idx = jnp.reshape(i, ()).astype(jnp.int32)
     ctx.set(op, 'Out', arr[idx])
 

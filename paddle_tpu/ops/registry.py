@@ -100,6 +100,17 @@ class LoweringContext(object):
         # time
         self.mesh = mesh
         self.batch_axis = batch_axis
+        # trace-time constant folding for scalar index chains: under
+        # whole-block jit every value is a tracer, but tensor-array ops
+        # need concrete indices to keep list state (the reference keeps
+        # them concrete by interpreting op-by-op).  fill_constant /
+        # increment / assign record known scalar values here; run_op
+        # invalidates entries any other op overwrites.
+        self.concrete = {}
+        # per-array log of resolved indices, appended at forward-lowering
+        # time and popped (reverse order) by the array ops' backwards —
+        # in-place index vars make self.concrete stale by backward time
+        self.array_log = {}
 
     # ---- value access ----
     def get(self, op, slot, default=None):
@@ -152,6 +163,10 @@ class LoweringContext(object):
             batch_axis=self.batch_axis)
 
 
+# op types that maintain ctx.concrete themselves (their lowerings set or
+# propagate entries); every other op's outputs invalidate stale entries
+_CONCRETE_PRESERVING = {'fill_constant', 'increment', 'assign'}
+
 SEQLEN_SUFFIX = '@SEQLEN'
 # ops that consume sequence structure and emit dense outputs — sequence
 # lengths must NOT propagate through them
@@ -163,6 +178,10 @@ _SEQ_CONSUMERS = {
 def run_op(ctx, op):
     """Lower one op into the trace, propagating sequence-length metadata
     (the static-shape stand-in for LoD, SURVEY §5.7)."""
+    if op.type not in _CONCRETE_PRESERVING:
+        for names in op.outputs.values():
+            for n in names:
+                ctx.concrete.pop(n, None)
     get_lowering(op.type)(ctx, op)
     if op.type in _SEQ_CONSUMERS or op.type.endswith('_grad'):
         return
@@ -233,8 +252,17 @@ def _make_generic_grad(fwd_type):
         }
         # only outputs the forward pass actually produced (some lowerings
         # write optional outputs conditionally, e.g. sequence_pool MaxIndex)
+        # and only float ones: integer/bool outputs carry no gradient and
+        # jax.vjp rejects non-float0 cotangents for them (bounded While
+        # emits its bool condition and int counters as outputs)
+        def _inexact(v):
+            if isinstance(v, (list, tuple)):
+                return bool(v) and _inexact(v[0])
+            return jnp.issubdtype(jnp.result_type(v), jnp.inexact)
+
         out_names = [(slot, n) for slot in fwd_outputs
-                     for n in fwd_outputs[slot] if ctx.has(n)]
+                     for n in fwd_outputs[slot]
+                     if ctx.has(n) and _inexact(ctx.lookup(n))]
         faux = Operator(
             ctx.block, fwd_type,
             inputs={s: list(n) for s, n in fwd_inputs.items()},
@@ -263,20 +291,32 @@ def _make_generic_grad(fwd_type):
         diff_vals = [fwd_input_vals[s][i] for s, i, _ in diff_specs]
         primal_outs, vjp_fn = jax.vjp(primal, *diff_vals)
 
+        def _match_ct(ct, ref):
+            # cotangents may be pytrees (tensor-array lists); match leaf
+            # dtypes to the primal structure
+            if isinstance(ref, (list, tuple)):
+                return [_match_ct(c, r) for c, r in zip(ct, ref)]
+            ct = jnp.asarray(ct)
+            return ct.astype(ref.dtype) if ct.dtype != ref.dtype else ct
+
         cotangents = []
         for k, (_, n) in enumerate(out_names):
             gname = n + GRAD_SUFFIX
             if ctx.has(gname):
-                ct = ctx.lookup(gname)
-                if ct.dtype != primal_outs[k].dtype:
-                    ct = ct.astype(primal_outs[k].dtype)
-                cotangents.append(ct)
+                cotangents.append(_match_ct(ctx.lookup(gname),
+                                            primal_outs[k]))
             else:
-                cotangents.append(jnp.zeros_like(primal_outs[k]))
+                cotangents.append(jax.tree_util.tree_map(
+                    jnp.zeros_like, primal_outs[k]))
         grads = vjp_fn(tuple(cotangents))
+        # when an op writes a var it also reads (loop-carried While state),
+        # the input-grad name coincides with the output-cotangent name;
+        # that pre-existing value is this op's own cotangent, not a sibling
+        # contribution, so it must be overwritten rather than accumulated
+        cotangent_names = {n + GRAD_SUFFIX for _, n in out_names}
         for (slot, i, gname), g in zip(diff_specs, grads):
-            if ctx.has(gname):  # accumulate if a rename pass didn't split it
-                g = ctx.lookup(gname) + g
+            if ctx.has(gname) and gname not in cotangent_names:
+                g = ctx.lookup(gname) + g  # rename pass didn't split it
             ctx.store(gname, g)
 
     return grad_lowering

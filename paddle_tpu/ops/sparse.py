@@ -65,7 +65,11 @@ class SparseRows(object):
 
 
 def sparse_add(a, b):
-    """Gradient accumulation closed over {dense, SparseRows} operands."""
+    """Gradient accumulation closed over {dense, SparseRows, tensor-array
+    list} operands.  Lists add elementwise — python `+` would concatenate,
+    silently corrupting summed tensor-array gradients."""
+    if isinstance(a, list) and isinstance(b, list):
+        return [sparse_add(x, y) for x, y in zip(a, b)]
     a_sparse = isinstance(a, SparseRows)
     b_sparse = isinstance(b, SparseRows)
     if a_sparse and b_sparse:

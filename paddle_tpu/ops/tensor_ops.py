@@ -11,7 +11,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .registry import register_lowering
+from .registry import (register_lowering, register_grad_lowering,
+                       fwd_structure, GRAD_SUFFIX)
 from ..fluid import core
 
 
@@ -27,6 +28,8 @@ def _fill_constant(ctx, op):
     value = op.attrs.get('value', 0.0)
     shape = op.attrs.get('shape', [1])
     ctx.set(op, 'Out', jnp.full(tuple(shape), value, dtype=dtype))
+    if tuple(shape) == (1, ):  # scalar: track for index constant folding
+        ctx.concrete[op.output('Out')[0]] = value
 
 
 @register_lowering('fill_constant_batch_size_like')
@@ -216,6 +219,25 @@ def _split(ctx, op):
 @register_lowering('assign')
 def _assign(ctx, op):
     ctx.set(op, 'Out', ctx.get(op, 'X'))
+    out_name = op.output('Out')[0]
+    cin = ctx.concrete.get(op.input('X')[0])
+    if cin is not None:
+        ctx.concrete[out_name] = cin
+    else:
+        ctx.concrete.pop(out_name, None)
+
+
+@register_grad_lowering('assign')
+def _assign_grad(ctx, op):
+    """Identity pass-through.  Explicit (not generic-vjp) because assign is
+    used to snapshot loop-carried state (While Init): by backward time the
+    source name holds the FINAL loop value, so recomputing the primal
+    would mismatch the cotangent's pre-loop structure."""
+    fwd_inputs, fwd_outputs, _ = fwd_structure(op)
+    gsrc = fwd_outputs['Out'][0] + GRAD_SUFFIX
+    gnames = op.output('X' + GRAD_SUFFIX)
+    if ctx.has(gsrc) and gnames and gnames[0]:
+        ctx.store(gnames[0], ctx.lookup(gsrc))
 
 
 @register_lowering('assign_value')
@@ -419,6 +441,12 @@ def _increment(ctx, op):
     x = ctx.get(op, 'X')
     step = op.attrs.get('step', 1.0)
     ctx.set(op, 'Out', x + jnp.asarray(step, x.dtype))
+    out_name = op.output('Out')[0]
+    cin = ctx.concrete.get(op.input('X')[0])
+    if cin is not None:
+        ctx.concrete[out_name] = cin + step
+    else:
+        ctx.concrete.pop(out_name, None)
 
 
 def _register_compare(name, fn):

@@ -136,3 +136,121 @@ def test_seq2seq_attention_trains():
             losses.append(float(lv[0]))
     assert all(np.isfinite(l) for l in losses), losses
     assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+def test_while_grad_bounded():
+    """Backward through While via max_trip_count (reference
+    test_while_op.py pattern / while_op.cc grad maker): three data slices
+    accumulated through a tensor array inside the loop; mean loss; the
+    gradient of each slice must be 1/numel."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        d0 = fluid.layers.data(name='d0', shape=[10], dtype='float32',
+                               append_batch_size=False)
+        d1 = fluid.layers.data(name='d1', shape=[10], dtype='float32',
+                               append_batch_size=False)
+        d2 = fluid.layers.data(name='d2', shape=[10], dtype='float32',
+                               append_batch_size=False)
+        for v in (d0, d1, d2):
+            v.stop_gradient = False
+        i = fluid.layers.fill_constant(shape=[1], dtype='int64', value=0)
+        data_array = fluid.layers.array_write(x=d0, i=i)
+        i = fluid.layers.increment(x=i)
+        fluid.layers.array_write(x=d1, i=i, array=data_array)
+        i = fluid.layers.increment(x=i)
+        fluid.layers.array_write(x=d2, i=i, array=data_array)
+
+        i = fluid.layers.fill_constant(shape=[1], dtype='int64', value=0)
+        init = fluid.layers.fill_constant(shape=[10], dtype='float32',
+                                          value=0.0)
+        mem_array = fluid.layers.array_write(x=init, i=i)
+        array_len = fluid.layers.fill_constant(shape=[1], dtype='int64',
+                                               value=3)
+        cond = fluid.layers.less_than(x=i, y=array_len)
+        w = fluid.layers.While(cond=cond, max_trip_count=3)
+        with w.block():
+            d = fluid.layers.array_read(array=data_array, i=i)
+            prev = fluid.layers.array_read(array=mem_array, i=i)
+            result = fluid.layers.elementwise_add(x=d, y=prev)
+            fluid.layers.increment(x=i, value=1.0, in_place=True)
+            fluid.layers.array_write(result, i=i, array=mem_array)
+            fluid.layers.less_than(x=i, y=array_len, cond=cond)
+        sum_result = fluid.layers.array_read(array=mem_array, i=i)
+        loss = fluid.layers.mean(sum_result)
+        fluid.backward.append_backward(loss)
+
+    rng = np.random.RandomState(0)
+    feed = {k: rng.rand(10).astype('float32') for k in ('d0', 'd1', 'd2')}
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        outs = exe.run(prog, feed=feed,
+                       fetch_list=[sum_result, loss, 'd0@GRAD', 'd1@GRAD',
+                                   'd2@GRAD'])
+    sr, lv, g0, g1, g2 = [np.asarray(o) for o in outs]
+    np.testing.assert_allclose(
+        sr, feed['d0'] + feed['d1'] + feed['d2'], rtol=1e-5)
+    np.testing.assert_allclose(lv, sr.mean(), rtol=1e-5)
+    for g in (g0, g1, g2):
+        np.testing.assert_allclose(g, np.full(10, 0.1, np.float32),
+                                   rtol=1e-5)
+
+
+def test_while_forward_unbounded_still_works():
+    """No max_trip_count -> lax.while_loop path with Init snapshots."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        i = fluid.layers.fill_constant(shape=[1], dtype='float32', value=0.0)
+        limit = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                           value=7.0)
+        total = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                           value=0.0)
+        cond = fluid.layers.less_than(x=i, y=limit)
+        w = fluid.layers.While(cond=cond)
+        with w.block():
+            new_total = fluid.layers.elementwise_add(total, i)
+            fluid.layers.assign(new_total, total)
+            fluid.layers.increment(x=i, value=1.0, in_place=True)
+            fluid.layers.less_than(x=i, y=limit, cond=cond)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        out, = exe.run(prog, feed={}, fetch_list=[total])
+    assert float(np.asarray(out)[0]) == 21.0  # 0+..+6
+
+
+def test_while_grad_with_stop_gradient_slice():
+    """A write whose source has stop_gradient=True gets no grad op; the
+    attr-correlated index log must still route the other slices' grads to
+    the right slots."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        d0 = fluid.layers.data(name='d0', shape=[10], dtype='float32',
+                               append_batch_size=False)
+        d1 = fluid.layers.data(name='d1', shape=[10], dtype='float32',
+                               append_batch_size=False)
+        d2 = fluid.layers.data(name='d2', shape=[10], dtype='float32',
+                               append_batch_size=False)
+        d0.stop_gradient = False
+        d2.stop_gradient = False  # d1 stays stop_gradient=True
+        i = fluid.layers.fill_constant(shape=[1], dtype='int64', value=0)
+        arr = fluid.layers.array_write(x=d0, i=i)
+        i = fluid.layers.increment(x=i)
+        fluid.layers.array_write(x=d1, i=i, array=arr)
+        i = fluid.layers.increment(x=i)
+        fluid.layers.array_write(x=d2, i=i, array=arr)
+        i0 = fluid.layers.fill_constant(shape=[1], dtype='int64', value=0)
+        i2 = fluid.layers.fill_constant(shape=[1], dtype='int64', value=2)
+        a = fluid.layers.array_read(array=arr, i=i0)
+        b = fluid.layers.array_read(array=arr, i=i2)
+        # loss = mean(a) + 3*mean(b): d0 grad = 0.1, d2 grad = 0.3
+        loss = fluid.layers.elementwise_add(
+            fluid.layers.mean(a),
+            fluid.layers.scale(fluid.layers.mean(b), scale=3.0))
+        fluid.backward.append_backward(loss)
+    rng = np.random.RandomState(1)
+    feed = {k: rng.rand(10).astype('float32') for k in ('d0', 'd1', 'd2')}
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        g0, g2 = exe.run(prog, feed=feed,
+                         fetch_list=['d0@GRAD', 'd2@GRAD'])
+    np.testing.assert_allclose(np.asarray(g0), np.full(10, 0.1), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g2), np.full(10, 0.3), rtol=1e-5)
