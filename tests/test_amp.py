@@ -83,3 +83,30 @@ def test_amp_master_weights_stay_fp32():
         for p in prog.global_block().all_parameters():
             arr = np.asarray(scope.find_var(p.name).value())
             assert arr.dtype == np.float32, (p.name, arr.dtype)
+
+
+def test_amp_loss_parity_with_fp32_training():
+    """VERDICT Weak #9 guard: a full bf16-AMP training run must land at an
+    fp32-comparable loss (not just a finite one) — the check that AMP
+    throughput didn't buy a silent quality regression."""
+
+    def train(amp):
+        import contextlib
+        prog, startup, loss = _build_convnet()
+        prog.random_seed = 5
+        xv, yv = _data()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.core.Scope()):
+            exe.run(startup)
+            guard = fluid.amp_guard() if amp else contextlib.nullcontext()
+            with guard:
+                for _ in range(30):
+                    lv, = exe.run(prog, feed={'x': xv, 'y': yv},
+                                  fetch_list=[loss])
+        return float(np.asarray(lv).flatten()[0])
+
+    l_fp32 = train(amp=False)
+    l_amp = train(amp=True)
+    # both optimized the same schedule; bf16 rounding noise only
+    assert l_amp < 1.0, (l_amp, l_fp32)  # genuinely trained (start ~1.39)
+    assert abs(l_amp - l_fp32) < 0.15, (l_amp, l_fp32)
