@@ -404,7 +404,11 @@ class Executor(object):
         self._closed = False
 
     def _next_rng(self, program):
-        import jax
+        # Keys are built HOST-side as raw uint32[2] threefry keys — a
+        # device-side jax.random.split would dispatch a separate tiny
+        # computation every step, serializing ~12ms of runtime round trip
+        # against the training step.  A numpy key rides the jit call's own
+        # argument transfer instead.
         if flags.FLAGS.cpu_deterministic or flags.FLAGS.cudnn_deterministic:
             # deterministic mode (reference FLAGS_cpu_deterministic,
             # build_strategy.h:41): key depends only on (program seed,
@@ -419,12 +423,15 @@ class Executor(object):
                               lambda r: self._det_steps.pop(r, None))
             step = self._det_steps.get(key, 0)
             self._det_steps[key] = step + 1
-            return jax.random.fold_in(
-                jax.random.PRNGKey(program.random_seed or 0), step)
+            return np.array([(program.random_seed or 0) & 0xffffffff, step],
+                            np.uint32)
         if self._rng is None:
-            self._rng = jax.random.PRNGKey(program.random_seed or 0)
-        self._rng, key = jax.random.split(self._rng)
-        return key
+            # mask to the key word width: PRNGKey accepted 64-bit and
+            # negative seeds, so keep accepting them
+            self._rng_seed = int(program.random_seed or 0) & 0xffffffff
+            self._rng = 0
+        self._rng += 1
+        return np.array([self._rng_seed, self._rng], np.uint32)
 
     def as_lodtensor(self, data):
         return core.LoDTensor(np.asarray(data))

@@ -50,8 +50,12 @@ def layer_warp(block_func, input, ch_out, count, stride):
     return res_out
 
 
-def resnet_imagenet(input, class_dim, depth=50):
-    """ResNet-50/101/152 (reference resnet.py:47)."""
+def resnet_imagenet(input, class_dim, depth=50, logits_only=False):
+    """ResNet-50/101/152 (reference resnet.py:47).  ``logits_only`` skips
+    the softmax so the caller can use the fused
+    softmax_with_cross_entropy loss (one kernel, better numerics than
+    softmax + cross_entropy — reference softmax_with_cross_entropy_op.cc
+    motivates the same fusion)."""
     cfg = {
         18: ([2, 2, 2, 1], basicblock),
         34: ([3, 4, 6, 3], basicblock),
@@ -72,7 +76,8 @@ def resnet_imagenet(input, class_dim, depth=50):
     pool2 = fluid.layers.pool2d(
         input=res4, pool_size=7, pool_type='avg', pool_stride=1,
         global_pooling=True)
-    out = fluid.layers.fc(input=pool2, size=class_dim, act='softmax')
+    out = fluid.layers.fc(input=pool2, size=class_dim,
+                          act=None if logits_only else 'softmax')
     return out
 
 
@@ -96,20 +101,33 @@ def build(depth=50,
           image_shape=(3, 224, 224),
           lr=0.01,
           use_momentum=True,
-          variant='imagenet'):
-    """Build the train/test programs (reference benchmark fluid_benchmark)."""
+          variant='imagenet',
+          fused_ce=True):
+    """Build the train/test programs (reference benchmark fluid_benchmark).
+
+    ``fused_ce`` (imagenet variant) trains on the fused
+    softmax_with_cross_entropy head — one kernel, log-sum-exp stable —
+    and leaves a softmax prediction output for inference/accuracy."""
     main = fluid.Program()
     startup = fluid.Program()
     with fluid.program_guard(main, startup):
         img = fluid.layers.data(
             name='img', shape=list(image_shape), dtype='float32')
         label = fluid.layers.data(name='label', shape=[1], dtype='int64')
-        if variant == 'imagenet':
-            prediction = resnet_imagenet(img, class_dim, depth=depth)
+        if variant == 'imagenet' and fused_ce:
+            logits = resnet_imagenet(img, class_dim, depth=depth,
+                                     logits_only=True)
+            prediction = fluid.layers.softmax(logits)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(
+                    logits=logits, label=label))
         else:
-            prediction = resnet_cifar10(img, class_dim, depth=depth)
-        loss = fluid.layers.mean(
-            fluid.layers.cross_entropy(input=prediction, label=label))
+            if variant == 'imagenet':
+                prediction = resnet_imagenet(img, class_dim, depth=depth)
+            else:
+                prediction = resnet_cifar10(img, class_dim, depth=depth)
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(input=prediction, label=label))
         acc = fluid.layers.accuracy(input=prediction, label=label)
         test_program = main.clone(for_test=True)
         if use_momentum:
