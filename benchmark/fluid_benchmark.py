@@ -23,9 +23,7 @@ import paddle_tpu.dataset as dataset
 def _lod_feed(rows, dtype, dim=1):
     flat = np.concatenate(
         [np.asarray(r, dtype).reshape(-1, dim) for r in rows])
-    lt = fluid.core.LoDTensor(flat)
-    lt.set_recursive_sequence_lengths([[len(r) for r in rows]])
-    return lt
+    return fluid.create_lod_tensor(flat, [[len(r) for r in rows]])
 
 
 def _mnist(args, rng):
@@ -81,11 +79,15 @@ def _stacked_lstm(args, rng):
 
 def _machine_translation(args, rng):
     from paddle_tpu.models import seq2seq
-    model = seq2seq.build(src_dict_dim=1000, trg_dict_dim=1000)
+    # reference get_model dims (benchmark/fluid/models/machine_translation.py:
+    # embedding_dim=512, encoder/decoder_size=512, dict_size=30000)
+    model = seq2seq.build(src_dict_dim=30000, trg_dict_dim=30000,
+                          embedding_dim=512, encoder_size=512,
+                          decoder_size=512)
     seq_len = args.seq_len
-    src = [rng.randint(3, 1000, size=(seq_len, 1)).tolist()
+    src = [rng.randint(3, 30000, size=(seq_len, 1)).tolist()
            for _ in range(args.batch_size)]
-    trg = [rng.randint(3, 1000, size=(seq_len, 1)).tolist()
+    trg = [rng.randint(3, 30000, size=(seq_len, 1)).tolist()
            for _ in range(args.batch_size)]
     feed = {
         'src_word_id': _lod_feed(src, 'int64'),

@@ -209,17 +209,17 @@ def _lod_to_padded(lt, bucket=_SEQ_BUCKET):
     T is bucketed to a multiple of ``bucket`` so recompiles are bounded
     (the static-shape answer to LoD's no-padding design, SURVEY §5.7)."""
     data = lt.numpy()
-    offsets = lt.lod()[-1]
-    lengths = np.asarray(
-        [offsets[i + 1] - offsets[i] for i in range(len(offsets) - 1)],
-        np.int32)
+    offsets = np.asarray(lt.lod()[-1], np.int64)
+    lengths = (offsets[1:] - offsets[:-1]).astype(np.int32)
     b = len(lengths)
     max_len = int(lengths.max()) if b else 0
     t = max(((max_len + bucket - 1) // bucket) * bucket, bucket)
     out = np.zeros((b, t) + data.shape[1:], data.dtype)
-    for i in range(b):
-        s, e = offsets[i], offsets[i + 1]
-        out[i, :e - s] = data[s:e]
+    if b and len(data):
+        # vectorized scatter: row i gets data[offsets[i]:offsets[i+1]]
+        row = np.repeat(np.arange(b), lengths)
+        pos = np.arange(len(data)) - np.repeat(offsets[:-1], lengths)
+        out[row, pos] = data
     return out, lengths
 
 

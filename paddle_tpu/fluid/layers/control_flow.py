@@ -457,8 +457,15 @@ class DynamicRNN(object):
         parent_block = main_program.block(self.parent_idx)
         out_vars = []
         for name in self.outputs:
+            step_var = self.sub_block._find_var_recursive(name)
             ov = parent_block.create_var(
-                name=name + '@rnn_out', dtype='float32', lod_level=1)
+                name=name + '@rnn_out',
+                dtype=step_var.dtype if step_var is not None else 'float32',
+                lod_level=1)
+            if step_var is not None and step_var.shape:
+                # per-step [B, ...] stacks to a sequence [N, ...]; keep the
+                # feature dims so downstream fc sizes its weight correctly
+                ov.shape = (-1, ) + tuple(step_var.shape[1:])
             out_vars.append(ov)
         self._out_vars = out_vars
         exclude = [i for _, i in self.inputs] + list(self.memories.keys())

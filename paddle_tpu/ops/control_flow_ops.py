@@ -231,6 +231,14 @@ def _recurrent(ctx, op):
             outs.append(jnp.where(mm, o, jnp.zeros_like(o)))
         return new_carry, tuple(outs)
 
+    # Remat the step body, keeping matmul outputs: without this the scan
+    # stacks every per-step intermediate (e.g. the [B, T, D] attention
+    # tanh inside a DynamicRNN decoder) as a backward residual — O(T^2)
+    # HBM traffic; with dots_saveable only the small dot outputs are
+    # stored and the elementwise chains are recomputed in the backward
+    # scan (the standard TPU remat-scan recipe).
+    step = jax.checkpoint(
+        step, policy=jax.checkpoint_policies.dots_saveable)
     _, collected = jax.lax.scan(step, mem_init, (tuple(xs), step_mask))
     for out_var_name, col in zip(op.output('Out'), collected):
         out = col if time_major else jnp.swapaxes(col, 0, 1)
