@@ -1,0 +1,66 @@
+"""Data-parallel trainer process for the multi-process distributed test.
+
+Reference pattern: test_dist_base.py:155-290 spawns trainer processes on
+localhost and asserts dist loss ~= local loss.  TPU-native shape of the
+same proof: each process joins the JAX distributed runtime through the
+PADDLE_* env contract (parallel/multihost.py), the mesh spans every
+process's virtual CPU devices, and ONE SPMD program trains over the
+global batch with compiler-inserted gradient all-reduces — no pserver,
+no send/recv ops.
+
+Every process generates the identical global batch (same seed) and
+contributes its addressable shard; rank 0's losses are the result.
+Prints one JSON line: {"pid": N, "losses": [...]}.
+"""
+import json
+import os
+
+
+def main():
+    # mirror tests/conftest.py: the ambient interpreter (axon
+    # sitecustomize) may have imported jax already pointed at the real
+    # chip; flip it to a 2-virtual-device CPU before the backend spins up
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    os.environ['XLA_FLAGS'] = (
+        os.environ.get('XLA_FLAGS', '') +
+        ' --xla_force_host_platform_device_count=2').strip()
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.parallel.multihost import init_distributed_env
+
+    nproc, pid = init_distributed_env()
+    assert len(jax.devices()) == 2 * nproc, (
+        'global device view must span all processes: %d devices, %d procs' %
+        (len(jax.devices()), nproc))
+
+    from paddle_tpu.models import mnist
+    model = mnist.build(nn_type='mlp', lr=0.01)
+    model['startup'].random_seed = 7
+    model['main'].random_seed = 7
+    steps = int(os.environ.get('DIST_TEST_STEPS', '5'))
+    batch = int(os.environ.get('DIST_TEST_BATCH', '32'))
+    rng = np.random.RandomState(42)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(model['startup'])
+        pe = fluid.ParallelExecutor(loss_name=model['loss'].name,
+                                    main_program=model['main'],
+                                    scope=scope)
+        # one fixed global batch, every step: the loss must fall (overfit)
+        # and every process feeds the identical global array, each
+        # materializing only its addressable shard
+        img = rng.standard_normal((batch, 784)).astype('float32')
+        label = rng.randint(0, 10, (batch, 1)).astype('int64')
+        for _ in range(steps):
+            loss_v, = pe.run([model['loss']],
+                             feed={'img': img, 'label': label})
+            losses.append(float(np.asarray(loss_v).flatten()[0]))
+    print(json.dumps({'pid': pid, 'losses': losses}), flush=True)
+
+
+if __name__ == '__main__':
+    main()
