@@ -405,13 +405,21 @@ class Program(object):
         return program_serde.program_to_dict(self)
 
     def serialize_to_string(self):
-        from . import program_serde
-        return program_serde.serialize_program(self)
+        """framework.proto ProgramDesc bytes — the reference's public
+        model contract (framework.proto:183)."""
+        from . import proto_serde
+        return proto_serde.serialize_program(self)
 
     @staticmethod
     def parse_from_string(data):
-        from . import program_serde
-        return program_serde.deserialize_program(data)
+        if isinstance(data, str):
+            data = data.encode('utf-8')
+        if data[:1] == b'{':
+            # legacy structural-JSON artifact (pre-protobuf rounds)
+            from . import program_serde
+            return program_serde.deserialize_program(data)
+        from . import proto_serde
+        return proto_serde.deserialize_program(data)
 
 
 # ops whose clone(for_test) should set is_test

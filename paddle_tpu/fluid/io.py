@@ -3,10 +3,12 @@
 The reference implements persistence as programs of ``save``/``load`` ops run
 by the Executor (io.py:89-506, operators/save_op.cc).  Here the same public
 API persists scope tensors directly from the host — params are pulled from
-the device once and written as one ``.npz``-style combined file or one file
-per variable (matching save_vars/save_combine semantics).  The serialized
-inference model keeps the program-is-data contract: ``__model__`` holds the
-serialized program (program_serde), params sit next to it.
+the device once and written in the reference's version-0 LoDTensor stream
+format (lod_tensor.cc:251), one file per variable or back-to-back in one
+combined file (save_combine_op.cc).  ``__model__`` holds ProgramDesc
+protobuf bytes with embedded feed/fetch ops — the reference's public model
+contract (framework.proto:183, inference/io.cc:117); legacy JSON/npy/npz
+artifacts from earlier rounds still load.
 """
 
 import json
@@ -15,7 +17,8 @@ import os
 import numpy as np
 
 from . import core
-from .framework import Program, Parameter, Variable, default_main_program
+from .framework import Program, Parameter, Variable, Operator, \
+    default_main_program
 from .executor import global_scope
 
 __all__ = [
@@ -44,13 +47,40 @@ def _scope_value(scope, name):
 
 
 def _save_one(path, arr):
+    # version-0 LoDTensor stream — the reference's parameter-file
+    # contract (operators/save_op.cc -> lod_tensor.cc:251)
+    from . import proto_serde
     with open(path, 'wb') as f:
-        np.lib.format.write_array(f, np.asarray(arr))
+        f.write(proto_serde.serialize_lod_tensor(np.asarray(arr)))
 
 
 def _load_one(path):
+    from . import proto_serde
     with open(path, 'rb') as f:
-        return np.lib.format.read_array(f)
+        if f.read(6) == b'\x93NUMPY':  # legacy npy artifact
+            f.seek(0)
+            return np.lib.format.read_array(f)
+        f.seek(0)
+        arr, _lod = proto_serde.read_lod_tensor(f)
+        return arr
+
+
+def check_tensor_matches_var(arr, var, source):
+    """Guard against stream misassignment: combined files carry no names,
+    so dims/dtype must agree with the program's var desc."""
+    want_np = np.dtype(var.np_dtype)
+    if arr.dtype != want_np:
+        raise RuntimeError(
+            '%s: dtype %s from file does not match var %r dtype %s' %
+            (source, arr.dtype, var.name, want_np))
+    want = tuple(var.shape or ())
+    concrete_ok = (len(arr.shape) == len(want) and all(
+        w in (-1, None) or int(w) == int(g)
+        for w, g in zip(want, arr.shape)))
+    if want and not concrete_ok:
+        raise RuntimeError(
+            '%s: shape %s from file does not match var %r shape %s' %
+            (source, arr.shape, var.name, want))
 
 
 def save_vars(executor,
@@ -71,10 +101,14 @@ def save_vars(executor,
             _save_one(
                 os.path.join(dirname, var.name), _scope_value(scope, var.name))
     else:
-        # combined file: npz (data-only), analog of save_combine_op
-        blob = {v.name: _scope_value(scope, v.name) for v in vars}
+        # combined file: each var's LoDTensor stream back-to-back in var
+        # order (reference operators/save_combine_op.cc)
+        from . import proto_serde
         with open(os.path.join(dirname, filename), 'wb') as f:
-            np.savez(f, **blob)
+            for v in vars:
+                f.write(
+                    proto_serde.serialize_lod_tensor(
+                        _scope_value(scope, v.name)))
 
 
 def save_params(executor, dirname, main_program=None, filename=None):
@@ -114,10 +148,20 @@ def load_vars(executor,
             arr = _load_one(os.path.join(dirname, var.name))
             scope.var(var.name).set_value(arr)
     else:
-        with np.load(os.path.join(dirname, filename),
-                     allow_pickle=False) as blob:
-            for var in vars:
-                scope.var(var.name).set_value(blob[var.name])
+        path = os.path.join(dirname, filename)
+        with open(path, 'rb') as f:
+            magic = f.read(2)
+        if magic == b'PK':  # legacy npz artifact
+            with np.load(path, allow_pickle=False) as blob:
+                for var in vars:
+                    scope.var(var.name).set_value(blob[var.name])
+        else:
+            from . import proto_serde
+            with open(path, 'rb') as f:
+                for var in vars:
+                    arr, _lod = proto_serde.read_lod_tensor(f)
+                    check_tensor_matches_var(arr, var, path)
+                    scope.var(var.name).set_value(arr)
 
 
 def load_params(executor, dirname, main_program=None, filename=None):
@@ -168,16 +212,64 @@ def save_inference_model(dirname,
     inference_program = pruned.inference_optimize()
     fetch_var_names = [v.name for v in target_vars]
 
+    # the reference records feed/fetch targets INSIDE the program
+    # (io.py:561 prepend_feed_ops/append_fetch_ops), so ``__model__`` is
+    # pure ProgramDesc protobuf bytes — the public contract
+    # (inference/io.cc:117 reads the file as a ProgramDesc)
+    _prepend_feed_ops(inference_program, list(feeded_var_names))
+    _append_fetch_ops(inference_program, fetch_var_names)
     model_filename = model_filename or '__model__'
-    meta = {
-        'program': inference_program.serialize_to_string().decode('utf-8'),
-        'feed_var_names': list(feeded_var_names),
-        'fetch_var_names': fetch_var_names,
-    }
-    with open(os.path.join(dirname, model_filename), 'w') as f:
-        json.dump(meta, f)
+    with open(os.path.join(dirname, model_filename), 'wb') as f:
+        f.write(inference_program.serialize_to_string())
     save_persistables(executor, dirname, main_program, params_filename)
     return fetch_var_names
+
+
+def _prepend_feed_ops(program, feed_target_names, feed_holder='feed'):
+    """(reference io.py prepend_feed_ops)"""
+    blk = program.global_block()
+    blk.create_var(name=feed_holder,
+                   type=core.VarDesc.VarType.FEED_MINIBATCH,
+                   persistable=True)
+    for i, name in enumerate(feed_target_names):
+        op = Operator(blk, 'feed', inputs={'X': [feed_holder]},
+                      outputs={'Out': [name]}, attrs={'col': i})
+        blk.ops.insert(i, op)
+    program._bump_version()
+
+
+def _append_fetch_ops(program, fetch_target_names, fetch_holder='fetch'):
+    """(reference io.py append_fetch_ops)"""
+    blk = program.global_block()
+    blk.create_var(name=fetch_holder,
+                   type=core.VarDesc.VarType.FETCH_LIST,
+                   persistable=True)
+    for i, name in enumerate(fetch_target_names):
+        blk.ops.append(
+            Operator(blk, 'fetch', inputs={'X': [name]},
+                     outputs={'Out': [fetch_holder]}, attrs={'col': i}))
+    program._bump_version()
+
+
+def _strip_feed_fetch_ops(program):
+    """Recover (feed_names, fetch_names) from the embedded feed/fetch ops
+    and remove them (this executor feeds/fetches by name)."""
+    blk = program.global_block()
+    feeds, fetches = {}, {}
+    kept = []
+    for op in blk.ops:
+        if op.type == 'feed':
+            feeds[op.attrs.get('col', len(feeds))] = op.output('Out')[0]
+        elif op.type == 'fetch':
+            fetches[op.attrs.get('col', len(fetches))] = op.input('X')[0]
+        else:
+            kept.append(op)
+    blk.ops[:] = kept
+    for holder in ('feed', 'fetch'):
+        blk.vars.pop(holder, None)
+    program._bump_version()
+    return ([feeds[i] for i in sorted(feeds)],
+            [fetches[i] for i in sorted(fetches)])
 
 
 def load_inference_model(dirname,
@@ -187,12 +279,16 @@ def load_inference_model(dirname,
     """Returns (program, feed_target_names, fetch_targets)
     (reference io.py:677)."""
     model_filename = model_filename or '__model__'
-    with open(os.path.join(dirname, model_filename), 'r') as f:
-        meta = json.load(f)
-    program = Program.parse_from_string(meta['program'])
+    with open(os.path.join(dirname, model_filename), 'rb') as f:
+        data = f.read()
+    if data[:1] == b'{':  # legacy JSON wrapper (pre-protobuf rounds)
+        meta = json.loads(data.decode('utf-8'))
+        program = Program.parse_from_string(meta['program'])
+        feed_names = meta['feed_var_names']
+        fetch_names = meta['fetch_var_names']
+    else:
+        program = Program.parse_from_string(data)
+        feed_names, fetch_names = _strip_feed_fetch_ops(program)
     load_persistables(executor, dirname, program, params_filename)
-    feed_names = meta['feed_var_names']
-    fetch_targets = [
-        program.global_block().var(n) for n in meta['fetch_var_names']
-    ]
+    fetch_targets = [program.global_block().var(n) for n in fetch_names]
     return program, feed_names, fetch_targets

@@ -33,18 +33,19 @@ def _print(ctx, op, scope):
 
 @register_host_op('save')
 def _save(ctx, op, scope):
+    """version-0 LoDTensor stream (reference operators/save_op.cc ->
+    framework/lod_tensor.cc:251 SerializeToStream)."""
+    from ..fluid import io as fluid_io
     x = ctx.get(op, 'X')
     path = op.attrs['file_path']
     os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
-    with open(path, 'wb') as f:
-        np.lib.format.write_array(f, np.asarray(x))
+    fluid_io._save_one(path, np.asarray(x))
 
 
 @register_host_op('load')
 def _load(ctx, op, scope):
-    path = op.attrs['file_path']
-    with open(path, 'rb') as f:
-        arr = np.lib.format.read_array(f)
+    from ..fluid import io as fluid_io
+    arr = fluid_io._load_one(op.attrs['file_path'])
     names = op.output('Out')
     if names:
         ctx.store(names[0], arr)
@@ -53,22 +54,40 @@ def _load(ctx, op, scope):
 
 @register_host_op('save_combine')
 def _save_combine(ctx, op, scope):
+    """Streams back-to-back in input order (reference save_combine_op.cc)."""
+    from ..fluid import proto_serde
     xs = ctx.get_list(op, 'X')
-    names = op.input('X')
     path = op.attrs['file_path']
     os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
     with open(path, 'wb') as f:
-        np.savez(f, **{n: np.asarray(x) for n, x in zip(names, xs)})
+        for x in xs:
+            f.write(proto_serde.serialize_lod_tensor(np.asarray(x)))
 
 
 @register_host_op('load_combine')
 def _load_combine(ctx, op, scope):
+    from ..fluid import proto_serde
+    from ..fluid import io as fluid_io
     path = op.attrs['file_path']
     names = op.output('Out')
-    with np.load(path, allow_pickle=False) as blob:
+    with open(path, 'rb') as f:
+        magic = f.read(2)
+        f.seek(0)
+        if magic == b'PK':  # legacy npz artifact
+            with np.load(path, allow_pickle=False) as blob:
+                for n in names:
+                    ctx.store(n, blob[n])
+                    scope.var(n).set_value(blob[n])
+            return
         for n in names:
-            ctx.store(n, blob[n])
-            scope.var(n).set_value(blob[n])
+            arr, _lod = proto_serde.read_lod_tensor(f)
+            var = op.block._find_var_recursive(n)
+            if var is not None:
+                # combined streams carry no names; order misassignment
+                # must fail loudly, not silently swap weights
+                fluid_io.check_tensor_matches_var(arr, var, path)
+            ctx.store(n, arr)
+            scope.var(n).set_value(arr)
 
 
 # ---- chunk evaluation (reference operators/chunk_eval_op.cc — CPU-only
