@@ -192,6 +192,20 @@ def convert_dtype_to_np(dtype):
     return np.dtype(dtype)
 
 
+class PaddedSequence(object):
+    """A LoD feed already lowered to device: padded [B, T, ...] data plus
+    per-row lengths.  Produced by the double-buffer reader's prefetch
+    thread (reference create_double_buffer_reader_op.cc moved batches to
+    device ahead of the compute stream); consumed by
+    executor.prepare_feed_arrays."""
+
+    __slots__ = ('data', 'lengths')
+
+    def __init__(self, data, lengths):
+        self.data = data
+        self.lengths = lengths
+
+
 # ----------------------------------------------------------------------------
 # LoDTensor (paddle/fluid/framework/lod_tensor.h)
 # ----------------------------------------------------------------------------

@@ -136,7 +136,11 @@ def prepare_feed_arrays(feed):
     import jax
     feed_arrays = {}
     for name, value in feed.items():
-        if isinstance(value, core.LoDTensor) and value.lod():
+        if isinstance(value, core.PaddedSequence):
+            # already padded + device-staged by a double-buffer reader
+            feed_arrays[name] = value.data
+            feed_arrays[name + registry.SEQLEN_SUFFIX] = value.lengths
+        elif isinstance(value, core.LoDTensor) and value.lod():
             padded, lengths = _lod_to_padded(value)
             feed_arrays[name] = padded
             feed_arrays[name + registry.SEQLEN_SUFFIX] = lengths

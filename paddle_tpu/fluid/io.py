@@ -28,8 +28,18 @@ __all__ = [
 ]
 
 
+_NON_TENSOR_KINDS = frozenset([
+    core.VarDesc.VarType.FEED_MINIBATCH, core.VarDesc.VarType.FETCH_LIST,
+    core.VarDesc.VarType.READER, core.VarDesc.VarType.RAW,
+    core.VarDesc.VarType.STEP_SCOPES, core.VarDesc.VarType.CHANNEL,
+])
+
+
 def is_persistable(var):
-    return var.persistable
+    # readers/feed/fetch holders are persistable program objects but carry
+    # no tensor to serialize (reference io.py load_vars skips these kinds)
+    return var.persistable and getattr(var, 'type',
+                                       None) not in _NON_TENSOR_KINDS
 
 
 def is_parameter(var):
@@ -212,6 +222,11 @@ def save_inference_model(dirname,
     inference_program = pruned.inference_optimize()
     fetch_var_names = [v.name for v in target_vars]
 
+    # params first, FROM THE PRUNED PROGRAM: combined files are
+    # order-addressed streams, so the save order must be the var order
+    # the loader will walk (the reference saves from the pruned program
+    # too, io.py:633)
+    save_persistables(executor, dirname, inference_program, params_filename)
     # the reference records feed/fetch targets INSIDE the program
     # (io.py:561 prepend_feed_ops/append_fetch_ops), so ``__model__`` is
     # pure ProgramDesc protobuf bytes — the public contract
@@ -221,7 +236,6 @@ def save_inference_model(dirname,
     model_filename = model_filename or '__model__'
     with open(os.path.join(dirname, model_filename), 'wb') as f:
         f.write(inference_program.serialize_to_string())
-    save_persistables(executor, dirname, main_program, params_filename)
     return fetch_var_names
 
 

@@ -2,9 +2,10 @@
 
 py_reader (reference io.py:474) feeds minibatches through the native
 blocking queue (csrc/blocking_queue.cc) from a background thread; the
-executor pops each batch on the host and feeds the compiled XLA step —
-double-buffering comes from the queue plus JAX's async dispatch rather
-than a device-side double_buffer reader op.
+executor pops each batch on the host and feeds the compiled XLA step.
+double_buffer() adds a device-prefetch thread that pads and stages the
+next batch on device while the current step runs (the reference's
+create_double_buffer_reader_op.cc behavior).
 """
 
 import pickle
@@ -66,6 +67,8 @@ class _PyReaderFeeder(object):
     def __init__(self, capacity, shapes, dtypes, lod_levels):
         from ...runtime import NativeBlockingQueue
         self.queue = NativeBlockingQueue(capacity)
+        self.capacity = capacity
+        self._closed = False
         self.shapes = shapes
         self.dtypes = dtypes
         self.lod_levels = lod_levels or [0] * len(shapes)
@@ -74,6 +77,11 @@ class _PyReaderFeeder(object):
         self._exhausted = False
         self._error = None
         self._shuffle_buffer = 0
+        # set by double_buffer(): batches are padded + device_put on a
+        # prefetch thread so transfer of batch N+1 overlaps step N
+        self._double_buffer_place = None
+        self._dev_queue = None
+        self._convert_thread = None
 
     def decorate_paddle_reader(self, reader, places=None):
         """reader yields per-sample tuples; batches are assembled with
@@ -115,6 +123,10 @@ class _PyReaderFeeder(object):
         if self._shuffle_buffer > 1:
             provider = _shuffled_provider(provider, self._shuffle_buffer)
 
+        if self._double_buffer_place is not None:
+            self._start_zero_copy_pipeline(provider)
+            return
+
         def work():
             try:
                 for batch in provider():
@@ -129,19 +141,106 @@ class _PyReaderFeeder(object):
         self._thread = threading.Thread(target=work, daemon=True)
         self._thread.start()
 
+    # ---- double-buffer device prefetch (reference
+    # operators/reader/create_double_buffer_reader_op.cc: a prefetch
+    # thread keeps the next batch resident on device).  Batches move
+    # producer -> converter as PYTHON REFERENCES, not serialized bytes:
+    # at ResNet batch sizes the pickle+queue+unpickle round trip costs
+    # more than the training step itself. ----
+    def _convert_batch(self, item):
+        import jax
+        from ..executor import _lod_to_padded
+        dev = self._double_buffer_place.jax_device()
+        out = []
+        for slot, lod in zip(item, self.lod_levels):
+            if isinstance(slot, core.LoDTensor) and slot.lod():
+                padded, lengths = _lod_to_padded(slot)
+                out.append(
+                    core.PaddedSequence(
+                        jax.device_put(padded, dev),
+                        jax.device_put(lengths, dev)))
+            else:
+                arr = slot.numpy() if isinstance(slot, core.LoDTensor) \
+                    else np.asarray(slot)
+                out.append(jax.device_put(arr, dev))
+        return tuple(out)
+
+    def _start_zero_copy_pipeline(self, provider):
+        import queue as _queue
+        self._closed = False
+        end = self._end_sentinel = object()
+        ref_q = _queue.Queue(maxsize=max(2, min(int(self.capacity), 8)))
+        self._dev_queue = _queue.Queue(maxsize=2)
+
+        def _put(q, item):
+            while not self._closed:
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for batch in provider():
+                    if not _put(ref_q, tuple(batch)):
+                        return
+            except BaseException as e:
+                self._error = e
+            finally:
+                _put(ref_q, end)
+
+        def convert():
+            try:
+                while not self._closed:
+                    try:
+                        item = ref_q.get(timeout=0.1)
+                    except _queue.Empty:
+                        continue
+                    if item is end:
+                        _put(self._dev_queue, None)
+                        return
+                    _put(self._dev_queue, self._convert_batch(item))
+            except BaseException as e:
+                self._error = e
+                _put(self._dev_queue, None)
+
+        self._thread = threading.Thread(target=produce, daemon=True)
+        self._convert_thread = threading.Thread(target=convert, daemon=True)
+        self._thread.start()
+        self._convert_thread.start()
+
+    def _eof_or_raise(self):
+        """End of stream: surface a provider error once, then signal EOF
+        on this and every later pop until reset()."""
+        self._exhausted = True
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError(
+                'py_reader data provider failed: %r' % (err, )) from err
+        return None
+
     def pop(self):
+        if self._convert_thread is not None:
+            if self._exhausted:  # the sentinel is delivered only once
+                return None
+            batch = self._dev_queue.get()
+            if batch is None:
+                return self._eof_or_raise()
+            return batch
         data = self.queue.pop()
         if data is None:
-            if self._error is not None:
-                err, self._error = self._error, None
-                raise RuntimeError(
-                    'py_reader data provider failed: %r' % (err, )) from err
-            self._exhausted = True
-            return None
+            return self._eof_or_raise()
         return pickle.loads(data)
 
     def reset(self):
         self.queue.close()
+        self._closed = True
+        if self._convert_thread is not None:
+            self._convert_thread.join(timeout=5)
+            self._convert_thread = None
+            self._dev_queue = None
         if self._thread is not None:
             self._thread.join(timeout=5)
         self._thread = None
@@ -205,8 +304,17 @@ def batch(reader, batch_size):
 
 
 def double_buffer(reader, place=None, name=None):
-    """Device prefetch is provided by the queue + async dispatch; identity
-    for API parity (reference layers/io.py:891)."""
+    """Stage batches on device one step ahead (reference layers/io.py:891,
+    create_double_buffer_reader_op.cc): a prefetch thread pads LoD slots
+    and ``device_put``s every slot, so the host->device transfer of batch
+    N+1 overlaps device execution of step N.  Takes effect at the
+    reader's next ``start()``."""
+    feeder = get_reader_feeder(reader.name)
+    if feeder is not None:
+        if place is None:
+            place = core.TPUPlace() if core.is_compiled_with_tpu() \
+                else core.CPUPlace()
+        feeder._double_buffer_place = place
     return reader
 
 

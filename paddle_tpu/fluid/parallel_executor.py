@@ -114,7 +114,10 @@ class _SpmdCompiledBlock(_CompiledBlock):
         for n, v in feed_values.items():
             if isinstance(v, core.LoDTensor):
                 v = v.numpy()
-            feeds[n] = jax.device_put(np.asarray(v), self._feed_shardings[n])
+            if not isinstance(v, jax.Array):
+                v = np.asarray(v)
+            # device arrays (double-buffer prefetch) reshard device-side
+            feeds[n] = jax.device_put(v, self._feed_shardings[n])
         new_state, fetches = self._jit(state_rw, state_ro, feeds, rng_key)
         for name, val in new_state.items():
             scope.var(name).set_value(val)

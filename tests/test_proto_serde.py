@@ -301,3 +301,40 @@ def test_inference_model_file_is_pure_program_desc(tmp_path):
                        feed={'x': np.ones((2, 4), np.float32)},
                        fetch_list=fetches)
         assert np.allclose(np.sum(out, axis=1), 1.0, atol=1e-5)
+
+
+def test_combined_params_inference_roundtrip(tmp_path):
+    """Combined param streams are order-addressed: saving from the
+    TRAINING program (optimizer accumulators interleaved) while loading
+    in the pruned program's order would misassign same-shaped streams —
+    save must walk the pruned program (reference io.py:633)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[6], dtype='float32')
+        h = fluid.layers.fc(input=x, size=6, act='tanh')
+        y = fluid.layers.fc(input=h, size=6, act='softmax')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(y, label))
+        test_prog = main.clone(for_test=True)  # before minimize: no updates
+        # Momentum accumulators have the exact shape/dtype of their params
+        fluid.optimizer.Momentum(learning_rate=0.1,
+                                 momentum=0.9).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(5)
+    feed = {'x': rng.standard_normal((4, 6)).astype('float32'),
+            'label': rng.randint(0, 6, (4, 1)).astype('int64')}
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        want, = exe.run(test_prog, feed=feed, fetch_list=[y])
+        fluid.io.save_inference_model(str(tmp_path), ['x'], [y], exe,
+                                      main_program=main,
+                                      params_filename='params.bin')
+    fresh = fluid.core.Scope()
+    with fluid.scope_guard(fresh):
+        prog, feeds, fetches = fluid.io.load_inference_model(
+            str(tmp_path), exe, params_filename='params.bin')
+        got, = exe.run(prog, feed={'x': feed['x']}, fetch_list=fetches)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
