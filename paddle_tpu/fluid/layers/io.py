@@ -152,7 +152,7 @@ class _PyReaderFeeder(object):
         from ..executor import _lod_to_padded
         dev = self._double_buffer_place.jax_device()
         out = []
-        for slot, lod in zip(item, self.lod_levels):
+        for slot in item:
             if isinstance(slot, core.LoDTensor) and slot.lod():
                 padded, lengths = _lod_to_padded(slot)
                 out.append(
@@ -168,12 +168,20 @@ class _PyReaderFeeder(object):
     def _start_zero_copy_pipeline(self, provider):
         import queue as _queue
         self._closed = False
-        end = self._end_sentinel = object()
+        self._generation = getattr(self, '_generation', 0) + 1
+        gen = self._generation
+        end = object()
+        # locals captured by the closures: a thread from a PREVIOUS
+        # generation that outlives reset() keeps touching ITS queues and
+        # can never corrupt the next epoch's state
         ref_q = _queue.Queue(maxsize=max(2, min(int(self.capacity), 8)))
-        self._dev_queue = _queue.Queue(maxsize=2)
+        dev_q = self._dev_queue = _queue.Queue(maxsize=2)
+
+        def _live():
+            return not self._closed and self._generation == gen
 
         def _put(q, item):
-            while not self._closed:
+            while _live():
                 try:
                     q.put(item, timeout=0.1)
                     return True
@@ -181,30 +189,34 @@ class _PyReaderFeeder(object):
                     continue
             return False
 
+        def _record_error(e):
+            if _live():
+                self._error = e
+
         def produce():
             try:
                 for batch in provider():
                     if not _put(ref_q, tuple(batch)):
                         return
             except BaseException as e:
-                self._error = e
+                _record_error(e)
             finally:
                 _put(ref_q, end)
 
         def convert():
             try:
-                while not self._closed:
+                while _live():
                     try:
                         item = ref_q.get(timeout=0.1)
                     except _queue.Empty:
                         continue
                     if item is end:
-                        _put(self._dev_queue, None)
+                        _put(dev_q, None)
                         return
-                    _put(self._dev_queue, self._convert_batch(item))
+                    _put(dev_q, self._convert_batch(item))
             except BaseException as e:
-                self._error = e
-                _put(self._dev_queue, None)
+                _record_error(e)
+                _put(dev_q, None)
 
         self._thread = threading.Thread(target=produce, daemon=True)
         self._convert_thread = threading.Thread(target=convert, daemon=True)

@@ -101,6 +101,10 @@ class Optimizer(object):
             persistable=True,
             dtype=dtype or param.dtype,
             shape=shape)
+        # record the owning param so placement passes (e.g. the sparse
+        # DistributeTranspiler rewrite) can co-locate accumulators with
+        # their param without guessing from names
+        var._accumulator_for = param.name
         self.helper.set_variable_initializer(
             var, initializer=Constant(value=float(fill_value)))
         self._accumulators[name][param.name] = var

@@ -8,9 +8,18 @@ sharded over the mesh, XLA cross-replica sums over ICI — so
 ``get_trainer_program`` returns the original program annotated for
 ParallelExecutor, and multi-host scale-out uses the same program via
 ``jax.distributed`` (rendezvous owned by the TPU runtime, replacing
-gen_nccl_id_op).  The pserver program surface is kept for API parity;
-sparse/CTR models shard their embeddings with
-``paddle_tpu.parallel.shard`` instead of remote prefetch.
+gen_nccl_id_op).  The pserver program surface is kept for API parity.
+
+The SPARSE path keeps the reference's program->program rewrite
+architecture: where the reference replaces ``lookup_table`` ops over a
+distributed table with split_ids -> (send/recv) prefetch -> merge_ids
+(distribute_transpiler.py:939-1090
+``_replace_lookup_table_op_with_prefetch``), ``transpile()`` here walks
+the program, finds every ``lookup_table`` whose ``is_distributed`` attr
+is set, row-shards the table and its optimizer accumulators over the
+mesh, and marks the ops local — GSPMD then lowers the gather into the
+exact all-to-all/all-gather exchange the pserver prefetch implemented
+by hand, riding ICI instead of gRPC.
 """
 
 from ..framework import default_main_program, Program
@@ -24,6 +33,8 @@ class DistributeTranspilerConfig(object):
     slice_var_up = True
     split_method = None
     min_block_size = 8192
+    # mesh axis the distributed lookup tables' rows shard over
+    sparse_shard_axis = 'dp'
 
 
 class DistributeTranspiler(object):
@@ -53,7 +64,22 @@ class DistributeTranspiler(object):
         program._is_distributed = True
         program._trainers = trainers
         program._trainer_id = trainer_id
+        # sparse path: the program rewrite (reference
+        # _replace_lookup_table_op_with_prefetch analog)
+        self.distributed_lookup_tables = _shard_distributed_tables(
+            program, self.config.sparse_shard_axis)
+        if startup_program is not None:
+            _shard_distributed_tables(
+                startup_program, self.config.sparse_shard_axis,
+                only_names=set(self.distributed_lookup_tables))
         self._transpiled = True
+
+    @property
+    def has_distributed_lookup_table(self):
+        """(reference distribute_transpiler.py has_distributed_lookup_table)"""
+        if not self._transpiled:
+            raise RuntimeError('call transpile() first')
+        return bool(self.distributed_lookup_tables)
 
     def get_trainer_program(self):
         """The SPMD trainer program IS the original program: run it with
@@ -82,3 +108,47 @@ class DistributeTranspiler(object):
 
     def get_startup_program(self, endpoint, pserver_program=None):
         return Program()
+
+
+def _shard_distributed_tables(program, axis, only_names=None):
+    """Row-shard every ``lookup_table(is_distributed=True)`` table (and
+    its optimizer accumulators) over ``axis``.
+
+    This is the TPU shape of the reference's sparse rewrite: the table
+    never lives whole on one device; the lookup's gather crosses the
+    mesh via compiler-inserted collectives, and the sparse
+    SelectedRows-gradient update runs against the local rows.
+    Returns the sorted table names."""
+    from ...parallel.api import shard, sharding_of, PartitionSpec
+
+    if only_names is not None:
+        # a startup program carries the same table VARS but no
+        # lookup_table ops — the caller names the tables to annotate
+        tables = set(only_names)
+    else:
+        tables = set()
+        for block in program.blocks:
+            for op in block.ops:
+                if op.type not in ('lookup_table', 'lookup_table_grad'):
+                    continue
+                if not op.attrs.get('is_distributed'):
+                    continue
+                # the rewrite happened here; no remote prefetch remains
+                op.attrs['remote_prefetch'] = False
+                tables.add(op.input('W')[0])
+    for block in program.blocks:
+        for name in tables:
+            w = block._find_var_recursive(name)
+            if w is not None and sharding_of(w) is None:
+                shard(w, PartitionSpec(axis, None))
+        # optimizer accumulators co-locate with their table: exact
+        # ownership is recorded at creation (Optimizer._add_accumulator
+        # tags vars), never guessed from names
+        for v in block.vars.values():
+            if (getattr(v, '_accumulator_for', None) in tables
+                    and len(v.shape or ()) >= 2
+                    and sharding_of(v) is None):
+                shard(v, PartitionSpec(axis, None))
+    if only_names is None:
+        program._distributed_lookup_tables = sorted(tables)
+    return sorted(tables)

@@ -16,7 +16,7 @@ __all__ = ['build']
 
 
 def build(sparse_dim=None, embed_size=16, hidden_sizes=(64, 32),
-          lr=0.01, is_sparse=True, optimizer=None):
+          lr=0.01, is_sparse=True, is_distributed=False, optimizer=None):
     sparse_dim = sparse_dim or ctr_data.SPARSE_DIM
     main = fluid.Program()
     startup = fluid.Program()
@@ -32,6 +32,7 @@ def build(sparse_dim=None, embed_size=16, hidden_sizes=(64, 32),
             input=sparse_ids,
             size=[sparse_dim, embed_size],
             is_sparse=is_sparse,
+            is_distributed=is_distributed,
             param_attr=fluid.ParamAttr(name='ctr_embedding'),
             dtype='float32')
         embed_flat = fluid.layers.reshape(

@@ -283,3 +283,66 @@ def test_spmd_row_sharded_embedding():
                 batch = []
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_distribute_transpiler_sparse_rewrite():
+    """DistributeTranspiler's sparse pass (the TPU analog of the
+    reference's _replace_lookup_table_op_with_prefetch program rewrite,
+    distribute_transpiler.py:939-1090): lookup_table(is_distributed=True)
+    tables and their optimizer accumulators row-shard over the mesh, and
+    a training step runs with the table genuinely distributed."""
+    from paddle_tpu import parallel
+    from paddle_tpu.models import ctr
+    from paddle_tpu.dataset import ctr as ctr_data
+
+    m = ctr.build(sparse_dim=512, embed_size=8, hidden_sizes=(16, ),
+                  is_sparse=True, is_distributed=True)
+    t = fluid.DistributeTranspiler()
+    t.transpile(0, program=m['main'], startup_program=m['startup'],
+                trainers=1)
+    assert t.has_distributed_lookup_table
+    assert t.distributed_lookup_tables == ['ctr_embedding']
+    blk = m['main'].global_block()
+    spec = parallel.sharding_of(blk.var('ctr_embedding'))
+    assert tuple(spec) == ('dp', None), spec
+    # the Adam moments of the table shard with it
+    moment_specs = [
+        parallel.sharding_of(v) for v in blk.vars.values()
+        if v.name.startswith('ctr_embedding_') and v.persistable
+        and len(v.shape or ()) == 2 and (v.shape or (0, ))[0] == 512
+    ]
+    assert moment_specs and all(
+        s is not None and tuple(s) == ('dp', None) for s in moment_specs), \
+        moment_specs
+    # dense params stay unannotated (replicated)
+    dense_param = next(p for p in m['main'].all_parameters()
+                       if p.name != 'ctr_embedding')
+    assert parallel.sharding_of(dense_param) is None
+    # and no lookup op still asks for remote prefetch
+    for op in blk.ops:
+        if op.type == 'lookup_table':
+            assert op.attrs.get('remote_prefetch') is False
+
+    mesh = parallel.make_mesh({'dp': 8})
+    scope = fluid.core.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(m['startup'])
+        pe = fluid.ParallelExecutor(
+            loss_name=m['loss'].name, main_program=t.get_trainer_program(),
+            scope=scope, mesh=mesh)
+        feed = {'dense': rng.standard_normal(
+                    (16, ctr_data.DENSE_DIM)).astype('float32'),
+                'sparse_ids': rng.randint(
+                    0, 512, (16, ctr_data.SPARSE_SLOTS)).astype('int64'),
+                'label': rng.randint(0, 2, (16, 1)).astype('int64')}
+        losses = []
+        for _ in range(6):
+            lv, = pe.run([m['loss'].name], feed=feed)
+            losses.append(float(np.asarray(lv).flatten()[0]))
+        table = scope.find_var('ctr_embedding').value()
+        assert hasattr(table, 'sharding') and \
+            not table.sharding.is_fully_replicated
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], losses
