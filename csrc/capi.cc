@@ -196,4 +196,52 @@ int64_t ptc_get_output_data(void* h, int i, char* buf, uint64_t cap) {
   return static_cast<int64_t>(len);
 }
 
+// ---- training (reference train/demo/demo_trainer.cc: a C/C++ program
+// drives the full train loop — load programs, init params, step) ----
+
+void* ptc_trainer_create(const char* model_dir) {
+  Gil gil;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.capi_bridge");
+  if (!mod) {
+    PyErr_Print();
+    return nullptr;
+  }
+  PyObject* tr = PyObject_CallMethod(mod, "create_trainer", "s", model_dir);
+  Py_DECREF(mod);
+  if (!tr) {
+    PyErr_Print();
+    return nullptr;
+  }
+  Predictor* p = new Predictor();  // same handle shape: one PyObject
+  p->obj = tr;
+  return p;
+}
+
+void ptc_trainer_destroy(void* h) { ptc_predictor_destroy(h); }
+
+int ptc_trainer_set_input(void* h, const char* name, const char* data,
+                          uint64_t byte_len, const int64_t* shape, int ndim,
+                          int dtype) {
+  return ptc_set_input(h, name, data, byte_len, shape, ndim, dtype);
+}
+
+// one training step; the scalar loss lands in *loss_out
+int ptc_trainer_step(void* h, double* loss_out) {
+  Predictor* p = static_cast<Predictor*>(h);
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(p->obj, "step", nullptr);
+  if (!r) {
+    PyErr_Print();
+    return -1;
+  }
+  double v = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  if (v == -1.0 && PyErr_Occurred()) {
+    PyErr_Print();
+    return -1;
+  }
+  if (loss_out) *loss_out = v;
+  return 0;
+}
+
 }  // extern "C"

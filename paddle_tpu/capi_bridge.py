@@ -49,3 +49,48 @@ class CApiPredictor(object):
 
 def create(model_dir):
     return CApiPredictor(model_dir)
+
+
+class CApiTrainer(object):
+    """C-side TRAINING loop (reference train/demo/demo_trainer.cc: load
+    serialized startup/main ProgramDesc files, find the mean op's output
+    as the loss, run the startup program, then step the train program).
+    The program files are the framework.proto bytes the reference demo
+    reads — full contract parity."""
+
+    def __init__(self, model_dir):
+        import os
+        with open(os.path.join(model_dir, 'main_program'), 'rb') as f:
+            self._main = fluid.Program.parse_from_string(f.read())
+        with open(os.path.join(model_dir, 'startup_program'), 'rb') as f:
+            startup = fluid.Program.parse_from_string(f.read())
+        self._loss_name = None
+        for op in self._main.global_block().ops:
+            if op.type == 'mean':
+                self._loss_name = op.output('Out')[0]
+                break
+        if self._loss_name is None:
+            raise RuntimeError('loss (mean op) not found in main program')
+        place = fluid.TPUPlace() if fluid.core.is_compiled_with_tpu() \
+            else fluid.CPUPlace()
+        self._scope = fluid.core.Scope()
+        self._exe = fluid.Executor(place)
+        with fluid.scope_guard(self._scope):
+            self._exe.run(startup)
+        self._inputs = {}
+
+    def set_input(self, name, data, shape, dtype_code):
+        arr = np.frombuffer(data, dtype=_DTYPES[int(dtype_code)]).reshape(
+            [int(s) for s in shape])
+        self._inputs[name] = arr
+
+    def step(self):
+        """One training step; returns the scalar loss."""
+        with fluid.scope_guard(self._scope):
+            v, = self._exe.run(self._main, feed=dict(self._inputs),
+                               fetch_list=[self._loss_name])
+        return float(np.asarray(v).flatten()[0])
+
+
+def create_trainer(model_dir):
+    return CApiTrainer(model_dir)

@@ -29,6 +29,8 @@ __all__ = [
     'hsigmoid', 'nce', 'multiplex', 'dropout', 'layer_norm', 'lstm_unit',
     'linear_chain_crf', 'crf_decoding', 'cos_sim', 'flash_attention',
     'warpctc', 'ctc_greedy_decoder', 'edit_distance', 'roi_pool',
+    'conv3d_transpose', 'crop', 'dice_loss', 'image_resize_short',
+    'lod_reset', 'mean_iou', 'pad_constant_like', 'rank_loss',
 ]
 
 
@@ -260,9 +262,10 @@ def conv2d_transpose(input,
     n, c, h, w_ = input.shape
     if filter_size is None:
         output_size = _pair(output_size)
+        # reference conv2d_transpose: k = (out + 2p - (in-1)s - 1)//d + 1
         filter_size = [
-            output_size[0] - (h - 1) * stride[0] + 2 * padding[0],
-            output_size[1] - (w_ - 1) * stride[1] + 2 * padding[1]
+            (output_size[i] + 2 * padding[i] - (s - 1) * stride[i] - 1) //
+            dilation[i] + 1 for i, s in enumerate((h, w_))
         ]
     else:
         filter_size = _pair(filter_size)
@@ -1537,4 +1540,198 @@ def roi_pool(input, rois, pooled_height=1, pooled_width=1,
             'pooled_width': pooled_width,
             'spatial_scale': spatial_scale
         })
+    return out
+
+
+def conv3d_transpose(input,
+                     num_filters,
+                     output_size=None,
+                     filter_size=None,
+                     padding=0,
+                     stride=1,
+                     dilation=1,
+                     groups=None,
+                     param_attr=None,
+                     bias_attr=None,
+                     use_cudnn=True,
+                     act=None,
+                     name=None):
+    """Transposed 3D convolution (reference nn.py:2426 conv3d_transpose;
+    operators/conv_transpose_op.cc)."""
+
+    def _triple(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * 3
+
+    helper = LayerHelper('conv3d_transpose', **locals())
+    dtype = helper.input_dtype()
+    num_channels = input.shape[1]
+    groups = groups or 1
+    stride = _triple(stride)
+    padding = _triple(padding)
+    dilation = _triple(dilation)
+    n, c, d, h, w_ = input.shape
+    if filter_size is None:
+        output_size = _triple(output_size)
+        # reference conv3d_transpose: k = (out + 2p - (in-1)s - 1)//d + 1
+        filter_size = [
+            (output_size[i] + 2 * padding[i] - (s - 1) * stride[i] - 1) //
+            dilation[i] + 1 for i, s in enumerate((d, h, w_))
+        ]
+    else:
+        filter_size = _triple(filter_size)
+    filter_shape = [num_channels, num_filters // groups] + filter_size
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype)
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    out_spatial = [
+        (s - 1) * stride[i] - 2 * padding[i] + dilation[i] *
+        (filter_size[i] - 1) + 1 for i, s in enumerate((d, h, w_))
+    ]
+    pre_bias.shape = tuple([n, num_filters] + out_spatial)
+    helper.append_op(
+        type='conv3d_transpose',
+        inputs={'Input': [input],
+                'Filter': [w]},
+        outputs={'Output': [pre_bias]},
+        attrs={
+            'strides': stride,
+            'paddings': padding,
+            'dilations': dilation,
+            'groups': groups
+        })
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Crop x to ``shape`` starting at ``offsets`` (reference nn.py:5453;
+    operators/crop_op.cc).  ``shape`` may be a Variable whose dims give
+    the target shape."""
+    helper = LayerHelper('crop', **locals())
+    inputs = {'X': [x]}
+    attrs = {}
+    if isinstance(shape, Variable):
+        inputs['Y'] = [shape]
+        out_shape = shape.shape
+    else:
+        attrs['shape'] = list(shape)
+        out_shape = tuple(shape)
+    if offsets is None:
+        offsets = [0] * len(x.shape)
+    attrs['offsets'] = list(offsets)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = tuple(out_shape)
+    helper.append_op(
+        type='crop', inputs=inputs, outputs={'Out': [out]}, attrs=attrs)
+    return out
+
+
+def dice_loss(input, label, epsilon=0.00001):
+    """Dice loss for binary segmentation (reference nn.py:5032): a pure
+    composition — one_hot the labels, per-sample intersection and area
+    sums over every non-batch dim, 1 - 2I/(A + eps), batch mean."""
+    label = one_hot(label, depth=input.shape[-1])
+    reduce_dim = list(range(1, len(input.shape)))
+    inse = reduce_sum(input * label, dim=reduce_dim)
+    dice_denominator = reduce_sum(input, dim=reduce_dim) + reduce_sum(
+        label, dim=reduce_dim)
+    dice_score = 1 - inse * 2 / (dice_denominator + epsilon)
+    return reduce_mean(dice_score)
+
+
+def image_resize_short(input, out_short_len, resample='BILINEAR'):
+    """Resize so the short image edge equals out_short_len, keeping the
+    aspect ratio (reference nn.py:5175)."""
+    in_shape = input.shape
+    if len(in_shape) != 4:
+        raise ValueError(
+            'The rank of input must be 4 (num_batches, channels, in_h, '
+            'in_w).')
+    hw = list(in_shape[2:4])
+    short_idx = hw.index(min(hw))
+    long_idx = 1 - short_idx
+    out_shape = list(hw)
+    out_shape[short_idx] = out_short_len
+    out_shape[long_idx] = int(
+        float(out_shape[long_idx]) *
+        (float(out_short_len) / float(hw[short_idx])) + 0.5)
+    return image_resize(input=input, out_shape=out_shape, resample=resample)
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """Re-assign x's LoD from y or target_lod (reference nn.py:4625;
+    operators/lod_reset_op.cc).  Under the padded+SEQLEN lowering the
+    dense payload is unchanged; the new lengths ride the side-band."""
+    helper = LayerHelper('lod_reset', **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = tuple(x.shape)
+    out.lod_level = 1
+    if y is not None:
+        helper.append_op(
+            type='lod_reset', inputs={'X': [x], 'Y': [y]},
+            outputs={'Out': [out]})
+    elif target_lod is not None:
+        helper.append_op(
+            type='lod_reset', inputs={'X': [x]},
+            outputs={'Out': [out]},
+            attrs={'target_lod': [int(v) for v in target_lod]})
+    else:
+        raise ValueError('lod_reset: y and target_lod cannot both be None')
+    return out
+
+
+def mean_iou(input, label, num_classes):
+    """Mean intersection-over-union (reference nn.py:5403;
+    operators/mean_iou_op.cc).  Returns (mean_iou, out_wrong,
+    out_correct)."""
+    helper = LayerHelper('mean_iou', **locals())
+    iou = helper.create_variable_for_type_inference('float32')
+    out_wrong = helper.create_variable_for_type_inference('int32')
+    out_correct = helper.create_variable_for_type_inference('int32')
+    for v in (iou, out_wrong, out_correct):
+        v.shape = (1, )
+        v.stop_gradient = True
+    helper.append_op(
+        type='mean_iou',
+        inputs={'Predictions': [input],
+                'Labels': [label]},
+        outputs={
+            'OutMeanIou': [iou],
+            'OutWrong': [out_wrong],
+            'OutCorrect': [out_correct]
+        },
+        attrs={'num_classes': num_classes})
+    return iou, out_wrong, out_correct
+
+
+def pad_constant_like(x, y, pad_value=0., name=None):
+    """Pad y with pad_value so its shape matches x (reference nn.py:4849;
+    operators/pad_constant_like_op.cc)."""
+    helper = LayerHelper('pad_constant_like', **locals())
+    out = helper.create_variable_for_type_inference(y.dtype)
+    out.shape = tuple(x.shape)
+    helper.append_op(
+        type='pad_constant_like',
+        inputs={'X': [x],
+                'Y': [y]},
+        outputs={'Out': [out]},
+        attrs={'pad_value': float(pad_value)})
+    return out
+
+
+def rank_loss(label, left, right, name=None):
+    """RankNet pairwise loss (reference nn.py:5551;
+    operators/rank_loss_op.cc)."""
+    helper = LayerHelper('rank_loss', **locals())
+    for v, n in ((label, 'label'), (left, 'left'), (right, 'right')):
+        if not isinstance(v, Variable):
+            raise ValueError('rank_loss: %s must be a Variable' % n)
+    out = helper.create_variable_for_type_inference('float32')
+    out.shape = tuple(left.shape)
+    helper.append_op(
+        type='rank_loss',
+        inputs={'Label': [label],
+                'Left': [left],
+                'Right': [right]},
+        outputs={'Out': [out]})
     return out

@@ -237,23 +237,16 @@ def _max_pool3d_with_index(ctx, op):
 
 @register_lowering('conv3d_transpose')
 def _conv3d_transpose(ctx, op):
+    from .nn_ops import grouped_conv_transpose
     x = ctx.get(op, 'Input')
-    w = ctx.get(op, 'Filter')  # (C_in, C_out, kd, kh, kw)
+    w = ctx.get(op, 'Filter')  # (C_in, C_out/groups, kd, kh, kw)
     strides = list(op.attrs.get('strides', [1, 1, 1]))
     paddings = list(op.attrs.get('paddings', [0, 0, 0]))
     dilations = list(op.attrs.get('dilations', [1, 1, 1]))
-    if (op.attrs.get('groups', 1) or 1) != 1:
-        raise NotImplementedError(
-            'conv3d_transpose: grouped deconvolution is not lowered; the '
-            'reference kernel supports it (conv_transpose_op.cc)')
+    groups = op.attrs.get('groups', 1) or 1
     x, w = amp_cast_in(x, w)
-    out = jax.lax.conv_transpose(
-        x, jnp.swapaxes(w, 0, 1),
-        strides=strides,
-        padding=[(p, p) for p in paddings],
-        rhs_dilation=dilations,
-        dimension_numbers=('NCDHW', 'IODHW', 'NCDHW'),
-        transpose_kernel=True)
+    out = grouped_conv_transpose(x, w, strides, paddings, dilations, groups,
+                                 ('NCDHW', 'IODHW', 'NCDHW'))
     ctx.set(op, 'Output', amp_cast_out(out))
 
 

@@ -64,6 +64,36 @@ def _depthwise_conv2d(ctx, op):
     ctx.set(op, 'Output', amp_cast_out(out))
 
 
+def grouped_conv_transpose(x, w, strides, paddings, dilations, groups, dn):
+    """Transpose conv as a fractionally-strided forward conv
+    (conv_general_dilated with lhs_dilation=strides, kernel flipped;
+    the reference col2im path computes the same map,
+    conv_transpose_op.h).  Groups run as per-group slices, concatenated.
+    w layout: (C_in, C_out/groups, *k); output spatial size is
+    (in-1)*s - 2p + d*(k-1) + 1."""
+    nd = len(strides)
+    spatial = tuple(range(2, 2 + nd))
+    k_eff = [d * (int(w.shape[2 + i]) - 1) + 1
+             for i, d in enumerate(dilations)]
+    pad = [(k_eff[i] - 1 - paddings[i], k_eff[i] - 1 - paddings[i])
+           for i in range(nd)]
+
+    def one(xi, wi):
+        return jax.lax.conv_general_dilated(
+            xi, jnp.flip(wi, spatial),
+            window_strides=(1, ) * nd,
+            padding=pad,
+            lhs_dilation=list(strides),
+            rhs_dilation=list(dilations),
+            dimension_numbers=dn)
+
+    if groups == 1:
+        return one(x, w)
+    xs = jnp.split(x, groups, axis=1)
+    ws = jnp.split(w, groups, axis=0)
+    return jnp.concatenate([one(xi, wi) for xi, wi in zip(xs, ws)], axis=1)
+
+
 @register_lowering('conv2d_transpose')
 def _conv2d_transpose(ctx, op):
     x = ctx.get(op, 'Input')
@@ -74,13 +104,8 @@ def _conv2d_transpose(ctx, op):
     groups = op.attrs.get('groups', 1) or 1
     x, w = amp_cast_in(x, w)
     # gradient-of-conv formulation (matches the reference's col2im path)
-    out = jax.lax.conv_transpose(
-        x, jnp.swapaxes(w, 0, 1) if groups == 1 else w,
-        strides=strides,
-        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
-        rhs_dilation=dilations,
-        dimension_numbers=('NCHW', 'IOHW', 'NCHW'),
-        transpose_kernel=True)
+    out = grouped_conv_transpose(x, w, strides, paddings, dilations, groups,
+                                 ('NCHW', 'IOHW', 'NCHW'))
     ctx.set(op, 'Output', amp_cast_out(out))
 
 

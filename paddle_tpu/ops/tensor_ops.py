@@ -431,9 +431,62 @@ def _random_crop(ctx, op):
 
 @register_lowering('lod_reset')
 def _lod_reset(ctx, op):
-    # LoD metadata is carried outside the traced values (§5.7 lowering);
-    # the dense payload passes through unchanged.
-    ctx.set(op, 'Out', ctx.get(op, 'X'))
+    """Reference lod_reset_op.cc: keep the flat payload, replace the LoD.
+    Under the padded+SEQLEN lowering a re-segmentation is a RE-LAYOUT:
+    the flat rows move from the old [B, T, ...] padding to a new
+    [B', T', ...] one.  The new offsets must be concrete (attr
+    target_lod, or a non-sequence Y whose values are known offsets via a
+    concrete fill) — the new batch/bucket sizes are shapes."""
+    from .registry import SEQLEN_SUFFIX
+    x = ctx.get(op, 'X')
+    out_name = op.output('Out')[0]
+    offsets = None
+    if op.attrs.get('target_lod'):
+        offsets = np.asarray(op.attrs['target_lod'], np.int64)
+    elif op.input('Y'):
+        y_name = op.input('Y')[0]
+        conc = ctx.concrete.get(y_name)
+        if conc is not None:
+            offsets = np.asarray(conc, np.int64).reshape(-1)
+        elif (y_name + SEQLEN_SUFFIX) in ctx.env:
+            # Y is itself a padded sequence: adopt its layout lengths —
+            # same flat count, so the payload layout already agrees when
+            # both paddings bucket alike; re-layout below needs concrete
+            # offsets, which a traced Y cannot give
+            raise NotImplementedError(
+                'lod_reset from a traced sequence Y would make the new '
+                'padding data-dependent; pass target_lod or a concrete Y')
+    if offsets is None:
+        raise ValueError('lod_reset needs Y or target_lod')
+    new_lens = offsets[1:] - offsets[:-1]
+    b2 = len(new_lens)
+    t2 = int(max(((int(new_lens.max()) + 15) // 16) * 16, 16)) if b2 else 16
+
+    in_lens = ctx.env.get(op.input('X')[0] + SEQLEN_SUFFIX)
+    feat = x.shape[2:] if in_lens is not None else x.shape[1:]
+    # flat index each output slot reads: n = offsets[b2] + t2 (concrete)
+    n_grid = offsets[:-1, None] + np.arange(t2)[None, :]
+    valid = np.arange(t2)[None, :] < new_lens[:, None]
+    n_flat = jnp.asarray(np.where(valid, n_grid, 0))
+    if in_lens is None:
+        # x is flat [N, ...]
+        out = jnp.take(x, n_flat.reshape(-1), axis=0)
+    else:
+        # x is padded [B, T, ...]: flat n lives at row r, col n-start[r]
+        in_lens = in_lens.astype(jnp.int32)
+        cum = jnp.cumsum(in_lens)
+        starts = cum - in_lens
+        n1 = n_flat.reshape(-1)
+        r = jnp.searchsorted(cum, n1, side='right').astype(jnp.int32)
+        r = jnp.clip(r, 0, x.shape[0] - 1)
+        c = (n1 - jnp.take(starts, r)).astype(jnp.int32)
+        c = jnp.clip(c, 0, x.shape[1] - 1)
+        out = x[r, c]
+    out = out.reshape((b2, t2) + feat)
+    mask = jnp.asarray(valid).reshape((b2, t2) + (1, ) * len(feat))
+    out = jnp.where(mask, out, jnp.zeros_like(out))
+    ctx.store(out_name, out)
+    ctx.env[out_name + SEQLEN_SUFFIX] = jnp.asarray(new_lens, jnp.int32)
 
 
 @register_lowering('increment')

@@ -18,6 +18,10 @@ __all__ = [
     'data', 'fc', 'embedding', 'img_conv', 'img_pool', 'dropout', 'concat',
     'addto', 'classification_cost', 'cross_entropy_cost', 'mse_cost',
     'square_error_cost', 'pooling', 'lstmemory_like', 'batch_norm',
+    'memory', 'recurrent_group', 'StaticInput', 'last_seq', 'first_seq',
+    'max_id', 'trans', 'scaling', 'slope_intercept', 'sum_cost',
+    'rank_cost', 'smooth_l1_cost', 'huber_regression_cost',
+    'multi_binary_label_cross_entropy_cost', 'lstmemory', 'gru_like',
 ]
 
 
@@ -225,3 +229,228 @@ def parse_network(*outputs):
     for out in outputs:
         walk(out)
     return seen
+
+
+# ----------------------------------------------------------------------------
+# recurrent group DSL (reference layer.py recurrent_group/memory — the v2
+# step-function API over the legacy RecurrentGradientMachine; here the
+# step builds inside a fluid DynamicRNN block, one masked lax.scan)
+# ----------------------------------------------------------------------------
+class StaticInput(object):
+    """Whole-sequence input visible at every step (reference
+    layer.py StaticInput)."""
+
+    def __init__(self, input):
+        self.input = input
+
+
+class _MemoryLayer(Layer):
+    """Recurrent state: reads last step's value of the layer named
+    ``name``; ``size`` fixes the state width, ``boot_layer`` its init."""
+
+    def __init__(self, name, size, boot_layer=None):
+        self.link_name = name
+        self.boot_layer = boot_layer
+
+        def build(ctx):
+            rnn = ctx.get('__rnn__')
+            if rnn is None:
+                raise RuntimeError(
+                    'memory() is only meaningful inside recurrent_group')
+            if self.boot_layer is not None:
+                boot_var = self.boot_layer.to_fluid(ctx)
+                mem = rnn.memory(init=boot_var)
+            else:
+                mem = rnn.memory(shape=[size], value=0.0)
+            ctx.setdefault('__pending_memories__', []).append(
+                (mem, self.link_name))
+            return mem
+
+        super(_MemoryLayer, self).__init__(
+            'memory', [boot_layer] if boot_layer is not None else [],
+            lambda ctx, *pv: build(ctx), size=size)
+
+
+def memory(name, size, boot_layer=None, **kwargs):
+    return _MemoryLayer(name, size, boot_layer)
+
+
+def _wrap_fluid_var(ctx, var, kind='step_input'):
+    layer = Layer(kind, [], lambda _ctx: var)
+    ctx[layer.name] = var
+    return layer
+
+
+def recurrent_group(step, input, name=None, **kwargs):
+    """Run ``step`` per timestep over sequence inputs (reference
+    layer.py:3317 recurrent_group).  ``step`` receives one Layer per
+    input (StaticInput wraps whole-sequence inputs) and returns the
+    step's output layer; ``memory(name=N)`` inside the step reads the
+    previous step's value of the layer named N."""
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    seq_parents = [i.input if isinstance(i, StaticInput) else i
+                   for i in inputs]
+
+    def build(ctx, *parent_vars):
+        rnn = fluid.layers.DynamicRNN()
+        outer_rnn = ctx.get('__rnn__')
+        outer_pending = ctx.pop('__pending_memories__', None)
+        ctx['__rnn__'] = rnn
+        with rnn.block():
+            step_layers = []
+            for spec, var in zip(inputs, parent_vars):
+                if isinstance(spec, StaticInput):
+                    step_layers.append(
+                        _wrap_fluid_var(ctx, rnn.static_input(var),
+                                        'static_input'))
+                else:
+                    step_layers.append(
+                        _wrap_fluid_var(ctx, rnn.step_input(var)))
+            out_layer = step(*step_layers)
+            out_var = out_layer.to_fluid(ctx)
+            for mem_var, link_name in ctx.pop('__pending_memories__', []):
+                target = ctx.get(link_name)
+                if target is None:
+                    raise RuntimeError(
+                        'memory(name=%r): no step layer with that name '
+                        'was built' % link_name)
+                rnn.update_memory(mem_var, target)
+            rnn.output(out_var)
+        if outer_rnn is not None:
+            ctx['__rnn__'] = outer_rnn
+        else:
+            ctx.pop('__rnn__', None)
+        if outer_pending is not None:
+            ctx['__pending_memories__'] = outer_pending
+        return rnn()
+
+    layer = Layer('recurrent_group', seq_parents, build, name=name)
+    return layer
+
+
+def lstmemory(input, size=None, name=None, **kwargs):
+    """LSTM over a pre-projected [*, 4D] sequence (reference layer.py
+    lstmemory: input must already be width 4*size)."""
+
+    def build(ctx, parent_var):
+        width = size or (input.size // 4 if input.size else None)
+        hidden, _ = fluid.layers.dynamic_lstm(parent_var, size=width * 4)
+        return hidden
+
+    return Layer('lstmemory', [input], build, name=name, size=size)
+
+
+def gru_like(input, size, name=None, **kwargs):
+    """GRU block: gate projection + dynamic_gru (reference networks.py
+    simple_gru)."""
+
+    def build(ctx, parent_var):
+        proj = fluid.layers.fc(parent_var, size=size * 3)
+        return fluid.layers.dynamic_gru(proj, size=size)
+
+    return Layer('gru', [input], build, name=name, size=size)
+
+
+# ---- sequence/shape layers ----
+def last_seq(input, name=None, **kwargs):
+    def build(ctx, parent_var):
+        return fluid.layers.sequence_last_step(parent_var)
+
+    return Layer('last_seq', [input], build, name=name, size=input.size)
+
+
+def first_seq(input, name=None, **kwargs):
+    def build(ctx, parent_var):
+        return fluid.layers.sequence_first_step(parent_var)
+
+    return Layer('first_seq', [input], build, name=name, size=input.size)
+
+
+def max_id(input, name=None, **kwargs):
+    """Argmax over the feature dim (reference layer.py maxid_layer)."""
+
+    def build(ctx, parent_var):
+        _, idx = fluid.layers.topk(parent_var, k=1)
+        return idx
+
+    return Layer('max_id', [input], build, name=name, size=1)
+
+
+def trans(input, name=None, **kwargs):
+    def build(ctx, parent_var):
+        return fluid.layers.transpose(
+            parent_var, perm=[1, 0])
+
+    return Layer('trans', [input], build, name=name)
+
+
+def scaling(input, weight, name=None, **kwargs):
+    """Row-wise scale: out[i] = weight[i] * input[i] (reference
+    scaling_layer)."""
+
+    def build(ctx, input_var, weight_var):
+        return fluid.layers.elementwise_mul(input_var, weight_var, axis=0)
+
+    return Layer('scaling', [input, weight], build, name=name,
+                 size=input.size)
+
+
+def slope_intercept(input, slope=1.0, intercept=0.0, name=None, **kwargs):
+    def build(ctx, parent_var):
+        return fluid.layers.scale(
+            parent_var, scale=float(slope), bias=float(intercept))
+
+    return Layer('slope_intercept', [input], build, name=name,
+                 size=input.size)
+
+
+# ---- cost layers (reference layer.py cost family) ----
+def _cost_layer(kind, parents, build, name, prediction=None):
+    layer = Layer(kind, parents, build, name=name)
+    layer.is_cost = True
+    if prediction is not None:
+        layer.prediction_parent = prediction
+    return layer
+
+
+def sum_cost(input, name=None, **kwargs):
+    def build(ctx, parent_var):
+        return fluid.layers.reduce_sum(parent_var)
+
+    return _cost_layer('sum_cost', [input], build, name, prediction=input)
+
+
+def rank_cost(left, right, label, name=None, **kwargs):
+    """RankNet pairwise cost (reference layer.py rank_cost)."""
+
+    def build(ctx, left_var, right_var, label_var):
+        return fluid.layers.mean(
+            fluid.layers.rank_loss(label_var, left_var, right_var))
+
+    return _cost_layer('rank_cost', [left, right, label], build, name)
+
+
+def smooth_l1_cost(input, label, name=None, **kwargs):
+    def build(ctx, input_var, label_var):
+        return fluid.layers.mean(
+            fluid.layers.smooth_l1(input_var, label_var))
+
+    return _cost_layer('smooth_l1_cost', [input, label], build, name,
+                      prediction=input)
+
+
+huber_regression_cost = smooth_l1_cost
+
+
+def multi_binary_label_cross_entropy_cost(input, label, name=None,
+                                          **kwargs):
+    """Per-label sigmoid cross entropy (reference layer.py
+    multi_binary_label_cross_entropy)."""
+
+    def build(ctx, input_var, label_var):
+        ce = fluid.layers.sigmoid_cross_entropy_with_logits(
+            input_var, label_var)
+        return fluid.layers.mean(ce)
+
+    return _cost_layer('multi_binary_label_cross_entropy',
+                       [input, label], build, name, prediction=input)

@@ -126,3 +126,69 @@ def test_capi_standalone_c_program(tmp_path):
     # default precision — compare loosely across devices
     np.testing.assert_allclose(row0, want[0], rtol=5e-2)
     np.testing.assert_allclose(sum(row0), 1.0, rtol=1e-3)
+
+
+def _save_train_programs(model_dir):
+    """fit-a-line training programs serialized as ProgramDesc bytes (what
+    the reference train/demo/demo_trainer.cc loads)."""
+    os.makedirs(model_dir, exist_ok=True)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1, act=None)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    with open(os.path.join(model_dir, 'main_program'), 'wb') as f:
+        f.write(main.serialize_to_string())
+    with open(os.path.join(model_dir, 'startup_program'), 'wb') as f:
+        f.write(startup.serialize_to_string())
+
+
+def test_capi_trainer_bridge(tmp_path):
+    """The trainer bridge drives a full training loop from serialized
+    programs (reference train/demo/demo_trainer.cc flow)."""
+    from paddle_tpu import capi_bridge
+    model_dir = os.path.join(str(tmp_path), 'train_model')
+    _save_train_programs(model_dir)
+    tr = capi_bridge.create_trainer(model_dir)
+    x = (np.arange(26, dtype='float32') / 26.0).reshape(2, 13)
+    y = np.asarray([[0.0], [1.0]], 'float32')
+    tr.set_input('x', x.tobytes(), [2, 13], 0)
+    tr.set_input('y', y.tobytes(), [2, 1], 0)
+    losses = [tr.step() for _ in range(10)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], losses
+
+
+def test_capi_standalone_c_trainer(tmp_path):
+    """Compile and run the pure-C TRAINING demo: a C program that loads
+    ProgramDesc files, initializes params, and steps the optimizer —
+    no Python code of its own (reference train/demo/demo_trainer.cc)."""
+    if not _build_capi():
+        pytest.skip('capi library not buildable here')
+    model_dir = os.path.join(str(tmp_path), 'train_model')
+    _save_train_programs(model_dir)
+
+    demo_bin = os.path.join(str(tmp_path), 'train_demo')
+    ldflags = subprocess.run(
+        'python3-config --ldflags --embed || python3-config --ldflags',
+        shell=True, capture_output=True, text=True).stdout.split()
+    cc = subprocess.run(
+        ['gcc', os.path.join(REPO, 'csrc', 'train_demo.c'),
+         '-o', demo_bin, CAPI_SO] + ldflags,
+        capture_output=True, text=True)
+    if cc.returncode != 0:
+        pytest.skip('cannot link embedded-python demo: %s' % cc.stderr[:200])
+
+    env = dict(os.environ)
+    env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
+    env['LD_LIBRARY_PATH'] = (os.path.dirname(CAPI_SO) + os.pathsep +
+                              env.get('LD_LIBRARY_PATH', ''))
+    env.setdefault('JAX_PLATFORMS', 'cpu')
+    run = subprocess.run([demo_bin, model_dir, REPO, '10'],
+                         capture_output=True, text=True, env=env,
+                         timeout=300)
+    assert run.returncode == 0, (run.stdout[-400:], run.stderr[-800:])
+    assert 'TRAIN_OK' in run.stdout, run.stdout[-400:]

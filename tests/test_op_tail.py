@@ -298,3 +298,206 @@ def test_model_average():
                 averaged, np.mean(snapshots, axis=0), rtol=1e-5)
         restored = np.asarray(scope.find_var(param_name).value())
         np.testing.assert_allclose(restored, live, rtol=1e-6)
+
+
+def test_crop_layer():
+    x = np.arange(24, dtype='float32').reshape(2, 3, 4)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data('x2', [2, 3, 4], append_batch_size=False,
+                               dtype='float32')
+        out = fluid.layers.crop(xv, shape=[2, 2, 2], offsets=[0, 1, 1])
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        got, = exe.run(main, feed={'x2': x}, fetch_list=[out])
+    np.testing.assert_allclose(got, x[0:2, 1:3, 1:3])
+
+
+def test_dice_loss_layer():
+    rng = np.random.RandomState(0)
+    probs = rng.dirichlet(np.ones(4), size=6).astype('float32')
+    label = rng.randint(0, 4, (6, 1)).astype('int64')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        p = fluid.layers.data('p', [4], dtype='float32')
+        l = fluid.layers.data('l', [1], dtype='int64')
+        loss = fluid.layers.dice_loss(p, l)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        got, = exe.run(main, feed={'p': probs, 'l': label},
+                       fetch_list=[loss])
+    onehot = np.eye(4, dtype='float32')[label.ravel()]
+    inse = (probs * onehot).sum(axis=1)
+    denom = probs.sum(axis=1) + onehot.sum(axis=1)
+    want = (1 - 2 * inse / (denom + 1e-5)).mean()
+    np.testing.assert_allclose(np.asarray(got).ravel()[0], want, rtol=1e-5)
+
+
+def test_image_resize_short_layer():
+    rng = np.random.RandomState(1)
+    img = rng.standard_normal((2, 3, 6, 12)).astype('float32')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('img', [3, 6, 12], dtype='float32')
+        out = fluid.layers.image_resize_short(x, out_short_len=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        got, = exe.run(main, feed={'img': img}, fetch_list=[out])
+    # short edge 6 -> 3, long edge 12 -> 6 (aspect kept)
+    assert np.asarray(got).shape == (2, 3, 3, 6)
+
+
+def test_lod_reset_layer_updates_lengths():
+    """lod_reset re-segments a sequence: sequence_pool after the reset
+    must sum over the NEW segments (reference test_lod_reset_op.py)."""
+    from helpers import lod_feed
+    rows = [[1.0, 2.0], [3.0, 4.0, 5.0], [6.0]]  # lengths 2,3,1
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', [1], dtype='float32', lod_level=1)
+        # re-segment the 6 rows as lengths 3,3 (offsets 0,3,6)
+        out = fluid.layers.lod_reset(x, target_lod=[0, 3, 6])
+        pooled = fluid.layers.sequence_pool(out, pool_type='sum')
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        got, = exe.run(main, feed={'x': lod_feed(rows, 'float32')},
+                       fetch_list=[pooled])
+    np.testing.assert_allclose(
+        np.asarray(got).ravel(), [1 + 2 + 3, 4 + 5 + 6], rtol=1e-6)
+
+
+def test_mean_iou_layer():
+    pred = np.array([0, 1, 1, 2], 'int32')
+    lab = np.array([0, 1, 2, 2], 'int32')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        p = fluid.layers.data('p', [4], append_batch_size=False,
+                              dtype='int32')
+        l = fluid.layers.data('l', [4], append_batch_size=False,
+                              dtype='int32')
+        iou, wrong, correct = fluid.layers.mean_iou(p, l, num_classes=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        got = exe.run(main, feed={'p': pred, 'l': lab},
+                      fetch_list=[iou, wrong, correct])
+    # class ious: 0: 1/1; 1: 1/2; 2: 1/2 -> mean 2/3
+    np.testing.assert_allclose(np.asarray(got[0]).ravel()[0], 2.0 / 3,
+                               rtol=1e-5)
+    assert int(np.asarray(got[1]).ravel()[0]) == 1
+    assert int(np.asarray(got[2]).ravel()[0]) == 3
+
+
+def test_pad_constant_like_layer():
+    x = np.zeros((4, 3), 'float32')
+    y = np.ones((2, 2), 'float32')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data('x', [4, 3], append_batch_size=False,
+                               dtype='float32')
+        yv = fluid.layers.data('y', [2, 2], append_batch_size=False,
+                               dtype='float32')
+        out = fluid.layers.pad_constant_like(xv, yv, pad_value=9.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        got, = exe.run(main, feed={'x': x, 'y': y}, fetch_list=[out])
+    want = np.full((4, 3), 9.0, 'float32')
+    want[:2, :2] = 1.0
+    np.testing.assert_allclose(got, want)
+
+
+def test_rank_loss_layer():
+    rng = np.random.RandomState(2)
+    label = rng.randint(0, 2, (5, 1)).astype('float32')
+    left = rng.standard_normal((5, 1)).astype('float32')
+    right = rng.standard_normal((5, 1)).astype('float32')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lv = fluid.layers.data('lab', [1], dtype='float32')
+        le = fluid.layers.data('left', [1], dtype='float32')
+        ri = fluid.layers.data('right', [1], dtype='float32')
+        out = fluid.layers.rank_loss(lv, le, ri)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        got, = exe.run(main, feed={'lab': label, 'left': left,
+                                   'right': right}, fetch_list=[out])
+    d = left - right
+    want = np.log1p(np.exp(d)) - label * d
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_conv3d_transpose_layer_and_groups():
+    """Grouped deconv equals per-group deconv composition (reference
+    conv_transpose_op.cc group loop)."""
+    rng = np.random.RandomState(3)
+    x = rng.standard_normal((2, 4, 3, 4, 4)).astype('float32')
+
+    def build(groups):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            xv = fluid.layers.data('x', [4, 3, 4, 4], dtype='float32')
+            out = fluid.layers.conv3d_transpose(
+                xv, num_filters=4, filter_size=3, stride=2, padding=1,
+                groups=groups, bias_attr=False,
+                param_attr=fluid.ParamAttr(name='w'))
+        return main, startup, out
+
+    main, startup, out = build(groups=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w = np.asarray(scope.find_var('w').value())
+        got, = exe.run(main, feed={'x': x}, fetch_list=[out])
+    assert np.asarray(got).shape == (2, 4, 5, 7, 7)
+    # manual composition: group g sees channels [2g:2g+2] with w rows alike
+    import jax, jax.numpy as jnp
+    outs = []
+    for g in range(2):
+        outs.append(np.asarray(jax.lax.conv_transpose(
+            jnp.asarray(x[:, 2 * g:2 * g + 2]),
+            jnp.swapaxes(jnp.asarray(w[2 * g:2 * g + 2]), 0, 1),
+            strides=[2, 2, 2], padding=[(1, 1)] * 3,
+            dimension_numbers=('NCDHW', 'IODHW', 'NCDHW'),
+            transpose_kernel=True)))
+    want = np.concatenate(outs, axis=1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_transpose_dilation():
+    """Dilated deconv must GROW the output: (in-1)*s - 2p + d*(k-1) + 1
+    (reference conv_transpose_op.cc infer shape); a naive
+    transpose-kernel path shrinks it to zero."""
+    rng = np.random.RandomState(4)
+    x = rng.standard_normal((1, 2, 4, 4)).astype('float32')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data('x', [2, 4, 4], dtype='float32')
+        out = fluid.layers.conv2d_transpose(
+            xv, num_filters=3, filter_size=3, stride=1, padding=0,
+            dilation=2, bias_attr=False,
+            param_attr=fluid.ParamAttr(name='wd'))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w = np.asarray(scope.find_var('wd').value())
+        got, = exe.run(main, feed={'x': x}, fetch_list=[out])
+    got = np.asarray(got)
+    assert got.shape == (1, 3, 8, 8), got.shape  # 3 + 2*(3-1)+1 - 1 = 8
+    # reference semantics: scatter x onto the output through the dilated
+    # kernel: out[:, o, i+d*ki, j+d*kj] += x[:, c, i, j] * w[c, o, ki, kj]
+    want = np.zeros((1, 3, 8, 8), np.float32)
+    for c in range(2):
+        for o in range(3):
+            for ki in range(3):
+                for kj in range(3):
+                    want[0, o, 2 * ki:2 * ki + 4, 2 * kj:2 * kj + 4] += (
+                        x[0, c] * w[c, o, ki, kj])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

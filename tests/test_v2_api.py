@@ -141,3 +141,116 @@ def test_v2_mse_regression():
         event_handler=lambda e: costs.append(e.cost)
         if isinstance(e, v2_event.EndIteration) else None)
     assert costs[-1] < 0.05, (costs[0], costs[-1])
+
+
+def test_v2_recurrent_group_trains():
+    """The v2 recurrent_group/memory step DSL (reference layer.py
+    recurrent_group over the RecurrentGradientMachine): a simple RNN
+    classifier built from a step function must train."""
+    import paddle_tpu.v2 as paddle
+    rng = np.random.RandomState(0)
+
+    words = paddle.layer.data(
+        name='words',
+        type=paddle.data_type.integer_value_sequence(30))
+    emb = paddle.layer.embedding(input=words, size=8)
+
+    def step(word):
+        mem = paddle.layer.memory(name='rnn_state', size=16)
+        return paddle.layer.fc(
+            input=[word, mem], size=16,
+            act=paddle.activation.Tanh(), name='rnn_state')
+
+    rnn_out = paddle.layer.recurrent_group(step=step, input=emb)
+    last = paddle.layer.last_seq(input=rnn_out)
+    pred = paddle.layer.fc(input=last, size=3,
+                           act=paddle.activation.Softmax())
+    label = paddle.layer.data(
+        name='label', type=paddle.data_type.integer_value(3))
+    cost = paddle.layer.classification_cost(input=pred, label=label)
+
+    params = paddle.parameters.create(cost)
+    opt = paddle.optimizer.Adam(learning_rate=0.05)
+    trainer = paddle.trainer.SGD(cost=cost, parameters=params,
+                                 update_equation=opt)
+
+    data = [([int(w) for w in rng.randint(0, 30, size=rng.randint(2, 6))],
+             int(rng.randint(0, 3))) for _ in range(24)]
+    losses = []
+
+    def on_event(event):
+        if isinstance(event, paddle.event.EndIteration):
+            losses.append(event.cost)
+
+    trainer.train(
+        reader=paddle.minibatch.batch(lambda: iter(data), batch_size=8),
+        num_passes=6,
+        event_handler=on_event,
+        feeding={'words': 0, 'label': 1})
+    assert all(np.isfinite(l) for l in losses)
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
+
+
+def test_v2_cost_and_seq_layers():
+    """Smoke the widened v2 surface: rank_cost, smooth_l1, first_seq,
+    max_id, slope_intercept."""
+    import paddle_tpu.v2 as paddle
+    import paddle_tpu.fluid as fluid
+    rng = np.random.RandomState(1)
+
+    left = paddle.layer.data(name='left',
+                             type=paddle.data_type.dense_vector(1))
+    right = paddle.layer.data(name='right',
+                              type=paddle.data_type.dense_vector(1))
+    lbl = paddle.layer.data(name='lbl',
+                            type=paddle.data_type.dense_vector(1))
+    cost = paddle.layer.rank_cost(left=left, right=right, label=lbl)
+    topo = __import__('paddle_tpu.v2.topology',
+                      fromlist=['Topology']).Topology(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(topo.startup_program)
+        v, = exe.run(topo.main_program,
+                     feed={'left': rng.standard_normal((4, 1)).astype(
+                               'float32'),
+                           'right': rng.standard_normal((4, 1)).astype(
+                               'float32'),
+                           'lbl': rng.randint(0, 2, (4, 1)).astype(
+                               'float32')},
+                     fetch_list=[topo.cost_var])
+    assert np.isfinite(float(np.asarray(v).ravel()[0]))
+
+    # seq layers + slope_intercept + smooth_l1 over a sequence pipeline
+    seq = paddle.layer.data(
+        name='seq', type=paddle.data_type.dense_vector_sequence(4))
+    scaled = paddle.layer.slope_intercept(seq, slope=2.0, intercept=1.0)
+    first = paddle.layer.first_seq(input=scaled)
+    ids = paddle.layer.max_id(input=first)
+    tgt = paddle.layer.data(name='tgt',
+                            type=paddle.data_type.dense_vector(4))
+    cost2 = paddle.layer.smooth_l1_cost(input=first, label=tgt)
+    topo2 = __import__('paddle_tpu.v2.topology',
+                       fromlist=['Topology']).Topology(cost2)
+    rows = [rng.standard_normal((3, 4)).astype('float32'),
+            rng.standard_normal((2, 4)).astype('float32')]
+    flat = np.concatenate(rows)
+    lt = fluid.core.LoDTensor(flat)
+    lt.set_recursive_sequence_lengths([[3, 2]])
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(topo2.startup_program)
+        # first_seq is in the cost DAG; materialize max_id (a side
+        # output) into the same program to fetch it too
+        first_var = topo2._ctx[first.name]
+        with fluid.program_guard(topo2.main_program,
+                                 topo2.startup_program):
+            ids_var = ids.to_fluid(topo2._ctx)
+        f_v, i_v, c_v = exe.run(
+            topo2.main_program,
+            feed={'seq': lt,
+                  'tgt': rng.standard_normal((2, 4)).astype('float32')},
+            fetch_list=[first_var, ids_var, topo2.cost_var])
+    want_first = 2.0 * np.stack([rows[0][0], rows[1][0]]) + 1.0
+    np.testing.assert_allclose(np.asarray(f_v), want_first, rtol=1e-5)
+    np.testing.assert_array_equal(
+        np.asarray(i_v).ravel(), want_first.argmax(axis=1))
+    assert np.isfinite(float(np.asarray(c_v).ravel()[0]))
