@@ -1610,6 +1610,11 @@ def crop(x, shape=None, offsets=None, name=None):
     helper = LayerHelper('crop', **locals())
     inputs = {'X': [x]}
     attrs = {}
+    if shape is None:
+        raise ValueError(
+            'crop: shape is required — a list of output dims or a '
+            'Variable whose shape is the target (reference nn.py:5453 '
+            'asserts the same)')
     if isinstance(shape, Variable):
         inputs['Y'] = [shape]
         out_shape = shape.shape
@@ -1688,8 +1693,11 @@ def mean_iou(input, label, num_classes):
     iou = helper.create_variable_for_type_inference('float32')
     out_wrong = helper.create_variable_for_type_inference('int32')
     out_correct = helper.create_variable_for_type_inference('int32')
+    iou.shape = (1, )
+    # per-class counts (reference mean_iou_op.cc SetOutputDim)
+    out_wrong.shape = (num_classes, )
+    out_correct.shape = (num_classes, )
     for v in (iou, out_wrong, out_correct):
-        v.shape = (1, )
         v.stop_gradient = True
     helper.append_op(
         type='mean_iou',

@@ -114,26 +114,39 @@ def _pad_constant_like(ctx, op):
 
 @register_lowering('mean_iou')
 def _mean_iou(ctx, op):
-    """Mean intersection-over-union over classes (reference
-    mean_iou_op.cc): per-class IoU from the confusion counts, averaged
-    over classes that appear."""
+    """Mean intersection-over-union (reference mean_iou_op.h): per
+    sample, a match increments correct[pred]; a mismatch increments
+    wrong[label] AND wrong[pred].  IoU[c] = correct/(correct+wrong),
+    averaged over classes with a nonzero denominator.  OutWrong and
+    OutCorrect are PER-CLASS [num_classes] vectors; InMeanIou/InWrongs/
+    InCorrects accumulate into the outputs (streaming evaluation)."""
     pred = jnp.reshape(ctx.get(op, 'Predictions'), (-1, )).astype(jnp.int32)
     label = jnp.reshape(ctx.get(op, 'Labels'), (-1, )).astype(jnp.int32)
     num_classes = int(op.attrs['num_classes'])
     cls = jnp.arange(num_classes)
+    match = (pred == label)[:, None]
     pred_oh = pred[:, None] == cls[None, :]
     lbl_oh = label[:, None] == cls[None, :]
-    inter = jnp.sum(pred_oh & lbl_oh, axis=0).astype(jnp.float32)
-    union = jnp.sum(pred_oh | lbl_oh, axis=0).astype(jnp.float32)
-    present = union > 0
-    iou = jnp.where(present, inter / jnp.maximum(union, 1.0), 0.0)
+    correct = jnp.sum(pred_oh & match, axis=0).astype(jnp.int32)
+    wrong = (jnp.sum(lbl_oh & ~match, axis=0) +
+             jnp.sum(pred_oh & ~match, axis=0)).astype(jnp.int32)
+    for w in ctx.get_list(op, 'InWrongs') or []:
+        wrong = wrong + w.astype(jnp.int32)
+    for c in ctx.get_list(op, 'InCorrects') or []:
+        correct = correct + c.astype(jnp.int32)
+    denom = wrong + correct
+    present = denom > 0
+    iou = jnp.where(present,
+                    correct.astype(jnp.float32) /
+                    jnp.maximum(denom, 1).astype(jnp.float32), 0.0)
     miou = jnp.sum(iou) / jnp.maximum(
         jnp.sum(present.astype(jnp.float32)), 1.0)
-    wrong = jnp.sum((pred != label).astype(jnp.int32))
-    correct = jnp.sum((pred == label).astype(jnp.int32))
-    ctx.set(op, 'OutMeanIou', jnp.reshape(miou, (1, )))
-    ctx.set(op, 'OutWrong', jnp.reshape(wrong, (1, )))
-    ctx.set(op, 'OutCorrect', jnp.reshape(correct, (1, )))
+    miou = jnp.reshape(miou, (1, ))
+    for m in ctx.get_list(op, 'InMeanIou') or []:
+        miou = miou + jnp.reshape(m, (1, )).astype(jnp.float32)
+    ctx.set(op, 'OutMeanIou', miou)
+    ctx.set(op, 'OutWrong', wrong)
+    ctx.set(op, 'OutCorrect', correct)
 
 
 @register_lowering('bilinear_tensor_product')

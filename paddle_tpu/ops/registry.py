@@ -153,7 +153,7 @@ class LoweringContext(object):
         return key
 
     def sub_context(self, block=None, env=None):
-        return LoweringContext(
+        sub = LoweringContext(
             block if block is not None else self.block,
             env if env is not None else self.env,
             rng_key=None,
@@ -161,11 +161,17 @@ class LoweringContext(object):
             place=self.place,
             mesh=self.mesh,
             batch_axis=self.batch_axis)
+        # trace-time constants survive into re-traces (grad synthesis,
+        # sub-blocks): lowerings that need concrete values (lod_reset
+        # offsets, tensor-array indices) behave identically there
+        sub.concrete = dict(self.concrete)
+        return sub
 
 
 # op types that maintain ctx.concrete themselves (their lowerings set or
 # propagate entries); every other op's outputs invalidate stale entries
-_CONCRETE_PRESERVING = {'fill_constant', 'increment', 'assign'}
+_CONCRETE_PRESERVING = {'fill_constant', 'increment', 'assign',
+                        'assign_value'}
 
 SEQLEN_SUFFIX = '@SEQLEN'
 # ops that consume sequence structure and emit dense outputs — sequence

@@ -244,8 +244,11 @@ def _assign_grad(ctx, op):
 def _assign_value(ctx, op):
     vals = np.asarray(op.attrs['values'])
     dtype = _np_dtype(op.attrs.get('dtype'))
-    ctx.set(op, 'Out',
-            jnp.asarray(vals.reshape(tuple(op.attrs['shape'])), dtype=dtype))
+    arr = vals.reshape(tuple(op.attrs['shape'])).astype(dtype)
+    ctx.set(op, 'Out', jnp.asarray(arr))
+    # the values are program constants: record them so consumers needing
+    # concrete data (lod_reset offsets) can fold them at trace time
+    ctx.concrete[op.output('Out')[0]] = arr
 
 
 @register_lowering('shape')

@@ -1,0 +1,33 @@
+"""Legacy (pre-v2) config DSL (reference:
+python/paddle/trainer_config_helpers/__init__.py).
+
+The reference's oldest API generation: model configs are Python files
+calling ``settings(...)``, ``*_layer(...)`` builders and ``outputs(...)``,
+parsed by the trainer binary into a protobuf ModelConfig for the legacy
+GradientMachine.  Here the same surface builds the v2 DAG (itself a shim
+over the TPU fluid stack), so legacy config files execute as one compiled
+XLA program:
+
+    from paddle_tpu.trainer_config_helpers import *
+    settings(batch_size=32, learning_rate=1e-3,
+             learning_method=AdamOptimizer())
+    x = data_layer(name='x', size=16)
+    h = fc_layer(input=x, size=32, act=TanhActivation())
+    y = fc_layer(input=h, size=4, act=SoftmaxActivation())
+    lbl = data_layer(name='label', size=4, data_type_kind='index')
+    outputs(classification_cost(input=y, label=lbl))
+
+``get_config()`` then hands (costs, settings) to the v2 trainer flow.
+"""
+
+from .activations import *  # noqa: F401,F403
+from .poolings import *  # noqa: F401,F403
+from .attrs import *  # noqa: F401,F403
+from .optimizers import *  # noqa: F401,F403
+from .layers import *  # noqa: F401,F403
+from .networks import *  # noqa: F401,F403
+
+from . import activations, poolings, attrs, optimizers, layers, networks
+
+__all__ = (activations.__all__ + poolings.__all__ + attrs.__all__ +
+           optimizers.__all__ + layers.__all__ + networks.__all__)

@@ -1,0 +1,16 @@
+"""Legacy activation objects (reference
+trainer_config_helpers/activations.py) — aliases of the v2 objects."""
+
+from ..v2 import activation as _act
+
+__all__ = [
+    'TanhActivation', 'SigmoidActivation', 'SoftmaxActivation',
+    'ReluActivation', 'LinearActivation', 'IdentityActivation',
+]
+
+TanhActivation = _act.Tanh
+SigmoidActivation = _act.Sigmoid
+SoftmaxActivation = _act.Softmax
+ReluActivation = _act.Relu
+LinearActivation = _act.Linear
+IdentityActivation = _act.Linear

@@ -1,0 +1,27 @@
+"""Legacy attribute bags (reference trainer_config_helpers/attrs.py).
+Accepted for config compatibility; placement/regularization decisions
+belong to the XLA stack."""
+
+__all__ = ['ParamAttr', 'ParameterAttribute', 'ExtraAttr',
+           'ExtraLayerAttribute']
+
+
+class ParameterAttribute(object):
+    def __init__(self, name=None, initial_std=None, initial_mean=None,
+                 learning_rate=None, l1_rate=None, l2_rate=None,
+                 sparse_update=False, **kwargs):
+        self.name = name
+        self.initial_std = initial_std
+        self.initial_mean = initial_mean
+        self.learning_rate = learning_rate
+        self.sparse_update = sparse_update
+
+
+class ExtraLayerAttribute(object):
+    def __init__(self, error_clipping_threshold=None, drop_rate=None,
+                 device=None, **kwargs):
+        self.drop_rate = drop_rate
+
+
+ParamAttr = ParameterAttribute
+ExtraAttr = ExtraLayerAttribute

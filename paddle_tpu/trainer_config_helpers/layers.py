@@ -1,0 +1,148 @@
+"""Legacy layer builders (reference trainer_config_helpers/layers.py).
+
+Each ``*_layer`` returns a v2 DAG node (paddle_tpu.v2.layer.Layer); the
+legacy names and calling conventions are preserved, the engine is the
+TPU fluid stack.  ``outputs()`` records the config's roots the way the
+old parser did (config_parser marks output layers)."""
+
+from ..v2 import layer as _v2
+from ..v2 import data_type as _dt
+
+__all__ = [
+    'data_layer', 'fc_layer', 'embedding_layer', 'img_conv_layer',
+    'img_pool_layer', 'pooling_layer', 'concat_layer', 'addto_layer',
+    'dropout_layer', 'lstmemory', 'grumemory', 'batch_norm_layer',
+    'last_seq', 'first_seq', 'maxid_layer', 'memory', 'recurrent_group',
+    'StaticInput', 'classification_cost', 'cross_entropy',
+    'regression_cost', 'mse_cost', 'rank_cost', 'smooth_l1_cost',
+    'multi_binary_label_cross_entropy', 'outputs', 'get_config',
+    'reset_config',
+]
+
+_OUTPUTS = []
+
+
+def data_layer(name, size, data_type_kind='dense', seq=False, **kwargs):
+    """(reference layers.py data_layer).  The legacy DSL declares only
+    name+size; the value kind rides ``data_type_kind``:
+    'dense'|'index', seq=True for sequence input."""
+    if data_type_kind == 'index':
+        t = _dt.integer_value_sequence(size) if seq else \
+            _dt.integer_value(size)
+    else:
+        t = _dt.dense_vector_sequence(size) if seq else \
+            _dt.dense_vector(size)
+    return _v2.data(name=name, type=t)
+
+
+def fc_layer(input, size, act=None, name=None, param_attr=None,
+             bias_attr=None, **kwargs):
+    return _v2.fc(input=input, size=size, act=act, name=name)
+
+
+def embedding_layer(input, size, name=None, param_attr=None, **kwargs):
+    return _v2.embedding(input=input, size=size, name=name)
+
+
+def img_conv_layer(input, filter_size, num_filters, num_channels=None,
+                   stride=1, padding=0, act=None, name=None, **kwargs):
+    return _v2.img_conv(input=input, filter_size=filter_size,
+                        num_filters=num_filters,
+                        num_channels=num_channels, stride=stride,
+                        padding=padding, act=act, name=name)
+
+
+def img_pool_layer(input, pool_size, stride=1, padding=0, pool_type=None,
+                   name=None, **kwargs):
+    return _v2.img_pool(input=input, pool_size=pool_size, stride=stride,
+                        padding=padding, pool_type=pool_type, name=name)
+
+
+def pooling_layer(input, pooling_type=None, name=None, **kwargs):
+    return _v2.pooling(input=input, pooling_type=pooling_type, name=name)
+
+
+def concat_layer(input, name=None, **kwargs):
+    return _v2.concat(input=input, name=name)
+
+
+def addto_layer(input, act=None, name=None, **kwargs):
+    return _v2.addto(input=input, act=act, name=name)
+
+
+def dropout_layer(input, dropout_rate, name=None, **kwargs):
+    return _v2.dropout(input=input, dropout_rate=dropout_rate, name=name)
+
+
+def lstmemory(input, size=None, name=None, reverse=False, **kwargs):
+    return _v2.lstmemory(input=input, size=size, name=name)
+
+
+def grumemory(input, size, name=None, **kwargs):
+    return _v2.gru_like(input=input, size=size, name=name)
+
+
+def batch_norm_layer(input, act=None, name=None, **kwargs):
+    return _v2.batch_norm(input=input, act=act, name=name)
+
+
+def last_seq(input, name=None, **kwargs):
+    return _v2.last_seq(input=input, name=name)
+
+
+def first_seq(input, name=None, **kwargs):
+    return _v2.first_seq(input=input, name=name)
+
+
+def maxid_layer(input, name=None, **kwargs):
+    return _v2.max_id(input=input, name=name)
+
+
+memory = _v2.memory
+recurrent_group = _v2.recurrent_group
+StaticInput = _v2.StaticInput
+
+
+def classification_cost(input, label, name=None, **kwargs):
+    return _v2.classification_cost(input=input, label=label, name=name)
+
+
+def cross_entropy(input, label, name=None, **kwargs):
+    return _v2.cross_entropy_cost(input=input, label=label, name=name)
+
+
+def regression_cost(input, label, name=None, **kwargs):
+    return _v2.square_error_cost(input=input, label=label, name=name)
+
+
+mse_cost = regression_cost
+
+
+def rank_cost(left, right, label, name=None, **kwargs):
+    return _v2.rank_cost(left=left, right=right, label=label, name=name)
+
+
+def smooth_l1_cost(input, label, name=None, **kwargs):
+    return _v2.smooth_l1_cost(input=input, label=label, name=name)
+
+
+def multi_binary_label_cross_entropy(input, label, name=None, **kwargs):
+    return _v2.multi_binary_label_cross_entropy_cost(
+        input=input, label=label, name=name)
+
+
+def outputs(*layers):
+    """(reference config_parser outputs()): mark the config's roots."""
+    _OUTPUTS.extend(layers)
+
+
+def get_config():
+    """The executable view of the parsed config: (output/cost layers,
+    settings dict) — what the legacy trainer binary extracted from the
+    protobuf ModelConfig."""
+    from .optimizers import get_settings
+    return list(_OUTPUTS), get_settings()
+
+
+def reset_config():
+    del _OUTPUTS[:]

@@ -1,0 +1,26 @@
+"""Legacy composite networks (reference
+trainer_config_helpers/networks.py): simple_lstm / simple_gru /
+simple_img_conv_pool as layer compositions."""
+
+from . import layers as _l
+
+__all__ = ['simple_lstm', 'simple_gru', 'simple_img_conv_pool']
+
+
+def simple_lstm(input, size, name=None, **kwargs):
+    """fc gate projection + lstmemory (reference networks.py:xxx
+    simple_lstm)."""
+    proj = _l.fc_layer(input=input, size=size * 4)
+    return _l.lstmemory(input=proj, size=size, name=name)
+
+
+def simple_gru(input, size, name=None, **kwargs):
+    return _l.grumemory(input=input, size=size, name=name)
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         pool_stride=1, act=None, name=None, **kwargs):
+    conv = _l.img_conv_layer(input=input, filter_size=filter_size,
+                             num_filters=num_filters, act=act)
+    return _l.img_pool_layer(input=conv, pool_size=pool_size,
+                             stride=pool_stride, name=name)

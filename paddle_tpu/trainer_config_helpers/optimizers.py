@@ -1,0 +1,95 @@
+"""Legacy settings()/optimizer DSL (reference
+trainer_config_helpers/optimizers.py:358 settings).
+
+settings() records the module-level training config the old trainer
+binary would have parsed; ``get_settings()``/``make_v2_optimizer()``
+expose it to the executable v2 flow."""
+
+from ..v2 import optimizer as _v2_opt
+
+__all__ = [
+    'settings', 'get_settings', 'make_v2_optimizer', 'AdamOptimizer',
+    'AdamaxOptimizer', 'MomentumOptimizer', 'RMSPropOptimizer',
+    'AdaGradOptimizer', 'BaseSGDOptimizer',
+]
+
+_SETTINGS = {}
+
+
+class BaseSGDOptimizer(object):
+    kwargs = {}
+
+    def to_v2(self, learning_rate):
+        raise NotImplementedError
+
+
+class MomentumOptimizer(BaseSGDOptimizer):
+    def __init__(self, momentum=0.9, **kwargs):
+        self.momentum = momentum
+
+    def to_v2(self, learning_rate):
+        return _v2_opt.Momentum(momentum=self.momentum,
+                                learning_rate=learning_rate)
+
+
+class AdamOptimizer(BaseSGDOptimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8, **kwargs):
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def to_v2(self, learning_rate):
+        return _v2_opt.Adam(beta1=self.beta1, beta2=self.beta2,
+                            epsilon=self.epsilon,
+                            learning_rate=learning_rate)
+
+
+class AdamaxOptimizer(BaseSGDOptimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, **kwargs):
+        self.beta1, self.beta2 = beta1, beta2
+
+    def to_v2(self, learning_rate):
+        return _v2_opt.Adamax(beta1=self.beta1, beta2=self.beta2,
+                              learning_rate=learning_rate)
+
+
+class RMSPropOptimizer(BaseSGDOptimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, **kwargs):
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_v2(self, learning_rate):
+        return _v2_opt.RMSProp(rho=self.rho, epsilon=self.epsilon,
+                               learning_rate=learning_rate)
+
+
+class AdaGradOptimizer(BaseSGDOptimizer):
+    def to_v2(self, learning_rate):
+        return _v2_opt.AdaGrad(learning_rate=learning_rate)
+
+
+def settings(batch_size,
+             learning_rate=1e-3,
+             learning_method=None,
+             regularization=None,
+             gradient_clipping_threshold=None,
+             **kwargs):
+    """(reference optimizers.py:358) Record the training configuration."""
+    _SETTINGS.clear()
+    _SETTINGS.update(
+        batch_size=batch_size,
+        learning_rate=learning_rate,
+        learning_method=learning_method,
+        gradient_clipping_threshold=gradient_clipping_threshold)
+    _SETTINGS.update(kwargs)
+
+
+def get_settings():
+    return dict(_SETTINGS)
+
+
+def make_v2_optimizer():
+    """The recorded settings as a v2 optimizer (SGD when no
+    learning_method was set)."""
+    lr = _SETTINGS.get('learning_rate', 1e-3)
+    method = _SETTINGS.get('learning_method')
+    if method is None:
+        return _v2_opt.Momentum(momentum=0.0, learning_rate=lr)
+    return method.to_v2(lr)

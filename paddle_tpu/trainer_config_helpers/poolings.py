@@ -1,0 +1,9 @@
+"""Legacy pooling objects (reference trainer_config_helpers/poolings.py)."""
+
+from ..v2 import pooling as _pooling
+
+__all__ = ['MaxPooling', 'AvgPooling', 'SumPooling']
+
+MaxPooling = _pooling.Max
+AvgPooling = _pooling.Avg
+SumPooling = _pooling.Sum

@@ -334,6 +334,10 @@ def lstmemory(input, size=None, name=None, **kwargs):
 
     def build(ctx, parent_var):
         width = size or (input.size // 4 if input.size else None)
+        if width is None:
+            raise ValueError(
+                'lstmemory: cannot infer the hidden width — the input '
+                'layer declares no size; pass size= explicitly')
         hidden, _ = fluid.layers.dynamic_lstm(parent_var, size=width * 4)
         return hidden
 
@@ -439,7 +443,33 @@ def smooth_l1_cost(input, label, name=None, **kwargs):
                       prediction=input)
 
 
-huber_regression_cost = smooth_l1_cost
+def huber_regression_cost(input, label, delta=1.0, name=None, **kwargs):
+    """Huber loss with threshold delta (reference layer.py
+    huber_regression_cost): 0.5 d^2 inside |d|<=delta, delta(|d| -
+    0.5 delta) outside."""
+
+    def build(ctx, input_var, label_var):
+        diff = fluid.layers.elementwise_sub(input_var, label_var)
+        absd = fluid.layers.abs(diff)
+        quad = fluid.layers.scale(
+            fluid.layers.elementwise_mul(diff, diff), scale=0.5)
+        lin = fluid.layers.scale(
+            fluid.layers.scale(absd, bias=-0.5 * float(delta)),
+            scale=float(delta))
+        small = fluid.layers.cast(
+            fluid.layers.less_than(
+                absd,
+                fluid.layers.fill_constant_batch_size_like(
+                    absd, shape=[-1, 1], value=float(delta),
+                    dtype='float32')), 'float32')
+        per = fluid.layers.elementwise_add(
+            fluid.layers.elementwise_mul(small, quad),
+            fluid.layers.elementwise_mul(
+                fluid.layers.scale(small, scale=-1.0, bias=1.0), lin))
+        return fluid.layers.mean(per)
+
+    return _cost_layer('huber_regression_cost', [input, label], build,
+                       name, prediction=input)
 
 
 def multi_binary_label_cross_entropy_cost(input, label, name=None,

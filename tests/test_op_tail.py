@@ -81,15 +81,16 @@ def test_pad_constant_like():
 def test_mean_iou():
     pred = np.asarray([0, 1, 1, 2], np.int32)
     label = np.asarray([0, 1, 2, 2], np.int32)
-    # class0: 1/1, class1: 1/2, class2: 1/2 -> mean 2/3
+    # class0: 1/1, class1: 1/2, class2: 1/2 -> mean 2/3; the mismatch
+    # (pred 1, label 2) bumps wrong[1] and wrong[2] (mean_iou_op.h)
     t = OpTest()
     t.op_type = 'mean_iou'
     t.inputs = {'Predictions': pred, 'Labels': label}
     t.attrs = {'num_classes': 3}
     t.outputs = {
         'OutMeanIou': np.asarray([2.0 / 3.0], np.float32),
-        'OutWrong': np.asarray([1], np.int32),
-        'OutCorrect': np.asarray([3], np.int32),
+        'OutWrong': np.asarray([0, 1, 1], np.int32),
+        'OutCorrect': np.asarray([1, 1, 1], np.int32),
     }
     t.check_output()
 
@@ -388,8 +389,8 @@ def test_mean_iou_layer():
     # class ious: 0: 1/1; 1: 1/2; 2: 1/2 -> mean 2/3
     np.testing.assert_allclose(np.asarray(got[0]).ravel()[0], 2.0 / 3,
                                rtol=1e-5)
-    assert int(np.asarray(got[1]).ravel()[0]) == 1
-    assert int(np.asarray(got[2]).ravel()[0]) == 3
+    np.testing.assert_array_equal(np.asarray(got[1]).ravel(), [0, 1, 1])
+    np.testing.assert_array_equal(np.asarray(got[2]).ravel(), [1, 1, 1])
 
 
 def test_pad_constant_like_layer():
@@ -501,3 +502,23 @@ def test_conv_transpose_dilation():
                     want[0, o, 2 * ki:2 * ki + 4, 2 * kj:2 * kj + 4] += (
                         x[0, c] * w[c, o, ki, kj])
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_lod_reset_via_assigned_y():
+    """lod_reset(x, y=assign(offsets)) — Y's values are trace-time
+    constants and must fold into the new padding layout."""
+    from helpers import lod_feed
+    rows = [[1.0, 2.0], [3.0, 4.0, 5.0], [6.0]]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', [1], dtype='float32', lod_level=1)
+        y = fluid.layers.assign(np.asarray([0, 3, 6], 'int32'))
+        out = fluid.layers.lod_reset(x, y=y)
+        pooled = fluid.layers.sequence_pool(out, pool_type='sum')
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        got, = exe.run(main, feed={'x': lod_feed(rows, 'float32')},
+                       fetch_list=[pooled])
+    np.testing.assert_allclose(np.asarray(got).ravel(), [6.0, 15.0],
+                               rtol=1e-6)

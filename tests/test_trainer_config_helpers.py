@@ -1,0 +1,83 @@
+"""Legacy (pre-v2) config DSL tests: a trainer_config_helpers config
+builds, trains and infers through the v2/fluid stack (reference:
+python/paddle/trainer_config_helpers/tests + the legacy config-file
+flow: settings() + *_layer() + outputs())."""
+
+import numpy as np
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu import trainer_config_helpers as tch
+
+
+def setup_function(_fn):
+    tch.reset_config()
+
+
+def test_legacy_config_trains_classifier():
+    """A classic legacy config file body, executed end-to-end."""
+    tch.settings(batch_size=8, learning_rate=0.05,
+                 learning_method=tch.AdamOptimizer())
+    x = tch.data_layer(name='x', size=16)
+    h = tch.fc_layer(input=x, size=32, act=tch.TanhActivation())
+    pred = tch.fc_layer(input=h, size=4, act=tch.SoftmaxActivation())
+    lbl = tch.data_layer(name='label', size=4, data_type_kind='index')
+    tch.outputs(tch.classification_cost(input=pred, label=lbl))
+
+    costs, cfg = tch.get_config()
+    assert cfg['batch_size'] == 8 and len(costs) == 1
+
+    params = paddle.parameters.create(costs[0])
+    trainer = paddle.trainer.SGD(cost=costs[0], parameters=params,
+                                 update_equation=tch.make_v2_optimizer())
+    rng = np.random.RandomState(0)
+    centers = rng.standard_normal((4, 16)).astype('float32') * 2
+    data = [(centers[i % 4] +
+             0.2 * rng.standard_normal(16).astype('float32'), i % 4)
+            for i in range(64)]
+    losses = []
+
+    def on_event(event):
+        if isinstance(event, paddle.event.EndIteration):
+            losses.append(event.cost)
+
+    trainer.train(
+        reader=paddle.minibatch.batch(lambda: iter(data),
+                                      batch_size=cfg['batch_size']),
+        num_passes=4, event_handler=on_event,
+        feeding={'x': 0, 'label': 1})
+    assert all(np.isfinite(l) for l in losses)
+    assert np.mean(losses[-4:]) < np.mean(losses[:4]) * 0.6, losses
+
+
+def test_legacy_sequence_config_with_networks():
+    """simple_lstm over an index sequence + pooling cost path."""
+    import paddle_tpu.fluid as fluid
+    tch.settings(batch_size=4, learning_rate=0.01,
+                 learning_method=tch.MomentumOptimizer(momentum=0.9))
+    words = tch.data_layer(name='words', size=40, data_type_kind='index',
+                           seq=True)
+    emb = tch.embedding_layer(input=words, size=8)
+    lstm = tch.simple_lstm(input=emb, size=12)
+    pooled = tch.pooling_layer(input=lstm,
+                               pooling_type=tch.MaxPooling())
+    pred = tch.fc_layer(input=pooled, size=2,
+                        act=tch.SoftmaxActivation())
+    lbl = tch.data_layer(name='label', size=2, data_type_kind='index')
+    tch.outputs(tch.classification_cost(input=pred, label=lbl))
+
+    costs, cfg = tch.get_config()
+    from paddle_tpu.v2.topology import Topology
+    topo = Topology(costs[0])
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(1)
+    rows = [rng.randint(0, 40, (l, 1)) for l in (3, 5, 2, 4)]
+    lt = fluid.core.LoDTensor(np.concatenate(rows).astype('int64'))
+    lt.set_recursive_sequence_lengths([[len(r) for r in rows]])
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(topo.startup_program)
+        v, = exe.run(topo.main_program,
+                     feed={'words': lt,
+                           'label': rng.randint(0, 2, (4, 1)).astype(
+                               'int64')},
+                     fetch_list=[topo.cost_var])
+    assert np.isfinite(float(np.asarray(v).ravel()[0]))

@@ -254,3 +254,25 @@ def test_v2_cost_and_seq_layers():
     np.testing.assert_array_equal(
         np.asarray(i_v).ravel(), want_first.argmax(axis=1))
     assert np.isfinite(float(np.asarray(c_v).ravel()[0]))
+
+
+def test_v2_huber_cost_delta():
+    """huber_regression_cost honors delta (was silently smooth-l1)."""
+    import paddle_tpu.v2 as paddle
+    import paddle_tpu.fluid as fluid
+    pred = paddle.layer.data(name='p', type=paddle.data_type.dense_vector(1))
+    tgt = paddle.layer.data(name='t', type=paddle.data_type.dense_vector(1))
+    cost = paddle.layer.huber_regression_cost(input=pred, label=tgt,
+                                              delta=2.0)
+    from paddle_tpu.v2.topology import Topology
+    topo = Topology(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    p = np.asarray([[0.0], [5.0]], 'float32')  # diffs 0.5 (quad), 5 (lin)
+    t = np.asarray([[-0.5], [0.0]], 'float32')
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(topo.startup_program)
+        v, = exe.run(topo.main_program, feed={'p': p, 't': t},
+                     fetch_list=[topo.cost_var])
+    # huber(0.5; d=2) = 0.125; huber(5; d=2) = 2*(5-1) = 8 -> mean 4.0625
+    np.testing.assert_allclose(float(np.asarray(v).ravel()[0]), 4.0625,
+                               rtol=1e-5)
