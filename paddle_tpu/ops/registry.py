@@ -357,15 +357,24 @@ def amp_cast_in(*xs):
 
 
 def amp_cast_out(out):
-    """AMP output policy for convolutions: keep activations bf16.
+    """AMP output policy for convolutions: activations LAND in HBM as
+    bf16.
 
-    Upcasting between convs doubles HBM read+write traffic for every
-    activation tensor — the dominant cost of a conv net on TPU.  bf16
-    activations flow through BN (which computes its statistics in fp32,
-    ops/nn_ops.py _batch_norm), relu, pooling and residual adds; matmul
-    outputs are fp32 via preferred_element_type; parameter gradients
-    arrive fp32 because the astype(bf16) cast's VJP converts cotangents
-    back.  Master weights and optimizer state stay fp32 throughout."""
+    Under AMP every conv call site runs amp_cast_in first, so its bf16
+    operands yield a bf16 result directly (the TPU MXU accumulates
+    bf16 products in fp32 internally regardless of the output dtype) —
+    the materialized [B,C,H,W] tensor is 2 bytes/element, and keeping
+    it fp32 would double HBM read+write traffic for every activation,
+    the dominant cost of a conv net on TPU.  This hook is the safety
+    net for any call site whose result comes back fp32 (e.g. a future
+    preferred_element_type).  bf16 activations flow through BN (which
+    upcasts in-register for its statistics, ops/nn_ops.py
+    _batch_norm), relu, pooling and residual adds; master weights and
+    optimizer state stay fp32 throughout."""
+    import jax.numpy as jnp
+    if _AMP['enabled'] and hasattr(out, 'dtype') and \
+            out.dtype == jnp.float32:
+        return out.astype(jnp.bfloat16)
     return out
 
 

@@ -145,4 +145,6 @@ def get_config():
 
 
 def reset_config():
+    from .optimizers import _SETTINGS
     del _OUTPUTS[:]
+    _SETTINGS.clear()  # a new config must not inherit old hyperparams

@@ -8,7 +8,7 @@ __all__ = ['simple_lstm', 'simple_gru', 'simple_img_conv_pool']
 
 
 def simple_lstm(input, size, name=None, **kwargs):
-    """fc gate projection + lstmemory (reference networks.py:xxx
+    """fc gate projection + lstmemory (reference networks.py:632
     simple_lstm)."""
     proj = _l.fc_layer(input=input, size=size * 4)
     return _l.lstmemory(input=proj, size=size, name=name)

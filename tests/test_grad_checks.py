@@ -59,8 +59,8 @@ def test_mean_iou_inputs_have_no_grad():
     t.inputs = {'Predictions': pred, 'Labels': label}
     t.attrs = {'num_classes': 2}
     t.outputs = {'OutMeanIou': np.asarray([1.0], np.float32),
-                 'OutWrong': np.asarray([0], np.int32),
-                 'OutCorrect': np.asarray([2], np.int32)}
+                 'OutWrong': np.asarray([0, 0], np.int32),
+                 'OutCorrect': np.asarray([1, 1], np.int32)}
     t.check_output()
 
 
