@@ -112,12 +112,26 @@ def _ctr(args, rng):
     return model, feed, args.batch_size, 'examples/sec'
 
 
+def _transformer(args, rng):
+    from paddle_tpu.models import transformer
+    seq_len = args.seq_len
+    model = transformer.build(src_vocab=30000, trg_vocab=30000,
+                              max_len=seq_len, n_layer=6, n_head=8,
+                              d_model=512, d_ff=2048)
+    src = rng.randint(2, 30000, (args.batch_size, seq_len)).astype('int64')
+    trg = np.concatenate(
+        [np.zeros((args.batch_size, 1), 'int64'), src[:, :-1]], axis=1)
+    feed = {'src_ids': src, 'trg_ids': trg, 'lbl_ids': src}
+    return model, feed, args.batch_size * seq_len, 'tokens/sec'
+
+
 MODELS = {
     'mnist': _mnist,
     'resnet': _resnet,
     'vgg': _vgg,
     'stacked_lstm': _stacked_lstm,
     'machine_translation': _machine_translation,
+    'transformer': _transformer,
     'ctr': _ctr,
 }
 

@@ -53,9 +53,15 @@ def _pick_impl(ctx, op):
 @register_lowering('flash_attention')
 def flash_attention_lowering(ctx, op):
     from ..parallel import context_parallel as cp
+    from .registry import amp_cast_in
     q = ctx.get(op, 'Q')
     k = ctx.get(op, 'K')
     v = ctx.get(op, 'V')
+    # under AMP the projections arrive fp32 (matmul accumulation dtype);
+    # cast HERE so the layout transposes into the kernel move half the
+    # bytes, the kernel's matmuls run at bf16 MXU rate, and the output
+    # stays bf16 in HBM (amp_cast_out policy)
+    q, k, v = amp_cast_in(q, k, v)
     causal = bool(op.attrs.get('causal', False))
     scale = op.attrs.get('scale', None)
     if scale is not None and scale <= 0:

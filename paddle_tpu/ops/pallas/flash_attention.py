@@ -7,14 +7,19 @@ probabilities from the saved log-sum-exp (the flash-attention trick) in
 two kernels: one accumulating dQ over K blocks, one accumulating dK/dV
 over Q blocks.
 
-Layout: [batch, seq, heads, head_dim] at the API (matching
-ops/attention_ops.py); kernels run on [batch, heads, seq, head_dim].
+Layout: [batch, seq, heads, head_dim] END TO END.  The kernels see the
+row-major [B, L, H*D] view and loop the heads INSIDE (unrolled — each
+head is a static D-column slice), so the [B,L,H,D] -> [B,H,L,D]
+transpose the usual formulation forces is never materialised.  In a
+6-layer transformer those transposes (4 per attention forward + their
+VJPs) were ~23% of the training step on hardware.
 Variable-length rows mask K/V columns at ``seq_lengths`` — identical
 semantics to parallel.context_parallel.dense_attention.
 
-v1 scope: K/V for one (batch, head) pair live in VMEM whole
-(L * head_dim * 4 bytes each) — fine to L ≈ 16k at D=128; block the K/V
-grid dimension too before going past that.
+Scope: K/V for one batch row live in VMEM whole across all heads
+(2 * L * H * D * 2 bytes bf16) — fine to L ≈ 4-8k at H*D = 512; longer
+sequences belong to ring attention over the 'sp' mesh axis
+(parallel/context_parallel.py), which shards L before the kernel runs.
 """
 
 import functools
@@ -30,11 +35,10 @@ _NEG_INF = -1e30
 
 
 def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
-                causal, block_q, block_k, kv_len):
-    iq = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32)  # [bq, D]
+                causal, block_q, block_k, kv_len, heads, d):
+    iq = pl.program_id(1)
     length = lens_ref[pl.program_id(0), 0]
-    bq, d = q.shape
+    bq = q_ref.shape[1]
     nk = kv_len // block_k
     if causal:
         # only K blocks intersecting col <= row can contribute
@@ -44,117 +48,131 @@ def _fwd_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
     row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32,
                                                   (block_q, block_k), 0)
 
-    def body(j, carry):
-        m, l, acc = carry
-        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, kb, (((1, ), (1, )), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        col = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        mask = col < length
-        if causal:
-            mask = jnp.logical_and(mask, col <= row)
-        s = jnp.where(mask, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        p = jnp.where(mask, p, 0.0)
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.dot(p, vb,
-                                    preferred_element_type=jnp.float32)
-        return m_new, l, acc
+    for h in range(heads):
+        q = q_ref[0, :, h * d:(h + 1) * d].astype(jnp.float32)  # [bq, D]
 
-    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
-    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(jnp.maximum(l, 1e-30))  # [bq, 1]
+        def body(j, carry, h=h):
+            m, l, acc = carry
+            kb = k_ref[0, pl.ds(j * block_k, block_k),
+                       h * d:(h + 1) * d].astype(jnp.float32)
+            vb = v_ref[0, pl.ds(j * block_k, block_k),
+                       h * d:(h + 1) * d].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, kb, (((1, ), (1, )), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            col = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = col < length
+            if causal:
+                mask = jnp.logical_and(mask, col <= row)
+            s = jnp.where(mask, s, _NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            p = jnp.where(mask, p, 0.0)
+            alpha = jnp.exp(m - m_new)
+            l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc = acc * alpha + jnp.dot(p, vb,
+                                        preferred_element_type=jnp.float32)
+            return m_new, l, acc
+
+        m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((bq, 1), jnp.float32)
+        acc0 = jnp.zeros((bq, d), jnp.float32)
+        m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+        o_ref[0, :, h * d:(h + 1) * d] = (
+            acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0, :, h] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
 
 
 def _dq_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, *, scale, causal, block_q, block_k, kv_len):
-    iq = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]      # [bq, 1]
-    delta = delta_ref[0, 0]  # [bq, 1]
+               dq_ref, *, scale, causal, block_q, block_k, kv_len, heads,
+               d):
+    iq = pl.program_id(1)
     length = lens_ref[pl.program_id(0), 0]
-    bq, d = q.shape
+    bq = q_ref.shape[1]
     nk = kv_len // block_k
     hi = (jnp.minimum(((iq + 1) * block_q + block_k - 1) // block_k, nk)
           if causal else nk)
     row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32,
                                                   (block_q, block_k), 0)
 
-    def body(j, dq):
-        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, kb, (((1, ), (1, )), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        col = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        mask = col < length
-        if causal:
-            mask = jnp.logical_and(mask, col <= row)
-        p = jnp.exp(jnp.where(mask, s, _NEG_INF) - lse)
-        p = jnp.where(mask, p, 0.0)
-        dp = jax.lax.dot_general(do, vb, (((1, ), (1, )), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
-        return dq + jnp.dot(ds, kb, preferred_element_type=jnp.float32)
+    for h in range(heads):
+        q = q_ref[0, :, h * d:(h + 1) * d].astype(jnp.float32)
+        do = do_ref[0, :, h * d:(h + 1) * d].astype(jnp.float32)
+        lse = lse_ref[0, :, h][:, None]      # [bq, 1]
+        delta = delta_ref[0, :, h][:, None]  # [bq, 1]
 
-    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+        def body(j, dq, h=h, q=q, do=do, lse=lse, delta=delta):
+            kb = k_ref[0, pl.ds(j * block_k, block_k),
+                       h * d:(h + 1) * d].astype(jnp.float32)
+            vb = v_ref[0, pl.ds(j * block_k, block_k),
+                       h * d:(h + 1) * d].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, kb, (((1, ), (1, )), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            col = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = col < length
+            if causal:
+                mask = jnp.logical_and(mask, col <= row)
+            p = jnp.exp(jnp.where(mask, s, _NEG_INF) - lse)
+            p = jnp.where(mask, p, 0.0)
+            dp = jax.lax.dot_general(do, vb, (((1, ), (1, )), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - delta) * scale
+            return dq + jnp.dot(ds, kb, preferred_element_type=jnp.float32)
+
+        dq = jax.lax.fori_loop(0, hi, body,
+                               jnp.zeros((bq, d), jnp.float32))
+        dq_ref[0, :, h * d:(h + 1) * d] = dq.astype(dq_ref.dtype)
 
 
 def _dkv_kernel(lens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale, causal, block_q, block_k, q_len):
-    ik = pl.program_id(2)
-    kb = k_ref[0, 0].astype(jnp.float32)  # [bk, D]
-    vb = v_ref[0, 0].astype(jnp.float32)
+                dk_ref, dv_ref, *, scale, causal, block_q, block_k, q_len,
+                heads, d):
+    ik = pl.program_id(1)
     length = lens_ref[pl.program_id(0), 0]
-    bk, d = kb.shape
+    bk = k_ref.shape[1]
     nq = q_len // block_q
     # with causal masking, Q blocks strictly above the diagonal contribute 0
     lo = (ik * block_k) // block_q if causal else 0
     col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32,
                                                   (block_k, block_q), 0)
 
-    def body(j, carry):
-        dk, dv = carry
-        qb = q_ref[0, 0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        dob = do_ref[0, 0, pl.ds(j * block_q, block_q), :].astype(
-            jnp.float32)
-        lseb = jnp.transpose(
-            lse_ref[0, 0, pl.ds(j * block_q, block_q), :], (1, 0))
-        deltab = jnp.transpose(
-            delta_ref[0, 0, pl.ds(j * block_q, block_q), :], (1, 0))
-        # s_T[bk, bq] = (K Q^T) * scale
-        s = jax.lax.dot_general(
-            kb, qb, (((1, ), (1, )), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        rowq = j * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_k, block_q), 1)
-        mask = col < length
-        if causal:
-            mask = jnp.logical_and(mask, col <= rowq)
-        p = jnp.exp(jnp.where(mask, s, _NEG_INF) - lseb)
-        p = jnp.where(mask, p, 0.0)
-        dv = dv + jnp.dot(p, dob, preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(vb, dob, (((1, ), (1, )), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - deltab) * scale
-        dk = dk + jnp.dot(ds, qb, preferred_element_type=jnp.float32)
-        return dk, dv
+    for h in range(heads):
+        kb = k_ref[0, :, h * d:(h + 1) * d].astype(jnp.float32)  # [bk, D]
+        vb = v_ref[0, :, h * d:(h + 1) * d].astype(jnp.float32)
 
-    z = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(lo, nq, body, (z, z))
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+        def body(j, carry, h=h, kb=kb, vb=vb):
+            dk, dv = carry
+            qb = q_ref[0, pl.ds(j * block_q, block_q),
+                       h * d:(h + 1) * d].astype(jnp.float32)
+            dob = do_ref[0, pl.ds(j * block_q, block_q),
+                         h * d:(h + 1) * d].astype(jnp.float32)
+            lseb = lse_ref[0, pl.ds(j * block_q, block_q), h][None, :]
+            deltab = delta_ref[0, pl.ds(j * block_q, block_q), h][None, :]
+            # s_T[bk, bq] = (K Q^T) * scale
+            s = jax.lax.dot_general(
+                kb, qb, (((1, ), (1, )), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            rowq = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 1)
+            mask = col < length
+            if causal:
+                mask = jnp.logical_and(mask, col <= rowq)
+            p = jnp.exp(jnp.where(mask, s, _NEG_INF) - lseb)
+            p = jnp.where(mask, p, 0.0)
+            dv = dv + jnp.dot(p, dob, preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(vb, dob, (((1, ), (1, )), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - deltab) * scale
+            dk = dk + jnp.dot(ds, qb, preferred_element_type=jnp.float32)
+            return dk, dv
+
+        z = jnp.zeros((bk, d), jnp.float32)
+        dk, dv = jax.lax.fori_loop(lo, nq, body, (z, z))
+        dk_ref[0, :, h * d:(h + 1) * d] = dk.astype(dk_ref.dtype)
+        dv_ref[0, :, h * d:(h + 1) * d] = dv.astype(dv_ref.dtype)
 
 
 def _interpret_default():
@@ -165,87 +183,93 @@ def _pad_len(l, block):
     return ((l + block - 1) // block) * block
 
 
-def _fwd_impl(q, k, v, lens, causal, scale, block_q, block_k, interpret):
-    """q,k,v: [B,H,L,D]; lens: [B,1] int32.  Returns (o, lse)."""
-    b, h, lq, d = q.shape
-    lk = k.shape[2]
-    grid = (b, h, lq // block_q)
-    qspec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, i: (bi, hi, i, 0))
-    kvspec = pl.BlockSpec((1, 1, lk, d), lambda bi, hi, i: (bi, hi, 0, 0))
-    lspec = pl.BlockSpec((b, 1), lambda bi, hi, i: (0, 0),
+def _fwd_impl(q, k, v, lens, causal, scale, block_q, block_k, interpret,
+              heads):
+    """q,k,v: [B,Lq,H*D] / [B,Lk,H*D]; lens: [B,1] int32 -> (o, lse)."""
+    b, lq, hd = q.shape
+    lk = k.shape[1]
+    d = hd // heads
+    grid = (b, lq // block_q)
+    qspec = pl.BlockSpec((1, block_q, hd), lambda bi, i: (bi, i, 0))
+    kvspec = pl.BlockSpec((1, lk, hd), lambda bi, i: (bi, 0, 0))
+    lsespec = pl.BlockSpec((1, block_q, heads), lambda bi, i: (bi, i, 0))
+    lspec = pl.BlockSpec((b, 1), lambda bi, i: (0, 0),
                          memory_space=pltpu.SMEM)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, kv_len=lk),
+                          block_q=block_q, block_k=block_k, kv_len=lk,
+                          heads=heads, d=d),
         grid=grid,
         in_specs=[lspec, qspec, kvspec, kvspec],
-        out_specs=[
-            qspec,
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda bi, hi, i: (bi, hi, i, 0)),
-        ],
+        out_specs=[qspec, lsespec],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, lq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, lq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, lq, hd), q.dtype),
+            jax.ShapeDtypeStruct((b, lq, heads), jnp.float32),
         ],
         interpret=interpret)(lens, q, k, v)
     return o, lse
 
 
 def _bwd_impl(q, k, v, lens, o, lse, do, causal, scale, block_q, block_k,
-              interpret):
-    b, h, lq, d = q.shape
-    lk = k.shape[2]
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1, keepdims=True)  # [B,H,Lq,1]
-    qspec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, i: (bi, hi, i, 0))
-    qfull = pl.BlockSpec((1, 1, lq, d), lambda bi, hi, i: (bi, hi, 0, 0))
-    kvspec = pl.BlockSpec((1, 1, lk, d), lambda bi, hi, i: (bi, hi, 0, 0))
-    kvblk = pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, i: (bi, hi, i, 0))
-    rowblk = pl.BlockSpec((1, 1, block_q, 1),
-                          lambda bi, hi, i: (bi, hi, i, 0))
-    rowfull = pl.BlockSpec((1, 1, lq, 1), lambda bi, hi, i: (bi, hi, 0, 0))
-    lspec = pl.BlockSpec((b, 1), lambda bi, hi, i: (0, 0),
+              interpret, heads):
+    b, lq, hd = q.shape
+    lk = k.shape[1]
+    d = hd // heads
+    # delta[b, t, h] = sum_d do * o per head
+    delta = jnp.sum(
+        (do.astype(jnp.float32) * o.astype(jnp.float32)).reshape(
+            b, lq, heads, d), axis=-1)
+    qspec = pl.BlockSpec((1, block_q, hd), lambda bi, i: (bi, i, 0))
+    qfull = pl.BlockSpec((1, lq, hd), lambda bi, i: (bi, 0, 0))
+    kvspec = pl.BlockSpec((1, lk, hd), lambda bi, i: (bi, 0, 0))
+    kvblk = pl.BlockSpec((1, block_k, hd), lambda bi, i: (bi, i, 0))
+    rowblk = pl.BlockSpec((1, block_q, heads), lambda bi, i: (bi, i, 0))
+    rowfull = pl.BlockSpec((1, lq, heads), lambda bi, i: (bi, 0, 0))
+    lspec = pl.BlockSpec((b, 1), lambda bi, i: (0, 0),
                          memory_space=pltpu.SMEM)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, kv_len=lk),
-        grid=(b, h, lq // block_q),
+                          block_q=block_q, block_k=block_k, kv_len=lk,
+                          heads=heads, d=d),
+        grid=(b, lq // block_q),
         in_specs=[lspec, qspec, kvspec, kvspec, qspec, rowblk, rowblk],
         out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((b, h, lq, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, lq, hd), q.dtype),
         interpret=interpret)(lens, q, k, v, do, lse, delta)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, q_len=lq),
-        grid=(b, h, lk // block_k),
+                          block_q=block_q, block_k=block_k, q_len=lq,
+                          heads=heads, d=d),
+        grid=(b, lk // block_k),
         in_specs=[lspec, qfull, kvblk, kvblk, qfull, rowfull, rowfull],
         out_specs=[kvblk, kvblk],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, lk, d), k.dtype),
-            jax.ShapeDtypeStruct((b, h, lk, d), v.dtype),
+            jax.ShapeDtypeStruct((b, lk, hd), k.dtype),
+            jax.ShapeDtypeStruct((b, lk, hd), v.dtype),
         ],
         interpret=interpret)(lens, q, k, v, do, lse, delta)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, lens, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, lens, causal, scale, block_q, block_k, interpret,
+           heads):
     o, _ = _fwd_impl(q, k, v, lens, causal, scale, block_q, block_k,
-                     interpret)
+                     interpret, heads)
     return o
 
 
-def _flash_fwd(q, k, v, lens, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, lens, causal, scale, block_q, block_k, interpret,
+               heads):
     o, lse = _fwd_impl(q, k, v, lens, causal, scale, block_q, block_k,
-                       interpret)
+                       interpret, heads)
     return o, (q, k, v, lens, o, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, heads, res, do):
     q, k, v, lens, o, lse = res
     dq, dk, dv = _bwd_impl(q, k, v, lens, o, lse, do, causal, scale,
-                           block_q, block_k, interpret)
+                           block_q, block_k, interpret, heads)
     return dq, dk, dv, None
 
 
@@ -270,17 +294,14 @@ def flash_attention(q, k, v, causal=False, scale=None, seq_lengths=None,
     else:
         lens = jnp.asarray(seq_lengths, jnp.int32).reshape(b, 1)
 
-    def to_bhld(x, lpad):
-        x = jnp.transpose(x, (0, 2, 1, 3))  # [B,H,L,D]
-        pad = lpad - x.shape[2]
+    def flat_pad(x, lpad):
+        x = x.reshape(x.shape[0], x.shape[1], heads * d)
+        pad = lpad - x.shape[1]
         if pad:
-            x = jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
         return x
 
-    qt = to_bhld(q, lq_p)
-    kt = to_bhld(k, lk_p)
-    vt = to_bhld(v, lk_p)
-    o = _flash(qt, kt, vt, lens, bool(causal), scale, block_q, block_k,
-               bool(interpret))
-    o = o[:, :, :lq, :]
-    return jnp.transpose(o, (0, 2, 1, 3))
+    o = _flash(flat_pad(q, lq_p), flat_pad(k, lk_p), flat_pad(v, lk_p),
+               lens, bool(causal), scale, block_q, block_k,
+               bool(interpret), heads)
+    return o[:, :lq].reshape(b, lq, heads, d)

@@ -37,7 +37,11 @@ def _attend_block(q, k, v, scale, mask):
     pieces.  q: [B,Lq,H,D], k/v: [B,Lk,H,D], mask: [B,1,Lq,Lk] or None.
     Returns (m, l, acc): running max [B,H,Lq], sum [B,H,Lq],
     numerator [B,Lq,H,D]."""
-    s = jnp.einsum('bqhd,bkhd->bhqk', q, k) * scale
+    # scores and softmax statistics in f32 regardless of input dtype:
+    # bf16 inputs (AMP) keep the MXU fast paths, but a bf16 running
+    # sum/max across thousands of columns drifts (8-bit mantissa)
+    s = jnp.einsum('bqhd,bkhd->bhqk', q, k,
+                   preferred_element_type=jnp.float32) * scale
     if mask is not None:
         s = jnp.where(mask, s, _NEG_INF)
     m = jnp.max(s, axis=-1)
@@ -47,7 +51,8 @@ def _attend_block(q, k, v, scale, mask):
     if mask is not None:
         p = jnp.where(mask, p, 0.0)
     l = jnp.sum(p, axis=-1)
-    acc = jnp.einsum('bhqk,bkhd->bqhd', p, v)
+    acc = jnp.einsum('bhqk,bkhd->bqhd', p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
     return m_safe, l, acc
 
 
@@ -102,9 +107,10 @@ def _ring_local(q, k, v, lens, axis_name, n_steps, causal, scale):
     lkv = k.shape[1]  # cross-attention: K/V chunk length may differ from Q's
     q_pos = idx * lq + jnp.arange(lq)
 
-    m0 = jnp.full((b, h, lq), _NEG_INF / 2, q.dtype)
-    l0 = jnp.zeros((b, h, lq), q.dtype)
-    acc0 = jnp.zeros((b, lq, h, v.shape[-1]), q.dtype)
+    # running statistics live in f32 (see _attend_block)
+    m0 = jnp.full((b, h, lq), _NEG_INF / 2, jnp.float32)
+    l0 = jnp.zeros((b, h, lq), jnp.float32)
+    acc0 = jnp.zeros((b, lq, h, v.shape[-1]), jnp.float32)
 
     def step(carry, t):
         k_blk, v_blk, m, l, acc = carry

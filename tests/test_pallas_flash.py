@@ -90,3 +90,36 @@ def test_program_level_pallas_impl():
             lv, = exe.run(main, feed={'x': xv}, fetch_list=[loss])
             vals.append(float(np.asarray(lv).flatten()[0]))
     assert all(np.isfinite(vals)), vals
+
+
+def test_flash_attention_amp_matches_fp32():
+    """Under AMP the attention inputs cast to bf16 at the op boundary,
+    but softmax statistics stay f32 on every impl — the result must
+    track the fp32 path within bf16-matmul tolerance."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.ops import registry
+
+    rng = np.random.RandomState(0)
+    B, L, H, D = 2, 64, 2, 16
+    qkv = rng.standard_normal((3, B, L, H * D)).astype('float32')
+
+    def run(amp):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            q = fluid.layers.data('q', [L, H * D], dtype='float32')
+            k = fluid.layers.data('k', [L, H * D], dtype='float32')
+            v = fluid.layers.data('v', [L, H * D], dtype='float32')
+            out = fluid.layers.flash_attention(q, k, v, num_heads=H,
+                                               causal=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.core.Scope()), fluid.amp_guard(amp):
+            exe.run(startup)
+            o, = exe.run(main, feed={'q': qkv[0], 'k': qkv[1],
+                                     'v': qkv[2]}, fetch_list=[out])
+        return np.asarray(o, np.float32)
+
+    full = run(False)
+    mixed = run(True)
+    # bf16 inputs: ~2-3 decimal digits; f32 stats keep the error bounded
+    np.testing.assert_allclose(mixed, full, rtol=5e-2, atol=5e-2)
+    assert np.max(np.abs(mixed - full)) < 0.05
