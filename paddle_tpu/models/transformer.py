@@ -27,7 +27,7 @@ def position_encoding(max_len, d_model):
                    -(np.arange(0, d_model, 2).astype('float64') / d_model))
     table = np.zeros((max_len, d_model))
     table[:, 0::2] = np.sin(pos * div)
-    table[:, 1::2] = np.cos(pos * div[:d_model - d_model // 2])
+    table[:, 1::2] = np.cos(pos * div[:d_model // 2])
     return table[None].astype('float32')
 
 

@@ -114,9 +114,16 @@ def _swish(ctx, op):
 
 @register_lowering('softmax')
 def _softmax(ctx, op):
-    # fluid softmax normalizes the trailing axis (operators/softmax_op.cc)
+    # fluid softmax normalizes the trailing axis (operators/softmax_op.cc);
+    # the exp/sum runs f32 even for bf16 inputs (AMP) — over wide axes a
+    # bf16 denominator drifts — and the output lands back in input dtype
     x = ctx.get(op, 'X')
-    ctx.set(op, 'Out', jax.nn.softmax(x, axis=-1))
+    if x.dtype == jnp.bfloat16:
+        ctx.set(op, 'Out',
+                jax.nn.softmax(x.astype(jnp.float32),
+                               axis=-1).astype(x.dtype))
+    else:
+        ctx.set(op, 'Out', jax.nn.softmax(x, axis=-1))
 
 
 @register_lowering('prelu')

@@ -19,6 +19,8 @@ def _index_label(label):
 @register_lowering('cross_entropy')
 def _cross_entropy(ctx, op):
     x = ctx.get(op, 'X')  # probabilities (N, C)
+    if x.dtype == jnp.bfloat16:
+        x = x.astype(jnp.float32)  # log() of bf16 probs loses digits
     label = ctx.get(op, 'Label')
     if op.attrs.get('soft_label', False):
         loss = -jnp.sum(label * jnp.log(jnp.maximum(x, _EPS)), axis=-1,
@@ -37,6 +39,10 @@ def _cross_entropy(ctx, op):
 def _softmax_with_cross_entropy(ctx, op):
     logits = ctx.get(op, 'Logits')
     label = ctx.get(op, 'Label')
+    # bf16 logits (AMP) read at half HBM width, but the exp/sum over a
+    # large vocab must run f32 — the upcast fuses into the reduction
+    if logits.dtype == jnp.bfloat16:
+        logits = logits.astype(jnp.float32)
     log_p = jax.nn.log_softmax(logits, axis=-1)
     softmax = jnp.exp(log_p)
     if op.attrs.get('soft_label', False):

@@ -233,14 +233,17 @@ def _layer_norm(ctx, op):
     eps = op.attrs.get('epsilon', 1e-5)
     begin = op.attrs.get('begin_norm_axis', 1)
     axes = tuple(range(begin, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
-    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    # statistics accumulate in f32 even when bf16 activations flow in
+    # (same policy as _batch_norm: bf16 mean/var reductions drift)
+    xs = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
+    mean = jnp.mean(xs, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xs - mean), axis=axes, keepdims=True)
+    y = ((xs - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
     norm_shape = (1, ) * begin + x.shape[begin:]
     if scale is not None:
-        y = y * jnp.reshape(scale, norm_shape)
+        y = y * jnp.reshape(scale, norm_shape).astype(x.dtype)
     if bias is not None:
-        y = y + jnp.reshape(bias, norm_shape)
+        y = y + jnp.reshape(bias, norm_shape).astype(x.dtype)
     ctx.set(op, 'Y', y)
     ctx.set(op, 'Mean', jnp.reshape(mean, mean.shape[:begin]))
     ctx.set(op, 'Variance', jnp.reshape(var, var.shape[:begin]))

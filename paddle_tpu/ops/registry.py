@@ -380,10 +380,14 @@ def amp_cast_out(out):
 
 def amp_matmul(x, y):
     """The one home of the AMP matmul policy: bf16 operands with fp32
-    accumulation (preferred_element_type) when AMP is on."""
+    accumulation (preferred_element_type) when AMP is on, and the
+    result LANDS bf16 (amp_cast_out) — the epilogue cast fuses into the
+    matmul, so fc activations cross HBM at half width like conv
+    activations do."""
     import jax.numpy as jnp
     x, y = amp_cast_in(x, y)
-    return jnp.matmul(
-        x, y,
-        preferred_element_type=jnp.float32
-        if (_AMP['enabled'] and x.dtype == jnp.bfloat16) else None)
+    return amp_cast_out(
+        jnp.matmul(
+            x, y,
+            preferred_element_type=jnp.float32
+            if (_AMP['enabled'] and x.dtype == jnp.bfloat16) else None))

@@ -92,12 +92,13 @@ def test_program_level_pallas_impl():
     assert all(np.isfinite(vals)), vals
 
 
-def test_flash_attention_amp_matches_fp32():
+@pytest.mark.parametrize('impl', ['dense', 'pallas'])
+def test_flash_attention_amp_matches_fp32(impl):
     """Under AMP the attention inputs cast to bf16 at the op boundary,
     but softmax statistics stay f32 on every impl — the result must
-    track the fp32 path within bf16-matmul tolerance."""
+    track the fp32 path within bf16-matmul tolerance.  The pallas case
+    runs the kernel in interpret mode on CPU."""
     import paddle_tpu.fluid as fluid
-    from paddle_tpu.ops import registry
 
     rng = np.random.RandomState(0)
     B, L, H, D = 2, 64, 2, 16
@@ -110,7 +111,7 @@ def test_flash_attention_amp_matches_fp32():
             k = fluid.layers.data('k', [L, H * D], dtype='float32')
             v = fluid.layers.data('v', [L, H * D], dtype='float32')
             out = fluid.layers.flash_attention(q, k, v, num_heads=H,
-                                               causal=True)
+                                               causal=True, impl=impl)
         exe = fluid.Executor(fluid.CPUPlace())
         with fluid.scope_guard(fluid.core.Scope()), fluid.amp_guard(amp):
             exe.run(startup)
