@@ -117,13 +117,10 @@ def _softmax(ctx, op):
     # fluid softmax normalizes the trailing axis (operators/softmax_op.cc);
     # the exp/sum runs f32 even for bf16 inputs (AMP) — over wide axes a
     # bf16 denominator drifts — and the output lands back in input dtype
+    from .registry import amp_upcast_f32
     x = ctx.get(op, 'X')
-    if x.dtype == jnp.bfloat16:
-        ctx.set(op, 'Out',
-                jax.nn.softmax(x.astype(jnp.float32),
-                               axis=-1).astype(x.dtype))
-    else:
-        ctx.set(op, 'Out', jax.nn.softmax(x, axis=-1))
+    ctx.set(op, 'Out',
+            jax.nn.softmax(amp_upcast_f32(x), axis=-1).astype(x.dtype))
 
 
 @register_lowering('prelu')

@@ -9,8 +9,11 @@ implementation for where it runs:
 - SPMD executor with an 'sp' (sequence/context parallel) mesh axis:
   **ring attention** (K/V blocks rotate on ICI neighbor links) or
   **Ulysses** all-to-all head resharding, per the ``impl`` attr;
-- single device on TPU: Pallas flash-attention kernel (VMEM-blocked online
-  softmax — never materialises the [L, L] score matrix in HBM);
+- single device on TPU: dense XLA attention while the [B,H,Lq,Lk] score
+  tensor fits the budget (measured faster than the v1 Pallas kernel at
+  every length that fits), switching to the Pallas flash kernel
+  (VMEM-blocked online softmax, O(L) memory — never materialises the
+  [L, L] scores in HBM) beyond it;
 - otherwise: dense XLA attention.
 
 Layout: Q, K, V are [batch, seq, heads, head_dim].  Variable-length
@@ -22,7 +25,15 @@ from . import registry
 from .registry import register_lowering
 
 
-def _pick_impl(ctx, op):
+# 'auto' switches dense -> pallas when the materialised [B,H,Lq,Lk] f32
+# score tensor would exceed this budget.  Measured on v5e (fwd+bwd, AMP):
+# XLA's fused dense attention beats the v1 Pallas kernel on raw speed at
+# every length that FITS (256..4096), so the kernel's job is the O(L)
+# memory profile that keeps long contexts compiling at all.
+_DENSE_SCORE_BYTES_BUDGET = 2 << 30
+
+
+def _pick_impl(ctx, op, q=None, k=None):
     impl = op.attrs.get('impl', 'auto')
     mesh = ctx.mesh
     sp = op.attrs.get('sp_axis', 'sp')
@@ -36,8 +47,11 @@ def _pick_impl(ctx, op):
                       ctx.place.jax_device().platform != 'cpu')
         except Exception:
             on_tpu = False
-        if on_tpu:
-            return 'pallas'
+        if on_tpu and q is not None and k is not None:
+            b, lq = q.shape[0], q.shape[1]
+            lk, h = k.shape[1], (q.shape[2] if q.ndim == 4 else 1)
+            if b * h * lq * lk * 4 > _DENSE_SCORE_BYTES_BUDGET:
+                return 'pallas'
         return 'dense'
     if impl in ('ring', 'ulysses') and not has_sp:
         import warnings
@@ -57,10 +71,10 @@ def flash_attention_lowering(ctx, op):
     q = ctx.get(op, 'Q')
     k = ctx.get(op, 'K')
     v = ctx.get(op, 'V')
-    # under AMP the projections arrive fp32 (matmul accumulation dtype);
-    # cast HERE so the layout transposes into the kernel move half the
-    # bytes, the kernel's matmuls run at bf16 MXU rate, and the output
-    # stays bf16 in HBM (amp_cast_out policy)
+    # under AMP the projections normally arrive bf16 already (amp_matmul
+    # lands bf16); this cast is the safety net for fp32 producers (e.g.
+    # a biased path before harmonization, or AMP-off callers of a mixed
+    # graph) so the kernel never runs a widened layout
     q, k, v = amp_cast_in(q, k, v)
     causal = bool(op.attrs.get('causal', False))
     scale = op.attrs.get('scale', None)
@@ -73,7 +87,7 @@ def flash_attention_lowering(ctx, op):
     names = op.input('K')
     if names and ctx.has(names[0] + registry.SEQLEN_SUFFIX):
         lens = ctx.lookup(names[0] + registry.SEQLEN_SUFFIX)
-    impl = _pick_impl(ctx, op)
+    impl = _pick_impl(ctx, op, q=q, k=k)
     if impl in ('ring', 'ulysses'):
         sp = op.attrs.get('sp_axis', 'sp')
         mesh = ctx.mesh

@@ -222,8 +222,12 @@ def _recurrent(ctx, op):
             new_val = env[upd] if upd is not None else env[m]
             old_val = carry[m]
             mm = jnp.reshape(m_t, (b, ) + (1, ) * (new_val.ndim - 1))
-            # boolean select keeps integer memories (e.g. beam ids) exact
-            new_carry[m] = jnp.where(mm, new_val, old_val)
+            # the carry type must be stable across steps: in-block math
+            # may promote (bf16 state + f32 gate math under AMP) — fold
+            # the update back to the memory's own dtype.  boolean select
+            # keeps integer memories (e.g. beam ids) exact
+            new_carry[m] = jnp.where(mm, new_val.astype(old_val.dtype),
+                                     old_val)
         outs = []
         for on in out_names:
             o = env[on]

@@ -4,7 +4,7 @@ softmax_with_cross_entropy_op.cc, and the *_loss_op.cc family)."""
 import jax
 import jax.numpy as jnp
 
-from .registry import register_lowering
+from .registry import register_lowering, amp_upcast_f32
 
 _EPS = 1e-12
 
@@ -18,9 +18,8 @@ def _index_label(label):
 
 @register_lowering('cross_entropy')
 def _cross_entropy(ctx, op):
-    x = ctx.get(op, 'X')  # probabilities (N, C)
-    if x.dtype == jnp.bfloat16:
-        x = x.astype(jnp.float32)  # log() of bf16 probs loses digits
+    # log() of bf16 probabilities loses digits — compute f32
+    x = amp_upcast_f32(ctx.get(op, 'X'))  # probabilities (N, C)
     label = ctx.get(op, 'Label')
     if op.attrs.get('soft_label', False):
         loss = -jnp.sum(label * jnp.log(jnp.maximum(x, _EPS)), axis=-1,
@@ -37,12 +36,10 @@ def _cross_entropy(ctx, op):
 
 @register_lowering('softmax_with_cross_entropy')
 def _softmax_with_cross_entropy(ctx, op):
-    logits = ctx.get(op, 'Logits')
-    label = ctx.get(op, 'Label')
     # bf16 logits (AMP) read at half HBM width, but the exp/sum over a
     # large vocab must run f32 — the upcast fuses into the reduction
-    if logits.dtype == jnp.bfloat16:
-        logits = logits.astype(jnp.float32)
+    logits = amp_upcast_f32(ctx.get(op, 'Logits'))
+    label = ctx.get(op, 'Label')
     log_p = jax.nn.log_softmax(logits, axis=-1)
     softmax = jnp.exp(log_p)
     if op.attrs.get('soft_label', False):
@@ -59,7 +56,7 @@ def _softmax_with_cross_entropy(ctx, op):
 
 @register_lowering('sigmoid_cross_entropy_with_logits')
 def _sigmoid_ce(ctx, op):
-    x = ctx.get(op, 'X')
+    x = amp_upcast_f32(ctx.get(op, 'X'))
     label = ctx.get(op, 'Label')
     # max(x,0) - x*z + log(1+exp(-|x|)), numerically stable
     loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
@@ -100,7 +97,7 @@ def _smooth_l1(ctx, op):
 
 @register_lowering('log_loss')
 def _log_loss(ctx, op):
-    p = ctx.get(op, 'Predicted')
+    p = amp_upcast_f32(ctx.get(op, 'Predicted'))
     label = ctx.get(op, 'Labels')
     eps = op.attrs.get('epsilon', 1e-4)
     loss = -label * jnp.log(p + eps) - (1 - label) * jnp.log(1 - p + eps)
@@ -118,8 +115,8 @@ def _hinge_loss(ctx, op):
 @register_lowering('rank_loss')
 def _rank_loss(ctx, op):
     label = ctx.get(op, 'Label')
-    left = ctx.get(op, 'Left')
-    right = ctx.get(op, 'Right')
+    left = amp_upcast_f32(ctx.get(op, 'Left'))
+    right = amp_upcast_f32(ctx.get(op, 'Right'))
     d = left - right
     ctx.set(op, 'Out', jnp.log1p(jnp.exp(d)) - label * d)
 

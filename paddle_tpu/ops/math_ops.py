@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .registry import register_lowering, amp_matmul
+from .registry import register_lowering, amp_matmul, amp_harmonize
 
 
 def _flatten_2d(x, num_col_dims):
@@ -121,6 +121,9 @@ def _register_elementwise(name, fn):
             if xd is not None and xd.shape and len(xd.shape) != x.ndim:
                 axis = -1
         y = _bcast_y(x, y, axis)
+        # bf16 activation + f32 parameter (fc bias, scales) computes
+        # bf16 under AMP — promotion would re-widen the activation
+        x, y = amp_harmonize(x, y)
         ctx.set(op, 'Out', fn(x, y))
 
 

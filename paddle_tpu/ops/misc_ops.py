@@ -21,7 +21,7 @@ import jax.numpy as jnp
 
 from .registry import (register_lowering, register_host_op, _LOWERINGS,
                        SEQLEN_SUFFIX, amp_cast_in, amp_cast_out,
-                       amp_matmul)
+                       amp_matmul, amp_harmonize)
 
 
 # ---- aliases: same kernel, second registered name ----
@@ -41,7 +41,9 @@ def _fc(ctx, op):
     x2 = jnp.reshape(x, (int(np.prod(x.shape[:num_col_dims])), -1))
     out = amp_matmul(x2, w)
     if bias is not None:
-        out = out + jnp.reshape(bias, (1, -1))
+        # the f32 bias must not re-widen a bf16 activation (AMP)
+        out, b = amp_harmonize(out, jnp.reshape(bias, (1, -1)))
+        out = out + b
     out = jnp.reshape(out, tuple(x.shape[:num_col_dims]) + (w.shape[1], ))
     ctx.set(op, 'Out', out)
 

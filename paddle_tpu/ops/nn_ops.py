@@ -12,6 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .registry import (register_lowering, register_grad_lowering,
+                       amp_upcast_f32,
                        fwd_structure, amp_cast_in, amp_cast_out,
                        amp_enabled)
 
@@ -235,7 +236,7 @@ def _layer_norm(ctx, op):
     axes = tuple(range(begin, x.ndim))
     # statistics accumulate in f32 even when bf16 activations flow in
     # (same policy as _batch_norm: bf16 mean/var reductions drift)
-    xs = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
+    xs = amp_upcast_f32(x)
     mean = jnp.mean(xs, axis=axes, keepdims=True)
     var = jnp.mean(jnp.square(xs - mean), axis=axes, keepdims=True)
     y = ((xs - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype)

@@ -378,6 +378,35 @@ def amp_cast_out(out):
     return out
 
 
+def amp_upcast_f32(x):
+    """Precision-sensitive math (softmax/norm statistics, loss
+    exp/log paths) computes f32 even when AMP lands activations bf16;
+    the upcast fuses into the consuming reduction, so HBM still sees
+    bf16.  The ONE home of the upcast policy — lowerings call this
+    instead of hand-rolling dtype checks."""
+    import jax.numpy as jnp
+    if x is not None and hasattr(x, 'dtype') and x.dtype == jnp.bfloat16:
+        return x.astype(jnp.float32)
+    return x
+
+
+def amp_harmonize(x, y):
+    """Mixed bf16/f32 elementwise operands compute bf16 under AMP: the
+    f32 side is a parameter (bias, scale) whose in-register cast fuses,
+    and promoting instead would re-widen every biased fc activation back
+    to f32 in HBM.  Without AMP, normal promotion applies untouched."""
+    import jax.numpy as jnp
+    if not _AMP['enabled']:
+        return x, y
+    dx = getattr(x, 'dtype', None)
+    dy = getattr(y, 'dtype', None)
+    if dx == jnp.bfloat16 and dy == jnp.float32:
+        y = y.astype(jnp.bfloat16)
+    elif dy == jnp.bfloat16 and dx == jnp.float32:
+        x = x.astype(jnp.bfloat16)
+    return x, y
+
+
 def amp_matmul(x, y):
     """The one home of the AMP matmul policy: bf16 operands with fp32
     accumulation (preferred_element_type) when AMP is on, and the

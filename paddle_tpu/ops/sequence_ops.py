@@ -304,23 +304,32 @@ def _lstm(ctx, op):
         w_ic = bias[0, 4 * d:5 * d]
         w_fc = bias[0, 5 * d:6 * d]
         w_oc = bias[0, 6 * d:7 * d]
-    h_prev = h0 if h0 is not None else jnp.zeros((b_sz, d), x.dtype)
-    c_prev = c0 if c0 is not None else jnp.zeros((b_sz, d), x.dtype)
+    # dtype flow under AMP: the sequence x and hidden state h stay in
+    # x's dtype (bf16 — the recurrent matmul rides the MXU fast path via
+    # the bf16-cast weight), while gates and the CELL state compute and
+    # carry in f32: c accumulates across T steps, exactly the drift an
+    # 8-bit mantissa cannot hold
+    cd = x.dtype
+    w_r = w.astype(cd)
+    h_prev = (h0.astype(cd) if h0 is not None
+              else jnp.zeros((b_sz, d), cd))
+    c_prev = (c0.astype(jnp.float32) if c0 is not None
+              else jnp.zeros((b_sz, d), jnp.float32))
 
     xs = jnp.swapaxes(x, 0, 1)  # [T, B, 4D]
     if is_reverse:
         xs = jnp.flip(xs, 0)
     if lengths is None:
-        step_mask = jnp.ones((t, b_sz), x.dtype)
+        step_mask = jnp.ones((t, b_sz), jnp.float32)
     else:
-        step_mask = _mask(x, lengths, x.dtype).T  # [T, B]
+        step_mask = _mask(x, lengths, jnp.float32).T  # [T, B]
         if is_reverse:
             step_mask = jnp.flip(step_mask, 0)
 
     def step(carry, inp):
         h, c = carry
         x_t, m_t = inp
-        gates = x_t + h @ w + gate_bias
+        gates = (x_t + h @ w_r).astype(jnp.float32) + gate_bias
         # reference gate layout: [candidate(in), input, forget, output]
         # (math/detail/lstm_cpu_kernel.h:44-47)
         gc, gi, gf, go = jnp.split(gates, 4, axis=1)
@@ -335,7 +344,7 @@ def _lstm(ctx, op):
         o = gate_act(go)
         h_new = o * cell_act(c_new)
         m = m_t[:, None]
-        h_out = m * h_new + (1 - m) * h
+        h_out = (m * h_new + (1 - m) * h.astype(jnp.float32)).astype(cd)
         c_out = m * c_new + (1 - m) * c
         return (h_out, c_out), (h_out, c_out)
 
@@ -344,9 +353,9 @@ def _lstm(ctx, op):
         hs = jnp.flip(hs, 0)
         cs = jnp.flip(cs, 0)
     ctx.set(op, 'Hidden', jnp.swapaxes(hs, 0, 1))
-    ctx.set(op, 'Cell', jnp.swapaxes(cs, 0, 1))
+    ctx.set(op, 'Cell', jnp.swapaxes(cs, 0, 1).astype(cd))
     ctx.set(op, 'BatchGate', x)
-    ctx.set(op, 'BatchCellPreAct', jnp.swapaxes(cs, 0, 1))
+    ctx.set(op, 'BatchCellPreAct', jnp.swapaxes(cs, 0, 1).astype(cd))
 
 
 @register_lowering('gru')
@@ -364,31 +373,41 @@ def _gru(ctx, op):
 
     b_sz, t, d3 = x.shape
     d = d3 // 3
-    w_g = w[:, :2 * d]  # update+reset recurrent weights
-    w_c = w[:, 2 * d:]
+    # same AMP dtype flow as _lstm: x/h in x's dtype for the MXU, the
+    # gate math in f32; the bias adds INSIDE the step so the whole
+    # [B, T, 3D] sequence is never widened to f32 in HBM
+    cd = x.dtype
+    w_g = w[:, :2 * d].astype(cd)  # update+reset recurrent weights
+    w_c = w[:, 2 * d:].astype(cd)
     if bias is not None:
-        x = x + bias
-    h_prev = h0 if h0 is not None else jnp.zeros((b_sz, d), x.dtype)
+        bias_g = bias.reshape(1, -1)[:, :2 * d].astype(jnp.float32)
+        bias_c = bias.reshape(1, -1)[:, 2 * d:].astype(jnp.float32)
+    else:
+        bias_g = bias_c = 0.0
+    h_prev = h0.astype(cd) if h0 is not None else jnp.zeros((b_sz, d), cd)
 
     xs = jnp.swapaxes(x, 0, 1)
     if is_reverse:
         xs = jnp.flip(xs, 0)
     if lengths is None:
-        step_mask = jnp.ones((t, b_sz), x.dtype)
+        step_mask = jnp.ones((t, b_sz), jnp.float32)
     else:
-        step_mask = _mask(x, lengths, x.dtype).T
+        step_mask = _mask(x, lengths, jnp.float32).T
         if is_reverse:
             step_mask = jnp.flip(step_mask, 0)
 
     def step(h, inp):
         x_t, m_t = inp
-        gu_gr = gate_act(x_t[:, :2 * d] + h @ w_g)
+        gu_gr = gate_act(
+            (x_t[:, :2 * d] + h @ w_g).astype(jnp.float32) + bias_g)
         u, r = jnp.split(gu_gr, 2, axis=1)
-        c = cand_act(x_t[:, 2 * d:] + (r * h) @ w_c)
+        c = cand_act((x_t[:, 2 * d:] +
+                      (r.astype(cd) * h) @ w_c).astype(jnp.float32) +
+                     bias_c)
         # reference: h = (1-u)*h_prev + u*c (math/detail/gru_kernel.h:62)
-        h_new = (1 - u) * h + u * c
+        h_new = (1 - u) * h.astype(jnp.float32) + u * c
         m = m_t[:, None]
-        h_out = m * h_new + (1 - m) * h
+        h_out = (m * h_new + (1 - m) * h.astype(jnp.float32)).astype(cd)
         return h_out, h_out
 
     _, hs = jax.lax.scan(step, h_prev, (xs, step_mask))
