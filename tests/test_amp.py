@@ -110,3 +110,45 @@ def test_amp_loss_parity_with_fp32_training():
     # both optimized the same schedule; bf16 rounding noise only
     assert l_amp < 1.0, (l_amp, l_fp32)  # genuinely trained (start ~1.39)
     assert abs(l_amp - l_fp32) < 0.15, (l_amp, l_fp32)
+
+
+def test_amp_lstm_training_loss_parity():
+    """The AMP recurrence policy (bf16 sequence/hidden state, f32 gate
+    math, f32 LSTM cell carry) must track fp32 training — an all-bf16
+    cell accumulator would drift across time steps."""
+    import contextlib
+    from helpers import lod_feed
+
+    def train(amp):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            words = fluid.layers.data('words', [1], dtype='int64',
+                                      lod_level=1)
+            label = fluid.layers.data('label', [1], dtype='int64')
+            emb = fluid.layers.embedding(input=words, size=[50, 16])
+            proj = fluid.layers.fc(input=emb, size=32 * 4)
+            h, _ = fluid.layers.dynamic_lstm(input=proj, size=32 * 4)
+            last = fluid.layers.sequence_last_step(input=h)
+            pred = fluid.layers.fc(input=last, size=2, act='softmax')
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(pred, label))
+            fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+        startup.random_seed = 3
+        rng = np.random.RandomState(0)
+        rows = [rng.randint(0, 50, (l, 1)).tolist()
+                for l in (7, 12, 5, 9, 11, 6, 8, 10)]
+        feed = {'words': lod_feed(rows, 'int64'),
+                'label': rng.randint(0, 2, (8, 1)).astype('int64')}
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.core.Scope()):
+            exe.run(startup)
+            guard = fluid.amp_guard() if amp else contextlib.nullcontext()
+            with guard:
+                for _ in range(20):
+                    lv, = exe.run(main, feed=feed, fetch_list=[loss])
+        return float(np.asarray(lv).flatten()[0])
+
+    l_fp32 = train(False)
+    l_amp = train(True)
+    assert l_fp32 < 0.3, l_fp32  # overfits the fixed batch
+    assert abs(l_amp - l_fp32) < 0.1, (l_amp, l_fp32)
