@@ -26,8 +26,11 @@ from .attrs import *  # noqa: F401,F403
 from .optimizers import *  # noqa: F401,F403
 from .layers import *  # noqa: F401,F403
 from .networks import *  # noqa: F401,F403
+from .evaluators import *  # noqa: F401,F403
 
-from . import activations, poolings, attrs, optimizers, layers, networks
+from . import activations, poolings, attrs, optimizers, layers, \
+    networks, evaluators
 
 __all__ = (activations.__all__ + poolings.__all__ + attrs.__all__ +
-           optimizers.__all__ + layers.__all__ + networks.__all__)
+           optimizers.__all__ + layers.__all__ + networks.__all__ +
+           evaluators.__all__)

@@ -1,0 +1,85 @@
+"""Legacy evaluator DSL (reference
+trainer_config_helpers/evaluators.py:18 — evaluators attach metric
+computations to a config's output layers).
+
+Each evaluator returns a v2 DAG node computing the metric through the
+fluid metric ops (layers/metric_op.py), so legacy configs that attach
+evaluators get real, fetchable metric values from the same compiled
+program."""
+
+from ..v2 import layer as _v2
+from .. import fluid
+
+__all__ = [
+    'classification_error_evaluator', 'auc_evaluator',
+    'ctc_error_evaluator', 'chunk_evaluator', 'sum_evaluator',
+    'column_sum_evaluator',
+]
+
+
+def _metric_layer(kind, parents, build, name):
+    layer = _v2.Layer(kind, parents, build, name=name)
+    layer.is_evaluator = True
+    return layer
+
+
+def classification_error_evaluator(input, label, name=None, **kwargs):
+    """Error rate = 1 - accuracy (reference evaluators.py:220)."""
+
+    def build(ctx, input_var, label_var):
+        acc = fluid.layers.accuracy(input=input_var, label=label_var)
+        return fluid.layers.scale(acc, scale=-1.0, bias=1.0)
+
+    return _metric_layer('classification_error', [input, label], build,
+                         name)
+
+
+def auc_evaluator(input, label, name=None, **kwargs):
+    """(reference evaluators.py:272)"""
+
+    def build(ctx, input_var, label_var):
+        auc_out, _, _ = fluid.layers.auc(input=input_var, label=label_var)
+        return auc_out
+
+    return _metric_layer('auc', [input, label], build, name)
+
+
+def ctc_error_evaluator(input, label, name=None, **kwargs):
+    """Edit-distance between CTC decodes and labels
+    (reference evaluators.py:398)."""
+
+    def build(ctx, input_var, label_var):
+        decoded = fluid.layers.ctc_greedy_decoder(input=input_var,
+                                                  blank=0)
+        dist, _ = fluid.layers.edit_distance(decoded, label_var)
+        return fluid.layers.mean(dist)
+
+    return _metric_layer('ctc_error', [input, label], build, name)
+
+
+def chunk_evaluator(input, label, chunk_scheme='IOB', num_chunk_types=1,
+                    name=None, **kwargs):
+    """Chunk F1 (reference evaluators.py:425)."""
+
+    def build(ctx, input_var, label_var):
+        _, _, f1, _, _, _ = fluid.layers.chunk_eval(
+            input=input_var, label=label_var,
+            chunk_scheme=chunk_scheme.lower(),
+            num_chunk_types=num_chunk_types)
+        return f1
+
+    return _metric_layer('chunk_f1', [input, label], build, name)
+
+
+def sum_evaluator(input, name=None, **kwargs):
+    def build(ctx, input_var):
+        return fluid.layers.reduce_sum(input_var)
+
+    return _metric_layer('sum', [input], build, name)
+
+
+def column_sum_evaluator(input, name=None, **kwargs):
+    def build(ctx, input_var):
+        return fluid.layers.reduce_sum(input_var, dim=0)
+
+    return _metric_layer('column_sum', [input], build, name)

@@ -81,3 +81,33 @@ def test_legacy_sequence_config_with_networks():
                                'int64')},
                      fetch_list=[topo.cost_var])
     assert np.isfinite(float(np.asarray(v).ravel()[0]))
+
+
+def test_legacy_evaluators_compute_metrics():
+    """Evaluator DSL nodes materialize into the same program and return
+    real metric values (reference evaluators.py attaches metric
+    computations to output layers)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.v2.topology import Topology
+
+    tch.settings(batch_size=4, learning_rate=0.01)
+    x = tch.data_layer(name='x', size=8)
+    pred = tch.fc_layer(input=x, size=3, act=tch.SoftmaxActivation())
+    lbl = tch.data_layer(name='label', size=3, data_type_kind='index')
+    cost = tch.classification_cost(input=pred, label=lbl)
+    err = tch.classification_error_evaluator(input=pred, label=lbl)
+
+    topo = Topology(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(4)
+    feed = {'x': rng.standard_normal((6, 8)).astype('float32'),
+            'label': rng.randint(0, 3, (6, 1)).astype('int64')}
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(topo.startup_program)
+        with fluid.program_guard(topo.main_program, topo.startup_program):
+            err_var = err.to_fluid(topo._ctx)
+        c_v, e_v = exe.run(topo.main_program, feed=feed,
+                           fetch_list=[topo.cost_var, err_var])
+    err_val = float(np.asarray(e_v).ravel()[0])
+    assert 0.0 <= err_val <= 1.0, err_val
+    assert np.isfinite(float(np.asarray(c_v).ravel()[0]))
