@@ -106,7 +106,7 @@ def as_numpy(value):
     return np.asarray(value)
 
 
-def _pop_readers_into_feed(program, feed):
+def _pop_readers_into_feed(program, feed, place=None):
     """For each read op, pop one minibatch from its py_reader queue and
     inject it as feeds (reference: reader ops produce LoDTensors inside the
     interpreter loop; here data stays ahead of the compiled step).  Raises
@@ -120,6 +120,11 @@ def _pop_readers_into_feed(program, feed):
         if feeder is None:
             raise RuntimeError('no py_reader registered for %r' %
                                reader_name)
+        if place is not None:
+            # bind the prefetch target to the executor CONSUMING this
+            # reader (per-feeder, so an interleaved CPU eval executor
+            # can't re-route a TPU train reader's staging)
+            feeder._executor_place = place
         batch = feeder.pop()
         if batch is None:
             raise core.EOFException(
@@ -482,7 +487,9 @@ class Executor(object):
             f.name if isinstance(f, Variable) else str(f) for f in fetch_list
         ]
         feed = dict(feed)
-        _pop_readers_into_feed(program, feed)
+        from .layers import io as layers_io
+        layers_io.note_executor_place(self.place)
+        _pop_readers_into_feed(program, feed, self.place)
         feed_arrays = prepare_feed_arrays(feed)
         validate_feed(program, feed_arrays)
         sig = feed_signature(feed_arrays)

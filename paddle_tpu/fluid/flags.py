@@ -79,9 +79,11 @@ def get_flag(name):
 
 def set_flag(name, value):
     flag = _registry[name]
-    flag.value = _coerce(flag, value)
+    new = _coerce(flag, value)
+    # on_set doubles as validator: a raise must leave the old value
     if flag.on_set is not None:
-        flag.on_set(flag.value)
+        flag.on_set(new)
+    flag.value = new
 
 
 def on_set(name, fn):
@@ -165,8 +167,26 @@ DEFINE_int32('rpc_deadline', 180000,
 DEFINE_bool('eager_delete_scope', True,
             'Drop executor kid scopes eagerly (scope lifetimes are '
             'Python-managed here; kept for launcher parity).')
+DEFINE_string('fused_lstm', 'auto',
+              "lstm-op recurrence impl: 'auto' picks the fused Pallas "
+              "cell kernel (ops/pallas/lstm.py) when the shape profile "
+              "wins on TPU (256 <= D <= 512, lane-aligned, default "
+              'activations, no peepholes - measured +14-15% fwd+bwd at '
+              "D=512), 'never' always uses the lax.scan path, 'always' "
+              'forces the kernel wherever it is legal.  lstmp (projected '
+              'recurrence) always uses the scan path.')
 
 on_set('check_nan_inf', _toggle_jax_debug_nans)
+
+
+def _validate_fused_lstm(value):
+    if value not in ('auto', 'never', 'always'):
+        raise ValueError(
+            "FLAGS_fused_lstm must be 'auto', 'never' or 'always' "
+            '(got %r)' % (value, ))
+
+
+on_set('fused_lstm', _validate_fused_lstm)
 
 # the reference whitelists which flags may come from the environment
 # (__init__.py:121-141); everything defined above is eligible here

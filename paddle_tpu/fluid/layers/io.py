@@ -80,8 +80,25 @@ class _PyReaderFeeder(object):
         # set by double_buffer(): batches are padded + device_put on a
         # prefetch thread so transfer of batch N+1 overlaps step N
         self._double_buffer_place = None
+        self._double_buffer_requested = False
+        self._executor_place = None  # bound by the consuming Executor
         self._dev_queue = None
         self._convert_thread = None
+
+    def _effective_db_place(self):
+        """Prefetch target: explicit double_buffer place, else the place
+        of the executor consuming THIS reader (bound per-feeder at pop
+        time), else the place of whichever executor last ran (covers the
+        batches converted before the first pop), else the build
+        default."""
+        if self._double_buffer_place is not None:
+            return self._double_buffer_place
+        if self._executor_place is not None:
+            return self._executor_place
+        if _last_executor_place is not None:
+            return _last_executor_place
+        return core.TPUPlace() if core.is_compiled_with_tpu() \
+            else core.CPUPlace()
 
     def decorate_paddle_reader(self, reader, places=None):
         """reader yields per-sample tuples; batches are assembled with
@@ -123,7 +140,7 @@ class _PyReaderFeeder(object):
         if self._shuffle_buffer > 1:
             provider = _shuffled_provider(provider, self._shuffle_buffer)
 
-        if self._double_buffer_place is not None:
+        if self._double_buffer_requested:
             self._start_zero_copy_pipeline(provider)
             return
 
@@ -150,7 +167,7 @@ class _PyReaderFeeder(object):
     def _convert_batch(self, item):
         import jax
         from ..executor import _lod_to_padded
-        dev = self._double_buffer_place.jax_device()
+        dev = self._effective_db_place().jax_device()
         out = []
         for slot in item:
             if isinstance(slot, core.LoDTensor) and slot.lod():
@@ -315,18 +332,32 @@ def batch(reader, batch_size):
     return reader
 
 
+def note_executor_place(place):
+    """Called by Executor.run: remembers the live execution place so
+    double_buffer(place=None) prefetches to the device actually running
+    the program (a CPU-place Executor on a TPU build must NOT get its
+    batches staged to the TPU)."""
+    global _last_executor_place
+    _last_executor_place = place
+
+
+_last_executor_place = None
+
+
 def double_buffer(reader, place=None, name=None):
     """Stage batches on device one step ahead (reference layers/io.py:891,
     create_double_buffer_reader_op.cc): a prefetch thread pads LoD slots
     and ``device_put``s every slot, so the host->device transfer of batch
     N+1 overlaps device execution of step N.  Takes effect at the
-    reader's next ``start()``."""
+    reader's next ``start()``.  With ``place=None`` the target device is
+    resolved lazily per batch from the executor that last ran (falling
+    back to the build default before any run); a mis-staged early batch
+    is re-put by the executor's feed conversion, so this is a perf
+    default, never a correctness choice."""
     feeder = get_reader_feeder(reader.name)
     if feeder is not None:
-        if place is None:
-            place = core.TPUPlace() if core.is_compiled_with_tpu() \
-                else core.CPUPlace()
         feeder._double_buffer_place = place
+        feeder._double_buffer_requested = True
     return reader
 
 

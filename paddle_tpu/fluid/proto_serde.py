@@ -298,7 +298,13 @@ def _attr_bytes(name, value):
             for s in items:
                 out += _field_str(8, s)
         else:
-            # ints (the empty-list default: INTS carries no elements)
+            # ints — and the EMPTY-list fallback.  The wire attr type is
+            # inferred from the first element because the in-memory attr
+            # is a plain Python list; an empty FLOATS/STRINGS/BOOLEANS
+            # attr therefore degrades to INTS-with-no-elements on the
+            # wire.  Our own loader treats any empty list identically;
+            # a strict foreign OpDesc type-checker could reject such a
+            # program (documented delta, ADVICE r2 #1).
             out += _field_varint(2, 3)
             for i in items:
                 out += _field_varint(6, int(i))
@@ -419,8 +425,11 @@ def serialize_program(program):
     out = b''
     for blk in program.blocks:
         body = _field_varint(1, blk.idx)
-        body += _field_varint(2, blk.parent_idx if blk.parent_idx is not
-                              None and blk.parent_idx >= 0 else 0)
+        # root block parent is -1 in the reference's emitted bytes
+        # (signed 64-bit varint); sub-blocks carry their real parent
+        parent = (blk.parent_idx if blk.parent_idx is not None
+                  and blk.parent_idx >= 0 else -1)
+        body += _field_varint(2, parent)
         for v in blk.vars.values():
             body += _field_bytes(3, _var_desc_bytes(v))
         for op in blk.ops:

@@ -50,7 +50,12 @@ def _pick_impl(ctx, op, q=None, k=None):
         if on_tpu and q is not None and k is not None:
             b, lq = q.shape[0], q.shape[1]
             lk, h = k.shape[1], (q.shape[2] if q.ndim == 4 else 1)
-            if b * h * lq * lk * 4 > _DENSE_SCORE_BYTES_BUDGET:
+            # dense-path scores carry q's dtype (bf16 under AMP, f32
+            # otherwise) — budget by the ACTUAL element size, not 4
+            # (ADVICE r2 #4: assuming f32 halved the usable budget and
+            # flipped 'auto' to the slower flash kernel too early)
+            itemsize = getattr(getattr(q, 'dtype', None), 'itemsize', 4)
+            if b * h * lq * lk * itemsize > _DENSE_SCORE_BYTES_BUDGET:
                 return 'pallas'
         return 'dense'
     if impl in ('ring', 'ulysses') and not has_sp:

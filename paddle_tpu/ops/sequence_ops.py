@@ -25,6 +25,32 @@ def _seqlen(ctx, op, slot='X'):
     return ctx.env.get(names[0] + SEQLEN_SUFFIX)
 
 
+def _fused_lstm_ok(d, b_sz, use_peepholes, gate_act_name, cell_act_name,
+                   cand_act_name):
+    """Auto policy for the fused Pallas LSTM cell (ops/pallas/lstm.py).
+    Measured on v5e (tools/lstm_kernel_lab.py): the kernel wins +14-15%
+    fwd+bwd at D=512 (B=128 and B=512) but loses at D=128, where the
+    per-step matmul is too small to amortize the per-grid-step DMA.
+    D is capped at 512: the backward's dW VMEM accumulator is D*4D*4
+    bytes regardless of batch tiling (16MB alone at D=1024, the whole
+    scoped-VMEM budget)."""
+    from ..fluid import flags
+    mode = flags.FLAGS.fused_lstm
+    if mode == 'never':
+        return False
+    legal = (not use_peepholes
+             and gate_act_name == 'sigmoid'
+             and cell_act_name == 'tanh'
+             and cand_act_name == 'tanh'
+             and d % 128 == 0 and d <= 512 and b_sz % 8 == 0)
+    if mode == 'always':
+        # engages even on CPU (kernel runs in interpret mode there) so
+        # the fused lowering glue is testable without hardware
+        return legal
+    return (legal and d >= 256
+            and jax.default_backend() in ('tpu', 'axon'))
+
+
 def _mask(x, lengths, dtype=None):
     """[B, T] validity mask broadcastable against x [B, T, ...]."""
     t = x.shape[1]
@@ -325,6 +351,24 @@ def _lstm(ctx, op):
         step_mask = _mask(x, lengths, jnp.float32).T  # [T, B]
         if is_reverse:
             step_mask = jnp.flip(step_mask, 0)
+
+    if _fused_lstm_ok(d, b_sz, use_peepholes,
+                      op.attrs.get('gate_activation', 'sigmoid'),
+                      op.attrs.get('cell_activation', 'tanh'),
+                      op.attrs.get('candidate_activation', 'tanh')):
+        from .pallas import lstm as pl_lstm
+        bias_arr = (gate_bias if bias is not None
+                    else jnp.zeros((1, 4 * d), jnp.float32))
+        hs, cs = pl_lstm.lstm_fused_tm(xs, w, bias_arr, h_prev, c_prev,
+                                       mask=step_mask)
+        if is_reverse:
+            hs = jnp.flip(hs, 0)
+            cs = jnp.flip(cs, 0)
+        ctx.set(op, 'Hidden', jnp.swapaxes(hs, 0, 1))
+        ctx.set(op, 'Cell', jnp.swapaxes(cs, 0, 1).astype(cd))
+        ctx.set(op, 'BatchGate', x)
+        ctx.set(op, 'BatchCellPreAct', jnp.swapaxes(cs, 0, 1).astype(cd))
+        return
 
     def step(carry, inp):
         h, c = carry
