@@ -1,11 +1,26 @@
-"""Driver benchmark: ResNet-50 training imgs/sec/chip on TPU, plus the
-seq2seq NMT tokens/sec metric BASELINE.json names.
+"""Driver benchmark.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Prints ONE JSON line.  Top-level keys keep the driver contract
+(metric/value/unit/vs_baseline = the ResNet-50 headline), and `configs`
+carries one fully-schema'd record per benchmark config — value, unit,
+mfu, vs_baseline (null where the reference published no number), ms per
+step — so nothing rides piggyback on the headline record
+(VERDICT r2 next-#10).
+
+Configs (reference benchmark/fluid suite):
+  resnet        ResNet-50 ImageNet train, bs512 224^2  (models/resnet.py)
+  nmt           WMT14 seq2seq+attention 512/512/512 dict30k, bs512 seq32
+  transformer   transformer-base 6L d512 ff2048 h8, bs128 seq256
+  stacked_lstm  IMDB stacked dynamic LSTM (3x128), bs128 seq64
+
 Baseline: the reference's best published ResNet-50 training number,
-84.08 imgs/sec on 2x Xeon 6148 with MKL-DNN (BASELINE.md; the K40m tables
-have no ResNet-50 row).  The reference publishes no in-tree NMT number
-(BASELINE.md), so the NMT metric carries no vs_baseline ratio.
+84.08 imgs/sec (2x Xeon 6148 MKL-DNN, BASELINE.md — the K40m GPU tables
+predate ResNet-50); no in-tree baseline exists for the sequence configs.
+
+MFU: analytic model FLOPs (documented per config below) over the v5e
+peak of 197 bf16 TFLOP/s.  All timing is pipelined (fetch-drain): the
+axon dev tunnel costs ~100ms per SYNCED dispatch, which would measure
+the tunnel, not the chip (MFU_BOUND_r03.json).
 """
 
 import json
@@ -13,69 +28,79 @@ import time
 
 import numpy as np
 
-BASELINE_IMGS_PER_SEC = 84.08
-# bs512 + bf16 AMP activations: measured best single-chip operating point
-# (round-2 sweep: 2371 imgs/s @256, 2412 @512, 2276 @768, 2075 @1024 on
-# the pipelined direct-jit loop; the step is HBM-bandwidth-bound)
-BATCH = 512
+PEAK_FLOPS = 197e12  # v5e bf16
+BASELINE_RESNET_IMGS_PER_SEC = 84.08
 WARMUP = 2
-STEPS = 20
 
 
-def _timed_steps(exe, prog, feed, loss_var):
-    """Warm both step variants, then run STEPS pipelined steps — no
-    per-step loss materialization, so host dispatch of step N+1 overlaps
-    device execution of step N (the double-buffered training loop every
-    real input pipeline runs); the final fetch drains the pipeline before
-    the clock stops.  Returns (elapsed_seconds, final_loss)."""
+def _timed_steps(exe, prog, feed, loss_var, steps):
+    """Pipelined: no per-step loss fetch; the final fetch drains."""
     for _ in range(WARMUP):
         exe.run(prog, feed=feed, fetch_list=[loss_var])
-        # the no-fetch step variant compiles separately; warm it too
         exe.run(prog, feed=feed, fetch_list=[])
     t0 = time.time()
-    for _ in range(STEPS - 1):
+    for _ in range(steps - 1):
         exe.run(prog, feed=feed, fetch_list=[])
     loss_v = exe.run(prog, feed=feed, fetch_list=[loss_var])
     elapsed = time.time() - t0
     return elapsed, float(np.asarray(loss_v[0]).flatten()[0])
 
 
-def _bench_resnet(on_tpu):
-    """ResNet-50 training imgs/sec on one chip."""
-    import jax
+def _run(model, feed, on_tpu, steps):
+    """Returns (elapsed_seconds, steps_actually_timed)."""
     import paddle_tpu.fluid as fluid
-    from paddle_tpu.models import resnet
-
-    batch = BATCH if on_tpu else 8
-    image_shape = (3, 224, 224) if on_tpu else (3, 64, 64)
-    model = resnet.build(
-        depth=50, class_dim=1000, image_shape=image_shape, lr=0.1)
+    if not on_tpu:
+        steps = 2  # CPU path is a smoke test, not a benchmark
     place = fluid.TPUPlace() if on_tpu else fluid.CPUPlace()
     exe = fluid.Executor(place)
     scope = fluid.core.Scope()
-    rng = np.random.RandomState(0)
-    img = rng.standard_normal((batch, ) + image_shape).astype('float32')
-    label = rng.randint(0, 1000, size=(batch, 1)).astype('int64')
-    # pre-stage the batch on device once: the metric is per-chip compute
-    # throughput; input pipelining overlaps transfers in real training
-    dev = place.jax_device()
-    feed = {'img': jax.device_put(img, dev),
-            'label': jax.device_put(label, dev)}
     with fluid.scope_guard(scope), fluid.amp_guard(on_tpu):
-        # bf16 matmul/conv inputs with fp32 master weights on TPU (the
-        # MXU's native format); fp32 on the CPU fallback
         exe.run(model['startup'])
-        elapsed, loss = _timed_steps(exe, model['main'], feed, model['loss'])
+        elapsed, loss = _timed_steps(exe, model['main'], feed,
+                                     model['loss'], steps)
     assert np.isfinite(loss)
-    return batch * STEPS / elapsed
+    return elapsed, steps
 
 
-def _bench_nmt(on_tpu, seq_len=32):
-    """Seq2seq+attention NMT training tokens/sec at the reference config
-    (machine_translation.py get_model: 512/512/512, dict 30000)."""
+def _stage(feed, place_on_tpu):
+    if not place_on_tpu:
+        return feed
+    import jax
+    import paddle_tpu.fluid as fluid
+    dev = fluid.TPUPlace().jax_device()
+    return {k: (v if isinstance(v, fluid.core.LoDTensor)
+                else jax.device_put(np.asarray(v), dev))
+            for k, v in feed.items()}
+
+
+def bench_resnet(on_tpu, steps=20):
+    """FLOPs/img 23.15e9: conv+fc MACs x2, train=3x fwd — the analytic
+    count cross-checked in MFU_BOUND_r03.json / tools/jax_resnet_bound.py."""
+    from paddle_tpu.models import resnet
+    batch = 512 if on_tpu else 8
+    shape = (3, 224, 224) if on_tpu else (3, 64, 64)
+    model = resnet.build(depth=50, class_dim=1000, image_shape=shape, lr=0.1)
+    rng = np.random.RandomState(0)
+    feed = _stage({
+        'img': rng.standard_normal((batch, ) + shape).astype('float32'),
+        'label': rng.randint(0, 1000, size=(batch, 1)).astype('int64'),
+    }, on_tpu)
+    elapsed, steps = _run(model, feed, on_tpu, steps)
+    v = batch * steps / elapsed
+    return {
+        'metric': 'resnet50_train_imgs_per_sec_per_chip',
+        'value': round(v, 2), 'unit': 'imgs/sec',
+        'ms_per_step': round(elapsed / steps * 1000, 2),
+        'mfu': round(v * 23.15e9 / PEAK_FLOPS, 4) if on_tpu else None,
+        'vs_baseline': round(v / BASELINE_RESNET_IMGS_PER_SEC, 3),
+    }
+
+
+def bench_nmt(on_tpu, steps=20, seq_len=32):
+    """FLOPs/token 1.404e8: measured 2.3 TFLOP/step at bs512 seq32 via
+    XLA cost analysis (round-2 README profile) / (512*32) tokens."""
     import paddle_tpu.fluid as fluid
     from paddle_tpu.models import seq2seq
-
     batch = 512 if on_tpu else 8
     dict_dim, dim = (30000, 512) if on_tpu else (100, 16)
     model = seq2seq.build(src_dict_dim=dict_dim, trg_dict_dim=dict_dim,
@@ -92,31 +117,98 @@ def _bench_nmt(on_tpu, seq_len=32):
            for _ in range(batch)]
     feed = {'src_word_id': lod(src), 'target_language_word': lod(trg),
             'target_language_next_word': lod(trg)}
-    place = fluid.TPUPlace() if on_tpu else fluid.CPUPlace()
-    exe = fluid.Executor(place)
-    scope = fluid.core.Scope()
-    with fluid.scope_guard(scope), fluid.amp_guard(on_tpu):
-        exe.run(model['startup'])
-        elapsed, loss = _timed_steps(exe, model['main'], feed, model['loss'])
-    assert np.isfinite(loss)
-    return batch * seq_len * STEPS / elapsed
+    elapsed, steps = _run(model, feed, on_tpu, steps)
+    v = batch * seq_len * steps / elapsed
+    return {
+        'metric': 'nmt_train_tokens_per_sec_per_chip',
+        'value': round(v, 2), 'unit': 'tokens/sec',
+        'ms_per_step': round(elapsed / steps * 1000, 2),
+        'mfu': round(v * 1.404e8 / PEAK_FLOPS, 4) if on_tpu else None,
+        'vs_baseline': None,  # reference published no NMT number
+    }
+
+
+def _transformer_flops_per_token(n_layer, d, d_ff, seq, vocab):
+    """Train FLOPs per (batch*seq) token: 3x fwd; fwd = 2 MACs x
+    (enc layer: 4d^2 attn + 2*d*d_ff ffn; dec layer: self + cross attn
+    + ffn; vocab projection) + score/context matmuls 2*2*seq*d per
+    attention."""
+    enc = n_layer * (4 * d * d + 2 * d * d_ff + 2 * 2 * seq * d)
+    dec = n_layer * (8 * d * d + 2 * d * d_ff + 2 * 2 * 2 * seq * d)
+    return 3.0 * 2.0 * (enc + dec + vocab * d)
+
+
+def bench_transformer(on_tpu, steps=10):
+    from paddle_tpu.models import transformer
+    batch, seq = (128, 256) if on_tpu else (4, 16)
+    n_layer, n_head, d, d_ff, vocab = \
+        (6, 8, 512, 2048, 30000) if on_tpu else (2, 4, 64, 128, 100)
+    model = transformer.build(src_vocab=vocab, trg_vocab=vocab,
+                              max_len=seq, n_layer=n_layer, n_head=n_head,
+                              d_model=d, d_ff=d_ff)
+    rng = np.random.RandomState(0)
+    ids = lambda: rng.randint(1, vocab, size=(batch, seq)).astype('int64')
+    feed = _stage({'src_ids': ids(), 'trg_ids': ids(), 'lbl_ids': ids()},
+                  on_tpu)
+    elapsed, steps = _run(model, feed, on_tpu, steps)
+    v = batch * seq * steps / elapsed
+    fpt = _transformer_flops_per_token(n_layer, d, d_ff, seq, vocab)
+    return {
+        'metric': 'transformer_base_train_tokens_per_sec_per_chip',
+        'value': round(v, 2), 'unit': 'tokens/sec',
+        'ms_per_step': round(elapsed / steps * 1000, 2),
+        'mfu': round(v * fpt / PEAK_FLOPS, 4) if on_tpu else None,
+        'vs_baseline': None,  # reference published no transformer number
+    }
+
+
+def bench_stacked_lstm(on_tpu, steps=20, seq_len=64):
+    """IMDB stacked LSTM (3 layers, h=128 — the reference benchmark
+    model's width).  FLOPs/token: 2 MACs x (layer1 128->512 x-proj +
+    128->512 recurrence; layers 2-3 concat-256->512 + recurrence), x3
+    for training ~= 3.2e6 — the model is tiny; the metric is
+    throughput, and on this dev tunnel it is dispatch-latency-bound
+    (README round-3 sequence notes)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import stacked_lstm
+    batch = 128 if on_tpu else 8
+    model = stacked_lstm.build()
+    rng = np.random.RandomState(0)
+    rows = [rng.randint(0, 5149, size=(seq_len, 1)).tolist()
+            for _ in range(batch)]
+    feed = {'words': fluid.create_lod_tensor(rows, [[seq_len] * batch]),
+            'label': rng.randint(0, 2, size=(batch, 1)).astype('int64')}
+    elapsed, steps = _run(model, feed, on_tpu, steps)
+    v = batch * seq_len * steps / elapsed
+    fpt = 3.0 * 2.0 * (128 * 512 + 128 * 512 + 2 * (256 * 512 + 128 * 512))
+    return {
+        'metric': 'stacked_lstm_train_tokens_per_sec_per_chip',
+        'value': round(v, 2), 'unit': 'tokens/sec',
+        'ms_per_step': round(elapsed / steps * 1000, 2),
+        'mfu': round(v * fpt / PEAK_FLOPS, 4) if on_tpu else None,
+        'vs_baseline': None,  # reference LSTM tables are a different net
+    }
 
 
 def main():
     import paddle_tpu.fluid as fluid
 
     on_tpu = fluid.core.is_compiled_with_tpu()
-    imgs_per_sec = _bench_resnet(on_tpu)
-    nmt_tokens_per_sec = _bench_nmt(on_tpu)
-    print(
-        json.dumps({
-            'metric': 'resnet50_train_imgs_per_sec_per_chip',
-            'value': round(imgs_per_sec, 2),
-            'unit': 'imgs/sec',
-            'vs_baseline': round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
-            # BASELINE.json's second named metric ("seq2seq NMT tokens/sec")
-            'nmt_train_tokens_per_sec_per_chip': round(nmt_tokens_per_sec, 2),
-        }))
+    configs = [
+        bench_resnet(on_tpu),
+        bench_nmt(on_tpu),
+        bench_transformer(on_tpu),
+        bench_stacked_lstm(on_tpu),
+    ]
+    head = configs[0]
+    print(json.dumps({
+        'metric': head['metric'],
+        'value': head['value'],
+        'unit': head['unit'],
+        'vs_baseline': head['vs_baseline'],
+        'mfu': head['mfu'],
+        'configs': configs,
+    }))
 
 
 if __name__ == '__main__':

@@ -12,11 +12,12 @@ from .flags import FLAGS
 flags.try_from_env(flags.TRYFROMENV)
 from . import core
 from .core import (CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace, LoDTensor,
-                   Scope, is_compiled_with_tpu, is_compiled_with_cuda)
+                   LoDTensorArray, Scope, is_compiled_with_tpu,
+                   is_compiled_with_cuda)
 from . import framework
 from .framework import (Program, Operator, Variable, Parameter,
                         default_main_program, default_startup_program,
-                        program_guard, name_scope)
+                        program_guard, name_scope, get_var)
 from . import executor
 from .executor import Executor, global_scope, scope_guard, fetch_var
 from . import parallel_executor
@@ -25,6 +26,7 @@ from .parallel_executor import ParallelExecutor, ExecutionStrategy, \
 from . import initializer
 from . import layers
 from . import nets
+from . import contrib
 from . import optimizer
 from . import backward
 from .backward import append_backward, calc_gradient, gradients

@@ -1,0 +1,10 @@
+"""Contrib surface (reference: python/paddle/fluid/contrib/__init__.py):
+the decoder DSL (InitState/StateCell/TrainingDecoder/BeamSearchDecoder)
+and memory_usage."""
+
+from .decoder import InitState, StateCell, TrainingDecoder, \
+    BeamSearchDecoder
+from .memory_usage_calc import memory_usage
+
+__all__ = ['InitState', 'StateCell', 'TrainingDecoder', 'BeamSearchDecoder',
+           'memory_usage']

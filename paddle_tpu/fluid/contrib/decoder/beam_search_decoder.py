@@ -1,0 +1,421 @@
+"""Decoder DSL: InitState / StateCell / TrainingDecoder / BeamSearchDecoder
+(reference: python/paddle/fluid/contrib/decoder/beam_search_decoder.py:43,
+159,384,523).
+
+Same API, TPU-native mechanism.  The reference's TrainingDecoder drives a
+DynamicRNN (while-op re-entering the interpreter per step); here the same
+DynamicRNN lowers to ONE masked ``lax.scan``.  The reference's
+BeamSearchDecoder grows beams through nested LoD inside a while-op; here
+the beam dimension is the static ``[B*K]`` row layout (models/seq2seq.py
+decode pattern): a StaticRNN scans ``max_len`` steps, the ``beam_search``
+op selects per-step candidates, states are re-wired to their surviving
+parents with a ``gather`` on ``parent_idx`` (replacing the reference's
+``sequence_expand`` by prev_scores), and ``beam_search_decode`` backtracks
+the parent pointers at the end.  ``early_stop`` is a no-op: the scan has a
+static trip count and finished beams carry ``end_id`` forward inside the
+beam_search op — same results, fixed schedule.
+"""
+
+import contextlib
+
+from ... import unique_name
+from ...framework import Variable
+from ...layer_helper import LayerHelper
+from ... import layers
+
+__all__ = ['InitState', 'StateCell', 'TrainingDecoder', 'BeamSearchDecoder']
+
+
+class _DecoderType(object):
+    TRAINING = 1
+    BEAM_SEARCH = 2
+
+
+class InitState(object):
+    """Initial hidden state (reference beam_search_decoder.py:43): either
+    an explicit variable or a constant-filled one shaped like
+    ``init_boot``'s batch."""
+
+    def __init__(self,
+                 init=None,
+                 shape=None,
+                 value=0.0,
+                 init_boot=None,
+                 need_reorder=False,
+                 dtype='float32'):
+        if init is not None:
+            self._init = init
+        elif init_boot is None:
+            raise ValueError(
+                'init_boot must be provided to infer the shape of InitState')
+        else:
+            self._init = layers.fill_constant_batch_size_like(
+                input=init_boot, value=value, shape=shape, dtype=dtype)
+        self._shape = shape
+        self._value = value
+        self._need_reorder = need_reorder
+        self._dtype = dtype
+
+    @property
+    def value(self):
+        return self._init
+
+    @property
+    def need_reorder(self):
+        return self._need_reorder
+
+
+class _MemoryState(object):
+    """State held as an RNN memory (both decoder types use rnn.memory +
+    update_memory here; the static [B*K] layout never needs the
+    reference's separate array-state path)."""
+
+    def __init__(self, state_name, rnn_obj, init_state):
+        self._state_name = state_name
+        self._rnn_obj = rnn_obj
+        self._state_mem = self._rnn_obj.memory(init=init_state.value)
+
+    def get_state(self):
+        return self._state_mem
+
+    def update_state(self, state):
+        self._rnn_obj.update_memory(self._state_mem, state)
+
+
+class StateCell(object):
+    """Bookkeeping for an RNN cell's inputs/states and the user-defined
+    updater (reference beam_search_decoder.py:159).  The updater runs once
+    per step under whichever decoder is active."""
+
+    def __init__(self, inputs, states, out_state, name=None):
+        self.helper = LayerHelper('state_cell', name=name)
+        self._cur_states = {}
+        self._state_names = []
+        for state_name, state in states.items():
+            if not isinstance(state, InitState):
+                raise ValueError('state must be an InitState object')
+            self._cur_states[state_name] = state
+            self._state_names.append(state_name)
+        self._inputs = inputs  # inputs is a map of {input_name: input}
+        self._cur_decoder_obj = None
+        self._in_decoder = False
+        self._states_holder = {}
+        self._switched_decoder = False
+        self._state_updater = None
+        self._out_state = out_state
+
+    def _enter_decoder(self, decoder_obj):
+        if self._in_decoder or self._cur_decoder_obj is not None:
+            raise ValueError('StateCell is already used in a decoder')
+        self._in_decoder = True
+        self._cur_decoder_obj = decoder_obj
+        self._switched_decoder = False
+
+    def _leave_decoder(self, decoder_obj):
+        if not self._in_decoder or self._cur_decoder_obj != decoder_obj:
+            raise ValueError('not in this decoder')
+        self._in_decoder = False
+        self._cur_decoder_obj = None
+        self._switched_decoder = False
+
+    def _switch_decoder(self):
+        """Materialize each InitState as a memory of the active decoder's
+        RNN (lazy, on first get_state inside the block)."""
+        if not self._in_decoder:
+            raise ValueError('switched decoder outside a decoder block')
+        if self._switched_decoder:
+            raise ValueError('decoder switched twice')
+        for state_name in self._state_names:
+            state = self._cur_states.get(state_name)
+            if not isinstance(state, InitState):
+                raise ValueError('all states must be InitState before switch')
+            self._states_holder[state_name] = _MemoryState(
+                state_name, self._cur_decoder_obj._rnn_obj(), state)
+            self._cur_states[state_name] = \
+                self._states_holder[state_name].get_state()
+        self._switched_decoder = True
+
+    def get_state(self, state_name):
+        if self._in_decoder and not self._switched_decoder:
+            self._switch_decoder()
+        if state_name not in self._cur_states:
+            raise ValueError('unknown state %r' % state_name)
+        return self._cur_states[state_name]
+
+    def get_input(self, input_name):
+        if input_name not in self._inputs or \
+                self._inputs[input_name] is None:
+            raise ValueError('input %r not found or not initialized'
+                             % input_name)
+        return self._inputs[input_name]
+
+    def set_state(self, state_name, state_value):
+        self._cur_states[state_name] = state_value
+
+    def state_updater(self, updater):
+        self._state_updater = updater
+
+        def _decorator(state_cell):
+            if state_cell == self:
+                raise TypeError('updater should only accept a StateCell')
+            updater(state_cell)
+
+        return _decorator
+
+    def compute_state(self, inputs):
+        """Feed this step's inputs and run the user updater."""
+        if self._in_decoder and not self._switched_decoder:
+            self._switch_decoder()
+        for input_name, input_value in inputs.items():
+            if input_name not in self._inputs:
+                raise ValueError('unknown input %r' % input_name)
+            self._inputs[input_name] = input_value
+        self._state_updater(self)
+
+    def update_states(self):
+        """Commit the computed states back into the RNN memories."""
+        if self._in_decoder and not self._switched_decoder:
+            raise ValueError('update_states before compute_state')
+        for state_name, decoder_state in self._states_holder.items():
+            decoder_state.update_state(self._cur_states[state_name])
+
+    def out_state(self):
+        return self._cur_states[self._out_state]
+
+
+class TrainingDecoder(object):
+    """Teacher-forced decoder block over a DynamicRNN (reference
+    beam_search_decoder.py:384)."""
+
+    BEFORE_DECODER = 0
+    IN_DECODER = 1
+    AFTER_DECODER = 2
+
+    def __init__(self, state_cell, name=None):
+        self._helper = LayerHelper('training_decoder', name=name)
+        self._status = TrainingDecoder.BEFORE_DECODER
+        self._dynamic_rnn = layers.DynamicRNN()
+        self._type = _DecoderType.TRAINING
+        self._state_cell = state_cell
+        self._state_cell._enter_decoder(self)
+
+    @contextlib.contextmanager
+    def block(self):
+        if self._status != TrainingDecoder.BEFORE_DECODER:
+            raise ValueError('decoder.block() can only be invoked once')
+        self._status = TrainingDecoder.IN_DECODER
+        with self._dynamic_rnn.block():
+            yield
+        self._status = TrainingDecoder.AFTER_DECODER
+        self._state_cell._leave_decoder(self)
+
+    @property
+    def state_cell(self):
+        self._assert_in_decoder_block('state_cell')
+        return self._state_cell
+
+    @property
+    def dynamic_rnn(self):
+        return self._dynamic_rnn
+
+    def _rnn_obj(self):
+        return self._dynamic_rnn
+
+    @property
+    def type(self):
+        return self._type
+
+    def step_input(self, x):
+        self._assert_in_decoder_block('step_input')
+        return self._dynamic_rnn.step_input(x)
+
+    def static_input(self, x):
+        self._assert_in_decoder_block('static_input')
+        return self._dynamic_rnn.static_input(x)
+
+    def __call__(self, *args, **kwargs):
+        if self._status != TrainingDecoder.AFTER_DECODER:
+            raise ValueError('output can only be visited outside the block')
+        return self._dynamic_rnn(*args, **kwargs)
+
+    def output(self, *outputs):
+        self._assert_in_decoder_block('output')
+        self._dynamic_rnn.output(*outputs)
+
+    def _assert_in_decoder_block(self, method):
+        if self._status != TrainingDecoder.IN_DECODER:
+            raise ValueError('%s should be invoked inside the block() of '
+                             'TrainingDecoder' % method)
+
+
+class BeamSearchDecoder(object):
+    """Inference beam-search decoder (reference beam_search_decoder.py:523)
+    on the static [B*K] beam layout: a StaticRNN of max_len steps; see
+    module docstring for the mechanism mapping."""
+
+    BEFORE_BEAM_SEARCH_DECODER = 0
+    IN_BEAM_SEARCH_DECODER = 1
+    AFTER_BEAM_SEARCH_DECODER = 2
+
+    def __init__(self,
+                 state_cell,
+                 init_ids,
+                 init_scores,
+                 target_dict_dim,
+                 word_dim,
+                 input_var_dict=None,
+                 topk_size=50,
+                 sparse_emb=True,
+                 max_len=100,
+                 beam_size=1,
+                 end_id=1,
+                 name=None):
+        self._helper = LayerHelper('beam_search_decoder', name=name)
+        self._rnn = layers.StaticRNN()
+        self._type = _DecoderType.BEAM_SEARCH
+        self._state_cell = state_cell
+        self._state_cell._enter_decoder(self)
+        self._status = BeamSearchDecoder.BEFORE_BEAM_SEARCH_DECODER
+        self._arrays = {}  # read-value name -> memory var
+        self._beam_size = beam_size
+        self._end_id = end_id
+        self._max_len = max_len
+        self._init_ids = init_ids
+        self._init_scores = init_scores
+        self._target_dict_dim = target_dict_dim
+        self._topk_size = topk_size
+        self._sparse_emb = sparse_emb
+        self._word_dim = word_dim
+        self._input_var_dict = input_var_dict or {}
+        self._ids_mem = None
+        self._scores_mem = None
+        self._outputs = None
+        self._parent_idx = None
+
+    def _rnn_obj(self):
+        return self._rnn
+
+    @contextlib.contextmanager
+    def block(self):
+        if self._status != BeamSearchDecoder.BEFORE_BEAM_SEARCH_DECODER:
+            raise ValueError('block() can only be invoked once')
+        self._status = BeamSearchDecoder.IN_BEAM_SEARCH_DECODER
+        # the ticker drives the StaticRNN for max_len steps; rows follow
+        # the [B*K] beam layout of init_scores
+        ticker = layers.fill_constant_batch_size_like(
+            input=self._init_scores,
+            shape=[self._max_len, -1, 1],
+            value=0.0,
+            dtype='float32',
+            input_dim_idx=0,
+            output_dim_idx=1)
+        with self._rnn.step():
+            self._rnn.step_input(ticker)
+            yield
+        self._status = BeamSearchDecoder.AFTER_BEAM_SEARCH_DECODER
+        self._state_cell._leave_decoder(self)
+
+    @property
+    def type(self):
+        return self._type
+
+    def early_stop(self):
+        """No-op on the static layout: the scan runs its fixed trip count
+        and finished beams carry end_id through the beam_search op."""
+
+    def decode(self):
+        """The default decode loop (reference beam_search_decoder.py:653),
+        rebuilt on the [B*K] layout."""
+        with self.block():
+            prev_ids = self.read_array(init=self._init_ids, is_ids=True)
+            prev_scores = self.read_array(
+                init=self._init_scores, is_scores=True)
+            prev_ids_embedding = layers.embedding(
+                input=prev_ids,
+                size=[self._target_dict_dim, self._word_dim],
+                dtype='float32',
+                is_sparse=self._sparse_emb)
+
+            feed_dict = {}
+            update_dict = {}
+            for name, init_var in self._input_var_dict.items():
+                if name not in self._state_cell._inputs:
+                    raise ValueError('Variable %s not found in StateCell'
+                                     % name)
+                read_var = self.read_array(init=init_var)
+                update_dict[name] = read_var
+                feed_dict[name] = read_var
+
+            for input_name in self._state_cell._inputs:
+                if input_name not in feed_dict:
+                    feed_dict[input_name] = prev_ids_embedding
+
+            self._state_cell.compute_state(inputs=feed_dict)
+            current_state = self._state_cell.out_state()
+            scores = layers.fc(input=current_state,
+                               size=self._target_dict_dim,
+                               act='softmax')
+            topk_scores, topk_indices = layers.topk(
+                scores, k=self._beam_size)
+            accu_scores = layers.elementwise_add(
+                layers.log(topk_scores), prev_scores)
+            sel_ids, sel_scores, parent_idx = layers.beam_search(
+                prev_ids, prev_scores, topk_indices, accu_scores,
+                self._beam_size, end_id=self._end_id)
+            # re-wire every carried state to its surviving parent row:
+            # gather-by-parent_idx replaces both the reference's
+            # update_states() commit and its sequence_expand beam growth
+            for state_name in self._state_cell._state_names:
+                holder = self._state_cell._states_holder[state_name]
+                gathered = layers.gather(
+                    self._state_cell._cur_states[state_name], parent_idx)
+                self._rnn.update_memory(holder.get_state(), gathered)
+            self.update_array(prev_ids, sel_ids)
+            self.update_array(prev_scores, sel_scores)
+            for name, var in update_dict.items():
+                self.update_array(var, feed_dict[name])
+            self._parent_idx = parent_idx
+            self._rnn.output(sel_ids, sel_scores, parent_idx)
+
+    def read_array(self, init, is_ids=False, is_scores=False):
+        """Carried per-step value, initialized from ``init`` (an RNN
+        memory on this layout rather than a tensor array)."""
+        self._assert_in_decoder_block('read_array')
+        if is_ids and is_scores:
+            raise ValueError('an array cannot be both ids and scores')
+        if not isinstance(init, Variable):
+            raise TypeError('`init` must be a Variable')
+        mem = self._rnn.memory(init=init)
+        self._arrays[mem.name] = mem
+        if is_ids:
+            self._ids_mem = mem
+        elif is_scores:
+            self._scores_mem = mem
+        return mem
+
+    def update_array(self, array, value):
+        self._assert_in_decoder_block('update_array')
+        if not isinstance(array, Variable) or \
+                not isinstance(value, Variable):
+            raise TypeError('array and value must be Variables')
+        if array.name not in self._arrays:
+            raise ValueError('invoke read_array before update_array')
+        self._rnn.update_memory(array, value)
+
+    def __call__(self):
+        if self._status != BeamSearchDecoder.AFTER_BEAM_SEARCH_DECODER:
+            raise ValueError('output can only be visited outside the block')
+        ids_arr, scores_arr, parents_arr = self._rnn()
+        return layers.beam_search_decode(
+            ids_arr, scores_arr, parents_arr,
+            beam_size=self._beam_size, end_id=self._end_id)
+
+    @property
+    def state_cell(self):
+        self._assert_in_decoder_block('state_cell')
+        return self._state_cell
+
+    def _assert_in_decoder_block(self, method):
+        if self._status != BeamSearchDecoder.IN_BEAM_SEARCH_DECODER:
+            raise ValueError('%s should be invoked inside the block of '
+                             'BeamSearchDecoder' % method)

@@ -270,6 +270,17 @@ class LoDTensor(object):
         return 'LoDTensor(shape=%s, lod=%s)' % (self.shape(), self._lod)
 
 
+class LoDTensorArray(list):
+    """Ordered list of LoDTensors — the host-side mirror of the
+    LOD_TENSOR_ARRAY var type (reference pybind LoDTensorArray surface:
+    append + indexing; produced/consumed by the tensor-array ops)."""
+
+    def append(self, tensor):
+        if not isinstance(tensor, LoDTensor):
+            tensor = LoDTensor(np.asarray(tensor))
+        list.append(self, tensor)
+
+
 # ----------------------------------------------------------------------------
 # SelectedRows (paddle/fluid/framework/selected_rows.h:32)
 # ----------------------------------------------------------------------------

@@ -109,6 +109,26 @@ class DataFeeder(object):
             ret_dict[each_name] = each_converter.done()
         return ret_dict
 
+    def decorate_reader(self, reader, multi_devices=False, num_places=None,
+                        drop_last=True):
+        """Wrap a batched sample reader into one yielding ready feed
+        dicts (reference data_feeder.py decorate_reader)."""
+
+        def decorated():
+            for batch in reader():
+                if multi_devices:
+                    feeds = self.feed_parallel(batch, num_places)
+                    if len(feeds) == (num_places or 1):
+                        yield feeds
+                    elif not drop_last:
+                        # short final batch: yield it only when the
+                        # caller asked to keep remainders
+                        yield feeds
+                else:
+                    yield self.feed(batch)
+
+        return decorated
+
     def feed_parallel(self, iterable, num_places=None):
         """Split a batch across devices (reference data_feeder.py:201) —
         kept for API parity; SPMD sharding supersedes it."""

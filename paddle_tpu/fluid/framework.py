@@ -118,6 +118,12 @@ class Parameter(Variable):
         self.gradient_clip_attr = kwargs.get('gradient_clip_attr', None)
         self.do_model_average = kwargs.get('do_model_average', None)
 
+    def astype(self, dtype):
+        """Graph-side cast (reference Parameter.astype via math_op_patch):
+        returns a new Variable carrying this parameter cast to dtype."""
+        from .layers import tensor as tensor_layers
+        return tensor_layers.cast(self, dtype)
+
 
 class Operator(object):
     """One operation: type + named input/output var lists + attrs
@@ -186,6 +192,40 @@ class Operator(object):
 
     def all_attrs(self):
         return dict(self.attrs)
+
+    def attr_type(self, name):
+        """Python-type stand-in for the reference's proto AttrType enum."""
+        return type(self.attrs.get(name))
+
+    def has_kernel(self, op_type=None):
+        return (op_type or self.type) not in self.OP_WITHOUT_KERNEL_SET
+
+    def block_attr_id(self, name):
+        """Index of a sub-block attr (reference block_attr_id)."""
+        v = self.attrs.get(name)
+        return v.idx if isinstance(v, Block) else int(v)
+
+    def block_attr(self, name):
+        return self.block_attr_id(name)
+
+    def blocks_attr_ids(self, name):
+        v = self.attrs.get(name) or []
+        return [b.idx if isinstance(b, Block) else int(b) for b in v]
+
+    def blocks_attr(self, name):
+        return self.blocks_attr_ids(name)
+
+    def rename_input(self, old_name, new_name):
+        for slot, names in self.inputs.items():
+            self.inputs[slot] = [new_name if n == old_name else n
+                                 for n in names]
+        self.block.program._bump_version()
+
+    def rename_output(self, old_name, new_name):
+        for slot, names in self.outputs.items():
+            self.outputs[slot] = [new_name if n == old_name else n
+                                  for n in names]
+        self.block.program._bump_version()
 
     def to_string(self, throw_on_error=False):
         ins = {k: v for k, v in self.inputs.items()}
@@ -399,6 +439,36 @@ class Program(object):
 
     __repr__ = __str__ = lambda self: self.to_string()
 
+    def copy_data_info_from(self, other):
+        """Copy is_data/stop_gradient marks from ``other``'s global block
+        onto same-named vars here (reference Program.copy_data_info_from —
+        used after clone/prune so feed vars keep their data semantics)."""
+        for name, src in other.global_block().vars.items():
+            dst = self.global_block().vars.get(name)
+            if dst is not None:
+                dst.is_data = getattr(src, 'is_data', False)
+                dst.stop_gradient = src.stop_gradient
+
+    def get_desc(self):
+        """The program's wire-level description (the reference returns the
+        C++ ProgramDesc; here the structural dict the serde round-trips)."""
+        return self.desc_dict()
+
+    @contextlib.contextmanager
+    def optimized_guard(self, param_and_grads):
+        """Scope marking appended ops as optimizer ops (reference
+        Program.optimized_guard sets OpRole.Optimize + the param/grad
+        pair on every op built inside)."""
+        prior = self._op_role_var
+        self._op_role_var = [
+            v.name if isinstance(v, Variable) else v
+            for v in (param_and_grads or [])
+        ]
+        try:
+            yield
+        finally:
+            self._op_role_var = prior
+
     # ---- serialization (program-is-data contract) ----
     def desc_dict(self):
         from . import program_serde
@@ -482,3 +552,13 @@ def name_scope(prefix=None):
         yield
     finally:
         _name_scope_stack.pop()
+
+
+def get_var(name, program=None):
+    """Look up a Variable by name in ``program``'s global block
+    (reference framework.get_var)."""
+    program = program if program is not None else default_main_program()
+    v = program.global_block().vars.get(name)
+    if v is None:
+        raise ValueError('var %r not found in program' % name)
+    return v

@@ -19,7 +19,8 @@ from .tensor import fill_constant
 __all__ = [
     'While', 'StaticRNN', 'DynamicRNN', 'increment', 'array_write',
     'array_read', 'array_length', 'less_than', 'equal', 'Switch', 'IfElse',
-    'zeros_like',
+    'zeros_like', 'Print', 'is_empty', 'lod_rank_table',
+    'reorder_lod_tensor_by_rank', 'split_lod_tensor', 'merge_lod_tensor',
 ]
 
 
@@ -560,8 +561,16 @@ class Switch(object):
 
 
 class IfElse(object):
-    """Two-branch conditional (reference control_flow.py:1412).  Both
-    branches lower; outputs select elementwise on the condition."""
+    """Two-branch conditional (reference control_flow.py:1412).
+
+    ``input(x)`` routes rows through a real ``split_lod_tensor`` op (the
+    reference's data-routing substrate, operators/split_lod_tensor_op.cc):
+    each branch sees its row subset compacted to the front of a
+    static-shape buffer, and the ifelse op reassembles outputs with
+    ``merge_lod_tensor`` semantics (per-row partition, not a blend).
+    Branches that never call ``input`` fall back to computing both sides
+    on the full batch and selecting per row (pure-block equivalence) —
+    and a 1-element condition selects whole tensors."""
 
     OUT_IF_ELSE_BLOCKS = 2
 
@@ -572,6 +581,7 @@ class IfElse(object):
         self.outputs = {True: [], False: []}
         self.parent_idx = None
         self._out_vars = None
+        self._routed = False
 
     @contextlib.contextmanager
     def true_block(self):
@@ -597,7 +607,13 @@ class IfElse(object):
             self.blocks[branch] = sub_block
 
     def input(self, x):
-        return x
+        """Route x's rows into this branch via split_lod_tensor: the true
+        branch reads OutTrue (rows where cond), the false branch OutFalse
+        (reference IfElse.input, control_flow.py:1448)."""
+        self._routed = True
+        branch = self._current_branch
+        out_true, out_false = split_lod_tensor(x, self.cond)
+        return out_true if branch else out_false
 
     def output(self, *outs):
         self.outputs[self._current_branch].extend([o.name for o in outs])
@@ -611,14 +627,139 @@ class IfElse(object):
             ov = parent_block.create_var(
                 name=t_name + '@ifelse', dtype='float32')
             out_vars.append(ov)
+        # declare the branches' external reads (weights, globals) as op
+        # inputs so the executor threads them into the compiled state —
+        # same contract as While (_external_reads above)
+        ext = []
+        for blk in (self.blocks.get(True), self.blocks.get(False)):
+            if blk is not None:
+                for n in _external_reads(blk, exclude=(self.cond.name, )):
+                    if n not in ext:
+                        ext.append(n)
         parent_block.append_op(
             type='ifelse',
-            inputs={'Cond': [self.cond]},
+            inputs={'Cond': [self.cond],
+                    'X': ext},
             outputs={'Out': out_vars},
             attrs={
                 'true_block': self.blocks.get(True),
                 'false_block': self.blocks.get(False),
                 'true_out': list(self.outputs[True]),
                 'false_out': list(self.outputs[False]),
+                'routed': self._routed,
             })
         return out_vars
+
+
+def split_lod_tensor(input, mask, level=0):
+    """Partition input's rows by a [B, 1] bool mask into (out_true,
+    out_false) — the reference's IfElse data-routing substrate
+    (operators/split_lod_tensor_op.cc).  Static-shape form: each output
+    keeps the full buffer with its selected rows compacted to the front
+    in original order; merge_lod_tensor reconstructs exactly from the
+    mask, so the padding tail is never read."""
+    helper = LayerHelper('split_lod_tensor', **locals())
+    out_true = helper.create_variable_for_type_inference(dtype=input.dtype)
+    out_false = helper.create_variable_for_type_inference(dtype=input.dtype)
+    out_true.shape = input.shape
+    out_false.shape = input.shape
+    helper.append_op(
+        type='split_lod_tensor',
+        inputs={'X': [input],
+                'Mask': [mask]},
+        outputs={'OutTrue': [out_true],
+                 'OutFalse': [out_false]},
+        attrs={'level': level})
+    return out_true, out_false
+
+
+def merge_lod_tensor(in_true, in_false, x, mask, level=0):
+    """Inverse of split_lod_tensor (operators/merge_lod_tensor_op.cc):
+    row r of the output comes from the next unconsumed compacted row of
+    in_true when mask[r] else of in_false.  ``x`` carries the target
+    row structure (reference uses its LoD)."""
+    helper = LayerHelper('merge_lod_tensor', **locals())
+    out = helper.create_variable_for_type_inference(dtype=in_true.dtype)
+    out.shape = x.shape
+    helper.append_op(
+        type='merge_lod_tensor',
+        inputs={'X': [x],
+                'Mask': [mask],
+                'InTrue': [in_true],
+                'InFalse': [in_false]},
+        outputs={'Out': [out]},
+        attrs={'level': level})
+    return out
+
+
+def Print(input,
+          first_n=-1,
+          message=None,
+          summarize=-1,
+          print_tensor_name=True,
+          print_tensor_type=True,
+          print_tensor_shape=True,
+          print_tensor_lod=True,
+          print_phase='both'):
+    """Print a tensor's value while running (reference control_flow.Print /
+    operators/print_op.cc).  Lowered to the 'print' host op; returns the
+    input so it can be chained in place."""
+    helper = LayerHelper('print', **locals())
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    out.shape = input.shape
+    helper.append_op(
+        type='print',
+        inputs={'In': [input]},
+        outputs={'Out': [out]},
+        attrs={
+            'first_n': first_n,
+            'message': message or '',
+            'summarize': summarize,
+            'print_tensor_name': print_tensor_name,
+            'print_tensor_type': print_tensor_type,
+            'print_tensor_shape': print_tensor_shape,
+            'print_tensor_lod': print_tensor_lod,
+            'print_phase': print_phase.upper(),
+        })
+    return out
+
+
+def is_empty(x, cond=None, **ignored):
+    """True iff x has zero elements (reference operators/is_empty_op.cc)."""
+    helper = LayerHelper('is_empty', **locals())
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(dtype='bool')
+        cond.shape = (1, )
+    helper.append_op(
+        type='is_empty', inputs={'X': [x]}, outputs={'Out': [cond]})
+    return cond
+
+
+def lod_rank_table(x, level=0):
+    """Length-descending sort permutation of x's sequences (reference
+    control_flow.lod_rank_table / framework/lod_rank_table.h).  On the
+    padded layout the table is the [B] index permutation sorting rows by
+    length, descending, ties stable."""
+    helper = LayerHelper('lod_rank_table', **locals())
+    table = helper.create_variable_for_type_inference(dtype='int32')
+    table.shape = (x.shape[0] if x.shape else -1, )
+    helper.append_op(
+        type='lod_rank_table',
+        inputs={'X': [x]},
+        outputs={'Out': [table]},
+        attrs={'level': level})
+    return table
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """Reorder x's rows by a lod_rank_table permutation (reference
+    operators/reorder_lod_tensor_by_rank_op.cc)."""
+    helper = LayerHelper('reorder_lod_tensor_by_rank', **locals())
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    out.shape = x.shape
+    helper.append_op(
+        type='reorder_lod_tensor_by_rank',
+        inputs={'X': [x],
+                'RankTable': [rank_table]},
+        outputs={'Out': [out]})
+    return out

@@ -8,6 +8,7 @@ next batch on device while the current step runs (the reference's
 create_double_buffer_reader_op.cc behavior).
 """
 
+import contextlib
 import pickle
 import threading
 
@@ -20,7 +21,8 @@ from ..framework import default_main_program, default_startup_program, \
 from ..layer_helper import LayerHelper
 
 __all__ = ['data', 'py_reader', 'read_file', 'batch', 'double_buffer',
-           'open_recordio_file', 'open_files', 'shuffle', 'Preprocessor']
+           'open_recordio_file', 'open_files', 'shuffle', 'Preprocessor',
+           'random_data_generator']
 
 # reader var name -> _PyReaderFeeder.  Weak values: the strong reference
 # lives on the reader Variable (program lifetime), so discarding a program
@@ -493,12 +495,127 @@ def open_files(filenames,
     return rd
 
 
+def random_data_generator(low, high, shapes, lod_levels, for_parallel=True):
+    """Uniform-random dummy reader (reference layers/io.py:410,
+    operators/reader/create_random_data_generator_op.cc): a reader
+    Variable that synthesizes float32 batches itself — no file, no
+    start() needed.  Pair with read_file to get the data vars."""
+    shapes = [list(s) for s in shapes]
+    reader = py_reader(
+        capacity=4,
+        shapes=shapes,
+        dtypes=['float32'] * len(shapes),
+        lod_levels=list(lod_levels))
+    rng = np.random.RandomState(0)
+
+    def provider():
+        while True:
+            yield tuple(
+                rng.uniform(low, high, size=s).astype('float32')
+                for s in shapes)
+
+    feeder = get_reader_feeder(reader.name)
+    feeder.decorate_tensor_provider(provider)
+    feeder.start()
+    return reader
+
+
 class Preprocessor(object):
-    """Reference layers/io.py Preprocessor: custom reader transform blocks.
-    Host-side transforms belong in paddle_tpu.reader decorators; kept as a
-    documented stub for API parity."""
+    """Custom reader-transform block (reference layers/io.py Preprocessor /
+    operators/reader/create_custom_reader_op.cc): a sub-block of ops is
+    defined between ``inputs()`` and ``outputs()`` and applied to every
+    batch the underlying reader yields.
+
+    TPU-native mechanism: the block's ops run through the same XLA
+    lowering registry as any program — per batch, on the host-visible
+    feed path — by executing a tiny derived Program over the popped
+    batch, then pushing the transformed slots onward.  The returned
+    reader var swaps its feeder for the transforming one at ``start``.
+    """
+
+    BEFORE_SUB_BLOCK = 0
+    IN_SUB_BLOCK = 1
+    AFTER_SUB_BLOCK = 2
 
     def __init__(self, reader, name=None):
-        raise NotImplementedError(
-            'use paddle_tpu.reader.map_readers/xmap_readers for host-side '
-            'preprocessing')
+        self.underlying = reader
+        self.helper = LayerHelper('create_custom_reader', name=name)
+        self.status = Preprocessor.BEFORE_SUB_BLOCK
+        self.main_prog = self.helper.main_program
+        self.sub_block = None
+        self.source_vars = None
+        self.sink_vars = None
+
+    def _is_completed(self):
+        return self.sub_block and self.source_vars and self.sink_vars
+
+    @contextlib.contextmanager
+    def block(self):
+        self.status = Preprocessor.IN_SUB_BLOCK
+        self.sub_block = self.main_prog.create_block()
+        try:
+            yield
+        finally:
+            self.main_prog.rollback()
+            self.status = Preprocessor.AFTER_SUB_BLOCK
+            if not self._is_completed():
+                raise RuntimeError(
+                    'Preprocessor block needs inputs() and outputs()')
+            self._install()
+
+    def inputs(self):
+        if self.status != Preprocessor.IN_SUB_BLOCK:
+            raise RuntimeError(
+                'Preprocessor.inputs() must be called inside block()')
+        feeder = get_reader_feeder(self.underlying.name)
+        self.source_vars = []
+        for i, (shape, dtype) in enumerate(
+                zip(feeder.shapes, feeder.dtypes)):
+            v = self.sub_block.create_var(
+                name=unique_name.generate('preprocessor_src_%d' % i),
+                dtype=dtype)
+            v.shape = tuple(shape)
+            self.source_vars.append(v)
+        return self.source_vars
+
+    def outputs(self, *outs):
+        if self.status != Preprocessor.IN_SUB_BLOCK:
+            raise RuntimeError(
+                'Preprocessor.outputs() must be called inside block()')
+        self.sink_vars = list(outs)
+
+    def _install(self):
+        from ..executor import Executor
+        src_names = [v.name for v in self.source_vars]
+        sink_names = [v.name for v in self.sink_vars]
+        # derived per-batch program: the sub-block's ops over feed vars
+        from ..framework import Program
+        prog = Program()
+        blk = prog.global_block()
+        for v in self.source_vars:
+            nv = blk.create_var(name=v.name, dtype=v.dtype)
+            nv.shape = getattr(v, 'shape', None)
+            nv.is_data = True
+        for op in self.sub_block.ops:
+            blk.append_op(type=op.type, inputs=dict(op.inputs),
+                          outputs=dict(op.outputs), attrs=dict(op.attrs))
+        for name, v in self.sub_block.vars.items():
+            if name not in blk.vars:
+                blk.vars[name] = v
+        underlying_feeder = get_reader_feeder(self.underlying.name)
+        exe = Executor(core.CPUPlace())
+
+        original_pop = underlying_feeder.pop
+
+        def transforming_pop():
+            batch = original_pop()
+            if batch is None:
+                return None
+            feed = dict(zip(src_names, batch))
+            outs = exe.run(prog, feed=feed, fetch_list=sink_names)
+            return tuple(np.asarray(o) for o in outs)
+
+        underlying_feeder.pop = transforming_pop
+
+    def __call__(self):
+        return self.underlying

@@ -8,7 +8,7 @@ __activations__ = [
     'sqrt', 'abs', 'ceil', 'floor', 'cos', 'sin', 'round', 'reciprocal',
     'log', 'square', 'softplus', 'softsign', 'brelu', 'leaky_relu',
     'soft_relu', 'elu', 'relu6', 'pow', 'stanh', 'hard_sigmoid', 'swish',
-    'relu', 'thresholded_relu', 'hard_shrink', 'maxout',
+    'relu', 'thresholded_relu', 'hard_shrink',
 ]
 
 __all__ = __activations__ + [
@@ -17,7 +17,7 @@ __all__ = __activations__ + [
     'elementwise_pow', 'uniform_random', 'gaussian_random',
     'uniform_random_batch_size_like', 'gaussian_random_batch_size_like',
     'scale', 'cumsum', 'clip', 'clip_by_norm', 'logical_and', 'logical_or',
-    'logical_xor', 'logical_not',
+    'logical_xor', 'logical_not', 'sampling_id',
 ]
 
 
@@ -83,6 +83,20 @@ logical_and = _logical_layer('logical_and')
 logical_or = _logical_layer('logical_or')
 logical_xor = _logical_layer('logical_xor')
 logical_not = _logical_layer('logical_not', binary=False)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype='int64'):
+    """Sample one column index per row of a probability matrix (reference
+    operators/sampling_id_op.cc; layers/ops.py export)."""
+    helper = LayerHelper('sampling_id', **locals())
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    out.shape = (x.shape[0], )
+    helper.append_op(
+        type='sampling_id',
+        inputs={'X': [x]},
+        outputs={'Out': [out]},
+        attrs={'min': float(min), 'max': float(max), 'seed': int(seed)})
+    return out
 
 
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):

@@ -384,18 +384,27 @@ def beam_init_scores(ref, beam_size):
 
 
 def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
-                level=0, name=None):
+                level=0, row_offsets=None, name=None):
     """One beam-search step (reference layers beam_search,
     operators/beam_search_op.cc) on the static [B*K] beam layout.
-    Returns (selected_ids, selected_scores, parent_idx)."""
-    if level != 0:
-        raise NotImplementedError(
-            'beam_search level != 0: nested-LoD candidate levels are '
-            'subsumed by the static [B*K] beam layout')
+    Returns (selected_ids, selected_scores, parent_idx).
+
+    ``level`` selects the grouping LoD level exactly like the reference
+    (``ToAbsOffset(ids.lod())[level]`` delimits the selection pools):
+    level 0 pools rows per source sentence (uniform K blocks, or the
+    explicit ``row_offsets`` for ragged sentence->candidate nesting —
+    the static carrier of the reference's 2-level LoD), level 1 makes
+    every candidate row its own pool (the beam-growth step).  Pool
+    selection, finished-row carry, and per-parent output grouping
+    follow beam_search_op.cc; tests/test_beam_search.py pins the
+    contract against a numpy oracle of that kernel."""
     helper = LayerHelper('beam_search', **locals())
     selected_ids = helper.create_variable_for_type_inference('int64')
     selected_scores = helper.create_variable_for_type_inference('float32')
     parent_idx = helper.create_variable_for_type_inference('int32')
+    attrs = {'beam_size': beam_size, 'end_id': end_id, 'level': level}
+    if row_offsets is not None:
+        attrs['row_offsets'] = [int(o) for o in row_offsets]
     helper.append_op(
         type='beam_search',
         inputs={
@@ -409,9 +418,7 @@ def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
             'selected_scores': [selected_scores],
             'parent_idx': [parent_idx],
         },
-        attrs={'beam_size': beam_size,
-               'end_id': end_id,
-               'level': level})
+        attrs=attrs)
     return selected_ids, selected_scores, parent_idx
 
 

@@ -9,9 +9,9 @@ from ..initializer import Constant
 
 __all__ = [
     'create_tensor', 'create_parameter', 'create_global_var', 'cast',
-    'concat', 'sums', 'assign', 'fill_constant_batch_size_like',
+    'concat', 'sums', 'sum', 'assign', 'fill_constant_batch_size_like',
     'fill_constant', 'argmin', 'argmax', 'argsort', 'ones', 'zeros',
-    'reverse',
+    'reverse', 'create_array', 'load',
 ]
 
 
@@ -77,6 +77,36 @@ def sums(input, out=None):
         type='sum',
         inputs={'X': input},
         outputs={'Out': [out]})
+    return out
+
+
+def sum(x):
+    """Elementwise sum of a list of tensors (reference layers.sum,
+    auto-generated from operators/sum_op.cc)."""
+    if isinstance(x, Variable):
+        x = [x]
+    return sums(list(x))
+
+
+def create_array(dtype):
+    """Create an empty LOD_TENSOR_ARRAY var (reference tensor.create_array)
+    for array_write/array_read plumbing."""
+    helper = LayerHelper('create_array')
+    return helper.create_variable(
+        name='{0}.out'.format(helper.name),
+        type=core.VarDesc.VarType.LOD_TENSOR_ARRAY,
+        dtype=dtype)
+
+
+def load(out, file_path, load_as_fp16=None):
+    """Load a saved tensor stream into ``out`` (reference layers.load /
+    operators/load_op.cc)."""
+    helper = LayerHelper('load')
+    attrs = {'file_path': file_path}
+    if load_as_fp16 is not None:
+        attrs['load_as_fp16'] = load_as_fp16
+    helper.append_op(
+        type='load', inputs={}, outputs={'Out': [out]}, attrs=attrs)
     return out
 
 

@@ -109,6 +109,13 @@ class DistributeTranspiler(object):
     def get_startup_program(self, endpoint, pserver_program=None):
         return Program()
 
+    def get_pserver_programs(self, endpoint):
+        """(main, startup) pair for one endpoint (reference
+        get_pserver_programs) — stubs under the SPMD replacement, like
+        get_pserver_program."""
+        return (self.get_pserver_program(endpoint),
+                self.get_startup_program(endpoint))
+
 
 def _shard_distributed_tables(program, axis, only_names=None):
     """Row-shard every ``lookup_table(is_distributed=True)`` table (and

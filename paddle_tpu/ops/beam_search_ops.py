@@ -71,7 +71,18 @@ def _beam_search(ctx, op):
     k = int(op.attrs['beam_size'])
     end_id = int(op.attrs['end_id'])
 
+    offsets = op.attrs.get('row_offsets')
+    level = int(op.attrs.get('level', 0))
     bk, c = scores.shape
+    if level != 0 and offsets is None:
+        # level selects the grouping LoD level (beam_search_op.cc:31
+        # abs_lod[level]); on the static layout level 1 is the
+        # candidate level — every row its own selection pool
+        offsets = list(range(bk + 1))
+    if offsets is not None:
+        _beam_search_pooled(ctx, op, pre_ids, pre_scores, ids, scores,
+                            [int(o) for o in offsets], k, end_id)
+        return
     b = bk // k
     finished = (pre_ids.reshape(bk) == end_id)  # [B*K]
 
@@ -93,6 +104,73 @@ def _beam_search(ctx, op):
     ctx.set(op, 'selected_ids', sel_ids.reshape(bk, 1))
     ctx.set(op, 'selected_scores', top_scores.reshape(bk, 1))
     ctx.set(op, 'parent_idx', parent_idx.reshape(bk))
+
+
+def _beam_search_pooled(ctx, op, pre_ids, pre_scores, ids, scores,
+                        offsets, k, end_id):
+    """Nested-LoD selection pools on the static layout (reference
+    beam_search_op.cc with a 2-level sentence->candidate LoD): ``offsets``
+    are the absolute row offsets of the pools at the chosen ``level`` —
+    exactly the reference's ``ToAbsOffset(lod)[level]``.  Pools may be
+    ragged.  Per pool: every live row contributes its C candidates, a
+    finished row (pre_id == end_id) contributes itself once with all its
+    probability mass (beam_search_op.cc:177-191), and a pool whose rows
+    are ALL finished keeps emitting end_id carries — the static stand-in
+    for PruneEndBeams' row removal (the decode backtrack drops them).
+    Output is [num_pools * k, 1], each pool's survivors ordered by
+    (parent row, score desc) to match the reference's per-parent
+    grouping.
+    """
+    bk, c = scores.shape
+    n_pools = len(offsets) - 1
+    finished = (pre_ids.reshape(bk) == end_id)
+
+    keep0 = jnp.zeros((bk, c), bool).at[:, 0].set(True)
+    cand_scores = jnp.where(finished[:, None],
+                            jnp.where(keep0, pre_scores.reshape(bk, 1),
+                                      NEG_INF), scores)
+    cand_ids = jnp.where(finished[:, None], end_id, ids)
+
+    # row -> pool id from the static offsets
+    import numpy as _np
+    row_pool = _np.searchsorted(_np.asarray(offsets[1:]),
+                                _np.arange(bk), side='right')
+    row_pool = jnp.asarray(row_pool, jnp.int32)  # [bk]
+
+    flat_scores = cand_scores.reshape(bk * c)
+    flat_ids = cand_ids.reshape(bk * c)
+    flat_row = jnp.repeat(jnp.arange(bk, dtype=jnp.int32), c)
+    flat_pool = jnp.repeat(row_pool, c)
+
+    sel_rows, sel_ids, sel_scores = [], [], []
+    # out-of-pool entries are masked strictly BELOW the in-pool padding
+    # (-1e9) so a pool with fewer than k finite candidates never ties
+    # into a foreign pool's entries; any selection at the foreign level
+    # is rewritten to an end_id carry on the pool's first row
+    FOREIGN = NEG_INF * 2
+    for s in range(n_pools):
+        pool_scores = jnp.where(flat_pool == s, flat_scores, FOREIGN)
+        top_scores, top_idx = jax.lax.top_k(pool_scores, k)
+        rows = jnp.take(flat_row, top_idx)
+        toks = jnp.take(flat_ids, top_idx)
+        foreign = top_scores <= (NEG_INF * 1.5)
+        rows = jnp.where(foreign, offsets[s], rows)
+        toks = jnp.where(foreign, end_id, toks)
+        top_scores = jnp.where(foreign, NEG_INF, top_scores)
+        # reference ToMap groups survivors by parent row; break score
+        # ties (and order) by (row, -score)
+        order = jnp.argsort(rows * jnp.float32(1e6) - top_scores,
+                            stable=True)
+        sel_rows.append(jnp.take(rows, order))
+        sel_ids.append(jnp.take(toks, order))
+        sel_scores.append(jnp.take(top_scores, order))
+
+    parent = jnp.concatenate(sel_rows).astype(jnp.int32)
+    out_ids = jnp.concatenate(sel_ids).reshape(n_pools * k, 1)
+    out_scores = jnp.concatenate(sel_scores).reshape(n_pools * k, 1)
+    ctx.set(op, 'selected_ids', out_ids)
+    ctx.set(op, 'selected_scores', out_scores)
+    ctx.set(op, 'parent_idx', parent)
 
 
 @register_lowering('beam_search_decode')

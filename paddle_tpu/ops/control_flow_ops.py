@@ -281,13 +281,68 @@ def _switch_case(ctx, op):
             ctx.store(n, val)
 
 
+def _split_compact(x, mask_rows):
+    """Rows where mask, compacted to the front in original order (static
+    shape; the tail holds the complementary rows and is never read by
+    merge — branch ops compute over it and the results are discarded,
+    with zero cotangent flowing back through the unconsumed positions)."""
+    # stable argsort of (not mask) floats selected rows first, in order
+    order = jnp.argsort(jnp.logical_not(mask_rows).astype(jnp.int32),
+                        stable=True)
+    return jnp.take(x, order, axis=0)
+
+
+@register_lowering('split_lod_tensor')
+def _split_lod_tensor(ctx, op):
+    """Reference operators/split_lod_tensor_op.cc on static shapes: both
+    outputs keep the input's buffer size with their selected rows
+    compacted to the front; merge_lod_tensor's mask-driven reconstruction
+    never reads the tail.  The row count rides the @SEQLEN-style
+    side-band for any consumer that needs it."""
+    x = ctx.get(op, 'X')
+    mask = ctx.get(op, 'Mask')
+    m = jnp.reshape(mask, (-1, )).astype(bool)
+    out_true = _split_compact(x, m)
+    out_false = _split_compact(x, jnp.logical_not(m))
+    ctx.set(op, 'OutTrue', out_true)
+    ctx.set(op, 'OutFalse', out_false)
+    n_true = jnp.sum(m.astype(jnp.int32))
+    for slot, n in (('OutTrue', n_true), ('OutFalse', x.shape[0] - n_true)):
+        names = op.output(slot)
+        if names:
+            ctx.env[names[0] + '@ROWCOUNT'] = n
+
+
+@register_lowering('merge_lod_tensor')
+def _merge_lod_tensor(ctx, op):
+    """Reference operators/merge_lod_tensor_op.cc: out row r is the next
+    unconsumed compacted row of InTrue when mask[r] else of InFalse —
+    the exact inverse of split_lod_tensor's compaction."""
+    mask = ctx.get(op, 'Mask')
+    in_true = ctx.get(op, 'InTrue')
+    in_false = ctx.get(op, 'InFalse')
+    m = jnp.reshape(mask, (-1, )).astype(bool)
+    ti = jnp.cumsum(m.astype(jnp.int32)) - 1
+    fi = jnp.cumsum(jnp.logical_not(m).astype(jnp.int32)) - 1
+    tv = jnp.take(in_true, jnp.clip(ti, 0, in_true.shape[0] - 1), axis=0)
+    fv = jnp.take(in_false, jnp.clip(fi, 0, in_false.shape[0] - 1), axis=0)
+    mm = jnp.reshape(m, (m.shape[0], ) + (1, ) * (tv.ndim - 1))
+    ctx.set(op, 'Out', jnp.where(mm, tv, fv))
+
+
 @register_lowering('ifelse')
 def _ifelse(ctx, op):
+    """Routed mode (branches read their row subsets via split_lod_tensor
+    ops inside the blocks): outputs reassemble with merge_lod_tensor
+    semantics.  Unrouted mode: both branches run on the full batch and a
+    defined rule — cond with matching leading dim selects per row, a
+    1-element cond selects whole tensors — picks each output."""
     cond = ctx.get(op, 'Cond')
     true_block = op.attrs['true_block']
     false_block = op.attrs['false_block']
     true_out = op.attrs['true_out']
     false_out = op.attrs['false_out']
+    routed = op.attrs.get('routed', False)
     for blk in (true_block, false_block):
         if blk is not None:
             _reject_host_ops(blk, 'ifelse')
@@ -298,11 +353,24 @@ def _ifelse(ctx, op):
     if false_block is not None:
         _run_block(ctx, false_block, env_f)
     c = jnp.reshape(cond, (-1, ))
+    m = c.astype(bool)
+    ti = jnp.cumsum(m.astype(jnp.int32)) - 1
+    fi = jnp.cumsum(jnp.logical_not(m).astype(jnp.int32)) - 1
     for out_name, tn, fn_ in zip(op.output('Out'), true_out, false_out):
         tv, fv = env_t[tn], env_f[fn_]
-        cc = jnp.reshape(c, (c.shape[0], ) + (1, ) * (tv.ndim - 1)) \
-            if tv.ndim > 1 and c.shape[0] == tv.shape[0] else \
-            jnp.reshape(cond, ()).astype(bool)
+        if routed and tv.ndim >= 1 and tv.shape[0] == c.shape[0]:
+            # branch outputs are compacted per split order: merge
+            tvr = jnp.take(tv, jnp.clip(ti, 0, tv.shape[0] - 1), axis=0)
+            fvr = jnp.take(fv, jnp.clip(fi, 0, fv.shape[0] - 1), axis=0)
+            mm = jnp.reshape(m, (m.shape[0], ) + (1, ) * (tv.ndim - 1))
+            ctx.store(out_name, jnp.where(mm, tvr, fvr))
+            continue
+        if tv.ndim > 1 and c.shape[0] == tv.shape[0] and c.shape[0] > 1:
+            cc = jnp.reshape(m, (c.shape[0], ) + (1, ) * (tv.ndim - 1))
+        else:
+            cc = jnp.reshape(cond, ()).astype(bool) if cond.size == 1 \
+                else jnp.reshape(m, (c.shape[0], ) +
+                                 (1, ) * (tv.ndim - 1))
         ctx.store(out_name, jnp.where(cc, tv, fv))
 
 

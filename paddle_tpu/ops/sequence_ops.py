@@ -587,3 +587,59 @@ def _lstmp(ctx, op):
     ctx.set(op, 'Cell', jnp.swapaxes(cs, 0, 1))
     ctx.set(op, 'BatchGate', x)
     ctx.set(op, 'BatchHidden', jnp.swapaxes(rs, 0, 1))
+
+
+@register_lowering('lod_rank_table')
+def _lod_rank_table(ctx, op):
+    """Length-descending stable sort permutation (reference
+    framework/lod_rank_table.h built by operators/lod_rank_table_op.cc).
+    On the padded layout the 'table' is the [B] int32 row permutation."""
+    x = ctx.get(op, 'X')
+    lengths = _seqlen(ctx, op)
+    b = x.shape[0]
+    if lengths is None:
+        lengths = jnp.full((b, ), x.shape[1] if x.ndim > 1 else 1,
+                           jnp.int32)
+    # stable argsort on (-length, row) keeps the reference's tie order
+    perm = jnp.argsort(-lengths.astype(jnp.int32), stable=True)
+    ctx.set(op, 'Out', perm.astype(jnp.int32))
+
+
+@register_lowering('reorder_lod_tensor_by_rank')
+def _reorder_lod_tensor_by_rank(ctx, op):
+    """Gather rows by a rank-table permutation (reference
+    operators/reorder_lod_tensor_by_rank_op.cc); the sequence-length
+    side-band is permuted alongside the data."""
+    x = ctx.get(op, 'X')
+    perm = ctx.get(op, 'RankTable')
+    out = jnp.take(x, perm, axis=0)
+    ctx.set(op, 'Out', out)
+    lengths = _seqlen(ctx, op)
+    if lengths is not None:
+        out_name = op.output('Out')[0]
+        ctx.env[out_name + SEQLEN_SUFFIX] = jnp.take(lengths, perm, axis=0)
+
+
+@register_lowering('context_project')
+def _context_project(ctx, op):
+    """Parameter-free context-window concatenation (reference
+    math/context_project.h, the substrate of context_projection):
+    out[:, t] = concat(x[:, t+start], ..., x[:, t+start+L-1]) with zero
+    padding outside the time range."""
+    x = ctx.get(op, 'X')  # [B, T, D]
+    ctx_len = int(op.attrs['context_len'])
+    start = int(op.attrs.get('context_start',
+                             -((ctx_len - 1) // 2)))
+    b, t, d = x.shape
+    parts = []
+    for j in range(ctx_len):
+        off = start + j
+        if off == 0:
+            parts.append(x)
+        elif off > 0:
+            pad = jnp.zeros((b, off, d), x.dtype)
+            parts.append(jnp.concatenate([x[:, off:], pad], axis=1))
+        else:
+            pad = jnp.zeros((b, -off, d), x.dtype)
+            parts.append(jnp.concatenate([pad, x[:, :off]], axis=1))
+    ctx.set(op, 'Out', jnp.concatenate(parts, axis=2))

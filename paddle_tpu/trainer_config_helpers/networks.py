@@ -1,10 +1,18 @@
-"""Legacy composite networks (reference
-trainer_config_helpers/networks.py): simple_lstm / simple_gru /
-simple_img_conv_pool as layer compositions."""
+"""Legacy composite networks (reference trainer_config_helpers/
+networks.py — 1587 LoC of layer compositions; this file carries the
+presets the book/demo configs used: conv stacks through VGG-16,
+uni/bidirectional recurrent nets, and the attention blocks)."""
 
 from . import layers as _l
+from .poolings import MaxPooling
+from ..v2 import layer as _v2
 
-__all__ = ['simple_lstm', 'simple_gru', 'simple_img_conv_pool']
+__all__ = [
+    'simple_lstm', 'simple_gru', 'simple_gru2', 'simple_img_conv_pool',
+    'img_conv_bn_pool', 'img_conv_group', 'vgg_16_network',
+    'bidirectional_lstm', 'bidirectional_gru', 'simple_attention',
+    'dot_product_attention', 'sequence_conv_pool', 'text_conv_pool',
+]
 
 
 def simple_lstm(input, size, name=None, **kwargs):
@@ -18,9 +26,158 @@ def simple_gru(input, size, name=None, **kwargs):
     return _l.grumemory(input=input, size=size, name=name)
 
 
+def simple_gru2(input, size, name=None, **kwargs):
+    """reference simple_gru2: explicit 3x gate projection + grumemory."""
+    proj = _l.fc_layer(input=input, size=size * 3)
+    return _l.grumemory(input=proj, size=size, name=name)
+
+
 def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
                          pool_stride=1, act=None, name=None, **kwargs):
     conv = _l.img_conv_layer(input=input, filter_size=filter_size,
                              num_filters=num_filters, act=act)
     return _l.img_pool_layer(input=conv, pool_size=pool_size,
                              stride=pool_stride, name=name)
+
+
+def img_conv_bn_pool(input, filter_size, num_filters, pool_size,
+                     pool_stride=1, act=None, name=None, **kwargs):
+    """conv + batch_norm + pool (reference img_conv_bn_pool)."""
+    conv = _l.img_conv_layer(input=input, filter_size=filter_size,
+                             num_filters=num_filters, act=None)
+    bn = _l.batch_norm_layer(input=conv, act=act)
+    return _l.img_pool_layer(input=bn, pool_size=pool_size,
+                             stride=pool_stride, name=name)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_filter_size=3,
+                   conv_act=None, conv_with_batchnorm=False,
+                   pool_stride=2, num_channels=None, name=None, **kwargs):
+    """N stacked convs closed by one pool (reference img_conv_group)."""
+    tmp = input
+    if not isinstance(conv_num_filter, (list, tuple)):
+        conv_num_filter = [conv_num_filter]
+    for i, nf in enumerate(conv_num_filter):
+        tmp = _l.img_conv_layer(
+            input=tmp, filter_size=conv_filter_size, num_filters=nf,
+            num_channels=num_channels if i == 0 else None,
+            padding=(conv_filter_size - 1) // 2,
+            act=None if conv_with_batchnorm else conv_act)
+        if conv_with_batchnorm:
+            tmp = _l.batch_norm_layer(input=tmp, act=conv_act)
+    return _l.img_pool_layer(input=tmp, pool_size=pool_size,
+                             stride=pool_stride, name=name)
+
+
+def vgg_16_network(input_image, num_channels, num_classes=1000,
+                   **kwargs):
+    """VGG-16 (reference networks.py vgg_16_network): five conv groups
+    (2-2-3-3-3 convs of 64/128/256/512/512 filters, each closed by a
+    2x2 pool), two fc-4096 + dropout, softmax head."""
+    from .activations import ReluActivation, SoftmaxActivation
+    tmp = input_image
+    for gi, (filters, depth) in enumerate(((64, 2), (128, 2), (256, 3),
+                                           (512, 3), (512, 3))):
+        tmp = img_conv_group(
+            input=tmp, conv_num_filter=[filters] * depth, pool_size=2,
+            conv_filter_size=3, conv_act=ReluActivation(),
+            conv_with_batchnorm=True, pool_stride=2,
+            num_channels=num_channels if gi == 0 else None)
+    for _ in range(2):
+        tmp = _l.fc_layer(input=tmp, size=4096, act=ReluActivation())
+        tmp = _l.dropout_layer(input=tmp, dropout_rate=0.5)
+    return _l.fc_layer(input=tmp, size=num_classes,
+                       act=SoftmaxActivation())
+
+
+def bidirectional_lstm(input, size, return_seq=False, name=None,
+                       **kwargs):
+    """Forward + backward lstmemory, concatenated (reference
+    networks.py bidirectional_lstm)."""
+    fwd_proj = _l.fc_layer(input=input, size=size * 4)
+    fwd = _v2.lstmemory(input=fwd_proj, size=size)
+    bwd_proj = _l.fc_layer(input=input, size=size * 4)
+    bwd = _v2.lstmemory(input=bwd_proj, size=size, reverse=True)
+    if return_seq:
+        return _l.concat_layer(input=[fwd, bwd], name=name)
+    return _l.concat_layer(
+        input=[_l.last_seq(input=fwd), _l.first_seq(input=bwd)],
+        name=name)
+
+
+def bidirectional_gru(input, size, return_seq=False, name=None,
+                      **kwargs):
+    fwd = _v2.gru_like(input=input, size=size)
+    bwd = _v2.gru_like(input=input, size=size, reverse=True)
+    if return_seq:
+        return _l.concat_layer(input=[fwd, bwd], name=name)
+    return _l.concat_layer(
+        input=[_l.last_seq(input=fwd), _l.first_seq(input=bwd)],
+        name=name)
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     name=None, **kwargs):
+    """Bahdanau-style additive attention (reference networks.py
+    simple_attention): score = fc(tanh(proj + expand(decoder_state))),
+    context = sum(softmax(score) * encoded_sequence)."""
+    from .. import fluid
+
+    def build(ctx, seq_var, proj_var, state_var):
+        dec = fluid.layers.fc(state_var, size=proj_var.shape[-1],
+                              bias_attr=False)
+        dec_seq = fluid.layers.sequence_expand(dec, proj_var)
+        mix = fluid.layers.tanh(
+            fluid.layers.elementwise_add(proj_var, dec_seq))
+        # score vector v: e[b,t] = <v, mix[b,t,:]> (the fc-to-1 of the
+        # reference, written shape-agnostically over the padded layout)
+        d = int(proj_var.shape[-1])
+        vparam = fluid.layers.create_parameter(shape=[d], dtype='float32')
+        e = fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(mix, vparam, axis=-1),
+            dim=-1, keep_dim=True)
+        w = fluid.layers.sequence_softmax(e)
+        scaled = fluid.layers.elementwise_mul(seq_var, w, axis=0)
+        return fluid.layers.sequence_pool(scaled, pool_type='sum')
+
+    return _v2.Layer(
+        'simple_attention',
+        [encoded_sequence, encoded_proj, decoder_state], build,
+        name=name, size=encoded_sequence.size)
+
+
+def dot_product_attention(attended_sequence, attending_sequence,
+                          transformed_state, name=None, **kwargs):
+    """Dot-product attention (reference networks.py
+    dot_product_attention)."""
+    from .. import fluid
+
+    def build(ctx, attended_var, attending_var, state_var):
+        expanded = fluid.layers.sequence_expand(state_var, attending_var)
+        e = fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(attending_var, expanded),
+            dim=-1, keep_dim=True)
+        w = fluid.layers.sequence_softmax(e)
+        scaled = fluid.layers.elementwise_mul(attended_var, w, axis=0)
+        return fluid.layers.sequence_pool(scaled, pool_type='sum')
+
+    return _v2.Layer(
+        'dot_product_attention',
+        [attended_sequence, attending_sequence, transformed_state],
+        build, name=name, size=attended_sequence.size)
+
+
+def sequence_conv_pool(input, context_len, hidden_size,
+                       pool_type=None, name=None, **kwargs):
+    """Context projection + fc + sequence pool (reference
+    sequence_conv_pool — the text-CNN block)."""
+    proj = _l.mixed_layer(
+        size=input.size * context_len,
+        input=[_l.context_projection(input, context_len=context_len)])
+    hidden = _l.fc_layer(input=proj, size=hidden_size)
+    return _l.pooling_layer(input=hidden,
+                            pooling_type=pool_type or MaxPooling(),
+                            name=name)
+
+
+text_conv_pool = sequence_conv_pool

@@ -22,6 +22,16 @@ __all__ = [
     'max_id', 'trans', 'scaling', 'slope_intercept', 'sum_cost',
     'rank_cost', 'smooth_l1_cost', 'huber_regression_cost',
     'multi_binary_label_cross_entropy_cost', 'lstmemory', 'gru_like',
+    # round-3 tail (VERDICT r2 next-#8)
+    'cos_sim', 'maxout', 'block_expand', 'expand', 'repeat', 'seq_concat',
+    'seq_reshape', 'interpolation', 'power', 'sum_to_one_norm', 'clip',
+    'pad', 'rotate', 'img_cmrnorm', 'bilinear_interp', 'row_conv',
+    'multiplex', 'dot_prod', 'out_prod', 'l2_distance', 'sampling_id',
+    'print_layer', 'gru_step', 'lstm_step', 'crf', 'crf_decoding', 'ctc',
+    'hsigmoid', 'nce', 'huber_classification_cost', 'mixed',
+    'full_matrix_projection', 'trans_full_matrix_projection',
+    'identity_projection', 'table_projection', 'dotmul_projection',
+    'context_projection', 'conv_projection',
 ]
 
 
@@ -108,8 +118,17 @@ def img_conv(input, filter_size, num_filters, num_channels=None, stride=1,
              padding=0, act=None, name=None, **kwargs):
     def build(ctx, parent_var):
         a = _act_name(act)
+        v = parent_var
+        if len(v.shape) == 2:
+            # legacy configs feed images as flat dense vectors; recover
+            # [B, C, H, W] from num_channels + a square spatial extent
+            # (the reference config_parser did the same with the data
+            # layer's height/width fields)
+            c = num_channels or 1
+            hw = int(round((input.size // c) ** 0.5))
+            v = fluid.layers.reshape(v, shape=[-1, c, hw, hw])
         return fluid.layers.conv2d(
-            parent_var, num_filters=num_filters, filter_size=filter_size,
+            v, num_filters=num_filters, filter_size=filter_size,
             stride=stride, padding=padding, act=a)
 
     return Layer('img_conv', [input], build, name=name, size=num_filters)
@@ -328,7 +347,7 @@ def recurrent_group(step, input, name=None, **kwargs):
     return layer
 
 
-def lstmemory(input, size=None, name=None, **kwargs):
+def lstmemory(input, size=None, name=None, reverse=False, **kwargs):
     """LSTM over a pre-projected [*, 4D] sequence (reference layer.py
     lstmemory: input must already be width 4*size)."""
 
@@ -338,19 +357,21 @@ def lstmemory(input, size=None, name=None, **kwargs):
             raise ValueError(
                 'lstmemory: cannot infer the hidden width — the input '
                 'layer declares no size; pass size= explicitly')
-        hidden, _ = fluid.layers.dynamic_lstm(parent_var, size=width * 4)
+        hidden, _ = fluid.layers.dynamic_lstm(parent_var, size=width * 4,
+                                              is_reverse=reverse)
         return hidden
 
     return Layer('lstmemory', [input], build, name=name, size=size)
 
 
-def gru_like(input, size, name=None, **kwargs):
+def gru_like(input, size, name=None, reverse=False, **kwargs):
     """GRU block: gate projection + dynamic_gru (reference networks.py
     simple_gru)."""
 
     def build(ctx, parent_var):
         proj = fluid.layers.fc(parent_var, size=size * 3)
-        return fluid.layers.dynamic_gru(proj, size=size)
+        return fluid.layers.dynamic_gru(proj, size=size,
+                                        is_reverse=reverse)
 
     return Layer('gru', [input], build, name=name, size=size)
 
@@ -484,3 +505,435 @@ def multi_binary_label_cross_entropy_cost(input, label, name=None,
 
     return _cost_layer('multi_binary_label_cross_entropy',
                        [input, label], build, name, prediction=input)
+
+
+# ---- round-3 layer tail (VERDICT r2 next-#8: the most-used missing v2
+# kinds, each a declarative node over the fluid stack; reference
+# python/paddle/v2/layer.py auto-generates these from
+# trainer_config_helpers/layers.py builders of the same names) ----
+def cos_sim(a, b, scale=1.0, name=None, **kwargs):
+    def build(ctx, av, bv):
+        return fluid.layers.scale(fluid.layers.cos_sim(av, bv),
+                                  scale=float(scale))
+
+    return Layer('cos_sim', [a, b], build, name=name, size=1)
+
+
+def maxout(input, groups, name=None, **kwargs):
+    def build(ctx, v):
+        return fluid.layers.maxout(v, groups=groups)
+
+    return Layer('maxout', [input], build, name=name)
+
+
+def block_expand(input, block_x, block_y, stride_x=1, stride_y=1,
+                 padding_x=0, padding_y=0, name=None, **kwargs):
+    """Image -> sequence of flattened blocks (reference block_expand_layer
+    / operators/im2sequence_op.cc)."""
+
+    def build(ctx, v):
+        return fluid.layers.im2sequence(
+            v, filter_size=[block_y, block_x],
+            stride=[stride_y, stride_x], padding=[padding_y, padding_x])
+
+    return Layer('block_expand', [input], build, name=name)
+
+
+def expand(input, expand_as, name=None, **kwargs):
+    def build(ctx, v, ref):
+        return fluid.layers.sequence_expand(v, ref)
+
+    return Layer('expand', [input, expand_as], build, name=name,
+                 size=input.size)
+
+
+def repeat(input, num_repeats, name=None, **kwargs):
+    def build(ctx, v):
+        return fluid.layers.expand(v, expand_times=[1, num_repeats])
+
+    return Layer('repeat', [input], build, name=name)
+
+
+def seq_concat(a, b, name=None, **kwargs):
+    """Per-instance TIME concatenation (reference seq_concat_layer)."""
+
+    def build(ctx, av, bv):
+        return fluid.layers.sequence_concat([av, bv])
+
+    return Layer('seq_concat', [a, b], build, name=name, size=a.size)
+
+
+def seq_reshape(input, reshape_size, name=None, **kwargs):
+    def build(ctx, v):
+        return fluid.layers.sequence_reshape(v, new_dim=reshape_size)
+
+    return Layer('seq_reshape', [input], build, name=name,
+                 size=reshape_size)
+
+
+def interpolation(input, weight, name=None, **kwargs):
+    """w*x + (1-w)*y with per-row weight (reference interpolation_layer).
+    ``input`` is [x, y]."""
+    x, y = input
+
+    def build(ctx, xv, yv, wv):
+        wx = fluid.layers.elementwise_mul(xv, wv, axis=0)
+        wy = fluid.layers.elementwise_mul(
+            yv, fluid.layers.scale(wv, scale=-1.0, bias=1.0), axis=0)
+        return fluid.layers.elementwise_add(wx, wy)
+
+    return Layer('interpolation', [x, y, weight], build, name=name,
+                 size=x.size)
+
+
+def power(input, weight, name=None, **kwargs):
+    """out[i] = input[i] ^ weight[i] (reference power_layer)."""
+
+    def build(ctx, v, wv):
+        logv = fluid.layers.log(v)
+        return fluid.layers.exp(
+            fluid.layers.elementwise_mul(logv, wv, axis=0))
+
+    return Layer('power', [input, weight], build, name=name,
+                 size=input.size)
+
+
+def sum_to_one_norm(input, name=None, **kwargs):
+    def build(ctx, v):
+        s = fluid.layers.reduce_sum(v, dim=1, keep_dim=True)
+        return fluid.layers.elementwise_div(v, s)
+
+    return Layer('sum_to_one_norm', [input], build, name=name,
+                 size=input.size)
+
+
+def clip(input, min, max, name=None, **kwargs):
+    def build(ctx, v):
+        return fluid.layers.clip(v, min=float(min), max=float(max))
+
+    return Layer('clip', [input], build, name=name, size=input.size)
+
+
+def pad(input, pad_c=None, pad_h=None, pad_w=None, name=None, **kwargs):
+    def build(ctx, v):
+        paddings = []
+        for p in (pad_c, pad_h, pad_w):
+            paddings += list(p) if p else [0, 0]
+        return fluid.layers.pad(v, paddings=[0, 0] + paddings)
+
+    return Layer('pad', [input], build, name=name)
+
+
+def rotate(input, height, width, name=None, **kwargs):
+    """90-degree CCW rotation of the HxW planes (reference
+    rotate_layer)."""
+
+    def build(ctx, v):
+        c = (input.size or height * width) // (height * width)
+        img = fluid.layers.reshape(v, shape=[-1, c, height, width])
+        t = fluid.layers.transpose(img, perm=[0, 1, 3, 2])
+        rev = fluid.layers.reverse(t, axis=2)
+        return fluid.layers.reshape(rev, shape=[-1, c * height * width])
+
+    return Layer('rotate', [input], build, name=name, size=input.size)
+
+
+def img_cmrnorm(input, size=5, scale=0.0001, power=0.75, name=None,
+                **kwargs):
+    def build(ctx, v):
+        return fluid.layers.lrn(v, n=size, alpha=scale, beta=power)
+
+    return Layer('img_cmrnorm', [input], build, name=name)
+
+
+def bilinear_interp(input, out_size_x, out_size_y, name=None, **kwargs):
+    def build(ctx, v):
+        return fluid.layers.image_resize(
+            v, out_shape=[out_size_y, out_size_x], resample='BILINEAR')
+
+    return Layer('bilinear_interp', [input], build, name=name)
+
+
+def row_conv(input, context_len, name=None, **kwargs):
+    def build(ctx, v):
+        return fluid.layers.row_conv(v, future_context_size=context_len)
+
+    return Layer('row_conv', [input], build, name=name, size=input.size)
+
+
+def multiplex(input, name=None, **kwargs):
+    """input[0] is the per-row selector into input[1:] (reference
+    multiplex_layer)."""
+
+    def build(ctx, idx, *choices):
+        return fluid.layers.multiplex(list(choices), idx)
+
+    return Layer('multiplex', list(input), build, name=name)
+
+
+def dot_prod(a, b, name=None, **kwargs):
+    def build(ctx, av, bv):
+        return fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(av, bv), dim=1, keep_dim=True)
+
+    return Layer('dot_prod', [a, b], build, name=name, size=1)
+
+
+def out_prod(a, b, name=None, **kwargs):
+    """Row-wise outer product flattened (reference out_prod_layer)."""
+
+    def build(ctx, av, bv):
+        m, n = a.size, b.size
+        ar = fluid.layers.reshape(av, shape=[-1, m, 1])
+        br = fluid.layers.reshape(bv, shape=[-1, 1, n])
+        return fluid.layers.reshape(
+            fluid.layers.matmul(ar, br), shape=[-1, m * n])
+
+    return Layer('out_prod', [a, b], build, name=name,
+                 size=(a.size or 0) * (b.size or 0))
+
+
+def l2_distance(a, b, name=None, **kwargs):
+    def build(ctx, av, bv):
+        d = fluid.layers.elementwise_sub(av, bv)
+        return fluid.layers.sqrt(
+            fluid.layers.reduce_sum(
+                fluid.layers.elementwise_mul(d, d), dim=1, keep_dim=True))
+
+    return Layer('l2_distance', [a, b], build, name=name, size=1)
+
+
+def sampling_id(input, name=None, **kwargs):
+    def build(ctx, v):
+        return fluid.layers.sampling_id(v)
+
+    return Layer('sampling_id', [input], build, name=name, size=1)
+
+
+def print_layer(input, message=None, name=None, **kwargs):
+    def build(ctx, v):
+        return fluid.layers.Print(v, message=message or '')
+
+    return Layer('print', [input], build, name=name, size=input.size)
+
+
+def gru_step(input, state, size, act=None, gate_act=None, name=None,
+             **kwargs):
+    """One GRU step inside a recurrent_group (reference gru_step_layer /
+    operators/gru_unit_op.cc)."""
+
+    def build(ctx, iv, sv):
+        h, _, _ = fluid.layers.gru_unit(
+            input=iv, hidden=sv, size=size * 3,
+            activation=_act_name(act) or 'tanh',
+            gate_activation=_act_name(gate_act) or 'sigmoid')
+        return h
+
+    return Layer('gru_step', [input, state], build, name=name, size=size)
+
+
+def lstm_step(input, state, cell, size, act=None, gate_act=None,
+              name=None, **kwargs):
+    """One LSTM step (reference lstm_step_layer / lstm_unit_op): returns
+    the hidden; pair with a second memory for the cell via
+    ``get_output``-style wiring in the step fn."""
+
+    def build(ctx, iv, sv, cv):
+        h, c = fluid.layers.lstm_unit(
+            x_t=iv, hidden_t_prev=sv, cell_t_prev=cv)
+        ctx['%s@cell' % (name or 'lstm_step')] = c
+        return h
+
+    return Layer('lstm_step', [input, state, cell], build, name=name,
+                 size=size)
+
+
+def crf(input, label, size=None, name=None, **kwargs):
+    """Linear-chain CRF cost (reference crf_layer /
+    operators/linear_chain_crf_op.cc)."""
+
+    def build(ctx, iv, lv):
+        ll = fluid.layers.linear_chain_crf(
+            input=iv, label=lv,
+            param_attr=fluid.ParamAttr(name=(name or 'crf') + '_w'))
+        return fluid.layers.mean(ll)
+
+    return _cost_layer('crf', [input, label], build, name,
+                       prediction=input)
+
+
+def crf_decoding(input, size=None, label=None, name=None, **kwargs):
+    def build(ctx, iv, *rest):
+        return fluid.layers.crf_decoding(
+            input=iv, param_attr=fluid.ParamAttr(
+                name=(name or 'crf') + '_w'))
+
+    parents = [input] + ([label] if label is not None else [])
+    return Layer('crf_decoding', parents, build, name=name, size=1)
+
+
+def ctc(input, label, size=None, blank=0, norm_by_times=False, name=None,
+        **kwargs):
+    """CTC cost (reference ctc_layer / warp_ctc_layer -> warpctc_op)."""
+
+    def build(ctx, iv, lv):
+        loss = fluid.layers.warpctc(input=iv, label=lv, blank=blank,
+                                    norm_by_times=norm_by_times)
+        return fluid.layers.mean(loss)
+
+    return _cost_layer('ctc', [input, label], build, name,
+                       prediction=input)
+
+
+def hsigmoid(input, label, num_classes, name=None, **kwargs):
+    def build(ctx, iv, lv):
+        return fluid.layers.mean(
+            fluid.layers.hsigmoid(iv, lv, num_classes))
+
+    return _cost_layer('hsigmoid', [input, label], build, name,
+                       prediction=input)
+
+
+def nce(input, label, num_classes, num_neg_samples=10, name=None,
+        **kwargs):
+    def build(ctx, iv, lv):
+        return fluid.layers.mean(
+            fluid.layers.nce(input=iv, label=lv, num_total_classes=
+                             num_classes,
+                             num_neg_samples=num_neg_samples))
+
+    return _cost_layer('nce', [input, label], build, name,
+                       prediction=input)
+
+
+def huber_classification_cost(input, label, name=None, **kwargs):
+    """Huber loss for {0,1} classification on a +-1 margin (reference
+    huber_classification_cost): y' = 2y-1, quadratic inside the margin,
+    linear beyond."""
+
+    def build(ctx, iv, lv):
+        y = fluid.layers.scale(fluid.layers.cast(lv, 'float32'),
+                               scale=2.0, bias=-1.0)
+        z = fluid.layers.elementwise_mul(iv, y)
+        one_minus = fluid.layers.scale(z, scale=-1.0, bias=1.0)
+        hinge = fluid.layers.relu(one_minus)
+        inside = fluid.layers.cast(
+            fluid.layers.less_than(
+                fluid.layers.scale(z, scale=-1.0),
+                fluid.layers.fill_constant_batch_size_like(
+                    z, shape=[-1, 1], value=1.0, dtype='float32')),
+            'float32')  # z > -1
+        quad = fluid.layers.elementwise_mul(hinge, hinge)
+        lin = fluid.layers.scale(z, scale=-4.0)  # -4z for z < -1
+        per = fluid.layers.elementwise_add(
+            fluid.layers.elementwise_mul(inside, quad),
+            fluid.layers.elementwise_mul(
+                fluid.layers.scale(inside, scale=-1.0, bias=1.0), lin))
+        return fluid.layers.mean(per)
+
+    return _cost_layer('huber_classification_cost', [input, label],
+                       build, name, prediction=input)
+
+
+# ---- mixed layer + projections (reference mixed_layer; each projection
+# contributes a term summed by the mixed node) ----
+class _Projection(object):
+    def __init__(self, parent, term_fn, size=None):
+        self.parent = parent
+        self.term_fn = term_fn
+        self.size = size
+
+
+def full_matrix_projection(input, size, **kwargs):
+    return _Projection(
+        input, lambda v: fluid.layers.fc(v, size=size, bias_attr=False),
+        size=size)
+
+
+def trans_full_matrix_projection(input, size, **kwargs):
+    return _Projection(
+        input, lambda v: fluid.layers.fc(v, size=size, bias_attr=False),
+        size=size)
+
+
+def identity_projection(input, **kwargs):
+    return _Projection(input, lambda v: v, size=input.size)
+
+
+def table_projection(input, size, **kwargs):
+    vocab = input.size
+
+    def term(v):
+        return fluid.layers.embedding(v, size=[vocab, size])
+
+    return _Projection(input, term, size=size)
+
+
+def dotmul_projection(input, **kwargs):
+    size = input.size
+
+    def term(v):
+        w = fluid.layers.create_parameter(shape=[size], dtype='float32')
+        return fluid.layers.elementwise_mul(v, w, axis=1)
+
+    return _Projection(input, term, size=size)
+
+
+def context_projection(input, context_len, context_start=None, **kwargs):
+    """Parameter-free context concatenation (reference
+    context_projection / math/context_project.h): out[t] is the window
+    [t+start, t+start+context_len) of rows concatenated feature-wise,
+    zero-padded outside the sequence.  No trainable weight — the
+    reference's trainable variant is sequence_conv, kept separate."""
+    start = (-((context_len - 1) // 2) if context_start is None
+             else context_start)
+
+    def term(v):
+        # time shifts need the padded runtime layout: one op, lowered in
+        # ops/sequence_ops.py:_context_project over the [B, T, D] view
+        from ..fluid.layer_helper import LayerHelper
+        helper = LayerHelper('context_project')
+        out = helper.create_variable_for_type_inference(dtype=v.dtype)
+        out.shape = tuple(v.shape[:-1]) + (
+            (v.shape[-1] or 0) * context_len, )
+        helper.append_op(
+            type='context_project',
+            inputs={'X': [v]},
+            outputs={'Out': [out]},
+            attrs={'context_len': int(context_len),
+                   'context_start': int(start)})
+        return out
+
+    return _Projection(input, term, size=input.size * context_len)
+
+
+def conv_projection(input, filter_size, num_filters, num_channels=None,
+                    stride=1, padding=0, **kwargs):
+    def term(v):
+        return fluid.layers.conv2d(
+            v, num_filters=num_filters, filter_size=filter_size,
+            stride=stride, padding=padding, bias_attr=False)
+
+    return _Projection(input, term, size=num_filters)
+
+
+def mixed(size=None, input=None, act=None, bias_attr=None, name=None,
+          **kwargs):
+    """Sum of projection terms + optional activation (reference
+    mixed_layer)."""
+    projs = input if isinstance(input, (list, tuple)) else [input]
+    parents = [p.parent for p in projs]
+
+    def build(ctx, *parent_vars):
+        out = None
+        for proj, v in zip(projs, parent_vars):
+            term = proj.term_fn(v)
+            out = term if out is None else \
+                fluid.layers.elementwise_add(out, term)
+        a = _act_name(act)
+        if a:
+            out = getattr(fluid.layers, a)(out)
+        return out
+
+    return Layer('mixed', parents, build, name=name,
+                 size=size or projs[0].size)

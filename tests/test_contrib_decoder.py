@@ -1,0 +1,221 @@
+"""contrib decoder DSL (InitState/StateCell/TrainingDecoder/
+BeamSearchDecoder) + contrib.memory_usage + the round-3 API-parity tail
+(reference contrib/decoder/beam_search_decoder.py, memory_usage_calc.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+VOCAB = 37
+EMB = 16
+HID = 16
+
+
+def _build_cell(boot):
+    init_h = fluid.contrib.InitState(init=boot)
+    cell = fluid.contrib.StateCell(
+        inputs={'x': None}, states={'h': init_h}, out_state='h')
+
+    @cell.state_updater
+    def updater(state_cell):
+        x = state_cell.get_input('x')
+        h = state_cell.get_state('h')
+        new_h = fluid.layers.fc(input=[x, h], size=HID, act='tanh')
+        state_cell.set_state('h', new_h)
+
+    return cell
+
+
+def test_training_decoder_trains():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = fluid.layers.data('src', shape=[1], dtype='int64',
+                                lod_level=1)
+        trg = fluid.layers.data('trg', shape=[1], dtype='int64',
+                                lod_level=1)
+        lbl = fluid.layers.data('lbl', shape=[1], dtype='int64',
+                                lod_level=1)
+        src_emb = fluid.layers.embedding(src, size=[VOCAB, EMB])
+        enc_last = fluid.layers.sequence_pool(src_emb, pool_type='last')
+        boot = fluid.layers.fc(enc_last, size=HID, act='tanh')
+        cell = _build_cell(boot)
+
+        decoder = fluid.contrib.TrainingDecoder(cell)
+        trg_emb = fluid.layers.embedding(trg, size=[VOCAB, EMB])
+        with decoder.block():
+            word = decoder.step_input(trg_emb)
+            decoder.state_cell.compute_state(inputs={'x': word})
+            score = fluid.layers.fc(
+                input=decoder.state_cell.get_state('h'),
+                size=VOCAB, act='softmax')
+            decoder.state_cell.update_states()
+            decoder.output(score)
+        probs = decoder()
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=probs, label=lbl))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    B, T = 4, 6
+
+    def lod_ids():
+        rows = [rng.randint(2, VOCAB, size=(T, 1)).tolist()
+                for _ in range(B)]
+        return fluid.create_lod_tensor(rows, [[T] * B])
+
+    feed = {'src': lod_ids(), 'trg': lod_ids(), 'lbl': lod_ids()}
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        losses = [float(np.asarray(
+            exe.run(main, feed=feed, fetch_list=[loss])[0]))
+            for _ in range(6)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_beam_search_decoder_decodes():
+    beam_size, max_len = 3, 5
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = fluid.layers.data('src', shape=[1], dtype='int64',
+                                lod_level=1)
+        src_emb = fluid.layers.embedding(src, size=[VOCAB, EMB])
+        enc_last = fluid.layers.sequence_pool(src_emb, pool_type='last')
+        boot = fluid.layers.fc(enc_last, size=HID, act='tanh')
+        boot_beam = fluid.layers.beam_expand(boot, beam_size)
+        cell = _build_cell(boot_beam)
+        init_ids = fluid.layers.fill_constant_batch_size_like(
+            input=boot_beam, shape=[-1, 1], value=0.0, dtype='int64')
+        init_scores = fluid.layers.beam_init_scores(boot, beam_size)
+
+        decoder = fluid.contrib.BeamSearchDecoder(
+            state_cell=cell,
+            init_ids=init_ids,
+            init_scores=init_scores,
+            target_dict_dim=VOCAB,
+            word_dim=EMB,
+            topk_size=10,
+            sparse_emb=False,
+            max_len=max_len,
+            beam_size=beam_size,
+            end_id=1)
+        decoder.decode()
+        sent_ids, sent_scores = decoder()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(1)
+    B, T = 2, 4
+    rows = [rng.randint(2, VOCAB, size=(T, 1)).tolist() for _ in range(B)]
+    feed = {'src': fluid.create_lod_tensor(rows, [[T] * B])}
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        ids, scores = exe.run(main, feed=feed,
+                              fetch_list=[sent_ids, sent_scores])
+    ids = np.asarray(ids)
+    assert ids.shape[0] == B
+    assert ids.shape[1] == beam_size
+    assert np.asarray(scores).shape[:2] == (B, beam_size)
+
+
+def test_memory_usage():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = fluid.layers.data('x', shape=[784])
+        fluid.layers.fc(x, size=100)
+    low, high, unit = fluid.contrib.memory_usage(main, batch_size=32)
+    assert low > 0 and high >= low and unit in ('B', 'KB', 'MB', 'GB')
+    with pytest.raises(ValueError):
+        fluid.contrib.memory_usage(main, batch_size=0)
+
+
+def test_api_tail_small_surfaces():
+    """get_var, Program.optimized_guard/copy_data_info_from, Operator
+    rename/kernel helpers, LoDTensorArray, ps dispatchers, layers.sum/
+    create_array/Print/is_empty, sampling_id, lod_rank_table+reorder."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4])
+        y = fluid.layers.fc(x, size=4)
+        z = fluid.layers.sum([x, y])
+        z2 = fluid.layers.Print(z, message='test')
+        cond = fluid.layers.is_empty(z2)
+        s = fluid.layers.data('s', shape=[3], dtype='float32', lod_level=1)
+        table = fluid.layers.lod_rank_table(s)
+        s2 = fluid.layers.reorder_lod_tensor_by_rank(s, table)
+        probs = fluid.layers.data('p', shape=[5])
+        sid = fluid.layers.sampling_id(probs)
+        out = fluid.layers.mean(z2) + fluid.layers.mean(s2)
+
+    assert fluid.get_var('x', main) is not None
+    with pytest.raises(ValueError):
+        fluid.get_var('nope', main)
+    op = main.global_block().ops[0]
+    assert op.has_kernel() in (True, False)
+    arr = fluid.LoDTensorArray()
+    arr.append(np.zeros((2, 2)))
+    assert len(arr) == 1
+
+    with main.optimized_guard([y]):
+        pass
+    clone = main.clone()
+    clone.copy_data_info_from(main)
+    assert clone.global_block().vars['x'].is_data
+
+    from paddle_tpu.fluid.transpiler import HashName, RoundRobin
+    eps = ['a:1', 'b:2']
+    rr = RoundRobin(eps)
+    assert rr.dispatch(['v1', 'v2', 'v3']) == ['a:1', 'b:2', 'a:1']
+    hn = HashName(eps)
+    d1 = hn.dispatch(['v1', 'v2'])
+    assert d1 == hn.dispatch(['v1', 'v2'])  # deterministic
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    rows = [rng.standard_normal((n, 3)).astype('float32')
+            for n in (2, 4, 1)]
+    feed = {
+        'x': rng.standard_normal((3, 4)).astype('float32'),
+        's': fluid.create_lod_tensor(
+            np.concatenate(rows), [[len(r) for r in rows]]),
+        'p': np.full((3, 5), 0.2, 'float32'),
+    }
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        vals = exe.run(main, feed=feed,
+                       fetch_list=[out, cond, sid, table])
+    assert np.isfinite(np.asarray(vals[0])).all()
+    assert not bool(np.asarray(vals[1]).flatten()[0])  # z2 not empty
+    assert np.asarray(vals[2]).shape == (3, )
+    # table sorts lengths (2,4,1) descending -> rows (1,0,2)
+    np.testing.assert_array_equal(np.asarray(vals[3]), [1, 0, 2])
+
+
+def test_random_data_generator_and_preprocessor():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.random_data_generator(
+            low=0.0, high=1.0, shapes=[[8, 3], [8, 1]], lod_levels=[0, 0])
+        pre = fluid.layers.Preprocessor(reader=reader)
+        with pre.block():
+            img, lbl = pre.inputs()
+            img_out = fluid.layers.scale(img, scale=2.0)
+            lbl_out = fluid.layers.scale(lbl, scale=0.0)
+            pre.outputs(img_out, lbl_out)
+        img_v, lbl_v = fluid.layers.read_file(pre())
+        out = fluid.layers.mean(img_v) + fluid.layers.mean(lbl_v)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        v = exe.run(main, fetch_list=[out, img_v, lbl_v])
+    img_a, lbl_a = np.asarray(v[1]), np.asarray(v[2])
+    assert img_a.shape == (8, 3)
+    # scaled x2: uniform [0,1) doubled lands in [0,2); mean near 1
+    assert 0.5 < img_a.mean() < 1.5
+    np.testing.assert_allclose(lbl_a, 0.0)

@@ -1,0 +1,282 @@
+"""Round-3 trainer_config_helpers breadth (VERDICT r2 next-#3): the
+builder tail (crf/ctc/maxout/mixed+projections/bidirectional/attention
+and the elementwise family) executed config-file-style end to end
+(reference trainer_config_helpers/layers.py, networks.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import trainer_config_helpers as tch
+from paddle_tpu.v2.topology import Topology
+
+
+def setup_function(_fn):
+    tch.reset_config()
+
+
+def _lod_ids(rng, vocab, lengths):
+    rows = [rng.randint(0, vocab, (l, 1)) for l in lengths]
+    lt = fluid.core.LoDTensor(np.concatenate(rows).astype('int64'))
+    lt.set_recursive_sequence_lengths([[len(r) for r in rows]])
+    return lt
+
+
+def _run_cost(cost, feed, steps=1, lr=0.05):
+    topo = Topology(cost)
+    main, startup = topo.main_program, topo.startup_program
+    if steps > 1:
+        with fluid.program_guard(main, startup):
+            fluid.optimizer.SGD(lr).minimize(topo.cost_var)
+    exe = fluid.Executor(fluid.CPUPlace())
+    vals = []
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        for _ in range(steps):
+            v, = exe.run(main, feed=feed, fetch_list=[topo.cost_var])
+            vals.append(float(np.asarray(v).ravel()[0]))
+    return vals
+
+
+def test_crf_tagging_config_trains():
+    """fc emission + crf_layer cost, the label-semantic-roles shape."""
+    tch.settings(batch_size=4, learning_rate=0.05)
+    words = tch.data_layer(name='words', size=30, data_type_kind='index',
+                           seq=True)
+    emb = tch.embedding_layer(input=words, size=8)
+    emission = tch.fc_layer(input=emb, size=5)
+    tags = tch.data_layer(name='tags', size=5, data_type_kind='index',
+                          seq=True)
+    cost = tch.crf_layer(input=emission, label=tags, size=5)
+
+    rng = np.random.RandomState(0)
+    lengths = (3, 5, 2, 4)
+    feed = {'words': _lod_ids(rng, 30, lengths),
+            'tags': _lod_ids(rng, 5, lengths)}
+    vals = _run_cost(cost, feed, steps=5)
+    assert np.isfinite(vals).all()
+    assert vals[-1] < vals[0]
+
+
+def test_ctc_config_trains():
+    tch.settings(batch_size=4, learning_rate=0.02)
+    feats = tch.data_layer(name='feats', size=16, seq=True)
+    h = tch.fc_layer(input=feats, size=12, act=tch.TanhActivation())
+    logits = tch.fc_layer(input=h, size=6)  # 5 labels + blank
+    lbl = tch.data_layer(name='lbl', size=6, data_type_kind='index',
+                         seq=True)
+    cost = tch.ctc_layer(input=logits, label=lbl, size=6, blank=0)
+
+    rng = np.random.RandomState(1)
+    frames = [rng.standard_normal((l, 16)) for l in (6, 7, 5, 8)]
+    ft = fluid.core.LoDTensor(np.concatenate(frames).astype('float32'))
+    ft.set_recursive_sequence_lengths([[len(f) for f in frames]])
+    feed = {'feats': ft, 'lbl': _lod_ids(rng, 5, (2, 3, 2, 3))}
+    # warpctc labels 1..5 (0 = blank)
+    vals = _run_cost(cost, feed, steps=4)
+    assert np.isfinite(vals).all()
+
+
+def test_mixed_layer_with_projections_trains():
+    """mixed = full_matrix + identity + table projections summed."""
+    tch.settings(batch_size=8, learning_rate=0.05)
+    x = tch.data_layer(name='x', size=12)
+    ids = tch.data_layer(name='ids', size=20, data_type_kind='index')
+    mix = tch.mixed_layer(
+        size=12,
+        input=[
+            tch.full_matrix_projection(input=x, size=12),
+            tch.identity_projection(input=x),
+            tch.table_projection(input=ids, size=12),
+        ],
+        act=tch.TanhActivation())
+    pred = tch.fc_layer(input=mix, size=3, act=tch.SoftmaxActivation())
+    lbl = tch.data_layer(name='label', size=3, data_type_kind='index')
+    cost = tch.classification_cost(input=pred, label=lbl)
+
+    rng = np.random.RandomState(2)
+    feed = {'x': rng.standard_normal((8, 12)).astype('float32'),
+            'ids': rng.randint(0, 20, (8, 1)).astype('int64'),
+            'label': rng.randint(0, 3, (8, 1)).astype('int64')}
+    vals = _run_cost(cost, feed, steps=6)
+    assert np.isfinite(vals).all()
+    assert vals[-1] < vals[0]
+
+
+def test_sequence_conv_pool_text_classifier():
+    tch.settings(batch_size=4, learning_rate=0.05)
+    words = tch.data_layer(name='words', size=50, data_type_kind='index',
+                           seq=True)
+    emb = tch.embedding_layer(input=words, size=8)
+    feat = tch.sequence_conv_pool(input=emb, context_len=3,
+                                  hidden_size=16)
+    pred = tch.fc_layer(input=feat, size=2, act=tch.SoftmaxActivation())
+    lbl = tch.data_layer(name='label', size=2, data_type_kind='index')
+    cost = tch.classification_cost(input=pred, label=lbl)
+
+    rng = np.random.RandomState(3)
+    feed = {'words': _lod_ids(rng, 50, (4, 6, 3, 5)),
+            'label': rng.randint(0, 2, (4, 1)).astype('int64')}
+    vals = _run_cost(cost, feed, steps=4)
+    assert np.isfinite(vals).all()
+
+
+def test_bidirectional_lstm_classifier():
+    tch.settings(batch_size=4, learning_rate=0.05)
+    words = tch.data_layer(name='words', size=40, data_type_kind='index',
+                           seq=True)
+    emb = tch.embedding_layer(input=words, size=8)
+    bi = tch.bidirectional_lstm(input=emb, size=10)
+    pred = tch.fc_layer(input=bi, size=2, act=tch.SoftmaxActivation())
+    lbl = tch.data_layer(name='label', size=2, data_type_kind='index')
+    cost = tch.classification_cost(input=pred, label=lbl)
+
+    rng = np.random.RandomState(4)
+    feed = {'words': _lod_ids(rng, 40, (3, 5, 2, 4)),
+            'label': rng.randint(0, 2, (4, 1)).astype('int64')}
+    vals = _run_cost(cost, feed, steps=2)
+    assert np.isfinite(vals).all()
+
+
+def test_simple_attention_block():
+    tch.settings(batch_size=3, learning_rate=0.01)
+    seq = tch.data_layer(name='seq', size=8, seq=True)
+    proj = tch.fc_layer(input=seq, size=8)
+    state = tch.data_layer(name='state', size=8)
+    ctxv = tch.simple_attention(encoded_sequence=seq, encoded_proj=proj,
+                                decoder_state=state)
+    cost = tch.sum_cost(input=tch.fc_layer(input=ctxv, size=4))
+
+    rng = np.random.RandomState(5)
+    rows = [rng.standard_normal((l, 8)) for l in (3, 5, 2)]
+    st = fluid.core.LoDTensor(np.concatenate(rows).astype('float32'))
+    st.set_recursive_sequence_lengths([[len(r) for r in rows]])
+    feed = {'seq': st,
+            'state': rng.standard_normal((3, 8)).astype('float32')}
+    vals = _run_cost(cost, feed, steps=1)
+    assert np.isfinite(vals).all()
+
+
+def test_elementwise_and_shape_builder_family():
+    """One forward pass through the round-3 elementwise/shape tail."""
+    tch.settings(batch_size=4, learning_rate=0.01)
+    x = tch.data_layer(name='x', size=6)
+    y = tch.data_layer(name='y', size=6)
+    w = tch.data_layer(name='w', size=1)
+
+    clip = tch.clip_layer(input=x, min=-1.0, max=1.0)
+    si = tch.slope_intercept_layer(input=clip, slope=2.0, intercept=0.5)
+    interp = tch.interpolation_layer(input=[x, y], weight=w)
+    norm = tch.sum_to_one_norm_layer(
+        input=tch.slope_intercept_layer(input=x, slope=0.0,
+                                        intercept=1.0))
+    dp = tch.dot_prod_layer(a=x, b=y)
+    l2 = tch.l2_distance_layer(a=x, b=y)
+    cs = tch.cos_sim(a=x, b=y)
+    op = tch.out_prod_layer(a=x, b=y)
+    cat = tch.concat_layer(input=[si, interp, norm, dp, l2, cs, op])
+    cost = tch.sum_cost(input=cat)
+
+    rng = np.random.RandomState(6)
+    feed = {'x': np.abs(rng.standard_normal((4, 6))).astype('float32'),
+            'y': rng.standard_normal((4, 6)).astype('float32'),
+            'w': rng.rand(4, 1).astype('float32')}
+    topo = Topology(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(topo.startup_program)
+        v, = exe.run(topo.main_program, feed=feed,
+                     fetch_list=[topo.cost_var])
+    assert np.isfinite(float(np.asarray(v).ravel()[0]))
+
+
+def test_maxout_and_cmrnorm_image_path():
+    tch.settings(batch_size=2, learning_rate=0.01)
+    img = tch.data_layer(name='img', size=3 * 8 * 8)
+    conv = tch.img_conv_layer(input=img, filter_size=3, num_filters=8,
+                              num_channels=3, padding=1,
+                              act=tch.ReluActivation())
+    norm = tch.img_cmrnorm_layer(input=conv, size=3)
+    mo = tch.maxout_layer(input=norm, groups=2)
+    pred = tch.fc_layer(input=mo, size=2, act=tch.SoftmaxActivation())
+    lbl = tch.data_layer(name='label', size=2, data_type_kind='index')
+    cost = tch.classification_cost(input=pred, label=lbl)
+
+    rng = np.random.RandomState(7)
+    feed = {'img': rng.standard_normal((2, 192)).astype('float32'),
+            'label': rng.randint(0, 2, (2, 1)).astype('int64')}
+    vals = _run_cost(cost, feed, steps=1)
+    assert np.isfinite(vals).all()
+
+
+def test_vgg_16_network_builds_and_runs():
+    """The reference's flagship preset, on a 32x32 input."""
+    tch.settings(batch_size=2, learning_rate=0.01)
+    img = tch.data_layer(name='img', size=3 * 32 * 32)
+    pred = tch.vgg_16_network(input_image=img, num_channels=3,
+                              num_classes=10)
+    lbl = tch.data_layer(name='label', size=10, data_type_kind='index')
+    cost = tch.classification_cost(input=pred, label=lbl)
+
+    rng = np.random.RandomState(8)
+    feed = {'img': rng.standard_normal((2, 3072)).astype('float32'),
+            'label': rng.randint(0, 10, (2, 1)).astype('int64')}
+    vals = _run_cost(cost, feed, steps=1)
+    assert np.isfinite(vals).all()
+
+
+def test_builder_count_meets_verdict_target():
+    """VERDICT r2 next-#3 done-criterion: builder count >= 60."""
+    builders = [n for n in tch.layers.__all__
+                if n not in ('outputs', 'get_config', 'reset_config',
+                             'memory', 'StaticInput')]
+    assert len(builders) + len(tch.networks.__all__) >= 60, (
+        len(builders), len(tch.networks.__all__))
+
+
+def test_lambda_cost_has_gradient_signal():
+    tch.settings(batch_size=3, learning_rate=0.05)
+    feats = tch.data_layer(name='feats', size=6, seq=True)
+    s = tch.fc_layer(input=feats, size=1)
+    rel = tch.data_layer(name='rel', size=1, seq=True)
+    cost = tch.lambda_cost(input=s, score=rel)
+
+    rng = np.random.RandomState(9)
+    rows = [rng.standard_normal((l, 6)) for l in (4, 5, 3)]
+    ft = fluid.core.LoDTensor(np.concatenate(rows).astype('float32'))
+    ft.set_recursive_sequence_lengths([[len(r) for r in rows]])
+    rrows = [rng.rand(l, 1) for l in (4, 5, 3)]
+    rt = fluid.core.LoDTensor(np.concatenate(rrows).astype('float32'))
+    rt.set_recursive_sequence_lengths([[len(r) for r in rrows]])
+    vals = _run_cost(cost, {'feats': ft, 'rel': rt}, steps=5)
+    assert np.isfinite(vals).all()
+    assert abs(vals[-1] - vals[0]) > 1e-7  # non-constant: grads flow
+
+
+def test_cross_entropy_with_selfnorm_penalizes_z():
+    tch.settings(batch_size=4, learning_rate=0.01)
+    x = tch.data_layer(name='x', size=8)
+    scores = tch.fc_layer(input=x, size=3)  # raw logits, no softmax
+    lbl = tch.data_layer(name='label', size=3, data_type_kind='index')
+    cost = tch.cross_entropy_with_selfnorm(
+        input=scores, label=lbl, softmax_selfnorm_alpha=10.0)
+    cost_plain = None  # penalty must make the cost differ from plain CE
+
+    rng = np.random.RandomState(10)
+    feed = {'x': 3.0 * rng.standard_normal((4, 8)).astype('float32'),
+            'label': rng.randint(0, 3, (4, 1)).astype('int64')}
+    topo = Topology(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(topo.startup_program)
+        with fluid.program_guard(topo.main_program,
+                                 topo.startup_program):
+            pred = fluid.layers.softmax(topo._ctx[scores.name])
+            plain = fluid.layers.mean(
+                fluid.layers.cross_entropy(input=pred,
+                                           label=topo._ctx[lbl.name]))
+        v, p = exe.run(topo.main_program, feed=feed,
+                       fetch_list=[topo.cost_var, plain])
+    v, p = float(np.asarray(v).ravel()[0]), float(np.asarray(p).ravel()[0])
+    assert np.isfinite([v, p]).all()
+    assert v > p  # the alpha * log(Z)^2 term is live

@@ -46,9 +46,18 @@ def generate():
     lines += _walk('paddle_tpu.fluid.optimizer', fluid.optimizer,
                    sorted(fluid.optimizer.__all__))
     lines += _walk('paddle_tpu.fluid', fluid, [
-        'Executor', 'ParallelExecutor', 'Program', 'DataFeeder',
-        'DistributeTranspiler', 'Trainer', 'Inferencer', 'scope_guard',
-        'program_guard', 'append_backward', 'Go', 'Select', 'make_channel',
+        'Executor', 'ParallelExecutor', 'Program', 'Operator', 'Variable',
+        'Parameter', 'DataFeeder', 'DistributeTranspiler',
+        'DistributeTranspilerConfig', 'InferenceTranspiler', 'Trainer',
+        'Inferencer', 'CheckpointConfig', 'BeginEpochEvent',
+        'EndEpochEvent', 'BeginStepEvent', 'EndStepEvent', 'CPUPlace',
+        'TPUPlace', 'CUDAPlace', 'CUDAPinnedPlace', 'LoDTensor',
+        'LoDTensorArray', 'Scope', 'ParamAttr', 'WeightNormParamAttr',
+        'ExecutionStrategy', 'BuildStrategy', 'scope_guard',
+        'program_guard', 'name_scope', 'append_backward', 'get_var',
+        'global_scope', 'create_lod_tensor', 'create_random_int_lodtensor',
+        'default_main_program', 'default_startup_program',
+        'memory_optimize', 'release_memory', 'Go', 'Select', 'make_channel',
         'channel_send', 'channel_recv', 'channel_close',
     ])
     lines += _walk('paddle_tpu.fluid.io', fluid.io, sorted(
@@ -59,6 +68,41 @@ def generate():
     ])
     lines += _walk('paddle_tpu.fluid.nets', fluid.nets,
                    sorted(fluid.nets.__all__))
+    lines += _walk('paddle_tpu.fluid.initializer', fluid.initializer, [
+        'Constant', 'Uniform', 'Normal', 'Xavier', 'MSRA', 'Bilinear',
+        'ConstantInitializer', 'UniformInitializer', 'NormalInitializer',
+        'XavierInitializer', 'MSRAInitializer', 'BilinearInitializer',
+        'force_init_on_cpu', 'init_on_cpu',
+    ])
+    lines += _walk('paddle_tpu.fluid.regularizer', fluid.regularizer, [
+        'L1Decay', 'L2Decay', 'L1DecayRegularizer', 'L2DecayRegularizer',
+    ])
+    lines += _walk('paddle_tpu.fluid.clip', fluid.clip, [
+        'ErrorClipByValue', 'GradientClipByValue', 'GradientClipByNorm',
+        'GradientClipByGlobalNorm',
+    ])
+    lines += _walk('paddle_tpu.fluid.profiler', fluid.profiler, [
+        'profiler', 'cuda_profiler', 'reset_profiler', 'start_profiler',
+        'stop_profiler',
+    ])
+    lines += _walk('paddle_tpu.fluid.unique_name', fluid.unique_name, [
+        'generate', 'guard', 'switch',
+    ])
+    lines += _walk('paddle_tpu.fluid.backward', fluid.backward, [
+        'append_backward', 'calc_gradient',
+    ])
+    lines += _walk('paddle_tpu.fluid.transpiler', fluid.transpiler, [
+        'DistributeTranspiler', 'DistributeTranspilerConfig',
+        'InferenceTranspiler', 'HashName', 'RoundRobin', 'memory_optimize',
+        'release_memory',
+    ])
+    lines += _walk('paddle_tpu.fluid.contrib', fluid.contrib, [
+        'InitState', 'StateCell', 'TrainingDecoder', 'BeamSearchDecoder',
+        'memory_usage',
+    ])
+    lines += _walk('paddle_tpu.fluid.recordio_writer', fluid.recordio_writer,
+                   ['convert_reader_to_recordio_file',
+                    'convert_reader_to_recordio_files'])
     return sorted(set(lines))
 
 
