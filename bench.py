@@ -129,12 +129,12 @@ def bench_nmt(on_tpu, steps=20, seq_len=32):
 
 
 def _transformer_flops_per_token(n_layer, d, d_ff, seq, vocab):
-    """Train FLOPs per (batch*seq) token: 3x fwd; fwd = 2 MACs x
-    (enc layer: 4d^2 attn + 2*d*d_ff ffn; dec layer: self + cross attn
-    + ffn; vocab projection) + score/context matmuls 2*2*seq*d per
-    attention."""
-    enc = n_layer * (4 * d * d + 2 * d * d_ff + 2 * 2 * seq * d)
-    dec = n_layer * (8 * d * d + 2 * d * d_ff + 2 * 2 * 2 * seq * d)
+    """Train FLOPs per (batch*seq) token: MACs x 2 x 3 (fwd, train=3x).
+    Per-token MACs: enc layer = 4d^2 (QKVO) + 2*d*d_ff (ffn) + 2*seq*d
+    (scores + context); dec layer adds the cross attention (8d^2 +
+    4*seq*d); plus the vocab projection."""
+    enc = n_layer * (4 * d * d + 2 * d * d_ff + 2 * seq * d)
+    dec = n_layer * (8 * d * d + 2 * d * d_ff + 4 * seq * d)
     return 3.0 * 2.0 * (enc + dec + vocab * d)
 
 

@@ -2,3 +2,5 @@
 (reference: go/ — master task queue, pserver; SURVEY §2.2)."""
 
 from .master import Master, TaskQueuePyFallback, cloud_reader  # noqa: F401
+from .master_server import MasterServer, MasterClient  # noqa: F401
+from .async_sparse import AsyncSparseEmbedding  # noqa: F401

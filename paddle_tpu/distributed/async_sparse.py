@@ -1,0 +1,114 @@
+"""Host-side asynchronous sparse-embedding service — the TPU-native
+shape of the reference's surviving async training mode (VERDICT r2
+next-#9).
+
+Reference architecture (2018 CTR production): the giant embedding lives
+on parameter servers; trainers `prefetch` only the rows a batch touches
+(operators/prefetch_op.cc -> AsyncPrefetchVar, distributed/rpc_client.h:46),
+compute the dense step, and push sparse grads back WITHOUT barriers —
+the pserver's `RunAsyncLoop` applies updates as they arrive
+(operators/listen_and_serv_op.cc:179; design
+doc/fluid/design/dist_train/async_update.md).
+
+Here the dense step is synchronous SPMD on the chip (BASELINE north
+star), and THIS service carries the async half: the table is
+host-resident (it is too large for HBM — that is the whole reason the
+reference sharded it off-device), `prefetch()` gathers the batch's rows
+to feed the compiled step, `push_grad()` enqueues the row-gradients, and
+a daemon thread applies them to the table while the next step's compute
+runs.  Reads may observe a bounded staleness of the in-flight updates —
+exactly the async-SGD semantics the reference shipped.
+"""
+
+import queue
+import threading
+
+import numpy as np
+
+__all__ = ['AsyncSparseEmbedding']
+
+
+class AsyncSparseEmbedding(object):
+    """One host-side embedding table with asynchronous SGD updates.
+
+    vocab, dim : table shape
+    lr         : SGD learning rate applied to pushed row-gradients
+    capacity   : max queued (ids, grad) batches before push blocks
+                 (bounds staleness the way the reference bounded it by
+                 RPC in-flight windows)
+    """
+
+    def __init__(self, vocab, dim, lr=0.01, capacity=64, seed=0,
+                 init_scale=0.01):
+        rng = np.random.RandomState(seed)
+        self._table = (init_scale *
+                       rng.standard_normal((vocab, dim))).astype('float32')
+        self._lr = float(lr)
+        self._q = queue.Queue(maxsize=capacity)
+        self._lock = threading.Lock()  # table row read/write atomicity
+        self._applied = 0
+        self._pushed = 0
+        self._error = None
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    # -- trainer side (reference prefetch_op / send sparse grad) --
+    def prefetch(self, ids):
+        """Gather current row values for a batch of ids -> [len(ids), D]
+        (reference AsyncPrefetchVar; reads see the table as of now,
+        minus whatever updates are still queued — async semantics)."""
+        ids = np.asarray(ids).reshape(-1)
+        with self._lock:
+            return self._table[ids].copy()
+
+    def push_grad(self, ids, grad):
+        """Enqueue d(loss)/d(rows) for asynchronous application; returns
+        immediately (the reference's barrier-free send)."""
+        if self._error is not None:
+            raise self._error
+        ids = np.asarray(ids).reshape(-1).copy()
+        grad = np.asarray(grad, dtype='float32').reshape(
+            len(ids), -1).copy()
+        self._pushed += 1
+        self._q.put((ids, grad))
+
+    # -- server side (reference listen_and_serv RunAsyncLoop) --
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            ids, grad = item
+            try:
+                with self._lock:
+                    # duplicate ids in one batch must accumulate
+                    np.subtract.at(self._table, ids, self._lr * grad)
+                self._applied += 1
+            except Exception as e:  # pragma: no cover - surfaced on push
+                self._error = e
+            finally:
+                self._q.task_done()
+
+    def drain(self):
+        """Block until every pushed update is applied (checkpoint /
+        end-of-pass barrier — the one sync point async training keeps,
+        mirroring the reference's checkpoint_notify)."""
+        self._q.join()
+        if self._error is not None:
+            raise self._error
+
+    @property
+    def stats(self):
+        return {'pushed': self._pushed, 'applied': self._applied,
+                'queued': self._q.qsize()}
+
+    def table(self):
+        """A consistent snapshot of the table (drains first)."""
+        self.drain()
+        with self._lock:
+            return self._table.copy()
+
+    def close(self):
+        self.drain()
+        self._q.put(None)
+        self._worker.join(timeout=10)

@@ -1,0 +1,131 @@
+"""Network service for the Master task queue (reference: go/master's RPC
+service `Service.GetTask/TaskFinished/TaskFailed` registered over Go
+net/rpc, go/master/service.go:89, consumed by the C-shim client
+python/paddle/v2/master/client.py).
+
+Transport: newline-delimited JSON over TCP — the control plane carries a
+few small messages per task (payloads are record RANGES, not records),
+so the Go version's codec buys nothing here.  One request per line:
+
+    {"method": "get_task"}                     -> {"tid": N, "task": {...}}
+    {"method": "task_finished", "tid": N}      -> {"ok": true}
+    {"method": "task_failed", "tid": N}        -> {"discarded": 0|1}
+    {"method": "counts"}                       -> {"counts": [t,p,d,x]}
+    {"method": "new_pass"}                     -> {"ok": true}
+
+The server owns the Master instance; trainers hold a MasterClient.
+Fault tolerance semantics live in the queue itself (timeouts requeue a
+dead trainer's pending task; failure_max caps retries) — the server is
+a thin door onto them.
+"""
+
+import json
+import socket
+import socketserver
+import threading
+
+__all__ = ['MasterServer', 'MasterClient']
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        master = self.server.master
+        for line in self.rfile:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line.decode())
+                method = req.get('method')
+                if method == 'get_task':
+                    tid, task = master.get_task()
+                    resp = {'tid': tid, 'task': task}
+                elif method == 'task_finished':
+                    master.task_finished(int(req['tid']))
+                    resp = {'ok': True}
+                elif method == 'task_failed':
+                    r = master.task_failed(int(req['tid']))
+                    resp = {'discarded': r}
+                elif method == 'counts':
+                    resp = {'counts': list(master.counts())}
+                elif method == 'new_pass':
+                    master.new_pass()
+                    resp = {'ok': True}
+                else:
+                    resp = {'error': 'unknown method %r' % method}
+            except Exception as e:  # surface to the client, keep serving
+                resp = {'error': str(e)}
+            try:
+                self.wfile.write((json.dumps(resp) + '\n').encode())
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                return
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class MasterServer(object):
+    """Serve a Master over TCP from a daemon thread."""
+
+    def __init__(self, master, host='127.0.0.1', port=0):
+        self.master = master
+        self._srv = _TCPServer((host, port), _Handler)
+        self._srv.master = master
+        self.host, self.port = self._srv.server_address
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+
+    @property
+    def endpoint(self):
+        return '%s:%d' % (self.host, self.port)
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class MasterClient(object):
+    """Trainer-side connection (reference v2/master/client.py ctypes
+    shim -> go client).  Blocking request/response on one socket."""
+
+    def __init__(self, endpoint, timeout=30.0):
+        host, port = endpoint.rsplit(':', 1)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._rfile = self._sock.makefile('rb')
+
+    def _call(self, **req):
+        self._sock.sendall((json.dumps(req) + '\n').encode())
+        line = self._rfile.readline()
+        if not line:
+            raise ConnectionError('master closed the connection')
+        resp = json.loads(line.decode())
+        if 'error' in resp:
+            raise RuntimeError('master error: %s' % resp['error'])
+        return resp
+
+    def get_task(self):
+        r = self._call(method='get_task')
+        return r['tid'], r['task']
+
+    def task_finished(self, tid):
+        self._call(method='task_finished', tid=tid)
+
+    def task_failed(self, tid):
+        return self._call(method='task_failed', tid=tid)['discarded']
+
+    def counts(self):
+        return tuple(self._call(method='counts')['counts'])
+
+    def new_pass(self):
+        self._call(method='new_pass')
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -115,15 +115,18 @@ class DataFeeder(object):
         dicts (reference data_feeder.py decorate_reader)."""
 
         def decorated():
+            n_places = num_places or 1
             for batch in reader():
                 if multi_devices:
-                    feeds = self.feed_parallel(batch, num_places)
-                    if len(feeds) == (num_places or 1):
-                        yield feeds
-                    elif not drop_last:
-                        # short final batch: yield it only when the
-                        # caller asked to keep remainders
-                        yield feeds
+                    batch = list(batch)
+                    rem = len(batch) % n_places
+                    if rem and drop_last:
+                        # uneven final shard sizes would give devices
+                        # mismatched shapes — drop the remainder
+                        batch = batch[:len(batch) - rem]
+                    if len(batch) < n_places:
+                        continue  # cannot cover every device
+                    yield self.feed_parallel(batch, n_places)
                 else:
                     yield self.feed(batch)
 

@@ -194,8 +194,11 @@ class Operator(object):
         return dict(self.attrs)
 
     def attr_type(self, name):
-        """Python-type stand-in for the reference's proto AttrType enum."""
-        return type(self.attrs.get(name))
+        """Python-type stand-in for the reference's proto AttrType enum.
+        Raises on unknown names like the reference pybind surface."""
+        if name not in self.attrs:
+            raise ValueError('op %r has no attr %r' % (self.type, name))
+        return type(self.attrs[name])
 
     def has_kernel(self, op_type=None):
         return (op_type or self.type) not in self.OP_WITHOUT_KERNEL_SET

@@ -581,7 +581,7 @@ class IfElse(object):
         self.outputs = {True: [], False: []}
         self.parent_idx = None
         self._out_vars = None
-        self._routed = False
+        self._routed = {True: False, False: False}
 
     @contextlib.contextmanager
     def true_block(self):
@@ -610,8 +610,8 @@ class IfElse(object):
         """Route x's rows into this branch via split_lod_tensor: the true
         branch reads OutTrue (rows where cond), the false branch OutFalse
         (reference IfElse.input, control_flow.py:1448)."""
-        self._routed = True
         branch = self._current_branch
+        self._routed[branch] = True
         out_true, out_false = split_lod_tensor(x, self.cond)
         return out_true if branch else out_false
 
@@ -646,7 +646,8 @@ class IfElse(object):
                 'false_block': self.blocks.get(False),
                 'true_out': list(self.outputs[True]),
                 'false_out': list(self.outputs[False]),
-                'routed': self._routed,
+                'routed_true': self._routed[True],
+                'routed_false': self._routed[False],
             })
         return out_vars
 

@@ -53,8 +53,13 @@ class DistributeTranspiler(object):
             program = default_main_program()
         if not sync_mode:
             raise NotImplementedError(
-                'async parameter-server updates have no TPU analog; the '
-                'dense path is synchronous SPMD (SURVEY §2.5 row "async")')
+                'dense async parameter-server updates have no TPU analog '
+                '(the dense path is synchronous SPMD, SURVEY §2.5); the '
+                'surviving async use case — barrier-free sparse embedding '
+                'updates for CTR — is served by '
+                'paddle_tpu.distributed.AsyncSparseEmbedding '
+                '(listen_and_serv RunAsyncLoop analog, '
+                'tests/test_async_sparse.py)')
         self.trainer_id = trainer_id
         self.trainers = trainers
         self.pserver_endpoints = [

@@ -342,7 +342,10 @@ def _ifelse(ctx, op):
     false_block = op.attrs['false_block']
     true_out = op.attrs['true_out']
     false_out = op.attrs['false_out']
-    routed = op.attrs.get('routed', False)
+    routed_true = op.attrs.get('routed_true',
+                               op.attrs.get('routed', False))
+    routed_false = op.attrs.get('routed_false',
+                                op.attrs.get('routed', False))
     for blk in (true_block, false_block):
         if blk is not None:
             _reject_host_ops(blk, 'ifelse')
@@ -358,10 +361,16 @@ def _ifelse(ctx, op):
     fi = jnp.cumsum(jnp.logical_not(m).astype(jnp.int32)) - 1
     for out_name, tn, fn_ in zip(op.output('Out'), true_out, false_out):
         tv, fv = env_t[tn], env_f[fn_]
-        if routed and tv.ndim >= 1 and tv.shape[0] == c.shape[0]:
-            # branch outputs are compacted per split order: merge
-            tvr = jnp.take(tv, jnp.clip(ti, 0, tv.shape[0] - 1), axis=0)
-            fvr = jnp.take(fv, jnp.clip(fi, 0, fv.shape[0] - 1), axis=0)
+        rowwise = tv.ndim >= 1 and tv.shape[0] == c.shape[0]
+        if (routed_true or routed_false) and rowwise:
+            # a routed branch's output is compacted in split order and
+            # needs the cumsum re-expansion; an unrouted branch's output
+            # is already row-aligned and is read in place (mixed usage
+            # is legal: each side is indexed by ITS OWN layout)
+            tvr = (jnp.take(tv, jnp.clip(ti, 0, tv.shape[0] - 1), axis=0)
+                   if routed_true else tv)
+            fvr = (jnp.take(fv, jnp.clip(fi, 0, fv.shape[0] - 1), axis=0)
+                   if routed_false else fv)
             mm = jnp.reshape(m, (m.shape[0], ) + (1, ) * (tv.ndim - 1))
             ctx.store(out_name, jnp.where(mm, tvr, fvr))
             continue

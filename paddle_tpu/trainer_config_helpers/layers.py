@@ -281,8 +281,16 @@ def get_output_layer(input, arg_name=None, name=None, **kwargs):
     the build ctx under '<layer>@<arg>'."""
 
     def build(ctx, v):
-        key = '%s@%s' % (input.name, arg_name) if arg_name else input.name
-        return ctx.get(key, v)
+        if not arg_name:
+            return v
+        key = '%s@%s' % (input.name, arg_name)
+        if key not in ctx:
+            raise KeyError(
+                'get_output_layer: layer %r publishes no output %r '
+                '(known aux keys: %s)' %
+                (input.name, arg_name,
+                 [k for k in ctx if '@' in str(k)]))
+        return ctx[key]
 
     return _v2.Layer('get_output', [input], build, name=name,
                      size=input.size)

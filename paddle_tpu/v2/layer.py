@@ -590,9 +590,7 @@ def power(input, weight, name=None, **kwargs):
     """out[i] = input[i] ^ weight[i] (reference power_layer)."""
 
     def build(ctx, v, wv):
-        logv = fluid.layers.log(v)
-        return fluid.layers.exp(
-            fluid.layers.elementwise_mul(logv, wv, axis=0))
+        return fluid.layers.elementwise_pow(v, wv, axis=0)
 
     return Layer('power', [input, weight], build, name=name,
                  size=input.size)
@@ -735,17 +733,20 @@ def gru_step(input, state, size, act=None, gate_act=None, name=None,
 def lstm_step(input, state, cell, size, act=None, gate_act=None,
               name=None, **kwargs):
     """One LSTM step (reference lstm_step_layer / lstm_unit_op): returns
-    the hidden; pair with a second memory for the cell via
-    ``get_output``-style wiring in the step fn."""
+    the hidden; the cell state is published under '<layer name>@cell'
+    for get_output_layer(arg_name='cell')."""
+    layer_box = []
 
     def build(ctx, iv, sv, cv):
         h, c = fluid.layers.lstm_unit(
             x_t=iv, hidden_t_prev=sv, cell_t_prev=cv)
-        ctx['%s@cell' % (name or 'lstm_step')] = c
+        ctx['%s@cell' % layer_box[0].name] = c
         return h
 
-    return Layer('lstm_step', [input, state, cell], build, name=name,
-                 size=size)
+    layer = Layer('lstm_step', [input, state, cell], build, name=name,
+                  size=size)
+    layer_box.append(layer)
+    return layer
 
 
 def crf(input, label, size=None, name=None, **kwargs):

@@ -41,15 +41,26 @@ def main():
     model['main'].random_seed = 7
     steps = int(os.environ.get('DIST_TEST_STEPS', '5'))
     batch = int(os.environ.get('DIST_TEST_BATCH', '32'))
+    mode = os.environ.get('DIST_TEST_MODE', 'dp')
     rng = np.random.RandomState(42)
     exe = fluid.Executor(fluid.CPUPlace())
     scope = fluid.core.Scope()
+    mesh = None
+    if mode == 'dp_tp':
+        # cross-process dp x tp: the tp axis spans devices living in
+        # DIFFERENT processes, so the classifier matmul's collectives
+        # cross the process boundary (VERDICT r2 next-#5)
+        from paddle_tpu import parallel
+        devs = jax.devices()
+        mesh = parallel.make_mesh({'dp': len(devs) // 2, 'tp': 2}, devs)
+        fc_w = model['main'].all_parameters()[-2]
+        parallel.shard(fc_w, None, 'tp')
     losses = []
     with fluid.scope_guard(scope):
         exe.run(model['startup'])
         pe = fluid.ParallelExecutor(loss_name=model['loss'].name,
                                     main_program=model['main'],
-                                    scope=scope)
+                                    scope=scope, mesh=mesh)
         # one fixed global batch, every step: the loss must fall (overfit)
         # and every process feeds the identical global array, each
         # materializing only its addressable shard

@@ -1,0 +1,101 @@
+"""Stateless EDL trainer for the kill/re-dispatch/resume integration
+test (reference pattern: go/master trainers are stateless — a dead
+trainer's pending task times out and is re-dispatched, go/master/
+service.go:140; high-level Trainer auto-resumes from the newest
+checkpoint, SURVEY §5.3/5.4).
+
+Claims record-range tasks from the MasterServer, trains one step per
+chunk, checkpoints after every finished task, and reports what it did
+as one JSON line: {"tag", "resumed", "start_step", "tasks": [...]}.
+
+Env: MASTER_ENDPOINT, CKPT_DIR, EDL_HANG_AFTER (finish N tasks then
+hang mid-task — the crash site for the test's kill), DATA_DIM.
+"""
+
+import json
+import os
+import pickle
+import time
+
+
+def main():
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.distributed import MasterClient
+    from paddle_tpu.runtime.native import RecordIOScanner
+
+    tag = os.environ.get('WORKER_TAG', 'w')
+    ckpt_dir = os.environ['CKPT_DIR']
+    hang_after = int(os.environ.get('EDL_HANG_AFTER', '-1'))
+    dim = int(os.environ.get('DATA_DIM', '8'))
+
+    main_prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        x = fluid.layers.data('x', shape=[dim])
+        y = fluid.layers.data('y', shape=[1])
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    step_file = os.path.join(ckpt_dir, 'step')
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        resumed = False
+        start_step = 0
+        if os.path.exists(step_file):
+            fluid.io.load_persistables(exe, ckpt_dir, main_prog)
+            with open(step_file) as f:
+                start_step = int(f.read().strip())
+            resumed = True
+
+        client = MasterClient(os.environ['MASTER_ENDPOINT'])
+        step = start_step
+        done_tasks = []
+        scanners = {}
+        while True:
+            tid, task = client.get_task()
+            if tid == -1:
+                break  # pass finished
+            if task is None:
+                time.sleep(0.05)
+                continue
+            if hang_after >= 0 and len(done_tasks) >= hang_after:
+                # crash site: task CLAIMED but never finished
+                print(json.dumps({'tag': tag, 'hanging_on': tid}),
+                      flush=True)
+                time.sleep(300)
+            path = task['path']
+            sc = scanners.get(path)
+            if sc is None or sc[1] > task['start']:
+                sc = [RecordIOScanner(path), 0]
+                scanners[path] = sc
+            rows = []
+            while sc[1] < task['start'] + task['count']:
+                rec = next(sc[0])
+                if sc[1] >= task['start']:
+                    rows.append(pickle.loads(rec))
+                sc[1] += 1
+            xs = np.stack([r[0] for r in rows]).astype('float32')
+            ys = np.stack([r[1] for r in rows]).astype('float32')
+            exe.run(main_prog, feed={'x': xs, 'y': ys},
+                    fetch_list=[loss])
+            step += 1
+            fluid.io.save_persistables(exe, ckpt_dir, main_prog)
+            with open(step_file, 'w') as f:
+                f.write(str(step))
+            client.task_finished(tid)
+            done_tasks.append(tid)
+        print(json.dumps({'tag': tag, 'resumed': resumed,
+                          'start_step': start_step,
+                          'tasks': done_tasks}), flush=True)
+
+
+if __name__ == '__main__':
+    main()

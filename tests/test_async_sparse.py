@@ -1,0 +1,106 @@
+"""Async sparse-embedding training (VERDICT r2 next-#9): the reference's
+surviving async mode — host-resident table, row prefetch into the
+synchronous dense step, barrier-free gradient push applied by a
+background thread (listen_and_serv RunAsyncLoop analog)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed import AsyncSparseEmbedding
+
+VOCAB, DIM, B = 100, 8, 16
+
+
+def _ctr_step_program():
+    """Dense half of a CTR-style model: the embedding rows arrive as a
+    FEED (the prefetch output), so their gradient is a fetchable var —
+    the sparse push payload."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        rows = fluid.layers.data('emb_rows', shape=[DIM])
+        rows.stop_gradient = False
+        label = fluid.layers.data('label', shape=[1])
+        h = fluid.layers.fc(rows, size=16, act='relu')
+        pred = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=label))
+        opt = fluid.optimizer.SGD(0.05)
+        opt.minimize(loss)
+        grads = fluid.backward.calc_gradient(loss, [rows])
+    return main, startup, loss, grads[0]
+
+
+def _batches(steps, seed=0):
+    rng = np.random.RandomState(seed)
+    truth = rng.standard_normal((VOCAB, )).astype('float32')
+    for _ in range(steps):
+        ids = rng.randint(0, VOCAB, size=(B, ))
+        y = truth[ids][:, None] * 0.5
+        yield ids, y.astype('float32')
+
+
+def test_async_ctr_trains_and_drains():
+    svc = AsyncSparseEmbedding(VOCAB, DIM, lr=0.05, seed=1)
+    main, startup, loss, row_grad = _ctr_step_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    losses = []
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        for ids, y in _batches(steps=60):
+            rows = svc.prefetch(ids)  # reference AsyncPrefetchVar
+            lv, gv = exe.run(main, feed={'emb_rows': rows, 'label': y},
+                             fetch_list=[loss, row_grad])
+            svc.push_grad(ids, np.asarray(gv))  # barrier-free send
+            losses.append(float(np.asarray(lv).ravel()[0]))
+    svc.drain()
+    stats = svc.stats
+    assert stats['pushed'] == 60 and stats['applied'] == 60
+    assert np.isfinite(losses).all()
+    # async staleness still converges (the reference's operating claim)
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.7, (
+        np.mean(losses[:10]), np.mean(losses[-10:]))
+    svc.close()
+
+
+def test_async_matches_sync_when_drained_per_step():
+    """Draining after every push serializes the pipeline: the async
+    service must then reproduce synchronous sparse SGD exactly."""
+    a = AsyncSparseEmbedding(VOCAB, DIM, lr=0.1, seed=2)
+    sync_table = a.table().copy()
+    rng = np.random.RandomState(3)
+    for _ in range(20):
+        ids = rng.randint(0, VOCAB, size=(B, ))
+        g = rng.standard_normal((B, DIM)).astype('float32')
+        a.push_grad(ids, g)
+        a.drain()
+        np.subtract.at(sync_table, ids, 0.1 * g)
+    np.testing.assert_allclose(a.table(), sync_table, rtol=1e-6)
+    a.close()
+
+
+def test_concurrent_pushers_no_lost_updates():
+    """Two trainer threads pushing without barriers (the reference's
+    multi-trainer async loop): every update must land exactly once."""
+    import threading
+    svc = AsyncSparseEmbedding(VOCAB, DIM, lr=1.0, seed=4,
+                               init_scale=0.0)
+    n_per = 50
+
+    def pusher(tid):
+        rng = np.random.RandomState(tid)
+        for _ in range(n_per):
+            ids = rng.randint(0, VOCAB, size=(4, ))
+            svc.push_grad(ids, np.ones((4, DIM), 'float32'))
+
+    ts = [threading.Thread(target=pusher, args=(t, )) for t in (10, 20)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    svc.drain()
+    table = svc.table()
+    # total mass: each pushed row-grad subtracts lr*1 from DIM entries
+    total = -table.sum()
+    assert abs(total - 2 * n_per * 4 * DIM) < 1e-3, total
+    svc.close()

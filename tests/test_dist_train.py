@@ -95,3 +95,44 @@ def test_two_process_dp_matches_single_process():
     np.testing.assert_allclose(dist, single, rtol=2e-4, atol=2e-5)
     # and training actually went somewhere
     assert single[-1] < single[0]
+
+
+def test_four_process_dp_matches_single_process():
+    """VERDICT r2 next-#5: the 4-process run (4 procs x 2 virtual
+    devices = 8-way dp)."""
+    single = _run_single()
+    dist = _run_dist(nproc=4)
+    assert len(dist) == STEPS
+    np.testing.assert_allclose(dist, single, rtol=2e-4, atol=2e-5)
+
+
+def test_two_process_dp_tp_mesh():
+    """VERDICT r2 next-#5: a dp x tp mesh whose tp axis crosses the
+    process boundary (classifier weight sharded over tp), loss parity
+    with the single-process run."""
+    single = _run_single()
+    port = _free_port()
+    env = _base_env()
+    env['DIST_TEST_MODE'] = 'dp_tp'
+    procs = []
+    for pid in range(2):
+        penv = dict(env,
+                    PADDLE_TRAINERS_NUM='2',
+                    PADDLE_TRAINER_ID=str(pid),
+                    PADDLE_COORDINATOR='127.0.0.1:%d' % port)
+        procs.append(
+            subprocess.Popen([sys.executable, WORKER], env=penv,
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True))
+    outs = []
+    for p in procs:
+        try:
+            stdout, stderr = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, stdout, stderr))
+    losses = [_parse_losses(*out) for out in outs]
+    np.testing.assert_allclose(losses[1], losses[0], rtol=1e-6)
+    np.testing.assert_allclose(losses[0], single, rtol=2e-4, atol=2e-5)

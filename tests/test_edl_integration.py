@@ -1,0 +1,127 @@
+"""EDL end-to-end (VERDICT r2 next-#5): a trainer killed mid-task, the
+master re-dispatching its claimed chunk after the timeout, and a
+replacement trainer resuming from the checkpoint and finishing the
+pass (reference: go/master/service.go:140 timeouts + stateless
+trainers; Trainer checkpoint auto-resume, SURVEY §5.3/5.4).  The master
+runs IN this process behind the new MasterServer RPC door
+(go/master net/rpc service parity); trainers are real subprocesses."""
+
+import json
+import os
+import pickle
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import Master, MasterServer, MasterClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, 'tests', 'edl_worker.py')
+
+DIM = 8
+RECORDS_PER_TASK = 4
+N_TASKS = 6
+
+
+def _write_dataset(path):
+    from paddle_tpu.runtime.native import RecordIOWriter
+    rng = np.random.RandomState(0)
+    w = RecordIOWriter(path)
+    for _ in range(RECORDS_PER_TASK * N_TASKS):
+        x = rng.standard_normal(DIM).astype('float32')
+        y = np.array([x.sum() * 0.5], 'float32')
+        w.write(pickle.dumps((x, y)))
+    w.close()
+
+
+def _spawn(endpoint, ckpt, tag, hang_after=-1, stderr=None):
+    env = dict(os.environ)
+    env.pop('XLA_FLAGS', None)
+    env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
+    env['MASTER_ENDPOINT'] = endpoint
+    env['CKPT_DIR'] = ckpt
+    env['WORKER_TAG'] = tag
+    env['DATA_DIM'] = str(DIM)
+    env['EDL_HANG_AFTER'] = str(hang_after)
+    return subprocess.Popen([sys.executable, WORKER], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=stderr or subprocess.PIPE, text=True)
+
+
+def test_trainer_kill_redispatch_resume(tmp_path):
+    data = str(tmp_path / 'train.recordio')
+    ckpt = str(tmp_path / 'ckpt')
+    os.makedirs(ckpt)
+    _write_dataset(data)
+
+    master = Master(store_path=str(tmp_path / 'store'),
+                    chunk_timeout_secs=2, failure_max=3)
+    master.set_dataset([data], records_per_task=RECORDS_PER_TASK)
+    assert master.counts()[0] == N_TASKS
+    server = MasterServer(master)
+    try:
+        # trainer A: finishes 2 tasks, then hangs holding its 3rd claim.
+        # stderr goes to DEVNULL: A's stdout is read line-by-line below,
+        # and an undrained stderr pipe could fill and block the worker
+        # before it prints its claim line
+        a = _spawn(server.endpoint, ckpt, 'A', hang_after=2,
+                   stderr=subprocess.DEVNULL)
+        deadline = time.time() + 120
+        hanging_tid = None
+        while time.time() < deadline and hanging_tid is None:
+            line = a.stdout.readline()
+            if line.strip().startswith('{'):
+                msg = json.loads(line)
+                hanging_tid = msg.get('hanging_on')
+        assert hanging_tid is not None, 'trainer A never reached its claim'
+        todo, pending, done, discarded = master.counts()
+        assert done == 2 and pending >= 1
+        # kill mid-task (the claim is live, never finished)
+        a.send_signal(signal.SIGKILL)
+        a.wait(timeout=30)
+
+        # trainer B resumes from A's checkpoint and drains the pass,
+        # including the killed task once its claim times out
+        b = _spawn(server.endpoint, ckpt, 'B')
+        stdout, stderr = b.communicate(timeout=240)
+        assert b.returncode == 0, stderr
+        out = json.loads(
+            [l for l in stdout.splitlines() if l.strip().startswith('{')][-1])
+        assert out['resumed'] is True
+        assert out['start_step'] == 2  # A's checkpoint carried over
+        assert hanging_tid in out['tasks'], (hanging_tid, out)
+        todo, pending, done, discarded = master.counts()
+        assert done == N_TASKS and todo == 0 and pending == 0
+        assert discarded == 0
+    finally:
+        server.close()
+        master.close()
+
+
+def test_master_client_rpc_roundtrip(tmp_path):
+    """The RPC door itself: claims are exclusive across clients and
+    finished/failed/counts round-trip."""
+    data = str(tmp_path / 'd.recordio')
+    _write_dataset(data)
+    master = Master(chunk_timeout_secs=60, failure_max=2)
+    master.set_dataset([data], records_per_task=RECORDS_PER_TASK)
+    server = MasterServer(master)
+    try:
+        c1 = MasterClient(server.endpoint)
+        c2 = MasterClient(server.endpoint)
+        t1, task1 = c1.get_task()
+        t2, task2 = c2.get_task()
+        assert t1 != t2 and task1 != task2
+        c1.task_finished(t1)
+        assert c2.task_failed(t2) == 0  # requeued, not discarded
+        counts = c1.counts()
+        assert counts[2] == 1  # one done
+        c1.close()
+        c2.close()
+    finally:
+        server.close()
+        master.close()

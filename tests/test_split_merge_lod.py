@@ -150,3 +150,34 @@ def test_ifelse_routed_trains():
     assert np.isfinite(losses).all()
     assert not np.allclose(w_t, 0.5)    # true branch trained
     assert not np.allclose(w_f, -0.25)  # false branch trained
+
+
+def test_ifelse_mixed_routing_one_branch_unrouted():
+    """One branch reads its compacted subset via ie.input(x), the other
+    reads x directly (row-aligned): each side must be indexed by ITS OWN
+    layout when merging."""
+    rng = np.random.RandomState(5)
+    x_np = rng.standard_normal((B, D)).astype('float32')
+    y_np = rng.standard_normal((B, 1)).astype('float32')
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[D])
+        lbl = fluid.layers.data('y', shape=[1])
+        limit = fluid.layers.fill_constant(
+            shape=[1], dtype='float32', value=0.0)
+        cond = fluid.layers.less_than(x=lbl, y=limit)
+        ie = fluid.layers.IfElse(cond)
+        with ie.true_block():
+            xin = ie.input(x)  # routed: compacted layout
+            ie.output(fluid.layers.scale(xin, scale=3.0))
+        with ie.false_block():
+            ie.output(fluid.layers.scale(x, scale=7.0))  # unrouted
+        out = ie()[0]
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        ov = exe.run(main, feed={'x': x_np, 'y': y_np},
+                     fetch_list=[out])[0]
+    want = np.where(y_np < 0, 3.0 * x_np, 7.0 * x_np)
+    np.testing.assert_allclose(np.asarray(ov), want, rtol=1e-5)
