@@ -194,21 +194,32 @@ def main():
     import paddle_tpu.fluid as fluid
 
     on_tpu = fluid.core.is_compiled_with_tpu()
-    configs = [
-        bench_resnet(on_tpu),
-        bench_nmt(on_tpu),
-        bench_transformer(on_tpu),
-        bench_stacked_lstm(on_tpu),
-    ]
+    configs = []
+    for fn in (bench_resnet, bench_nmt, bench_transformer,
+               bench_stacked_lstm):
+        try:
+            configs.append(fn(on_tpu))
+        except Exception as e:  # a failing config must not zero the rest
+            configs.append({
+                'metric': fn.__name__.replace('bench_', '') + '_FAILED',
+                'value': None, 'unit': None, 'mfu': None,
+                'vs_baseline': None, 'error': '%s: %s' %
+                (type(e).__name__, str(e)[:300]),
+            })
     head = configs[0]
     print(json.dumps({
         'metric': head['metric'],
         'value': head['value'],
         'unit': head['unit'],
         'vs_baseline': head['vs_baseline'],
-        'mfu': head['mfu'],
+        'mfu': head.get('mfu'),
         'configs': configs,
     }))
+    if head.get('value') is None:
+        # the partial report (incl. the other configs' numbers and this
+        # error) is already on stdout; exit nonzero for the driver
+        raise SystemExit('headline ResNet bench failed: %s' %
+                         head.get('error'))
 
 
 if __name__ == '__main__':
