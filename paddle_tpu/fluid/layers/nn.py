@@ -1057,6 +1057,22 @@ def scatter(input, index, updates, name=None):
 def slice(input, axes, starts, ends):
     helper = LayerHelper('slice', **locals())
     out = helper.create_variable_for_type_inference(input.dtype)
+    if getattr(input, 'shape', None):
+        # mirror the runtime's Python slice semantics (negative indices,
+        # INT_MAX-as-open-end); unknown dims (-1) stay unknown
+        INT_MAX = 2**31 - 1
+        shape = list(input.shape)
+        for ax, s, e in zip(axes, starts, ends):
+            if not (0 <= ax < len(shape)):
+                continue
+            dim = shape[ax]
+            if dim is None or int(dim) < 0:
+                continue
+            import builtins
+            shape[ax] = len(range(int(dim))[builtins.slice(
+                None if s <= -INT_MAX else s,
+                None if e >= INT_MAX else e)])
+        out.shape = tuple(shape)
     helper.append_op(
         type='slice',
         inputs={'Input': [input]},

@@ -217,13 +217,30 @@ def _sequence_conv(ctx, op):
 
 @register_lowering('sequence_slice')
 def _sequence_slice(ctx, op):
+    """Per-sequence window (reference sequence_slice_op.cc: each row i
+    keeps [offset_i, offset_i + length_i)).  Static layout: rows are
+    gathered to the front of the same padded buffer and the lengths
+    side-band becomes length_i — offsets/lengths may be traced per-row
+    values or concrete scalars."""
     x = ctx.get(op, 'X')
-    offset = ctx.get(op, 'Offset')
-    length = ctx.get(op, 'Length')
-    # static-shape approximation: same offset/length per batch row
-    off = int(np.asarray(offset).flatten()[0])
-    ln = int(np.asarray(length).flatten()[0])
-    ctx.set(op, 'Out', x[:, off:off + ln])
+    offset = jnp.reshape(ctx.get(op, 'Offset'), (-1, )).astype(jnp.int32)
+    length = jnp.reshape(ctx.get(op, 'Length'), (-1, )).astype(jnp.int32)
+    b, t = x.shape[0], x.shape[1]
+    if offset.shape[0] == 1 and b > 1:
+        offset = jnp.broadcast_to(offset, (b, ))
+    if length.shape[0] == 1 and b > 1:
+        length = jnp.broadcast_to(length, (b, ))
+    pos = jnp.arange(t)[None, :]  # [1, T]
+    idx = jnp.clip(offset[:, None] + pos, 0, t - 1)
+    gathered = jnp.take_along_axis(
+        x, jnp.reshape(idx, (b, t) + (1, ) * (x.ndim - 2)), axis=1)
+    valid = pos < length[:, None]
+    out = jnp.where(
+        jnp.reshape(valid, (b, t) + (1, ) * (x.ndim - 2)), gathered,
+        jnp.zeros_like(gathered))
+    ctx.set(op, 'Out', out)
+    for n in op.output('Out'):
+        ctx.env[n + SEQLEN_SUFFIX] = length
 
 
 @register_lowering('sequence_enumerate')
@@ -643,3 +660,24 @@ def _context_project(ctx, op):
             pad = jnp.zeros((b, -off, d), x.dtype)
             parts.append(jnp.concatenate([pad, x[:, :off]], axis=1))
     ctx.set(op, 'Out', jnp.concatenate(parts, axis=2))
+
+
+@register_lowering('kmax_seq_score')
+def _kmax_seq_score(ctx, op):
+    """Top-k scores per sequence (reference kmax_seq_score_layer):
+    scores arrive [B, T] or [B, T, 1] padded; padding is masked out of
+    the per-row top_k.  A sequence shorter than k pads its tail scores
+    with 0 (finite — a -inf leak would poison downstream losses)."""
+    x = ctx.get(op, 'X')
+    k = int(op.attrs.get('beam_size', 1))
+    lengths = _seqlen(ctx, op)
+    v = x[..., 0] if x.ndim == 3 and x.shape[-1] == 1 else x
+    if k > v.shape[1]:
+        raise ValueError(
+            'kmax_seq_score: beam_size %d exceeds the padded time dim %d'
+            % (k, v.shape[1]))
+    if lengths is not None:
+        m = _mask(v, lengths)
+        v = jnp.where(m, v, -jnp.inf)
+    scores, _ = jax.lax.top_k(v, k)
+    ctx.set(op, 'Out', jnp.where(jnp.isfinite(scores), scores, 0.0))

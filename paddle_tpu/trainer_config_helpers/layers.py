@@ -29,6 +29,10 @@ __all__ = [
     'cos_sim', 'dot_prod_layer', 'out_prod_layer', 'l2_distance_layer',
     'multiplex_layer', 'sampling_id_layer', 'print_layer',
     'selective_fc_layer', 'get_output_layer',
+    # second tail batch
+    'prelu_layer', 'crop_layer', 'sub_seq_layer', 'kmax_seq_score_layer',
+    'linear_comb_layer', 'convex_comb_layer', 'tensor_layer',
+    'conv_shift_layer', 'scale_shift_layer', 'gated_unit_layer',
     # mixed + projections
     'mixed_layer', 'full_matrix_projection',
     'trans_full_matrix_projection', 'identity_projection',
@@ -294,6 +298,46 @@ def get_output_layer(input, arg_name=None, name=None, **kwargs):
 
     return _v2.Layer('get_output', [input], build, name=name,
                      size=input.size)
+
+
+def prelu_layer(input, name=None, **kwargs):
+    return _v2.prelu(input=input, name=name)
+
+
+def crop_layer(input, shape=None, offsets=None, name=None, **kwargs):
+    return _v2.crop(input=input, shape=shape, offsets=offsets, name=name)
+
+
+def sub_seq_layer(input, starts, ends, name=None, **kwargs):
+    return _v2.sub_seq(input=input, starts=starts, ends=ends, name=name)
+
+
+def kmax_seq_score_layer(input, beam_size=1, name=None, **kwargs):
+    return _v2.kmax_seq_score(input=input, beam_size=beam_size, name=name)
+
+
+def linear_comb_layer(weights, vectors, size=None, name=None, **kwargs):
+    return _v2.linear_comb(weights=weights, vectors=vectors, size=size,
+                           name=name)
+
+
+convex_comb_layer = linear_comb_layer
+
+
+def tensor_layer(a, b, size, name=None, **kwargs):
+    return _v2.tensor_product(a=a, b=b, size=size, name=name)
+
+
+def conv_shift_layer(a, b, name=None, **kwargs):
+    return _v2.conv_shift(a=a, b=b, name=name)
+
+
+def scale_shift_layer(input, name=None, **kwargs):
+    return _v2.scale_shift(input=input, name=name)
+
+
+def gated_unit_layer(input, size, name=None, **kwargs):
+    return _v2.gated_unit(input=input, size=size, name=name)
 
 
 # ---- mixed + projections ----

@@ -32,6 +32,10 @@ __all__ = [
     'full_matrix_projection', 'trans_full_matrix_projection',
     'identity_projection', 'table_projection', 'dotmul_projection',
     'context_projection', 'conv_projection',
+    # second tail batch
+    'prelu', 'crop', 'sub_seq', 'kmax_seq_score', 'linear_comb',
+    'convex_comb', 'tensor_product', 'conv_shift', 'scale_shift',
+    'gated_unit',
 ]
 
 
@@ -938,3 +942,163 @@ def mixed(size=None, input=None, act=None, bias_attr=None, name=None,
 
     return Layer('mixed', parents, build, name=name,
                  size=size or projs[0].size)
+
+
+# ---- second tail batch: the remaining commonly-used legacy kinds ----
+def prelu(input, name=None, **kwargs):
+    def build(ctx, v):
+        return fluid.layers.prelu(v, mode='all')
+
+    return Layer('prelu', [input], build, name=name, size=input.size)
+
+
+def crop(input, shape=None, offsets=None, name=None, **kwargs):
+    def build(ctx, v):
+        return fluid.layers.crop(v, shape=shape, offsets=offsets)
+
+    size = None
+    if shape:
+        size = 1
+        for d in list(shape)[1:]:  # dim 0 is batch
+            size *= int(d)
+    return Layer('crop', [input], build, name=name, size=size)
+
+
+def sub_seq(input, starts, ends, name=None, **kwargs):
+    """Per-sequence time slice (reference sub_seq_layer): ``starts``/
+    ``ends`` are END-EXCLUSIVE positions; sequence_slice takes (offset,
+    LENGTH), so length = ends - starts."""
+
+    def build(ctx, v, sv, ev):
+        length = fluid.layers.elementwise_sub(ev, sv)
+        return fluid.layers.sequence_slice(v, sv, length)
+
+    return Layer('sub_seq', [input, starts, ends], build, name=name,
+                 size=input.size)
+
+
+def kmax_seq_score(input, beam_size=1, name=None, **kwargs):
+    """Top-k scores per sequence (reference kmax_seq_score_layer) —
+    its own op lowering (ops/sequence_ops.py) because the time axis only
+    exists on the padded runtime layout."""
+
+    def build(ctx, v):
+        from ..fluid.layer_helper import LayerHelper
+        helper = LayerHelper('kmax_seq_score')
+        out = helper.create_variable_for_type_inference(dtype=v.dtype)
+        out.shape = (v.shape[0] if v.shape else -1, beam_size)
+        helper.append_op(
+            type='kmax_seq_score',
+            inputs={'X': [v]},
+            outputs={'Out': [out]},
+            attrs={'beam_size': int(beam_size)})
+        return out
+
+    return Layer('kmax_seq_score', [input], build, name=name,
+                 size=beam_size)
+
+
+def linear_comb(weights, vectors, size=None, name=None, **kwargs):
+    """out = sum_i w[i] * vec_block[i] (reference linear_comb_layer):
+    weights [B, M], vectors [B, M*size] viewed as M blocks of size."""
+
+    def build(ctx, wv, vv):
+        m = weights.size
+        d = size or (vectors.size // m)
+        v3 = fluid.layers.reshape(vv, shape=[-1, m, d])
+        w3 = fluid.layers.reshape(wv, shape=[-1, m, 1])
+        return fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(v3, w3), dim=1)
+
+    return Layer('linear_comb', [weights, vectors], build, name=name,
+                 size=size or (vectors.size // weights.size
+                               if vectors.size and weights.size else None))
+
+
+convex_comb = linear_comb
+
+
+def tensor_product(a, b, size, name=None, **kwargs):
+    """Bilinear tensor product (reference tensor_layer): out[:, k] =
+    a W_k b^T with one [Da, Db] weight slice per output."""
+
+    def build(ctx, av, bv):
+        da, db = a.size, b.size
+        w = fluid.layers.create_parameter(
+            shape=[da, size * db], dtype='float32')
+        # [B, Da] @ [Da, K*Db] -> [B, K, Db]; then row-dot with b
+        proj = fluid.layers.reshape(
+            fluid.layers.matmul(av, w), shape=[-1, size, db])
+        b3 = fluid.layers.reshape(bv, shape=[-1, 1, db])
+        return fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(proj, b3), dim=2)
+
+    return Layer('tensor_product', [a, b], build, name=name, size=size)
+
+
+def conv_shift(a, b, name=None, **kwargs):
+    """Circular correlation (reference conv_shift_layer /
+    operators/conv_shift_op.cc): out[:, i] = sum_j a[:, i+j-M/2 mod N]
+    * b[:, j] with b the odd-width kernel."""
+
+    if b.size is None or b.size % 2 != 1:
+        raise ValueError(
+            'conv_shift kernel width must be odd (reference '
+            'conv_shift_op.cc requires 2N+1); got %r' % (b.size, ))
+
+    def build(ctx, av, bv):
+        n, m = a.size, b.size
+        half = m // 2
+        parts = []
+        for j in range(m):
+            shift = j - half
+            # roll a by -shift (circular) via concat of slices
+            k = shift % n
+            if k == 0:
+                rolled = av
+            else:
+                left = fluid.layers.slice(av, axes=[1], starts=[k],
+                                          ends=[n])
+                right = fluid.layers.slice(av, axes=[1], starts=[0],
+                                           ends=[k])
+                rolled = fluid.layers.concat([left, right], axis=1)
+            wj = fluid.layers.slice(bv, axes=[1], starts=[j],
+                                    ends=[j + 1])
+            parts.append(fluid.layers.elementwise_mul(rolled, wj,
+                                                      axis=0))
+        out = parts[0]
+        for p in parts[1:]:
+            out = fluid.layers.elementwise_add(out, p)
+        return out
+
+    return Layer('conv_shift', [a, b], build, name=name, size=a.size)
+
+
+def scale_shift(input, name=None, **kwargs):
+    """y = w*x + b with scalar learned w, b (reference
+    scale_shift_layer)."""
+
+    def build(ctx, v):
+        w = fluid.layers.create_parameter(
+            shape=[1], dtype='float32',
+            default_initializer=fluid.initializer.Constant(1.0))
+        b = fluid.layers.create_parameter(
+            shape=[1], dtype='float32',
+            default_initializer=fluid.initializer.Constant(0.0))
+        return fluid.layers.elementwise_add(
+            fluid.layers.elementwise_mul(v, w, axis=0), b, axis=0)
+
+    return Layer('scale_shift', [input], build, name=name,
+                 size=input.size)
+
+
+def gated_unit(input, size, name=None, **kwargs):
+    """GLU block: act(fc(x)) * sigmoid(fc(x)) (reference
+    gated_unit_layer)."""
+
+    def build(ctx, v):
+        a = fluid.layers.fc(v, size=size)
+        g = fluid.layers.fc(v, size=size, act='sigmoid')
+        return fluid.layers.elementwise_mul(a, g)
+
+    return Layer('gated_unit', [input], build, name=name, size=size)

@@ -280,3 +280,117 @@ def test_cross_entropy_with_selfnorm_penalizes_z():
     v, p = float(np.asarray(v).ravel()[0]), float(np.asarray(p).ravel()[0])
     assert np.isfinite([v, p]).all()
     assert v > p  # the alpha * log(Z)^2 term is live
+
+
+def test_second_tail_batch_builders():
+    """prelu/crop/sub_seq/kmax/linear_comb/tensor/conv_shift/scale_shift/
+    gated_unit all build and run one finite forward."""
+    tch.settings(batch_size=3, learning_rate=0.01)
+    x = tch.data_layer(name='x', size=6)
+    y = tch.data_layer(name='y', size=3)  # odd kernel for conv_shift
+    w = tch.data_layer(name='w', size=2)
+    vecs = tch.data_layer(name='vecs', size=2 * 6)
+
+    pr = tch.prelu_layer(input=x)
+    lc = tch.linear_comb_layer(weights=w, vectors=vecs, size=6)
+    tp = tch.tensor_layer(a=x, b=y, size=4)
+    cshift = tch.conv_shift_layer(a=x, b=y)
+    ss = tch.scale_shift_layer(input=x)
+    gu = tch.gated_unit_layer(input=x, size=5)
+    cat = tch.concat_layer(input=[pr, lc, tp, cshift, ss, gu])
+    cost = tch.sum_cost(input=cat)
+
+    rng = np.random.RandomState(11)
+    feed = {'x': rng.standard_normal((3, 6)).astype('float32'),
+            'y': rng.standard_normal((3, 3)).astype('float32'),
+            'w': rng.standard_normal((3, 2)).astype('float32'),
+            'vecs': rng.standard_normal((3, 12)).astype('float32')}
+    vals = _run_cost(cost, feed, steps=2)
+    assert np.isfinite(vals).all()
+
+
+def test_conv_shift_matches_numpy_circular_correlation():
+    """conv_shift oracle: out[:, i] = sum_j a[:, (i + j - M//2) % N] b[:, j]
+    (reference operators/conv_shift_op.cc)."""
+    tch.settings(batch_size=2, learning_rate=0.01)
+    a = tch.data_layer(name='a', size=5)
+    b = tch.data_layer(name='b', size=3)
+    out = tch.conv_shift_layer(a=a, b=b)
+    cost = tch.sum_cost(input=out)
+    topo = Topology(cost)
+    rng = np.random.RandomState(12)
+    av = rng.standard_normal((2, 5)).astype('float32')
+    bv = rng.standard_normal((2, 3)).astype('float32')
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(topo.startup_program)
+        got = exe.run(topo.main_program, feed={'a': av, 'b': bv},
+                      fetch_list=[topo._ctx[out.name]])[0]
+    want = np.zeros_like(av)
+    n, m = 5, 3
+    for i in range(n):
+        for j in range(m):
+            want[:, i] += av[:, (i + j - m // 2) % n] * bv[:, j]
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+
+def test_kmax_and_subseq_sequence_builders():
+    tch.settings(batch_size=3, learning_rate=0.01)
+    seq = tch.data_layer(name='seq', size=1, seq=True)
+    k = tch.kmax_seq_score_layer(input=seq, beam_size=2)
+    cost = tch.sum_cost(input=k)
+    rng = np.random.RandomState(13)
+    rows = [rng.standard_normal((l, 1)) for l in (4, 6, 3)]
+    st = fluid.core.LoDTensor(np.concatenate(rows).astype('float32'))
+    st.set_recursive_sequence_lengths([[len(r) for r in rows]])
+    vals = _run_cost(cost, {'seq': st}, steps=1)
+    assert np.isfinite(vals).all()
+
+
+def test_sub_seq_slices_correct_window():
+    """sub_seq takes END-exclusive positions; tokens starts..ends-1."""
+    tch.settings(batch_size=2, learning_rate=0.01)
+    seq = tch.data_layer(name='seq', size=2, seq=True)
+    st = tch.data_layer(name='st', size=1, data_type_kind='index')
+    en = tch.data_layer(name='en', size=1, data_type_kind='index')
+    sub = tch.sub_seq_layer(input=seq, starts=st, ends=en)
+    cost = tch.sum_cost(input=tch.pooling_layer(
+        input=sub, pooling_type=tch.SumPooling()))
+    topo = Topology(cost)
+    rows = [np.arange(10).reshape(5, 2).astype('float32'),
+            np.arange(8).reshape(4, 2).astype('float32')]
+    lt = fluid.core.LoDTensor(np.concatenate(rows))
+    lt.set_recursive_sequence_lengths([[5, 4]])
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(topo.startup_program)
+        v, = exe.run(topo.main_program,
+                     feed={'seq': lt,
+                           'st': np.array([[1], [0]], 'int64'),
+                           'en': np.array([[3], [2]], 'int64')},
+                     fetch_list=[topo.cost_var])
+    # row0 tokens 1..2 sum = (2+3)+(4+5)=14; row1 tokens 0..1 = (0+1)+(2+3)=6
+    np.testing.assert_allclose(float(np.asarray(v).ravel()[0]), 20.0,
+                               rtol=1e-6)
+
+
+def test_kmax_short_sequences_pad_finite():
+    tch.settings(batch_size=2, learning_rate=0.01)
+    seq = tch.data_layer(name='seq', size=1, seq=True)
+    k = tch.kmax_seq_score_layer(input=seq, beam_size=3)
+    cost = tch.sum_cost(input=k)
+    rows = [np.array([[5.0]], 'float32'),  # length 1 < k=3
+            np.array([[1.0], [2.0], [3.0], [4.0]], 'float32')]
+    lt = fluid.core.LoDTensor(np.concatenate(rows))
+    lt.set_recursive_sequence_lengths([[1, 4]])
+    vals = _run_cost(cost, {'seq': lt}, steps=1)
+    # row0: 5 + 0 + 0; row1: 4+3+2 -> total 14, FINITE
+    np.testing.assert_allclose(vals[0], 14.0, rtol=1e-6)
+
+
+def test_conv_shift_rejects_even_kernel():
+    tch.settings(batch_size=2, learning_rate=0.01)
+    a = tch.data_layer(name='a', size=5)
+    b = tch.data_layer(name='b', size=4)
+    with pytest.raises(ValueError):
+        tch.conv_shift_layer(a=a, b=b)
