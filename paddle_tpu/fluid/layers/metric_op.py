@@ -3,7 +3,8 @@
 from ..layer_helper import LayerHelper
 from ..framework import Variable
 
-__all__ = ['accuracy', 'auc', 'chunk_eval']
+__all__ = ['accuracy', 'auc', 'chunk_eval', 'precision_recall',
+           'positive_negative_pair']
 
 
 def accuracy(input, label, k=1, correct=None, total=None):
@@ -84,3 +85,49 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,
         })
     return (precision, recall, f1_score, num_infer_chunks,
             num_label_chunks, num_correct_chunks)
+
+
+def precision_recall(input, label, class_number=None):
+    """Per-class precision/recall/F1 batch metrics (reference
+    operators/precision_recall_op.cc).  ``input`` is the probability
+    matrix; the op consumes the argmax indices."""
+    helper = LayerHelper('precision_recall', **locals())
+    cls = class_number
+    if cls is None:
+        shape = getattr(input, 'shape', None)
+        if not shape or len(shape) < 2 or shape[-1] is None or \
+                int(shape[-1]) < 0:
+            raise ValueError(
+                'precision_recall: cannot infer class_number from input '
+                'shape %r - pass class_number explicitly' % (shape, ))
+        cls = int(shape[-1])
+    from .nn import topk
+    _, idx = topk(input, 1)
+    batch_metrics = helper.create_variable_for_type_inference('float32')
+    batch_metrics.shape = (3, )
+    helper.append_op(
+        type='precision_recall',
+        inputs={'Indices': [idx],
+                'Labels': [label]},
+        outputs={'BatchMetrics': [batch_metrics]},
+        attrs={'class_number': int(cls)})
+    return batch_metrics
+
+
+def positive_negative_pair(score, label, query_id):
+    """Ranking pair agreement counts per query (reference
+    operators/positive_negative_pair_op.cc).
+    Returns (positive, negative, neutral) pair counts."""
+    helper = LayerHelper('positive_negative_pair', **locals())
+    pos = helper.create_variable_for_type_inference('float32')
+    neg = helper.create_variable_for_type_inference('float32')
+    neu = helper.create_variable_for_type_inference('float32')
+    helper.append_op(
+        type='positive_negative_pair',
+        inputs={'Score': [score],
+                'Label': [label],
+                'QueryID': [query_id]},
+        outputs={'PositivePair': [pos],
+                 'NegativePair': [neg],
+                 'NeutralPair': [neu]})
+    return pos, neg, neu

@@ -13,7 +13,11 @@ from .. import fluid
 __all__ = [
     'classification_error_evaluator', 'auc_evaluator',
     'ctc_error_evaluator', 'chunk_evaluator', 'sum_evaluator',
-    'column_sum_evaluator',
+    'column_sum_evaluator', 'precision_recall_evaluator',
+    'pnpair_evaluator', 'detection_map_evaluator',
+    'value_printer_evaluator', 'gradient_printer_evaluator',
+    'maxid_printer_evaluator', 'maxframe_printer_evaluator',
+    'seqtext_printer_evaluator', 'classification_error_printer_evaluator',
 ]
 
 
@@ -83,3 +87,145 @@ def column_sum_evaluator(input, name=None, **kwargs):
         return fluid.layers.reduce_sum(input_var, dim=0)
 
     return _metric_layer('column_sum', [input], build, name)
+
+
+def precision_recall_evaluator(input, label, positive_label=None,
+                               name=None, **kwargs):
+    """Precision/recall/F1 (reference evaluators.py:353 ->
+    operators/precision_recall_op.cc).  Without ``positive_label``:
+    the op's macro-averaged [precision, recall, F1] vector (shape (3,)).
+    With ``positive_label``: binary metrics for that class, the
+    reference's single-class mode."""
+
+    def build(ctx, input_var, label_var):
+        if positive_label is None:
+            return fluid.layers.precision_recall(
+                input=input_var, label=label_var)
+        # binary mode: metrics for the one positive class
+        _, idx = fluid.layers.topk(input_var, 1)
+        pos = float(positive_label)
+        pred_pos = fluid.layers.cast(
+            fluid.layers.equal(
+                fluid.layers.cast(idx, 'float32'),
+                fluid.layers.fill_constant_batch_size_like(
+                    label_var, shape=[-1, 1], value=pos,
+                    dtype='float32')), 'float32')
+        lbl_pos = fluid.layers.cast(
+            fluid.layers.equal(
+                fluid.layers.cast(label_var, 'float32'),
+                fluid.layers.fill_constant_batch_size_like(
+                    label_var, shape=[-1, 1], value=pos,
+                    dtype='float32')), 'float32')
+        tp = fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(pred_pos, lbl_pos))
+        pred_n = fluid.layers.reduce_sum(pred_pos)
+        lbl_n = fluid.layers.reduce_sum(lbl_pos)
+        eps = fluid.layers.fill_constant(shape=[1], dtype='float32',
+                                         value=1e-12)
+        precision = fluid.layers.elementwise_div(
+            tp, fluid.layers.elementwise_max(pred_n, eps))
+        recall = fluid.layers.elementwise_div(
+            tp, fluid.layers.elementwise_max(lbl_n, eps))
+        f1 = fluid.layers.elementwise_div(
+            fluid.layers.scale(
+                fluid.layers.elementwise_mul(precision, recall),
+                scale=2.0),
+            fluid.layers.elementwise_max(
+                fluid.layers.elementwise_add(precision, recall), eps))
+        return fluid.layers.concat(
+            [fluid.layers.reshape(v, shape=[1])
+             for v in (precision, recall, f1)], axis=0)
+
+    return _metric_layer('precision_recall', [input, label], build, name)
+
+
+def pnpair_evaluator(input, label, query_id, name=None, **kwargs):
+    """Positive-negative pair stat per query (reference
+    evaluators.py:306 -> operators/positive_negative_pair_op.cc).
+    Returns one [3] vector: [positive, negative, neutral] pair counts
+    (one fetchable var, like every evaluator)."""
+
+    def build(ctx, input_var, label_var, qid_var):
+        pos, neg, neu = fluid.layers.positive_negative_pair(
+            score=input_var, label=label_var, query_id=qid_var)
+        return fluid.layers.concat(
+            [fluid.layers.reshape(v, shape=[1])
+             for v in (pos, neg, neu)], axis=0)
+
+    return _metric_layer('pnpair', [input, label, query_id], build, name)
+
+
+def detection_map_evaluator(input, label, num_classes, background_id=0,
+                            overlap_threshold=0.5, name=None, **kwargs):
+    """Detection mAP (reference evaluators.py:170 ->
+    operators/detection_map_op.cc); ``input`` is the detection output
+    [N, 6] rows, ``label`` the ground-truth rows, ``num_classes`` the
+    class count the mAP averages over."""
+
+    def build(ctx, det_var, gt_var):
+        return fluid.layers.detection_map(
+            det_var, gt_var, int(num_classes),
+            background_label=background_id,
+            overlap_threshold=overlap_threshold)
+
+    return _metric_layer('detection_map', [input, label], build, name)
+
+
+# ---- printer evaluators (reference evaluators.py:589-787): debugging
+# evaluators that print tensors during execution; all ride the 'print'
+# host op like layers.Print ----
+def _printer(kind, layers_in, name, transform=None):
+    def build(ctx, *vs):
+        out = vs[0] if transform is None else transform(*vs)
+        return fluid.layers.Print(out, message='[%s]' % kind)
+
+    return _metric_layer(kind, list(layers_in), build, name)
+
+
+def value_printer_evaluator(input, name=None, **kwargs):
+    return _printer('value_printer', [input], name)
+
+
+def gradient_printer_evaluator(input, name=None, **kwargs):
+    """Documented delta: the reference prints the layer's GRADIENT; here
+    gradients are fused inside the compiled backward and are not
+    addressable per-layer, so this prints the layer's forward value
+    under the gradient_printer tag (attach it for placement parity,
+    use FLAGS_check_nan_inf for gradient diagnostics)."""
+    return _printer('gradient_printer', [input], name)
+
+
+def maxid_printer_evaluator(input, name=None, **kwargs):
+    def tr(v):
+        _, idx = fluid.layers.topk(v, k=1)
+        return idx
+
+    return _printer('maxid_printer', [input], name, transform=tr)
+
+
+def maxframe_printer_evaluator(input, name=None, **kwargs):
+    def tr(v):
+        return fluid.layers.sequence_pool(v, pool_type='max')
+
+    return _printer('maxframe_printer', [input], name, transform=tr)
+
+
+def seqtext_printer_evaluator(input, result_file=None, name=None,
+                              **kwargs):
+    if result_file is not None:
+        import warnings
+        warnings.warn(
+            'seqtext_printer_evaluator: result_file is not supported '
+            '(documented delta) - sequences print to stdout via the '
+            'print host op instead of writing %r' % result_file)
+    return _printer('seqtext_printer', [input], name)
+
+
+def classification_error_printer_evaluator(input, label, name=None,
+                                           **kwargs):
+    def tr(iv, lv):
+        acc = fluid.layers.accuracy(input=iv, label=lv)
+        return fluid.layers.scale(acc, scale=-1.0, bias=1.0)
+
+    return _printer('classification_error_printer', [input, label], name,
+                    transform=tr)

@@ -394,3 +394,111 @@ def test_conv_shift_rejects_even_kernel():
     b = tch.data_layer(name='b', size=4)
     with pytest.raises(ValueError):
         tch.conv_shift_layer(a=a, b=b)
+
+
+def test_evaluator_tail_precision_recall_and_pnpair():
+    tch.settings(batch_size=6, learning_rate=0.01)
+    x = tch.data_layer(name='x', size=8)
+    pred = tch.fc_layer(input=x, size=3, act=tch.SoftmaxActivation())
+    lbl = tch.data_layer(name='label', size=3, data_type_kind='index')
+    cost = tch.classification_cost(input=pred, label=lbl)
+    pr = tch.precision_recall_evaluator(input=pred, label=lbl)
+
+    topo = Topology(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(14)
+    feed = {'x': rng.standard_normal((6, 8)).astype('float32'),
+            'label': rng.randint(0, 3, (6, 1)).astype('int64')}
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(topo.startup_program)
+        with fluid.program_guard(topo.main_program,
+                                 topo.startup_program):
+            pr_var = pr.to_fluid(topo._ctx)
+        v, = exe.run(topo.main_program, feed=feed, fetch_list=[pr_var])
+    v = np.asarray(v)
+    assert v.shape == (3, ) and np.isfinite(v).all()
+    assert ((0.0 <= v) & (v <= 1.0)).all()
+
+    # pnpair: perfect ranking within one query -> all pairs positive
+    score = fluid.layers.data('s', shape=[1])
+    lab = fluid.layers.data('l', shape=[1])
+    qid = fluid.layers.data('q', shape=[1], dtype='int64')
+    pos, neg, neu = fluid.layers.positive_negative_pair(score, lab, qid)
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        pv, nv, uv = exe2.run(
+            fluid.default_main_program(),
+            feed={'s': np.array([[0.9], [0.5], [0.1]], 'float32'),
+                  'l': np.array([[2.0], [1.0], [0.0]], 'float32'),
+                  'q': np.zeros((3, 1), 'int64')},
+            fetch_list=[pos, neg, neu])
+    assert float(np.asarray(pv)) == 3.0
+    assert float(np.asarray(nv)) == 0.0
+    assert float(np.asarray(uv)) == 0.0
+
+
+def test_printer_evaluators_run(capsys):
+    tch.settings(batch_size=2, learning_rate=0.01)
+    x = tch.data_layer(name='x', size=4)
+    pred = tch.fc_layer(input=x, size=2, act=tch.SoftmaxActivation())
+    lbl = tch.data_layer(name='label', size=2, data_type_kind='index')
+    cost = tch.classification_cost(input=pred, label=lbl)
+    vp = tch.value_printer_evaluator(input=pred)
+    topo = Topology(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(15)
+    feed = {'x': rng.standard_normal((2, 4)).astype('float32'),
+            'label': rng.randint(0, 2, (2, 1)).astype('int64')}
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(topo.startup_program)
+        with fluid.program_guard(topo.main_program,
+                                 topo.startup_program):
+            vp_var = vp.to_fluid(topo._ctx)
+        v, = exe.run(topo.main_program, feed=feed, fetch_list=[vp_var])
+    assert np.isfinite(np.asarray(v)).all()
+    assert '[value_printer]' in capsys.readouterr().out
+
+
+def test_precision_recall_binary_mode_and_pnpair_single_var():
+    tch.settings(batch_size=6, learning_rate=0.01)
+    x = tch.data_layer(name='x', size=8)
+    pred = tch.fc_layer(input=x, size=3, act=tch.SoftmaxActivation())
+    lbl = tch.data_layer(name='label', size=3, data_type_kind='index')
+    cost = tch.classification_cost(input=pred, label=lbl)
+    pr_bin = tch.precision_recall_evaluator(input=pred, label=lbl,
+                                            positive_label=1)
+    topo = Topology(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(16)
+    feed = {'x': rng.standard_normal((6, 8)).astype('float32'),
+            'label': rng.randint(0, 3, (6, 1)).astype('int64')}
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(topo.startup_program)
+        with fluid.program_guard(topo.main_program,
+                                 topo.startup_program):
+            v_bin = pr_bin.to_fluid(topo._ctx)
+        bv, = exe.run(topo.main_program, feed=feed, fetch_list=[v_bin])
+    bv = np.asarray(bv)
+    assert bv.shape == (3, ) and ((0 <= bv) & (bv <= 1)).all()
+
+    # pnpair evaluator now returns ONE [3] fetchable var
+    tch.reset_config()
+    tch.settings(batch_size=3, learning_rate=0.01)
+    s = tch.data_layer(name='s', size=1)
+    l = tch.data_layer(name='l', size=1)
+    q = tch.data_layer(name='q', size=1, data_type_kind='index')
+    pn = tch.pnpair_evaluator(input=s, label=l, query_id=q)
+    cost2 = tch.sum_cost(input=tch.fc_layer(input=s, size=1))
+    topo2 = Topology(cost2)
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe2.run(topo2.startup_program)
+        with fluid.program_guard(topo2.main_program,
+                                 topo2.startup_program):
+            pn_var = pn.to_fluid(topo2._ctx)
+        v, = exe2.run(topo2.main_program,
+                      feed={'s': np.array([[0.9], [0.5], [0.1]], 'float32'),
+                            'l': np.array([[2.0], [1.0], [0.0]], 'float32'),
+                            'q': np.zeros((3, 1), 'int64')},
+                      fetch_list=[pn_var])
+    np.testing.assert_allclose(np.asarray(v), [3.0, 0.0, 0.0])
