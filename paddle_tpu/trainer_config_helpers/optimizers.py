@@ -10,7 +10,9 @@ from ..v2 import optimizer as _v2_opt
 __all__ = [
     'settings', 'get_settings', 'make_v2_optimizer', 'AdamOptimizer',
     'AdamaxOptimizer', 'MomentumOptimizer', 'RMSPropOptimizer',
-    'AdaGradOptimizer', 'BaseSGDOptimizer',
+    'AdaGradOptimizer', 'BaseSGDOptimizer', 'DecayedAdaGradOptimizer',
+    'AdaDeltaOptimizer', 'BaseRegularization', 'L2Regularization',
+    'ModelAverage', 'GradientClippingThreshold',
 ]
 
 _SETTINGS = {}
@@ -65,6 +67,62 @@ class AdaGradOptimizer(BaseSGDOptimizer):
         return _v2_opt.AdaGrad(learning_rate=learning_rate)
 
 
+class DecayedAdaGradOptimizer(BaseSGDOptimizer):
+    """(reference optimizers.py:235)"""
+
+    def __init__(self, rho=0.95, epsilon=1e-6, **kwargs):
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_v2(self, learning_rate):
+        return _v2_opt.DecayedAdaGrad(rho=self.rho, epsilon=self.epsilon,
+                                      learning_rate=learning_rate)
+
+
+class AdaDeltaOptimizer(BaseSGDOptimizer):
+    """(reference optimizers.py:263)"""
+
+    def __init__(self, rho=0.95, epsilon=1e-6, **kwargs):
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_v2(self, learning_rate):
+        return _v2_opt.AdaDelta(rho=self.rho, epsilon=self.epsilon,
+                                learning_rate=learning_rate)
+
+
+class BaseRegularization(object):
+    """(reference optimizers.py:294)"""
+
+    def __init__(self, rate=0.0):
+        self.rate = rate
+
+
+class L2Regularization(BaseRegularization):
+    """settings(regularization=L2Regularization(1e-4)) — forwarded into
+    the v2 optimizer's regularization slot."""
+
+
+class ModelAverage(object):
+    """(reference optimizers.py:319) — average_window config carried to
+    the v2 optimizer surface."""
+
+    def __init__(self, average_window, max_average_window=None, **kwargs):
+        self.average_window = average_window
+        self.max_average_window = max_average_window
+
+
+class GradientClippingThreshold(object):
+    """(reference optimizers.py:336) — records the global clipping
+    threshold; settings() already accepts
+    gradient_clipping_threshold=<float> directly, this object form is
+    the reference's extra_settings spelling."""
+
+    def __init__(self, threshold, **kwargs):
+        self.threshold = threshold
+
+    def __float__(self):
+        return float(self.threshold)
+
+
 def settings(batch_size,
              learning_rate=1e-3,
              learning_method=None,
@@ -77,6 +135,7 @@ def settings(batch_size,
         batch_size=batch_size,
         learning_rate=learning_rate,
         learning_method=learning_method,
+        regularization=regularization,
         gradient_clipping_threshold=gradient_clipping_threshold)
     _SETTINGS.update(kwargs)
 
@@ -87,9 +146,20 @@ def get_settings():
 
 def make_v2_optimizer():
     """The recorded settings as a v2 optimizer (SGD when no
-    learning_method was set)."""
+    learning_method was set).  A recorded ``regularization`` rides into
+    the v2 optimizer's regularization slot (L2Decay at the fluid
+    level)."""
     lr = _SETTINGS.get('learning_rate', 1e-3)
     method = _SETTINGS.get('learning_method')
-    if method is None:
-        return _v2_opt.Momentum(momentum=0.0, learning_rate=lr)
-    return method.to_v2(lr)
+    opt = (_v2_opt.Momentum(momentum=0.0, learning_rate=lr)
+           if method is None else method.to_v2(lr))
+    reg = _SETTINGS.get('regularization')
+    if reg is not None:
+        rate = getattr(reg, 'rate', None)
+        if rate is None:
+            raise TypeError(
+                'settings(regularization=...) expects an L2Regularization '
+                '(tch or v2 flavor, both carry .rate); got %r' % (reg, ))
+        if rate:
+            opt.kwargs['regularization'] = _v2_opt.L2Regularization(rate)
+    return opt

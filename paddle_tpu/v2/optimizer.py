@@ -70,6 +70,7 @@ class DecayedAdaGrad(Optimizer):
         return fluid.optimizer.DecayedAdagrad(
             learning_rate=self.kwargs.get('learning_rate', 0.001),
             decay=self.kwargs.get('rho', 0.95),
+            epsilon=self.kwargs.get('epsilon', 1e-6),
             regularization=self._regularization())
 
 

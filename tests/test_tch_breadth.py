@@ -502,3 +502,45 @@ def test_precision_recall_binary_mode_and_pnpair_single_var():
                             'q': np.zeros((3, 1), 'int64')},
                       fetch_list=[pn_var])
     np.testing.assert_allclose(np.asarray(v), [3.0, 0.0, 0.0])
+
+
+def test_optimizer_dsl_full_family_trains():
+    """Every legacy learning_method maps onto the executable stack."""
+    methods = [tch.MomentumOptimizer(momentum=0.9), tch.AdamOptimizer(),
+               tch.AdamaxOptimizer(), tch.RMSPropOptimizer(),
+               tch.AdaGradOptimizer(), tch.DecayedAdaGradOptimizer(),
+               tch.AdaDeltaOptimizer()]
+    rng = np.random.RandomState(17)
+    import paddle_tpu.v2 as paddle
+    for m in methods:
+        tch.reset_config()
+        tch.settings(batch_size=8, learning_rate=0.05, learning_method=m,
+                     regularization=tch.L2Regularization(1e-4),
+                     gradient_clipping_threshold=
+                     tch.GradientClippingThreshold(5.0))
+        x = tch.data_layer(name='x', size=6)
+        pred = tch.fc_layer(input=x, size=2,
+                            act=tch.SoftmaxActivation())
+        lbl = tch.data_layer(name='label', size=2,
+                             data_type_kind='index')
+        cost = tch.classification_cost(input=pred, label=lbl)
+        opt = tch.make_v2_optimizer()
+        # the recorded regularization must actually reach the optimizer
+        assert opt.kwargs['regularization'].rate == 1e-4
+        params = paddle.parameters.create(cost)
+        trainer = paddle.trainer.SGD(cost=cost, parameters=params,
+                                     update_equation=opt)
+        data = [(rng.standard_normal(6).astype('float32'), i % 2)
+                for i in range(16)]
+        seen = []
+
+        def on_event(event):
+            if isinstance(event, paddle.event.EndIteration):
+                seen.append(event.cost)
+
+        trainer.train(
+            reader=paddle.minibatch.batch(lambda: iter(data),
+                                          batch_size=8),
+            num_passes=2, event_handler=on_event,
+            feeding={'x': 0, 'label': 1})
+        assert seen and all(np.isfinite(c) for c in seen), type(m).__name__
