@@ -1,0 +1,140 @@
+"""PyDataProvider2: the legacy @provider data DSL (reference:
+python/paddle/trainer/PyDataProvider2.py:365 provider()).
+
+A config file decorates a generator::
+
+    @provider(input_types={'x': dense_vector(4), 'y': integer_value(2)})
+    def process(settings, file_name):
+        for line in open(file_name):
+            yield parse(line)
+
+The reference wrapped this into a C++-driven PyDataProvider2 object; here
+the decorated function becomes a ``DataProvider`` whose ``as_reader``
+yields per-sample tuples in input_types order — directly consumable by
+the v2 trainer / paddle_tpu.batch readers.  Shuffling honors
+``should_shuffle`` with a bounded pool like the reference's pool_size.
+"""
+
+import random
+
+from ..v2.data_type import (  # noqa: F401 — the legacy import surface
+    dense_vector, dense_vector_sequence, sparse_binary_vector,
+    sparse_float_vector, integer_value, integer_value_sequence,
+    sparse_binary_vector_sequence, sparse_float_vector_sequence,
+    InputType, DataType, SequenceType)
+
+__all__ = [
+    'provider', 'CacheType', 'dense_vector', 'dense_vector_sequence',
+    'sparse_binary_vector', 'sparse_float_vector', 'integer_value',
+    'integer_value_sequence', 'sparse_binary_vector_sequence',
+    'sparse_float_vector_sequence',
+]
+
+
+class CacheType(object):
+    NO_CACHE = 0
+    CACHE_PASS_IN_MEM = 1
+
+
+class DataProviderSettings(object):
+    """The ``settings`` object handed to the process function (the
+    reference stores input_types and init_hook state on it)."""
+
+    def __init__(self, input_types, **kwargs):
+        self.input_types = input_types
+        self.__dict__.update(kwargs)
+
+
+class DataProvider(object):
+    """Wrapped provider: call it with a file name (or use as_reader over
+    a file list) to iterate samples."""
+
+    def __init__(self, generator, input_types, should_shuffle, pool_size,
+                 cache, init_hook, kwargs):
+        self._generator = generator
+        self.input_types = input_types
+        self.should_shuffle = (True if should_shuffle is None
+                               else should_shuffle)
+        self.pool_size = pool_size
+        self.cache = cache
+        self.settings = DataProviderSettings(input_types)
+        if init_hook is not None:
+            init_hook(self.settings, **kwargs)
+        self._pass_cache = {}  # keyed by the file tuple (train != test)
+
+    def __call__(self, file_name, *args, **kwargs):
+        return self._generator(self.settings, file_name, *args, **kwargs)
+
+    def _ordered(self, sample):
+        if isinstance(sample, dict):
+            if not isinstance(self.input_types, dict):
+                raise TypeError(
+                    'provider yielded a dict but input_types is not a '
+                    'dict of layer-name -> InputType')
+            return tuple(sample[k] for k in self.input_types)
+        return tuple(sample) if isinstance(sample, (list, tuple)) \
+            else (sample, )
+
+    def as_reader(self, file_list, seed=0):
+        """A v2-style reader creator over the files (sample tuples in
+        input_types order; bounded shuffle pool per should_shuffle)."""
+
+        key = tuple(file_list)
+        pass_counter = [0]
+
+        def reader():
+            if self.cache == CacheType.CACHE_PASS_IN_MEM and \
+                    key in self._pass_cache:
+                samples = list(self._pass_cache[key])
+            else:
+                samples = []
+                for fname in file_list:
+                    for sample in self(fname):
+                        samples.append(self._ordered(sample))
+                if self.cache == CacheType.CACHE_PASS_IN_MEM:
+                    self._pass_cache[key] = list(samples)
+            if self.should_shuffle:
+                # a fresh permutation every pass (the reference
+                # reshuffles per pass), deterministic per (seed, pass)
+                rng = random.Random(seed * 1000003 + pass_counter[0])
+                pass_counter[0] += 1
+                if self.pool_size and self.pool_size > 0:
+                    # bounded pool shuffle (reference pool_size)
+                    pool = []
+                    out = []
+                    for s in samples:
+                        pool.append(s)
+                        if len(pool) >= self.pool_size:
+                            rng.shuffle(pool)
+                            out.extend(pool)
+                            pool = []
+                    rng.shuffle(pool)
+                    out.extend(pool)
+                    samples = out
+                else:
+                    rng.shuffle(samples)
+            for s in samples:
+                yield s
+
+        return reader
+
+
+def provider(input_types=None,
+             should_shuffle=None,
+             pool_size=-1,
+             min_pool_size=-1,
+             can_over_batch_size=True,
+             calc_batch_size=None,
+             cache=CacheType.NO_CACHE,
+             check=False,
+             check_fail_continue=False,
+             init_hook=None,
+             **outter_kwargs):
+    """(reference PyDataProvider2.py:365) Decorate a per-file sample
+    generator into a DataProvider."""
+
+    def decorate(fn):
+        return DataProvider(fn, input_types, should_shuffle, pool_size,
+                            cache, init_hook, outter_kwargs)
+
+    return decorate

@@ -1,0 +1,6 @@
+"""Legacy ``paddle.trainer`` package surface (reference:
+python/paddle/trainer/ — the config-parser generation).  Carries
+PyDataProvider2, the data-provider decorator DSL legacy config files
+import."""
+
+from . import PyDataProvider2  # noqa: F401

@@ -21,6 +21,7 @@ XLA program:
 """
 
 from .activations import *  # noqa: F401,F403
+from .data_sources import *  # noqa: F401,F403
 from .poolings import *  # noqa: F401,F403
 from .attrs import *  # noqa: F401,F403
 from .optimizers import *  # noqa: F401,F403
@@ -29,8 +30,8 @@ from .networks import *  # noqa: F401,F403
 from .evaluators import *  # noqa: F401,F403
 
 from . import activations, poolings, attrs, optimizers, layers, \
-    networks, evaluators
+    networks, evaluators, data_sources
 
 __all__ = (activations.__all__ + poolings.__all__ + attrs.__all__ +
            optimizers.__all__ + layers.__all__ + networks.__all__ +
-           evaluators.__all__)
+           evaluators.__all__ + data_sources.__all__)

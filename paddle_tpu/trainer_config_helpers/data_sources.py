@@ -1,0 +1,61 @@
+"""define_py_data_sources2 (reference:
+trainer_config_helpers/data_sources.py) — the config-file hook binding a
+PyDataProvider2 module/function to train/test file lists.
+
+The reference records a PyData proto block the trainer binary resolved
+at startup; here the binding resolves immediately to DataProvider-backed
+readers, exposed via ``get_data_sources()`` for the executable v2 flow
+(and recorded into settings for get_config() consumers)."""
+
+import importlib
+
+__all__ = ['define_py_data_sources2', 'get_data_sources']
+
+_DATA_SOURCES = {}
+
+
+def _load_file_list(file_list):
+    if isinstance(file_list, (list, tuple)):
+        return list(file_list)
+    with open(file_list) as f:
+        return [l.strip() for l in f if l.strip()]
+
+
+def _resolve(module, obj, args):
+    if isinstance(module, str):
+        module = importlib.import_module(module)
+    dp = getattr(module, obj) if isinstance(obj, str) else obj
+    # reference passes args through the init_hook kwargs; re-bind any
+    # the config supplies on top of what provider() bound
+    if args:
+        for k, v in args.items():
+            setattr(dp.settings, k, v)
+    return dp
+
+
+def define_py_data_sources2(train_list, test_list, module, obj,
+                            args=None):
+    """(reference data_sources.py define_py_data_sources2) Bind the
+    provider ``obj`` in ``module`` to the train/test file lists.
+
+    ``module`` may be a module object or import path; ``obj`` the
+    provider name (or the DataProvider itself).  ``train_list`` /
+    ``test_list`` are list files (one data path per line) or direct
+    lists of paths; either may be None."""
+    _DATA_SOURCES.clear()
+    for split, flist in (('train', train_list), ('test', test_list)):
+        if flist is None:
+            continue
+        dp = _resolve(module, obj, args)
+        _DATA_SOURCES[split] = dp.as_reader(_load_file_list(flist))
+
+
+def get_data_sources():
+    """{'train': reader, 'test': reader} bound by the last
+    define_py_data_sources2 call (the single source of truth —
+    get_config()'s settings dict does not duplicate it)."""
+    return dict(_DATA_SOURCES)
+
+
+def reset_data_sources():
+    _DATA_SOURCES.clear()

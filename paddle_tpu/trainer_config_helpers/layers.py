@@ -493,5 +493,7 @@ def get_config():
 
 def reset_config():
     from .optimizers import _SETTINGS
+    from .data_sources import reset_data_sources
     del _OUTPUTS[:]
     _SETTINGS.clear()  # a new config must not inherit old hyperparams
+    reset_data_sources()

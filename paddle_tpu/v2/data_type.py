@@ -10,40 +10,51 @@ class DataType(object):
     Index = 3
 
 
+class SequenceType(object):
+    NO_SEQUENCE = 0
+    SEQUENCE = 1
+    SUB_SEQUENCE = 2
+
+
 class InputType(object):
     def __init__(self, dim, seq_type, data_type):
         self.dim = dim
-        self.seq_type = seq_type  # 0 = no sequence, 1 = sequence
+        # SequenceType: NO_SEQUENCE=0, SEQUENCE=1, SUB_SEQUENCE=2
+        self.seq_type = seq_type
         self.type = data_type
 
 
 def dense_vector(dim):
-    return InputType(dim, 0, DataType.Dense)
+    return InputType(dim, SequenceType.NO_SEQUENCE, DataType.Dense)
 
 
 def dense_array(dim):
-    return InputType(dim, 0, DataType.Dense)
+    return InputType(dim, SequenceType.NO_SEQUENCE, DataType.Dense)
 
 
 def dense_vector_sequence(dim):
-    return InputType(dim, 1, DataType.Dense)
+    return InputType(dim, SequenceType.SEQUENCE, DataType.Dense)
 
 
 def integer_value(value_range):
-    return InputType(value_range, 0, DataType.Index)
+    return InputType(value_range, SequenceType.NO_SEQUENCE, DataType.Index)
 
 
 def integer_value_sequence(value_range):
-    return InputType(value_range, 1, DataType.Index)
+    return InputType(value_range, SequenceType.SEQUENCE, DataType.Index)
 
 
 def sparse_binary_vector(dim):
-    return InputType(dim, 0, DataType.SparseNonValue)
+    return InputType(dim, SequenceType.NO_SEQUENCE, DataType.SparseNonValue)
 
 
 def sparse_float_vector(dim):
-    return InputType(dim, 0, DataType.SparseValue)
+    return InputType(dim, SequenceType.NO_SEQUENCE, DataType.SparseValue)
+
+
+def sparse_float_vector_sequence(dim):
+    return InputType(dim, SequenceType.SEQUENCE, DataType.SparseValue)
 
 
 def sparse_binary_vector_sequence(dim):
-    return InputType(dim, 1, DataType.SparseNonValue)
+    return InputType(dim, SequenceType.SEQUENCE, DataType.SparseNonValue)

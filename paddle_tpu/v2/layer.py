@@ -68,6 +68,11 @@ class Layer(object):
 def data(name, type, **kwargs):
     """Input declaration (reference layer.py data / data_layer)."""
     t = type
+    if getattr(t, 'seq_type', 0) == 2:
+        raise NotImplementedError(
+            'SUB_SEQUENCE (nested lod_level=2) inputs are not supported '
+            'by the v2 shim - flatten to SEQUENCE or use the fluid API '
+            'with lod_level=2 where the op supports it')
 
     def build(ctx):
         if t.type == _data_type.DataType.Index:
