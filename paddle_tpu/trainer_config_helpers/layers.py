@@ -33,6 +33,7 @@ __all__ = [
     'prelu_layer', 'crop_layer', 'sub_seq_layer', 'kmax_seq_score_layer',
     'linear_comb_layer', 'convex_comb_layer', 'tensor_layer',
     'conv_shift_layer', 'scale_shift_layer', 'gated_unit_layer',
+    'roi_pool_layer', 'priorbox_layer', 'cross_channel_norm_layer',
     # mixed + projections
     'mixed_layer', 'full_matrix_projection',
     'trans_full_matrix_projection', 'identity_projection',
@@ -338,6 +339,29 @@ def scale_shift_layer(input, name=None, **kwargs):
 
 def gated_unit_layer(input, size, name=None, **kwargs):
     return _v2.gated_unit(input=input, size=size, name=name)
+
+
+def roi_pool_layer(input, rois, pooled_width, pooled_height,
+                   spatial_scale=1.0, name=None, **kwargs):
+    return _v2.roi_pool(input=input, rois=rois,
+                        pooled_width=pooled_width,
+                        pooled_height=pooled_height,
+                        spatial_scale=spatial_scale, name=name)
+
+
+def priorbox_layer(input, image, min_size, max_size=None,
+                   aspect_ratio=None, variance=None, num_channels=3,
+                   name=None, **kwargs):
+    return _v2.priorbox(input=input, image=image, min_sizes=min_size,
+                        max_sizes=max_size, aspect_ratios=aspect_ratio,
+                        variance=variance, num_channels=num_channels,
+                        name=name)
+
+
+def cross_channel_norm_layer(input, num_channels=None, name=None,
+                             **kwargs):
+    return _v2.cross_channel_norm(input=input, num_channels=num_channels,
+                                  name=name)
 
 
 # ---- mixed + projections ----

@@ -35,7 +35,7 @@ __all__ = [
     # second tail batch
     'prelu', 'crop', 'sub_seq', 'kmax_seq_score', 'linear_comb',
     'convex_comb', 'tensor_product', 'conv_shift', 'scale_shift',
-    'gated_unit',
+    'gated_unit', 'roi_pool', 'priorbox', 'cross_channel_norm',
 ]
 
 
@@ -1107,3 +1107,66 @@ def gated_unit(input, size, name=None, **kwargs):
         return fluid.layers.elementwise_mul(a, g)
 
     return Layer('gated_unit', [input], build, name=name, size=size)
+
+
+# ---- detection-flavored legacy kinds (over the fluid detection stack) ----
+def roi_pool(input, rois, pooled_width, pooled_height, spatial_scale=1.0,
+             name=None, **kwargs):
+    """(reference roi_pool_layer -> operators/roi_pool_op.cc)"""
+
+    def build(ctx, v, rv):
+        return fluid.layers.roi_pool(
+            v, rv, pooled_height=pooled_height, pooled_width=pooled_width,
+            spatial_scale=spatial_scale)
+
+    return Layer('roi_pool', [input, rois], build, name=name)
+
+
+def priorbox(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
+             variance=None, num_channels=3, name=None, **kwargs):
+    """(reference priorbox_layer -> operators/detection/prior_box_op.cc);
+    returns the [H*W*P, 4] boxes (variances ride the ctx under
+    '<name>@variances' for get_output-style access)."""
+    layer_box = []
+
+    def build(ctx, v, img):
+        if len(img.shape) == 2:
+            c = num_channels or 1
+            hw = int(round((image.size // c) ** 0.5))
+            img = fluid.layers.reshape(img, shape=[-1, c, hw, hw])
+        # fluid.prior_box owns list coercion and the reference defaults
+        box_kwargs = {'min_sizes': min_sizes}
+        if max_sizes is not None:
+            box_kwargs['max_sizes'] = max_sizes
+        if aspect_ratios is not None:
+            box_kwargs['aspect_ratios'] = aspect_ratios
+        if variance is not None:
+            box_kwargs['variance'] = variance
+        boxes, variances = fluid.layers.prior_box(v, img, **box_kwargs)
+        ctx['%s@variances' % layer_box[0].name] = variances
+        return boxes
+
+    layer = Layer('priorbox', [input, image], build, name=name)
+    layer_box.append(layer)
+    return layer
+
+
+def cross_channel_norm(input, num_channels=None, name=None, **kwargs):
+    """Per-position L2 normalization across channels with a LEARNED
+    per-channel scale (reference CrossChannelNormLayer, the SSD conv4_3
+    norm — scale conventionally initialized to 20)."""
+
+    def build(ctx, v):
+        if len(v.shape) == 2:
+            c = num_channels or 1
+            hw = int(round((input.size // c) ** 0.5))
+            v = fluid.layers.reshape(v, shape=[-1, c, hw, hw])
+        normed = fluid.layers.l2_normalize(v, axis=1)
+        c_dim = int(v.shape[1])
+        scale = fluid.layers.create_parameter(
+            shape=[c_dim], dtype='float32',
+            default_initializer=fluid.initializer.Constant(20.0))
+        return fluid.layers.elementwise_mul(normed, scale, axis=1)
+
+    return Layer('cross_channel_norm', [input], build, name=name,
+                 size=input.size)

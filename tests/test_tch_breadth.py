@@ -544,3 +544,65 @@ def test_optimizer_dsl_full_family_trains():
             num_passes=2, event_handler=on_event,
             feeding={'x': 0, 'label': 1})
         assert seen and all(np.isfinite(c) for c in seen), type(m).__name__
+
+
+def test_detection_flavored_builders():
+    """roi_pool / priorbox / cross_channel_norm legacy builders over the
+    fluid detection stack."""
+    tch.settings(batch_size=2, learning_rate=0.01)
+    img = tch.data_layer(name='img', size=3 * 16 * 16)
+    conv = tch.img_conv_layer(input=img, filter_size=3, num_filters=4,
+                              num_channels=3, padding=1,
+                              act=tch.ReluActivation())
+    norm = tch.cross_channel_norm_layer(input=conv)
+    # the learned scale initializes to the SSD convention (20): outputs
+    # are ~20x the plain l2_normalize
+    cost = tch.sum_cost(input=tch.fc_layer(input=norm, size=2))
+    rng = np.random.RandomState(18)
+    feed = {'img': rng.standard_normal((2, 768)).astype('float32')}
+    vals = _run_cost(cost, feed, steps=1)
+    assert np.isfinite(vals).all()
+
+    # priorbox: boxes over a 4x4 feature map of a 16x16 image
+    tch.reset_config()
+    tch.settings(batch_size=1, learning_rate=0.01)
+    im = tch.data_layer(name='im', size=3 * 16 * 16)
+    conv2 = tch.img_conv_layer(input=im, filter_size=3, num_filters=4,
+                               num_channels=3, padding=1, stride=4)
+    pb = tch.priorbox_layer(input=conv2, image=im, min_size=[4.0],
+                            max_size=[8.0], aspect_ratio=[2.0])
+    cost2 = tch.sum_cost(input=pb)
+    topo = Topology(cost2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(topo.startup_program)
+        v, = exe.run(topo.main_program,
+                     feed={'im': rng.standard_normal((1, 768)).astype(
+                         'float32')},
+                     fetch_list=[topo._ctx[pb.name]])
+    boxes = np.asarray(v)
+    assert boxes.shape[-1] == 4 and np.isfinite(boxes).all()
+
+    # roi_pool: pool two rois out of the conv map
+    tch.reset_config()
+    tch.settings(batch_size=1, learning_rate=0.01)
+    im3 = tch.data_layer(name='im3', size=3 * 16 * 16)
+    feat = tch.img_conv_layer(input=im3, filter_size=3, num_filters=4,
+                              num_channels=3, padding=1)
+    rois = tch.data_layer(name='rois', size=4)
+    rp = tch.roi_pool_layer(input=feat, rois=rois, pooled_width=2,
+                            pooled_height=2, spatial_scale=1.0)
+    cost3 = tch.sum_cost(input=rp)
+    topo3 = Topology(cost3)
+    exe3 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe3.run(topo3.startup_program)
+        v3, = exe3.run(topo3.main_program,
+                       feed={'im3': rng.standard_normal((1, 768)).astype(
+                           'float32'),
+                           'rois': np.array([[0, 0, 7, 7],
+                                             [4, 4, 15, 15]],
+                                            'float32')},
+                       fetch_list=[topo3._ctx[rp.name]])
+    pooled = np.asarray(v3)
+    assert pooled.shape[-2:] == (2, 2) and np.isfinite(pooled).all()
