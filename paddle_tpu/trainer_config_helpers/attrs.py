@@ -3,7 +3,23 @@ Accepted for config compatibility; placement/regularization decisions
 belong to the XLA stack."""
 
 __all__ = ['ParamAttr', 'ParameterAttribute', 'ExtraAttr',
-           'ExtraLayerAttribute']
+           'ExtraLayerAttribute', 'HookAttr', 'HookAttribute']
+
+
+class HookAttribute(object):
+    """Parameter hook config (reference attrs.py:59 — pruning masks
+    etc.).  Recorded for config compatibility; static mask pruning has
+    no training-time effect under XLA's dense kernels, so hooks are
+    carried as inert metadata (documented delta)."""
+
+    def __init__(self, type, sparsity_ratio=None, **kwargs):
+        self.type = type
+        self.sparsity_ratio = sparsity_ratio
+        if sparsity_ratio is not None and not 0.0 <= sparsity_ratio <= 1.0:
+            raise ValueError('sparsity_ratio must be within [0, 1]')
+
+
+HookAttr = HookAttribute
 
 
 class ParameterAttribute(object):
