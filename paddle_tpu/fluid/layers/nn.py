@@ -917,6 +917,13 @@ def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
 def pad(x, paddings, pad_value=0.0, name=None):
     helper = LayerHelper('pad', **locals())
     out = helper.create_variable_for_type_inference(x.dtype)
+    if getattr(x, 'shape', None):
+        shape = list(x.shape)
+        for i in range(min(len(shape), len(paddings) // 2)):
+            if shape[i] is not None and int(shape[i]) >= 0:
+                shape[i] = int(shape[i]) + paddings[2 * i] + \
+                    paddings[2 * i + 1]
+        out.shape = tuple(shape)
     helper.append_op(
         type='pad',
         inputs={'X': [x]},

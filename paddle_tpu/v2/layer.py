@@ -36,6 +36,11 @@ __all__ = [
     'prelu', 'crop', 'sub_seq', 'kmax_seq_score', 'linear_comb',
     'convex_comb', 'tensor_product', 'conv_shift', 'scale_shift',
     'gated_unit', 'roi_pool', 'priorbox', 'cross_channel_norm',
+    # third tail batch
+    'resize', 'row_l2_norm', 'switch_order', 'upsample', 'spp',
+    'recurrent', 'img_conv3d', 'img_pool3d', 'factorization_machine',
+    'scaling_projection', 'slice_projection', 'dotmul_operator',
+    'detection_output',
 ]
 
 
@@ -1170,3 +1175,223 @@ def cross_channel_norm(input, num_channels=None, name=None, **kwargs):
 
     return Layer('cross_channel_norm', [input], build, name=name,
                  size=input.size)
+
+
+# ---- third tail batch (closing the reference layers.py inventory) ----
+def resize(input, size, name=None, **kwargs):
+    """Re-chunk rows to width ``size`` (reference resize_layer: a [B, N]
+    batch becomes [B*N/size, size])."""
+
+    def build(ctx, v):
+        return fluid.layers.reshape(v, shape=[-1, int(size)])
+
+    return Layer('resize', [input], build, name=name, size=size)
+
+
+def row_l2_norm(input, name=None, **kwargs):
+    """x / ||x||_2 per row (reference row_l2_norm_layer)."""
+
+    def build(ctx, v):
+        return fluid.layers.l2_normalize(v, axis=-1)
+
+    return Layer('row_l2_norm', [input], build, name=name,
+                 size=input.size)
+
+
+def switch_order(input, reshape_from='NCHW', reshape_to='NHWC',
+                 name=None, **kwargs):
+    """Permute image dims (reference switch_order_layer)."""
+    perm = {'NCHW': {'NHWC': [0, 2, 3, 1]},
+            'NHWC': {'NCHW': [0, 3, 1, 2]}}[reshape_from][reshape_to]
+
+    def build(ctx, v):
+        return fluid.layers.transpose(v, perm=perm)
+
+    return Layer('switch_order', [input], build, name=name)
+
+
+def upsample(input, scale=2, upsample_mode='nearest', name=None,
+             **kwargs):
+    """Integer-factor spatial upsampling (reference upsample_layer)."""
+
+    def build(ctx, v):
+        h, w = int(v.shape[2]), int(v.shape[3])
+        return fluid.layers.image_resize(
+            v, out_shape=[h * int(scale), w * int(scale)],
+            resample='NEAREST' if upsample_mode == 'nearest'
+            else 'BILINEAR')
+
+    return Layer('upsample', [input], build, name=name)
+
+
+def spp(input, pyramid_height=2, pool_type=None, name=None, **kwargs):
+    """Spatial pyramid pooling (reference spp_layer /
+    operators/spp_op.cc): pool at pyramid levels 0..H-1 into exactly
+    4^l bins each (padding up to a bin multiple first, as the
+    reference's padded pooling does), concatenated per channel."""
+    ptype = (pool_type.name if pool_type is not None else 'max')
+
+    def build(ctx, v):
+        c, h, w = int(v.shape[1]), int(v.shape[2]), int(v.shape[3])
+        parts = []
+        for level in range(int(pyramid_height)):
+            bins = 2 ** level
+            ph = bins * (-(-h // bins))  # pad to a bin multiple
+            pw = bins * (-(-w // bins))
+            vv = v
+            if (ph, pw) != (h, w):
+                vv = fluid.layers.pad(
+                    v, paddings=[0, 0, 0, 0, 0, ph - h, 0, pw - w])
+            pooled = fluid.layers.pool2d(
+                vv, pool_size=[ph // bins, pw // bins], pool_type=ptype,
+                pool_stride=[ph // bins, pw // bins])
+            parts.append(fluid.layers.reshape(pooled, shape=[-1, c *
+                                                             bins * bins]))
+        return fluid.layers.concat(parts, axis=1)
+
+    return Layer('spp', [input], build, name=name)
+
+
+def recurrent(input, size=None, act=None, reverse=False, name=None,
+              **kwargs):
+    """Plain full-matrix recurrence out_t = act(in_t + out_{t-1} W)
+    (reference recurrent_layer) — expressed through the recurrent_group
+    step DSL over the fluid scan (state update by the memory's
+    name-match contract)."""
+    if reverse:
+        raise NotImplementedError(
+            'recurrent_layer(reverse=True): wrap the input with a '
+            'reversed lstmemory/gru instead — recurrent_group scans '
+            'forward')
+    width = size or input.size
+    if input.size is not None and width != input.size:
+        raise ValueError(
+            'recurrent_layer: the reference recurrence is out_t = '
+            'act(in_t + out_(t-1) W), so input width (%r) must equal '
+            'size (%r) — project with fc_layer first' %
+            (input.size, width))
+    state = '%s@state' % (name or 'recurrent_%d' % (Layer._counter[0], ))
+    from .activation import Tanh
+
+    def step(ipt):
+        mem = memory(name=state, size=width)
+        # reference math exactly: in_t enters UNPROJECTED; only the
+        # carried state passes through the weight (+ the layer bias)
+        rec = fc(input=mem, size=width)
+        return addto(input=[ipt, rec], act=act or Tanh(), name=state)
+
+    out = recurrent_group(step=step, input=input, name=name)
+    out.size = width
+    return out
+
+
+def img_conv3d(input, filter_size, num_filters, num_channels=None,
+               stride=1, padding=0, act=None, name=None, **kwargs):
+    def build(ctx, v):
+        if len(v.shape) == 2:
+            # flat legacy volume feeds recover [B, C, D, H, W] via
+            # num_channels + a cubic spatial extent (img_conv's 2-D
+            # convention, one rank up)
+            c = num_channels or 1
+            side = int(round((input.size // c) ** (1.0 / 3.0)))
+            v = fluid.layers.reshape(
+                v, shape=[-1, c, side, side, side])
+        return fluid.layers.conv3d(
+            v, num_filters=num_filters, filter_size=filter_size,
+            stride=stride, padding=padding, act=_act_name(act))
+
+    return Layer('img_conv3d', [input], build, name=name,
+                 size=num_filters)
+
+
+def img_pool3d(input, pool_size, stride=1, padding=0, pool_type=None,
+               name=None, **kwargs):
+    ptype = (pool_type or _MaxPool()).name
+
+    def build(ctx, v):
+        return fluid.layers.pool3d(
+            v, pool_size=pool_size, pool_type=ptype, pool_stride=stride,
+            pool_padding=padding)
+
+    return Layer('img_pool3d', [input], build, name=name)
+
+
+def factorization_machine(input, factor_size, name=None, **kwargs):
+    """Second-order FM interaction term (reference
+    factorization_machine layer): 0.5 * sum((xV)^2 - (x^2)(V^2))."""
+
+    def build(ctx, v):
+        n = input.size
+        vmat = fluid.layers.create_parameter(
+            shape=[n, int(factor_size)], dtype='float32')
+        xv = fluid.layers.matmul(v, vmat)                   # [B, k]
+        x2v2 = fluid.layers.matmul(
+            fluid.layers.square(v), fluid.layers.square(vmat))
+        return fluid.layers.scale(
+            fluid.layers.reduce_sum(
+                fluid.layers.elementwise_sub(
+                    fluid.layers.square(xv), x2v2),
+                dim=1, keep_dim=True),
+            scale=0.5)
+
+    return Layer('factorization_machine', [input], build, name=name,
+                 size=1)
+
+
+def scaling_projection(input, **kwargs):
+    """w * x with one learned scalar (reference scaling_projection)."""
+
+    def term(v):
+        w = fluid.layers.create_parameter(shape=[1], dtype='float32')
+        return fluid.layers.elementwise_mul(v, w, axis=0)
+
+    return _Projection(input, term, size=input.size)
+
+
+def slice_projection(input, slices, **kwargs):
+    """Column slices of the input concatenated (reference
+    slice_projection; slices = [(start, end), ...])."""
+    width = sum(e - s for s, e in slices)
+
+    def term(v):
+        parts = [fluid.layers.slice(v, axes=[1], starts=[s], ends=[e])
+                 for s, e in slices]
+        return parts[0] if len(parts) == 1 else fluid.layers.concat(
+            parts, axis=1)
+
+    return _Projection(input, term, size=width)
+
+
+def dotmul_operator(a, b, scale=1.0, **kwargs):
+    """Elementwise scale*a*b mixed-layer term (reference
+    dotmul_operator — a two-input operator): expressed as an identity
+    projection of a hidden product node, so mixed()'s one-parent-per-
+    term contract holds."""
+    prod = Layer(
+        'dotmul_op', [a, b],
+        lambda ctx, va, vb: fluid.layers.scale(
+            fluid.layers.elementwise_mul(va, vb), scale=float(scale)),
+        size=a.size)
+    return identity_projection(prod)
+
+
+def detection_output(loc, conf, priorbox_layer_out, num_classes,
+                     nms_threshold=0.45, name=None, **kwargs):
+    """SSD decode + NMS (reference detection_output_layer ->
+    operators/detection/detection_output).  Flat conv outputs reshape
+    to the [N, P, 4] / [N, P, C] layout fluid.detection_output expects
+    (num_classes sizes the score reshape)."""
+
+    def build(ctx, loc_v, conf_v, pb_v):
+        variances = ctx.get('%s@variances' % priorbox_layer_out.name)
+        if len(loc_v.shape) == 2:
+            loc_v = fluid.layers.reshape(loc_v, shape=[0, -1, 4])
+        if len(conf_v.shape) == 2:
+            conf_v = fluid.layers.reshape(
+                conf_v, shape=[0, -1, int(num_classes)])
+        return fluid.layers.detection_output(
+            loc_v, conf_v, pb_v, variances,
+            nms_threshold=nms_threshold)
+
+    return Layer('detection_output', [loc, conf, priorbox_layer_out],
+                 build, name=name)

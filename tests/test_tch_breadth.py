@@ -606,3 +606,108 @@ def test_detection_flavored_builders():
                        fetch_list=[topo3._ctx[rp.name]])
     pooled = np.asarray(v3)
     assert pooled.shape[-2:] == (2, 2) and np.isfinite(pooled).all()
+
+
+def test_third_tail_batch_builders():
+    """resize/row_l2_norm/switch_order/upsample/spp/fm/scaling+slice
+    projections/dotmul_operator through one forward."""
+    tch.settings(batch_size=2, learning_rate=0.01)
+    x = tch.data_layer(name='x', size=12)
+    rl = tch.row_l2_norm_layer(input=x)
+    rs = tch.resize_layer(input=x, size=6)
+    fm = tch.factorization_machine(input=x, factor_size=4)
+    mix = tch.mixed_layer(
+        size=12,
+        input=[tch.scaling_projection(input=x),
+               tch.slice_projection(input=x, slices=[(0, 6), (6, 12)]),
+               tch.dotmul_operator(a=x, b=x)])
+    cost = tch.sum_cost(input=tch.concat_layer(input=[rl, fm, mix]))
+
+    rng = np.random.RandomState(19)
+    feed = {'x': rng.standard_normal((2, 12)).astype('float32')}
+    vals = _run_cost(cost, feed, steps=2)
+    assert np.isfinite(vals).all()
+
+    # resize reshapes [2,12] -> [4,6]
+    topo = Topology(tch.sum_cost(input=rs))
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(topo.startup_program)
+        v, = exe.run(topo.main_program, feed=feed,
+                     fetch_list=[topo._ctx[rs.name]])
+    assert np.asarray(v).shape == (4, 6)
+
+
+def test_third_batch_image_builders():
+    tch.settings(batch_size=2, learning_rate=0.01)
+    img = tch.data_layer(name='img', size=3 * 8 * 8)
+    conv = tch.img_conv_layer(input=img, filter_size=3, num_filters=4,
+                              num_channels=3, padding=1)
+    so = tch.switch_order_layer(input=conv)
+    up = tch.upsample_layer(input=conv, scale=2)
+    sp = tch.spp_layer(input=conv, pyramid_height=2)
+    rng = np.random.RandomState(20)
+    feed = {'img': rng.standard_normal((2, 192)).astype('float32')}
+    # non-divisible spp: 8x8 map at pyramid_height=3 pads 8->8 (l2: 4
+    # bins of 2) but a 6x6 conv map needs padding at level 2
+    conv6 = tch.img_conv_layer(input=img, filter_size=3, num_filters=4,
+                               num_channels=3, padding=0)  # 6x6
+    sp6 = tch.spp_layer(input=conv6, pyramid_height=3)
+    for lyr, want_shape in ((so, (2, 8, 8, 4)), (up, (2, 4, 16, 16)),
+                            (sp, (2, 4 * (1 + 4))),
+                            (sp6, (2, 4 * (1 + 4 + 16)))):
+        tch.reset_config()
+        tch.settings(batch_size=2, learning_rate=0.01)
+        topo = Topology(tch.sum_cost(input=lyr))
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.core.Scope()):
+            exe.run(topo.startup_program)
+            v, = exe.run(topo.main_program, feed=feed,
+                         fetch_list=[topo._ctx[lyr.name]])
+        assert np.asarray(v).shape == want_shape, (
+            lyr.kind, np.asarray(v).shape, want_shape)
+
+
+def test_recurrent_layer_trains():
+    tch.settings(batch_size=4, learning_rate=0.05)
+    words = tch.data_layer(name='words', size=20, data_type_kind='index',
+                           seq=True)
+    emb = tch.embedding_layer(input=words, size=8)
+    rnn = tch.recurrent_layer(input=emb, size=8)  # ref: in width == size
+    assert rnn.size == 8
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        tch.recurrent_layer(input=emb, size=10)
+    pooled = tch.pooling_layer(input=rnn, pooling_type=tch.MaxPooling())
+    pred = tch.fc_layer(input=pooled, size=2,
+                        act=tch.SoftmaxActivation())
+    lbl = tch.data_layer(name='label', size=2, data_type_kind='index')
+    cost = tch.classification_cost(input=pred, label=lbl)
+    rng = np.random.RandomState(21)
+    feed = {'words': _lod_ids(rng, 20, (3, 5, 2, 4)),
+            'label': rng.randint(0, 2, (4, 1)).astype('int64')}
+    vals = _run_cost(cost, feed, steps=4)
+    assert np.isfinite(vals).all()
+
+
+def test_conv3d_builders_run():
+    tch.settings(batch_size=1, learning_rate=0.01)
+    vol = tch.data_layer(name='vol', size=2 * 6 * 6 * 6)
+
+    # flat volume feeds aren't auto-reshaped (only 2D images are);
+    # build on the fluid var level through the v2 node
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu.v2 import layer as v2l
+
+    def reshape_build(ctx, v):
+        return fluid.layers.reshape(v, shape=[-1, 2, 6, 6, 6])
+
+    vol4d = v2l.Layer('reshape_vol', [vol], reshape_build, size=2)
+    c3 = tch.img_conv3d_layer(input=vol4d, filter_size=3, num_filters=3,
+                              padding=1)
+    p3 = tch.img_pool3d_layer(input=c3, pool_size=2, stride=2)
+    cost = tch.sum_cost(input=p3)
+    rng = np.random.RandomState(22)
+    feed = {'vol': rng.standard_normal((1, 432)).astype('float32')}
+    vals = _run_cost(cost, feed, steps=1)
+    assert np.isfinite(vals).all()
