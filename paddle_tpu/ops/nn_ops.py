@@ -602,3 +602,24 @@ def _spp(ctx, op):
                              axis=(4, 5)) / jnp.maximum(cnt[None, None], 1)
         outs.append(pooled.reshape(n, -1))
     ctx.set(op, 'Out', jnp.concatenate(outs, axis=1))
+
+
+@register_lowering('scale_sub_region')
+def _scale_sub_region(ctx, op):
+    """Scale values inside per-sample [C,H,W] index boxes (reference
+    legacy ScaleSubRegionLayer / operators/scale_sub_region via the v2
+    builder): indices rows are 1-based inclusive
+    [c0, c1, h0, h1, w0, w1]."""
+    x = ctx.get(op, 'X')  # [B, C, H, W]
+    idx = ctx.get(op, 'Indices').astype(jnp.int32)  # [B, 6]
+    value = float(op.attrs.get('value', 1.0))
+    b, c, h, w = x.shape
+    cs = jnp.arange(c)[None, :, None, None]
+    hs = jnp.arange(h)[None, None, :, None]
+    ws = jnp.arange(w)[None, None, None, :]
+    lo = lambda col: (idx[:, col] - 1)[:, None, None, None]
+    hi = lambda col: idx[:, col][:, None, None, None]
+    mask = ((cs >= lo(0)) & (cs < hi(1)) &
+            (hs >= lo(2)) & (hs < hi(3)) &
+            (ws >= lo(4)) & (ws < hi(5)))
+    ctx.set(op, 'Out', jnp.where(mask, x * value, x))

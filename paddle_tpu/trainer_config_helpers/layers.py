@@ -39,7 +39,8 @@ __all__ = [
     'upsample_layer', 'spp_layer', 'recurrent_layer',
     'img_conv3d_layer', 'img_pool3d_layer', 'factorization_machine',
     'scaling_projection', 'slice_projection', 'dotmul_operator',
-    'detection_output_layer', 'multibox_loss_layer', 'square_error_cost',
+    'detection_output_layer', 'multibox_loss_layer',
+    'scale_sub_region_layer', 'square_error_cost',
     'printer_layer', 'gru_step_naive_layer', 'seq_slice_layer',
     'layer_support',
     # mixed + projections
@@ -640,3 +641,10 @@ square_error_cost = regression_cost
 printer_layer = print_layer
 gru_step_naive_layer = gru_step_layer
 seq_slice_layer = sub_seq_layer
+
+
+def scale_sub_region_layer(input, indices, value=1.0, num_channels=None,
+                           name=None, **kwargs):
+    return _v2.scale_sub_region(input=input, indices=indices,
+                                value=value, num_channels=num_channels,
+                                name=name)

@@ -40,7 +40,7 @@ __all__ = [
     'resize', 'row_l2_norm', 'switch_order', 'upsample', 'spp',
     'recurrent', 'img_conv3d', 'img_pool3d', 'factorization_machine',
     'scaling_projection', 'slice_projection', 'dotmul_operator',
-    'detection_output',
+    'detection_output', 'scale_sub_region',
 ]
 
 
@@ -93,6 +93,26 @@ def data(name, type, **kwargs):
     return layer
 
 
+
+
+def _reshape_to_nchw(v, flat_size, num_channels, who):
+    """Recover [B, C, H, W] from a flat legacy feed (the config_parser
+    height/width convention: square spatial extent).  Validates the
+    square assumption instead of silently mis-shaping."""
+    c = num_channels or 1
+    if flat_size is None or flat_size % c:
+        raise ValueError(
+            '%s: input size %r is not divisible by num_channels %r' %
+            (who, flat_size, c))
+    hw = int(round((flat_size // c) ** 0.5))
+    if hw * hw * c != flat_size:
+        raise ValueError(
+            '%s: input size %r with num_channels %r is not a square '
+            'image (inferred side %r); reshape explicitly for '
+            'non-square inputs' % (who, flat_size, c, hw))
+    return fluid.layers.reshape(v, shape=[-1, c, hw, hw])
+
+
 def _act_name(act):
     if act is None:
         return None
@@ -134,13 +154,9 @@ def img_conv(input, filter_size, num_filters, num_channels=None, stride=1,
         a = _act_name(act)
         v = parent_var
         if len(v.shape) == 2:
-            # legacy configs feed images as flat dense vectors; recover
-            # [B, C, H, W] from num_channels + a square spatial extent
-            # (the reference config_parser did the same with the data
-            # layer's height/width fields)
-            c = num_channels or 1
-            hw = int(round((input.size // c) ** 0.5))
-            v = fluid.layers.reshape(v, shape=[-1, c, hw, hw])
+            # legacy configs feed images as flat dense vectors (the
+            # reference config_parser recovered geometry the same way)
+            v = _reshape_to_nchw(v, input.size, num_channels, 'img_conv')
         return fluid.layers.conv2d(
             v, num_filters=num_filters, filter_size=filter_size,
             stride=stride, padding=padding, act=a)
@@ -1136,9 +1152,8 @@ def priorbox(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
 
     def build(ctx, v, img):
         if len(img.shape) == 2:
-            c = num_channels or 1
-            hw = int(round((image.size // c) ** 0.5))
-            img = fluid.layers.reshape(img, shape=[-1, c, hw, hw])
+            img = _reshape_to_nchw(img, image.size, num_channels,
+                                   'priorbox')
         # fluid.prior_box owns list coercion and the reference defaults
         box_kwargs = {'min_sizes': min_sizes}
         if max_sizes is not None:
@@ -1163,9 +1178,8 @@ def cross_channel_norm(input, num_channels=None, name=None, **kwargs):
 
     def build(ctx, v):
         if len(v.shape) == 2:
-            c = num_channels or 1
-            hw = int(round((input.size // c) ** 0.5))
-            v = fluid.layers.reshape(v, shape=[-1, c, hw, hw])
+            v = _reshape_to_nchw(v, input.size, num_channels,
+                                 'cross_channel_norm')
         normed = fluid.layers.l2_normalize(v, axis=1)
         c_dim = int(v.shape[1])
         scale = fluid.layers.create_parameter(
@@ -1395,3 +1409,28 @@ def detection_output(loc, conf, priorbox_layer_out, num_classes,
 
     return Layer('detection_output', [loc, conf, priorbox_layer_out],
                  build, name=name)
+
+
+def scale_sub_region(input, indices, value=1.0, num_channels=None,
+                     name=None, **kwargs):
+    """Scale values inside per-sample [C, H, W] boxes (reference
+    scale_sub_region_layer; indices rows are 1-based inclusive
+    [c0, c1, h0, h1, w0, w1])."""
+
+    def build(ctx, v, iv):
+        if len(v.shape) == 2:
+            v = _reshape_to_nchw(v, input.size, num_channels,
+                                 'scale_sub_region')
+        from ..fluid.layer_helper import LayerHelper
+        helper = LayerHelper('scale_sub_region')
+        out = helper.create_variable_for_type_inference(dtype=v.dtype)
+        out.shape = v.shape
+        helper.append_op(
+            type='scale_sub_region',
+            inputs={'X': [v], 'Indices': [iv]},
+            outputs={'Out': [out]},
+            attrs={'value': float(value)})
+        return out
+
+    return Layer('scale_sub_region', [input, indices], build, name=name,
+                 size=input.size)

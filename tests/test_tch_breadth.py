@@ -711,3 +711,32 @@ def test_conv3d_builders_run():
     feed = {'vol': rng.standard_normal((1, 432)).astype('float32')}
     vals = _run_cost(cost, feed, steps=1)
     assert np.isfinite(vals).all()
+
+
+def test_scale_sub_region_layer():
+    """1-based inclusive [c0,c1,h0,h1,w0,w1] boxes scale in place."""
+    tch.settings(batch_size=2, learning_rate=0.01)
+    img = tch.data_layer(name='img', size=2 * 4 * 4)
+    box = tch.data_layer(name='box', size=6)
+    out = tch.scale_sub_region_layer(input=img, indices=box, value=3.0,
+                                     num_channels=2)
+    cost = tch.sum_cost(input=out)
+    topo = Topology(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    x = np.ones((2, 32), 'float32')
+    boxes = np.array([[1, 1, 1, 2, 1, 2],    # ch 1, rows 1-2, cols 1-2
+                      [2, 2, 3, 4, 3, 4]], 'float32')
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(topo.startup_program)
+        v, = exe.run(topo.main_program, feed={'img': x, 'box': boxes},
+                     fetch_list=[topo._ctx[out.name]])
+    v = np.asarray(v)
+    assert v.shape == (2, 2, 4, 4)
+    # sample 0: channel 0 rows0-1 cols0-1 scaled x3 -> 4 cells
+    want0 = np.ones((2, 4, 4), 'float32')
+    want0[0, 0:2, 0:2] = 3.0
+    np.testing.assert_allclose(v[0], want0)
+    # sample 1: channel 1 rows2-3 cols2-3
+    want1 = np.ones((2, 4, 4), 'float32')
+    want1[1, 2:4, 2:4] = 3.0
+    np.testing.assert_allclose(v[1], want1)
