@@ -623,3 +623,27 @@ def _scale_sub_region(ctx, op):
             (hs >= lo(2)) & (hs < hi(3)) &
             (ws >= lo(4)) & (ws < hi(5)))
     ctx.set(op, 'Out', jnp.where(mask, x * value, x))
+
+
+@register_lowering('dynamic_conv2d')
+def _dynamic_conv2d(ctx, op):
+    """Per-sample dynamic-filter convolution (the legacy ConvOperator
+    inside mixed_layer: the FILTER is another layer's output, not a
+    parameter).  X [B, C, H, W], Filter [B, O*C*kh*kw] -> [B, O, H', W']
+    via a vmapped conv."""
+    x = ctx.get(op, 'X')
+    f = ctx.get(op, 'Filter')
+    o = int(op.attrs['num_filters'])
+    kh, kw = op.attrs['filter_size']
+    stride = op.attrs.get('strides', [1, 1])
+    pad = op.attrs.get('paddings', [0, 0])
+    b, c = x.shape[0], x.shape[1]
+    filt = jnp.reshape(f, (b, o, c, int(kh), int(kw)))
+
+    def one(xi, fi):
+        return jax.lax.conv_general_dilated(
+            xi[None], fi, tuple(int(s) for s in stride),
+            [(int(pad[0]), int(pad[0])), (int(pad[1]), int(pad[1]))],
+            dimension_numbers=('NCHW', 'OIHW', 'NCHW'))[0]
+
+    ctx.set(op, 'Out', jax.vmap(one)(x, filt))

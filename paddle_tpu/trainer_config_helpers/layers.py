@@ -39,7 +39,7 @@ __all__ = [
     'upsample_layer', 'spp_layer', 'recurrent_layer',
     'img_conv3d_layer', 'img_pool3d_layer', 'factorization_machine',
     'scaling_projection', 'slice_projection', 'dotmul_operator',
-    'detection_output_layer', 'multibox_loss_layer',
+    'conv_operator', 'detection_output_layer', 'multibox_loss_layer',
     'scale_sub_region_layer', 'square_error_cost',
     'printer_layer', 'gru_step_naive_layer', 'seq_slice_layer',
     'layer_support',
@@ -424,6 +424,7 @@ factorization_machine = _v2.factorization_machine
 scaling_projection = _v2.scaling_projection
 slice_projection = _v2.slice_projection
 dotmul_operator = _v2.dotmul_operator
+conv_operator = _v2.conv_operator
 
 
 def detection_output_layer(input_loc, input_conf, priorbox, num_classes,

@@ -40,7 +40,7 @@ __all__ = [
     'resize', 'row_l2_norm', 'switch_order', 'upsample', 'spp',
     'recurrent', 'img_conv3d', 'img_pool3d', 'factorization_machine',
     'scaling_projection', 'slice_projection', 'dotmul_operator',
-    'detection_output', 'scale_sub_region',
+    'detection_output', 'scale_sub_region', 'conv_operator',
 ]
 
 
@@ -1434,3 +1434,53 @@ def scale_sub_region(input, indices, value=1.0, num_channels=None,
 
     return Layer('scale_sub_region', [input, indices], build, name=name,
                  size=input.size)
+
+
+def conv_operator(img, filter, filter_size, num_filters,
+                  num_channels=None, stride=1, padding=0,
+                  filter_size_y=None, stride_y=None, padding_y=None,
+                  trans=False, **kwargs):
+    """Dynamic-filter conv mixed-layer operator (reference
+    conv_operator: the filter VALUES come from the ``filter`` layer's
+    per-sample output, reshaped to [O, C, kh, kw] — not a trained
+    parameter).  The term flattens to the 2-D [B, O*H'*W'] layout every
+    mixed projection carries, with the size computed from the conv
+    arithmetic."""
+    if trans:
+        raise NotImplementedError(
+            'conv_operator(trans=True): transposed dynamic-filter conv '
+            'is not carried — use conv2d_transpose at the fluid level')
+    kh = int(filter_size)
+    kw = int(filter_size_y if filter_size_y is not None else filter_size)
+    sh = int(stride)
+    sw = int(stride_y if stride_y is not None else stride)
+    ph = int(padding)
+    pw = int(padding_y if padding_y is not None else padding)
+    c = num_channels or 1
+    side = int(round((img.size // c) ** 0.5))
+    out_h = (side + 2 * ph - kh) // sh + 1
+    out_w = (side + 2 * pw - kw) // sw + 1
+    term_size = int(num_filters) * out_h * out_w
+
+    def build(ctx, img_v, filt_v):
+        v = img_v
+        if len(v.shape) == 2:
+            v = _reshape_to_nchw(v, img.size, num_channels,
+                                 'conv_operator')
+        from ..fluid.layer_helper import LayerHelper
+        helper = LayerHelper('dynamic_conv2d')
+        out = helper.create_variable_for_type_inference(dtype=v.dtype)
+        out.shape = (-1, int(num_filters), out_h, out_w)
+        helper.append_op(
+            type='dynamic_conv2d',
+            inputs={'X': [v], 'Filter': [filt_v]},
+            outputs={'Out': [out]},
+            attrs={'num_filters': int(num_filters),
+                   'filter_size': [kh, kw],
+                   'strides': [sh, sw],
+                   'paddings': [ph, pw]})
+        # mixed terms are 2-D [B, size]: flatten the conv map
+        return fluid.layers.reshape(out, shape=[0, -1])
+
+    prod = Layer('conv_op', [img, filter], build, size=term_size)
+    return identity_projection(prod)

@@ -740,3 +740,65 @@ def test_scale_sub_region_layer():
     want1 = np.ones((2, 4, 4), 'float32')
     want1[1, 2:4, 2:4] = 3.0
     np.testing.assert_allclose(v[1], want1)
+
+
+def test_conv_operator_dynamic_filter_matches_torch():
+    """The filter VALUES come from a layer output, per sample — oracle:
+    torch conv2d applied per sample."""
+    import torch
+    import torch.nn.functional as F
+    tch.settings(batch_size=2, learning_rate=0.01)
+    img = tch.data_layer(name='img', size=2 * 5 * 5)
+    filt = tch.data_layer(name='filt', size=3 * 2 * 3 * 3)  # O=3,C=2,k=3
+    op = tch.conv_operator(img=img, filter=filt, filter_size=3,
+                           num_filters=3, num_channels=2)
+    assert op.size == 3 * 3 * 3  # O * H' * W' (5-3+1 = 3)
+    # the reference's standard use: conv term summed with a projection
+    mix = tch.mixed_layer(
+        size=op.size,
+        input=[op, tch.full_matrix_projection(input=img, size=op.size)])
+    cost = tch.sum_cost(input=mix)
+    topo = Topology(cost)
+    rng = np.random.RandomState(23)
+    xv = rng.standard_normal((2, 50)).astype('float32')
+    fv = rng.standard_normal((2, 54)).astype('float32')
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(topo.startup_program)
+        v, = exe.run(topo.main_program, feed={'img': xv, 'filt': fv},
+                     fetch_list=[topo._ctx[mix.name]])
+    got = np.asarray(v)
+    x4 = torch.tensor(xv.reshape(2, 2, 5, 5))
+    f5 = torch.tensor(fv.reshape(2, 3, 2, 3, 3))
+    conv = np.stack([
+        F.conv2d(x4[i:i + 1], f5[i]).numpy()[0] for i in range(2)])
+    assert got.shape == (2, 27)  # flattened mixed-term layout
+    # mix = conv_term + W @ img; recover the conv half by subtracting
+    # the projection (weights fetched from the scope would be needed for
+    # an exact check; instead check the conv term alone via a
+    # projection-free mixed)
+    tch.reset_config()
+    tch.settings(batch_size=2, learning_rate=0.01)
+    img2 = tch.data_layer(name='img2', size=50)
+    filt2 = tch.data_layer(name='filt2', size=54)
+    mix2 = tch.mixed_layer(
+        size=27, input=[tch.conv_operator(img=img2, filter=filt2,
+                                          filter_size=3, num_filters=3,
+                                          num_channels=2)])
+    topo2 = Topology(tch.sum_cost(input=mix2))
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe2.run(topo2.startup_program)
+        v2, = exe2.run(topo2.main_program,
+                       feed={'img2': xv, 'filt2': fv},
+                       fetch_list=[topo2._ctx[mix2.name]])
+    np.testing.assert_allclose(np.asarray(v2),
+                               conv.reshape(2, 27), rtol=1e-4,
+                               atol=1e-5)
+    with _pytest_raises_not_implemented():
+        tch.conv_operator(img=img2, filter=filt2, filter_size=3,
+                          num_filters=3, num_channels=2, trans=True)
+
+
+def _pytest_raises_not_implemented():
+    return pytest.raises(NotImplementedError)
