@@ -1,7 +1,20 @@
 """Driver benchmark.
 
-Prints ONE JSON line.  Top-level keys keep the driver contract
-(metric/value/unit/vs_baseline = the ResNet-50 headline), and `configs`
+Unkillable-by-construction (VERDICT r3 next-#1): the parent process
+imports NO jax — each config runs in its own subprocess under a hard
+wall-clock budget, and the parent emits a full contract-shaped JSON
+line after EVERY config completes.  A tunnel hang (the round-3 failure
+mode: BENCH_r03.json rc=124, nothing captured) now costs only the
+hanging config's budget; every already-finished number is already on
+stdout and in BENCH_PARTIAL.json.  The LAST JSON line on stdout is
+always the most complete record.
+
+Top-level keys keep the driver contract: metric/value/unit/vs_baseline
+are the ResNet-50 headline when it finished, else the first config that
+did ("headline from whatever finished", VERDICT r3 next-#1 — a resnet
+timeout must not zero the run; its TIMEOUT record stays in `configs`
+and `vs_baseline` goes null since only resnet has a published
+baseline).  `configs`
 carries one fully-schema'd record per benchmark config — value, unit,
 mfu, vs_baseline (null where the reference published no number), ms per
 step — so nothing rides piggyback on the headline record
@@ -24,6 +37,10 @@ the tunnel, not the chip (MFU_BOUND_r03.json).
 """
 
 import json
+import os
+import signal
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -31,6 +48,16 @@ import numpy as np
 PEAK_FLOPS = 197e12  # v5e bf16
 BASELINE_RESNET_IMGS_PER_SEC = 84.08
 WARMUP = 2
+
+# Per-config wall-clock budgets (seconds).  ResNet gets extra headroom
+# for the bs512 224^2 compile; the total (~16 min worst case, all four
+# hanging) stays under the driver's observed >=25 min patience.
+BUDGETS = {'resnet': 320, 'nmt': 240, 'transformer': 240,
+           'stacked_lstm': 200}
+if os.environ.get('BENCH_BUDGET'):  # uniform override, mainly for tests
+    BUDGETS = {k: int(os.environ['BENCH_BUDGET']) for k in BUDGETS}
+PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            'BENCH_PARTIAL.json')
 
 
 def _timed_steps(exe, prog, feed, loss_var, steps):
@@ -187,40 +214,146 @@ def bench_stacked_lstm(on_tpu, steps=20, seq_len=64):
         'ms_per_step': round(elapsed / steps * 1000, 2),
         'mfu': round(v * fpt / PEAK_FLOPS, 4) if on_tpu else None,
         'vs_baseline': None,  # reference LSTM tables are a different net
+        # On the axon dev tunnel each synced dispatch costs ~100ms and
+        # this model's step is smaller than that, so the wall-clock here
+        # measures the tunnel, not the chip (VERDICT r3 weak-#7).  The
+        # device-true kernel numbers live in tools/lstm_kernel_lab.py
+        # (fori_loop-batched on-device timing).
+        'dispatch_bound': True,
     }
 
 
-def main():
-    import paddle_tpu.fluid as fluid
+CONFIGS = {
+    'resnet': bench_resnet,
+    'nmt': bench_nmt,
+    'transformer': bench_transformer,
+    'stacked_lstm': bench_stacked_lstm,
+}
 
+
+def run_one(name):
+    """Child mode: run a single config, print exactly one JSON line."""
+    if os.environ.get('BENCH_FORCE_CPU') == '1':
+        # Hermetic escape hatch: the ambient site config registers the
+        # TPU backend at interpreter start, so the env var alone is not
+        # enough — pin via jax.config after import too.
+        os.environ['JAX_PLATFORMS'] = 'cpu'
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+    import paddle_tpu.fluid as fluid
     on_tpu = fluid.core.is_compiled_with_tpu()
-    configs = []
-    for fn in (bench_resnet, bench_nmt, bench_transformer,
-               bench_stacked_lstm):
-        try:
-            configs.append(fn(on_tpu))
-        except Exception as e:  # a failing config must not zero the rest
-            configs.append({
-                'metric': fn.__name__.replace('bench_', '') + '_FAILED',
-                'value': None, 'unit': None, 'mfu': None,
-                'vs_baseline': None, 'error': '%s: %s' %
-                (type(e).__name__, str(e)[:300]),
-            })
-    head = configs[0]
-    print(json.dumps({
+    rec = CONFIGS[name](on_tpu)
+    print(json.dumps(rec), flush=True)
+
+
+def _headline(configs):
+    """ResNet if it produced a number, else the first config that did,
+    else the ResNet failure record (driver contract needs a headline)."""
+    done = [c for c in configs if c.get('value') is not None]
+    for c in done:
+        if c['metric'].startswith('resnet'):
+            return c
+    if done:
+        return done[0]
+    return configs[0] if configs else {
+        'metric': 'resnet50_train_imgs_per_sec_per_chip',
+        'value': None, 'unit': None, 'vs_baseline': None,
+        'error': 'no config ran'}
+
+
+def _emit(configs, partial):
+    """One full contract-shaped JSON line; also rewrite the partial file
+    atomically so the driver can parse it even if stdout is lost."""
+    head = _headline(configs)
+    line = json.dumps({
         'metric': head['metric'],
         'value': head['value'],
         'unit': head['unit'],
         'vs_baseline': head['vs_baseline'],
         'mfu': head.get('mfu'),
+        'partial': partial,
         'configs': configs,
-    }))
+    })
+    print(line, flush=True)
+    try:
+        tmp = PARTIAL_PATH + '.tmp'
+        with open(tmp, 'w') as f:
+            f.write(line + '\n')
+        os.replace(tmp, PARTIAL_PATH)
+    except OSError:
+        pass  # read-only fs must not kill the bench
+    return head
+
+
+def _run_child(name, budget):
+    """Run one config in a subprocess under a hard wall-clock budget.
+    The child gets its own session so a hung XLA/tunnel call is killed
+    as a whole process group — nothing in the parent can block."""
+    t0 = time.time()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), '--config', name],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        start_new_session=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=budget)
+    except subprocess.TimeoutExpired:
+        # Kill the whole session: a grandchild holding the inherited
+        # pipe fds would otherwise keep communicate() blocked past the
+        # budget (and could keep holding the TPU for later configs).
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        stdout, _ = proc.communicate()
+        return {'metric': name + '_TIMEOUT', 'value': None, 'unit': None,
+                'mfu': None, 'vs_baseline': None,
+                'error': 'wall-clock budget %ds exceeded '
+                         '(tunnel hang?); partial output: %r'
+                         % (budget, (stdout or b'')[-200:])}
+    elapsed = time.time() - t0
+    out = stdout.decode('utf-8', 'replace').strip().splitlines()
+    for ln in reversed(out):
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and 'metric' in rec:
+            rec['wall_s'] = round(elapsed, 1)
+            return rec
+    return {'metric': name + '_FAILED', 'value': None, 'unit': None,
+            'mfu': None, 'vs_baseline': None,
+            'error': 'rc=%d stderr tail: %s' %
+            (proc.returncode,
+             stderr.decode('utf-8', 'replace')[-300:])}
+
+
+def main():
+    # Backstop: if anything in the parent itself wedges, force a final
+    # flush + exit.  The parent imports no jax, so this should be moot.
+    total_budget = sum(BUDGETS.values()) + 120
+
+    def _bail(signum, frame):
+        _emit(state['configs'], partial=True)
+        os._exit(3)
+
+    state = {'configs': []}
+    signal.signal(signal.SIGALRM, _bail)
+    signal.alarm(total_budget)
+
+    for name in CONFIGS:
+        state['configs'].append(_run_child(name, BUDGETS[name]))
+        if len(state['configs']) < len(CONFIGS):
+            _emit(state['configs'], partial=True)
+    signal.alarm(0)
+    head = _emit(state['configs'], partial=False)
     if head.get('value') is None:
         # the partial report (incl. the other configs' numbers and this
         # error) is already on stdout; exit nonzero for the driver
-        raise SystemExit('headline ResNet bench failed: %s' %
-                         head.get('error'))
+        raise SystemExit('headline bench failed: %s' % head.get('error'))
 
 
 if __name__ == '__main__':
-    main()
+    if len(sys.argv) == 3 and sys.argv[1] == '--config':
+        run_one(sys.argv[2])
+    else:
+        main()

@@ -1,0 +1,91 @@
+"""The driver-bench machinery must be unkillable (VERDICT r3 next-#1).
+
+BENCH_r03.json was rc=124 with nothing captured because bench.py buffered
+one JSON line until all four configs finished.  These tests pin the new
+contract: the parent imports no jax, each config runs in a subprocess
+under a hard budget, a contract-shaped JSON line is flushed after EVERY
+config, and a hanging config costs only its own budget.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, 'bench.py')
+
+CONTRACT_KEYS = {'metric', 'value', 'unit', 'vs_baseline'}
+
+
+def _run_bench(env_extra, timeout):
+    env = dict(os.environ)
+    # children must not inherit the suite's 8-device virtual mesh
+    env.pop('XLA_FLAGS', None)
+    env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, BENCH], env=env, timeout=timeout,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        start_new_session=True)
+
+
+def test_every_config_flushes_and_timeouts_are_isolated():
+    """Tiny budgets -> every child is killed mid-startup, yet the parent
+    emits one contract line per config plus the final line, writes the
+    partial file, and exits on its own (no external timeout needed)."""
+    proc = _run_bench({'BENCH_BUDGET': '3', 'BENCH_FORCE_CPU': '1'}, 120)
+    lines = [json.loads(l) for l in proc.stdout.decode().splitlines() if l]
+    # 3 incremental lines + 1 final (the last config's completion IS the
+    # final record — no duplicate emission)
+    assert len(lines) == 4, proc.stdout
+    assert [r['partial'] for r in lines] == [True, True, True, False]
+    for rec in lines:
+        assert CONTRACT_KEYS <= set(rec), rec
+        assert 'configs' in rec and 'partial' in rec
+    final = lines[-1]
+    assert final['partial'] is False
+    assert len(final['configs']) == 4
+    # every config carries an isolated TIMEOUT record, not a crash
+    for cfg in final['configs']:
+        assert cfg['metric'].endswith('_TIMEOUT'), cfg
+        assert 'budget' in cfg['error']
+    # nothing finished -> headline has no value -> nonzero exit
+    assert proc.returncode != 0
+    partial = json.loads(open(os.path.join(REPO, 'BENCH_PARTIAL.json')).read())
+    assert partial['configs'] == final['configs']
+
+
+def test_incremental_lines_are_each_driver_parseable():
+    """Kill the parent after the first config completes: the stdout tail
+    must already be a valid contract record (the round-3 failure mode)."""
+    env = dict(os.environ)
+    env.pop('XLA_FLAGS', None)
+    env.update({'BENCH_BUDGET': '3', 'BENCH_FORCE_CPU': '1'})
+    proc = subprocess.Popen(
+        [sys.executable, BENCH], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        start_new_session=True)
+    try:
+        first = proc.stdout.readline().decode()
+        rec = json.loads(first)
+    finally:
+        proc.kill()
+        proc.wait()
+    assert CONTRACT_KEYS <= set(rec)
+    assert rec['partial'] is True
+    assert len(rec['configs']) == 1
+
+
+def test_single_config_child_runs_cpu():
+    """The cheapest config end-to-end on CPU through the child entry."""
+    env = dict(os.environ)
+    env.pop('XLA_FLAGS', None)
+    env['BENCH_FORCE_CPU'] = '1'
+    proc = subprocess.run(
+        [sys.executable, BENCH, '--config', 'stacked_lstm'], env=env,
+        timeout=180, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        start_new_session=True)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    rec = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert rec['value'] > 0
+    assert rec['dispatch_bound'] is True
