@@ -7,6 +7,26 @@ whole-block to XLA, and executed on TPU.  See SURVEY.md for the layer map.
 
 __version__ = '0.1.0'
 
+import os as _os
+import sys as _sys
+
+if 'jax' in _sys.modules and _os.environ.get('JAX_PLATFORMS'):
+    # An ambient site config (which is what imports jax this early) may
+    # have force-set jax.config.jax_platforms over the JAX_PLATFORMS
+    # env var; re-assert the env contract now, before importing any
+    # submodule (they may run jax computations at import).  Inlined
+    # rather than imported from fluid.core to keep that ordering; when
+    # jax is not yet loaded, fluid.core.lazy_jax() applies the same
+    # reconciliation (see reconcile_platforms there for the full why).
+    _jax = _sys.modules['jax']
+    _want = _os.environ['JAX_PLATFORMS']
+    try:
+        if (_jax.config.jax_platforms or '').split(',')[0] != \
+                _want.split(',')[0]:
+            _jax.config.update('jax_platforms', _want)
+    except Exception:
+        pass  # backends already initialized: leave the live config alone
+
 from . import fluid  # noqa: F401
 from . import reader  # noqa: F401
 from . import dataset  # noqa: F401

@@ -33,6 +33,27 @@ _jax = None
 _jax_lock = threading.Lock()
 
 
+def reconcile_platforms(jax):
+    """Re-assert the JAX_PLATFORMS env var over the live jax config.
+
+    JAX's documented contract is that the env var selects the platform,
+    but ambient site configs may force-set ``jax.config.jax_platforms``
+    (e.g. to 'axon,cpu') at interpreter start, overriding it — a process
+    pinned to JAX_PLATFORMS=cpu then still dials (and, on a dead TPU
+    tunnel, hangs on) the accelerator.  Compares only the priority
+    platform so an 'axon,cpu' config under JAX_PLATFORMS=axon keeps its
+    cpu fallback (host ops need the cpu backend)."""
+    want = os.environ.get('JAX_PLATFORMS')
+    if not want:
+        return
+    try:
+        have = jax.config.jax_platforms or ''
+        if have.split(',')[0] != want.split(',')[0]:
+            jax.config.update('jax_platforms', want)
+    except Exception:
+        pass  # backends already initialized: leave the live config alone
+
+
 def lazy_jax():
     """Import jax lazily so that pure graph construction needs no device."""
     global _jax
@@ -40,6 +61,7 @@ def lazy_jax():
         with _jax_lock:
             if _jax is None:
                 import jax
+                reconcile_platforms(jax)
                 _jax = jax
     return _jax
 

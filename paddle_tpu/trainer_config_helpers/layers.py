@@ -72,21 +72,40 @@ def data_layer(name, size, data_type_kind='dense', seq=False, **kwargs):
     return _v2.data(name=name, type=t)
 
 
+def _with_layer_attr(layer, kwargs):
+    """Apply the semantic half of ExtraLayerAttribute: ``drop_rate``
+    wraps the built layer in dropout (the reference config_parser's
+    post-layer dropout insertion).  The placement/engine knobs (device,
+    error_clipping_threshold) have no per-layer XLA analog — see the
+    PARITY.md fidelity audit."""
+    la = kwargs.get('layer_attr')
+    dr = getattr(la, 'drop_rate', None) if la is not None else None
+    if dr:
+        return _v2.dropout(input=layer, dropout_rate=dr)
+    return layer
+
+
 def fc_layer(input, size, act=None, name=None, param_attr=None,
              bias_attr=None, **kwargs):
-    return _v2.fc(input=input, size=size, act=act, name=name)
+    return _with_layer_attr(
+        _v2.fc(input=input, size=size, act=act, name=name,
+               param_attr=param_attr, bias_attr=bias_attr), kwargs)
 
 
 def embedding_layer(input, size, name=None, param_attr=None, **kwargs):
-    return _v2.embedding(input=input, size=size, name=name)
+    return _v2.embedding(input=input, size=size, name=name,
+                         param_attr=param_attr)
 
 
 def img_conv_layer(input, filter_size, num_filters, num_channels=None,
-                   stride=1, padding=0, act=None, name=None, **kwargs):
-    return _v2.img_conv(input=input, filter_size=filter_size,
-                        num_filters=num_filters,
-                        num_channels=num_channels, stride=stride,
-                        padding=padding, act=act, name=name)
+                   stride=1, padding=0, act=None, name=None,
+                   param_attr=None, bias_attr=None, **kwargs):
+    return _with_layer_attr(
+        _v2.img_conv(input=input, filter_size=filter_size,
+                     num_filters=num_filters,
+                     num_channels=num_channels, stride=stride,
+                     padding=padding, act=act, name=name,
+                     param_attr=param_attr, bias_attr=bias_attr), kwargs)
 
 
 def img_pool_layer(input, pool_size, stride=1, padding=0, pool_type=None,
@@ -111,12 +130,20 @@ def dropout_layer(input, dropout_rate, name=None, **kwargs):
     return _v2.dropout(input=input, dropout_rate=dropout_rate, name=name)
 
 
-def lstmemory(input, size=None, name=None, reverse=False, **kwargs):
-    return _v2.lstmemory(input=input, size=size, name=name)
+def lstmemory(input, size=None, name=None, reverse=False, param_attr=None,
+              bias_attr=None, **kwargs):
+    return _with_layer_attr(
+        _v2.lstmemory(input=input, size=size, name=name,
+                      reverse=reverse, param_attr=param_attr,
+                      bias_attr=bias_attr), kwargs)
 
 
-def grumemory(input, size, name=None, **kwargs):
-    return _v2.gru_like(input=input, size=size, name=name)
+def grumemory(input, size, name=None, reverse=False, param_attr=None,
+              bias_attr=None, **kwargs):
+    return _with_layer_attr(
+        _v2.gru_like(input=input, size=size, name=name,
+                     reverse=reverse, param_attr=param_attr,
+                     bias_attr=bias_attr), kwargs)
 
 
 def batch_norm_layer(input, act=None, name=None, **kwargs):

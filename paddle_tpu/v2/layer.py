@@ -8,6 +8,8 @@ into a protobuf ModelConfig consumed by the C++ GradientMachine).  Here the
 "engine" under v2 is the same TPU fluid stack — one compiled XLA program
 instead of the legacy Layer/Matrix interpreter (legacy/gserver/)."""
 
+import numpy as np
+
 from . import data_type as _data_type
 from .activation import BaseActivation, Linear
 from .pooling import Max as _MaxPool
@@ -121,15 +123,73 @@ def _act_name(act):
     return act
 
 
-def fc(input, size, act=None, name=None, **kwargs):
+class _LegacyDefaultStdNormal(fluid.initializer.NormalInitializer):
+    """Gaussian around a requested mean with the legacy default std.
+
+    The legacy config_parser's unset-initial_std default is
+    1/sqrt(fan_in) (reference config_parser.py parameter defaults), and
+    fan_in is only known once the parameter shape exists — so resolve
+    the std at init-op emission time."""
+
+    def __call__(self, var, block):
+        shape = list(var.shape)
+        fan_in = shape[0] if len(shape) <= 2 else \
+            int(np.prod(shape[1:]))
+        self._std_dev = 1.0 / float(max(fan_in, 1)) ** 0.5
+        return super(_LegacyDefaultStdNormal, self).__call__(var, block)
+
+
+def _fluid_attr(attr):
+    """Map a legacy ParameterAttribute (reference
+    trainer_config_helpers/layers.py:349 — the argument every
+    parameterized legacy layer takes) onto a fluid ParamAttr.
+
+    Duck-typed so both trainer_config_helpers.attrs.ParameterAttribute
+    and plain fluid ParamAttr/str/False flow through without this
+    module importing the DSL layer above it.  Semantics carried:
+    initial_std/initial_mean -> gaussian initializer (std==0 exactly
+    collapses to a constant, the reference's is_static-like use; std
+    UNSET with a mean keeps the legacy default std of 1/sqrt(fan_in) so
+    symmetry still breaks), name and learning_rate pass through, False
+    means "no parameter" (bias off)."""
+    if attr is None or attr is False or isinstance(
+            attr, (fluid.ParamAttr, str)):
+        return attr
+    std = getattr(attr, 'initial_std', None)
+    mean = getattr(attr, 'initial_mean', None)
+    init = None
+    if std is not None or mean is not None:
+        mean = 0.0 if mean is None else float(mean)
+        if std is None:
+            init = _LegacyDefaultStdNormal(loc=mean)
+        elif float(std) == 0.0:
+            init = fluid.initializer.ConstantInitializer(mean)
+        else:
+            init = fluid.initializer.NormalInitializer(loc=mean,
+                                                       scale=float(std))
+    kw = {}
+    if getattr(attr, 'name', None):
+        kw['name'] = attr.name
+    if getattr(attr, 'learning_rate', None) is not None:
+        kw['learning_rate'] = attr.learning_rate
+    return fluid.ParamAttr(initializer=init, **kw)
+
+
+def fc(input, size, act=None, name=None, param_attr=None, bias_attr=None,
+       **kwargs):
     inputs = input if isinstance(input, (list, tuple)) else [input]
+    # the legacy contract: one weight attr per input (or one broadcast
+    # over all), a single bias attr after the sum — exactly fluid fc's
+    # own multi-input handling, so delegate whole
+    if isinstance(param_attr, (list, tuple)):
+        p_attr = [_fluid_attr(a) for a in param_attr]
+    else:
+        p_attr = _fluid_attr(param_attr)
 
     def build(ctx, *parent_vars):
-        out = None
-        for v in parent_vars:
-            term = fluid.layers.fc(v, size=size)
-            out = term if out is None else fluid.layers.elementwise_add(
-                out, term)
+        out = fluid.layers.fc(list(parent_vars), size=size,
+                              param_attr=p_attr,
+                              bias_attr=_fluid_attr(bias_attr))
         a = _act_name(act if act is not None else Linear())
         if a == 'softmax':
             return fluid.layers.softmax(out)
@@ -140,16 +200,18 @@ def fc(input, size, act=None, name=None, **kwargs):
     return Layer('fc', inputs, build, name=name, size=size)
 
 
-def embedding(input, size, name=None, **kwargs):
+def embedding(input, size, name=None, param_attr=None, **kwargs):
     def build(ctx, parent_var):
         vocab = input.size
-        return fluid.layers.embedding(parent_var, size=[vocab, size])
+        return fluid.layers.embedding(parent_var, size=[vocab, size],
+                                      param_attr=_fluid_attr(param_attr))
 
     return Layer('embedding', [input], build, name=name, size=size)
 
 
 def img_conv(input, filter_size, num_filters, num_channels=None, stride=1,
-             padding=0, act=None, name=None, **kwargs):
+             padding=0, act=None, name=None, param_attr=None,
+             bias_attr=None, **kwargs):
     def build(ctx, parent_var):
         a = _act_name(act)
         v = parent_var
@@ -159,7 +221,9 @@ def img_conv(input, filter_size, num_filters, num_channels=None, stride=1,
             v = _reshape_to_nchw(v, input.size, num_channels, 'img_conv')
         return fluid.layers.conv2d(
             v, num_filters=num_filters, filter_size=filter_size,
-            stride=stride, padding=padding, act=a)
+            stride=stride, padding=padding, act=a,
+            param_attr=_fluid_attr(param_attr),
+            bias_attr=_fluid_attr(bias_attr))
 
     return Layer('img_conv', [input], build, name=name, size=num_filters)
 
@@ -377,7 +441,8 @@ def recurrent_group(step, input, name=None, **kwargs):
     return layer
 
 
-def lstmemory(input, size=None, name=None, reverse=False, **kwargs):
+def lstmemory(input, size=None, name=None, reverse=False, param_attr=None,
+              bias_attr=None, **kwargs):
     """LSTM over a pre-projected [*, 4D] sequence (reference layer.py
     lstmemory: input must already be width 4*size)."""
 
@@ -387,21 +452,26 @@ def lstmemory(input, size=None, name=None, reverse=False, **kwargs):
             raise ValueError(
                 'lstmemory: cannot infer the hidden width — the input '
                 'layer declares no size; pass size= explicitly')
-        hidden, _ = fluid.layers.dynamic_lstm(parent_var, size=width * 4,
-                                              is_reverse=reverse)
+        hidden, _ = fluid.layers.dynamic_lstm(
+            parent_var, size=width * 4, is_reverse=reverse,
+            param_attr=_fluid_attr(param_attr),
+            bias_attr=_fluid_attr(bias_attr))
         return hidden
 
     return Layer('lstmemory', [input], build, name=name, size=size)
 
 
-def gru_like(input, size, name=None, reverse=False, **kwargs):
+def gru_like(input, size, name=None, reverse=False, param_attr=None,
+             bias_attr=None, **kwargs):
     """GRU block: gate projection + dynamic_gru (reference networks.py
     simple_gru)."""
 
     def build(ctx, parent_var):
         proj = fluid.layers.fc(parent_var, size=size * 3)
         return fluid.layers.dynamic_gru(proj, size=size,
-                                        is_reverse=reverse)
+                                        is_reverse=reverse,
+                                        param_attr=_fluid_attr(param_attr),
+                                        bias_attr=_fluid_attr(bias_attr))
 
     return Layer('gru', [input], build, name=name, size=size)
 

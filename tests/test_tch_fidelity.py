@@ -1,0 +1,158 @@
+"""Argument-fidelity tests for the legacy config DSL (VERDICT r3
+next-#3): every forwarded kwarg must CHANGE the built model, not just be
+accepted.  Reference contract: trainer_config_helpers/layers.py:1500
+(lstmemory reverse), :349 (ParameterAttribute on every parameterized
+layer), and ParameterAttribute semantics from attrs.py (initial_std /
+initial_mean / name; bias_attr=False disables the bias parameter).
+
+The deterministic-parameter trick: ParameterAttribute(initial_std=0.0,
+initial_mean=c) pins every weight to the constant c, so outputs are
+comparable across independently-created topologies and the reversed
+recurrence can be checked against its flip-the-input oracle exactly.
+"""
+
+import numpy as np
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu import trainer_config_helpers as tch
+
+
+def setup_function(_fn):
+    tch.reset_config()
+
+
+def _const_attr(c, name=None):
+    return tch.ParamAttr(initial_std=0.0, initial_mean=c, name=name)
+
+
+def _lstm_chain(reverse, d=6):
+    """x -> deterministic fc(4d) -> lstmemory(reverse=...)."""
+    x = tch.data_layer(name='x', size=8, seq=True)
+    proj = tch.fc_layer(input=x, size=4 * d, act=tch.LinearActivation(),
+                        param_attr=_const_attr(0.1), bias_attr=False)
+    lstm = tch.lstmemory(input=proj, size=d, reverse=reverse,
+                         param_attr=_const_attr(0.2),
+                         bias_attr=_const_attr(0.0))
+    return lstm
+
+
+def _infer_seq(out_layer, seq):
+    params = paddle.parameters.create(out_layer)
+    return paddle.infer(output_layer=out_layer, parameters=params,
+                        input=[(seq, )])
+
+
+def test_lstmemory_reverse_flips_the_recurrence():
+    rng = np.random.RandomState(0)
+    seq = [rng.standard_normal(8).astype('float32') for _ in range(5)]
+
+    fwd = _infer_seq(_lstm_chain(reverse=False), seq)
+    tch.reset_config()
+    rev = _infer_seq(_lstm_chain(reverse=True), seq)
+    # the flag must change the computation...
+    assert not np.allclose(fwd, rev)
+    # ...and must equal the flip-input-flip-output oracle exactly on
+    # the valid region (outputs are padded past the true length, so the
+    # flip runs over the sequence's own 5 steps, not the padded axis)
+    tch.reset_config()
+    fwd_on_flipped = _infer_seq(_lstm_chain(reverse=False), seq[::-1])
+    np.testing.assert_allclose(rev[:, :5], fwd_on_flipped[:, 4::-1],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_grumemory_reverse_flips_the_recurrence():
+    rng = np.random.RandomState(1)
+    seq = [rng.standard_normal(8).astype('float32') for _ in range(5)]
+
+    def chain(reverse):
+        x = tch.data_layer(name='x', size=8, seq=True)
+        return tch.grumemory(input=x, size=6, reverse=reverse,
+                             param_attr=_const_attr(0.15),
+                             bias_attr=_const_attr(0.0))
+
+    fwd = _infer_seq(chain(False), seq)
+    tch.reset_config()
+    rev = _infer_seq(chain(True), seq)
+    assert not np.allclose(fwd, rev)
+    tch.reset_config()
+    fwd_on_flipped = _infer_seq(chain(False), seq[::-1])
+    np.testing.assert_allclose(rev[:, :5], fwd_on_flipped[:, 4::-1],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fc_bias_attr_false_removes_the_bias_parameter():
+    x = tch.data_layer(name='x', size=4)
+    out = tch.fc_layer(input=x, size=3, bias_attr=False)
+    with_out_bias = paddle.parameters.create(out).names()
+    assert len(with_out_bias) == 1, with_out_bias
+
+    tch.reset_config()
+    x = tch.data_layer(name='x', size=4)
+    out = tch.fc_layer(input=x, size=3)
+    with_bias = paddle.parameters.create(out).names()
+    assert len(with_bias) == 2, with_bias
+
+
+def test_fc_param_attr_name_and_initializer_are_honored():
+    x = tch.data_layer(name='x', size=4)
+    out = tch.fc_layer(input=x, size=3, act=tch.LinearActivation(),
+                       param_attr=_const_attr(0.25, name='fid_w'),
+                       bias_attr=_const_attr(0.5, name='fid_b'))
+    params = paddle.parameters.create(out)
+    assert 'fid_w' in params.names() and 'fid_b' in params.names()
+    np.testing.assert_allclose(params.get('fid_w'), 0.25)
+    np.testing.assert_allclose(params.get('fid_b'), 0.5)
+    # and the forward actually uses them: y = x @ 0.25 + 0.5
+    xv = np.arange(4, dtype='float32')
+    got = paddle.infer(output_layer=out, parameters=params,
+                       input=[(xv, )])
+    np.testing.assert_allclose(got, np.full((1, 3), xv.sum() * 0.25 + 0.5),
+                               rtol=1e-5)
+
+
+def test_embedding_param_attr_initializer_is_honored():
+    words = tch.data_layer(name='w', size=11, data_type_kind='index',
+                           seq=True)
+    emb = tch.embedding_layer(input=words, size=5,
+                              param_attr=_const_attr(0.125, name='emb_t'))
+    params = paddle.parameters.create(emb)
+    assert 'emb_t' in params.names()
+    tab = params.get('emb_t')
+    assert tab.shape == (11, 5)
+    np.testing.assert_allclose(tab, 0.125)
+
+
+def test_param_attr_mean_with_unset_std_still_breaks_symmetry():
+    """initial_mean with initial_std UNSET must keep the legacy default
+    gaussian (std 1/sqrt(fan_in)), NOT collapse to a constant — a
+    constant would pin every hidden unit identical forever."""
+    x = tch.data_layer(name='x', size=16)
+    out = tch.fc_layer(input=x, size=8, act=tch.LinearActivation(),
+                       param_attr=tch.ParamAttr(initial_mean=0.05,
+                                                name='sym_w'),
+                       bias_attr=False)
+    params = paddle.parameters.create(out)
+    w = params.get('sym_w')
+    # centered near the mean, but NOT constant
+    assert np.std(w) > 1e-3, 'weights collapsed to a constant'
+    assert abs(np.mean(w) - 0.05) < 3 * (1 / 4.0) / np.sqrt(w.size)
+
+
+def test_layer_attr_drop_rate_wraps_in_dropout():
+    x = tch.data_layer(name='x', size=4)
+    plain = tch.fc_layer(input=x, size=3)
+    assert plain.kind == 'fc'
+    dropped = tch.fc_layer(input=x, size=3,
+                           layer_attr=tch.ExtraAttr(drop_rate=0.5))
+    assert dropped.kind == 'dropout'
+    assert dropped.parents[0].kind == 'fc'
+
+
+def test_img_conv_bias_attr_false_and_param_name():
+    img = tch.data_layer(name='img', size=2 * 8 * 8)
+    conv = tch.img_conv_layer(input=img, filter_size=3, num_filters=4,
+                              num_channels=2, padding=1,
+                              param_attr=_const_attr(0.01, name='cw'),
+                              bias_attr=False)
+    params = paddle.parameters.create(conv)
+    assert params.names() == ['cw'], params.names()
