@@ -219,13 +219,15 @@ class PaddedSequence(object):
     per-row lengths.  Produced by the double-buffer reader's prefetch
     thread (reference create_double_buffer_reader_op.cc moved batches to
     device ahead of the compute stream); consumed by
-    executor.prepare_feed_arrays."""
+    executor.prepare_feed_arrays.  ``rows`` carries the OUTER level of a
+    nested (2-level LoD) batch — sub-sequences per sequence — or None."""
 
-    __slots__ = ('data', 'lengths')
+    __slots__ = ('data', 'lengths', 'rows')
 
-    def __init__(self, data, lengths):
+    def __init__(self, data, lengths, rows=None):
         self.data = data
         self.lengths = lengths
+        self.rows = rows
 
 
 # ----------------------------------------------------------------------------

@@ -145,10 +145,19 @@ def prepare_feed_arrays(feed):
             # already padded + device-staged by a double-buffer reader
             feed_arrays[name] = value.data
             feed_arrays[name + registry.SEQLEN_SUFFIX] = value.lengths
+            if value.rows is not None:
+                feed_arrays[name + registry.ROWS_SUFFIX] = value.rows
         elif isinstance(value, core.LoDTensor) and value.lod():
             padded, lengths = _lod_to_padded(value)
             feed_arrays[name] = padded
             feed_arrays[name + registry.SEQLEN_SUFFIX] = lengths
+            lod = value.lod()
+            if len(lod) >= 2:
+                # nested sequence: also carry the outer level (number of
+                # sub-sequences per top-level sequence)
+                outer = np.asarray(lod[0], np.int64)
+                feed_arrays[name + registry.ROWS_SUFFIX] = (
+                    outer[1:] - outer[:-1]).astype(np.int32)
         elif isinstance(value,
                         (core.LoDTensor, core.SelectedRows, jax.Array)):
             feed_arrays[name] = value
@@ -163,7 +172,7 @@ def validate_feed(program, feed_arrays):
     data_feeder.py:29)."""
     block = program.block(0)
     for name, value in feed_arrays.items():
-        if name.endswith(registry.SEQLEN_SUFFIX):
+        if name.endswith((registry.SEQLEN_SUFFIX, registry.ROWS_SUFFIX)):
             continue
         if isinstance(value, core.SelectedRows):
             continue  # row-subset feeds carry their own height metadata

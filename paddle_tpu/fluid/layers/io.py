@@ -174,10 +174,16 @@ class _PyReaderFeeder(object):
         for slot in item:
             if isinstance(slot, core.LoDTensor) and slot.lod():
                 padded, lengths = _lod_to_padded(slot)
+                lod = slot.lod()
+                rows = None
+                if len(lod) >= 2:  # nested: keep the outer level too
+                    outer = np.asarray(lod[0], np.int64)
+                    rows = jax.device_put(
+                        (outer[1:] - outer[:-1]).astype(np.int32), dev)
                 out.append(
                     core.PaddedSequence(
                         jax.device_put(padded, dev),
-                        jax.device_put(lengths, dev)))
+                        jax.device_put(lengths, dev), rows))
             else:
                 arr = slot.numpy() if isinstance(slot, core.LoDTensor) \
                     else np.asarray(slot)

@@ -211,3 +211,154 @@ def _beam_search_decode(ctx, op):
     ctx.set(op, 'SentenceScores', final_scores)
 
 
+
+
+@register_lowering('cross_entropy_over_beam')
+def _cross_entropy_over_beam(ctx, op):
+    """Learning-to-search cost over multi-step beam expansions
+    (reference trainer_config_helpers/layers.py:6465 cross_entropy_over_beam;
+    kernel: legacy/gserver/layers/CrossEntropyOverBeam.cpp).
+
+    Per expansion e the op takes Scores_e (padded [R_e, C_e] candidate
+    scores, rows grouped by sequence), Ids_e ([R_e, K] selected candidate
+    ids, -1-padded) and Gold_e ([B] gold candidate id).  Every complete
+    path through the selected candidates is scored by summing its
+    per-expansion candidate scores; the cost is softmax cross entropy
+    over all paths with the gold path as the label.  If gold falls off
+    the beam at step t, the paths are those of the beam at step t and
+    the gold path is appended as an extra candidate (the reference's
+    goldAsExtraPath).
+
+    TPU-native split: the integer path construction (data-dependent,
+    CPU-only in the reference too) runs on host via jax.pure_callback on
+    the NON-differentiated ids/gold; the score gather + softmax-CE stays
+    in XLA so d(cost)/d(scores) flows through the normal vjp (scatter-add
+    through the gathers).
+
+    Documented delta: expansion rows are mapped to the r-th VALID
+    (non -1) selected entry of the previous expansion, consistently with
+    the reference's calValidExpandStep counting; the reference's own
+    constructTotalExpansion indexes parents by flat slot, which disagrees
+    with its counting whenever a -1 hole precedes the parent inside a
+    row — we keep the self-consistent semantics."""
+    import numpy as np
+
+    score_names = op.input('Scores')
+    id_names = op.input('Ids')
+    gold_names = op.input('Gold')
+    n_exp = len(score_names)
+    assert len(id_names) == n_exp and len(gold_names) == n_exp, \
+        'cross_entropy_over_beam: Scores/Ids/Gold must align per expansion'
+
+    scores = [ctx.lookup(n) for n in score_names]
+    ids = [ctx.lookup(n) for n in id_names]
+    golds = [ctx.lookup(n) for n in gold_names]
+    scores = [s[..., 0] if s.ndim == 3 and s.shape[-1] == 1 else s
+              for s in scores]
+    ids = [i[..., 0] if i.ndim == 3 and i.shape[-1] == 1 else i
+           for i in ids]
+    golds = [g.reshape(-1) for g in golds]
+
+    b = int(golds[0].shape[0])
+    ks = [int(i.shape[1]) for i in ids]  # per-expansion beam width
+    # static path bound: every candidate slot of the widest expansion
+    # could be a surviving path, +1 for the gold-as-extra path
+    p_max = max(int(i.shape[0]) * int(i.shape[1]) for i in ids) + 1
+
+    def build_paths(*args):
+        ids_np = [np.asarray(a, np.int64) for a in args[:n_exp]]
+        golds_np = [np.asarray(a, np.int64) for a in args[n_exp:]]
+        path_row = np.zeros((b, n_exp, p_max), np.int32)
+        path_col = np.zeros((b, n_exp, p_max), np.int32)
+        exp_valid = np.zeros((b, n_exp), np.float32)
+        path_mask = np.zeros((b, p_max), np.bool_)
+        gold_idx = np.zeros((b, ), np.int32)
+
+        # per-expansion row offsets per sequence: expansion 0 has one
+        # row per sequence; expansion e+1 has one row per valid entry
+        starts = [np.zeros(b + 1, np.int64) for _ in range(n_exp)]
+        starts[0] = np.arange(b + 1, dtype=np.int64)
+        for e in range(n_exp - 1):
+            counts = [int((ids_np[e][starts[e][s]:starts[e][s + 1]] >= 0)
+                          .sum()) for s in range(b)]
+            starts[e + 1] = np.concatenate(
+                [[0], np.cumsum(counts)]).astype(np.int64)
+
+        for s in range(b):
+            # --- valid-expansion scan + gold tracking ---
+            gold_row = [0] * (n_exp + 1)   # row of the gold path, per-seq
+            gold_col = [-1] * n_exp
+            valid_cnt = 0
+            gold_off_beam = True
+            for e in range(n_exp):
+                seq_ids = ids_np[e][starts[e][s]:starts[e][s + 1]]
+                row = seq_ids[gold_row[e]] if gold_row[e] < len(seq_ids) \
+                    else np.full((ks[e], ), -1, np.int64)
+                hits = np.nonzero(row == golds_np[e][s])[0]
+                valid_cnt = e + 1
+                if hits.size == 0:
+                    break
+                gold_col[e] = int(hits[0])
+                flat_pos = gold_row[e] * ks[e] + gold_col[e]
+                gold_row[e + 1] = int(
+                    (seq_ids.reshape(-1)[:flat_pos] >= 0).sum())
+            else:
+                gold_off_beam = False
+            last = valid_cnt - 1
+            exp_valid[s, :valid_cnt] = 1.0
+
+            # --- every valid entry of the last expansion is a path ---
+            seq_last = ids_np[last][starts[last][s]:starts[last][s + 1]]
+            entries = [(r, c, int(v))
+                       for r, rowv in enumerate(seq_last)
+                       for c, v in enumerate(rowv) if v >= 0]
+            n_path = len(entries)
+            for p, (r, c, v) in enumerate(entries):
+                path_row[s, last, p] = starts[last][s] + r
+                path_col[s, last, p] = v
+                parent = r
+                for e in range(last - 1, -1, -1):
+                    seq_e = ids_np[e][starts[e][s]:starts[e][s + 1]]
+                    vr, vc = np.nonzero(seq_e >= 0)
+                    pr, pc = int(vr[parent]), int(vc[parent])
+                    path_row[s, e, p] = starts[e][s] + pr
+                    path_col[s, e, p] = int(seq_e[pr, pc])
+                    parent = pr
+            if gold_off_beam:
+                for e in range(valid_cnt):
+                    path_row[s, e, n_path] = starts[e][s] + gold_row[e]
+                    path_col[s, e, n_path] = int(golds_np[e][s])
+                gold_idx[s] = n_path
+                n_path += 1
+            else:
+                flat_pos = gold_row[last] * ks[last] + gold_col[last]
+                gold_idx[s] = int(
+                    (seq_last.reshape(-1)[:flat_pos] >= 0).sum())
+            path_mask[s, :n_path] = True
+        return path_row, path_col, exp_valid, path_mask, gold_idx
+
+    out_spec = (
+        jax.ShapeDtypeStruct((b, n_exp, p_max), jnp.int32),
+        jax.ShapeDtypeStruct((b, n_exp, p_max), jnp.int32),
+        jax.ShapeDtypeStruct((b, n_exp), jnp.float32),
+        jax.ShapeDtypeStruct((b, p_max), jnp.bool_),
+        jax.ShapeDtypeStruct((b, ), jnp.int32),
+    )
+    path_row, path_col, exp_valid, path_mask, gold_idx = jax.pure_callback(
+        build_paths, out_spec,
+        *[i.astype(jnp.int32) for i in ids],
+        *[g.astype(jnp.int32) for g in golds])
+
+    # --- differentiable half: gather + masked softmax CE over paths ---
+    total = jnp.zeros((b, p_max), jnp.float32)
+    for e in range(n_exp):
+        s_e = scores[e].astype(jnp.float32)
+        rows = jnp.clip(path_row[:, e, :], 0, s_e.shape[0] - 1)
+        cols = jnp.clip(path_col[:, e, :], 0, s_e.shape[1] - 1)
+        total = total + s_e[rows, cols] * exp_valid[:, e][:, None]
+    total = jnp.where(path_mask, total, NEG_INF)
+    lse = jax.nn.logsumexp(total, axis=1)
+    gold_score = jnp.take_along_axis(total, gold_idx[:, None].astype(
+        jnp.int32), axis=1)[:, 0]
+    loss = (lse - gold_score)[:, None]
+    ctx.set(op, 'Out', loss)

@@ -174,6 +174,11 @@ _CONCRETE_PRESERVING = {'fill_constant', 'increment', 'assign',
                         'assign_value'}
 
 SEQLEN_SUFFIX = '@SEQLEN'
+# nested (2-level LoD) tensors additionally carry the OUTER level — the
+# number of sub-sequences each top-level sequence owns — as `<name>@ROWS`
+# int32[B]; the padded data rows are then grouped per sequence by
+# cumulative offsets (SURVEY §5.7 nested case)
+ROWS_SUFFIX = '@ROWS'
 # ops that consume sequence structure and emit dense outputs — sequence
 # lengths must NOT propagate through them
 _SEQ_CONSUMERS = {
@@ -191,18 +196,19 @@ def run_op(ctx, op):
     get_lowering(op.type)(ctx, op)
     if op.type in _SEQ_CONSUMERS or op.type.endswith('_grad'):
         return
-    seqlen = None
-    for names in op.inputs.values():
-        for n in names:
-            if (n + SEQLEN_SUFFIX) in ctx.env:
-                seqlen = ctx.env[n + SEQLEN_SUFFIX]
-                break
-        if seqlen is not None:
-            break
-    if seqlen is not None:
-        for names in op.outputs.values():
+    for suffix in (SEQLEN_SUFFIX, ROWS_SUFFIX):
+        meta = None
+        for names in op.inputs.values():
             for n in names:
-                ctx.env.setdefault(n + SEQLEN_SUFFIX, seqlen)
+                if (n + suffix) in ctx.env:
+                    meta = ctx.env[n + suffix]
+                    break
+            if meta is not None:
+                break
+        if meta is not None:
+            for names in op.outputs.values():
+                for n in names:
+                    ctx.env.setdefault(n + suffix, meta)
 
 
 GRAD_SUFFIX = '@GRAD'
@@ -278,9 +284,10 @@ def _make_generic_grad(fwd_type):
         seq_entries = {}
         for names in fwd_inputs.values():
             for n in names:
-                key = n + SEQLEN_SUFFIX
-                if ctx.has(key):
-                    seq_entries[key] = ctx.lookup(key)
+                for suffix in (SEQLEN_SUFFIX, ROWS_SUFFIX):
+                    key = n + suffix
+                    if ctx.has(key):
+                        seq_entries[key] = ctx.lookup(key)
 
         def primal(*diff_vals):
             env2 = dict(seq_entries)

@@ -15,7 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .registry import (register_lowering, register_grad_lowering,
-                       fwd_structure, SEQLEN_SUFFIX)
+                       fwd_structure, SEQLEN_SUFFIX, ROWS_SUFFIX)
 
 
 def _seqlen(ctx, op, slot='X'):
@@ -660,6 +660,57 @@ def _context_project(ctx, op):
             pad = jnp.zeros((b, -off, d), x.dtype)
             parts.append(jnp.concatenate([pad, x[:, :off]], axis=1))
     ctx.set(op, 'Out', jnp.concatenate(parts, axis=2))
+
+
+@register_lowering('sub_nested_seq')
+def _sub_nested_seq(ctx, op):
+    """Select whole sub-sequences of a nested sequence by per-sequence
+    row indices (reference sub_nested_seq_layer;
+    legacy/gserver/layers/SubNestedSequenceLayer.cpp).
+
+    Static-shape design: the nested input arrives padded [R, T, ...]
+    with inner lengths ``X@SEQLEN`` [R] and the outer level ``X@ROWS``
+    [B] (sub-sequences per sequence).  ``SelectedIndices`` is [B, k]
+    (-1 padded) of row indices RELATIVE to each sequence's own rows —
+    the reference's selected_indices contract.  Output keeps the nested
+    form: [B*k, T, ...] rows (invalid selections zeroed, length 0) with
+    fresh @SEQLEN/@ROWS sidecars, so downstream sequence ops and a
+    second selection round both compose."""
+    x = ctx.get(op, 'X')
+    sel = ctx.get(op, 'SelectedIndices')
+    inner = _seqlen(ctx, op, 'X')
+    rows = ctx.env.get(op.input('X')[0] + ROWS_SUFFIX)
+    if inner is None:
+        inner = jnp.full((x.shape[0], ), x.shape[1], jnp.int32)
+    if rows is None:
+        raise ValueError(
+            'sub_nested_seq: input %r carries no @ROWS outer level — '
+            'feed it as a 2-level LoD tensor' % op.input('X')[0])
+    if sel.ndim == 3 and sel.shape[-1] == 1:
+        sel = sel[..., 0]
+    sel = sel.astype(jnp.int32)
+    b, k = sel.shape
+    row_start = jnp.cumsum(rows) - rows            # [B]
+    valid = (sel >= 0) & (sel < rows[:, None])     # [B, k]
+    abs_rows = jnp.clip(row_start[:, None] + jnp.clip(sel, 0), 0,
+                        x.shape[0] - 1).reshape(-1)  # [B*k]
+    flat_valid = valid.reshape(-1)
+    # compact valid rows to packed order (rows of sequence b start at
+    # cumsum of previous sequences' counts) so the output honors the
+    # same nested-layout invariant as the input; invalid selections
+    # scatter into a scratch row that is sliced off
+    n_out = b * k
+    pos = jnp.cumsum(flat_valid) - 1               # rank among valid
+    target = jnp.where(flat_valid, pos, n_out)
+    gathered = x[abs_rows]
+    out = jnp.zeros((n_out + 1, ) + x.shape[1:], x.dtype)
+    out = out.at[target].set(gathered)[:n_out]
+    out_inner = jnp.zeros((n_out + 1, ), jnp.int32).at[target].set(
+        inner[abs_rows].astype(jnp.int32))[:n_out]
+    ctx.set(op, 'Out', out)
+    ctx.env[op.output('Out')[0] + SEQLEN_SUFFIX] = out_inner
+    ctx.env[op.output('Out')[0] + ROWS_SUFFIX] = valid.sum(
+        axis=1).astype(jnp.int32)
 
 
 @register_lowering('kmax_seq_score')

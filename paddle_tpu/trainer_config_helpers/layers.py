@@ -54,6 +54,9 @@ __all__ = [
     'crf_layer', 'crf_decoding_layer', 'ctc_layer', 'warp_ctc_layer',
     'hsigmoid', 'nce_layer', 'sum_cost', 'huber_regression_cost',
     'huber_classification_cost', 'lambda_cost', 'cross_entropy_with_selfnorm',
+    # round-4: the last three builders (108/108, VERDICT r3 next-#4)
+    'sub_nested_seq_layer', 'BeamInput', 'cross_entropy_over_beam',
+    'beam_search', 'GeneratedInput',
 ]
 
 _OUTPUTS = []
@@ -62,13 +65,17 @@ _OUTPUTS = []
 def data_layer(name, size, data_type_kind='dense', seq=False, **kwargs):
     """(reference layers.py data_layer).  The legacy DSL declares only
     name+size; the value kind rides ``data_type_kind``:
-    'dense'|'index', seq=True for sequence input."""
+    'dense'|'index', seq=True for sequence input, seq='sub' for a
+    nested (SUB_SEQUENCE) input."""
+    nested = seq in ('sub', 'nested', 2)
     if data_type_kind == 'index':
-        t = _dt.integer_value_sequence(size) if seq else \
-            _dt.integer_value(size)
+        t = (_dt.integer_value_sub_sequence(size) if nested else
+             _dt.integer_value_sequence(size) if seq else
+             _dt.integer_value(size))
     else:
-        t = _dt.dense_vector_sequence(size) if seq else \
-            _dt.dense_vector(size)
+        t = (_dt.dense_vector_sub_sequence(size) if nested else
+             _dt.dense_vector_sequence(size) if seq else
+             _dt.dense_vector(size))
     return _v2.data(name=name, type=t)
 
 
@@ -81,7 +88,13 @@ def _with_layer_attr(layer, kwargs):
     la = kwargs.get('layer_attr')
     dr = getattr(la, 'drop_rate', None) if la is not None else None
     if dr:
-        return _v2.dropout(input=layer, dropout_rate=dr)
+        wrapped = _v2.dropout(input=layer, dropout_rate=dr)
+        # the user-facing layer NAME must resolve to the post-dropout
+        # value — the legacy config_parser applies drop_rate on the
+        # named layer itself, so memory(name=...) links and downstream
+        # name lookups see the dropped output
+        wrapped.name, layer.name = layer.name, wrapped.name
+        return wrapped
     return layer
 
 
@@ -164,6 +177,9 @@ def maxid_layer(input, name=None, **kwargs):
 
 memory = _v2.memory
 recurrent_group = _v2.recurrent_group
+beam_search = _v2.beam_search
+GeneratedInput = _v2.GeneratedInput
+BaseGeneratedInput = _v2.BaseGeneratedInput
 StaticInput = _v2.StaticInput
 
 
@@ -347,6 +363,18 @@ def crop_layer(input, shape=None, offsets=None, name=None, **kwargs):
 
 def sub_seq_layer(input, starts, ends, name=None, **kwargs):
     return _v2.sub_seq(input=input, starts=starts, ends=ends, name=name)
+
+
+def sub_nested_seq_layer(input, selected_indices, name=None, **kwargs):
+    return _v2.sub_nested_seq(input=input,
+                              selected_indices=selected_indices, name=name)
+
+
+BeamInput = _v2.BeamInput
+
+
+def cross_entropy_over_beam(input, name=None, **kwargs):
+    return _v2.cross_entropy_over_beam(input=input, name=name)
 
 
 def kmax_seq_score_layer(input, beam_size=1, name=None, **kwargs):

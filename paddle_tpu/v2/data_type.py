@@ -32,6 +32,14 @@ def dense_array(dim):
     return InputType(dim, SequenceType.NO_SEQUENCE, DataType.Dense)
 
 
+def dense_vector_sub_sequence(dim):
+    return InputType(dim, 2, DataType.Dense)
+
+
+def integer_value_sub_sequence(value_range):
+    return InputType(value_range, 2, DataType.Index)
+
+
 def dense_vector_sequence(dim):
     return InputType(dim, SequenceType.SEQUENCE, DataType.Dense)
 

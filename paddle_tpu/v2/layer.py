@@ -43,6 +43,9 @@ __all__ = [
     'recurrent', 'img_conv3d', 'img_pool3d', 'factorization_machine',
     'scaling_projection', 'slice_projection', 'dotmul_operator',
     'detection_output', 'scale_sub_region', 'conv_operator',
+    # round-4: the last legacy-DSL builders (VERDICT r3 next-#4)
+    'sub_nested_seq', 'beam_search', 'GeneratedInput', 'BaseGeneratedInput',
+    'BeamInput', 'cross_entropy_over_beam',
 ]
 
 
@@ -73,22 +76,20 @@ class Layer(object):
 
 
 def data(name, type, **kwargs):
-    """Input declaration (reference layer.py data / data_layer)."""
+    """Input declaration (reference layer.py data / data_layer).
+    SUB_SEQUENCE (seq_type=2) declares a nested 2-level LoD input: the
+    runtime carries it padded [rows, T, ...] with inner lengths plus the
+    outer sub-sequences-per-sequence level (ops/registry.py ROWS_SUFFIX
+    — SURVEY §5.7 nested case)."""
     t = type
-    if getattr(t, 'seq_type', 0) == 2:
-        raise NotImplementedError(
-            'SUB_SEQUENCE (nested lod_level=2) inputs are not supported '
-            'by the v2 shim - flatten to SEQUENCE or use the fluid API '
-            'with lod_level=2 where the op supports it')
 
     def build(ctx):
+        lod = int(getattr(t, 'seq_type', 0) or 0)
         if t.type == _data_type.DataType.Index:
             return fluid.layers.data(
-                name=name, shape=[1], dtype='int64',
-                lod_level=1 if t.seq_type else 0)
+                name=name, shape=[1], dtype='int64', lod_level=lod)
         return fluid.layers.data(
-            name=name, shape=[t.dim], dtype='float32',
-            lod_level=1 if t.seq_type else 0)
+            name=name, shape=[t.dim], dtype='float32', lod_level=lod)
 
     layer = Layer('data', [], build, name=name, size=t.dim)
     layer.data_type = t
@@ -1071,6 +1072,241 @@ def sub_seq(input, starts, ends, name=None, **kwargs):
 
     return Layer('sub_seq', [input, starts, ends], build, name=name,
                  size=input.size)
+
+
+class BaseGeneratedInput(object):
+    """Marker base for generation-time inputs of beam_search
+    (reference layers.py:4282)."""
+
+    def __init__(self):
+        self.bos_id = None
+        self.eos_id = None
+
+
+class GeneratedInput(BaseGeneratedInput):
+    """The previously-generated word fed back into the step: an
+    embedding lookup (shared table ``embedding_name``) of the last
+    step's predicted ids (reference layers.py:4294)."""
+
+    def __init__(self, size, embedding_name, embedding_size):
+        super(GeneratedInput, self).__init__()
+        self.size = size
+        self.embedding_name = embedding_name
+        self.embedding_size = embedding_size
+
+
+class _BeamRNNAdapter(object):
+    """ctx['__rnn__'] stand-in inside beam_search: memory() calls from
+    the step DSL land on the StaticRNN decode loop, with boot values
+    beam-expanded from [B, H] to the static [B*K, H] beam layout."""
+
+    def __init__(self, rnn, batch_ref, beam_size):
+        self._rnn = rnn
+        self._batch_ref = batch_ref
+        self._k = beam_size
+
+    def memory(self, init=None, shape=None, value=0.0):
+        if init is not None:
+            return self._rnn.memory(
+                init=fluid.layers.beam_expand(init, self._k))
+        return self._rnn.memory(shape=list(shape),
+                                batch_ref=self._batch_ref,
+                                init_value=value, ref_batch_dim_idx=0)
+
+    def update_memory(self, mem, var):
+        self._rnn.update_memory(mem, var)
+
+
+def beam_search(step, input, bos_id, eos_id, beam_size, max_length=500,
+                name=None, num_results_per_sample=None, **kwargs):
+    """Generation-mode recurrent group: run ``step`` per decode step and
+    beam-search over its softmax output (reference layers.py:4485).
+
+    TPU-native mechanism (SURVEY §5.7): instead of the reference's
+    RecurrentLayerGroupSetGenerator machinery over growing LoD beams,
+    the decode loop is a StaticRNN of ``max_length`` steps on the static
+    [B*K] beam layout — topk + the beam_search op select survivors,
+    every step memory is re-wired to its surviving parent row by
+    gather-by-parent_idx, and beam_search_decode backtracks the parent
+    pointers into finished sentences (ops/beam_search_ops.py).
+
+    ``step`` is the same DSL callable recurrent_group takes; ``memory()``
+    boots are beam-expanded to [B*K, H].  Boot layers must derive from
+    the static inputs (built in the parent block).  Returns the decoded
+    ids [B, num_results_per_sample, <=max_length]."""
+    if num_results_per_sample is None:
+        num_results_per_sample = beam_size
+    if num_results_per_sample > beam_size:
+        raise ValueError('num_results_per_sample (%d) must not exceed '
+                         'beam_size (%d)'
+                         % (num_results_per_sample, beam_size))
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    gen_idx = -1
+    static_specs = []
+    for i, each in enumerate(inputs):
+        if isinstance(each, Layer):
+            raise TypeError('in beam_search, none of the inputs may be a '
+                            'plain layer: wrap whole-sequence context in '
+                            'StaticInput')
+        if isinstance(each, BaseGeneratedInput):
+            if gen_idx != -1:
+                raise ValueError('beam_search accepts only one '
+                                 'GeneratedInput')
+            gen_idx = i
+        else:
+            static_specs.append(each)
+    if gen_idx == -1:
+        raise ValueError('beam_search: no GeneratedInput given')
+    gipt = inputs[gen_idx]
+    gipt.bos_id, gipt.eos_id = bos_id, eos_id
+    seq_parents = [s.input for s in static_specs]
+
+    def build(ctx, *static_vars):
+        if not static_vars:
+            raise ValueError(
+                'beam_search needs at least one StaticInput to anchor '
+                'the batch dimension (the encoder context)')
+        anchor = static_vars[0]
+        anchor_beam = fluid.layers.beam_expand(anchor, beam_size)
+        init_ids = fluid.layers.fill_constant_batch_size_like(
+            input=anchor_beam, shape=[-1, 1], value=float(bos_id),
+            dtype='int64')
+        init_scores = fluid.layers.beam_init_scores(anchor, beam_size)
+
+        rnn = fluid.layers.StaticRNN()
+        ticker = fluid.layers.fill_constant_batch_size_like(
+            input=init_scores, shape=[max_length, -1, 1], value=0.0,
+            dtype='float32', input_dim_idx=0, output_dim_idx=1)
+        outer_rnn = ctx.get('__rnn__')
+        outer_pending = ctx.pop('__pending_memories__', None)
+        with rnn.step():
+            rnn.step_input(ticker)
+            prev_ids = rnn.memory(init=init_ids)
+            prev_scores = rnn.memory(init=init_scores)
+            ctx['__rnn__'] = _BeamRNNAdapter(rnn, anchor_beam, beam_size)
+
+            trg_emb = fluid.layers.embedding(
+                prev_ids, size=[gipt.size, gipt.embedding_size],
+                dtype='float32',
+                param_attr=fluid.ParamAttr(name=gipt.embedding_name))
+            step_layers = []
+            si = 0
+            for i, spec in enumerate(inputs):
+                if i == gen_idx:
+                    step_layers.append(
+                        _wrap_fluid_var(ctx, trg_emb, 'generated_input'))
+                else:
+                    step_layers.append(_wrap_fluid_var(
+                        ctx,
+                        fluid.layers.beam_expand(static_vars[si],
+                                                 beam_size),
+                        'static_input'))
+                    si += 1
+            out_layer = step(*step_layers)
+            out_var = out_layer.to_fluid(ctx)  # [B*K, V] next-word probs
+
+            topk_scores, topk_indices = fluid.layers.topk(
+                out_var, k=beam_size)
+            accu_scores = fluid.layers.elementwise_add(
+                fluid.layers.log(topk_scores), prev_scores)
+            sel_ids, sel_scores, parent_idx = fluid.layers.beam_search(
+                prev_ids, prev_scores, topk_indices, accu_scores,
+                beam_size, end_id=eos_id)
+            for mem_var, link_name in ctx.pop('__pending_memories__', []):
+                target = ctx.get(link_name)
+                if target is None:
+                    raise RuntimeError(
+                        'memory(name=%r): no step layer with that name '
+                        'was built' % link_name)
+                rnn.update_memory(
+                    mem_var, fluid.layers.gather(target, parent_idx))
+            rnn.update_memory(prev_ids, sel_ids)
+            rnn.update_memory(prev_scores, sel_scores)
+            rnn.output(sel_ids, sel_scores, parent_idx)
+        if outer_rnn is not None:
+            ctx['__rnn__'] = outer_rnn
+        else:
+            ctx.pop('__rnn__', None)
+        if outer_pending is not None:
+            ctx['__pending_memories__'] = outer_pending
+
+        ids_arr, scores_arr, parents_arr = rnn()
+        sent_ids, _sent_scores = fluid.layers.beam_search_decode(
+            ids_arr, scores_arr, parents_arr, beam_size=beam_size,
+            end_id=eos_id)
+        if num_results_per_sample < beam_size:
+            sent_ids = fluid.layers.slice(
+                sent_ids, axes=[1], starts=[0],
+                ends=[num_results_per_sample])
+        return sent_ids
+
+    return Layer('beam_search', seq_parents, build, name=name)
+
+
+class BeamInput(object):
+    """One beam expansion for cross_entropy_over_beam (reference
+    layers.py:6441): scores over all candidates (nested seq of width-1
+    rows), the top-k selected candidate ids, and the gold candidate."""
+
+    def __init__(self, candidate_scores, selected_candidates, gold):
+        self.candidate_scores = candidate_scores
+        self.selected_candidates = selected_candidates
+        self.gold = gold
+
+
+def cross_entropy_over_beam(input, name=None, **kwargs):
+    """Learning-to-search cost over beam expansions (reference
+    layers.py:6465; kernel CrossEntropyOverBeam.cpp — see
+    ops/beam_search_ops.py for the TPU-native split: host path
+    construction + in-XLA gather/softmax so the score gradient flows)."""
+    beams = [input] if isinstance(input, BeamInput) else list(input)
+    for bm in beams:
+        if not isinstance(bm, BeamInput):
+            raise TypeError('cross_entropy_over_beam takes BeamInput '
+                            'objects, got %r' % (bm, ))
+    parents = []
+    for bm in beams:
+        parents += [bm.candidate_scores, bm.selected_candidates, bm.gold]
+
+    def build(ctx, *parent_vars):
+        from ..fluid.layer_helper import LayerHelper
+        helper = LayerHelper('cross_entropy_over_beam')
+        out = helper.create_variable_for_type_inference(dtype='float32')
+        out.shape = (-1, 1)
+        helper.append_op(
+            type='cross_entropy_over_beam',
+            inputs={'Scores': list(parent_vars[0::3]),
+                    'Ids': list(parent_vars[1::3]),
+                    'Gold': list(parent_vars[2::3])},
+            outputs={'Out': [out]})
+        return fluid.layers.mean(out)
+
+    layer = Layer('cross_entropy_over_beam', parents, build, name=name,
+                  size=1)
+    layer.is_cost = True
+    return layer
+
+
+def sub_nested_seq(input, selected_indices, name=None, **kwargs):
+    """Trim a nested sequence to the sub-sequences picked by
+    ``selected_indices`` [B, k] (reference sub_nested_seq_layer;
+    SubNestedSequenceLayer.cpp) — its own op lowering because both LoD
+    levels live only on the padded runtime layout
+    (ops/sequence_ops.py sub_nested_seq)."""
+
+    def build(ctx, v, sv):
+        from ..fluid.layer_helper import LayerHelper
+        helper = LayerHelper('sub_nested_seq')
+        out = helper.create_variable_for_type_inference(dtype=v.dtype)
+        out.shape = v.shape
+        helper.append_op(
+            type='sub_nested_seq',
+            inputs={'X': [v], 'SelectedIndices': [sv]},
+            outputs={'Out': [out]})
+        return out
+
+    return Layer('sub_nested_seq', [input, selected_indices], build,
+                 name=name, size=input.size)
 
 
 def kmax_seq_score(input, beam_size=1, name=None, **kwargs):

@@ -1,0 +1,179 @@
+"""The last legacy-DSL builders (VERDICT r3 next-#4, 108/108):
+sub_nested_seq_layer + cross_entropy_over_beam, against hand-computed
+oracles of the reference kernels (SubNestedSequenceLayer.cpp,
+CrossEntropyOverBeam.cpp).
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.v2 as paddle
+from paddle_tpu import trainer_config_helpers as tch
+from paddle_tpu.fluid.layer_helper import LayerHelper
+
+
+def setup_function(_fn):
+    tch.reset_config()
+
+
+def _beam_cost_program(n_exp, score_feeds):
+    """Build main/startup with the raw op; score_feeds[e] True -> data
+    var (LoD), False -> trainable parameter (for the gradient test)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        scores, ids, golds = [], [], []
+        for e in range(n_exp):
+            if score_feeds[e] is True:
+                scores.append(fluid.layers.data(
+                    's%d' % e, shape=[1], dtype='float32', lod_level=1))
+            else:
+                scores.append(fluid.layers.create_parameter(
+                    shape=list(score_feeds[e]), dtype='float32',
+                    name='score_param_%d' % e,
+                    default_initializer=fluid.initializer.
+                    NormalInitializer(scale=0.1)))
+            ids.append(fluid.layers.data(
+                'i%d' % e, shape=[-1], dtype='float32'))
+            golds.append(fluid.layers.data(
+                'g%d' % e, shape=[1], dtype='int64'))
+        helper = LayerHelper('cross_entropy_over_beam')
+        out = helper.create_variable_for_type_inference(dtype='float32')
+        out.shape = (-1, 1)
+        helper.append_op(
+            type='cross_entropy_over_beam',
+            inputs={'Scores': scores, 'Ids': ids, 'Gold': golds},
+            outputs={'Out': [out]})
+        loss = fluid.layers.mean(out)
+    return main, startup, out, loss
+
+
+def test_cross_entropy_over_beam_matches_hand_oracle():
+    """B=2, K=2, E=2.  Sequence 0 keeps gold in beam both steps;
+    sequence 1 loses gold at step 0 (goldAsExtraPath)."""
+    main, startup, out, _ = _beam_cost_program(2, [True, True])
+
+    s0 = fluid.create_lod_tensor(
+        np.asarray([[.1], [.7], [.2], [.5], [.6]], 'float32'), [[3, 2]])
+    s1 = fluid.create_lod_tensor(
+        np.asarray([[.3], [.4], [.9], [.2], [.1]], 'float32'),
+        [[2, 1, 2]])
+    feed = {
+        's0': s0, 's1': s1,
+        'i0': np.asarray([[1, 2], [0, -1]], 'float32'),
+        'i1': np.asarray([[0, 1], [0, -1], [1, -1]], 'float32'),
+        'g0': np.asarray([[1], [1]], 'int64'),
+        'g1': np.asarray([[1], [0]], 'int64'),
+    }
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        loss_v, = exe.run(main, feed=feed, fetch_list=[out])
+    loss_v = np.asarray(loss_v).reshape(-1)
+
+    # sequence 0: 3 paths, scores [0.3+0.7, 0.4+0.7, 0.9+0.2]; gold is
+    # path 1 (second valid entry of the final beam)
+    p0 = np.asarray([1.0, 1.1, 1.1])
+    want0 = np.log(np.exp(p0).sum()) - p0[1]
+    # sequence 1: gold falls off at step 0 -> paths are the step-0 beam
+    # [0.5] plus the gold path [0.6] appended
+    p1 = np.asarray([0.5, 0.6])
+    want1 = np.log(np.exp(p1).sum()) - p1[1]
+    np.testing.assert_allclose(loss_v, [want0, want1], rtol=1e-5)
+
+
+def test_cross_entropy_over_beam_mixed_beam_widths():
+    """Expansions may have different beam widths (K0=2, K1=3): flat
+    positions and the path bound must use each expansion's own width."""
+    main, startup, out, _ = _beam_cost_program(2, [True, True])
+    s0 = fluid.create_lod_tensor(
+        np.asarray([[.1], [.2]], 'float32'), [[2]])
+    s1 = fluid.create_lod_tensor(
+        np.asarray([[.5], [.6], [.7], [.8], [.9], [1.0]], 'float32'),
+        [[3, 3]])
+    feed = {
+        's0': s0, 's1': s1,
+        'i0': np.asarray([[0, 1]], 'float32'),
+        'i1': np.asarray([[1, -1, -1], [0, 2, -1]], 'float32'),
+        'g0': np.asarray([[0]], 'int64'),
+        'g1': np.asarray([[2]], 'int64'),
+    }
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        loss_v, = exe.run(main, feed=feed, fetch_list=[out])
+    # gold survives step 0 (col 0), falls off at step 1 (its row selects
+    # ids {1}) -> 3 beam paths [.1+.6, .2+.8, .2+1.0] + gold extra
+    # path [.1+.7]
+    p = np.asarray([0.7, 1.0, 1.2, 0.8])
+    want = np.log(np.exp(p).sum()) - p[3]
+    np.testing.assert_allclose(
+        np.asarray(loss_v).reshape(-1), [want], rtol=1e-5)
+
+
+def test_padded_sequence_reader_path_carries_outer_level():
+    """The double-buffer reader path must not drop the nested outer
+    level (PaddedSequence.rows -> @ROWS sideband)."""
+    from paddle_tpu.fluid.executor import prepare_feed_arrays
+    from paddle_tpu.ops import registry
+    ps = fluid.core.PaddedSequence(
+        np.zeros((3, 4, 2), 'float32'), np.asarray([2, 1, 3], 'int32'),
+        rows=np.asarray([2, 1], 'int32'))
+    arrays = prepare_feed_arrays({'x': ps})
+    np.testing.assert_array_equal(
+        arrays['x' + registry.ROWS_SUFFIX], [2, 1])
+    assert 'x' + registry.SEQLEN_SUFFIX in arrays
+
+
+def test_cross_entropy_over_beam_gradient_trains_scores():
+    """Scores as trainable parameters: SGD on the cost must push the
+    gold paths' scores up (the CrossEntropyOverBeam backward)."""
+    main, startup, out, loss = _beam_cost_program(
+        2, [(2, 2), (3, 2)])
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    feed = {
+        'i0': np.asarray([[1, 0], [0, 1]], 'float32'),
+        'i1': np.asarray([[0, 1], [1, -1], [0, 1]], 'float32'),
+        'g0': np.asarray([[1], [0]], 'int64'),
+        'g1': np.asarray([[1], [1]], 'int64'),
+    }
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        losses = [float(np.asarray(
+            exe.run(main, feed=feed, fetch_list=[loss])[0]).reshape(-1)[0])
+            for _ in range(25)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_sub_nested_seq_layer_selects_rows_tch():
+    """The tch builder end-to-end over the v2 DAG: nested input,
+    per-sequence row selection, pooled downstream — values pinned."""
+    x = tch.data_layer(name='x', size=2, seq='sub')
+    sel = tch.data_layer(name='sel', size=2)
+    sub = tch.sub_nested_seq_layer(input=x, selected_indices=sel)
+    pooled = tch.pooling_layer(input=sub, pooling_type=tch.SumPooling())
+
+    # drive the DAG through fluid directly (value-pinning test; the
+    # trainer path is exercised by the breadth suite)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        out_var = pooled.to_fluid({})
+    rows = [
+        [[1., 1.], [2., 2.]],
+        [[10., 10.]],
+        [[3., 3.], [4., 4.], [5., 5.]],
+        [[7., 7.], [8., 8.]],
+    ]
+    flat = np.concatenate([np.asarray(r, 'float32') for r in rows])
+    lt = fluid.create_lod_tensor(flat, [[3, 1], [2, 1, 3, 2]])
+    sel_np = np.asarray([[2, 0], [0, -1]], 'float32')
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        got, = exe.run(main, feed={'x': lt, 'sel': sel_np},
+                       fetch_list=[out_var])
+    got = np.asarray(got)
+    # packed rows: [c, a, d, pad] summed over time
+    np.testing.assert_allclose(got[:4, 0], [12., 3., 15., 0.], rtol=1e-6)

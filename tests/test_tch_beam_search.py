@@ -1,0 +1,116 @@
+"""tch beam_search generation DSL (reference layers.py:4485) — the
+decode loop runs on the static [B*K] layout (StaticRNN + beam_search op
++ parent backtrack; see v2/layer.py beam_search).
+
+Oracle: with sharply-peaked step distributions the beam top-1 equals the
+greedy argmax rollout, which we recompute in numpy from the ACTUAL
+parameter values pulled out of the scope — an independent re-execution
+of the whole decoder math.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.v2 as paddle
+from paddle_tpu import trainer_config_helpers as tch
+
+VOCAB, EMB, HID = 7, 4, 4
+BOS, EOS, K, MAXLEN = 0, 1, 2, 4
+
+
+def _build_decoder():
+    enc = tch.data_layer(name='enc', size=HID)
+
+    def step(context, word):
+        mem = tch.memory(name='dec_h', size=HID)
+        h = tch.fc_layer(input=[word, context, mem], size=HID,
+                         act=tch.TanhActivation(), name='dec_h',
+                         param_attr=[tch.ParamAttr(name='w_word'),
+                                     tch.ParamAttr(name='w_ctx'),
+                                     tch.ParamAttr(name='w_mem')],
+                         bias_attr=tch.ParamAttr(name='b_h'))
+        return tch.fc_layer(input=h, size=VOCAB,
+                            act=tch.SoftmaxActivation(),
+                            param_attr=tch.ParamAttr(name='w_out'),
+                            bias_attr=tch.ParamAttr(name='b_out'))
+
+    return enc, tch.beam_search(
+        step=step,
+        input=[tch.StaticInput(enc),
+               tch.GeneratedInput(size=VOCAB, embedding_name='gen_emb',
+                                  embedding_size=EMB)],
+        bos_id=BOS, eos_id=EOS, beam_size=K, max_length=MAXLEN)
+
+
+def _greedy_rollout(params, enc_row):
+    """Independent numpy re-execution: argmax rollout of the decoder."""
+    emb = params['gen_emb']
+    h = np.zeros(HID, 'float32')
+    prev = BOS
+    out = []
+    for _ in range(MAXLEN):
+        x = emb[prev]
+        pre = (x @ params['w_word'] + enc_row @ params['w_ctx'] +
+               h @ params['w_mem'] + params['b_h'])
+        h = np.tanh(pre)
+        logits = h @ params['w_out'] + params['b_out']
+        p = np.exp(logits - logits.max())
+        p /= p.sum()
+        nxt = int(p.argmax())
+        out.append(nxt)
+        if nxt == EOS:
+            break
+        prev = nxt
+    return out
+
+
+def test_beam_search_generates_and_matches_greedy_oracle():
+    tch.reset_config()
+    enc, gen = _build_decoder()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        out_var = gen.to_fluid({})
+
+    rng = np.random.RandomState(7)
+    enc_np = (rng.standard_normal((2, HID)) * 2.0).astype('float32')
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # scale the parameters up so every step distribution is sharply
+        # peaked -> beam top-1 == greedy rollout
+        params = {}
+        for name in ('gen_emb', 'w_word', 'w_ctx', 'w_mem', 'b_h',
+                     'w_out', 'b_out'):
+            v = np.asarray(fluid.fetch_var(name, scope))
+            v = (v * 3.0).astype('float32')
+            scope.find_var(name).set_value(v)
+            params[name] = v
+        ids, = exe.run(main, feed={'enc': enc_np}, fetch_list=[out_var])
+
+    ids = np.asarray(ids)
+    assert ids.shape[0] == 2 and ids.shape[1] == K
+    assert ids.shape[2] <= MAXLEN
+    assert ((ids >= -1) & (ids < VOCAB)).all()
+
+    for b in range(2):
+        want = _greedy_rollout(params, enc_np[b])
+        got = [int(v) for v in ids[b, 0] if v >= 0]
+        # drop the trailing eos padding the decode backtrack may carry
+        assert got[:len(want)] == want, (b, got, want)
+
+
+def test_beam_search_validates_inputs():
+    tch.reset_config()
+    enc = tch.data_layer(name='enc2', size=HID)
+    import pytest
+    with pytest.raises(ValueError):
+        tch.beam_search(step=lambda *a: a[0],
+                        input=[tch.StaticInput(enc)],
+                        bos_id=0, eos_id=1, beam_size=2)
+    with pytest.raises(ValueError):
+        tch.beam_search(step=lambda *a: a[0],
+                        input=[tch.GeneratedInput(VOCAB, 'e', EMB)],
+                        bos_id=0, eos_id=1, beam_size=2,
+                        num_results_per_sample=5)

@@ -142,10 +142,15 @@ def test_layer_attr_drop_rate_wraps_in_dropout():
     x = tch.data_layer(name='x', size=4)
     plain = tch.fc_layer(input=x, size=3)
     assert plain.kind == 'fc'
-    dropped = tch.fc_layer(input=x, size=3,
+    dropped = tch.fc_layer(input=x, size=3, name='nm',
                            layer_attr=tch.ExtraAttr(drop_rate=0.5))
     assert dropped.kind == 'dropout'
     assert dropped.parents[0].kind == 'fc'
+    # the user-facing NAME resolves to the post-dropout value, so
+    # memory(name='nm') links see dropout (legacy config_parser applies
+    # drop_rate on the named layer itself)
+    assert dropped.name == 'nm'
+    assert dropped.parents[0].name != 'nm'
 
 
 def test_img_conv_bias_attr_false_and_param_name():
