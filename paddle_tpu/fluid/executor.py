@@ -341,6 +341,13 @@ class _CompiledBlock(object):
                                            batch_axis=spmd_ref['batch_axis'])
             for op in ops:
                 registry.run_op(ctx, op)
+            for n in fetch_names_:
+                if n in ctx.cond_uninit:
+                    raise RuntimeError(
+                        'fetch of var %r, whose only assignment is '
+                        'inside a single conditional_block — '
+                        'uninitialized when the cond is false '
+                        '(reference conditional_block_op.cc)' % n)
             new_state = {n: env[n] for n in state_out_ if n in env}
             fetches = [env[n] for n in fetch_names_]
             return new_state, fetches
@@ -363,6 +370,17 @@ class _CompiledBlock(object):
         for op in self.ops:
             host_impl = registry.get_host_op(op.type)
             if host_impl is not None:
+                # host ops bypass run_op: apply the may-read-before-
+                # write check here (a save/print of a cond-uninit var
+                # is exactly the reference's uninitialized-read error)
+                for n in op.input_arg_names:
+                    if n in ctx.cond_uninit:
+                        raise RuntimeError(
+                            'host op %r reads var %r, whose only '
+                            'assignment is inside a single '
+                            'conditional_block — uninitialized when '
+                            'the cond is false (reference '
+                            'conditional_block_op.cc)' % (op.type, n))
                 host_impl(ctx, op, scope)
             else:
                 registry.run_op(ctx, op)
@@ -371,6 +389,13 @@ class _CompiledBlock(object):
                 _check_nan_inf(
                     [(n, env[n]) for n in op.output_arg_names if n in env],
                     'output of op %r' % op.type)
+        for n in self.fetch_names:
+            if n in ctx.cond_uninit:
+                raise RuntimeError(
+                    'fetch of var %r, whose only assignment is inside '
+                    'a single conditional_block — uninitialized when '
+                    'the cond is false (reference '
+                    'conditional_block_op.cc)' % n)
         new_state = {n: env[n] for n in self.state_out if n in env}
         fetches = [env[n] for n in self.fetch_names]
         return new_state, fetches

@@ -57,7 +57,10 @@ def _run_block_eager(block, scope, env):
     """Execute a block's ops sequentially with concrete values (the host
     fallback interpreter — reference Executor::Run over a sub-block)."""
     from ..fluid import core
-    ctx = LoweringContext(block, env, rng_key=None, place=core.CPUPlace())
+    # goroutine bodies run detached from the spawning trace: treat as a
+    # conditional scope (no cond-uninit checks or clears)
+    ctx = LoweringContext(block, env, rng_key=None, place=core.CPUPlace(),
+                          conditional_scope=True)
     ctx.scope = scope
     for op in block.ops:
         host_impl = get_host_op(op.type)

@@ -32,8 +32,12 @@ def _block_reads_writes(block):
 
 
 def _run_block(ctx, block, env):
+    # may-read-before-write tracking spans nested blocks (shared set);
+    # the body executes conditionally, so its reads aren't checked and
+    # its writes don't clear the flag (registry.LoweringContext)
     sub = LoweringContext(block, env, rng_key=None, is_test=ctx.is_test,
-                          place=ctx.place)
+                          place=ctx.place, cond_uninit=ctx.cond_uninit,
+                          conditional_scope=True)
     for op in block.ops:
         run_op(sub, op)
     return env
@@ -386,7 +390,14 @@ def _ifelse(ctx, op):
 @register_lowering('conditional_block')
 def _conditional_block(ctx, op):
     """Reference conditional_block_op.cc: run sub-block if cond; written
-    vars keep old values otherwise (select blend)."""
+    vars keep old values otherwise (select blend).
+
+    A var whose FIRST assignment is this block gets a zero-filled
+    else-value — unobservable once a second branch (the IfElse pattern)
+    or any later unconditional write covers it; until then the name is
+    tracked in ctx.cond_uninit and any read of it is rejected at
+    lowering time, reproducing the reference's uninitialized-read error
+    (there: a runtime enforce on the cond-false path)."""
     conds = ctx.get_list(op, 'X') if op.input('X') else ctx.get_list(
         op, 'Cond')
     block = op.attrs['sub_block']
@@ -399,7 +410,18 @@ def _conditional_block(ctx, op):
         if n in block.vars:
             continue  # block-local temp
         new = env[n]
-        old = ctx.lookup(n) if ctx.has(n) else jnp.zeros_like(new)
+        if ctx.has(n):
+            old = ctx.lookup(n)
+            # a second conditional write is treated as covering the
+            # name (the IfElse complementary-branch pattern).  Cond
+            # EQUIVALENCE is not decidable at desc level, so two blocks
+            # with unrelated conds also clear — a documented
+            # approximation; the reference would error at run time only
+            # if both conds were false AND the var was then read
+            ctx.cond_uninit.discard(n)
+        else:
+            old = jnp.zeros_like(new)
+            ctx.cond_uninit.add(n)
         ctx.store(n, jnp.where(c, new, old))
 
 

@@ -88,7 +88,8 @@ class LoweringContext(object):
     """
 
     def __init__(self, block, env, rng_key=None, is_test=False, place=None,
-                 mesh=None, batch_axis=None):
+                 mesh=None, batch_axis=None, cond_uninit=None,
+                 conditional_scope=False):
         self.block = block
         self.env = env
         self._rng = rng_key
@@ -111,6 +112,21 @@ class LoweringContext(object):
         # time and popped (reverse order) by the array ops' backwards —
         # in-place index vars make self.concrete stale by backward time
         self.array_log = {}
+        # names whose ONLY assignment so far is inside a single
+        # conditional_block: the reference leaves such a var
+        # uninitialized when the cond is false and errors on read
+        # (conditional_block_op.cc); the blended lowering zero-fills
+        # instead, which is unobservable once a second branch (or any
+        # unconditional op) writes the name — until then, a read is a
+        # may-read-before-write program error and is rejected at
+        # lowering time.  The set is SHARED down nested contexts (pass
+        # cond_uninit); conditional_scope=True marks a context whose ops
+        # execute conditionally (branch/loop bodies) — there, reads are
+        # not checked (a same-cond guarded read is legal in the
+        # reference) and writes do not clear the flag (the write itself
+        # may never execute).
+        self.cond_uninit = cond_uninit if cond_uninit is not None else set()
+        self.conditional_scope = conditional_scope
 
     # ---- value access ----
     def get(self, op, slot, default=None):
@@ -160,7 +176,9 @@ class LoweringContext(object):
             is_test=self.is_test,
             place=self.place,
             mesh=self.mesh,
-            batch_axis=self.batch_axis)
+            batch_axis=self.batch_axis,
+            cond_uninit=self.cond_uninit,
+            conditional_scope=self.conditional_scope)
         # trace-time constants survive into re-traces (grad synthesis,
         # sub-blocks): lowerings that need concrete values (lod_reset
         # offsets, tensor-array indices) behave identically there
@@ -189,11 +207,30 @@ _SEQ_CONSUMERS = {
 def run_op(ctx, op):
     """Lower one op into the trace, propagating sequence-length metadata
     (the static-shape stand-in for LoD, SURVEY §5.7)."""
+    guarded = ctx.conditional_scope or op.type == 'conditional_block'
+    if ctx.cond_uninit and not guarded:
+        for names in op.inputs.values():
+            for n in names:
+                if n in ctx.cond_uninit:
+                    raise RuntimeError(
+                        'op %r reads var %r, whose only assignment is '
+                        'inside a single conditional_block: when the '
+                        'cond is false the var is uninitialized '
+                        '(reference conditional_block_op.cc errors on '
+                        'such a read) — write it unconditionally or in '
+                        'both branches first' % (op.type, n))
     if op.type not in _CONCRETE_PRESERVING:
         for names in op.outputs.values():
             for n in names:
                 ctx.concrete.pop(n, None)
     get_lowering(op.type)(ctx, op)
+    if ctx.cond_uninit and not guarded:
+        # an unconditional write covers the name; writes inside
+        # branch/loop bodies (conditional_scope) may never execute and
+        # must NOT clear it
+        for names in op.outputs.values():
+            for n in names:
+                ctx.cond_uninit.discard(n)
     if op.type in _SEQ_CONSUMERS or op.type.endswith('_grad'):
         return
     for suffix in (SEQLEN_SUFFIX, ROWS_SUFFIX):

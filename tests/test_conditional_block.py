@@ -1,0 +1,177 @@
+"""conditional_block semantics (VERDICT r3 next-#6): written vars blend
+with their previous value; a var whose ONLY assignment is a single
+conditional block is uninitialized on the cond-false path in the
+reference (conditional_block_op.cc) — here any read of it is rejected at
+lowering time, and the zero-filled else-value is proven unobservable
+once both branches (or any unconditional write) cover the name.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.layer_helper import LayerHelper
+
+
+def _cond_block(main, cond_var, body, out_names):
+    """Append a conditional_block op whose sub-block runs body()."""
+    helper = LayerHelper('conditional_block')
+    sub = main.create_block()
+    body()
+    main.rollback()
+    helper.append_op(
+        type='conditional_block',
+        inputs={'Cond': [cond_var]},
+        outputs={'Out': out_names},
+        attrs={'sub_block': sub})
+
+
+def test_written_var_keeps_old_value_when_cond_false():
+    for cond_value, want in ((1, 7.0), (0, 3.0)):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            cond = fluid.layers.fill_constant([1], 'bool', bool(cond_value))
+            v = fluid.layers.fill_constant([1], 'float32', 3.0)
+
+            def body():
+                seven = fluid.layers.fill_constant([1], 'float32', 7.0)
+                fluid.layers.assign(seven, v)
+
+            _cond_block(main, cond, body, [v.name])
+            out = fluid.layers.scale(v, scale=1.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.core.Scope()):
+            exe.run(startup)
+            got, = exe.run(main, feed={}, fetch_list=[out])
+        assert float(np.asarray(got).flatten()[0]) == want
+
+
+def test_read_of_conditionally_uninitialized_var_is_rejected():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        cond = fluid.layers.fill_constant([1], 'bool', True)
+        fresh = main.current_block().create_var(
+            name='only_in_branch', dtype='float32', shape=[1])
+
+        def body():
+            seven = fluid.layers.fill_constant([1], 'float32', 7.0)
+            fluid.layers.assign(seven, fresh)
+
+        _cond_block(main, cond, body, [fresh.name])
+        out = fluid.layers.scale(fresh, scale=2.0)  # the illegal read
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        with pytest.raises(Exception, match='conditional_block'):
+            exe.run(main, feed={}, fetch_list=[out])
+
+
+def test_fetch_of_conditionally_uninitialized_var_is_rejected():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        cond = fluid.layers.fill_constant([1], 'bool', True)
+        fresh = main.current_block().create_var(
+            name='fetch_me', dtype='float32', shape=[1])
+
+        def body():
+            seven = fluid.layers.fill_constant([1], 'float32', 7.0)
+            fluid.layers.assign(seven, fresh)
+
+        _cond_block(main, cond, body, [fresh.name])
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        with pytest.raises(Exception, match='conditional_block'):
+            exe.run(main, feed={}, fetch_list=['fetch_me'])
+
+
+def test_guarded_read_inside_conditional_scope_is_legal():
+    """A read of the cond-uninit var INSIDE another conditional block is
+    guarded (the reference never errors on any path of this program):
+    only unguarded reads are rejected."""
+    for cond_value, want in ((1, 14.0), (0, 0.0)):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            cond = fluid.layers.fill_constant([1], 'bool', bool(cond_value))
+            v = fluid.layers.fill_constant([1], 'float32', 0.0)
+            fresh = main.current_block().create_var(
+                name='guarded_x', dtype='float32', shape=[1])
+
+            def first():
+                seven = fluid.layers.fill_constant([1], 'float32', 7.0)
+                fluid.layers.assign(seven, fresh)
+
+            def second():
+                fluid.layers.assign(
+                    fluid.layers.scale(fresh, scale=2.0), v)
+
+            _cond_block(main, cond, first, [fresh.name])
+            _cond_block(main, cond, second, [v.name])
+            out = fluid.layers.scale(v, scale=1.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.core.Scope()):
+            exe.run(startup)
+            got, = exe.run(main, feed={}, fetch_list=[out])
+        assert float(np.asarray(got).flatten()[0]) == want
+
+
+def test_loop_body_write_does_not_clear_the_flag():
+    """A write inside a while body may execute zero times — it must NOT
+    legalize a later unguarded read of a cond-uninit var."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        cond = fluid.layers.fill_constant([1], 'bool', True)
+        fresh = main.current_block().create_var(
+            name='loop_x', dtype='float32', shape=[1])
+
+        def body():
+            seven = fluid.layers.fill_constant([1], 'float32', 7.0)
+            fluid.layers.assign(seven, fresh)
+
+        _cond_block(main, cond, body, [fresh.name])
+        i = fluid.layers.fill_constant([1], 'float32', 0.0)
+        limit = fluid.layers.fill_constant([1], 'float32', 0.0)
+        wcond = fluid.layers.less_than(x=i, y=limit)  # zero trips
+        w = fluid.layers.While(cond=wcond)
+        with w.block():
+            eight = fluid.layers.fill_constant([1], 'float32', 8.0)
+            fluid.layers.assign(eight, fresh)
+            fluid.layers.increment(x=i, value=1.0, in_place=True)
+            fluid.layers.less_than(x=i, y=limit, cond=wcond)
+        out = fluid.layers.scale(fresh, scale=1.0)  # unguarded read
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        import pytest as _pytest
+        with _pytest.raises(Exception, match='conditional_block'):
+            exe.run(main, feed={}, fetch_list=[out])
+
+
+def test_both_branches_cover_the_var_ifelse_pattern():
+    """true-block + false-block both writing the var (the IfElse
+    lowering pattern): the read is legal and selects correctly — the
+    zero-fill is unobservable."""
+    for cond_value, want in ((1, 7.0), (0, 9.0)):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            cond = fluid.layers.fill_constant([1], 'bool', bool(cond_value))
+            notc = fluid.layers.logical_not(cond)
+            fresh = main.current_block().create_var(
+                name='branch_out', dtype='float32', shape=[1])
+
+            def true_body():
+                seven = fluid.layers.fill_constant([1], 'float32', 7.0)
+                fluid.layers.assign(seven, fresh)
+
+            def false_body():
+                nine = fluid.layers.fill_constant([1], 'float32', 9.0)
+                fluid.layers.assign(nine, fresh)
+
+            _cond_block(main, cond, true_body, [fresh.name])
+            _cond_block(main, notc, false_body, [fresh.name])
+            out = fluid.layers.scale(fresh, scale=1.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.core.Scope()):
+            exe.run(startup)
+            got, = exe.run(main, feed={}, fetch_list=[out])
+        assert float(np.asarray(got).flatten()[0]) == want
