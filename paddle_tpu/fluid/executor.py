@@ -356,6 +356,27 @@ class _CompiledBlock(object):
         donate = (0, ) if self.state_rw else ()
         self._jit = jax.jit(fn, donate_argnums=donate)
 
+        # eager-path release plan (memory_optimize transpiler): names the
+        # pass marked releasable, positioned at their last use over THIS
+        # executable's op list and filtered against what must stay alive
+        # to the end
+        self._eager_release = {}
+        allowed = getattr(program, '_releasable', None)
+        if allowed:
+            keep = (set(self.fetch_names) | set(state_out) |
+                    set(state_in))
+            last = {}
+            for i, op in enumerate(ops):
+                for n in op.input_arg_names:
+                    last[n] = i
+                for n in op.output_arg_names:
+                    last[n] = i
+            rel = {}
+            for n, i in last.items():
+                if n in allowed and n not in keep:
+                    rel.setdefault(i, []).append(n)
+            self._eager_release = rel
+
     def _run_eager(self, scope, state_rw, state_ro, feeds, rng):
         """Unfused op-by-op execution for blocks containing host ops
         (save/load/print/readers) — identical semantics, no jit."""
@@ -367,7 +388,7 @@ class _CompiledBlock(object):
             self.block, env, rng_key=rng, place=self.place)
         ctx.scope = scope
         check_nan = flags.FLAGS.check_nan_inf
-        for op in self.ops:
+        for op_idx, op in enumerate(self.ops):
             host_impl = registry.get_host_op(op.type)
             if host_impl is not None:
                 # host ops bypass run_op: apply the may-read-before-
@@ -389,6 +410,10 @@ class _CompiledBlock(object):
                 _check_nan_inf(
                     [(n, env[n]) for n in op.output_arg_names if n in env],
                     'output of op %r' % op.type)
+            # memory_optimize release plan: drop vars past their last use
+            # so the eager env's peak live set matches true liveness
+            for n in self._eager_release.get(op_idx, ()):
+                env.pop(n, None)
         for n in self.fetch_names:
             if n in ctx.cond_uninit:
                 raise RuntimeError(
@@ -500,27 +525,21 @@ class Executor(object):
         except AttributeError:
             pass  # object without a __dict__; fall back to LRU semantics
 
-    def run(self,
-            program=None,
-            feed=None,
-            fetch_list=None,
-            feed_var_name='feed',
-            fetch_var_name='fetch',
-            scope=None,
-            return_numpy=True,
-            use_program_cache=False):
-        if self._closed:
-            raise RuntimeError('Attempted to use a closed Executor')
-        program = program if program is not None else default_main_program()
+    def _resolve_and_compile(self, program, feed, fetch_list, scope):
+        """Shared front half of run()/memory_analysis(): normalize the
+        arguments, prepare/validate feeds, and resolve (or build) the
+        cached executable."""
+        program = program if program is not None else \
+            default_main_program()
         scope = scope if scope is not None else _current_scope()
-        feed = feed if feed is not None else {}
+        feed = dict(feed if feed is not None else {})
         fetch_list = fetch_list if fetch_list is not None else []
         if isinstance(fetch_list, (Variable, str)):
             fetch_list = [fetch_list]
         fetch_names = [
-            f.name if isinstance(f, Variable) else str(f) for f in fetch_list
+            f.name if isinstance(f, Variable) else str(f)
+            for f in fetch_list
         ]
-        feed = dict(feed)
         from .layers import io as layers_io
         layers_io.note_executor_place(self.place)
         _pop_readers_into_feed(program, feed, self.place)
@@ -530,9 +549,9 @@ class Executor(object):
         key = (id(program), program._version, tuple(fetch_names), sig,
                self.place, id(scope), registry.amp_enabled())
         # id()-keyed entries are purged when the keyed object dies, so a
-        # recycled id can never alias a stale compile (the LRU alone can't
-        # guarantee this: evicting one entry may unpin a program whose id
-        # recurs while sibling entries survive)
+        # recycled id can never alias a stale compile (the LRU alone
+        # can't guarantee this: evicting one entry may unpin a program
+        # whose id recurs while sibling entries survive)
         self._pin_cache_lifetime(program)
         self._pin_cache_lifetime(scope)
         compiled = self._cache.get(key)
@@ -544,6 +563,53 @@ class Executor(object):
                 self._cache.popitem(last=False)
         else:
             self._cache.move_to_end(key)
+        return program, scope, feed_arrays, compiled
+
+    def memory_analysis(self, program=None, feed=None, fetch_list=None,
+                        scope=None):
+        """XLA buffer-assignment stats for the compiled program (the
+        measured counterpart of the reference memory_optimize's print
+        log): returns the jax CompiledMemoryStats — in particular
+        ``temp_size_in_bytes``, the peak intermediate-buffer footprint
+        after XLA's liveness-driven reuse.  Feeds must be shaped like a
+        real run's (they key the compile)."""
+        import jax
+        program, scope, feed_arrays, compiled = self._resolve_and_compile(
+            program, feed, fetch_list, scope)
+        if any(_is_host_op(op) for op in compiled.ops):
+            raise RuntimeError(
+                'memory_analysis: the program contains host ops '
+                '(%s) and runs on the eager path, which has no single '
+                'compiled executable — remove them or analyse the '
+                'compute-only portion' % sorted(
+                    {op.type for op in compiled.ops
+                     if _is_host_op(op)}))
+        device = self.place.jax_device()
+        to_value = lambda v, d: _to_device_value(v, d, device)
+        state_rw = compiled._state_from_scope(scope, compiled.state_rw,
+                                              to_value)
+        state_ro = compiled._state_from_scope(scope, compiled.state_ro,
+                                              to_value)
+        feeds = {n: _to_device_value(
+                     v, compiled.block._find_var_recursive(n), device)
+                 for n, v in feed_arrays.items()}
+        rng = jax.random.PRNGKey(0)
+        return compiled._jit.lower(
+            state_rw, state_ro, feeds, rng).compile().memory_analysis()
+
+    def run(self,
+            program=None,
+            feed=None,
+            fetch_list=None,
+            feed_var_name='feed',
+            fetch_var_name='fetch',
+            scope=None,
+            return_numpy=True,
+            use_program_cache=False):
+        if self._closed:
+            raise RuntimeError('Attempted to use a closed Executor')
+        program, scope, feed_arrays, compiled = self._resolve_and_compile(
+            program, feed, fetch_list, scope)
 
         eager = any(_is_host_op(op) for op in compiled.ops)
         rng = self._next_rng(program)

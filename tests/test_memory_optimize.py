@@ -1,0 +1,160 @@
+"""memory_optimize: measured, not asserted (VERDICT r3 next-#7).
+
+Two claims, both pinned here with numbers:
+
+1. Compiled (jit) path: XLA buffer assignment already does the
+   liveness-driven reuse the reference's transpiler rewrites by hand.
+   Executor.memory_analysis() exposes the compiled executable's temp
+   footprint; on an N-step elementwise chain whose intermediates sum to
+   N*4MB, temp memory stays bounded by a couple of buffers.
+
+2. Eager (host-op-segmented) path: there the env really would pin every
+   intermediate, and memory_optimize's release plan measurably frees
+   dead vars mid-run (probed by a host op sampling jax.live_arrays()).
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.layer_helper import LayerHelper
+from paddle_tpu.ops.registry import register_host_op
+
+N_CHAIN = 8
+MB = (1024, 1024)  # 4 MiB fp32 per intermediate
+
+
+def _chain_program(with_probe):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=list(MB), append_batch_size=False)
+        v = x
+        for i in range(N_CHAIN):
+            v = fluid.layers.scale(v, scale=1.0 + 1.0 / (i + 1))
+        if with_probe:
+            helper = LayerHelper('live_probe')
+            helper.append_op(type='live_probe', inputs={},
+                             outputs={}, attrs={})
+    return main, startup, v
+
+
+_probe = {}
+
+
+@register_host_op('live_probe')
+def _live_probe(ctx, op, scope):
+    import jax
+    _probe['bytes'] = sum(
+        int(np.prod(a.shape)) * a.dtype.itemsize
+        for a in jax.live_arrays())
+
+
+def test_compiled_path_xla_reuses_buffers():
+    main, startup, out = _chain_program(with_probe=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    x = np.ones(MB, 'float32')
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        stats = exe.memory_analysis(main, feed={'x': x},
+                                    fetch_list=[out])
+    one_buf = int(np.prod(MB)) * 4
+    # chain intermediates sum to N_CHAIN buffers; XLA's reuse keeps the
+    # temp footprint to a small constant number of them
+    assert stats.temp_size_in_bytes <= 3 * one_buf, (
+        stats.temp_size_in_bytes, N_CHAIN * one_buf)
+
+
+def _run_eager_chain(optimize):
+    main, startup, out = _chain_program(with_probe=True)
+    if optimize:
+        fluid.memory_optimize(main)
+    exe = fluid.Executor(fluid.CPUPlace())
+    x = np.ones(MB, 'float32')
+    _probe.clear()
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        got, = exe.run(main, feed={'x': x}, fetch_list=[out])
+    return _probe['bytes'], np.asarray(got)
+
+
+def test_eager_path_release_plan_frees_dead_vars():
+    bytes_plain, out_plain = _run_eager_chain(optimize=False)
+    bytes_opt, out_opt = _run_eager_chain(optimize=True)
+    # results identical — the pass only frees DEAD values
+    np.testing.assert_allclose(out_opt, out_plain, rtol=1e-6)
+    one_buf = int(np.prod(MB)) * 4
+    # without the plan every chain intermediate is still alive at the
+    # probe; with it, all but the fetched tail are gone
+    assert bytes_plain - bytes_opt >= (N_CHAIN - 3) * one_buf, (
+        bytes_plain, bytes_opt)
+
+
+def test_memory_optimize_after_first_run_still_takes_effect():
+    """memory_optimize bumps the program version, so an executable
+    cached BEFORE the pass is re-keyed — call order must not silently
+    disable the release plan."""
+    main, startup, out = _chain_program(with_probe=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    x = np.ones(MB, 'float32')
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        _probe.clear()
+        exe.run(main, feed={'x': x}, fetch_list=[out])  # warm the cache
+        bytes_before = _probe['bytes']
+        fluid.memory_optimize(main)
+        _probe.clear()
+        exe.run(main, feed={'x': x}, fetch_list=[out])
+        bytes_after = _probe['bytes']
+    one_buf = int(np.prod(MB)) * 4
+    assert bytes_before - bytes_after >= (N_CHAIN - 3) * one_buf, (
+        bytes_before, bytes_after)
+
+
+def test_vars_read_in_nested_sub_blocks_are_protected():
+    """A var consumed only at sub-block depth >= 2 must never be
+    releasable — its read is invisible to the global block's op list."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        cond = fluid.layers.fill_constant([1], 'bool', True)
+        deep = fluid.layers.fill_constant([1], 'float32', 5.0)
+        sink = fluid.layers.fill_constant([1], 'float32', 0.0)
+        helper = LayerHelper('conditional_block')
+        outer = main.create_block()
+        # depth 2: a while whose body reads `deep`
+        i = fluid.layers.fill_constant([1], 'float32', 0.0)
+        lim = fluid.layers.fill_constant([1], 'float32', 1.0)
+        wcond = fluid.layers.less_than(x=i, y=lim)
+        w = fluid.layers.While(cond=wcond)
+        with w.block():
+            fluid.layers.assign(fluid.layers.scale(deep, scale=2.0), sink)
+            fluid.layers.increment(x=i, value=1.0, in_place=True)
+            fluid.layers.less_than(x=i, y=lim, cond=wcond)
+        main.rollback()
+        helper.append_op(type='conditional_block',
+                         inputs={'Cond': [cond]},
+                         outputs={'Out': [sink.name]},
+                         attrs={'sub_block': outer})
+    fluid.memory_optimize(main)
+    assert deep.name not in main._releasable
+
+
+def test_memory_analysis_rejects_host_op_programs():
+    import pytest
+    main, startup, out = _chain_program(with_probe=True)  # host op
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        with pytest.raises(RuntimeError, match='host op'):
+            exe.memory_analysis(main, feed={'x': np.ones(MB, 'float32')},
+                                fetch_list=[out])
+
+
+def test_release_plan_protects_persistables_and_fetches():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], append_batch_size=False)
+        w = fluid.layers.create_parameter([4], 'float32', name='keep_w')
+        mid = fluid.layers.elementwise_add(x, w)
+        out = fluid.layers.scale(mid, scale=2.0)
+    fluid.memory_optimize(main)
+    assert 'keep_w' not in main._releasable
+    assert mid.name in main._releasable  # the actual dead intermediate
