@@ -437,7 +437,10 @@ class _CompiledBlock(object):
                                    self.block._find_var_recursive(name))
         return state
 
-    def run(self, scope, feed_values, rng_key, eager=False):
+    def _materialize_args(self, scope, feed_values):
+        """Device-stage the jit/eager call's arguments: threaded scope
+        state and feeds (shared by run() and Executor.memory_analysis —
+        the stats must describe the executable run() executes)."""
         device = self.place.jax_device()
         to_value = lambda v, desc: _to_device_value(v, desc, device)
         state_rw = self._state_from_scope(scope, self.state_rw, to_value)
@@ -446,6 +449,11 @@ class _CompiledBlock(object):
             n: _to_device_value(v, self.block._find_var_recursive(n), device)
             for n, v in feed_values.items()
         }
+        return state_rw, state_ro, feeds
+
+    def run(self, scope, feed_values, rng_key, eager=False):
+        state_rw, state_ro, feeds = self._materialize_args(scope,
+                                                           feed_values)
         if eager:
             new_state, fetches = self._run_eager(scope, state_rw, state_ro,
                                                  feeds, rng_key)
@@ -525,10 +533,15 @@ class Executor(object):
         except AttributeError:
             pass  # object without a __dict__; fall back to LRU semantics
 
-    def _resolve_and_compile(self, program, feed, fetch_list, scope):
+    def _resolve_and_compile(self, program, feed, fetch_list, scope,
+                             pop_readers=True):
         """Shared front half of run()/memory_analysis(): normalize the
         arguments, prepare/validate feeds, and resolve (or build) the
-        cached executable."""
+        cached executable.  ``pop_readers=False`` for analysis paths
+        that never execute the program — consuming a py_reader batch
+        there would silently drop a minibatch from training."""
+        if self._closed:
+            raise RuntimeError('Attempted to use a closed Executor')
         program = program if program is not None else \
             default_main_program()
         scope = scope if scope is not None else _current_scope()
@@ -542,7 +555,8 @@ class Executor(object):
         ]
         from .layers import io as layers_io
         layers_io.note_executor_place(self.place)
-        _pop_readers_into_feed(program, feed, self.place)
+        if pop_readers:
+            _pop_readers_into_feed(program, feed, self.place)
         feed_arrays = prepare_feed_arrays(feed)
         validate_feed(program, feed_arrays)
         sig = feed_signature(feed_arrays)
@@ -574,8 +588,15 @@ class Executor(object):
         after XLA's liveness-driven reuse.  Feeds must be shaped like a
         real run's (they key the compile)."""
         import jax
+        if program is not None and any(
+                op.type == 'read' for op in program.block(0).ops):
+            raise RuntimeError(
+                'memory_analysis: the program is reader-fed; popping a '
+                'py_reader batch here would silently drop a minibatch '
+                'from training — pass representative arrays via feed= '
+                'on a reader-free clone instead')
         program, scope, feed_arrays, compiled = self._resolve_and_compile(
-            program, feed, fetch_list, scope)
+            program, feed, fetch_list, scope, pop_readers=False)
         if any(_is_host_op(op) for op in compiled.ops):
             raise RuntimeError(
                 'memory_analysis: the program contains host ops '
@@ -584,15 +605,8 @@ class Executor(object):
                 'compute-only portion' % sorted(
                     {op.type for op in compiled.ops
                      if _is_host_op(op)}))
-        device = self.place.jax_device()
-        to_value = lambda v, d: _to_device_value(v, d, device)
-        state_rw = compiled._state_from_scope(scope, compiled.state_rw,
-                                              to_value)
-        state_ro = compiled._state_from_scope(scope, compiled.state_ro,
-                                              to_value)
-        feeds = {n: _to_device_value(
-                     v, compiled.block._find_var_recursive(n), device)
-                 for n, v in feed_arrays.items()}
+        state_rw, state_ro, feeds = compiled._materialize_args(
+            scope, feed_arrays)
         rng = jax.random.PRNGKey(0)
         return compiled._jit.lower(
             state_rw, state_ro, feeds, rng).compile().memory_analysis()
@@ -606,8 +620,6 @@ class Executor(object):
             scope=None,
             return_numpy=True,
             use_program_cache=False):
-        if self._closed:
-            raise RuntimeError('Attempted to use a closed Executor')
         program, scope, feed_arrays, compiled = self._resolve_and_compile(
             program, feed, fetch_list, scope)
 

@@ -76,10 +76,14 @@ def memory_optimize(input_program=None,
     keep = _protected(program, skip_opt_set)
 
     releasable = frozenset(n for n in last_use if n not in keep)
-    program._releasable = releasable
-    # a cached executable compiled before this pass has no release plan;
-    # bumping the version makes the executor re-key (and re-plan)
-    program._bump_version()
+    if getattr(program, '_releasable', None) != releasable:
+        program._releasable = releasable
+        # a cached executable compiled before this pass has no release
+        # plan; bumping the version makes the executor re-key (and
+        # re-plan).  Skipped when the set is unchanged (e.g.
+        # release_memory after memory_optimize) so identical plans don't
+        # force a gratuitous recompile.
+        program._bump_version()
 
     stats = {
         'num_vars': len(first_def),

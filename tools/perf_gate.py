@@ -1,0 +1,103 @@
+"""Perf-regression gate (VERDICT r3 next-#8): the framework's ResNet-50
+training step vs the independent pure-JAX bound (tools/jax_resnet_bound.py)
+in ONE process, so per-session throughput drift cancels in the ratio.
+The invariant MFU_BOUND_r03.json established: framework/bound >= 1.0
+(the whole-program XLA compile must not cost throughput vs hand-rolled
+JAX).  Prints one JSON line; run on TPU hardware — tests/test_perf_gate.py
+drives it and skips cleanly off-TPU.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BATCH = int(os.environ.get('PERF_GATE_BATCH', '256'))
+STEPS = int(os.environ.get('PERF_GATE_STEPS', '10'))
+
+
+def measure_bound():
+    import functools
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import jax_resnet_bound as bound
+
+    dev = jax.devices()[0]
+    params = bound.make_params(jax.random.PRNGKey(0), 'NCHW')
+    vel = [{k: jnp.zeros_like(v) for k, v in p.items()} for p in params]
+    params = jax.device_put(params, dev)
+    vel = jax.device_put(vel, dev)
+    rng = np.random.RandomState(0)
+    x = jax.device_put(jnp.asarray(
+        rng.standard_normal((BATCH, 3, 224, 224)), jnp.float32), dev)
+    label = jax.device_put(
+        rng.randint(0, 1000, size=(BATCH, )).astype(np.int32), dev)
+    step = functools.partial(bound.train_step, layout='NCHW', remat=False)
+    for _ in range(2):
+        params, vel, loss = step(params, vel, x, label)
+    float(loss)  # fetch drains (axon block_until_ready does not)
+    t0 = time.time()
+    for _ in range(STEPS):
+        params, vel, loss = step(params, vel, x, label)
+    float(loss)
+    return BATCH * STEPS / (time.time() - t0)
+
+
+def measure_framework():
+    import jax
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import resnet
+
+    model = resnet.build(depth=50, class_dim=1000,
+                         image_shape=(3, 224, 224), lr=0.1)
+    place = fluid.TPUPlace()
+    exe = fluid.Executor(place)
+    scope = fluid.core.Scope()
+    rng = np.random.RandomState(0)
+    dev = place.jax_device()
+    feed = {
+        'img': jax.device_put(
+            rng.standard_normal((BATCH, 3, 224, 224)).astype('float32'),
+            dev),
+        'label': jax.device_put(
+            rng.randint(0, 1000, size=(BATCH, 1)).astype('int64'), dev),
+    }
+    with fluid.scope_guard(scope), fluid.amp_guard(True):
+        exe.run(model['startup'])
+        for _ in range(2):
+            exe.run(model['main'], feed=feed, fetch_list=[model['loss']])
+            exe.run(model['main'], feed=feed, fetch_list=[])
+        t0 = time.time()
+        for _ in range(STEPS - 1):
+            exe.run(model['main'], feed=feed, fetch_list=[])
+        loss_v, = exe.run(model['main'], feed=feed,
+                          fetch_list=[model['loss']])
+        elapsed = time.time() - t0
+    assert np.isfinite(np.asarray(loss_v)).all()
+    return BATCH * STEPS / elapsed
+
+
+def main():
+    import jax
+    backend = jax.default_backend()
+    if backend not in ('tpu', 'axon'):
+        print(json.dumps({'skip': 'no TPU backend (%s)' % backend}))
+        return
+    # interleave-free, same process, same session: drift cancels
+    framework = measure_framework()
+    bound = measure_bound()
+    print(json.dumps({
+        'framework_imgs_per_sec': round(framework, 1),
+        'bound_imgs_per_sec': round(bound, 1),
+        'ratio': round(framework / bound, 4),
+        'batch': BATCH, 'steps': STEPS,
+    }))
+
+
+if __name__ == '__main__':
+    main()
