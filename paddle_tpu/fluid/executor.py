@@ -588,8 +588,9 @@ class Executor(object):
         after XLA's liveness-driven reuse.  Feeds must be shaped like a
         real run's (they key the compile)."""
         import jax
-        if program is not None and any(
-                op.type == 'read' for op in program.block(0).ops):
+        program = program if program is not None else \
+            default_main_program()
+        if any(op.type == 'read' for op in program.block(0).ops):
             raise RuntimeError(
                 'memory_analysis: the program is reader-fed; popping a '
                 'py_reader batch here would silently drop a minibatch '

@@ -437,13 +437,20 @@ def _lod_reset(ctx, op):
     """Reference lod_reset_op.cc: keep the flat payload, replace the LoD.
     Under the padded+SEQLEN lowering a re-segmentation is a RE-LAYOUT:
     the flat rows move from the old [B, T, ...] padding to a new
-    [B', T', ...] one.  The new offsets must be concrete (attr
-    target_lod, or a non-sequence Y whose values are known offsets via a
-    concrete fill) — the new batch/bucket sizes are shapes."""
+    [B', T', ...] one.  Three forms of the new segmentation: a concrete
+    attr target_lod; a Y whose values are trace-time-known offsets
+    (concrete fill); or a runtime LoD sequence Y — there Y's padded
+    layout [B2, T2] fixes the output bucket statically and only the
+    per-row lengths stay traced (the round-4 bucketed form).  Total-
+    length agreement with X (reference enforce: last offset == X rows)
+    is checked where trace-time-knowable — concrete offsets against a
+    flat X — and is the caller's contract in the traced cases."""
     from .registry import SEQLEN_SUFFIX
     x = ctx.get(op, 'X')
     out_name = op.output('Out')[0]
-    offsets = None
+    lens_arr = None   # traced or concrete new lengths [B2]
+    off_start = None  # traced or concrete new start offsets [B2]
+    b2 = t2 = None
     if op.attrs.get('target_lod'):
         offsets = np.asarray(op.attrs['target_lod'], np.int64)
     elif op.input('Y'):
@@ -452,27 +459,48 @@ def _lod_reset(ctx, op):
         if conc is not None:
             offsets = np.asarray(conc, np.int64).reshape(-1)
         elif (y_name + SEQLEN_SUFFIX) in ctx.env:
-            # Y is itself a padded sequence: adopt its layout lengths —
-            # same flat count, so the payload layout already agrees when
-            # both paddings bucket alike; re-layout below needs concrete
-            # offsets, which a traced Y cannot give
-            raise NotImplementedError(
-                'lod_reset from a traced sequence Y would make the new '
-                'padding data-dependent; pass target_lod or a concrete Y')
-    if offsets is None:
+            # the BUCKETED traced-Y form (closes the round-2/3 delta):
+            # Y is itself a padded sequence, so its STATIC layout
+            # [B2, T2] fixes the output bucket at trace time; only the
+            # per-row lengths are traced, and the re-layout below is
+            # pure gathers, which XLA takes with traced indices.  The
+            # one semantic bound vs the reference: a Y row longer than
+            # its padded bucket T2 cannot be represented (the feed
+            # bucketing guarantees it isn't)
+            offsets = None
+            y = ctx.lookup(y_name)
+            lens_arr = ctx.env[y_name + SEQLEN_SUFFIX].astype(jnp.int32)
+            b2, t2 = int(y.shape[0]), int(y.shape[1])
+            cum2 = jnp.cumsum(lens_arr)
+            off_start = cum2 - lens_arr
+        else:
+            raise ValueError(
+                'lod_reset: Y carries neither concrete offsets nor a '
+                'padded-sequence layout')
+    else:
         raise ValueError('lod_reset needs Y or target_lod')
-    new_lens = offsets[1:] - offsets[:-1]
-    b2 = len(new_lens)
-    t2 = int(max(((int(new_lens.max()) + 15) // 16) * 16, 16)) if b2 else 16
+    if lens_arr is None:
+        new_lens = offsets[1:] - offsets[:-1]
+        b2 = len(new_lens)
+        t2 = int(max(((int(new_lens.max()) + 15) // 16) * 16, 16)) \
+            if b2 else 16
+        lens_arr = jnp.asarray(new_lens, jnp.int32)
+        off_start = jnp.asarray(offsets[:-1], jnp.int64)
 
     in_lens = ctx.env.get(op.input('X')[0] + SEQLEN_SUFFIX)
     feat = x.shape[2:] if in_lens is not None else x.shape[1:]
-    # flat index each output slot reads: n = offsets[b2] + t2 (concrete)
-    n_grid = offsets[:-1, None] + np.arange(t2)[None, :]
-    valid = np.arange(t2)[None, :] < new_lens[:, None]
-    n_flat = jnp.asarray(np.where(valid, n_grid, 0))
+    if (offsets is not None and len(offsets) and in_lens is None
+            and int(offsets[-1]) != int(x.shape[0])):
+        raise ValueError(
+            'lod_reset: target offsets end at %d but X has %d rows '
+            '(reference lod_reset_op enforce)' %
+            (int(offsets[-1]), int(x.shape[0])))
+    # flat index each output slot reads: n = off_start[b2] + t
+    n_grid = off_start[:, None] + jnp.arange(t2)[None, :]
+    valid = jnp.arange(t2)[None, :] < lens_arr[:, None]
+    n_flat = jnp.where(valid, n_grid, 0)
     if in_lens is None:
-        # x is flat [N, ...]
+        # x is flat [N, ...]; jnp.take clips out-of-range indices
         out = jnp.take(x, n_flat.reshape(-1), axis=0)
     else:
         # x is padded [B, T, ...]: flat n lives at row r, col n-start[r]
@@ -486,10 +514,10 @@ def _lod_reset(ctx, op):
         c = jnp.clip(c, 0, x.shape[1] - 1)
         out = x[r, c]
     out = out.reshape((b2, t2) + feat)
-    mask = jnp.asarray(valid).reshape((b2, t2) + (1, ) * len(feat))
+    mask = valid.reshape((b2, t2) + (1, ) * len(feat))
     out = jnp.where(mask, out, jnp.zeros_like(out))
     ctx.store(out_name, out)
-    ctx.env[out_name + SEQLEN_SUFFIX] = jnp.asarray(new_lens, jnp.int32)
+    ctx.env[out_name + SEQLEN_SUFFIX] = lens_arr
 
 
 @register_lowering('increment')
