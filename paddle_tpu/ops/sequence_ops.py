@@ -715,10 +715,12 @@ def _sub_nested_seq(ctx, op):
 
 @register_lowering('kmax_seq_score')
 def _kmax_seq_score(ctx, op):
-    """Top-k scores per sequence (reference kmax_seq_score_layer):
-    scores arrive [B, T] or [B, T, 1] padded; padding is masked out of
-    the per-row top_k.  A sequence shorter than k pads its tail scores
-    with 0 (finite — a -inf leak would poison downstream losses)."""
+    """Top-k INDICES per sequence (reference KmaxSeqScoreLayer.cpp:52 —
+    "output ... is some selected indices of the given sequence", carried
+    as real values, -1 beyond min(k, seq_len)).  Scores arrive [B, T] or
+    [B, T, 1] padded; padding is masked out of the per-row top_k.  The
+    index output is exactly what sub_nested_seq_layer consumes as
+    selected_indices in the reference beam-training flow."""
     x = ctx.get(op, 'X')
     k = int(op.attrs.get('beam_size', 1))
     lengths = _seqlen(ctx, op)
@@ -730,5 +732,10 @@ def _kmax_seq_score(ctx, op):
     if lengths is not None:
         m = _mask(v, lengths)
         v = jnp.where(m, v, -jnp.inf)
-    scores, _ = jax.lax.top_k(v, k)
-    ctx.set(op, 'Out', jnp.where(jnp.isfinite(scores), scores, 0.0))
+        n_valid = jnp.minimum(lengths.astype(jnp.int32), k)
+    else:
+        n_valid = jnp.full((v.shape[0], ), min(v.shape[1], k), jnp.int32)
+    _, idx = jax.lax.top_k(v, k)
+    slot_ok = jnp.arange(k)[None, :] < n_valid[:, None]
+    ctx.set(op, 'Out',
+            jnp.where(slot_ok, idx, -1).astype(jnp.float32))

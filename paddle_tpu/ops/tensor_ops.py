@@ -485,7 +485,7 @@ def _lod_reset(ctx, op):
         t2 = int(max(((int(new_lens.max()) + 15) // 16) * 16, 16)) \
             if b2 else 16
         lens_arr = jnp.asarray(new_lens, jnp.int32)
-        off_start = jnp.asarray(offsets[:-1], jnp.int64)
+        off_start = jnp.asarray(offsets[:-1], jnp.int32)
 
     in_lens = ctx.env.get(op.input('X')[0] + SEQLEN_SUFFIX)
     feat = x.shape[2:] if in_lens is not None else x.shape[1:]

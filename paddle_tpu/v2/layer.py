@@ -1310,9 +1310,11 @@ def sub_nested_seq(input, selected_indices, name=None, **kwargs):
 
 
 def kmax_seq_score(input, beam_size=1, name=None, **kwargs):
-    """Top-k scores per sequence (reference kmax_seq_score_layer) —
-    its own op lowering (ops/sequence_ops.py) because the time axis only
-    exists on the padded runtime layout."""
+    """Top-k INDICES per sequence, -1 past min(k, len) (reference
+    kmax_seq_score_layer outputs selected ids, KmaxSeqScoreLayer.cpp:52)
+    — its own op lowering (ops/sequence_ops.py) because the time axis
+    only exists on the padded runtime layout.  Feeds
+    sub_nested_seq(selected_indices=...) directly."""
 
     def build(ctx, v):
         from ..fluid.layer_helper import LayerHelper

@@ -147,6 +147,38 @@ def test_cross_entropy_over_beam_gradient_trains_scores():
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
 
 
+def test_kmax_feeds_sub_nested_seq_reference_flow():
+    """The reference beam-training composition: kmax_seq_score over
+    per-sub-sequence scores -> selected_indices -> sub_nested_seq trims
+    the nested input (layers.py cross_entropy_over_beam doc: 'always
+    works together with kmax_seq_score_layer, sub_nested_seq_layer')."""
+    nested = tch.data_layer(name='nx', size=1, seq='sub')
+    scores = tch.data_layer(name='sc', size=1, seq=True)
+    sel = tch.kmax_seq_score_layer(input=scores, beam_size=2)
+    sub = tch.sub_nested_seq_layer(input=nested, selected_indices=sel)
+    pooled = tch.pooling_layer(input=sub, pooling_type=tch.SumPooling())
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        out_var = pooled.to_fluid({})
+    # seq0 has rows a=[1,2], b=[10], c=[3,4,5]; row scores favor c, a
+    # seq1 has row d=[7,8]; score picks d (tail -1)
+    rows = [[[1.], [2.]], [[10.]], [[3.], [4.], [5.]], [[7.], [8.]]]
+    flat = np.concatenate([np.asarray(r, 'float32') for r in rows])
+    nx = fluid.create_lod_tensor(flat, [[3, 1], [2, 1, 3, 2]])
+    sc = fluid.create_lod_tensor(
+        np.asarray([[.5], [.1], [.9], [.7]], 'float32'), [[3, 1]])
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        got, = exe.run(main, feed={'nx': nx, 'sc': sc},
+                       fetch_list=[out_var])
+    # selected: seq0 rows [2 (c), 0 (a)], seq1 row [0 (d)] -> packed
+    # sums [12, 3, 15, 0]
+    np.testing.assert_allclose(np.asarray(got)[:4, 0],
+                               [12., 3., 15., 0.], rtol=1e-6)
+
+
 def test_sub_nested_seq_layer_selects_rows_tch():
     """The tch builder end-to-end over the v2 DAG: nested input,
     per-sequence row selection, pooled downstream — values pinned."""

@@ -335,16 +335,20 @@ def test_conv_shift_matches_numpy_circular_correlation():
 
 
 def test_kmax_and_subseq_sequence_builders():
+    """kmax_seq_score outputs top-k INDICES (the reference contract,
+    KmaxSeqScoreLayer.cpp) — pinned by value."""
     tch.settings(batch_size=3, learning_rate=0.01)
     seq = tch.data_layer(name='seq', size=1, seq=True)
     k = tch.kmax_seq_score_layer(input=seq, beam_size=2)
     cost = tch.sum_cost(input=k)
-    rng = np.random.RandomState(13)
-    rows = [rng.standard_normal((l, 1)) for l in (4, 6, 3)]
+    rows = [np.asarray([[.1], [.9], [.5], [.2]]),   # top2 idx 1, 2
+            np.asarray([[.3], [.1], [.2], [.8], [.4], [.6]]),  # 3, 5
+            np.asarray([[.7], [.2], [.9]])]         # 2, 0
     st = fluid.core.LoDTensor(np.concatenate(rows).astype('float32'))
     st.set_recursive_sequence_lengths([[len(r) for r in rows]])
     vals = _run_cost(cost, {'seq': st}, steps=1)
-    assert np.isfinite(vals).all()
+    np.testing.assert_allclose(vals[0], (1 + 2) + (3 + 5) + (2 + 0),
+                               rtol=1e-6)
 
 
 def test_sub_seq_slices_correct_window():
@@ -374,7 +378,9 @@ def test_sub_seq_slices_correct_window():
                                rtol=1e-6)
 
 
-def test_kmax_short_sequences_pad_finite():
+def test_kmax_short_sequences_pad_minus_one():
+    """A sequence shorter than k fills its index tail with -1, exactly
+    the reference's -1 fill (KmaxSeqScoreLayer.cpp:115-117)."""
     tch.settings(batch_size=2, learning_rate=0.01)
     seq = tch.data_layer(name='seq', size=1, seq=True)
     k = tch.kmax_seq_score_layer(input=seq, beam_size=3)
@@ -384,8 +390,8 @@ def test_kmax_short_sequences_pad_finite():
     lt = fluid.core.LoDTensor(np.concatenate(rows))
     lt.set_recursive_sequence_lengths([[1, 4]])
     vals = _run_cost(cost, {'seq': lt}, steps=1)
-    # row0: 5 + 0 + 0; row1: 4+3+2 -> total 14, FINITE
-    np.testing.assert_allclose(vals[0], 14.0, rtol=1e-6)
+    # row0 indices: [0, -1, -1]; row1: [3, 2, 1] -> total 4, FINITE
+    np.testing.assert_allclose(vals[0], 4.0, rtol=1e-6)
 
 
 def test_conv_shift_rejects_even_kernel():
