@@ -194,9 +194,13 @@ def sequence_conv(input,
     return helper.append_activation(pre_act)
 
 
-def sequence_pool(input, pool_type):
+def sequence_pool(input, pool_type, agg_to_no_sequence=True):
     """Pool each sequence to one vector (reference nn.py sequence_pool;
-    pool_type: sum/average/sqrt/max/last/first)."""
+    pool_type: sum/average/sqrt/max/last/first).  On a NESTED (2-level
+    LoD) input, ``agg_to_no_sequence`` selects the legacy
+    AggregateLevel: True (default, reference layers.py:302) pools the
+    whole nested sample to one vector; False pools each sub-sequence,
+    yielding a plain sequence."""
     helper = LayerHelper('sequence_pool', **locals())
     dtype = helper.input_dtype()
     pool_out = helper.create_variable_for_type_inference(dtype)
@@ -208,7 +212,8 @@ def sequence_pool(input, pool_type):
         inputs={'X': [input]},
         outputs={'Out': [pool_out],
                  'MaxIndex': [max_index]},
-        attrs={'pooltype': pool_type.upper()})
+        attrs={'pooltype': pool_type.upper(),
+               'agg_to_no_sequence': bool(agg_to_no_sequence)})
     if pool_type == 'max':
         max_index.stop_gradient = True
     return pool_out

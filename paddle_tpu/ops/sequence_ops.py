@@ -94,6 +94,74 @@ def _sequence_pool(ctx, op):
         out = x[:, 0]
     else:
         raise NotImplementedError('sequence_pool type %r' % ptype)
+    rows = ctx.env.get(op.input('X')[0] + ROWS_SUFFIX)
+    if rows is not None and op.attrs.get('agg_to_no_sequence', True):
+        # nested input + AggregateLevel.TO_NO_SEQUENCE (the reference
+        # default, layers.py:302): aggregate over ALL timesteps of each
+        # TOP-level sequence.  The inner pooling above gives one value
+        # per sub-sequence row; reduce those per sample with the same
+        # pool semantics (average = total/total-count, not
+        # average-of-averages).
+        b = int(rows.shape[0])
+        r = x.shape[0]
+        cum = jnp.cumsum(rows)
+        start = cum - rows
+        seg = jnp.searchsorted(cum, jnp.arange(r), side='right')
+        seg = jnp.clip(seg, 0, b - 1)
+        row_cnt = lengths.astype(jnp.float32)
+        tot_cnt = jax.ops.segment_sum(row_cnt, seg, num_segments=b)
+        safe_cnt = jnp.maximum(tot_cnt, 1.0).reshape(
+            (b, ) + (1, ) * (out.ndim - 1)).astype(out.dtype)
+        if ptype in ('SUM', 'AVERAGE', 'SQRT'):
+            row_tot = jnp.sum(x * m, axis=1)
+            tot = jax.ops.segment_sum(row_tot, seg, num_segments=b)
+            if ptype == 'SUM':
+                out = tot
+            elif ptype == 'AVERAGE':
+                out = tot / safe_cnt
+            else:
+                out = tot / jnp.sqrt(safe_cnt)
+        elif ptype == 'MAX':
+            row_max = jnp.where(
+                jnp.reshape(lengths, lens.shape) > 0, out,
+                jnp.full_like(out, -jnp.inf))
+            out = jax.ops.segment_max(row_max, seg, num_segments=b)
+            out = jnp.where(jnp.isfinite(out), out, jnp.zeros_like(out))
+        elif ptype == 'LAST':
+            last_row = jnp.clip(start + rows - 1, 0, r - 1)
+            out = jnp.take(out, last_row, axis=0)
+        elif ptype == 'FIRST':
+            out = jnp.take(out, jnp.clip(start, 0, r - 1), axis=0)
+        if ptype in ('FIRST', 'LAST'):
+            # a sample with ZERO sub-sequences must not leak a
+            # neighbor's row (its start/end indices point into them)
+            has_rows = (rows > 0).reshape(
+                (b, ) + (1, ) * (out.ndim - 1))
+            out = jnp.where(has_rows, out, jnp.zeros_like(out))
+        ctx.set(op, 'Out', out)
+        if ptype == 'MAX':
+            ctx.set(op, 'MaxIndex', jnp.zeros(out.shape, jnp.int32))
+        return
+    if rows is not None:
+        # TO_SEQUENCE on a nested input: the per-row pooled values form
+        # a plain sequence — REPAD into the canonical [B, T, ...] +
+        # @SEQLEN runtime form so downstream sequence ops compose
+        # (T bound: no sample can own more than all R rows)
+        b = int(rows.shape[0])
+        r = out.shape[0]
+        cum = jnp.cumsum(rows)
+        start = cum - rows
+        seg = jnp.clip(jnp.searchsorted(cum, jnp.arange(r), side='right'),
+                       0, b - 1)
+        slot = jnp.arange(r) - jnp.take(start, seg)
+        padded = jnp.zeros((b, r) + out.shape[1:], out.dtype)
+        padded = padded.at[seg, slot].set(out)
+        ctx.set(op, 'Out', padded)
+        ctx.env[op.output('Out')[0] + SEQLEN_SUFFIX] = \
+            rows.astype(jnp.int32)
+        if ptype == 'MAX':
+            ctx.set(op, 'MaxIndex', jnp.zeros(padded.shape, jnp.int32))
+        return
     ctx.set(op, 'Out', out)
     if ptype == 'MAX':
         ctx.set(op, 'MaxIndex',

@@ -24,7 +24,22 @@ def _build_feed(data_layers, data_batch, feeding=None):
     for i, layer in enumerate(data_layers):
         col = [sample[order[i]] for sample in data_batch]
         t = layer.data_type
-        if t.seq_type:  # variable-length rows -> LoDTensor
+        if t.seq_type == 2:  # nested: sample = list of sub-sequences
+            width, dt = ((1, np.int64) if t.type == _dt.DataType.Index
+                         else (t.dim, np.float32))
+            chunks, inner, outer = [], [], []
+            for sample_rows in col:
+                outer.append(len(sample_rows))
+                for sub in sample_rows:
+                    arr = np.asarray(sub, dt).reshape(-1, width)
+                    chunks.append(arr)
+                    inner.append(len(arr))
+            flat = (np.concatenate(chunks) if chunks
+                    else np.zeros((0, width), dt))
+            lt = fluid.core.LoDTensor(flat)
+            lt.set_recursive_sequence_lengths([outer, inner])
+            feed[layer.name] = lt
+        elif t.seq_type:  # variable-length rows -> LoDTensor
             if t.type == _dt.DataType.Index:
                 flat = np.concatenate(
                     [np.asarray(r, np.int64).reshape(-1, 1) for r in col])

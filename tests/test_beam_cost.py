@@ -156,11 +156,17 @@ def test_kmax_feeds_sub_nested_seq_reference_flow():
     scores = tch.data_layer(name='sc', size=1, seq=True)
     sel = tch.kmax_seq_score_layer(input=scores, beam_size=2)
     sub = tch.sub_nested_seq_layer(input=nested, selected_indices=sel)
-    pooled = tch.pooling_layer(input=sub, pooling_type=tch.SumPooling())
+    # TO_SEQUENCE: one pooled value per selected sub-sequence
+    pooled = tch.pooling_layer(input=sub, pooling_type=tch.SumPooling(),
+                               agg_level=tch.AggregateLevel.TO_SEQUENCE)
+    # the default TO_NO_SEQUENCE: one value per top-level sequence
+    total = tch.pooling_layer(input=sub, pooling_type=tch.SumPooling())
 
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
-        out_var = pooled.to_fluid({})
+        ctx = {}
+        out_var = pooled.to_fluid(ctx)
+        tot_var = total.to_fluid(ctx)
     # seq0 has rows a=[1,2], b=[10], c=[3,4,5]; row scores favor c, a
     # seq1 has row d=[7,8]; score picks d (tail -1)
     rows = [[[1.], [2.]], [[10.]], [[3.], [4.], [5.]], [[7.], [8.]]]
@@ -171,12 +177,99 @@ def test_kmax_feeds_sub_nested_seq_reference_flow():
     exe = fluid.Executor(fluid.CPUPlace())
     with fluid.scope_guard(fluid.core.Scope()):
         exe.run(startup)
-        got, = exe.run(main, feed={'nx': nx, 'sc': sc},
-                       fetch_list=[out_var])
-    # selected: seq0 rows [2 (c), 0 (a)], seq1 row [0 (d)] -> packed
-    # sums [12, 3, 15, 0]
-    np.testing.assert_allclose(np.asarray(got)[:4, 0],
-                               [12., 3., 15., 0.], rtol=1e-6)
+        got, tot = exe.run(main, feed={'nx': nx, 'sc': sc},
+                           fetch_list=[out_var, tot_var])
+    # selected: seq0 rows [2 (c), 0 (a)], seq1 row [0 (d)].
+    # TO_SEQUENCE repads to the canonical [B, T, D] sequence form:
+    # seq0 -> [12, 3], seq1 -> [15]; per-sample totals [15, 15]
+    got = np.asarray(got)
+    np.testing.assert_allclose(got[0, :2, 0], [12., 3.], rtol=1e-6)
+    np.testing.assert_allclose(got[1, 0, 0], 15., rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(tot)[:2, 0], [15., 15.],
+                               rtol=1e-6)
+
+
+def test_to_sequence_pooling_chains_into_second_pool():
+    """TO_SEQUENCE output is a CANONICAL padded sequence: a second
+    sequence op over it must see the outer level as its time axis
+    (the review repro: [R, D] row-packing made a chained pool sum the
+    feature axis)."""
+    nx = tch.data_layer(name='cx', size=2, seq='sub')
+    inner = tch.pooling_layer(input=nx, pooling_type=tch.SumPooling(),
+                              agg_level=tch.AggregateLevel.TO_SEQUENCE)
+    outer = tch.pooling_layer(input=inner,
+                              pooling_type=tch.SumPooling())
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        out_var = outer.to_fluid({})
+    # seq0: rows [[1,10]], [[2,20]]; seq1: rows [[3,30]]
+    flat = np.asarray([[1., 10.], [2., 20.], [3., 30.]], 'float32')
+    nx_feed = fluid.create_lod_tensor(flat, [[2, 1], [1, 1, 1]])
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        got, = exe.run(main, feed={'cx': nx_feed}, fetch_list=[out_var])
+    np.testing.assert_allclose(np.asarray(got)[:2],
+                               [[3., 30.], [3., 30.]], rtol=1e-6)
+
+
+def test_nested_first_last_empty_sample_returns_zeros():
+    """A top-level sequence with zero sub-sequences must pool to zeros,
+    not leak a neighboring sample's row (the review repro)."""
+    for ptype, want in (('first', [1., 0., 3.]), ('last', [2., 0., 4.])):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data('ex', shape=[1], dtype='float32',
+                                  lod_level=2)
+            out = fluid.layers.sequence_pool(x, ptype)
+        vals = np.asarray([[1.], [2.], [3.], [4.]], 'float32')
+        lt = fluid.core.LoDTensor(vals)
+        lt.set_recursive_sequence_lengths([[2, 0, 1], [1, 1, 2]])
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.core.Scope()):
+            exe.run(startup)
+            got, = exe.run(main, feed={'ex': lt}, fetch_list=[out])
+        np.testing.assert_allclose(np.asarray(got)[:3, 0], want,
+                                   rtol=1e-6, err_msg=ptype)
+
+
+def test_nested_input_trains_through_v2_trainer():
+    """SUB_SEQUENCE end-to-end through the v2 trainer feeder: nested
+    samples (list of sub-sequences) convert to a 2-level LoD feed, flow
+    through sub_nested_seq + pooling, and the model trains."""
+    import paddle_tpu.v2.event as ev
+    nested = tch.data_layer(name='vx', size=4, seq='sub')
+    sel = tch.data_layer(name='vsel', size=1)
+    # k=1 selection: one row per sample, so downstream shapes are
+    # per-sample ([B, ...]) and align with the labels
+    sub = tch.sub_nested_seq_layer(input=nested, selected_indices=sel)
+    pooled = tch.pooling_layer(input=sub, pooling_type=tch.SumPooling())
+    pred = tch.fc_layer(input=pooled, size=2,
+                        act=tch.SoftmaxActivation())
+    lbl = tch.data_layer(name='vlbl', size=2, data_type_kind='index')
+    cost = tch.classification_cost(input=pred, label=lbl)
+
+    params = paddle.parameters.create(cost)
+    tr = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.1))
+    rng = np.random.RandomState(5)
+    data = []
+    for i in range(32):
+        c = i % 2
+        base = np.full(4, 2.0 if c else -2.0, 'float32')
+        # sample: 1..3 sub-sequences, each one 4-dim token
+        sample = [[list(base + 0.1 * rng.standard_normal(4))]
+                  for _ in range(rng.randint(1, 4))]
+        data.append((sample, [0.0], c))
+    costs = []
+    tr.train(reader=paddle.minibatch.batch(lambda: iter(data), 8),
+             num_passes=8,
+             event_handler=lambda e: costs.append(e.cost)
+             if isinstance(e, ev.EndIteration) else None,
+             feeding={'vx': 0, 'vsel': 1, 'vlbl': 2})
+    assert np.isfinite(costs).all()
+    assert costs[-1] < costs[0] * 0.7, (costs[0], costs[-1])
 
 
 def test_sub_nested_seq_layer_selects_rows_tch():
@@ -185,7 +278,8 @@ def test_sub_nested_seq_layer_selects_rows_tch():
     x = tch.data_layer(name='x', size=2, seq='sub')
     sel = tch.data_layer(name='sel', size=2)
     sub = tch.sub_nested_seq_layer(input=x, selected_indices=sel)
-    pooled = tch.pooling_layer(input=sub, pooling_type=tch.SumPooling())
+    pooled = tch.pooling_layer(input=sub, pooling_type=tch.SumPooling(),
+                               agg_level=tch.AggregateLevel.TO_SEQUENCE)
 
     # drive the DAG through fluid directly (value-pinning test; the
     # trainer path is exercised by the breadth suite)
@@ -207,5 +301,6 @@ def test_sub_nested_seq_layer_selects_rows_tch():
         got, = exe.run(main, feed={'x': lt, 'sel': sel_np},
                        fetch_list=[out_var])
     got = np.asarray(got)
-    # packed rows: [c, a, d, pad] summed over time
-    np.testing.assert_allclose(got[:4, 0], [12., 3., 15., 0.], rtol=1e-6)
+    # repadded [B, T, D]: seq0 rows [c, a] -> [12, 3]; seq1 [d] -> [15]
+    np.testing.assert_allclose(got[0, :2, 0], [12., 3.], rtol=1e-6)
+    np.testing.assert_allclose(got[1, 0, 0], 15., rtol=1e-6)
