@@ -194,13 +194,14 @@ def sequence_conv(input,
     return helper.append_activation(pre_act)
 
 
-def sequence_pool(input, pool_type, agg_to_no_sequence=True):
+def sequence_pool(input, pool_type, agg_to_no_sequence=False):
     """Pool each sequence to one vector (reference nn.py sequence_pool;
     pool_type: sum/average/sqrt/max/last/first).  On a NESTED (2-level
-    LoD) input, ``agg_to_no_sequence`` selects the legacy
-    AggregateLevel: True (default, reference layers.py:302) pools the
-    whole nested sample to one vector; False pools each sub-sequence,
-    yielding a plain sequence."""
+    LoD) input the FLUID default matches reference fluid: pool the LAST
+    LoD level (each sub-sequence), yielding a plain sequence.
+    ``agg_to_no_sequence=True`` is the legacy v2 AggregateLevel
+    .TO_NO_SEQUENCE (pool the whole nested sample) — v2/tch
+    pooling_layer pass it explicitly."""
     helper = LayerHelper('sequence_pool', **locals())
     dtype = helper.input_dtype()
     pool_out = helper.create_variable_for_type_inference(dtype)
@@ -240,7 +241,11 @@ def sequence_softmax(input, use_cudnn=False, name=None):
     return softmax_out
 
 
-def sequence_expand(x, y, ref_level=-1, name=None):
+def sequence_expand(x, y, ref_level=-1, name=None,
+                    expand_from_sequence=False):
+    """``expand_from_sequence`` selects the legacy
+    ExpandLevel.FROM_SEQUENCE on a nested ref: each item of the plain
+    sequence x broadcasts across the matching sub-sequence of y."""
     helper = LayerHelper('sequence_expand', **locals())
     dtype = helper.input_dtype('x')
     tmp = helper.create_variable_for_type_inference(dtype)
@@ -250,7 +255,8 @@ def sequence_expand(x, y, ref_level=-1, name=None):
         inputs={'X': [x],
                 'Y': [y]},
         outputs={'Out': [tmp]},
-        attrs={'ref_level': ref_level})
+        attrs={'ref_level': ref_level,
+               'expand_from_sequence': bool(expand_from_sequence)})
     return tmp
 
 

@@ -51,6 +51,16 @@ def _fused_lstm_ok(d, b_sz, use_peepholes, gate_act_name, cell_act_name,
             and jax.default_backend() in ('tpu', 'axon'))
 
 
+def _nested_segments(rows, r):
+    """Packed nested layout bookkeeping: per-sample row starts and each
+    global row's owning sample (rows [B] may be traced)."""
+    cum = jnp.cumsum(rows)
+    start = cum - rows
+    seg = jnp.clip(jnp.searchsorted(cum, jnp.arange(r), side='right'),
+                   0, int(rows.shape[0]) - 1)
+    return start, seg
+
+
 def _mask(x, lengths, dtype=None):
     """[B, T] validity mask broadcastable against x [B, T, ...]."""
     t = x.shape[1]
@@ -95,7 +105,7 @@ def _sequence_pool(ctx, op):
     else:
         raise NotImplementedError('sequence_pool type %r' % ptype)
     rows = ctx.env.get(op.input('X')[0] + ROWS_SUFFIX)
-    if rows is not None and op.attrs.get('agg_to_no_sequence', True):
+    if rows is not None and op.attrs.get('agg_to_no_sequence', False):
         # nested input + AggregateLevel.TO_NO_SEQUENCE (the reference
         # default, layers.py:302): aggregate over ALL timesteps of each
         # TOP-level sequence.  The inner pooling above gives one value
@@ -104,16 +114,20 @@ def _sequence_pool(ctx, op):
         # average-of-averages).
         b = int(rows.shape[0])
         r = x.shape[0]
-        cum = jnp.cumsum(rows)
-        start = cum - rows
-        seg = jnp.searchsorted(cum, jnp.arange(r), side='right')
-        seg = jnp.clip(seg, 0, b - 1)
+        start, seg = _nested_segments(rows, r)
         row_cnt = lengths.astype(jnp.float32)
         tot_cnt = jax.ops.segment_sum(row_cnt, seg, num_segments=b)
         safe_cnt = jnp.maximum(tot_cnt, 1.0).reshape(
             (b, ) + (1, ) * (out.ndim - 1)).astype(out.dtype)
         if ptype in ('SUM', 'AVERAGE', 'SQRT'):
-            row_tot = jnp.sum(x * m, axis=1)
+            # the inner pool already produced the masked time-sum (out
+            # IS it for SUM; AVERAGE/SQRT divided it by lens)
+            if ptype == 'SUM':
+                row_tot = out
+            elif ptype == 'AVERAGE':
+                row_tot = out * lens
+            else:
+                row_tot = out * jnp.sqrt(lens)
             tot = jax.ops.segment_sum(row_tot, seg, num_segments=b)
             if ptype == 'SUM':
                 out = tot
@@ -149,10 +163,7 @@ def _sequence_pool(ctx, op):
         # (T bound: no sample can own more than all R rows)
         b = int(rows.shape[0])
         r = out.shape[0]
-        cum = jnp.cumsum(rows)
-        start = cum - rows
-        seg = jnp.clip(jnp.searchsorted(cum, jnp.arange(r), side='right'),
-                       0, b - 1)
+        start, seg = _nested_segments(rows, r)
         slot = jnp.arange(r) - jnp.take(start, seg)
         padded = jnp.zeros((b, r) + out.shape[1:], out.dtype)
         padded = padded.at[seg, slot].set(out)
@@ -198,16 +209,57 @@ def _sequence_softmax(ctx, op):
 @register_lowering('sequence_expand')
 def _sequence_expand(ctx, op):
     """Broadcast each batch row of X across its ref sequence's steps
-    (reference sequence_expand_op.cc, level-1 semantics on padded form)."""
+    (reference sequence_expand_op.cc, level-1 semantics on padded form).
+
+    With attr ``expand_from_sequence`` and a NESTED ref (the legacy
+    ExpandLevel.FROM_SEQUENCE, reference layers.py:1838): X is a plain
+    sequence whose j-th item of sample b broadcasts across the j-th
+    sub-sequence of the nested ref — SEQUENCE expands to SUB_SEQUENCE."""
     x = ctx.get(op, 'X')  # [B, D] or [B, 1, D]
     y = ctx.get(op, 'Y')  # [B, T, ...] provides the target lengths
+    ynames = op.input('Y')
+    rows = (ctx.env.get(ynames[0] + ROWS_SUFFIX) if ynames else None)
+    if op.attrs.get('expand_from_sequence') and rows is not None:
+        # X [B, Tx, D] items -> ref rows [R, T2, ...]
+        if x.ndim < 3:
+            raise ValueError(
+                'sequence_expand(FROM_SEQUENCE): X must be a SEQUENCE '
+                '(padded [B, T, D]), got shape %s — FROM_NO_SEQUENCE '
+                'is the level for per-sample inputs' % (x.shape, ))
+        b = int(rows.shape[0])
+        r = y.shape[0]
+        start, seg = _nested_segments(rows, r)
+        raw_slot = jnp.arange(r) - jnp.take(start, seg)
+        slot = jnp.clip(raw_slot, 0, x.shape[1] - 1)
+        vals = x[seg, slot]                      # [R, D]
+        # a ref sub-sequence beyond X's own item count gets zeros, not
+        # clipped garbage (reference errors on the length mismatch;
+        # lengths are traced here, so mask instead — caller contract)
+        x_lens = ctx.env.get(op.input('X')[0] + SEQLEN_SUFFIX)
+        if x_lens is not None:
+            ok = raw_slot < jnp.take(x_lens.astype(jnp.int32), seg)
+            vals = jnp.where(
+                ok.reshape((-1, ) + (1, ) * (vals.ndim - 1)), vals,
+                jnp.zeros_like(vals))
+        t2 = y.shape[1]
+        out = jnp.repeat(vals[:, None], t2, axis=1)  # [R, T2, D]
+        inner = ctx.env.get(ynames[0] + SEQLEN_SUFFIX)
+        if inner is not None:
+            m = _mask(out, inner.astype(jnp.int32), out.dtype)
+            out = out * jnp.reshape(
+                m, m.shape + (1, ) * (out.ndim - 2))
+            ctx.env[op.output('Out')[0] + SEQLEN_SUFFIX] = \
+                inner.astype(jnp.int32)
+        ctx.env[op.output('Out')[0] + ROWS_SUFFIX] = \
+            rows.astype(jnp.int32)
+        ctx.set(op, 'Out', out)
+        return
     if x.ndim == y.ndim:  # already time-major: tile per-step
         ctx.set(op, 'Out', x)
         return
     t = y.shape[1]
     out = jnp.repeat(x[:, None], t, axis=1)
     ctx.set(op, 'Out', out)
-    ynames = op.input('Y')
     if ynames and (ynames[0] + SEQLEN_SUFFIX) in ctx.env:
         for n in op.output('Out'):
             ctx.env[n + SEQLEN_SUFFIX] = ctx.env[ynames[0] + SEQLEN_SUFFIX]

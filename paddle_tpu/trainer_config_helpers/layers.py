@@ -56,7 +56,7 @@ __all__ = [
     'huber_classification_cost', 'lambda_cost', 'cross_entropy_with_selfnorm',
     # round-4: the last three builders (108/108, VERDICT r3 next-#4)
     'sub_nested_seq_layer', 'BeamInput', 'cross_entropy_over_beam',
-    'beam_search', 'GeneratedInput', 'AggregateLevel',
+    'beam_search', 'GeneratedInput', 'AggregateLevel', 'ExpandLevel',
 ]
 
 _OUTPUTS = []
@@ -250,8 +250,13 @@ def maxout_layer(input, groups, name=None, **kwargs):
 
 
 # ---- sequence ----
-def expand_layer(input, expand_as, name=None, **kwargs):
-    return _v2.expand(input=input, expand_as=expand_as, name=name)
+ExpandLevel = _v2.ExpandLevel
+
+
+def expand_layer(input, expand_as, name=None,
+                 expand_level=ExpandLevel.FROM_NO_SEQUENCE, **kwargs):
+    return _v2.expand(input=input, expand_as=expand_as, name=name,
+                      expand_level=expand_level)
 
 
 def seq_concat_layer(a, b, name=None, **kwargs):

@@ -46,6 +46,7 @@ __all__ = [
     # round-4: the last legacy-DSL builders (VERDICT r3 next-#4)
     'sub_nested_seq', 'beam_search', 'GeneratedInput', 'BaseGeneratedInput',
     'BeamInput', 'cross_entropy_over_beam', 'AggregateLevel',
+    'ExpandLevel',
 ]
 
 
@@ -655,9 +656,22 @@ def block_expand(input, block_x, block_y, stride_x=1, stride_y=1,
     return Layer('block_expand', [input], build, name=name)
 
 
-def expand(input, expand_as, name=None, **kwargs):
+class ExpandLevel(object):
+    """Expansion level (reference layers.py:1838): FROM_NO_SEQUENCE
+    expands per-sample values over a sequence; FROM_SEQUENCE expands a
+    plain sequence's items over a NESTED ref's sub-sequences."""
+    FROM_NO_SEQUENCE = AggregateLevel.TO_NO_SEQUENCE
+    FROM_SEQUENCE = AggregateLevel.TO_SEQUENCE
+    FROM_TIMESTEP = FROM_NO_SEQUENCE  # legacy alias
+
+
+def expand(input, expand_as, name=None,
+           expand_level=ExpandLevel.FROM_NO_SEQUENCE, **kwargs):
     def build(ctx, v, ref):
-        return fluid.layers.sequence_expand(v, ref)
+        return fluid.layers.sequence_expand(
+            v, ref,
+            expand_from_sequence=(
+                expand_level == ExpandLevel.FROM_SEQUENCE))
 
     return Layer('expand', [input, expand_as], build, name=name,
                  size=input.size)

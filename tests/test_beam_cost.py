@@ -221,7 +221,8 @@ def test_nested_first_last_empty_sample_returns_zeros():
         with fluid.program_guard(main, startup):
             x = fluid.layers.data('ex', shape=[1], dtype='float32',
                                   lod_level=2)
-            out = fluid.layers.sequence_pool(x, ptype)
+            out = fluid.layers.sequence_pool(x, ptype,
+                                             agg_to_no_sequence=True)
         vals = np.asarray([[1.], [2.], [3.], [4.]], 'float32')
         lt = fluid.core.LoDTensor(vals)
         lt.set_recursive_sequence_lengths([[2, 0, 1], [1, 1, 2]])
@@ -231,6 +232,36 @@ def test_nested_first_last_empty_sample_returns_zeros():
             got, = exe.run(main, feed={'ex': lt}, fetch_list=[out])
         np.testing.assert_allclose(np.asarray(got)[:3, 0], want,
                                    rtol=1e-6, err_msg=ptype)
+
+
+def test_expand_from_sequence_over_nested_ref():
+    """ExpandLevel.FROM_SEQUENCE (reference layers.py:1838): the j-th
+    item of a plain sequence broadcasts across the j-th sub-sequence of
+    the nested ref — SEQUENCE expands to SUB_SEQUENCE."""
+    xs = tch.data_layer(name='px', size=1, seq=True)
+    ref = tch.data_layer(name='pref', size=1, seq='sub')
+    ex = tch.expand_layer(input=xs, expand_as=ref,
+                          expand_level=tch.ExpandLevel.FROM_SEQUENCE)
+    # pool each expanded sub-sequence: value * inner length
+    per_row = tch.pooling_layer(input=ex, pooling_type=tch.SumPooling(),
+                                agg_level=tch.AggregateLevel.TO_SEQUENCE)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        out_var = per_row.to_fluid({})
+    # sample0: items [10, 20] over sub-seqs of len 2, 1
+    # sample1: item [30] over one sub-seq of len 3
+    x_feed = fluid.create_lod_tensor(
+        np.asarray([[10.], [20.], [30.]], 'float32'), [[2, 1]])
+    ref_feed = fluid.create_lod_tensor(
+        np.zeros((6, 1), 'float32'), [[2, 1], [2, 1, 3]])
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        got, = exe.run(main, feed={'px': x_feed, 'pref': ref_feed},
+                       fetch_list=[out_var])
+    got = np.asarray(got)
+    np.testing.assert_allclose(got[0, :2, 0], [20., 20.], rtol=1e-6)
+    np.testing.assert_allclose(got[1, 0, 0], 90., rtol=1e-6)
 
 
 def test_nested_input_trains_through_v2_trainer():
