@@ -141,17 +141,25 @@ def _sequence_pool(ctx, op):
                 jnp.full_like(out, -jnp.inf))
             out = jax.ops.segment_max(row_max, seg, num_segments=b)
             out = jnp.where(jnp.isfinite(out), out, jnp.zeros_like(out))
-        elif ptype == 'LAST':
-            last_row = jnp.clip(start + rows - 1, 0, r - 1)
-            out = jnp.take(out, last_row, axis=0)
-        elif ptype == 'FIRST':
-            out = jnp.take(out, jnp.clip(start, 0, r - 1), axis=0)
-        if ptype in ('FIRST', 'LAST'):
-            # a sample with ZERO sub-sequences must not leak a
-            # neighbor's row (its start/end indices point into them)
-            has_rows = (rows > 0).reshape(
-                (b, ) + (1, ) * (out.ndim - 1))
-            out = jnp.where(has_rows, out, jnp.zeros_like(out))
+        elif ptype in ('LAST', 'FIRST'):
+            # the sample's true last/first timestep lives in its last/
+            # first NON-EMPTY sub-sequence (an empty trailing/leading
+            # row would otherwise contribute its padding); a sample
+            # with no non-empty rows pools to zeros
+            valid_row = lengths > 0
+            idx = jnp.arange(r)
+            if ptype == 'LAST':
+                pick = jax.ops.segment_max(
+                    jnp.where(valid_row, idx, -1), seg, num_segments=b)
+            else:
+                pick = -jax.ops.segment_max(
+                    jnp.where(valid_row, -idx, -(r + 1)), seg,
+                    num_segments=b)
+            has_any = (pick >= 0) & (pick <= r - 1)
+            out = jnp.take(out, jnp.clip(pick, 0, r - 1), axis=0)
+            out = jnp.where(
+                has_any.reshape((b, ) + (1, ) * (out.ndim - 1)), out,
+                jnp.zeros_like(out))
         ctx.set(op, 'Out', out)
         if ptype == 'MAX':
             ctx.set(op, 'MaxIndex', jnp.zeros(out.shape, jnp.int32))
@@ -219,6 +227,12 @@ def _sequence_expand(ctx, op):
     y = ctx.get(op, 'Y')  # [B, T, ...] provides the target lengths
     ynames = op.input('Y')
     rows = (ctx.env.get(ynames[0] + ROWS_SUFFIX) if ynames else None)
+    if op.attrs.get('expand_from_sequence') and rows is None:
+        raise ValueError(
+            'sequence_expand(FROM_SEQUENCE): the expand_as ref %r is '
+            'not a nested (2-level LoD) sequence — the reference '
+            'errors on this level mismatch; use FROM_NO_SEQUENCE for '
+            'a plain ref' % (ynames[0] if ynames else None))
     if op.attrs.get('expand_from_sequence') and rows is not None:
         # X [B, Tx, D] items -> ref rows [R, T2, ...]
         if x.ndim < 3:

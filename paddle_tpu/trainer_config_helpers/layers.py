@@ -168,12 +168,14 @@ def batch_norm_layer(input, act=None, name=None, **kwargs):
     return _v2.batch_norm(input=input, act=act, name=name)
 
 
-def last_seq(input, name=None, **kwargs):
-    return _v2.last_seq(input=input, name=name)
+def last_seq(input, name=None,
+             agg_level=AggregateLevel.TO_NO_SEQUENCE, **kwargs):
+    return _v2.last_seq(input=input, name=name, agg_level=agg_level)
 
 
-def first_seq(input, name=None, **kwargs):
-    return _v2.first_seq(input=input, name=name)
+def first_seq(input, name=None,
+              agg_level=AggregateLevel.TO_NO_SEQUENCE, **kwargs):
+    return _v2.first_seq(input=input, name=name, agg_level=agg_level)
 
 
 def maxid_layer(input, name=None, **kwargs):

@@ -494,16 +494,22 @@ def gru_like(input, size, name=None, reverse=False, param_attr=None,
 
 
 # ---- sequence/shape layers ----
-def last_seq(input, name=None, **kwargs):
+def last_seq(input, name=None,
+             agg_level=AggregateLevel.TO_NO_SEQUENCE, **kwargs):
     def build(ctx, parent_var):
-        return fluid.layers.sequence_last_step(parent_var)
+        return fluid.layers.sequence_pool(
+            parent_var, 'last',
+            agg_to_no_sequence=(agg_level != AggregateLevel.TO_SEQUENCE))
 
     return Layer('last_seq', [input], build, name=name, size=input.size)
 
 
-def first_seq(input, name=None, **kwargs):
+def first_seq(input, name=None,
+              agg_level=AggregateLevel.TO_NO_SEQUENCE, **kwargs):
     def build(ctx, parent_var):
-        return fluid.layers.sequence_first_step(parent_var)
+        return fluid.layers.sequence_pool(
+            parent_var, 'first',
+            agg_to_no_sequence=(agg_level != AggregateLevel.TO_SEQUENCE))
 
     return Layer('first_seq', [input], build, name=name, size=input.size)
 

@@ -264,6 +264,50 @@ def test_expand_from_sequence_over_nested_ref():
     np.testing.assert_allclose(got[1, 0, 0], 90., rtol=1e-6)
 
 
+def test_nested_last_first_skip_empty_rows():
+    """Whole-sample LAST/FIRST must come from the last/first NON-EMPTY
+    sub-sequence — an empty trailing/leading row would otherwise
+    contribute its padding zeros (review repro)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('er', shape=[1], dtype='float32',
+                              lod_level=2)
+        last = fluid.layers.sequence_pool(x, 'last',
+                                          agg_to_no_sequence=True)
+        first = fluid.layers.sequence_pool(x, 'first',
+                                           agg_to_no_sequence=True)
+    lt = fluid.core.LoDTensor(np.asarray([[5.], [7.]], 'float32'))
+    lt.set_recursive_sequence_lengths([[3], [0, 2, 0]])
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        lv, fv = exe.run(main, feed={'er': lt}, fetch_list=[last, first])
+    assert float(np.asarray(lv)[0, 0]) == 7.0
+    assert float(np.asarray(fv)[0, 0]) == 5.0
+
+
+def test_expand_from_sequence_rejects_plain_ref():
+    """FROM_SEQUENCE over a non-nested ref is the reference's level
+    mismatch error, not a silent no-op (review repro)."""
+    import pytest
+    xs = tch.data_layer(name='rx', size=1, seq=True)
+    ref = tch.data_layer(name='rref', size=1, seq=True)  # NOT nested
+    ex = tch.expand_layer(input=xs, expand_as=ref,
+                          expand_level=tch.ExpandLevel.FROM_SEQUENCE)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        out_var = ex.to_fluid({})
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        feed = {'rx': fluid.create_lod_tensor(
+                    np.asarray([[1.], [2.]], 'float32'), [[2]]),
+                'rref': fluid.create_lod_tensor(
+                    np.zeros((5, 1), 'float32'), [[5]])}
+        with pytest.raises(Exception, match='FROM_SEQUENCE'):
+            exe.run(main, feed=feed, fetch_list=[out_var])
+
+
 def test_nested_input_trains_through_v2_trainer():
     """SUB_SEQUENCE end-to-end through the v2 trainer feeder: nested
     samples (list of sub-sequences) convert to a 2-level LoD feed, flow
