@@ -152,8 +152,8 @@ def _sequence_pool(ctx, op):
                 pick = jax.ops.segment_max(
                     jnp.where(valid_row, idx, -1), seg, num_segments=b)
             else:
-                pick = -jax.ops.segment_max(
-                    jnp.where(valid_row, -idx, -(r + 1)), seg,
+                pick = jax.ops.segment_min(
+                    jnp.where(valid_row, idx, r + 1), seg,
                     num_segments=b)
             has_any = (pick >= 0) & (pick <= r - 1)
             out = jnp.take(out, jnp.clip(pick, 0, r - 1), axis=0)

@@ -35,14 +35,23 @@ def main():
         'global device view must span all processes: %d devices, %d procs' %
         (len(jax.devices()), nproc))
 
+    steps = int(os.environ.get('DIST_TEST_STEPS', '5'))
+    mode = os.environ.get('DIST_TEST_MODE', 'dp')
+
+    if mode == 'dp_sp':
+        # cross-process SEQUENCE parallelism: the 'sp' axis spans devices
+        # in DIFFERENT processes, so ring attention's lax.ppermute
+        # rotations of K/V blocks cross the process boundary — the
+        # multi-host long-context story (SURVEY §5.7)
+        _run_dp_sp(jax, np, fluid, pid, steps)
+        return
+
+    batch = int(os.environ.get('DIST_TEST_BATCH', '32'))
+    rng = np.random.RandomState(42)
     from paddle_tpu.models import mnist
     model = mnist.build(nn_type='mlp', lr=0.01)
     model['startup'].random_seed = 7
     model['main'].random_seed = 7
-    steps = int(os.environ.get('DIST_TEST_STEPS', '5'))
-    batch = int(os.environ.get('DIST_TEST_BATCH', '32'))
-    mode = os.environ.get('DIST_TEST_MODE', 'dp')
-    rng = np.random.RandomState(42)
     exe = fluid.Executor(fluid.CPUPlace())
     scope = fluid.core.Scope()
     mesh = None
@@ -69,6 +78,38 @@ def main():
         for _ in range(steps):
             loss_v, = pe.run([model['loss']],
                              feed={'img': img, 'label': label})
+            losses.append(float(np.asarray(loss_v).flatten()[0]))
+    print(json.dumps({'pid': pid, 'losses': losses}), flush=True)
+
+
+def _run_dp_sp(jax, np, fluid, pid, steps):
+    from paddle_tpu import parallel
+    from paddle_tpu.models import transformer
+
+    devs = jax.devices()
+    mesh = parallel.make_mesh({'dp': 1, 'sp': len(devs)}, devs)
+    T = 32  # fixed GLOBAL length: 1-proc shards 16 tokens, 2-proc 8
+    model = transformer.build(src_vocab=64, trg_vocab=64, max_len=T,
+                              n_layer=1, n_head=2, d_model=16, d_ff=32)
+    model['startup'].random_seed = 7
+    model['main'].random_seed = 7
+    rng = np.random.RandomState(42)
+    batch = 2
+    src = rng.randint(2, 64, (batch, T)).astype('int64')
+    trg = np.concatenate([np.zeros((batch, 1), 'int64'), src[:, :-1]],
+                         axis=1)
+    scope = fluid.core.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(model['startup'])
+        pe = fluid.ParallelExecutor(loss_name=model['loss'].name,
+                                    main_program=model['main'],
+                                    scope=scope, mesh=mesh)
+        for _ in range(steps):
+            loss_v, = pe.run([model['loss'].name],
+                             feed={'src_ids': src, 'trg_ids': trg,
+                                   'lbl_ids': src})
             losses.append(float(np.asarray(loss_v).flatten()[0]))
     print(json.dumps({'pid': pid, 'losses': losses}), flush=True)
 

@@ -48,17 +48,17 @@ def _parse_losses(rc, stdout, stderr):
     raise AssertionError('no JSON line in worker stdout:\n%s' % stdout)
 
 
-def _run_single():
-    env = _base_env()
+def _run_single(env_extra=None):
+    env = dict(_base_env(), **(env_extra or {}))
     env['PADDLE_TRAINERS_NUM'] = '1'
     proc = subprocess.run([sys.executable, WORKER], env=env,
                           capture_output=True, text=True, timeout=300)
     return _parse_losses(proc.returncode, proc.stdout, proc.stderr)
 
 
-def _run_dist(nproc=2):
+def _run_dist(nproc=2, env_extra=None):
     port = _free_port()
-    env = _base_env()
+    env = dict(_base_env(), **(env_extra or {}))
     procs = []
     for pid in range(nproc):
         penv = dict(env,
@@ -136,3 +136,19 @@ def test_two_process_dp_tp_mesh():
     losses = [_parse_losses(*out) for out in outs]
     np.testing.assert_allclose(losses[1], losses[0], rtol=1e-6)
     np.testing.assert_allclose(losses[0], single, rtol=2e-4, atol=2e-5)
+
+
+def test_two_process_dp_sp_ring_attention():
+    """Cross-process SEQUENCE parallelism (round 4): the sp mesh axis
+    spans devices in different processes, so ring attention's ppermute
+    K/V rotations cross the process boundary.  Both ranks must see one
+    replicated, finite, falling loss trajectory; it must match the same
+    global-length model run single-process on its own sp mesh."""
+    mode = {'DIST_TEST_MODE': 'dp_sp'}
+    single = _run_single(env_extra=mode)
+    dist = _run_dist(nproc=2, env_extra=mode)
+    assert all(np.isfinite(v) for v in dist)
+    assert dist[-1] < dist[0]
+    # ring over 4 shards (2 procs) vs ring over 2 shards (1 proc): same
+    # attention math, different FP reduction order -> float tolerance
+    np.testing.assert_allclose(dist, single, rtol=2e-4, atol=2e-5)
