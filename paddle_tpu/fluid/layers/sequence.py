@@ -15,6 +15,7 @@ __all__ = [
     'sequence_pool', 'sequence_softmax', 'sequence_first_step',
     'sequence_last_step', 'sequence_expand', 'sequence_concat',
     'sequence_reshape', 'sequence_enumerate', 'sequence_erase',
+    'sequence_reverse',
     'dynamic_lstmp',
     'sequence_slice', 'row_conv', 'sequence_pad', 'sequence_mask',
     'beam_search', 'beam_search_decode', 'beam_expand', 'beam_init_scores',
@@ -258,6 +259,23 @@ def sequence_expand(x, y, ref_level=-1, name=None,
         attrs={'ref_level': ref_level,
                'expand_from_sequence': bool(expand_from_sequence)})
     return tmp
+
+
+def sequence_reverse(x, name=None):
+    """Reverse each sequence along time, mask-aware (padding stays in
+    place).  The input transform behind reverse recurrences
+    (reference operators/reverse_op.cc; RecurrentGradientMachine's
+    reversed scan)."""
+    helper = LayerHelper('sequence_reverse', **locals())
+    out = helper.create_variable_for_type_inference(
+        dtype=helper.input_dtype('x'))
+    out.shape = x.shape
+    out.lod_level = x.lod_level
+    helper.append_op(
+        type='sequence_reverse',
+        inputs={'X': [x]},
+        outputs={'Out': [out]})
+    return out
 
 
 def sequence_concat(input, name=None):

@@ -214,6 +214,27 @@ def _sequence_softmax(ctx, op):
     ctx.set(op, 'Out', out[..., None] if squeeze else out)
 
 
+@register_lowering('sequence_reverse')
+def _sequence_reverse(ctx, op):
+    """Mask-aware per-sequence time reversal: out[b, t] = x[b, L_b-1-t]
+    for t < L_b, padding stays zero in place (the reference's
+    reverse-recurrence input transform; reverse_op.cc is the dense-axis
+    cousin).  Lengths propagate unchanged."""
+    x = ctx.get(op, 'X')
+    lengths = _seqlen(ctx, op)
+    t = x.shape[1]
+    if lengths is None:
+        ctx.set(op, 'Out', jnp.flip(x, axis=1))
+        return
+    lengths = lengths.astype(jnp.int32)
+    pos = jnp.arange(t)[None, :]
+    src = jnp.clip(lengths[:, None] - 1 - pos, 0, t - 1)
+    out = jnp.take_along_axis(
+        x, src.reshape(src.shape + (1, ) * (x.ndim - 2)), axis=1)
+    m = _expand_mask(_mask(x, lengths, x.dtype), x)
+    ctx.set(op, 'Out', out * m)
+
+
 @register_lowering('sequence_expand')
 def _sequence_expand(ctx, op):
     """Broadcast each batch row of X across its ref sequence's steps

@@ -411,17 +411,25 @@ def _wrap_fluid_var(ctx, var, kind='step_input'):
     return layer
 
 
-def recurrent_group(step, input, name=None, **kwargs):
+def recurrent_group(step, input, name=None, reverse=False, **kwargs):
     """Run ``step`` per timestep over sequence inputs (reference
     layer.py:3317 recurrent_group).  ``step`` receives one Layer per
     input (StaticInput wraps whole-sequence inputs) and returns the
     step's output layer; ``memory(name=N)`` inside the step reads the
-    previous step's value of the layer named N."""
+    previous step's value of the layer named N.  ``reverse=True`` scans
+    each sequence back-to-front with outputs aligned to the ORIGINAL
+    positions (mask-aware flip -> forward scan -> flip back, the
+    dynamic_lstm(is_reverse=) mechanism)."""
     inputs = input if isinstance(input, (list, tuple)) else [input]
     seq_parents = [i.input if isinstance(i, StaticInput) else i
                    for i in inputs]
 
     def build(ctx, *parent_vars):
+        if reverse:
+            parent_vars = tuple(
+                v if isinstance(spec, StaticInput)
+                else fluid.layers.sequence_reverse(v)
+                for spec, v in zip(inputs, parent_vars))
         rnn = fluid.layers.DynamicRNN()
         outer_rnn = ctx.get('__rnn__')
         outer_pending = ctx.pop('__pending_memories__', None)
@@ -452,7 +460,10 @@ def recurrent_group(step, input, name=None, **kwargs):
             ctx.pop('__rnn__', None)
         if outer_pending is not None:
             ctx['__pending_memories__'] = outer_pending
-        return rnn()
+        out = rnn()
+        if reverse:
+            out = fluid.layers.sequence_reverse(out)
+        return out
 
     layer = Layer('recurrent_group', seq_parents, build, name=name)
     return layer
@@ -1615,11 +1626,6 @@ def recurrent(input, size=None, act=None, reverse=False, name=None,
     (reference recurrent_layer) — expressed through the recurrent_group
     step DSL over the fluid scan (state update by the memory's
     name-match contract)."""
-    if reverse:
-        raise NotImplementedError(
-            'recurrent_layer(reverse=True): wrap the input with a '
-            'reversed lstmemory/gru instead — recurrent_group scans '
-            'forward')
     width = size or input.size
     if input.size is not None and width != input.size:
         raise ValueError(
@@ -1637,7 +1643,8 @@ def recurrent(input, size=None, act=None, reverse=False, name=None,
         rec = fc(input=mem, size=width)
         return addto(input=[ipt, rec], act=act or Tanh(), name=state)
 
-    out = recurrent_group(step=step, input=input, name=name)
+    out = recurrent_group(step=step, input=input, name=name,
+                          reverse=reverse)
     out.size = width
     return out
 
