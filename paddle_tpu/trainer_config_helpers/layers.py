@@ -164,8 +164,15 @@ def grumemory(input, size, name=None, reverse=False, param_attr=None,
                      bias_attr=bias_attr), kwargs)
 
 
-def batch_norm_layer(input, act=None, name=None, **kwargs):
-    return _v2.batch_norm(input=input, act=act, name=name)
+def batch_norm_layer(input, act=None, name=None, epsilon=1e-5,
+                     moving_average_fraction=0.9, use_global_stats=None,
+                     param_attr=None, bias_attr=None, **kwargs):
+    return _with_layer_attr(
+        _v2.batch_norm(input=input, act=act, name=name, epsilon=epsilon,
+                       moving_average_fraction=moving_average_fraction,
+                       use_global_stats=use_global_stats,
+                       param_attr=param_attr, bias_attr=bias_attr),
+        kwargs)
 
 
 def last_seq(input, name=None,

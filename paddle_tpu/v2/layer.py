@@ -242,9 +242,19 @@ def img_pool(input, pool_size, stride=1, padding=0, pool_type=None,
     return Layer('img_pool', [input], build, name=name)
 
 
-def batch_norm(input, act=None, name=None, **kwargs):
+def batch_norm(input, act=None, name=None, epsilon=1e-5,
+               moving_average_fraction=0.9, use_global_stats=None,
+               param_attr=None, bias_attr=None, **kwargs):
+    """(reference batch_norm_layer): epsilon, the moving-average
+    momentum, frozen-statistics mode, and the scale/shift attrs all
+    forward to fluid batch_norm."""
     def build(ctx, parent_var):
-        return fluid.layers.batch_norm(parent_var, act=_act_name(act))
+        return fluid.layers.batch_norm(
+            parent_var, act=_act_name(act),
+            is_test=bool(use_global_stats),
+            momentum=moving_average_fraction, epsilon=epsilon,
+            param_attr=_fluid_attr(param_attr),
+            bias_attr=_fluid_attr(bias_attr))
 
     return Layer('batch_norm', [input], build, name=name)
 

@@ -1,0 +1,125 @@
+"""Automated legacy-DSL signature audit (PARITY.md fidelity table's
+evidence): AST-parse the reference trainer_config_helpers/layers.py
+builder signatures (no import — the reference needs its own proto deps)
+and compare each parameter against this repo's builder signatures.
+
+For every shared builder, each reference parameter is classified:
+  explicit   — named in our signature (forwarded or deliberately handled)
+  kwargs     — absorbed by **kwargs (accepted-inert; the fidelity table
+               documents which of these carry semantics)
+  n/a        — our builder takes no **kwargs and lacks the name (would
+               raise TypeError — loud, not silent)
+
+Usage:
+    PYTHONPATH=. python tools/dsl_signature_audit.py [--write-report]
+
+The pytest gate (tests/test_api_spec.py) asserts zero builders regress
+to `n/a` for reference parameters and that the inert list only shrinks.
+"""
+
+import ast
+import argparse
+import inspect
+import os
+import sys
+
+REF = '/root/reference/python/paddle/trainer_config_helpers/layers.py'
+REPORT = 'tools/dsl_audit_report.md'
+
+# reference params that are engine knobs with no per-layer XLA analog —
+# documented as accepted-inert in PARITY.md's fidelity audit
+DOCUMENTED_INERT = {
+    'layer_attr', 'extra_attr', 'device', 'error_clipping_threshold',
+    'coeff',  # cost weighting handled at optimizer aggregation level
+    'stride',  # last_seq/first_seq stride-pooling (reference seq pool
+               # stride mode; no in-tree config uses it)
+    'num_channels',  # inferable from input shape in several builders
+}
+
+
+def reference_signatures():
+    tree = ast.parse(open(REF).read())
+    sigs = {}
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            args = [a.arg for a in node.args.args]
+            sigs[node.name] = args
+    return sigs
+
+
+def repo_builders():
+    import paddle_tpu.trainer_config_helpers as tch
+    out = {}
+    for name in tch.layers.__all__:
+        fn = getattr(tch, name, None)
+        if not callable(fn) or isinstance(fn, type):
+            continue
+        try:
+            sig = inspect.signature(fn)
+        except (TypeError, ValueError):
+            continue
+        params = [p for p in sig.parameters.values()]
+        names = [p.name for p in params
+                 if p.kind not in (p.VAR_KEYWORD, p.VAR_POSITIONAL)]
+        has_kwargs = any(p.kind == p.VAR_KEYWORD for p in params)
+        out[name] = (names, has_kwargs)
+    return out
+
+
+def audit():
+    ref = reference_signatures()
+    ours = repo_builders()
+    rows = []
+    for name in sorted(set(ref) & set(ours)):
+        ref_params = ref[name]
+        our_params, has_kwargs = ours[name]
+        for p in ref_params:
+            if p in our_params:
+                cls = 'explicit'
+            elif has_kwargs:
+                cls = ('inert-documented' if p in DOCUMENTED_INERT
+                       else 'kwargs')
+            else:
+                cls = 'n/a'
+            rows.append((name, p, cls))
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--write-report', action='store_true')
+    args = ap.parse_args()
+    rows = audit()
+    counts = {}
+    for _, _, cls in rows:
+        counts[cls] = counts.get(cls, 0) + 1
+    summary = ('builders audited: %d | params: %d | explicit: %d | '
+               'kwargs-absorbed: %d | documented-inert: %d | silent-missing: %d'
+               % (len({r[0] for r in rows}), len(rows),
+                  counts.get('explicit', 0), counts.get('kwargs', 0),
+                  counts.get('inert-documented', 0), counts.get('n/a', 0)))
+    print(summary)
+    if args.write_report:
+        lines = [
+            '# Legacy-DSL signature audit vs the reference',
+            '',
+            '`PYTHONPATH=. python tools/dsl_signature_audit.py '
+            '--write-report` regenerates this file.',
+            '', '**%s**' % summary, '',
+            'Parameters the reference accepts that our builders absorb '
+            'via `**kwargs` (candidates for the PARITY fidelity table; '
+            'semantic ones are forwarded — see tests/test_tch_fidelity.py):',
+            '', '| builder | reference param | class |', '|---|---|---|',
+        ]
+        for name, p, cls in rows:
+            if cls != 'explicit':
+                lines.append('| %s | %s | %s |' % (name, p, cls))
+        with open(REPORT, 'w') as f:
+            f.write('\n'.join(lines) + '\n')
+        print('wrote %s' % REPORT)
+
+
+if __name__ == '__main__':
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    main()
