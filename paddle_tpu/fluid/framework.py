@@ -399,7 +399,12 @@ class Program(object):
                     if op.type == 'dropout':
                         op.attrs['is_test'] = True
                     if op.type == 'batch_norm':
-                        op.attrs['is_test'] = True
+                        # a batch_norm built with an EXPLICIT
+                        # use_global_stats=False keeps batch statistics
+                        # even at test time (the reference's documented
+                        # False semantics, legacy layers.py batch_norm)
+                        if op.attrs.get('use_global_stats') is not False:
+                            op.attrs['is_test'] = True
         p._bump_version()
         return p
 

@@ -379,9 +379,13 @@ def batch_norm(input,
                moving_mean_name=None,
                moving_variance_name=None,
                do_model_average_for_mean_and_var=False,
-               fuse_with_relu=False):
+               fuse_with_relu=False,
+               use_global_stats=None):
     """Batch normalization (reference layers/nn.py batch_norm;
-    operators/batch_norm_op.cc)."""
+    operators/batch_norm_op.cc).  ``use_global_stats``: None = follow
+    is_test / clone(for_test); True = always moving statistics; an
+    EXPLICIT False keeps batch statistics even through
+    clone(for_test=True) — the legacy DSL's documented False mode."""
     helper = LayerHelper('batch_norm', **locals())
     dtype = helper.input_dtype()
     input_shape = input.shape
@@ -445,7 +449,8 @@ def batch_norm(input,
         attrs={
             'momentum': momentum,
             'epsilon': epsilon,
-            'is_test': is_test,
+            'is_test': bool(is_test or use_global_stats),
+            'use_global_stats': use_global_stats,
             'data_layout': data_layout,
         })
     return helper.append_activation(batch_norm_out)

@@ -192,7 +192,10 @@ def fc(input, size, act=None, name=None, param_attr=None, bias_attr=None,
         out = fluid.layers.fc(list(parent_vars), size=size,
                               param_attr=p_attr,
                               bias_attr=_fluid_attr(bias_attr))
-        a = _act_name(act if act is not None else Linear())
+        # reference default activation: Tanh (wrap_act_default,
+        # trainer_config_helpers/layers.py:1013) — NOT linear
+        from .activation import Tanh
+        a = _act_name(act if act is not None else Tanh())
         if a == 'softmax':
             return fluid.layers.softmax(out)
         if a:
@@ -215,7 +218,9 @@ def img_conv(input, filter_size, num_filters, num_channels=None, stride=1,
              padding=0, act=None, name=None, param_attr=None,
              bias_attr=None, **kwargs):
     def build(ctx, parent_var):
-        a = _act_name(act)
+        # reference default activation: ReLU (layers.py:2508)
+        from .activation import Relu
+        a = _act_name(act if act is not None else Relu())
         v = parent_var
         if len(v.shape) == 2:
             # legacy configs feed images as flat dense vectors (the
@@ -249,9 +254,11 @@ def batch_norm(input, act=None, name=None, epsilon=1e-5,
     momentum, frozen-statistics mode, and the scale/shift attrs all
     forward to fluid batch_norm."""
     def build(ctx, parent_var):
+        from .activation import Relu
         return fluid.layers.batch_norm(
-            parent_var, act=_act_name(act),
-            is_test=bool(use_global_stats),
+            parent_var,
+            act=_act_name(act if act is not None else Relu()),
+            use_global_stats=use_global_stats,
             momentum=moving_average_fraction, epsilon=epsilon,
             param_attr=_fluid_attr(param_attr),
             bias_attr=_fluid_attr(bias_attr))
@@ -1670,9 +1677,11 @@ def img_conv3d(input, filter_size, num_filters, num_channels=None,
             side = int(round((input.size // c) ** (1.0 / 3.0)))
             v = fluid.layers.reshape(
                 v, shape=[-1, c, side, side, side])
+        from .activation import Relu
         return fluid.layers.conv3d(
             v, num_filters=num_filters, filter_size=filter_size,
-            stride=stride, padding=padding, act=_act_name(act))
+            stride=stride, padding=padding,
+            act=_act_name(act if act is not None else Relu()))
 
     return Layer('img_conv3d', [input], build, name=name,
                  size=num_filters)

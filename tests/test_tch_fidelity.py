@@ -190,20 +190,46 @@ def test_batch_norm_epsilon_and_attrs_forward():
             input=tch.img_conv_layer(
                 input=x, filter_size=3, num_filters=2, num_channels=2,
                 padding=1, param_attr=_const_attr(0.1), bias_attr=False),
-            epsilon=eps, param_attr=_const_attr(1.0, name='bn_s%s' % eps),
-            bias_attr=_const_attr(0.0))
+            epsilon=eps,
+            param_attr=_const_attr(2.0, name='bn_s%s' % eps),
+            bias_attr=_const_attr(0.5))
     rng = np.random.RandomState(0)
     xv = rng.standard_normal(32).astype('float32')
     a = _infer_seq_dense(build(1e-5), xv)
     tch.reset_config()
     b = _infer_seq_dense(build(0.5), xv)
     assert not np.allclose(a, b), 'epsilon had no effect'
+    # scale=2/bias=0.5 differ from the default init (1/0): reverting
+    # the attr forwarding must change this output
+    tch.reset_config()
+    x2 = tch.data_layer(name='x', size=2 * 4 * 4)
+    plain = tch.batch_norm_layer(
+        input=tch.img_conv_layer(
+            input=x2, filter_size=3, num_filters=2, num_channels=2,
+            padding=1, param_attr=_const_attr(0.1), bias_attr=False),
+        epsilon=1e-5)
+    c = _infer_seq_dense(plain, xv)
+    assert not np.allclose(a, c), 'param/bias attrs had no effect'
 
 
 def _infer_seq_dense(out_layer, xv):
     params = paddle.parameters.create(out_layer)
     return paddle.infer(output_layer=out_layer, parameters=params,
                         input=[(xv, )])
+
+
+def test_reference_default_activations():
+    """The legacy DSL's wrapped defaults (wrap_act_default): fc=Tanh,
+    img_conv/batch_norm=ReLU — omitting act must NOT mean linear
+    (reference layers.py:1013,2508,3245)."""
+    x = tch.data_layer(name='x', size=4)
+    dflt = tch.fc_layer(input=x, size=3,
+                        param_attr=_const_attr(0.25, name='da_w'),
+                        bias_attr=False)
+    xv = np.arange(4, dtype='float32')
+    got = _infer_seq_dense(dflt, xv)
+    want = np.tanh(np.full((1, 3), xv.sum() * 0.25))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
 
 
 def test_dsl_signature_audit_has_no_silent_missing():

@@ -124,7 +124,11 @@ def test_v2_mse_regression():
                           type=paddle.data_type.dense_vector(3))
     y = paddle.layer.data(name='y',
                           type=paddle.data_type.dense_vector(1))
-    pred = paddle.layer.fc(input=x, size=1)
+    # reference v2 fc defaults to Tanh (wrap_act_default) — a
+    # regression head needs the explicit linear activation, exactly as
+    # on real Paddle
+    pred = paddle.layer.fc(input=x, size=1,
+                           act=paddle.activation.Linear())
     cost = paddle.layer.mse_cost(input=pred, label=y)
     parameters = paddle.parameters.create(cost)
     trainer = paddle.trainer.SGD(

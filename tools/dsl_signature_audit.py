@@ -13,18 +13,20 @@ For every shared builder, each reference parameter is classified:
 Usage:
     PYTHONPATH=. python tools/dsl_signature_audit.py [--write-report]
 
-The pytest gate (tests/test_api_spec.py) asserts zero builders regress
-to `n/a` for reference parameters and that the inert list only shrinks.
+The pytest gate (tests/test_tch_fidelity.py::
+test_dsl_signature_audit_has_no_silent_missing) asserts zero reference
+parameters fall to `n/a`.
 """
 
-import ast
 import argparse
+import ast
 import inspect
 import os
 import sys
 
 REF = '/root/reference/python/paddle/trainer_config_helpers/layers.py'
-REPORT = 'tools/dsl_audit_report.md'
+REPORT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      'dsl_audit_report.md')
 
 # reference params that are engine knobs with no per-layer XLA analog —
 # documented as accepted-inert in PARITY.md's fidelity audit
