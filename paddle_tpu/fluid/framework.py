@@ -394,17 +394,14 @@ class Program(object):
         if for_test:
             for blk in p.blocks:
                 for op in blk.ops:
+                    # batch_norm note: is_test only stops the running-
+                    # statistics update; WHICH statistics normalize is
+                    # the lowering's use_global_stats decision, so an
+                    # explicit use_global_stats=False still gets batch
+                    # statistics at test time without eval batches
+                    # polluting the moving averages (ops/nn_ops.py)
                     if 'is_test' in _IS_TEST_OPS.get(op.type, ()):
                         op.attrs['is_test'] = True
-                    if op.type == 'dropout':
-                        op.attrs['is_test'] = True
-                    if op.type == 'batch_norm':
-                        # a batch_norm built with an EXPLICIT
-                        # use_global_stats=False keeps batch statistics
-                        # even at test time (the reference's documented
-                        # False semantics, legacy layers.py batch_norm)
-                        if op.attrs.get('use_global_stats') is not False:
-                            op.attrs['is_test'] = True
         p._bump_version()
         return p
 

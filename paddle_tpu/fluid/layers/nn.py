@@ -449,9 +449,19 @@ def batch_norm(input,
         attrs={
             'momentum': momentum,
             'epsilon': epsilon,
-            'is_test': bool(is_test or use_global_stats),
-            'use_global_stats': use_global_stats,
+            # is_test is stored RAW: it gates the running-statistics
+            # update only.  WHICH statistics normalize is resolved in
+            # the lowering from use_global_stats (an EXPLICIT value
+            # wins over is_test in both directions; the tri-state
+            # "follow is_test" default is represented by OMITTING the
+            # attr — None is unserializable on the proto wire) — so
+            # use_global_stats=False at test time uses batch statistics
+            # WITHOUT the eval batches drifting the checkpointed
+            # moving averages
+            'is_test': bool(is_test),
             'data_layout': data_layout,
+            **({} if use_global_stats is None
+               else {'use_global_stats': bool(use_global_stats)}),
         })
     return helper.append_activation(batch_norm_out)
 

@@ -194,6 +194,15 @@ def _batch_norm(ctx, op):
     eps = op.attrs.get('epsilon', 1e-5)
     momentum = op.attrs.get('momentum', 0.9)
     is_test = op.attrs.get('is_test', False)
+    ugs = op.attrs.get('use_global_stats', None)
+    # which statistics normalize: an EXPLICIT use_global_stats wins in
+    # both directions (False = batch stats even at test time, True =
+    # frozen running stats even in training); None follows is_test.
+    # The running averages update only in actual training (not is_test)
+    # AND only when batch statistics were computed — eval passes with
+    # use_global_stats=False must not drift the checkpointed averages.
+    use_running = bool(ugs) if ugs is not None else bool(is_test)
+    update_running = (not use_running) and (not is_test)
     layout = op.attrs.get('data_layout', 'NCHW')
     axes = tuple(i for i in range(x.ndim)
                  if i != (1 if layout == 'NCHW' else x.ndim - 1))
@@ -203,19 +212,22 @@ def _batch_norm(ctx, op):
     # bf16 activations (AMP) keep bf16 through BN, but the statistics
     # must accumulate in fp32 or large batches lose the mean entirely
     xs = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
-    if is_test:
+    if use_running:
         mean, var = mean_in, var_in
         saved_mean, saved_var = mean_in, var_in
         mean_out, var_out = mean_in, var_in
     else:
         mean = jnp.mean(xs, axis=axes)
         var = jnp.mean(jnp.square(xs), axis=axes) - jnp.square(mean)
-        # running stats do not take gradients
-        m_s = jax.lax.stop_gradient(mean)
-        v_s = jax.lax.stop_gradient(var)
-        mean_out = momentum * mean_in + (1 - momentum) * m_s
-        var_out = momentum * var_in + (1 - momentum) * v_s
         saved_mean, saved_var = mean, var
+        if update_running:
+            # running stats do not take gradients
+            m_s = jax.lax.stop_gradient(mean)
+            v_s = jax.lax.stop_gradient(var)
+            mean_out = momentum * mean_in + (1 - momentum) * m_s
+            var_out = momentum * var_in + (1 - momentum) * v_s
+        else:
+            mean_out, var_out = mean_in, var_in
     inv_std = jax.lax.rsqrt(jnp.reshape(var, bshape) + eps)
     y = (xs - jnp.reshape(mean, bshape)) * inv_std * jnp.reshape(
         scale, bshape) + jnp.reshape(bias, bshape)

@@ -157,11 +157,11 @@ def lstmemory(input, size=None, name=None, reverse=False, param_attr=None,
 
 
 def grumemory(input, size, name=None, reverse=False, param_attr=None,
-              bias_attr=None, **kwargs):
+              bias_attr=None, project=None, **kwargs):
     return _with_layer_attr(
         _v2.gru_like(input=input, size=size, name=name,
                      reverse=reverse, param_attr=param_attr,
-                     bias_attr=bias_attr), kwargs)
+                     bias_attr=bias_attr, project=project), kwargs)
 
 
 def batch_norm_layer(input, act=None, name=None, epsilon=1e-5,
@@ -474,9 +474,10 @@ def spp_layer(input, pyramid_height=2, pool_type=None, name=None,
 
 
 def recurrent_layer(input, size=None, act=None, reverse=False,
-                    name=None, **kwargs):
+                    name=None, param_attr=None, bias_attr=None, **kwargs):
     return _v2.recurrent(input=input, size=size, act=act,
-                         reverse=reverse, name=name)
+                         reverse=reverse, name=name,
+                         param_attr=param_attr, bias_attr=bias_attr)
 
 
 def img_conv3d_layer(input, filter_size, num_filters, num_channels=None,

@@ -4,6 +4,7 @@ presets the book/demo configs used: conv stacks through VGG-16,
 uni/bidirectional recurrent nets, and the attention blocks)."""
 
 from . import layers as _l
+from .activations import LinearActivation
 from .poolings import MaxPooling
 from ..v2 import layer as _v2
 
@@ -15,21 +16,54 @@ __all__ = [
 ]
 
 
-def simple_lstm(input, size, name=None, **kwargs):
+def simple_lstm(input, size, name=None, reverse=False,
+                mat_param_attr=None, bias_param_attr=None,
+                inner_param_attr=None, **kwargs):
     """fc gate projection + lstmemory (reference networks.py:632
-    simple_lstm)."""
-    proj = _l.fc_layer(input=input, size=size * 4)
-    return _l.lstmemory(input=proj, size=size, name=name)
+    simple_lstm: the size*4 transform is a bias-free LINEAR
+    mixed_layer — fc_layer's Tanh default must not squash the gate
+    pre-activations).  mat_param_attr is the projection weight,
+    inner_param_attr/bias_param_attr the recurrence's."""
+    proj = _l.fc_layer(input=input, size=size * 4,
+                       act=LinearActivation(), bias_attr=False,
+                       param_attr=mat_param_attr)
+    return _l.lstmemory(input=proj, size=size, name=name, reverse=reverse,
+                        param_attr=inner_param_attr,
+                        bias_attr=bias_param_attr)
 
 
-def simple_gru(input, size, name=None, **kwargs):
-    return _l.grumemory(input=input, size=size, name=name)
+def _gru_block(input, size, name, reverse, mixed_param_attr,
+               mixed_bias_attr, gru_param_attr, gru_bias_attr):
+    """Shared body of simple_gru/simple_gru2 (identical structure in
+    the reference, differing only in kwarg spelling): one explicit
+    LINEAR size*3 projection feeding the raw GRU."""
+    proj = _l.fc_layer(input=input, size=size * 3,
+                       act=LinearActivation(),
+                       param_attr=mixed_param_attr,
+                       bias_attr=mixed_bias_attr)
+    return _l.grumemory(input=proj, size=size, name=name,
+                        reverse=reverse, param_attr=gru_param_attr,
+                        bias_attr=gru_bias_attr, project=False)
 
 
-def simple_gru2(input, size, name=None, **kwargs):
-    """reference simple_gru2: explicit 3x gate projection + grumemory."""
-    proj = _l.fc_layer(input=input, size=size * 3)
-    return _l.grumemory(input=proj, size=size, name=name)
+def simple_gru(input, size, name=None, reverse=False,
+               mixed_param_attr=None, mixed_bias_param_attr=None,
+               gru_param_attr=None, gru_bias_attr=None, **kwargs):
+    """reference gru_group/simple_gru (networks.py:1076 — note the
+    reference spells the projection bias kwarg mixed_bias_PARAM_attr
+    here but mixed_bias_attr on simple_gru2)."""
+    return _gru_block(input, size, name, reverse, mixed_param_attr,
+                      mixed_bias_param_attr, gru_param_attr,
+                      gru_bias_attr)
+
+
+def simple_gru2(input, size, name=None, reverse=False,
+                mixed_param_attr=None, mixed_bias_attr=None,
+                gru_param_attr=None, gru_bias_attr=None, **kwargs):
+    """reference simple_gru2 (networks.py:1163): same structure as
+    simple_gru, reference-spelled kwargs."""
+    return _gru_block(input, size, name, reverse, mixed_param_attr,
+                      mixed_bias_attr, gru_param_attr, gru_bias_attr)
 
 
 def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
@@ -41,11 +75,23 @@ def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
 
 
 def img_conv_bn_pool(input, filter_size, num_filters, pool_size,
-                     pool_stride=1, act=None, name=None, **kwargs):
-    """conv + batch_norm + pool (reference img_conv_bn_pool)."""
+                     pool_stride=1, act=None, name=None,
+                     num_channel=None, conv_padding=0,
+                     conv_param_attr=None, conv_bias_attr=None,
+                     bn_param_attr=None, bn_bias_attr=None, **kwargs):
+    """conv + batch_norm + pool (reference img_conv_bn_pool: the conv
+    is explicitly LINEAR — reference networks.py:308 — so the only
+    nonlinearity is the one batch_norm applies)."""
     conv = _l.img_conv_layer(input=input, filter_size=filter_size,
-                             num_filters=num_filters, act=None)
-    bn = _l.batch_norm_layer(input=conv, act=act)
+                             num_filters=num_filters,
+                             num_channels=num_channel,
+                             padding=conv_padding,
+                             act=LinearActivation(),
+                             param_attr=conv_param_attr,
+                             bias_attr=conv_bias_attr)
+    bn = _l.batch_norm_layer(input=conv, act=act,
+                             param_attr=bn_param_attr,
+                             bias_attr=bn_bias_attr)
     return _l.img_pool_layer(input=bn, pool_size=pool_size,
                              stride=pool_stride, name=name)
 
@@ -62,7 +108,9 @@ def img_conv_group(input, conv_num_filter, pool_size, conv_filter_size=3,
             input=tmp, filter_size=conv_filter_size, num_filters=nf,
             num_channels=num_channels if i == 0 else None,
             padding=(conv_filter_size - 1) // 2,
-            act=None if conv_with_batchnorm else conv_act)
+            # under batch_norm the conv is explicitly LINEAR (reference
+            # networks.py:410) and conv_act moves onto the BN
+            act=LinearActivation() if conv_with_batchnorm else conv_act)
         if conv_with_batchnorm:
             tmp = _l.batch_norm_layer(input=tmp, act=conv_act)
     return _l.img_pool_layer(input=tmp, pool_size=pool_size,
@@ -91,13 +139,21 @@ def vgg_16_network(input_image, num_channels, num_classes=1000,
 
 
 def bidirectional_lstm(input, size, return_seq=False, name=None,
-                       **kwargs):
-    """Forward + backward lstmemory, concatenated (reference
-    networks.py bidirectional_lstm)."""
-    fwd_proj = _l.fc_layer(input=input, size=size * 4)
-    fwd = _v2.lstmemory(input=fwd_proj, size=size)
-    bwd_proj = _l.fc_layer(input=input, size=size * 4)
-    bwd = _v2.lstmemory(input=bwd_proj, size=size, reverse=True)
+                       fwd_mat_param_attr=None, fwd_bias_param_attr=None,
+                       fwd_inner_param_attr=None,
+                       bwd_mat_param_attr=None, bwd_bias_param_attr=None,
+                       bwd_inner_param_attr=None, **kwargs):
+    """Forward + backward lstmemory, concatenated — delegates each arm
+    to simple_lstm exactly as the reference does (networks.py:1368),
+    so the bias-free LINEAR gate projection is defined in one place."""
+    fwd = simple_lstm(input=input, size=size,
+                      mat_param_attr=fwd_mat_param_attr,
+                      bias_param_attr=fwd_bias_param_attr,
+                      inner_param_attr=fwd_inner_param_attr)
+    bwd = simple_lstm(input=input, size=size, reverse=True,
+                      mat_param_attr=bwd_mat_param_attr,
+                      bias_param_attr=bwd_bias_param_attr,
+                      inner_param_attr=bwd_inner_param_attr)
     if return_seq:
         return _l.concat_layer(input=[fwd, bwd], name=name)
     return _l.concat_layer(
@@ -107,8 +163,10 @@ def bidirectional_lstm(input, size, return_seq=False, name=None,
 
 def bidirectional_gru(input, size, return_seq=False, name=None,
                       **kwargs):
-    fwd = _v2.gru_like(input=input, size=size)
-    bwd = _v2.gru_like(input=input, size=size, reverse=True)
+    # explicit project=True: the raw input always gets the learned gate
+    # projection here, even if its width coincidentally equals 3*size
+    fwd = _v2.gru_like(input=input, size=size, project=True)
+    bwd = _v2.gru_like(input=input, size=size, reverse=True, project=True)
     if return_seq:
         return _l.concat_layer(input=[fwd, bwd], name=name)
     return _l.concat_layer(

@@ -507,13 +507,29 @@ def lstmemory(input, size=None, name=None, reverse=False, param_attr=None,
 
 
 def gru_like(input, size, name=None, reverse=False, param_attr=None,
-             bias_attr=None, **kwargs):
-    """GRU block: gate projection + dynamic_gru (reference networks.py
-    simple_gru)."""
+             bias_attr=None, project=None, **kwargs):
+    """GRU block (reference grumemory, layers.py:1605).  The reference
+    contract is that grumemory's input IS the pre-projected size*3
+    gate input (it asserts input.size == 3*size and never projects).
+
+    ``project``: False = never project (the reference contract; raises
+    if the parent is not 3*size wide), True = always add the learned
+    gate projection (reference gru_group's mixed_layer), None = infer
+    from the parent width — composites pass an explicit value so a
+    coincidental 3*size-wide raw input cannot silently change the
+    architecture."""
 
     def build(ctx, parent_var):
-        proj = fluid.layers.fc(parent_var, size=size * 3)
-        return fluid.layers.dynamic_gru(proj, size=size,
+        v = parent_var
+        is_gate_width = int(v.shape[-1]) == size * 3
+        if project is False and not is_gate_width:
+            raise ValueError(
+                'grumemory: input width %r is not the pre-projected '
+                'gate width %r (reference layers.py:1605 contract)' %
+                (int(v.shape[-1]), size * 3))
+        if project is True or (project is None and not is_gate_width):
+            v = fluid.layers.fc(v, size=size * 3)
+        return fluid.layers.dynamic_gru(v, size=size,
                                         is_reverse=reverse,
                                         param_attr=_fluid_attr(param_attr),
                                         bias_attr=_fluid_attr(bias_attr))
@@ -1638,7 +1654,7 @@ def spp(input, pyramid_height=2, pool_type=None, name=None, **kwargs):
 
 
 def recurrent(input, size=None, act=None, reverse=False, name=None,
-              **kwargs):
+              param_attr=None, bias_attr=None, **kwargs):
     """Plain full-matrix recurrence out_t = act(in_t + out_{t-1} W)
     (reference recurrent_layer) — expressed through the recurrent_group
     step DSL over the fluid scan (state update by the memory's
@@ -1656,8 +1672,11 @@ def recurrent(input, size=None, act=None, reverse=False, name=None,
     def step(ipt):
         mem = memory(name=state, size=width)
         # reference math exactly: in_t enters UNPROJECTED; only the
-        # carried state passes through the weight (+ the layer bias)
-        rec = fc(input=mem, size=width)
+        # carried state passes through the weight (+ the layer bias),
+        # LINEARLY — fc's Tanh default would wrap the state product
+        # before the addto and change the recurrence
+        rec = fc(input=mem, size=width, act=Linear(),
+                 param_attr=param_attr, bias_attr=bias_attr)
         return addto(input=[ipt, rec], act=act or Tanh(), name=state)
 
     out = recurrent_group(step=step, input=input, name=name,

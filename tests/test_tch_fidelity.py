@@ -287,3 +287,227 @@ def test_img_conv_bias_attr_false_and_param_name():
                               bias_attr=False)
     params = paddle.parameters.create(conv)
     assert params.names() == ['cw'], params.names()
+
+
+def test_simple_lstm_projection_is_linear_and_biasfree():
+    """Composite fidelity (reference networks.py:696): simple_lstm's
+    size*4 gate transform is a bias-free LINEAR mixed_layer.  With
+    pinned parameters the composite must equal the manual chain built
+    with an explicit LinearActivation — if the fc Tanh default leaked
+    into the composite, the gate pre-activations would be squashed and
+    the outputs diverge."""
+    from paddle_tpu.trainer_config_helpers import networks as tchn
+    rng = np.random.RandomState(1)
+    seq = [rng.standard_normal(8).astype('float32') for _ in range(5)]
+
+    comp = tchn.simple_lstm(
+        input=tch.data_layer(name='x', size=8, seq=True), size=6,
+        mat_param_attr=_const_attr(0.1),
+        inner_param_attr=_const_attr(0.2),
+        bias_param_attr=_const_attr(0.0))
+    got = _infer_seq(comp, seq)
+    tch.reset_config()
+    want = _infer_seq(_lstm_chain(reverse=False), seq)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_simple_lstm_reverse_forwards():
+    from paddle_tpu.trainer_config_helpers import networks as tchn
+
+    def build(reverse):
+        return tchn.simple_lstm(
+            input=tch.data_layer(name='x', size=8, seq=True), size=6,
+            reverse=reverse, mat_param_attr=_const_attr(0.1),
+            inner_param_attr=_const_attr(0.2),
+            bias_param_attr=_const_attr(0.0))
+
+    rng = np.random.RandomState(2)
+    seq = [rng.standard_normal(8).astype('float32') for _ in range(4)]
+    fwd = _infer_seq(build(False), seq)
+    tch.reset_config()
+    rev = _infer_seq(build(True), seq)
+    assert not np.allclose(fwd, rev), 'reverse was swallowed'
+
+
+def test_img_conv_bn_pool_conv_is_linear():
+    """Composite fidelity (reference networks.py:308): the conv under
+    batch_norm is explicitly LINEAR; a leaked ReLU default would clip
+    the negative conv outputs before normalization and shift the BN
+    statistics."""
+    from paddle_tpu.trainer_config_helpers import networks as tchn
+
+    def composite():
+        x = tch.data_layer(name='img', size=2 * 4 * 4)
+        return tchn.img_conv_bn_pool(
+            input=x, filter_size=3, num_filters=2, pool_size=2,
+            num_channel=2,
+            conv_param_attr=_const_attr(0.1), conv_bias_attr=False,
+            bn_param_attr=_const_attr(1.0, name='bn_scale'),
+            bn_bias_attr=_const_attr(0.0))
+
+    def manual():
+        x = tch.data_layer(name='img', size=2 * 4 * 4)
+        conv = tch.img_conv_layer(input=x, filter_size=3, num_filters=2,
+                                  num_channels=2,
+                                  act=tch.LinearActivation(),
+                                  param_attr=_const_attr(0.1),
+                                  bias_attr=False)
+        bn = tch.batch_norm_layer(input=conv,
+                                  param_attr=_const_attr(1.0,
+                                                         name='bn_s2'),
+                                  bias_attr=_const_attr(0.0))
+        return tch.img_pool_layer(input=bn, pool_size=2)
+
+    # negative inputs make the linear conv produce negative values, so
+    # an erroneous pre-BN ReLU cannot be invisible
+    xv = -np.abs(np.random.RandomState(3).standard_normal(32)) \
+        .astype('float32')
+    got = _infer_seq_dense(composite(), xv)
+    tch.reset_config()
+    want = _infer_seq_dense(manual(), xv)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_recurrent_layer_state_product_is_linear():
+    """recurrent_layer's documented recurrence is
+    out_t = act(in_t + out_{t-1} W + b): the state-weight product
+    enters the addto LINEARLY.  Verified against a hand-rolled numpy
+    recurrence with pinned parameters — a leaked fc Tanh default would
+    compute act(in_t + tanh(out_{t-1} W + b)) instead."""
+    d = 4
+    x = tch.data_layer(name='x', size=d, seq=True)
+    out = tch.recurrent_layer(input=x, act=tch.TanhActivation(),
+                              param_attr=_const_attr(0.3, name='rw'))
+    rng = np.random.RandomState(4)
+    seq = [rng.standard_normal(d).astype('float32') for _ in range(5)]
+    got = _infer_seq(out, seq)
+
+    w = np.full((d, d), 0.3, dtype='float32')
+    h = np.zeros(d, dtype='float32')
+    want = []
+    for t in range(5):
+        h = np.tanh(seq[t] + h @ w)
+        want.append(h)
+    got = np.asarray(got)
+    np.testing.assert_allclose(got.reshape(-1, d)[:5], np.stack(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_batch_norm_explicit_false_wins_over_is_test():
+    """fluid contract: batch_norm(is_test=True, use_global_stats=False)
+    uses BATCH statistics via the direct path AND the
+    clone(for_test=True) path (both routes agree), and neither test
+    route drifts the checkpointed moving averages."""
+    import paddle_tpu.fluid as fluid
+
+    def build(is_test):
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            xv = fluid.layers.data('x', [3], dtype='float32')
+            y = fluid.layers.batch_norm(xv, is_test=is_test,
+                                        use_global_stats=False)
+        return prog, startup, y
+
+    rng = np.random.RandomState(5)
+    x = (rng.standard_normal((16, 3)) * 5 + 7).astype('float32')
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    outs, moving_means = [], []
+
+    def run(prog, startup, yname):
+        # the moving-average slots come from the op's own input list
+        # (they are named batch_norm_N.w_K, not *mean*)
+        bn_op = [o for o in prog.blocks[0].ops
+                 if o.type == 'batch_norm'][0]
+        mean_name = bn_op.inputs['Mean'][0]
+        scope = fluid.core.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            out = exe.run(prog, feed={'x': x}, fetch_list=[yname])[0]
+            # a few more eval passes, then read the moving mean
+            for _ in range(3):
+                exe.run(prog, feed={'x': x}, fetch_list=[yname])
+            mv = exe.run(prog, feed={'x': x}, fetch_list=[mean_name])[0]
+        return out, np.copy(mv)
+
+    # direct is_test route
+    for is_test in (False, True):
+        prog, startup, y = build(is_test)
+        out, mv = run(prog, startup, y.name)
+        outs.append(out)
+        if is_test:
+            moving_means.append(mv)
+    # clone(for_test=True) route
+    prog, startup, y = build(False)
+    test_prog = prog.clone(for_test=True)
+    out, mv = run(test_prog, startup, y.name)
+    outs.append(out)
+    moving_means.append(mv)
+
+    # batch statistics every time: all three outputs identical, and
+    # actually normalized (mean~0) rather than scaled by moving stats
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(outs[0], outs[2], rtol=1e-5, atol=1e-6)
+    assert abs(float(np.mean(outs[1]))) < 1e-3
+    # the moving mean is untouched by the test-mode passes (init 0.0;
+    # the feed mean is ~7, so a single leaked update would move it) -
+    # eval batches must not drift the checkpointed averages even though
+    # they normalize with batch statistics
+    assert moving_means, 'no test-mode moving means were collected'
+    for mv in moving_means:
+        np.testing.assert_allclose(mv, np.zeros_like(mv), atol=1e-7)
+
+
+def test_simple_gru2_single_projection():
+    """Composite fidelity (reference networks.py:1207): simple_gru2 is
+    ONE pinned linear projection + the raw GRU - gru_like must not add
+    a second hidden [3S,3S] projection when its input is already
+    3S-wide (double projection diverges from the reference and burns an
+    extra matmul per step)."""
+    from paddle_tpu.trainer_config_helpers import networks as tchn
+
+    def composite():
+        x = tch.data_layer(name='x', size=8, seq=True)
+        return tchn.simple_gru2(input=x, size=6,
+                                mixed_param_attr=_const_attr(0.1),
+                                mixed_bias_attr=False,
+                                gru_param_attr=_const_attr(0.2),
+                                gru_bias_attr=_const_attr(0.0))
+
+    def manual():
+        x = tch.data_layer(name='x', size=8, seq=True)
+        proj = tch.fc_layer(input=x, size=18, act=tch.LinearActivation(),
+                            param_attr=_const_attr(0.1), bias_attr=False)
+        return tch.grumemory(input=proj, size=6,
+                             param_attr=_const_attr(0.2),
+                             bias_attr=_const_attr(0.0))
+
+    rng = np.random.RandomState(6)
+    seq = [rng.standard_normal(8).astype('float32') for _ in range(5)]
+    got = _infer_seq(composite(), seq)
+    tch.reset_config()
+    want = _infer_seq(manual(), seq)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_batch_norm_default_program_serializes():
+    """The tri-state use_global_stats default must not leak a None attr
+    onto the proto wire: a default batch_norm program round-trips
+    through serialize/deserialize (reproduces the round-4 review's
+    save_inference_model crash)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import proto_serde
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = fluid.layers.data('x', [4], dtype='float32')
+        fluid.layers.batch_norm(fluid.layers.fc(x, 8))
+        # the explicit tri-states serialize as real booleans
+        fluid.layers.batch_norm(fluid.layers.fc(x, 8),
+                                use_global_stats=False)
+        fluid.layers.batch_norm(fluid.layers.fc(x, 8),
+                                use_global_stats=True)
+    wire = proto_serde.serialize_program(prog)
+    back = proto_serde.deserialize_program(wire)
+    bns = [o for o in back.blocks[0].ops if o.type == 'batch_norm']
+    assert [o.attrs.get('use_global_stats') for o in bns] == \
+        [None, False, True]
