@@ -18,9 +18,13 @@ from .api import shard, sharding_of, PartitionSpec
 from .context_parallel import (ring_attention, ulysses_attention,
                                dense_attention)
 from .multihost import init_distributed_env, parse_distributed_env
+from .pipeline import pipeline_spmd, pipeline_apply, stack_stage_params
+from .moe import moe_ffn, moe_ffn_spmd, init_moe_params
 
 __all__ = [
     'make_mesh', 'mesh_axes', 'DeviceMesh', 'shard', 'sharding_of',
     'PartitionSpec', 'ring_attention', 'ulysses_attention',
     'dense_attention', 'init_distributed_env', 'parse_distributed_env',
+    'pipeline_spmd', 'pipeline_apply', 'stack_stage_params',
+    'moe_ffn', 'moe_ffn_spmd', 'init_moe_params',
 ]

@@ -1,0 +1,128 @@
+"""Pipeline parallelism over a 'pp' mesh axis (GPipe-style).
+
+The reference (2018) has no pipeline engine — its model-parallel story
+is per-layer device placement inside ParallelDo / pserver shards
+(SURVEY §2.5).  On TPU the natural pipeline is SPMD: every device runs
+the SAME program, holds ONE stage's parameters (the stage-stacked
+pytree is sharded over 'pp' on its leading axis), and activations hop
+to the next stage over the ICI neighbor link via `lax.ppermute` — the
+cheapest collective on the chip, same pattern ring attention uses for
+K/V blocks.
+
+Schedule: classic GPipe fill-drain.  With S stages and M microbatches
+the loop runs M + S - 1 ticks; stage 0 injects microbatch t at tick t,
+stage s computes on the activation it received at tick end t-1, and
+the last stage emits microbatch t - (S-1) at tick t.  Bubble fraction
+is (S-1)/(M+S-1) — callers pick M >= 4*S to amortise (the classic
+GPipe guidance).
+
+Everything is pure JAX and differentiable: reverse-mode AD transposes
+the ppermutes (activations flow backward stage-to-stage exactly like a
+hand-written 1F1B backward), so `jax.grad` of a pipelined loss IS
+pipeline-parallel backprop.
+
+Layout contract: microbatches [M, mb, ...] (leading microbatch axis),
+stage parameters stacked on a leading [S, ...] axis and sharded
+P('pp') so shard_map hands each device its own stage's slice.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ['pipeline_apply', 'pipeline_spmd', 'stack_stage_params']
+
+
+def stack_stage_params(per_stage):
+    """[pytree_of_stage0, pytree_of_stage1, ...] -> one pytree whose
+    leaves carry a leading stage axis (shard it over 'pp')."""
+    return jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *per_stage)
+
+
+def pipeline_apply(stage_fn, stage_params, x_mb, axis_name='pp'):
+    """GPipe loop body — runs INSIDE shard_map.
+
+    stage_fn: (params_one_stage, h) -> h_next, same output/input shape
+              (inter-stage activations must agree; project inside the
+              stage if widths differ).
+    stage_params: THIS device's stage slice (leading stage axis already
+              consumed by the shard_map in_spec).
+    x_mb:     [M, mb, ...] microbatches, replicated over 'pp'.
+    Returns [M, mb, ...] pipeline outputs, replicated over 'pp'.
+    """
+    s = jax.lax.psum(1, axis_name)          # number of stages (static)
+    stage = jax.lax.axis_index(axis_name)
+    m = x_mb.shape[0]
+    fwd = [(i, i + 1) for i in range(s - 1)]  # no wraparound: stage 0
+    # receives zeros, which it ignores (it reads the feed instead)
+
+    def tick(carry, t):
+        buf, outs = carry
+        # stage 0 reads the microbatch feed; others read the activation
+        # that arrived from the previous stage at the end of last tick
+        inp = jnp.where(stage == 0, x_mb[jnp.clip(t, 0, m - 1)], buf)
+        out = stage_fn(stage_params, inp)
+        nxt = jax.lax.ppermute(out, axis_name, fwd)
+        # the LAST stage's tick-t output is microbatch t-(s-1)
+        idx = t - (s - 1)
+        valid = jnp.logical_and(stage == s - 1,
+                                jnp.logical_and(idx >= 0, idx < m))
+        upd = jax.lax.dynamic_update_slice(
+            outs, out[None].astype(outs.dtype),
+            (jnp.clip(idx, 0, m - 1),) + (0,) * out.ndim)
+        outs = jnp.where(valid, upd, outs)
+        return (nxt, outs), None
+
+    zero_buf = jnp.zeros_like(x_mb[0])
+    zero_out = jnp.zeros((m,) + x_mb.shape[1:], x_mb.dtype)
+    (_, outs), _ = jax.lax.scan(tick, (zero_buf, zero_out),
+                                jnp.arange(m + s - 1))
+    # only the last stage holds real outputs; broadcast to every stage
+    # so the loss is computable anywhere (others contribute zeros)
+    return jax.lax.psum(
+        jnp.where(stage == s - 1, outs, jnp.zeros_like(outs)), axis_name)
+
+
+def pipeline_spmd(stage_fn, mesh, axis_name='pp', batch_axis=None):
+    """Wrap pipeline_apply in a shard_map over `mesh`.
+
+    Returns fn(stacked_params, x_mb) -> [M, mb, ...]:
+      stacked_params  leaves [S, ...], sharded P('pp') on the stage axis
+      x_mb            [M, mb, ...] microbatches, replicated over 'pp';
+                      pass batch_axis='dp' to also shard the mb dim over
+                      a data-parallel mesh axis (the pipeline is
+                      orthogonal to data parallelism — each dp slice
+                      runs its own fill-drain over the same stages).
+    """
+    param_spec = P(axis_name)
+    data_spec = P(None, batch_axis) if batch_axis else P()
+    n_stage = mesh.shape[axis_name]
+
+    def check_stages(stacked):
+        for leaf in jax.tree_util.tree_leaves(stacked):
+            if leaf.shape[0] != n_stage:
+                raise ValueError(
+                    'pipeline_spmd: stacked stage axis is %d but the '
+                    "'%s' mesh axis has %d devices — a mismatched "
+                    'stack would silently run the wrong stages'
+                    % (leaf.shape[0], axis_name, n_stage))
+
+    def body(stacked_local, x_mb):
+        # shard_map hands each device a length-1 slice of the stage
+        # axis (validated against the mesh in the caller wrapper);
+        # squeeze it so stage_fn sees one stage's parameters
+        local = jax.tree_util.tree_map(lambda a: a[0], stacked_local)
+        return pipeline_apply(stage_fn, local, x_mb,
+                              axis_name=axis_name)
+
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(param_spec, data_spec),
+        out_specs=data_spec,
+        check_vma=False)
+
+    def fn(stacked_params, x_mb):
+        check_stages(stacked_params)
+        return mapped(stacked_params, x_mb)
+
+    return fn
