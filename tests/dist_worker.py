@@ -45,6 +45,12 @@ def main():
         # multi-host long-context story (SURVEY §5.7)
         _run_dp_sp(jax, np, fluid, pid, steps)
         return
+    if mode == 'pp':
+        # cross-process PIPELINE parallelism: stages live in different
+        # processes; every activation hop (and its backward transpose)
+        # is a ppermute across the process boundary
+        _run_pp(jax, np, pid, steps)
+        return
 
     batch = int(os.environ.get('DIST_TEST_BATCH', '32'))
     rng = np.random.RandomState(42)
@@ -111,6 +117,54 @@ def _run_dp_sp(jax, np, fluid, pid, steps):
                              feed={'src_ids': src, 'trg_ids': trg,
                                    'lbl_ids': src})
             losses.append(float(np.asarray(loss_v).flatten()[0]))
+    print(json.dumps({'pid': pid, 'losses': losses}), flush=True)
+
+
+# shared between _run_pp and the sequential oracle in
+# test_dist_train.py::test_two_process_pipeline_parallel — edit here,
+# both sides follow
+PP_CFG = {'d': 16, 'm': 8, 'mb': 2, 'seed': 7, 'lr': 0.2}
+
+
+def _run_pp(jax, np, pid, steps):
+    """4-stage GPipe over a 'pp' axis spanning both processes (2 local
+    devices each): deterministic init so the test can oracle the loss
+    trajectory against the sequential composition."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu import parallel
+
+    devs = jax.devices()
+    mesh = parallel.make_mesh({'pp': len(devs)}, devs)
+    d, m, mb = PP_CFG['d'], PP_CFG['m'], PP_CFG['mb']
+    rng = np.random.RandomState(PP_CFG['seed'])
+    stages = [{'w': (rng.standard_normal((d, d)) / 4.0).astype('float32'),
+               'b': np.zeros((d,), 'float32')} for _ in range(len(devs))]
+    stacked_host = {
+        k: np.stack([s[k] for s in stages]) for k in ('w', 'b')}
+    x = rng.standard_normal((m, mb, d)).astype('float32')
+
+    def put(a, spec):
+        sh = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(a.shape, sh,
+                                            lambda idx: a[idx])
+
+    params = {k: put(v, P('pp')) for k, v in stacked_host.items()}
+    xg = put(x, P())
+    fn = parallel.pipeline_spmd(
+        lambda p, h: jnp.tanh(h @ p['w'] + p['b']), mesh)
+
+    @jax.jit
+    def step(p):
+        loss, g = jax.value_and_grad(
+            lambda q: jnp.mean(fn(q, xg) ** 2))(p)
+        return loss, jax.tree_util.tree_map(
+            lambda a, b: a - PP_CFG['lr'] * b, p, g)
+
+    losses = []
+    for _ in range(steps):
+        loss, params = step(params)
+        losses.append(float(loss))
     print(json.dumps({'pid': pid, 'losses': losses}), flush=True)
 
 
