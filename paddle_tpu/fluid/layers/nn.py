@@ -28,7 +28,7 @@ __all__ = [
     'prelu', 'leaky_relu', 'soft_relu', 'flatten', 'random_crop', 'im2sequence',
     'hsigmoid', 'nce', 'multiplex', 'dropout', 'layer_norm', 'lstm_unit',
     'linear_chain_crf', 'crf_decoding', 'cos_sim', 'flash_attention',
-    'warpctc', 'ctc_greedy_decoder', 'edit_distance', 'roi_pool',
+    'moe_ffn', 'warpctc', 'ctc_greedy_decoder', 'edit_distance', 'roi_pool',
     'conv3d_transpose', 'crop', 'dice_loss', 'image_resize_short',
     'lod_reset', 'mean_iou', 'pad_constant_like', 'rank_loss',
 ]
@@ -1488,6 +1488,67 @@ def flash_attention(q, k, v, num_heads=None, causal=False, scale=None,
         })
     if squeeze_back:
         out = reshape(out, [0, 0, int(num_heads) * int(v.shape[-1])])
+    return out
+
+
+def moe_ffn(input, num_experts, d_ff, capacity_factor=1.25,
+            ep_axis='ep', param_attr=None, name=None):
+    """Switch-style Mixture-of-Experts FFN (TPU-native extension; the
+    reference predates MoE).
+
+    Top-1 routing with a static per-expert capacity (GShard dense
+    dispatch, ops/moe_ops.py): over-capacity tokens pass through with
+    zero expert output, the gate probability scales the kept ones so
+    the router trains.  Expert weights carry a leading [num_experts,
+    ...] axis annotated PartitionSpec(ep_axis): under a
+    ParallelExecutor mesh with an 'ep' axis GSPMD shards the experts
+    and partitions the dispatch/combine einsums — expert parallelism
+    through the same annotation mechanism tensor-parallel fc uses.
+    (For the hand-scheduled all_to_all variant outside the Program IR
+    see paddle_tpu.parallel.moe_ffn_spmd.)
+
+    input: [..., d_model] Variable.  Returns same shape.
+    """
+    from ...parallel import shard as _shard
+    import copy as _copy
+    helper = LayerHelper('moe_ffn', **locals())
+    dtype = helper.input_dtype()
+    d = int(input.shape[-1])
+    e, dff = int(num_experts), int(d_ff)
+
+    def _attr(base, suffix):
+        # one user attr names FOUR differently-shaped weights: suffix
+        # the name per weight so a named ParamAttr doesn't collide on
+        # the shared-parameter path
+        if base is None or base is False or getattr(base, 'name',
+                                                    None) is None:
+            return base
+        a = _copy.copy(base)
+        a.name = '%s.%s' % (base.name, suffix)
+        return a
+
+    gate_w = helper.create_parameter(attr=_attr(helper.param_attr, 'gate'),
+                                     shape=[d, e], dtype=dtype)
+    w1 = helper.create_parameter(attr=_attr(helper.param_attr, 'w1'),
+                                 shape=[e, d, dff], dtype=dtype)
+    b1 = helper.create_parameter(attr=_attr(helper.bias_attr, 'b1'),
+                                 shape=[e, dff], dtype=dtype,
+                                 is_bias=True)
+    w2 = helper.create_parameter(attr=_attr(helper.param_attr, 'w2'),
+                                 shape=[e, dff, d], dtype=dtype)
+    b2 = helper.create_parameter(attr=_attr(helper.bias_attr, 'b2'),
+                                 shape=[e, d], dtype=dtype, is_bias=True)
+    for p in (w1, b1, w2, b2):
+        _shard(p, ep_axis)          # leading expert axis over 'ep'
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = tuple(input.shape)
+    helper.append_op(
+        type='moe_ffn',
+        inputs={'X': [input], 'GateW': [gate_w], 'W1': [w1], 'B1': [b1],
+                'W2': [w2], 'B2': [b2]},
+        outputs={'Out': [out]},
+        attrs={'capacity_factor': float(capacity_factor),
+               'ep_axis': ep_axis})
     return out
 
 

@@ -1,0 +1,32 @@
+"""Mixture-of-Experts op lowering (fluid.layers.moe_ffn).
+
+The GShard DENSE dispatch formulation (parallel/moe.py moe_ffn): every
+tensor is static-shaped, the expert dimension is a real array axis, and
+parallelism comes from the expert weights' PartitionSpec over the 'ep'
+mesh axis — GSPMD partitions the dispatch and combine einsums and
+inserts the collectives, exactly the mechanism tensor-parallel fc uses.
+(The hand-scheduled all_to_all variant for shard_map users lives in
+parallel/moe.py moe_ffn_spmd; this lowering is the Program-IR path and
+delegates its math to parallel.moe.moe_ffn so routing has one source of
+truth.)
+"""
+
+from .registry import register_lowering
+from ..parallel import moe as _moe
+
+
+@register_lowering('moe_ffn')
+def _moe_ffn(ctx, op):
+    x = ctx.get(op, 'X')
+    params = {
+        'gate_w': ctx.get(op, 'GateW'),
+        'w1': ctx.get(op, 'W1'),
+        'b1': ctx.get(op, 'B1'),
+        'w2': ctx.get(op, 'W2'),
+        'b2': ctx.get(op, 'B2'),
+    }
+    cf = op.attrs.get('capacity_factor', 1.25)
+    lead = x.shape[:-1]
+    tok = x.reshape((-1, x.shape[-1]))
+    y = _moe.moe_ffn(params, tok, capacity_factor=cf)
+    ctx.set(op, 'Out', y.reshape(lead + (x.shape[-1], )))

@@ -74,11 +74,12 @@ def _expert_ffn(w1, b1, w2, b2, h):
 
 
 def moe_ffn(params, x, capacity_factor=1.25):
-    """Single-device reference semantics (also the test oracle path).
-    x: [N, D] tokens.  Returns [N, D]."""
+    """Single-device reference semantics — the test oracle path AND the
+    body of the fluid lowering (ops/moe_ops.py), so the routing math
+    has one source of truth.  x: [N, D] tokens.  Returns [N, D]."""
     n_expert = params['gate_w'].shape[-1]
     n = x.shape[0]
-    capacity = int(np.ceil(n / n_expert * capacity_factor))
+    capacity = max(int(np.ceil(n / n_expert * capacity_factor)), 1)
     dispatch, combine = _route_top1(x, params['gate_w'], n_expert,
                                     capacity)
     # [N,E,C] x [N,D] -> buckets [E,C,D]
