@@ -1492,7 +1492,7 @@ def flash_attention(q, k, v, num_heads=None, causal=False, scale=None,
 
 
 def moe_ffn(input, num_experts, d_ff, capacity_factor=1.25,
-            ep_axis='ep', param_attr=None, name=None):
+            ep_axis='ep', param_attr=None, bias_attr=None, name=None):
     """Switch-style Mixture-of-Experts FFN (TPU-native extension; the
     reference predates MoE).
 
@@ -1509,9 +1509,9 @@ def moe_ffn(input, num_experts, d_ff, capacity_factor=1.25,
 
     input: [..., d_model] Variable.  Returns same shape.
     """
+    helper = LayerHelper('moe_ffn', **locals())
     from ...parallel import shard as _shard
     import copy as _copy
-    helper = LayerHelper('moe_ffn', **locals())
     dtype = helper.input_dtype()
     d = int(input.shape[-1])
     e, dff = int(num_experts), int(d_ff)

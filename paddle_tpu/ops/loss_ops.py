@@ -1,6 +1,8 @@
 """Loss op lowerings (reference: paddle/fluid/operators/cross_entropy_op.cc,
 softmax_with_cross_entropy_op.cc, and the *_loss_op.cc family)."""
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -34,12 +36,59 @@ def _cross_entropy(ctx, op):
     ctx.set(op, 'Y', loss)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, ))
+def _fused_ce_bf16(logits, idx, ignore):
+    return _fused_ce_fwd_math(logits, idx, ignore)[:2]
+
+
+def _fused_ce_fwd_math(logits, idx, ignore):
+    # reductions in f32 (exp/sum over a large vocab drifts in bf16); the
+    # upcast fuses into the reduction so no f32 [N, V] tensor crosses HBM
+    lf = logits.astype(jnp.float32)
+    z = jax.scipy.special.logsumexp(lf, axis=-1, keepdims=True)
+    valid = (idx != ignore)
+    safe = jnp.where(valid, idx, 0)
+    picked = jnp.take_along_axis(lf, safe[..., None], axis=-1)
+    loss = jnp.where(valid[..., None], z - picked, 0.0)
+    p = jnp.exp(lf - z).astype(logits.dtype)    # residual stays bf16
+    return loss, p, (p, safe, valid)
+
+
+def _fused_ce_fwd(logits, idx, ignore):
+    loss, p, res = _fused_ce_fwd_math(logits, idx, ignore)
+    return (loss, p), res
+
+
+def _fused_ce_bwd(ignore, res, gs):
+    g_loss, _g_p = gs       # the Softmax output is not differentiated
+    p, safe, valid = res
+    onehot = jax.nn.one_hot(safe, p.shape[-1], dtype=jnp.float32)
+    scale = jnp.where(valid[..., None], g_loss.astype(jnp.float32), 0.0)
+    # dlogits lands bf16 DIRECTLY: its consumer is the bf16 vocab-matmul
+    # backward, and emitting f32 here cost a [N, V] f32 round-trip plus
+    # a convert (13% of the transformer step, round-4 xplane profile)
+    d = ((p.astype(jnp.float32) - onehot) * scale).astype(p.dtype)
+    return (d, jnp.zeros(safe.shape, jax.dtypes.float0))
+
+
+_fused_ce_bf16.defvjp(_fused_ce_fwd, _fused_ce_bwd)
+
+
 @register_lowering('softmax_with_cross_entropy')
 def _softmax_with_cross_entropy(ctx, op):
-    # bf16 logits (AMP) read at half HBM width, but the exp/sum over a
-    # large vocab must run f32 — the upcast fuses into the reduction
-    logits = amp_upcast_f32(ctx.get(op, 'Logits'))
+    raw = ctx.get(op, 'Logits')
     label = ctx.get(op, 'Label')
+    if not op.attrs.get('soft_label', False) and raw.dtype == jnp.bfloat16:
+        # AMP hard-label fast path: custom VJP keeps every [N, V]
+        # HBM-crossing tensor (softmax residual, dlogits) in bf16
+        idx = _index_label(label)
+        loss, softmax = _fused_ce_bf16(
+            raw, idx, op.attrs.get('ignore_index', -100))
+        ctx.set(op, 'Softmax', softmax)
+        ctx.set(op, 'Loss', loss)
+        return
+    # f32 path (and soft labels): plain composition, f32 throughout
+    logits = amp_upcast_f32(raw)
     log_p = jax.nn.log_softmax(logits, axis=-1)
     softmax = jnp.exp(log_p)
     if op.attrs.get('soft_label', False):

@@ -152,3 +152,46 @@ def test_amp_lstm_training_loss_parity():
     l_amp = train(True)
     assert l_fp32 < 0.3, l_fp32  # overfits the fixed batch
     assert abs(l_amp - l_fp32) < 0.1, (l_amp, l_fp32)
+
+
+def test_fused_bf16_ce_matches_f32_path():
+    """The AMP hard-label fused CE (custom VJP, ops/loss_ops.py
+    _fused_ce_bf16): loss, Softmax output, and parameter gradients must
+    match the f32 composition within bf16 tolerance, including
+    ignore_index rows."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import loss_ops
+
+    rng = np.random.RandomState(11)
+    n, v = 24, 96
+    logits = rng.standard_normal((n, v)).astype('float32') * 3
+    idx = rng.randint(0, v, (n, )).astype('int32')
+    idx[:4] = -100    # ignored rows
+
+    loss_bf, p_bf = loss_ops._fused_ce_bf16(
+        jnp.asarray(logits, jnp.bfloat16), jnp.asarray(idx), -100)
+    lf = jnp.asarray(logits, jnp.bfloat16).astype(jnp.float32)
+    log_p = jax.nn.log_softmax(lf, axis=-1)
+    want_p = jnp.exp(log_p)
+    safe = np.where(idx == -100, 0, idx)
+    want_loss = -np.take_along_axis(np.asarray(log_p), safe[:, None], 1)
+    want_loss[idx == -100] = 0.0
+    np.testing.assert_allclose(np.asarray(loss_bf, np.float32),
+                               want_loss, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(p_bf, np.float32),
+                               np.asarray(want_p), rtol=2e-2, atol=2e-2)
+
+    # gradient: d loss / d logits == (p - onehot) masked, in bf16
+    def total(lg):
+        l, _ = loss_ops._fused_ce_bf16(lg, jnp.asarray(idx), -100)
+        return jnp.sum(l)
+
+    g = jax.grad(total)(jnp.asarray(logits, jnp.bfloat16))
+    onehot = np.zeros((n, v), np.float32)
+    onehot[np.arange(n), safe] = 1.0
+    want_g = (np.asarray(want_p) - onehot)
+    want_g[idx == -100] = 0.0
+    assert g.dtype == jnp.bfloat16   # lands bf16 for the matmul consumer
+    np.testing.assert_allclose(np.asarray(g, np.float32), want_g,
+                               rtol=2e-2, atol=2e-2)

@@ -263,6 +263,8 @@ def test_fluid_moe_named_param_attr():
     with fluid.program_guard(prog, startup):
         xv = fluid.layers.data('x', [8], dtype='float32')
         fluid.layers.moe_ffn(xv, num_experts=4, d_ff=16,
-                             param_attr=fluid.ParamAttr(name='moe_w'))
+                             param_attr=fluid.ParamAttr(name='moe_w'),
+                             bias_attr=fluid.ParamAttr(name='moe_b'))
     names = sorted(p.name for p in prog.all_parameters())
-    assert {'moe_w.gate', 'moe_w.w1', 'moe_w.w2'} <= set(names), names
+    assert {'moe_w.gate', 'moe_w.w1', 'moe_w.w2',
+            'moe_b.b1', 'moe_b.b2'} <= set(names), names
