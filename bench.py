@@ -50,9 +50,11 @@ BASELINE_RESNET_IMGS_PER_SEC = 84.08
 WARMUP = 2
 
 # Per-config wall-clock budgets (seconds).  ResNet gets extra headroom
-# for the bs512 224^2 compile; the total (~16 min worst case, all four
-# hanging) stays under the driver's observed >=25 min patience.
-BUDGETS = {'resnet': 320, 'nmt': 240, 'transformer': 240,
+# for the bs512 224^2 compile, transformer for its 6-layer bs128
+# seq256 compile (observed >240s on a degraded tunnel window, round 4);
+# the total (~19 min worst case, all four hanging) stays under the
+# driver's observed >=25 min patience.
+BUDGETS = {'resnet': 320, 'nmt': 240, 'transformer': 340,
            'stacked_lstm': 200}
 if os.environ.get('BENCH_BUDGET'):  # uniform override, mainly for tests
     BUDGETS = {k: int(os.environ['BENCH_BUDGET']) for k in BUDGETS}

@@ -1531,21 +1531,29 @@ def moe_ffn(input, num_experts, d_ff, capacity_factor=1.25,
                                      shape=[d, e], dtype=dtype)
     w1 = helper.create_parameter(attr=_attr(helper.param_attr, 'w1'),
                                  shape=[e, d, dff], dtype=dtype)
-    b1 = helper.create_parameter(attr=_attr(helper.bias_attr, 'b1'),
-                                 shape=[e, dff], dtype=dtype,
-                                 is_bias=True)
     w2 = helper.create_parameter(attr=_attr(helper.param_attr, 'w2'),
                                  shape=[e, dff, d], dtype=dtype)
-    b2 = helper.create_parameter(attr=_attr(helper.bias_attr, 'b2'),
-                                 shape=[e, d], dtype=dtype, is_bias=True)
-    for p in (w1, b1, w2, b2):
+    experts = [w1, w2]
+    inputs = {'X': [input], 'GateW': [gate_w], 'W1': [w1], 'W2': [w2]}
+    if bias_attr is not False:
+        # bias_attr=False means NO bias at all (the repo-wide fc/conv
+        # convention), not a frozen zero parameter
+        b1 = helper.create_parameter(attr=_attr(helper.bias_attr, 'b1'),
+                                     shape=[e, dff], dtype=dtype,
+                                     is_bias=True)
+        b2 = helper.create_parameter(attr=_attr(helper.bias_attr, 'b2'),
+                                     shape=[e, d], dtype=dtype,
+                                     is_bias=True)
+        experts += [b1, b2]
+        inputs['B1'] = [b1]
+        inputs['B2'] = [b2]
+    for p in experts:
         _shard(p, ep_axis)          # leading expert axis over 'ep'
     out = helper.create_variable_for_type_inference(dtype)
     out.shape = tuple(input.shape)
     helper.append_op(
         type='moe_ffn',
-        inputs={'X': [input], 'GateW': [gate_w], 'W1': [w1], 'B1': [b1],
-                'W2': [w2], 'B2': [b2]},
+        inputs=inputs,
         outputs={'Out': [out]},
         attrs={'capacity_factor': float(capacity_factor),
                'ep_axis': ep_axis})

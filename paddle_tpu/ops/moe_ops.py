@@ -11,6 +11,8 @@ delegates its math to parallel.moe.moe_ffn so routing has one source of
 truth.)
 """
 
+import jax.numpy as jnp
+
 from .registry import register_lowering
 from ..parallel import moe as _moe
 
@@ -18,12 +20,20 @@ from ..parallel import moe as _moe
 @register_lowering('moe_ffn')
 def _moe_ffn(ctx, op):
     x = ctx.get(op, 'X')
+    w1 = ctx.get(op, 'W1')
+    w2 = ctx.get(op, 'W2')
+    b1 = ctx.get(op, 'B1')
+    b2 = ctx.get(op, 'B2')
     params = {
         'gate_w': ctx.get(op, 'GateW'),
-        'w1': ctx.get(op, 'W1'),
-        'b1': ctx.get(op, 'B1'),
-        'w2': ctx.get(op, 'W2'),
-        'b2': ctx.get(op, 'B2'),
+        'w1': w1,
+        # bias_attr=False omits the bias inputs entirely (no frozen
+        # zero parameters); the math sees zeros
+        'b1': b1 if b1 is not None else jnp.zeros(
+            (w1.shape[0], w1.shape[2]), w1.dtype),
+        'w2': w2,
+        'b2': b2 if b2 is not None else jnp.zeros(
+            (w2.shape[0], w2.shape[2]), w2.dtype),
     }
     cf = op.attrs.get('capacity_factor', 1.25)
     lead = x.shape[:-1]

@@ -268,3 +268,23 @@ def test_fluid_moe_named_param_attr():
     names = sorted(p.name for p in prog.all_parameters())
     assert {'moe_w.gate', 'moe_w.w1', 'moe_w.w2',
             'moe_b.b1', 'moe_b.b2'} <= set(names), names
+
+
+def test_fluid_moe_bias_attr_false_omits_biases():
+    """bias_attr=False means NO bias parameters (the repo-wide fc/conv
+    convention), not frozen zeros — and the layer still runs."""
+    import paddle_tpu.fluid as fluid
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        xv = fluid.layers.data('x', [8], dtype='float32')
+        y = fluid.layers.moe_ffn(xv, num_experts=4, d_ff=16,
+                                 bias_attr=False)
+    shapes = sorted(tuple(p.shape) for p in prog.all_parameters())
+    assert shapes == [(4, 8, 16), (4, 16, 8), (8, 4)], shapes
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        out = exe.run(prog, feed={'x': np.ones((4, 8), 'float32')},
+                      fetch_list=[y.name])[0]
+    assert np.all(np.isfinite(np.asarray(out)))

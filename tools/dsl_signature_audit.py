@@ -40,7 +40,12 @@ DOCUMENTED_INERT = {
 
 
 def reference_signatures():
-    tree = ast.parse(open(REF).read())
+    import warnings
+    with warnings.catch_warnings():
+        # the 2018 reference source carries pre-3.12 escape sequences
+        # ('\m' in docstrings); the audit reads signatures, not strings
+        warnings.simplefilter('ignore', SyntaxWarning)
+        tree = ast.parse(open(REF).read())
     sigs = {}
     for node in tree.body:
         if isinstance(node, ast.FunctionDef):
