@@ -162,11 +162,19 @@ def bidirectional_lstm(input, size, return_seq=False, name=None,
 
 
 def bidirectional_gru(input, size, return_seq=False, name=None,
+                      fwd_mixed_param_attr=None, fwd_mixed_bias_attr=None,
+                      fwd_gru_param_attr=None, fwd_gru_bias_attr=None,
+                      bwd_mixed_param_attr=None, bwd_mixed_bias_attr=None,
+                      bwd_gru_param_attr=None, bwd_gru_bias_attr=None,
                       **kwargs):
-    # explicit project=True: the raw input always gets the learned gate
-    # projection here, even if its width coincidentally equals 3*size
-    fwd = _v2.gru_like(input=input, size=size, project=True)
-    bwd = _v2.gru_like(input=input, size=size, reverse=True, project=True)
+    """Forward + backward GRU arms, each the reference's projected
+    gru block (networks.py:1226 forwards per-arm mixed/gru attrs)."""
+    fwd = _gru_block(input, size, None, False, fwd_mixed_param_attr,
+                     fwd_mixed_bias_attr, fwd_gru_param_attr,
+                     fwd_gru_bias_attr)
+    bwd = _gru_block(input, size, None, True, bwd_mixed_param_attr,
+                     bwd_mixed_bias_attr, bwd_gru_param_attr,
+                     bwd_gru_bias_attr)
     if return_seq:
         return _l.concat_layer(input=[fwd, bwd], name=name)
     return _l.concat_layer(

@@ -511,3 +511,44 @@ def test_batch_norm_default_program_serializes():
     bns = [o for o in back.blocks[0].ops if o.type == 'batch_norm']
     assert [o.attrs.get('use_global_stats') for o in bns] == \
         [None, False, True]
+
+
+def test_bidirectional_gru_param_attrs_forward():
+    """bidirectional_gru's per-arm mixed/gru attrs (reference
+    networks.py:1226) must reach the projections and recurrences: with
+    all weights pinned the composite equals the manual two-arm build."""
+    from paddle_tpu.trainer_config_helpers import networks as tchn
+    rng = np.random.RandomState(12)
+    seq = [rng.standard_normal(8).astype('float32') for _ in range(4)]
+
+    def composite():
+        x = tch.data_layer(name='x', size=8, seq=True)
+        return tchn.bidirectional_gru(
+            input=x, size=6, return_seq=True,
+            fwd_mixed_param_attr=_const_attr(0.1),
+            fwd_mixed_bias_attr=False,
+            fwd_gru_param_attr=_const_attr(0.2),
+            fwd_gru_bias_attr=_const_attr(0.0),
+            bwd_mixed_param_attr=_const_attr(0.15),
+            bwd_mixed_bias_attr=False,
+            bwd_gru_param_attr=_const_attr(0.25),
+            bwd_gru_bias_attr=_const_attr(0.0))
+
+    def manual():
+        x = tch.data_layer(name='x', size=8, seq=True)
+        fp = tch.fc_layer(input=x, size=18, act=tch.LinearActivation(),
+                          param_attr=_const_attr(0.1), bias_attr=False)
+        fwd = tch.grumemory(input=fp, size=6,
+                            param_attr=_const_attr(0.2),
+                            bias_attr=_const_attr(0.0))
+        bp = tch.fc_layer(input=x, size=18, act=tch.LinearActivation(),
+                          param_attr=_const_attr(0.15), bias_attr=False)
+        bwd = tch.grumemory(input=bp, size=6, reverse=True,
+                            param_attr=_const_attr(0.25),
+                            bias_attr=_const_attr(0.0))
+        return tch.concat_layer(input=[fwd, bwd])
+
+    got = _infer_seq(composite(), seq)
+    tch.reset_config()
+    want = _infer_seq(manual(), seq)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
