@@ -255,7 +255,8 @@ def run_one(name):
         # enough — pin via jax.config after import too.
         os.environ['JAX_PLATFORMS'] = 'cpu'
         import jax
-        jax.config.update('jax_platforms', 'cpu')
+        from paddle_tpu.fluid.core import reconcile_platforms
+        reconcile_platforms(jax)  # one guard, shared with the library
     import paddle_tpu.fluid as fluid
     on_tpu = fluid.core.is_compiled_with_tpu()
     rec = CONFIGS[name](on_tpu)

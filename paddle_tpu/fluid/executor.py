@@ -298,7 +298,13 @@ class _CompiledBlock(object):
                     v.type != core.VarDesc.VarType.SELECTED_ROWS)
 
         for op in ops:
-            for name in op.input_arg_names:
+            reads = list(op.input_arg_names)
+            if op.type in ('conditional_block', 'ifelse', 'switch_case'):
+                # blended control flow READS every written var's old
+                # value (the cond-false blend), so a startup-initialized
+                # persistable updated in a branch must arrive as state_in
+                reads += list(op.output_arg_names)
+            for name in reads:
                 if name in defined or name in state_in:
                     continue
                 if threadable(block._find_var_recursive(name)):
@@ -341,13 +347,13 @@ class _CompiledBlock(object):
                                            batch_axis=spmd_ref['batch_axis'])
             for op in ops:
                 registry.run_op(ctx, op)
-            for n in fetch_names_:
-                if n in ctx.cond_uninit:
-                    raise RuntimeError(
-                        'fetch of var %r, whose only assignment is '
-                        'inside a single conditional_block — '
-                        'uninitialized when the cond is false '
-                        '(reference conditional_block_op.cc)' % n)
+            registry.check_cond_uninit(ctx, fetch_names_, 'fetch')
+            # NOTE a persistable var assigned only inside a conditional
+            # block cannot reach here cond-uninit: the state scan counts
+            # blended control flow's outputs as READS, so the var is
+            # state_in — either the scope lacks it (_state_from_scope
+            # raises 'not initialized') or its real value is in env and
+            # the blend keeps it.  No zeros ever persist.
             new_state = {n: env[n] for n in state_out_ if n in env}
             fetches = [env[n] for n in fetch_names_]
             return new_state, fetches
@@ -394,15 +400,13 @@ class _CompiledBlock(object):
                 # host ops bypass run_op: apply the may-read-before-
                 # write check here (a save/print of a cond-uninit var
                 # is exactly the reference's uninitialized-read error)
-                for n in op.input_arg_names:
-                    if n in ctx.cond_uninit:
-                        raise RuntimeError(
-                            'host op %r reads var %r, whose only '
-                            'assignment is inside a single '
-                            'conditional_block — uninitialized when '
-                            'the cond is false (reference '
-                            'conditional_block_op.cc)' % (op.type, n))
+                registry.check_cond_uninit(ctx, op.input_arg_names,
+                                           'host op %r' % op.type)
                 host_impl(ctx, op, scope)
+                # ...and an unconditional host-op WRITE (load/
+                # load_combine) covers the name, same as run_op's rule
+                for n in op.output_arg_names:
+                    ctx.cond_uninit.discard(n)
             else:
                 registry.run_op(ctx, op)
             if check_nan:
@@ -414,13 +418,7 @@ class _CompiledBlock(object):
             # so the eager env's peak live set matches true liveness
             for n in self._eager_release.get(op_idx, ()):
                 env.pop(n, None)
-        for n in self.fetch_names:
-            if n in ctx.cond_uninit:
-                raise RuntimeError(
-                    'fetch of var %r, whose only assignment is inside '
-                    'a single conditional_block — uninitialized when '
-                    'the cond is false (reference '
-                    'conditional_block_op.cc)' % n)
+        registry.check_cond_uninit(ctx, self.fetch_names, 'fetch')
         new_state = {n: env[n] for n in self.state_out if n in env}
         fetches = [env[n] for n in self.fetch_names]
         return new_state, fetches

@@ -204,21 +204,32 @@ _SEQ_CONSUMERS = {
 }
 
 
+def check_cond_uninit(ctx, names, what):
+    """Reject a read of a var whose only assignment sits inside a single
+    conditional_block — when the cond is false the var is uninitialized
+    and the reference's conditional_block_op.cc enforce errors on the
+    read.  One helper for every call site (jit op inputs, host-op
+    inputs, fetches) so the rule cannot drift between paths."""
+    if not ctx.cond_uninit:
+        return
+    for n in names:
+        if n in ctx.cond_uninit:
+            raise RuntimeError(
+                '%s reads var %r, whose only assignment is inside a '
+                'single conditional_block: when the cond is false the '
+                'var is uninitialized (reference conditional_block_op.cc '
+                'errors on such a read) — write it unconditionally or '
+                'in both branches first' % (what, n))
+
+
 def run_op(ctx, op):
     """Lower one op into the trace, propagating sequence-length metadata
     (the static-shape stand-in for LoD, SURVEY §5.7)."""
     guarded = ctx.conditional_scope or op.type == 'conditional_block'
-    if ctx.cond_uninit and not guarded:
-        for names in op.inputs.values():
-            for n in names:
-                if n in ctx.cond_uninit:
-                    raise RuntimeError(
-                        'op %r reads var %r, whose only assignment is '
-                        'inside a single conditional_block: when the '
-                        'cond is false the var is uninitialized '
-                        '(reference conditional_block_op.cc errors on '
-                        'such a read) — write it unconditionally or in '
-                        'both branches first' % (op.type, n))
+    if not guarded:
+        check_cond_uninit(
+            ctx, (n for names in op.inputs.values() for n in names),
+            'op %r' % op.type)
     if op.type not in _CONCRETE_PRESERVING:
         for names in op.outputs.values():
             for n in names:

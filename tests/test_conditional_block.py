@@ -175,3 +175,94 @@ def test_both_branches_cover_the_var_ifelse_pattern():
             exe.run(startup)
             got, = exe.run(main, feed={}, fetch_list=[out])
         assert float(np.asarray(got).flatten()[0]) == want
+
+
+def test_persisting_conditionally_uninitialized_var_is_rejected():
+    """A PERSISTABLE var assigned only inside one conditional block
+    must be rejected before any zeros blend could persist into the
+    scope: the state scan counts the blend's old-value READ, so the
+    uninitialized persistable fails scope materialization with the
+    standard not-initialized error (round-4 review)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        cond = fluid.layers.fill_constant([1], 'bool', True)
+        fresh = main.current_block().create_var(
+            name='persist_me', dtype='float32', shape=[1])
+        fresh.persistable = True
+
+        def body():
+            seven = fluid.layers.fill_constant([1], 'float32', 7.0)
+            fluid.layers.assign(seven, fresh)
+
+        _cond_block(main, cond, body, [fresh.name])
+        out = fluid.layers.fill_constant([1], 'float32', 1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        with pytest.raises(Exception, match='not initialized'):
+            exe.run(main, feed={}, fetch_list=[out.name])
+
+
+def test_startup_initialized_persistable_may_update_in_a_branch():
+    """The legitimate pattern stays legal: a persistable initialized by
+    the startup program and conditionally updated blends with its real
+    old value (no zeros, no rejection) — e.g. a conditional counter."""
+    for cond_value, want in ((1, 7.0), (0, 3.0)):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            cond = fluid.layers.fill_constant([1], 'bool', bool(cond_value))
+            v = fluid.layers.create_global_var(
+                shape=[1], value=3.0, dtype='float32',
+                persistable=True, name='ctr_%d' % cond_value)
+
+            def body():
+                seven = fluid.layers.fill_constant([1], 'float32', 7.0)
+                fluid.layers.assign(seven, v)
+
+            _cond_block(main, cond, body, [v.name])
+            out = fluid.layers.scale(v, scale=1.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.core.Scope()):
+            exe.run(startup)
+            got, = exe.run(main, feed={}, fetch_list=[out])
+        assert float(np.asarray(got).flatten()[0]) == want
+
+
+def test_host_op_load_covers_the_var(tmp_path):
+    """An unconditional host-op WRITE (load) of a cond-uninit var covers
+    the name exactly like a jit-path write: the later read is legal and
+    sees the loaded value (round-4 review: host ops bypass run_op and
+    previously never cleared the flag)."""
+    # save a value first
+    save_main, save_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(save_main, save_startup):
+        v = fluid.layers.create_global_var(
+            shape=[1], value=41.0, dtype='float32', persistable=True,
+            name='ld_var')
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(save_startup)
+        fluid.io.save_vars(exe, str(tmp_path), save_main,
+                           vars=[v], filename=None)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        cond = fluid.layers.fill_constant([1], 'bool', False)
+        fresh = main.current_block().create_var(
+            name='ld_var', dtype='float32', shape=[1])
+
+        def body():
+            seven = fluid.layers.fill_constant([1], 'float32', 7.0)
+            fluid.layers.assign(seven, fresh)
+
+        _cond_block(main, cond, body, [fresh.name])
+        # unconditional host load covers the name...
+        main.current_block().append_op(
+            type='load', inputs={},
+            outputs={'Out': [fresh.name]},
+            attrs={'file_path': str(tmp_path / 'ld_var')})
+        out = fluid.layers.scale(fresh, scale=1.0)  # ...legal read
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        got, = exe.run(main, feed={}, fetch_list=[out])
+    assert float(np.asarray(got).flatten()[0]) == 41.0

@@ -19,7 +19,14 @@ BATCH = int(os.environ.get('PERF_GATE_BATCH', '256'))
 STEPS = int(os.environ.get('PERF_GATE_STEPS', '10'))
 
 
-def measure_bound():
+BLOCKS = int(os.environ.get('PERF_GATE_BLOCKS', '3'))
+
+
+def build_bound():
+    """Compile + warm the pure-JAX bound; returns a timed-block closure.
+    Interleaved with the framework's blocks in main() so minute-scale
+    tunnel drift (±30%, round-4 measurement discipline) hits both sides
+    alike instead of whichever ran second."""
     import functools
     import jax
     import jax.numpy as jnp
@@ -27,10 +34,11 @@ def measure_bound():
     import jax_resnet_bound as bound
 
     dev = jax.devices()[0]
+    state = {}
     params = bound.make_params(jax.random.PRNGKey(0), 'NCHW')
     vel = [{k: jnp.zeros_like(v) for k, v in p.items()} for p in params]
-    params = jax.device_put(params, dev)
-    vel = jax.device_put(vel, dev)
+    state['params'] = jax.device_put(params, dev)
+    state['vel'] = jax.device_put(vel, dev)
     rng = np.random.RandomState(0)
     x = jax.device_put(jnp.asarray(
         rng.standard_normal((BATCH, 3, 224, 224)), jnp.float32), dev)
@@ -38,16 +46,22 @@ def measure_bound():
         rng.randint(0, 1000, size=(BATCH, )).astype(np.int32), dev)
     step = functools.partial(bound.train_step, layout='NCHW', remat=False)
     for _ in range(2):
-        params, vel, loss = step(params, vel, x, label)
+        state['params'], state['vel'], loss = step(
+            state['params'], state['vel'], x, label)
     float(loss)  # fetch drains (axon block_until_ready does not)
-    t0 = time.time()
-    for _ in range(STEPS):
-        params, vel, loss = step(params, vel, x, label)
-    float(loss)
-    return BATCH * STEPS / (time.time() - t0)
+
+    def timed_block():
+        t0 = time.time()
+        for _ in range(STEPS):
+            state['params'], state['vel'], loss = step(
+                state['params'], state['vel'], x, label)
+        float(loss)
+        return BATCH * STEPS / (time.time() - t0)
+
+    return timed_block
 
 
-def measure_framework():
+def build_framework():
     import jax
     import numpy as np
     import paddle_tpu.fluid as fluid
@@ -72,14 +86,19 @@ def measure_framework():
         for _ in range(2):
             exe.run(model['main'], feed=feed, fetch_list=[model['loss']])
             exe.run(model['main'], feed=feed, fetch_list=[])
-        t0 = time.time()
-        for _ in range(STEPS - 1):
-            exe.run(model['main'], feed=feed, fetch_list=[])
-        loss_v, = exe.run(model['main'], feed=feed,
-                          fetch_list=[model['loss']])
-        elapsed = time.time() - t0
-    assert np.isfinite(np.asarray(loss_v)).all()
-    return BATCH * STEPS / elapsed
+
+    def timed_block():
+        with fluid.scope_guard(scope), fluid.amp_guard(True):
+            t0 = time.time()
+            for _ in range(STEPS - 1):
+                exe.run(model['main'], feed=feed, fetch_list=[])
+            loss_v, = exe.run(model['main'], feed=feed,
+                              fetch_list=[model['loss']])
+            elapsed = time.time() - t0
+        assert np.isfinite(np.asarray(loss_v)).all()
+        return BATCH * STEPS / elapsed
+
+    return timed_block
 
 
 def main():
@@ -88,12 +107,21 @@ def main():
     if backend not in ('tpu', 'axon'):
         print(json.dumps({'skip': 'no TPU backend (%s)' % backend}))
         return
-    # interleave-free, same process, same session: drift cancels
-    framework = measure_framework()
-    bound = measure_bound()
+    # both sides compiled first, then INTERLEAVED best-of-N blocks:
+    # a drift window between two monolithic measurements would otherwise
+    # decide the hard ratio>=1.0 gate, not the build under test
+    fw_block = build_framework()
+    bd_block = build_bound()
+    fw, bd = [], []
+    for _ in range(BLOCKS):
+        fw.append(fw_block())
+        bd.append(bd_block())
+    framework, bound = max(fw), max(bd)
     print(json.dumps({
         'framework_imgs_per_sec': round(framework, 1),
         'bound_imgs_per_sec': round(bound, 1),
+        'framework_blocks': [round(v, 1) for v in fw],
+        'bound_blocks': [round(v, 1) for v in bd],
         'ratio': round(framework / bound, 4),
         'batch': BATCH, 'steps': STEPS,
     }))
