@@ -624,6 +624,23 @@ class Executor(object):
 
         eager = any(_is_host_op(op) for op in compiled.ops)
         rng = self._next_rng(program)
+        from . import profiler as _profiler
+        if _profiler.is_profiler_enabled() and not flags.FLAGS.benchmark:
+            # one timeline slice per run (the reference profiler records
+            # per-op RecordEvents; whole-block XLA execution makes the
+            # run the natural host-side unit — device-side op slices
+            # come from the xplane capture).  The slice must cover
+            # device time, not just the async dispatch, so sync inside.
+            with _profiler.record_block(
+                    'executor_run/block0[%s]' %
+                    (compiled.fetch_names and
+                     ','.join(compiled.fetch_names) or 'nofetch')):
+                fetches = compiled.run(scope, feed_arrays, rng,
+                                       eager=eager)
+                for f in fetches:
+                    if hasattr(f, 'block_until_ready'):
+                        f.block_until_ready()
+            return self._convert_fetches(fetches, return_numpy)
         if flags.FLAGS.benchmark:
             import time as _time
             t0 = _time.perf_counter()
@@ -637,7 +654,9 @@ class Executor(object):
                 (_time.perf_counter() - t0) * 1e3, len(fetches))
         else:
             fetches = compiled.run(scope, feed_arrays, rng, eager=eager)
+        return self._convert_fetches(fetches, return_numpy)
 
+    def _convert_fetches(self, fetches, return_numpy):
         def convert(f):
             from ..ops.sparse import SparseRows
             if isinstance(f, core.SelectedRows):

@@ -21,6 +21,7 @@ __all__ = [
 _profiler_state = {
     'enabled': False,
     'events': defaultdict(list),  # name -> [durations]
+    'timeline': [],  # (name, start_s, dur_s) — tools/timeline.py source
     'trace_dir': None,
     'jax_trace_active': False,
     'start_time': None,
@@ -31,9 +32,12 @@ def is_profiler_enabled():
     return _profiler_state['enabled']
 
 
-def record_event(name, seconds):
+def record_event(name, seconds, start=None):
     if _profiler_state['enabled']:
         _profiler_state['events'][name].append(seconds)
+        _profiler_state['timeline'].append(
+            (name, (time.time() - seconds) if start is None else start,
+             seconds))
 
 
 @contextlib.contextmanager
@@ -45,16 +49,18 @@ def record_block(name):
     try:
         yield
     finally:
-        record_event(name, time.time() - t0)
+        record_event(name, time.time() - t0, start=t0)
 
 
 def reset_profiler():
     _profiler_state['events'] = defaultdict(list)
+    _profiler_state['timeline'] = []
 
 
 def start_profiler(state='All'):
     if _profiler_state['enabled']:
         return
+    reset_profiler()  # each start opens a fresh session record
     _profiler_state['enabled'] = True
     _profiler_state['start_time'] = time.time()
     trace_dir = _profiler_state.get('trace_dir')
@@ -91,6 +97,21 @@ def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
         try:
             with open(profile_path, 'w') as f:
                 f.write(report)
+        except OSError:
+            pass
+        # machine-readable sidecar: tools/timeline.py consumes this (the
+        # reference dumps a profiler_pb2 proto for tools/timeline.py:115;
+        # here the host record is JSON and device slices live in the
+        # xplane capture referenced by trace_dir)
+        try:
+            import json
+            with open(profile_path + '.events.json', 'w') as f:
+                json.dump({
+                    'host_events': [
+                        {'name': n, 'start_s': s, 'dur_s': d}
+                        for n, s, d in _profiler_state['timeline']],
+                    'trace_dir': _profiler_state.get('trace_dir'),
+                }, f)
         except OSError:
             pass
     print(report)

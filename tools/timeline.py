@@ -1,0 +1,126 @@
+"""Chrome-trace timeline converter (reference: tools/timeline.py:115).
+
+The reference converts profiler_pb2 dumps (host events + CUPTI GPU
+slices) into chrome://tracing JSON.  Here the host record is the
+``<profile_path>.events.json`` sidecar written by
+``fluid.profiler.profiler(..., profile_path)`` and the device record is
+the JAX xplane capture (written when profile_path's directory form is
+used) — this tool merges both into one chrome-tracing JSON:
+
+    python tools/timeline.py \
+        --profile_path trainer1=/tmp/p1.events.json,trainer2=... \
+        --timeline_path /tmp/timeline.json
+
+Single-file form (no ``name=``) is accepted too.  Load the output in
+chrome://tracing or https://ui.perfetto.dev.
+"""
+
+import argparse
+import json
+import os
+
+
+class _ChromeTraceFormatter(object):
+    """Minimal chrome-tracing JSON builder (catapult trace format)."""
+
+    def __init__(self):
+        self._events = []
+        self._metadata = []
+
+    def emit_pid(self, name, pid):
+        self._metadata.append({
+            'name': 'process_name', 'ph': 'M', 'pid': pid, 'tid': 0,
+            'args': {'name': name}})
+
+    def emit_region(self, timestamp_us, duration_us, pid, tid, category,
+                    name, args=None):
+        self._events.append({
+            'ph': 'X', 'cat': category, 'name': name, 'pid': pid,
+            'tid': tid, 'ts': timestamp_us, 'dur': duration_us,
+            'args': args or {}})
+
+    def format_to_string(self, pretty=False):
+        trace = {'traceEvents': self._metadata + self._events}
+        return json.dumps(trace, indent=4 if pretty else None)
+
+
+class Timeline(object):
+    """profile_dicts: {label: parsed .events.json dict}."""
+
+    def __init__(self, profile_dicts):
+        self._profiles = profile_dicts
+        self._chrome = _ChromeTraceFormatter()
+        self._next_pid = 0
+
+    def _allocate_pid(self):
+        pid = self._next_pid
+        self._next_pid += 1
+        return pid
+
+    def _emit_host(self, label, prof):
+        pid = self._allocate_pid()
+        self._chrome.emit_pid('%s:host' % label, pid)
+        for ev in prof.get('host_events', []):
+            self._chrome.emit_region(
+                ev['start_s'] * 1e6, ev['dur_s'] * 1e6, pid, 0, 'host',
+                ev['name'])
+
+    def _emit_device(self, label, prof):
+        trace_dir = prof.get('trace_dir')
+        if not trace_dir or not os.path.isdir(trace_dir):
+            return
+        try:
+            import sys
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            import xplane_top
+            planes = list(xplane_top.device_planes(trace_dir))
+        except ImportError:
+            # no tensorboard_plugin_profile -> host-only timeline
+            return
+        for plane_name, plane in planes:
+            pid = self._allocate_pid()
+            self._chrome.emit_pid('%s:%s' % (label, plane_name), pid)
+            for tid, line in enumerate(plane.lines):
+                for ev in line.events:
+                    name = plane.event_metadata[ev.metadata_id].name
+                    self._chrome.emit_region(
+                        ev.offset_ps / 1e6 + line.timestamp_ns / 1e3,
+                        ev.duration_ps / 1e6, pid, tid, 'device', name)
+
+    def generate_chrome_trace(self, pretty=False):
+        for label, prof in self._profiles.items():
+            self._emit_host(label, prof)
+            self._emit_device(label, prof)
+        return self._chrome.format_to_string(pretty)
+
+
+def parse_profile_paths(spec):
+    """'t1=f1,t2=f2' or a bare path -> {label: path}."""
+    if '=' not in spec:
+        return {'trainer': spec}
+    out = {}
+    for part in spec.split(','):
+        label, _, path = part.partition('=')
+        out[label] = path
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--profile_path', type=str, required=True,
+                    help='events.json path(s); multi-trainer form '
+                         'trainer1=file1,trainer2=file2')
+    ap.add_argument('--timeline_path', type=str, required=True)
+    args = ap.parse_args()
+    profiles = {}
+    for label, path in parse_profile_paths(args.profile_path).items():
+        with open(path) as f:
+            profiles[label] = json.load(f)
+    tl = Timeline(profiles)
+    with open(args.timeline_path, 'w') as f:
+        f.write(tl.generate_chrome_trace())
+    print('wrote %s' % args.timeline_path)
+
+
+if __name__ == '__main__':
+    main()
