@@ -194,6 +194,11 @@ class Master(object):
         self.store_path = store_path
         self._lock_fd = None
         self._events = 0
+        # monotone mutation counter: EVERY queue-state change bumps it
+        # (set_dataset, claims, finish/fail, new_pass, restore) — the
+        # replication door keys snapshot freshness on this, and keying
+        # on _events alone let set_dataset-only state slip past pull()
+        self._seq = 0
         if store_path:
             os.makedirs(store_path, exist_ok=True)
             self._acquire_lock()
@@ -201,6 +206,7 @@ class Master(object):
             if os.path.exists(snap):
                 with open(snap, 'rb') as f:
                     self._restore_blob(f.read())
+                self._seq += 1
 
     def _restore_blob(self, blob):
         """Restore from either engine's snapshot format: the native engine
@@ -263,6 +269,7 @@ class Master(object):
         recovered snapshot already holds tasks."""
         if sum(self._q.counts()[:3]) > 0:
             return
+        self._seq += 1
         for path in paths:
             n = 0
             scanner = native.RecordIOScanner(path)
@@ -285,6 +292,7 @@ class Master(object):
         tid, payload = self._q.get_task()
         if payload is None:
             return tid, None
+        self._seq += 1
         return tid, json.loads(payload.decode())
 
     # snapshot throttling: timeout-redispatch already tolerates a stale
@@ -294,10 +302,12 @@ class Master(object):
 
     def task_finished(self, tid):
         self._q.task_finished(tid)
+        self._seq += 1
         self._maybe_snapshot()
 
     def task_failed(self, tid):
         r = self._q.task_failed(tid)
+        self._seq += 1
         # a discard decision (failure cap reached) must be durable, or a
         # restarted master re-dispatches the poisoned task forever
         self._maybe_snapshot(force=(r == 1))
@@ -310,6 +320,7 @@ class Master(object):
 
     def new_pass(self):
         self._q.new_pass()
+        self._seq += 1
 
     def counts(self):
         """(todo, pending, done, discarded)"""
@@ -323,6 +334,78 @@ class Master(object):
         with open(tmp, 'wb') as f:
             f.write(self._q.snapshot())
         os.replace(tmp, snap)  # atomic like the etcd transactional put
+
+
+class SnapshotReplica(object):
+    """Cross-host snapshot replication through the TCP door (the
+    reference master survives host loss via etcd,
+    go/master/etcd_client.go:1; the flock+file store alone assumes a
+    shared filesystem).  A replica on ANOTHER base_dir mirrors the
+    primary's queue snapshots; after the primary host dies, a new
+    ``Master(store_path=replica_dir)`` restores from the last pulled
+    blob — same recovery path as a local restart.
+
+        rep = SnapshotReplica('host:port', '/other/fs/master_store')
+        rep.pull()            # one mirror now, or
+        rep.start(interval)   # background mirror thread
+    """
+
+    def __init__(self, endpoint, store_path):
+        self.endpoint = endpoint
+        self.store_path = store_path
+        os.makedirs(store_path, exist_ok=True)
+        self._seq = None
+        self._thread = None
+        self._stop = None
+        self.last_error = None
+        self.consecutive_failures = 0
+
+    def pull(self):
+        """Mirror the primary's current snapshot; returns True if a new
+        blob was written (seq advanced or first pull)."""
+        from .master_server import MasterClient
+        cli = MasterClient(self.endpoint)
+        try:
+            blob, seq = cli.fetch_snapshot()
+        finally:
+            cli.close()
+        if self._seq is not None and seq == self._seq:
+            return False
+        snap = os.path.join(self.store_path, 'master_snapshot.bin')
+        tmp = snap + '.tmp'
+        with open(tmp, 'wb') as f:
+            f.write(blob)
+        os.replace(tmp, snap)  # atomic, like the primary's own store
+        self._seq = seq
+        return True
+
+    def start(self, interval=1.0):
+        import threading
+        self._stop = threading.Event()
+
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.pull()
+                    self.last_error = None
+                    self.consecutive_failures = 0
+                except (ConnectionError, OSError, RuntimeError) as e:
+                    # transient blips (dropped TCP, one bad response)
+                    # must not kill mirroring for the rest of the run —
+                    # keep retrying until stop(); the caller can watch
+                    # consecutive_failures to alarm on a dead primary
+                    self.last_error = e
+                    self.consecutive_failures += 1
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        if self._stop is not None:
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
 
 
 def cloud_reader(master, pass_num=1, poll_interval=0.05):

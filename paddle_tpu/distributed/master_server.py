@@ -51,6 +51,14 @@ class _Handler(socketserver.StreamRequestHandler):
                 elif method == 'new_pass':
                     master.new_pass()
                     resp = {'ok': True}
+                elif method == 'snapshot':
+                    # replication door (go/master etcd_client.go analog):
+                    # a standby on ANOTHER filesystem mirrors the queue
+                    # state so master-host loss doesn't lose the pass
+                    import base64
+                    blob = master._q.snapshot()
+                    resp = {'blob': base64.b64encode(blob).decode(),
+                            'seq': getattr(master, '_seq', 0)}
                 else:
                     resp = {'error': 'unknown method %r' % method}
             except Exception as e:  # surface to the client, keep serving
@@ -123,6 +131,12 @@ class MasterClient(object):
 
     def new_pass(self):
         self._call(method='new_pass')
+
+    def fetch_snapshot(self):
+        """(blob_bytes, seq) of the master's current queue state."""
+        import base64
+        r = self._call(method='snapshot')
+        return base64.b64decode(r['blob']), r.get('seq', 0)
 
     def close(self):
         try:

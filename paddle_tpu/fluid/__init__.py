@@ -48,7 +48,8 @@ from .lod_tensor import create_lod_tensor, create_random_int_lodtensor
 from . import recordio_writer
 from . import transpiler
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, \
-    memory_optimize, release_memory, InferenceTranspiler
+    memory_optimize, release_memory, InferenceTranspiler, \
+    Float16Transpiler
 from . import evaluator
 from . import concurrency
 from . import amp

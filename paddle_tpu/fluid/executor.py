@@ -221,17 +221,36 @@ def feed_signature(feed_arrays):
 _SEQ_BUCKET = 16
 
 
+def _bucketed_len(max_len, bucket=_SEQ_BUCKET):
+    """Padded T for a batch whose longest row is ``max_len``.
+
+    Multiples of ``bucket`` up to 16*bucket (256 at the default), then
+    GEOMETRIC steps (x1.25, lane-aligned): a length-skewed corpus whose
+    tail reaches L distinct maxima must not mint O(L/bucket) distinct
+    shapes — each shape is one XLA compile and the LRU holds 64, so a
+    linear ladder past ~1024 recompiles forever (tests/
+    test_recompile_bound.py pins the ceiling this policy guarantees:
+    ≤ 16 + log1.25(L/256) buckets, 37 at L=64k; padding waste ≤ 25%)."""
+    linear_top = 16 * bucket
+    if max_len <= linear_top:
+        return max(((max_len + bucket - 1) // bucket) * bucket, bucket)
+    t = linear_top
+    while t < max_len:
+        t = ((t + (t >> 2)) + bucket - 1) // bucket * bucket
+    return t
+
+
 def _lod_to_padded(lt, bucket=_SEQ_BUCKET):
     """Concatenated LoD tensor -> (padded [B, T, ...], lengths [B]).
 
-    T is bucketed to a multiple of ``bucket`` so recompiles are bounded
-    (the static-shape answer to LoD's no-padding design, SURVEY §5.7)."""
+    T is bucketed so recompiles are bounded (the static-shape answer to
+    LoD's no-padding design, SURVEY §5.7; policy in _bucketed_len)."""
     data = lt.numpy()
     offsets = np.asarray(lt.lod()[-1], np.int64)
     lengths = (offsets[1:] - offsets[:-1]).astype(np.int32)
     b = len(lengths)
     max_len = int(lengths.max()) if b else 0
-    t = max(((max_len + bucket - 1) // bucket) * bucket, bucket)
+    t = _bucketed_len(max_len, bucket)
     out = np.zeros((b, t) + data.shape[1:], data.dtype)
     if b and len(data):
         # vectorized scatter: row i gets data[offsets[i]:offsets[i+1]]
@@ -464,6 +483,47 @@ class _CompiledBlock(object):
             scope.var(name).set_value(val)
         return fetches
 
+    def run_multi(self, scope, feed_values, rng_key, steps):
+        """K steps in ONE device dispatch: lax.fori_loop over the block
+        function, same feeds each iteration, per-iteration RNG via
+        fold_in.  The dispatch-latency amortizer for small steps (a
+        ~100ms tunnel round trip dwarfs a ~2ms LSTM step; reference
+        benchmarks loop on the host because each CUDA launch is ~µs)."""
+        import jax
+        if steps < 1:
+            raise ValueError('run_multi: steps must be >= 1, got %r'
+                             % (steps, ))
+        if any(_is_host_op(op) for op in self.ops):
+            raise RuntimeError(
+                'run_multi: the program contains host ops and cannot run '
+                'as one on-device loop — use run() per step')
+        state_rw, state_ro, feeds = self._materialize_args(scope,
+                                                           feed_values)
+        if not hasattr(self, '_multi_jit'):
+            fn = self._fn
+            rw_keys = list(self.state_rw)
+
+            def multi(state_rw, state_ro, feeds, rng, n):
+                def body(i, s):
+                    new_state, _ = fn(s, state_ro, feeds,
+                                      jax.random.fold_in(rng, i))
+                    return {k: new_state.get(k, s[k]) for k in rw_keys}
+
+                final = jax.lax.fori_loop(0, n - 1, body, state_rw)
+                # last step outside the loop so fetches come out
+                new_state, fetches = fn(final, state_ro, feeds,
+                                        jax.random.fold_in(rng, n - 1))
+                return new_state, fetches
+
+            self._multi_jit = jax.jit(
+                multi, static_argnums=(4, ),
+                donate_argnums=(0, ) if self.state_rw else ())
+        new_state, fetches = self._multi_jit(state_rw, state_ro, feeds,
+                                             rng_key, int(steps))
+        for name, val in new_state.items():
+            scope.var(name).set_value(val)
+        return fetches
+
 
 class Executor(object):
     """Program runner (reference executor.py:256 / executor.cc:125)."""
@@ -476,6 +536,10 @@ class Executor(object):
         self._cache = collections.OrderedDict()
         self._rng = None
         self._closed = False
+        # observability: compiles are the static-shape design's recompile
+        # cost (vs the reference's LoD no-padding design) — each cache
+        # miss below is one XLA compile; tests pin bounds on this
+        self.compile_count = 0
 
     def _next_rng(self, program):
         # Keys are built HOST-side as raw uint32[2] threefry keys — a
@@ -568,6 +632,7 @@ class Executor(object):
         self._pin_cache_lifetime(scope)
         compiled = self._cache.get(key)
         if compiled is None:
+            self.compile_count += 1
             compiled = _CompiledBlock(program, 0, [n for n, _, _ in sig],
                                       fetch_names, self.place, scope)
             self._cache[key] = compiled
@@ -654,6 +719,33 @@ class Executor(object):
                 (_time.perf_counter() - t0) * 1e3, len(fetches))
         else:
             fetches = compiled.run(scope, feed_arrays, rng, eager=eager)
+        return self._convert_fetches(fetches, return_numpy)
+
+    def run_multi(self,
+                  program=None,
+                  feed=None,
+                  fetch_list=None,
+                  steps=1,
+                  scope=None,
+                  return_numpy=True):
+        """Run ``steps`` iterations of the program as ONE device
+        dispatch (lax.fori_loop over the compiled block; same feed every
+        iteration, fresh RNG stream per iteration).  Returns the LAST
+        iteration's fetches.  For dispatch-bound small steps — e.g. the
+        stacked-LSTM benchmark where a ~2ms step rides a ~100ms tunnel
+        round trip — this makes the wall clock measure the chip.
+        Training state updates persist to the scope exactly as ``steps``
+        sequential run() calls would."""
+        program, scope, feed_arrays, compiled = self._resolve_and_compile(
+            program, feed, fetch_list, scope)
+        rng = self._next_rng(program)
+        # each distinct `steps` value is its own XLA compile (static arg)
+        seen = getattr(compiled, '_multi_steps_seen', set())
+        if int(steps) not in seen:
+            seen.add(int(steps))
+            compiled._multi_steps_seen = seen
+            self.compile_count += 1
+        fetches = compiled.run_multi(scope, feed_arrays, rng, steps)
         return self._convert_fetches(fetches, return_numpy)
 
     def _convert_fetches(self, fetches, return_numpy):

@@ -5,9 +5,11 @@ from .distribute_transpiler import DistributeTranspiler, \
     DistributeTranspilerConfig
 from .memory_optimization_transpiler import memory_optimize, release_memory
 from .inference_transpiler import InferenceTranspiler
+from .float16_transpiler import Float16Transpiler
 from .ps_dispatcher import HashName, RoundRobin
 
 __all__ = [
     'DistributeTranspiler', 'DistributeTranspilerConfig', 'memory_optimize',
-    'release_memory', 'InferenceTranspiler', 'HashName', 'RoundRobin',
+    'release_memory', 'InferenceTranspiler', 'Float16Transpiler',
+    'HashName', 'RoundRobin',
 ]

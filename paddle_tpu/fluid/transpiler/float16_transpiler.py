@@ -1,0 +1,165 @@
+"""Float16Transpiler (reference: paddle/contrib/float16/float16_transpiler.py:21).
+
+Rewrites a saved (f32) inference program to run in half precision: every
+f32 parameter in the scope is cast to the half dtype under a ``.fp16``
+name, feed targets gain a cast-in op, fetch targets a cast-back-to-f32
+op, and op inputs are renamed — so callers keep feeding/fetching f32
+exactly as before while the compute graph runs half precision end to
+end.
+
+TPU-native default is **bfloat16** (the MXU's native half type — fp16
+on TPU pays a convert at every matmul); ``dtype='float16'`` gives the
+reference's CUDA-oriented behavior.  Run ``InferenceTranspiler`` (BN
+fold) first, as the reference's float16_benchmark.md flow does; any
+surviving batch_norm keeps f32 inputs (the reference's exclusion list).
+"""
+
+import numpy as np
+
+from .. import core
+from ..executor import global_scope
+from ..framework import Operator
+
+__all__ = ['Float16Transpiler']
+
+_HALF_SUFFIX = '.fp16'
+
+
+class Float16Transpiler(object):
+    def transpile(self, program, place=None, scope=None, dtype='bfloat16',
+                  feeded_var_names=None, fetch_var_names=None):
+        """In-place program rewrite + scope param conversion.
+
+        feeded_var_names / fetch_var_names: required when the program
+        was loaded through this repo's load_inference_model (which
+        strips the embedded feed/fetch ops and returns the names);
+        programs still carrying feed/fetch ops need neither."""
+        if scope is None:
+            scope = global_scope()
+        if dtype in ('bfloat16', 'bf16'):
+            self._half = core.convert_dtype_to_np('bfloat16')
+        elif dtype in ('float16', 'fp16'):
+            self._half = np.dtype(np.float16)
+        else:
+            raise ValueError('half dtype must be bfloat16 or float16, '
+                             'got %r' % (dtype,))
+        self.scope = scope
+        self.block = program.global_block()
+        self.input_map = {}
+
+        def _name(v):  # load_inference_model returns fetch Variables
+            return v.name if hasattr(v, 'name') else str(v)
+
+        feeds = [_name(v) for v in (feeded_var_names or [])]
+        fetches = [_name(v) for v in (fetch_var_names or [])]
+        for op in self.block.ops:
+            if op.type == 'feed':
+                feeds.append(op.output('Out')[0])
+            elif op.type == 'fetch':
+                fetches.append(op.input('X')[0])
+
+        self._convert_params()
+        self._cast_feeds(feeds)
+        self._cast_fetches(fetches)
+        self._adjust_input()
+        self._remove_unused_vars()
+        program._bump_version()
+        return program
+
+    # -- private ----------------------------------------------------------
+
+    def _no_conversion_names(self):
+        """batch_norm requires f32 statistics even in half mode — the
+        reference's only exclusion (float16_transpiler.py:204)."""
+        names = set()
+        for op in self.block.ops:
+            if op.type == 'batch_norm':
+                names.update(op.input_arg_names)
+        return names
+
+    def _scope_np(self, name):
+        var = self.scope.find_var(name)
+        if var is None or var.value() is None:
+            return None
+        val = var.value()
+        return val.numpy() if isinstance(val, core.LoDTensor) else \
+            np.asarray(val)
+
+    def _convert_params(self):
+        no_convert = self._no_conversion_names()
+        for name in list(self.block.vars):
+            var = self.block.vars[name]
+            if not getattr(var, 'persistable', False) \
+                    or name in no_convert:
+                continue
+            value = self._scope_np(name)
+            if value is None or value.dtype != np.float32:
+                continue
+            half_name = name + _HALF_SUFFIX
+            self.block.create_var(name=half_name, shape=var.shape,
+                                  dtype=self._half, persistable=True)
+            self.scope.var(half_name).set_value(value.astype(self._half))
+            self.input_map[name] = half_name
+            del self.block.vars[name]
+
+    def _cast_feeds(self, feeds):
+        for name in dict.fromkeys(feeds):
+            var = self.block.vars.get(name)
+            if var is None or var.np_dtype != np.float32:
+                continue  # int id feeds stay integral
+            half_name = name + _HALF_SUFFIX
+            half_var = self.block.create_var(
+                name=half_name, shape=var.shape, dtype=self._half,
+                persistable=False)
+            # right after the feed op when embedded, else program start
+            pos = 0
+            for i, op in enumerate(self.block.ops):
+                if op.type == 'feed' and op.output('Out')[0] == name:
+                    pos = i + 1
+                    break
+            self.block._insert_op(
+                pos, type='cast', inputs={'X': [name]},
+                outputs={'Out': [half_name]},
+                attrs={'in_dtype': var.dtype, 'out_dtype': half_var.dtype})
+            self.input_map[name] = half_name
+
+    def _cast_fetches(self, fetches):
+        for name in dict.fromkeys(fetches):
+            var = self.block.vars.get(name)
+            if var is None or var.np_dtype != np.float32:
+                continue
+            half_name = name + _HALF_SUFFIX
+            half_var = self.block.create_var(
+                name=half_name, shape=var.shape, dtype=self._half,
+                persistable=False)
+            producer = None
+            for i, op in enumerate(self.block.ops):
+                if name in op.output_arg_names and op.type != 'cast':
+                    producer = i
+            if producer is None:
+                continue
+            self.block.ops[producer].rename_output(name, half_name)
+            # immediately after the producer so later consumers (incl.
+            # an embedded fetch op) still read a written f32 var
+            self.block._insert_op(
+                producer + 1, type='cast', inputs={'X': [half_name]},
+                outputs={'Out': [name]},
+                attrs={'in_dtype': half_var.dtype, 'out_dtype': var.dtype})
+
+    def _adjust_input(self):
+        for op in self.block.ops:
+            if op.type == 'cast':
+                continue  # the inserted casts must keep their f32 inputs
+            for arg in list(op.input_arg_names):
+                if arg in self.input_map:
+                    op.rename_input(arg, self.input_map[arg])
+
+    def _remove_unused_vars(self):
+        used = set()
+        for op in self.block.ops:
+            used.update(op.input_arg_names)
+            used.update(op.output_arg_names)
+        for name in list(self.block.vars):
+            var = self.block.vars[name]
+            if name not in used and not getattr(var, 'persistable', False):
+                del self.block.vars[name]

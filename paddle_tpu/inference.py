@@ -39,30 +39,52 @@ class NativeConfig(object):
                  prog_file=None,
                  param_file=None,
                  use_tpu=True,
-                 device=0):
+                 device=0,
+                 half_precision=None):
         self.model_dir = model_dir
         self.prog_file = prog_file
         self.param_file = param_file
         self.use_tpu = use_tpu
         self.device = device
+        # 'bfloat16' (TPU-native) or 'float16': run the loaded program
+        # through InferenceTranspiler (BN fold) + Float16Transpiler so
+        # the graph computes in half precision while feeds/fetches stay
+        # f32 (reference contrib/float16 flow)
+        self.half_precision = half_precision
 
 
 class PaddlePredictor(object):
     """(reference paddle_inference_api.h:90 / NativePaddlePredictor)"""
 
-    def __init__(self, config, _shared_scope=None):
+    def __init__(self, config, _shared_scope=None, _shared_model=None):
         self._config = config
         place = fluid.TPUPlace(config.device) if config.use_tpu and \
             core.is_compiled_with_tpu() else fluid.CPUPlace()
         self._exe = fluid.Executor(place)
         self._scope = _shared_scope or core.Scope()
         with fluid.scope_guard(self._scope):
+            if _shared_model is not None:
+                # clone: share the (possibly transpiled) program — the
+                # BN-fold scope rewrite is not idempotent, so a clone
+                # must never reload + re-transpile against the shared
+                # scope
+                (self._program, self._feed_names,
+                 self._fetch_targets) = _shared_model
+                return
             (self._program, self._feed_names,
              self._fetch_targets) = fluid.io.load_inference_model(
                  config.model_dir,
                  self._exe,
                  model_filename=config.prog_file,
                  params_filename=config.param_file)
+            if getattr(config, 'half_precision', None):
+                fluid.InferenceTranspiler().transpile(
+                    self._program, scope=self._scope)
+                fluid.Float16Transpiler().transpile(
+                    self._program, scope=self._scope,
+                    dtype=config.half_precision,
+                    feeded_var_names=self._feed_names,
+                    fetch_var_names=self._fetch_targets)
 
     @property
     def feed_names(self):
@@ -97,7 +119,10 @@ class PaddlePredictor(object):
 
     def clone(self):
         """New predictor sharing weights (reference Run/Clone contract)."""
-        return PaddlePredictor(self._config, _shared_scope=self._scope)
+        return PaddlePredictor(
+            self._config, _shared_scope=self._scope,
+            _shared_model=(self._program, self._feed_names,
+                           self._fetch_targets))
 
 
 def create_paddle_predictor(config):

@@ -87,10 +87,14 @@ def _softmax_with_cross_entropy(ctx, op):
         ctx.set(op, 'Softmax', softmax)
         ctx.set(op, 'Loss', loss)
         return
-    # f32 path (and soft labels): plain composition, f32 throughout
+    # f32 path (and soft labels): plain composition, f32 throughout.
+    # Softmax is an Intermediate output in the reference op (its grad
+    # kernel never consumes a Softmax cotangent) and the bf16 fast path
+    # above can't see one either — stop_gradient keeps the two paths'
+    # autodiff semantics identical (ADVICE r4 #1)
     logits = amp_upcast_f32(raw)
     log_p = jax.nn.log_softmax(logits, axis=-1)
-    softmax = jnp.exp(log_p)
+    softmax = jax.lax.stop_gradient(jnp.exp(log_p))
     if op.attrs.get('soft_label', False):
         loss = -jnp.sum(label * log_p, axis=-1, keepdims=True)
     else:

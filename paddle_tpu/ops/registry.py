@@ -463,15 +463,14 @@ def amp_harmonize(x, y):
 
 
 def amp_matmul(x, y):
-    """The one home of the AMP matmul policy: bf16 operands with fp32
-    accumulation (preferred_element_type) when AMP is on, and the
-    result LANDS bf16 (amp_cast_out) — the epilogue cast fuses into the
-    matmul, so fc activations cross HBM at half width like conv
-    activations do."""
+    """The one home of the AMP matmul policy: bf16 operands, bf16
+    result.  The TPU MXU accumulates bf16 products in fp32 internally
+    regardless of the requested output dtype, so a bf16 output is
+    bit-identical to preferred_element_type=f32 followed by a bf16
+    cast — but WITHOUT the f32 intermediate: asking for f32 made every
+    cotangent in the backward pass f32, which re-widened all gradient
+    matmuls and their HBM traffic (r5 transformer A/B: the pure-JAX
+    bound emitting bf16 ran the same matmuls ~45% faster end to end)."""
     import jax.numpy as jnp
     x, y = amp_cast_in(x, y)
-    return amp_cast_out(
-        jnp.matmul(
-            x, y,
-            preferred_element_type=jnp.float32
-            if (_AMP['enabled'] and x.dtype == jnp.bfloat16) else None))
+    return amp_cast_out(jnp.matmul(x, y))

@@ -86,15 +86,29 @@ def _block_mask(q_pos, k_pos, causal, batch_lens):
 
 def dense_attention(q, k, v, causal=False, scale=None, seq_lengths=None):
     """Single-device reference: softmax(QK^T * scale [+mask]) V.
-    q,k,v: [B,L,H,D]; seq_lengths: [B] optional valid K/V lengths."""
+    q,k,v: [B,L,H,D]; seq_lengths: [B] optional valid K/V lengths.
+
+    One-shot softmax, NOT the blockwise m/l/merge form the ring path
+    uses: on a single device the online-softmax machinery costs real
+    HBM traffic (f32 [B,L,H,D] numerator + l transposes + the final
+    divide measured ~4ms/step of layout copies on the r5 transformer
+    A/B trace) and buys nothing — there are no blocks to merge."""
     scale = scale if scale is not None else q.shape[-1]**-0.5
     lq, lk = q.shape[1], k.shape[1]
     mask = _block_mask(
         jnp.arange(lq), jnp.arange(lk), causal,
         None if seq_lengths is None else jnp.asarray(seq_lengths))
-    m, l, acc = _attend_block(q, k, v, scale, mask)
-    l = jnp.transpose(l, (0, 2, 1))[..., None]
-    return acc / jnp.maximum(l, 1e-20)
+    # scores/softmax in f32 (bf16 exp/sum across thousands of columns
+    # drifts); the probability matrix re-narrows to v's dtype so the
+    # PV matmul and its [B,L,H,D] output stay half-width under AMP
+    s = jnp.einsum('bqhd,bkhd->bhqk', q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)  # all-masked rows: 0, not 1/Lk
+    return jnp.einsum('bhqk,bkhd->bqhd', p.astype(v.dtype), v)
 
 
 def _ring_local(q, k, v, lens, axis_name, n_steps, causal, scale):

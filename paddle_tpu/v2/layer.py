@@ -528,6 +528,19 @@ def gru_like(input, size, name=None, reverse=False, param_attr=None,
                 'gate width %r (reference layers.py:1605 contract)' %
                 (int(v.shape[-1]), size * 3))
         if project is True or (project is None and not is_gate_width):
+            if project is None:
+                # the reference grumemory FATALS here (input.size must be
+                # 3*size, layers.py:1605); auto-projecting keeps lenient
+                # configs training but must not do so silently — a
+                # mis-wired width now trains a different architecture
+                # (ADVICE r4 #2)
+                import warnings
+                warnings.warn(
+                    'grumemory: input width %d != 3*size (%d); inserting '
+                    'a learned gate projection the reference would '
+                    'reject. Pass project=True to silence, or '
+                    'project=False for the strict reference contract.'
+                    % (int(v.shape[-1]), size * 3), stacklevel=2)
             v = fluid.layers.fc(v, size=size * 3)
         return fluid.layers.dynamic_gru(v, size=size,
                                         is_reverse=reverse,

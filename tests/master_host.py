@@ -1,0 +1,33 @@
+"""Master host process for the cross-host failover test
+(tests/test_master_failover.py): owns a Master + MasterServer, prints
+its endpoint as one JSON line, then serves until killed.
+
+Env: STORE_DIR, DATA_PATH, RECORDS_PER_TASK, CHUNK_TIMEOUT."""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from paddle_tpu.distributed import Master, MasterServer
+
+    master = Master(store_path=os.environ['STORE_DIR'],
+                    chunk_timeout_secs=float(
+                        os.environ.get('CHUNK_TIMEOUT', '60')),
+                    failure_max=3)
+    master.set_dataset([os.environ['DATA_PATH']],
+                       records_per_task=int(
+                           os.environ.get('RECORDS_PER_TASK', '4')))
+    server = MasterServer(master)
+    print(json.dumps({'endpoint': server.endpoint,
+                      'counts': list(master.counts())}), flush=True)
+    while True:  # killed by the test (SIGKILL — host loss)
+        time.sleep(0.2)
+
+
+if __name__ == '__main__':
+    main()

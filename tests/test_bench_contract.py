@@ -14,6 +14,7 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, 'bench.py')
+sys.path.insert(0, REPO)  # for `from bench import CONFIGS` (no jax)
 
 CONTRACT_KEYS = {'metric', 'value', 'unit', 'vs_baseline'}
 
@@ -35,16 +36,18 @@ def test_every_config_flushes_and_timeouts_are_isolated():
     partial file, and exits on its own (no external timeout needed)."""
     proc = _run_bench({'BENCH_BUDGET': '3', 'BENCH_FORCE_CPU': '1'}, 120)
     lines = [json.loads(l) for l in proc.stdout.decode().splitlines() if l]
-    # 3 incremental lines + 1 final (the last config's completion IS the
-    # final record — no duplicate emission)
-    assert len(lines) == 4, proc.stdout
-    assert [r['partial'] for r in lines] == [True, True, True, False]
+    # N-1 incremental lines + 1 final (the last config's completion IS
+    # the final record — no duplicate emission)
+    from bench import CONFIGS
+    n = len(CONFIGS)
+    assert len(lines) == n, proc.stdout
+    assert [r['partial'] for r in lines] == [True] * (n - 1) + [False]
     for rec in lines:
         assert CONTRACT_KEYS <= set(rec), rec
         assert 'configs' in rec and 'partial' in rec
     final = lines[-1]
     assert final['partial'] is False
-    assert len(final['configs']) == 4
+    assert len(final['configs']) == n
     # every config carries an isolated TIMEOUT record, not a crash
     for cfg in final['configs']:
         assert cfg['metric'].endswith('_TIMEOUT'), cfg
@@ -88,4 +91,7 @@ def test_single_config_child_runs_cpu():
     assert proc.returncode == 0, proc.stderr[-500:]
     rec = json.loads(proc.stdout.decode().strip().splitlines()[-1])
     assert rec['value'] > 0
-    assert rec['dispatch_bound'] is True
+    # headline is device-true (run_multi); the tunnel-bound number rides
+    # along as a secondary field
+    assert rec['device_true'] is True
+    assert rec['tokens_per_sec_dispatch_bound'] > 0

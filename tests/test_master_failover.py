@@ -1,0 +1,130 @@
+"""Cross-host master failover via TCP snapshot replication
+(VERDICT r4 next-#8; reference survives master-host loss through etcd,
+go/master/etcd_client.go:1).  The primary master is a real subprocess
+on store A; a SnapshotReplica mirrors its queue into store B over the
+TCP door; the primary is SIGKILLed; a new master constructed on store B
+recovers the pass — finished work stays finished, in-flight work is
+re-dispatched, nothing is lost."""
+
+import json
+import os
+import pickle
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+from paddle_tpu.distributed import Master, MasterClient
+from paddle_tpu.distributed.master import SnapshotReplica
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HOST = os.path.join(REPO, 'tests', 'master_host.py')
+
+RECORDS_PER_TASK = 4
+N_TASKS = 6
+
+
+def _write_dataset(path):
+    from paddle_tpu.runtime.native import RecordIOWriter
+    rng = np.random.RandomState(0)
+    w = RecordIOWriter(path)
+    for _ in range(RECORDS_PER_TASK * N_TASKS):
+        w.write(pickle.dumps(rng.standard_normal(4).astype('float32')))
+    w.close()
+
+
+def _drain(master_like, stop_after=None):
+    """Claim+finish tasks; returns the (path,start) ranges completed."""
+    ranges = []
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        tid, task = master_like.get_task()
+        if task is None:
+            if tid == -1:
+                break  # pass finished
+            time.sleep(0.05)
+            continue
+        ranges.append((task['path'], task['start']))
+        master_like.task_finished(tid)
+        if stop_after and len(ranges) >= stop_after:
+            break
+    return ranges
+
+
+def test_failover_restores_from_replicated_snapshot(tmp_path):
+    data = str(tmp_path / 'train.recordio')
+    _write_dataset(data)
+    store_a = str(tmp_path / 'host_a' / 'store')
+    store_b = str(tmp_path / 'host_b' / 'store')
+
+    env = dict(os.environ)
+    env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
+    env.update(STORE_DIR=store_a, DATA_PATH=data,
+               RECORDS_PER_TASK=str(RECORDS_PER_TASK),
+               CHUNK_TIMEOUT='1.5')
+    proc = subprocess.Popen([sys.executable, HOST], env=env,
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        hello = json.loads(proc.stdout.readline())
+        endpoint = hello['endpoint']
+        assert hello['counts'][0] == N_TASKS
+
+        cli = MasterClient(endpoint)
+        done_before = _drain(cli, stop_after=2)
+        assert len(done_before) == 2
+        # leave one task CLAIMED but unfinished (in flight at the crash)
+        tid_inflight, task_inflight = cli.get_task()
+        assert task_inflight is not None
+
+        replica = SnapshotReplica(endpoint, store_b)
+        assert replica.pull() is True
+        cli.close()
+    finally:
+        # host loss: no clean shutdown, no final snapshot flush on A
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
+
+    # new master on host B's filesystem — store A is gone with its host
+    m2 = Master(store_path=store_b, chunk_timeout_secs=1.5, failure_max=3)
+    try:
+        todo, pending, done, discarded = m2.counts()
+        assert done == 2          # finished work survived the failover
+        assert discarded == 0
+        # the in-flight claim was replicated as re-dispatchable todo
+        assert todo == N_TASKS - 2 and pending == 0
+        done_after = _drain(m2)
+        covered = set(done_before) | set(done_after)
+        starts = {s for _, s in covered}
+        assert starts == {i * RECORDS_PER_TASK for i in range(N_TASKS)}
+        # no double-completion either: finished tasks were not re-run
+        assert len(done_after) == N_TASKS - 2
+    finally:
+        m2.close()
+
+
+def test_replica_background_thread_and_seq_skip(tmp_path):
+    data = str(tmp_path / 'train.recordio')
+    _write_dataset(data)
+    from paddle_tpu.distributed import MasterServer
+    primary = Master(store_path=str(tmp_path / 'a'),
+                     chunk_timeout_secs=30, failure_max=3)
+    server = MasterServer(primary)
+    try:
+        primary.set_dataset([data], records_per_task=RECORDS_PER_TASK)
+        replica = SnapshotReplica(server.endpoint, str(tmp_path / 'b'))
+        assert replica.pull() is True
+        assert replica.pull() is False  # unchanged seq -> no rewrite
+        tid, _ = primary.get_task()
+        primary.task_finished(tid)
+        assert replica.pull() is True   # seq advanced
+        replica.start(interval=0.05)
+        time.sleep(0.3)
+        replica.stop()
+        m2 = Master(store_path=str(tmp_path / 'b'))
+        assert m2.counts()[2] == 1
+        m2.close()
+    finally:
+        server.close()
+        primary.close()

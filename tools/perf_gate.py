@@ -1,10 +1,16 @@
-"""Perf-regression gate (VERDICT r3 next-#8): the framework's ResNet-50
-training step vs the independent pure-JAX bound (tools/jax_resnet_bound.py)
-in ONE process, so per-session throughput drift cancels in the ratio.
-The invariant MFU_BOUND_r03.json established: framework/bound >= 1.0
-(the whole-program XLA compile must not cost throughput vs hand-rolled
-JAX).  Prints one JSON line; run on TPU hardware — tests/test_perf_gate.py
-drives it and skips cleanly off-TPU.
+"""Perf-regression gate: framework vs independent pure-JAX bound, in ONE
+process with INTERLEAVED timing blocks, for all three compute-bound
+bench configs (VERDICT r4 next-#3; r3 next-#8 established the pattern
+for ResNet).
+
+Invariant per config: the whole-program XLA compile must not cost
+throughput vs hand-rolled JAX — gated on the MAX of PER-BLOCK ratios
+(each comparison shares a drift window; ADVICE r4 #3 killed the old
+max(fw)/max(bd) cross-window pairing).
+
+Run on TPU hardware:  python tools/perf_gate.py [resnet|transformer|nmt|all]
+Prints one JSON line per config; tests/test_perf_gate.py drives it and
+skips cleanly off-TPU.
 """
 
 import json
@@ -15,90 +21,171 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-BATCH = int(os.environ.get('PERF_GATE_BATCH', '256'))
 STEPS = int(os.environ.get('PERF_GATE_STEPS', '10'))
-
-
 BLOCKS = int(os.environ.get('PERF_GATE_BLOCKS', '3'))
 
+# bs512 resnet / bs128 transformer don't co-reside with their bound's
+# params+Adam state+activations on one 16GB chip; half batch keeps the
+# ratio meaningful (both sides at the same operating point)
+RESNET_BATCH = int(os.environ.get('PERF_GATE_BATCH', '256'))
+TF_BATCH = int(os.environ.get('PERF_GATE_TF_BATCH', '64'))
+NMT_BATCH = int(os.environ.get('PERF_GATE_NMT_BATCH', '256'))
 
-def build_bound():
-    """Compile + warm the pure-JAX bound; returns a timed-block closure.
-    Interleaved with the framework's blocks in main() so minute-scale
-    tunnel drift (±30%, round-4 measurement discipline) hits both sides
-    alike instead of whichever ran second."""
-    import functools
-    import jax
-    import jax.numpy as jnp
+
+def _fw_timed_block(model, feed, loss_var, per_step_items):
+    """Compile+warm a framework step; returns a timed-block closure."""
     import numpy as np
+    import paddle_tpu.fluid as fluid
+    place = fluid.TPUPlace()
+    exe = fluid.Executor(place)
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope), fluid.amp_guard(True):
+        exe.run(model['startup'])
+        for _ in range(2):
+            exe.run(model['main'], feed=feed, fetch_list=[loss_var])
+            exe.run(model['main'], feed=feed, fetch_list=[])
+
+    def timed_block(steps=STEPS):
+        with fluid.scope_guard(scope), fluid.amp_guard(True):
+            t0 = time.time()
+            for _ in range(steps - 1):
+                exe.run(model['main'], feed=feed, fetch_list=[])
+            loss_v, = exe.run(model['main'], feed=feed,
+                              fetch_list=[loss_var])
+            elapsed = time.time() - t0
+        assert np.isfinite(np.asarray(loss_v)).all()
+        return per_step_items * steps / elapsed
+
+    return timed_block
+
+
+def build_resnet():
+    import jax
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import resnet
+    import functools
+    import jax.numpy as jnp
     import jax_resnet_bound as bound
 
-    dev = jax.devices()[0]
-    state = {}
+    model = resnet.build(depth=50, class_dim=1000,
+                         image_shape=(3, 224, 224), lr=0.1)
+    rng = np.random.RandomState(0)
+    dev = fluid.TPUPlace().jax_device()
+    feed = {
+        'img': jax.device_put(
+            rng.standard_normal(
+                (RESNET_BATCH, 3, 224, 224)).astype('float32'), dev),
+        'label': jax.device_put(
+            rng.randint(0, 1000, size=(RESNET_BATCH, 1)).astype('int64'),
+            dev),
+    }
+    fw = _fw_timed_block(model, feed, model['loss'], RESNET_BATCH)
+
     params = bound.make_params(jax.random.PRNGKey(0), 'NCHW')
     vel = [{k: jnp.zeros_like(v) for k, v in p.items()} for p in params]
-    state['params'] = jax.device_put(params, dev)
-    state['vel'] = jax.device_put(vel, dev)
-    rng = np.random.RandomState(0)
+    state = {'params': jax.device_put(params, dev),
+             'vel': jax.device_put(vel, dev)}
     x = jax.device_put(jnp.asarray(
-        rng.standard_normal((BATCH, 3, 224, 224)), jnp.float32), dev)
+        rng.standard_normal((RESNET_BATCH, 3, 224, 224)), jnp.float32), dev)
     label = jax.device_put(
-        rng.randint(0, 1000, size=(BATCH, )).astype(np.int32), dev)
+        rng.randint(0, 1000, size=(RESNET_BATCH, )).astype(np.int32), dev)
     step = functools.partial(bound.train_step, layout='NCHW', remat=False)
     for _ in range(2):
         state['params'], state['vel'], loss = step(
             state['params'], state['vel'], x, label)
     float(loss)  # fetch drains (axon block_until_ready does not)
 
-    def timed_block():
+    def bd(steps=STEPS):
         t0 = time.time()
-        for _ in range(STEPS):
+        for _ in range(steps):
             state['params'], state['vel'], loss = step(
                 state['params'], state['vel'], x, label)
         float(loss)
-        return BATCH * STEPS / (time.time() - t0)
+        return RESNET_BATCH * steps / (time.time() - t0)
 
-    return timed_block
+    return fw, bd
 
 
-def build_framework():
+def build_transformer():
     import jax
     import numpy as np
     import paddle_tpu.fluid as fluid
-    from paddle_tpu.models import resnet
+    from paddle_tpu.models import transformer
+    import jax_transformer_bound as bound
 
-    model = resnet.build(depth=50, class_dim=1000,
-                         image_shape=(3, 224, 224), lr=0.1)
-    place = fluid.TPUPlace()
-    exe = fluid.Executor(place)
-    scope = fluid.core.Scope()
+    seq = 256
+    model = transformer.build(src_vocab=30000, trg_vocab=30000,
+                              max_len=seq, n_layer=6, n_head=8,
+                              d_model=512, d_ff=2048)
     rng = np.random.RandomState(0)
-    dev = place.jax_device()
-    feed = {
-        'img': jax.device_put(
-            rng.standard_normal((BATCH, 3, 224, 224)).astype('float32'),
-            dev),
-        'label': jax.device_put(
-            rng.randint(0, 1000, size=(BATCH, 1)).astype('int64'), dev),
+    dev = fluid.TPUPlace().jax_device()
+    ids = lambda: jax.device_put(
+        rng.randint(1, 30000, size=(TF_BATCH, seq)).astype('int64'), dev)
+    feed = {'src_ids': ids(), 'trg_ids': ids(), 'lbl_ids': ids()}
+    fw = _fw_timed_block(model, feed, model['loss'], TF_BATCH * seq)
+    _, bd = bound.build(attn_impl='dense', batch=TF_BATCH, seq=seq)
+    return fw, (lambda steps=STEPS: bd(steps))
+
+
+def build_nmt():
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import seq2seq
+    import jax_nmt_bound as bound
+
+    seq = 32
+    model = seq2seq.build(src_dict_dim=30000, trg_dict_dim=30000,
+                          embedding_dim=512, encoder_size=512,
+                          decoder_size=512)
+    rng = np.random.RandomState(0)
+
+    def lod(rows):
+        return fluid.create_lod_tensor(rows, [[len(r) for r in rows]])
+
+    src = [rng.randint(3, 30000, size=(seq, 1)).tolist()
+           for _ in range(NMT_BATCH)]
+    trg = [rng.randint(3, 30000, size=(seq, 1)).tolist()
+           for _ in range(NMT_BATCH)]
+    feed = {'src_word_id': lod(src), 'target_language_word': lod(trg),
+            'target_language_next_word': lod(trg)}
+    fw = _fw_timed_block(model, feed, model['loss'], NMT_BATCH * seq)
+    _, bd = bound.build(batch=NMT_BATCH, seq=seq)
+    return fw, (lambda steps=STEPS: bd(steps))
+
+
+CONFIGS = {
+    'resnet': (build_resnet, 'imgs_per_sec'),
+    'transformer': (build_transformer, 'tokens_per_sec'),
+    'nmt': (build_nmt, 'tokens_per_sec'),
+}
+
+
+def run_config(name):
+    build, unit = CONFIGS[name]
+    # both sides compiled first, then INTERLEAVED blocks: a drift window
+    # between two monolithic measurements would otherwise decide the
+    # hard gate, not the build under test
+    fw_block, bd_block = build()
+    fw, bd = [], []
+    for _ in range(BLOCKS):
+        fw.append(fw_block())
+        bd.append(bd_block())
+    ratios = [f / b for f, b in zip(fw, bd)]
+    rec = {
+        'config': name,
+        'framework_' + unit: round(max(fw), 1),
+        'bound_' + unit: round(max(bd), 1),
+        'framework_blocks': [round(v, 1) for v in fw],
+        'bound_blocks': [round(v, 1) for v in bd],
+        'ratios': [round(r, 4) for r in ratios],
+        # gate statistic: best per-block ratio — each block pair shares
+        # a drift window, so no cross-window flattery (ADVICE r4 #3)
+        'ratio': round(max(ratios), 4),
+        'steps': STEPS, 'blocks': BLOCKS,
     }
-    with fluid.scope_guard(scope), fluid.amp_guard(True):
-        exe.run(model['startup'])
-        for _ in range(2):
-            exe.run(model['main'], feed=feed, fetch_list=[model['loss']])
-            exe.run(model['main'], feed=feed, fetch_list=[])
-
-    def timed_block():
-        with fluid.scope_guard(scope), fluid.amp_guard(True):
-            t0 = time.time()
-            for _ in range(STEPS - 1):
-                exe.run(model['main'], feed=feed, fetch_list=[])
-            loss_v, = exe.run(model['main'], feed=feed,
-                              fetch_list=[model['loss']])
-            elapsed = time.time() - t0
-        assert np.isfinite(np.asarray(loss_v)).all()
-        return BATCH * STEPS / elapsed
-
-    return timed_block
+    print(json.dumps(rec), flush=True)
+    return rec
 
 
 def main():
@@ -107,24 +194,10 @@ def main():
     if backend not in ('tpu', 'axon'):
         print(json.dumps({'skip': 'no TPU backend (%s)' % backend}))
         return
-    # both sides compiled first, then INTERLEAVED best-of-N blocks:
-    # a drift window between two monolithic measurements would otherwise
-    # decide the hard ratio>=1.0 gate, not the build under test
-    fw_block = build_framework()
-    bd_block = build_bound()
-    fw, bd = [], []
-    for _ in range(BLOCKS):
-        fw.append(fw_block())
-        bd.append(bd_block())
-    framework, bound = max(fw), max(bd)
-    print(json.dumps({
-        'framework_imgs_per_sec': round(framework, 1),
-        'bound_imgs_per_sec': round(bound, 1),
-        'framework_blocks': [round(v, 1) for v in fw],
-        'bound_blocks': [round(v, 1) for v in bd],
-        'ratio': round(framework / bound, 4),
-        'batch': BATCH, 'steps': STEPS,
-    }))
+    which = sys.argv[1] if len(sys.argv) > 1 else 'resnet'
+    names = list(CONFIGS) if which == 'all' else [which]
+    for name in names:
+        run_config(name)
 
 
 if __name__ == '__main__':

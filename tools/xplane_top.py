@@ -37,7 +37,7 @@ def _find_xplanes(trace_dir):
 
 def device_planes(trace_dir):
     """Yield (plane_name, plane) for accelerator planes in the capture."""
-    from tensorboard_plugin_profile.protobuf import xplane_pb2
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
     for path in sorted(_find_xplanes(trace_dir), key=os.path.getmtime):
         space = xplane_pb2.XSpace()
         with open(path, 'rb') as f:
@@ -54,13 +54,16 @@ def top_ops(trace_dir, merge_fusion_params=True):
     totals = defaultdict(lambda: [0.0, 0])
     for _, plane in device_planes(trace_dir):
         for line in plane.lines:
-            # XLA op lines carry per-op events; step lines duplicate them
-            if 'step' in line.name.lower():
+            # 'XLA Ops' carries the per-op device slices; 'Steps'/'XLA
+            # Modules' duplicate whole-step spans and 'Async XLA Ops'
+            # overlap real compute — both would double-count
+            if line.name != 'XLA Ops':
                 continue
             for ev in line.events:
                 name = plane.event_metadata[ev.metadata_id].name
                 if merge_fusion_params:
-                    name = re.sub(r'\.[0-9]+$', '', name)
+                    name = re.sub(r'^%', '', name)
+                    name = re.sub(r'[.\-][0-9]+( = .*)?$', '', name)
                 totals[name][0] += ev.duration_ps / 1e6
                 totals[name][1] += 1
     rows = [(k, v[0], v[1]) for k, v in totals.items()]
