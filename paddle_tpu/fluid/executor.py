@@ -442,7 +442,8 @@ class _CompiledBlock(object):
         fetches = [env[n] for n in self.fetch_names]
         return new_state, fetches
 
-    def _state_from_scope(self, scope, names, to_value):
+    def _state_from_scope(self, scope, names, to_value, cache_back=False):
+        import jax
         state = {}
         for name in names:
             var = scope.find_var(name)
@@ -450,18 +451,37 @@ class _CompiledBlock(object):
                 raise RuntimeError(
                     'persistable var %r is not initialized in scope — '
                     'did you run the startup program?' % name)
-            state[name] = to_value(var.value(),
-                                   self.block._find_var_recursive(name))
+            raw = var.value()
+            val = to_value(raw, self.block._find_var_recursive(name))
+            if cache_back and isinstance(val, jax.Array) \
+                    and not isinstance(raw, jax.Array):
+                # host-resident READ-ONLY state (e.g. params
+                # load_inference_model just read from disk) stays
+                # device-resident after the first staging: run() never
+                # writes state_ro back, so without this every inference
+                # call re-uploaded ~all params — ~10ms tunnel latency
+                # PER ARRAY made a 25ms ResNet-18 eval take 1.7s (r5).
+                # RW state must NOT be cached here: its staged buffer
+                # is donated into the jit, and caching it would leave
+                # the scope pointing at deleted buffers if the step
+                # raises before the post-run write-back.
+                lod = raw.lod() if isinstance(raw, core.LoDTensor) else None
+                if not lod:
+                    var.set_value(val)
+            state[name] = val
         return state
 
-    def _materialize_args(self, scope, feed_values):
+    def _materialize_args(self, scope, feed_values, cache_ro=False):
         """Device-stage the jit/eager call's arguments: threaded scope
         state and feeds (shared by run() and Executor.memory_analysis —
-        the stats must describe the executable run() executes)."""
+        the stats must describe the executable run() executes).
+        cache_ro: run()-only — memory_analysis must stay side-effect
+        free on the scope."""
         device = self.place.jax_device()
         to_value = lambda v, desc: _to_device_value(v, desc, device)
         state_rw = self._state_from_scope(scope, self.state_rw, to_value)
-        state_ro = self._state_from_scope(scope, self.state_ro, to_value)
+        state_ro = self._state_from_scope(scope, self.state_ro, to_value,
+                                          cache_back=cache_ro)
         feeds = {
             n: _to_device_value(v, self.block._find_var_recursive(n), device)
             for n, v in feed_values.items()
@@ -469,8 +489,8 @@ class _CompiledBlock(object):
         return state_rw, state_ro, feeds
 
     def run(self, scope, feed_values, rng_key, eager=False):
-        state_rw, state_ro, feeds = self._materialize_args(scope,
-                                                           feed_values)
+        state_rw, state_ro, feeds = self._materialize_args(
+            scope, feed_values, cache_ro=True)
         if eager:
             new_state, fetches = self._run_eager(scope, state_rw, state_ro,
                                                  feeds, rng_key)
@@ -497,8 +517,8 @@ class _CompiledBlock(object):
             raise RuntimeError(
                 'run_multi: the program contains host ops and cannot run '
                 'as one on-device loop — use run() per step')
-        state_rw, state_ro, feeds = self._materialize_args(scope,
-                                                           feed_values)
+        state_rw, state_ro, feeds = self._materialize_args(
+            scope, feed_values, cache_ro=True)
         if not hasattr(self, '_multi_jit'):
             fn = self._fn
             rw_keys = list(self.state_rw)
