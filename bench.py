@@ -20,11 +20,14 @@ mfu, vs_baseline (null where the reference published no number), ms per
 step — so nothing rides piggyback on the headline record
 (VERDICT r2 next-#10).
 
-Configs (reference benchmark/fluid suite):
-  resnet        ResNet-50 ImageNet train, bs512 224^2  (models/resnet.py)
-  nmt           WMT14 seq2seq+attention 512/512/512 dict30k, bs512 seq32
-  transformer   transformer-base 6L d512 ff2048 h8, bs128 seq256
-  stacked_lstm  IMDB stacked dynamic LSTM (3x128), bs128 seq64
+Configs (reference benchmark/fluid suite + the contrib/float16 flow):
+  resnet             ResNet-50 ImageNet train, bs512 224^2 (models/resnet.py)
+  nmt                WMT14 seq2seq+attention 512/512/512 dict30k, bs512 seq32
+  transformer        transformer-base 6L d512 ff2048 h8, bs128 seq256
+  stacked_lstm       IMDB stacked dynamic LSTM (3x128), bs128 seq64 —
+                     device-true via Executor.run_multi (K steps/dispatch)
+  resnet_infer_bf16  ResNet-50 INFERENCE bs256, Float16Transpiler'd to
+                     bf16, with a same-process f32 speedup ratio
 
 Baseline: the reference's best published ResNet-50 training number,
 84.08 imgs/sec (2x Xeon 6148 MKL-DNN, BASELINE.md — the K40m GPU tables
@@ -51,9 +54,11 @@ WARMUP = 2
 
 # Per-config wall-clock budgets (seconds).  ResNet gets extra headroom
 # for the bs512 224^2 compile, transformer for its 6-layer bs128
-# seq256 compile (observed >240s on a degraded tunnel window, round 4);
-# the total (~19 min worst case, all four hanging) stays under the
-# driver's observed >=25 min patience.
+# seq256 compile (observed >240s on a degraded tunnel window, round 4),
+# the inference config for its two (f32 + bf16) compiles; the total
+# (~24.7 min worst case, all five hanging) stays at the driver's
+# observed >=25 min patience — the all-hang case is already a dead
+# tunnel, where budget precision stops mattering.
 BUDGETS = {'resnet': 280, 'nmt': 200, 'transformer': 320,
            'stacked_lstm': 220, 'resnet_infer_bf16': 340}
 if os.environ.get('BENCH_BUDGET'):  # uniform override, mainly for tests
