@@ -260,3 +260,48 @@ class TestClipCast:
         x = RNG.uniform(-2, 2, (3, 4)).astype('float32')
         _t('cast', {'X': x}, {'Out': x.astype('int32')},
            {'in_dtype': 5, 'out_dtype': 2}).check_output()
+
+
+def test_softmax_with_ce_softmax_output_is_intermediate_both_paths():
+    """ADVICE r4 #1: the reference op treats Softmax as an Intermediate
+    output (its grad kernel never consumes a Softmax cotangent).  The
+    bf16 fast path can't see one by construction; the f32 path must
+    stop_gradient it so AMP on/off agree: a loss built on the Softmax
+    output contributes NOTHING to dLogits on either path."""
+    import paddle_tpu.fluid as fluid
+
+    def logits_grad(amp):
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data('x', [8])
+            label = fluid.layers.data('label', [1], dtype='int64')
+            logits = fluid.layers.fc(x, 8, bias_attr=False,
+                                     param_attr=fluid.ParamAttr(
+                                         name='w_ce_int'))
+            loss_ce = fluid.layers.softmax_with_cross_entropy(
+                logits, label)
+            # build an extra loss ON the Softmax output: must be inert
+            helper_out = prog.global_block().ops[-1].output('Softmax')[0]
+            soft_var = prog.global_block().var(helper_out)
+            extra = fluid.layers.mean(soft_var)
+            total = fluid.layers.elementwise_add(
+                fluid.layers.mean(loss_ce),
+                fluid.layers.scale(extra, scale=100.0))
+            fluid.backward.append_backward(total)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.core.Scope()
+        rng = np.random.RandomState(0)
+        with fluid.scope_guard(scope), fluid.amp_guard(amp):
+            exe.run(startup)
+            g, = exe.run(prog, feed={
+                'x': rng.standard_normal((4, 8)).astype('float32'),
+                'label': rng.randint(0, 8, (4, 1)).astype('int64')},
+                fetch_list=['w_ce_int@GRAD'])
+        return np.asarray(g, dtype=np.float32)
+
+    g_f32 = logits_grad(False)
+    g_amp = logits_grad(True)
+    # the x100-scaled softmax-mean loss must not leak into the grads on
+    # EITHER path; remaining difference is bf16 rounding only
+    assert np.abs(g_f32 - g_amp).max() < 0.05, (g_f32, g_amp)
+    assert np.abs(g_f32).max() < 5.0  # CE-scale, not 100x-softmax scale
