@@ -765,6 +765,16 @@ class Executor(object):
             seen.add(int(steps))
             compiled._multi_steps_seen = seen
             self.compile_count += 1
+        from . import profiler as _profiler
+        if _profiler.is_profiler_enabled():
+            with _profiler.record_block(
+                    'executor_run_multi/block0[x%d]' % int(steps)):
+                fetches = compiled.run_multi(scope, feed_arrays, rng,
+                                             steps)
+                for f in fetches:
+                    if hasattr(f, 'block_until_ready'):
+                        f.block_until_ready()
+            return self._convert_fetches(fetches, return_numpy)
         fetches = compiled.run_multi(scope, feed_arrays, rng, steps)
         return self._convert_fetches(fetches, return_numpy)
 

@@ -1,0 +1,100 @@
+"""Round-5 features composed as one user journey: train with
+run_multi under a profiled region, export the chrome timeline, save
+the model, serve it at half precision through the predictor, and
+fail over the EDL master to a replicated store — the pieces must
+compose, not just pass alone."""
+
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.inference as infer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, 'tools'))
+
+
+def test_train_profile_timeline_save_halfserve():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data('img', [1, 8, 8])
+        conv = fluid.layers.batch_norm(
+            fluid.layers.conv2d(img, num_filters=4, filter_size=3))
+        pred = fluid.layers.fc(conv, 10, act='softmax')
+        label = fluid.layers.data('label', [1], dtype='int64')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.Adam(0.01).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    rng = np.random.RandomState(0)
+    feed = {'img': rng.standard_normal((8, 1, 8, 8)).astype('float32'),
+            'label': rng.randint(0, 10, (8, 1)).astype('int64')}
+    with tempfile.TemporaryDirectory() as td:
+        prof = os.path.join(td, 'prof')
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            first, = exe.run(main, feed=feed, fetch_list=[loss])
+            # K steps in one dispatch, inside a profiled region
+            with fluid.profiler.profiler('CPU', profile_path=prof):
+                last, = exe.run_multi(main, feed=feed,
+                                      fetch_list=[loss], steps=10)
+            assert float(last[0]) < float(first[0])
+            # timeline export round-trips
+            from timeline import Timeline
+            prof_d = json.load(open(prof + '.events.json'))
+            trace = json.loads(
+                Timeline({'t': prof_d}).generate_chrome_trace())
+            assert any(e['ph'] == 'X' for e in trace['traceEvents'])
+            # save the trained model
+            model_dir = os.path.join(td, 'model')
+            fluid.io.save_inference_model(model_dir, ['img'], [pred], exe,
+                                          main_program=test_prog)
+        # serve it at half precision through the public predictor
+        ref_p = infer.create_paddle_predictor(
+            infer.NativeConfig(model_dir=model_dir, use_tpu=False))
+        half_p = infer.create_paddle_predictor(
+            infer.NativeConfig(model_dir=model_dir, use_tpu=False,
+                               half_precision='bfloat16'))
+        x = rng.standard_normal((4, 1, 8, 8)).astype('float32')
+        ref = np.asarray(ref_p.run([infer.PaddleTensor(data=x)])[0].data)
+        half = np.asarray(half_p.run([infer.PaddleTensor(data=x)])[0].data)
+        assert half.dtype == np.float32
+        assert np.abs(ref - half).max() < 3e-2
+
+
+def test_edl_master_failover_composes_with_recordio_reader(tmp_path):
+    """Dataset -> master -> replica -> failover -> cloud_reader drains
+    the recovered queue."""
+    import pickle
+    from paddle_tpu.distributed import Master, MasterServer
+    from paddle_tpu.distributed.master import SnapshotReplica, cloud_reader
+    from paddle_tpu.runtime.native import RecordIOWriter
+
+    data = str(tmp_path / 'd.recordio')
+    w = RecordIOWriter(data)
+    for i in range(12):
+        w.write(pickle.dumps(i))
+    w.close()
+
+    primary = Master(store_path=str(tmp_path / 'a'),
+                     chunk_timeout_secs=30, failure_max=3)
+    server = MasterServer(primary)
+    try:
+        primary.set_dataset([data], records_per_task=4)
+        replica = SnapshotReplica(server.endpoint, str(tmp_path / 'b'))
+        assert replica.pull()
+    finally:
+        server.close()
+        primary._lock_fd = None  # simulate host loss: no clean close
+    m2 = Master(store_path=str(tmp_path / 'b'))
+    try:
+        got = sorted(pickle.loads(r) for r in cloud_reader(m2)())
+        assert got == list(range(12))
+    finally:
+        m2.close()
