@@ -503,12 +503,18 @@ class _CompiledBlock(object):
             scope.var(name).set_value(val)
         return fetches
 
-    def run_multi(self, scope, feed_values, rng_key, steps):
-        """K steps in ONE device dispatch: lax.fori_loop over the block
-        function, same feeds each iteration, per-iteration RNG via
+    def run_multi(self, scope, feed_values, rng_key, steps,
+                  scanned_feeds=None):
+        """K steps in ONE device dispatch, per-iteration RNG via
         fold_in.  The dispatch-latency amortizer for small steps (a
         ~100ms tunnel round trip dwarfs a ~2ms LSTM step; reference
-        benchmarks loop on the host because each CUDA launch is ~µs)."""
+        benchmarks loop on the host because each CUDA launch is ~µs).
+
+        feed_values: feeds held constant across iterations.
+        scanned_feeds: {name: array with leading K axis} — one slice
+        per iteration (a whole epoch shipped in one transfer), driven
+        by lax.scan; without it the loop is a fori_loop over the same
+        batch."""
         import jax
         if steps < 1:
             raise ValueError('run_multi: steps must be >= 1, got %r'
@@ -519,27 +525,46 @@ class _CompiledBlock(object):
                 'as one on-device loop — use run() per step')
         state_rw, state_ro, feeds = self._materialize_args(
             scope, feed_values, cache_ro=True)
+        scanned = scanned_feeds or {}
         if not hasattr(self, '_multi_jit'):
             fn = self._fn
             rw_keys = list(self.state_rw)
 
-            def multi(state_rw, state_ro, feeds, rng, n):
-                def body(i, s):
-                    new_state, _ = fn(s, state_ro, feeds,
-                                      jax.random.fold_in(rng, i))
-                    return {k: new_state.get(k, s[k]) for k in rw_keys}
+            def multi(state_rw, state_ro, feeds, scanned, rng, n):
+                if scanned:
+                    def body(s, sl):
+                        i, per_step = sl
+                        merged = dict(feeds)
+                        merged.update(per_step)
+                        new_state, _ = fn(s, state_ro, merged,
+                                          jax.random.fold_in(rng, i))
+                        return ({k: new_state.get(k, s[k])
+                                 for k in rw_keys}, None)
 
-                final = jax.lax.fori_loop(0, n - 1, body, state_rw)
+                    head = {k: v[:-1] for k, v in scanned.items()}
+                    final, _ = jax.lax.scan(
+                        body, state_rw,
+                        (jax.numpy.arange(n - 1), head))
+                    last = dict(feeds)
+                    last.update({k: v[-1] for k, v in scanned.items()})
+                else:
+                    def body(i, s):
+                        new_state, _ = fn(s, state_ro, feeds,
+                                          jax.random.fold_in(rng, i))
+                        return {k: new_state.get(k, s[k]) for k in rw_keys}
+
+                    final = jax.lax.fori_loop(0, n - 1, body, state_rw)
+                    last = feeds
                 # last step outside the loop so fetches come out
-                new_state, fetches = fn(final, state_ro, feeds,
+                new_state, fetches = fn(final, state_ro, last,
                                         jax.random.fold_in(rng, n - 1))
                 return new_state, fetches
 
             self._multi_jit = jax.jit(
-                multi, static_argnums=(4, ),
+                multi, static_argnums=(5, ),
                 donate_argnums=(0, ) if self.state_rw else ())
         new_state, fetches = self._multi_jit(state_rw, state_ro, feeds,
-                                             rng_key, int(steps))
+                                             scanned, rng_key, int(steps))
         for name, val in new_state.items():
             scope.var(name).set_value(val)
         return fetches
@@ -747,22 +772,67 @@ class Executor(object):
                   fetch_list=None,
                   steps=1,
                   scope=None,
-                  return_numpy=True):
+                  return_numpy=True,
+                  feed_list=None):
         """Run ``steps`` iterations of the program as ONE device
-        dispatch (lax.fori_loop over the compiled block; same feed every
-        iteration, fresh RNG stream per iteration).  Returns the LAST
-        iteration's fetches.  For dispatch-bound small steps — e.g. the
-        stacked-LSTM benchmark where a ~2ms step rides a ~100ms tunnel
-        round trip — this makes the wall clock measure the chip.
-        Training state updates persist to the scope exactly as ``steps``
-        sequential run() calls would."""
+        dispatch.  Returns the LAST iteration's fetches.  For
+        dispatch-bound small steps — e.g. the stacked-LSTM benchmark
+        where a ~2ms step rides a ~100ms tunnel round trip — this makes
+        the wall clock measure the chip.  Training state updates
+        persist to the scope exactly as ``steps`` sequential run()
+        calls would.
+
+        feed: one batch reused every iteration (fori_loop), OR
+        feed_list: a list of per-iteration batches (same shapes/LoD
+        bucket) scanned on device — a mini-epoch in one dispatch;
+        ``steps`` is then len(feed_list)."""
+        if feed_list is not None:
+            if feed is not None:
+                raise ValueError('run_multi: pass feed OR feed_list')
+            if not feed_list:
+                raise ValueError('run_multi: feed_list is empty')
+            prog_ = program if program is not None else \
+                default_main_program()
+            if any(op.type == 'read' for op in prog_.global_block().ops):
+                # resolving would pop (and then lose) a reader
+                # minibatch before the scan body failed to find it
+                raise RuntimeError(
+                    'run_multi(feed_list=...) does not compose with '
+                    'py_reader-fed programs — feed the batches '
+                    'explicitly or use run() per step')
+            steps = len(feed_list)
+            per_step = [prepare_feed_arrays(dict(f)) for f in feed_list]
+            names = set(per_step[0])
+            shapes = {n: np.shape(per_step[0][n]) for n in names}
+            for i, fa in enumerate(per_step[1:], 1):
+                if set(fa) != names or any(
+                        np.shape(fa[n]) != shapes[n] for n in fa):
+                    raise ValueError(
+                        'run_multi: feed_list[%d] differs in names or '
+                        'shapes from feed_list[0] — all batches must '
+                        'share one shape bucket (pad to it, or group '
+                        'batches by bucket)' % i)
+            feed = per_step[0]  # keys the compile signature (already
+            # prepared: prepare_feed_arrays passes arrays through, so
+            # the resolve path does not re-pad batch 0)
         program, scope, feed_arrays, compiled = self._resolve_and_compile(
             program, feed, fetch_list, scope)
+        scanned = None
+        if feed_list is not None:
+            import jax
+            dev = self.place.jax_device()
+            scanned = {
+                n: jax.device_put(
+                    np.stack([np.asarray(fa[n]) for fa in per_step]), dev)
+                for n in per_step[0]
+            }
+            feed_arrays = {}  # every feed name arrives via the scan
         rng = self._next_rng(program)
         # each distinct `steps` value is its own XLA compile (static arg)
         seen = getattr(compiled, '_multi_steps_seen', set())
-        if int(steps) not in seen:
-            seen.add(int(steps))
+        key = (int(steps), scanned is not None)
+        if key not in seen:
+            seen.add(key)
             compiled._multi_steps_seen = seen
             self.compile_count += 1
         from . import profiler as _profiler
@@ -770,12 +840,13 @@ class Executor(object):
             with _profiler.record_block(
                     'executor_run_multi/block0[x%d]' % int(steps)):
                 fetches = compiled.run_multi(scope, feed_arrays, rng,
-                                             steps)
+                                             steps, scanned_feeds=scanned)
                 for f in fetches:
                     if hasattr(f, 'block_until_ready'):
                         f.block_until_ready()
             return self._convert_fetches(fetches, return_numpy)
-        fetches = compiled.run_multi(scope, feed_arrays, rng, steps)
+        fetches = compiled.run_multi(scope, feed_arrays, rng, steps,
+                                     scanned_feeds=scanned)
         return self._convert_fetches(fetches, return_numpy)
 
     def _convert_fetches(self, fetches, return_numpy):

@@ -79,3 +79,81 @@ def test_run_multi_rejects_host_ops():
         exe.run(startup)
         with pytest.raises(RuntimeError, match='host ops'):
             exe.run_multi(prog, feed=_feed(), fetch_list=[loss], steps=3)
+
+
+def test_run_multi_feed_list_matches_sequential():
+    """A mini-epoch of DIFFERENT batches in one dispatch (lax.scan over
+    device-staged feeds) must train exactly like sequential runs."""
+    rng = np.random.RandomState(1)
+    batches = [{'x': rng.rand(8, 4).astype('float32'),
+                'label': rng.randint(0, 3, (8, 1)).astype('int64')}
+               for _ in range(6)]
+
+    prog, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    s1 = fluid.core.Scope()
+    with fluid.scope_guard(s1):
+        exe.run(startup)
+        for b in batches:
+            seq_out, = exe.run(prog, feed=b, fetch_list=[loss])
+
+    prog2, startup2, loss2 = _build()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    s2 = fluid.core.Scope()
+    with fluid.scope_guard(s2):
+        exe2.run(startup2)
+        multi_out, = exe2.run_multi(prog2, feed_list=batches,
+                                    fetch_list=[loss2])
+        assert np.allclose(seq_out, multi_out, atol=1e-5), (
+            seq_out, multi_out)
+
+
+def test_run_multi_feed_list_rejects_mixed_shapes():
+    prog, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    rng = np.random.RandomState(0)
+    b1 = {'x': rng.rand(8, 4).astype('float32'),
+          'label': rng.randint(0, 3, (8, 1)).astype('int64')}
+    b2 = {'x': rng.rand(4, 4).astype('float32'),
+          'label': rng.randint(0, 3, (4, 1)).astype('int64')}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(ValueError, match='shape'):
+            exe.run_multi(prog, feed_list=[b1, b2], fetch_list=[loss])
+
+
+def test_run_multi_feed_list_lod_batches():
+    """Ragged LoD batches in one bucket scan correctly (lengths ride
+    the @SEQLEN sideband per step)."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        words = fluid.layers.data('words', shape=[1], dtype='int64',
+                                  lod_level=1)
+        emb = fluid.layers.embedding(words, size=[50, 8])
+        pooled = fluid.layers.sequence_pool(emb, 'sum')
+        loss = fluid.layers.mean(fluid.layers.fc(pooled, 2))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    rng = np.random.RandomState(2)
+
+    def batch():
+        lens = rng.randint(3, 15, size=4)
+        rows = [rng.randint(0, 50, size=(l, 1)).tolist() for l in lens]
+        return {'words': fluid.create_lod_tensor(
+            rows, [[len(r) for r in rows]])}
+
+    batches = [batch() for _ in range(4)]
+    exe = fluid.Executor(fluid.CPUPlace())
+    s1 = fluid.core.Scope()
+    with fluid.scope_guard(s1):
+        exe.run(startup)
+        for b in batches:
+            seq_out, = exe.run(prog, feed=b, fetch_list=[loss])
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    s2 = fluid.core.Scope()
+    with fluid.scope_guard(s2):
+        # same program object, fresh scope + identical startup init
+        exe2.run(startup)
+        multi_out, = exe2.run_multi(prog, feed_list=batches,
+                                    fetch_list=[loss])
+    assert np.allclose(seq_out, multi_out, atol=1e-5)
