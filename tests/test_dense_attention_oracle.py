@@ -1,0 +1,84 @@
+"""Numpy-oracle corner tests for the r5 dense_attention rewrite
+(parallel/context_parallel.py — one-shot softmax replaced the blockwise
+m/l/merge form; the masked-row semantics must not have moved)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.parallel import context_parallel as cp
+
+
+def _oracle(q, k, v, causal, lens):
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    out = np.zeros((b, lq, h, v.shape[-1]), np.float32)
+    for bi in range(b):
+        for hi in range(h):
+            s = q[bi, :, hi] @ k[bi, :, hi].T / np.sqrt(d)
+            mask = np.ones((lq, lk), bool)
+            if lens is not None:
+                mask &= (np.arange(lk)[None, :] < lens[bi])
+            if causal:
+                mask &= (np.arange(lk)[None, :]
+                         <= np.arange(lq)[:, None])
+            s = np.where(mask, s, -np.inf)
+            with np.errstate(invalid='ignore'):
+                e = np.exp(s - np.max(s, -1, keepdims=True))
+                e = np.where(mask, e, 0.0)
+                denom = e.sum(-1, keepdims=True)
+                p = np.where(denom > 0, e / np.maximum(denom, 1e-30), 0.0)
+            out[bi, :, hi] = p @ v[bi, :, hi]
+    return out
+
+
+@pytest.mark.parametrize('causal', [False, True])
+@pytest.mark.parametrize('lens', [None, [5, 1, 8, 3]])
+def test_dense_attention_matches_oracle(causal, lens):
+    rng = np.random.RandomState(0)
+    b, l, h, d = 4, 8, 2, 16
+    q = rng.standard_normal((b, l, h, d)).astype('float32')
+    k = rng.standard_normal((b, l, h, d)).astype('float32')
+    v = rng.standard_normal((b, l, h, d)).astype('float32')
+    got = np.asarray(cp.dense_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        causal=causal, seq_lengths=lens), np.float32)
+    want = _oracle(q, k, v, causal, lens)
+    assert np.allclose(got, want, atol=2e-5), np.abs(got - want).max()
+
+
+def test_dense_attention_zero_length_row_outputs_zero():
+    """A row with NO valid K positions must attend to nothing (zeros),
+    not a uniform average — the blockwise form guarded this with its
+    running-sum floor; the one-shot form guards via the masked-p
+    re-zero."""
+    rng = np.random.RandomState(1)
+    q = rng.standard_normal((2, 4, 1, 8)).astype('float32')
+    k = rng.standard_normal((2, 4, 1, 8)).astype('float32')
+    v = rng.standard_normal((2, 4, 1, 8)).astype('float32')
+    out = np.asarray(cp.dense_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        seq_lengths=[0, 4]))
+    assert np.allclose(out[0], 0.0, atol=1e-6)
+    assert not np.allclose(out[1], 0.0)
+
+
+def test_dense_attention_matches_ring_over_virtual_mesh():
+    """The rewritten single-device path must still agree with the ring
+    (blockwise) path — they are the same math with different schedules."""
+    import jax
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices('cpu')[:4])
+    mesh = Mesh(devs, ('sp', ))
+    rng = np.random.RandomState(2)
+    b, l, h, d = 2, 16, 2, 8
+    q = jnp.asarray(rng.standard_normal((b, l, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, l, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, l, h, d)), jnp.float32)
+    lens = [13, 16]
+    dense = np.asarray(cp.dense_attention(q, k, v, causal=True,
+                                          seq_lengths=lens))
+    ring = np.asarray(cp.ring_attention(q, k, v, mesh, axis='sp',
+                                        causal=True, seq_lengths=lens))
+    assert np.allclose(dense, ring, atol=2e-5), np.abs(dense - ring).max()
