@@ -54,11 +54,19 @@ class _Handler(socketserver.StreamRequestHandler):
                 elif method == 'snapshot':
                     # replication door (go/master etcd_client.go analog):
                     # a standby on ANOTHER filesystem mirrors the queue
-                    # state so master-host loss doesn't lose the pass
+                    # state so master-host loss doesn't lose the pass.
+                    # Read _seq BEFORE serializing: a mutator landing
+                    # between the two would otherwise pair an OLD blob
+                    # with a NEWER seq, and the replica would durably
+                    # skip re-pulling the state that seq promised (e.g.
+                    # a force-snapshotted poison-task discard).  The
+                    # stale-seq direction is safe — the next pull sees
+                    # seq advance and re-mirrors.
                     import base64
+                    seq = getattr(master, '_seq', 0)
                     blob = master._q.snapshot()
                     resp = {'blob': base64.b64encode(blob).decode(),
-                            'seq': getattr(master, '_seq', 0)}
+                            'seq': seq}
                 else:
                     resp = {'error': 'unknown method %r' % method}
             except Exception as e:  # surface to the client, keep serving

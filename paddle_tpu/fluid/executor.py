@@ -174,6 +174,8 @@ def validate_feed(program, feed_arrays):
     for name, value in feed_arrays.items():
         if name.endswith((registry.SEQLEN_SUFFIX, registry.ROWS_SUFFIX)):
             continue
+        if name == registry.SAMPLE_MASK_NAME:
+            continue  # executor-injected ragged-batch mask, not a data var
         if isinstance(value, core.SelectedRows):
             continue  # row-subset feeds carry their own height metadata
         var = block.vars.get(name)
@@ -216,6 +218,60 @@ def feed_signature(feed_arrays):
         return tuple(np.shape(a)), str(a.dtype)
 
     return tuple((n, ) + _sig_of(v) for n, v in sorted(feed_arrays.items()))
+
+
+def check_feed_list_uniform(per_step):
+    """lax.scan needs a uniform per-step structure: every prepared batch
+    must share feed_list[0]'s names, shapes AND dtypes (a mixed-dtype
+    stack would silently promote the whole scanned axis past the
+    compiled block's feed signature).  Uniformity is exactly 'same
+    feed_signature', so reuse it."""
+    sig0 = feed_signature(per_step[0])
+    for i, fa in enumerate(per_step[1:], 1):
+        if feed_signature(fa) != sig0:
+            raise ValueError(
+                'run_multi: feed_list[%d] differs in names, shapes or '
+                'dtypes from feed_list[0] — all batches must '
+                'share one shape bucket (pad to it, or group '
+                'batches by bucket)' % i)
+
+
+def prepare_feed_list(feed_list):
+    """Normalize a run_multi feed_list: one prepared feed dict per
+    iteration, uniform across steps.  Returns (steps, per_step).
+    (ParallelExecutor.run_multi composes the pieces itself — it must
+    pad ragged lots between preparation and the uniformity check.)"""
+    if not feed_list:
+        raise ValueError('run_multi: feed_list is empty')
+    per_step = [prepare_feed_arrays(dict(f)) for f in feed_list]
+    check_feed_list_uniform(per_step)
+    return len(per_step), per_step
+
+
+def stack_steps(vals):
+    """Stack per-iteration feeds along a new leading K axis for the
+    scanned dispatch.  Device-resident values (the double-buffer
+    prefetch form) stack ON DEVICE — np.stack would drag each batch
+    back through the host only to re-upload the whole epoch."""
+    import jax
+    import jax.numpy as jnp
+    if all(isinstance(v, jax.Array) for v in vals):
+        return jnp.stack(vals)
+    return np.stack([np.asarray(v) for v in vals])
+
+
+def _reject_reader_fed(program, what):
+    """run_multi never composes with py_reader-fed programs: resolving
+    would pop exactly ONE minibatch and the K-step loop would train on
+    it K times with no signal (the reference multi-iteration loop,
+    executor.cc:321-339, pulls fresh data every iteration)."""
+    prog = program if program is not None else default_main_program()
+    if any(op.type == 'read' for op in prog.global_block().ops):
+        raise RuntimeError(
+            '%s does not compose with py_reader-fed programs — feed '
+            'the batches explicitly (feed= or feed_list=) or use '
+            'run() per step' % what)
+    return prog
 
 
 _SEQ_BUCKET = 16
@@ -364,6 +420,23 @@ class _CompiledBlock(object):
                                            place=place,
                                            mesh=spmd_ref['mesh'],
                                            batch_axis=spmd_ref['batch_axis'])
+            mask = feeds.get(registry.SAMPLE_MASK_NAME)
+            if mask is not None:
+                # ragged-batch provenance roots: the feeds the PADDING
+                # treated as batch-led (recorded pre-padding, where an
+                # aux feed whose rows merely coincide with the padded
+                # size is still distinguishable), falling back to the
+                # dim-0 shape match.  run_op propagates from here;
+                # state (params) is never batch-led.
+                declared = getattr(self, '_batch_feed_names', None)
+                if declared is not None:
+                    ctx.batch_led = {n for n in feeds if n in declared}
+                else:
+                    ctx.batch_led = {
+                        n for n, v in feeds.items()
+                        if getattr(v, 'ndim', 0) >= 1
+                        and v.shape[0] == mask.shape[0]}
+                ctx.batch_tainted = set(ctx.batch_led)
             for op in ops:
                 registry.run_op(ctx, op)
             registry.check_cond_uninit(ctx, fetch_names_, 'fetch')
@@ -375,9 +448,16 @@ class _CompiledBlock(object):
             # the blend keeps it.  No zeros ever persist.
             new_state = {n: env[n] for n in state_out_ if n in env}
             fetches = [env[n] for n in fetch_names_]
+            # trace-time side channel: which fetches are batch-led, so
+            # the ragged-batch executors trim ONLY those back to the
+            # real row count (a parameter fetch whose dim 0 coincides
+            # with the padded batch size must come back whole)
+            self._fetch_batch_led = [n in ctx.batch_led
+                                     for n in fetch_names_]
             return new_state, fetches
 
         self._fn = fn
+        self._fetch_batch_led = None  # set at first trace
         donate = (0, ) if self.state_rw else ()
         self._jit = jax.jit(fn, donate_argnums=donate)
 
@@ -515,7 +595,6 @@ class _CompiledBlock(object):
         per iteration (a whole epoch shipped in one transfer), driven
         by lax.scan; without it the loop is a fori_loop over the same
         batch."""
-        import jax
         if steps < 1:
             raise ValueError('run_multi: steps must be >= 1, got %r'
                              % (steps, ))
@@ -526,48 +605,82 @@ class _CompiledBlock(object):
         state_rw, state_ro, feeds = self._materialize_args(
             scope, feed_values, cache_ro=True)
         scanned = scanned_feeds or {}
-        if not hasattr(self, '_multi_jit'):
-            fn = self._fn
-            rw_keys = list(self.state_rw)
-
-            def multi(state_rw, state_ro, feeds, scanned, rng, n):
-                if scanned:
-                    def body(s, sl):
-                        i, per_step = sl
-                        merged = dict(feeds)
-                        merged.update(per_step)
-                        new_state, _ = fn(s, state_ro, merged,
-                                          jax.random.fold_in(rng, i))
-                        return ({k: new_state.get(k, s[k])
-                                 for k in rw_keys}, None)
-
-                    head = {k: v[:-1] for k, v in scanned.items()}
-                    final, _ = jax.lax.scan(
-                        body, state_rw,
-                        (jax.numpy.arange(n - 1), head))
-                    last = dict(feeds)
-                    last.update({k: v[-1] for k, v in scanned.items()})
-                else:
-                    def body(i, s):
-                        new_state, _ = fn(s, state_ro, feeds,
-                                          jax.random.fold_in(rng, i))
-                        return {k: new_state.get(k, s[k]) for k in rw_keys}
-
-                    final = jax.lax.fori_loop(0, n - 1, body, state_rw)
-                    last = feeds
-                # last step outside the loop so fetches come out
-                new_state, fetches = fn(final, state_ro, last,
-                                        jax.random.fold_in(rng, n - 1))
-                return new_state, fetches
-
-            self._multi_jit = jax.jit(
-                multi, static_argnums=(5, ),
-                donate_argnums=(0, ) if self.state_rw else ())
-        new_state, fetches = self._multi_jit(state_rw, state_ro, feeds,
-                                             scanned, rng_key, int(steps))
+        jitted = self._get_multi_jit(feeds, scanned)
+        new_state, fetches = jitted(state_rw, state_ro, feeds,
+                                    scanned, rng_key, int(steps))
         for name, val in new_state.items():
             scope.var(name).set_value(val)
         return fetches
+
+    def _make_multi(self):
+        """The K-steps-per-dispatch function: K-1 iterations inside
+        lax.scan (per-step feeds) or fori_loop (constant feeds), last
+        step unrolled so fetches come out.  Shared verbatim by the
+        single-device and SPMD executors — only the jit wrapping
+        (shardings) differs."""
+        import jax
+        fn = self._fn
+        rw_keys = list(self.state_rw)
+
+        def multi(state_rw, state_ro, feeds, scanned, rng, n):
+            if scanned:
+                def body(s, sl):
+                    i, per_step = sl
+                    merged = dict(feeds)
+                    merged.update(per_step)
+                    new_state, _ = fn(s, state_ro, merged,
+                                      jax.random.fold_in(rng, i))
+                    return ({k: new_state.get(k, s[k])
+                             for k in rw_keys}, None)
+
+                head = {k: v[:-1] for k, v in scanned.items()}
+                final, _ = jax.lax.scan(
+                    body, state_rw,
+                    (jax.numpy.arange(n - 1), head))
+                last = dict(feeds)
+                last.update({k: v[-1] for k, v in scanned.items()})
+            else:
+                def body(i, s):
+                    new_state, _ = fn(s, state_ro, feeds,
+                                      jax.random.fold_in(rng, i))
+                    return {k: new_state.get(k, s[k]) for k in rw_keys}
+
+                final = jax.lax.fori_loop(0, n - 1, body, state_rw)
+                last = feeds
+            # last step outside the loop so fetches come out
+            new_state, fetches = fn(final, state_ro, last,
+                                    jax.random.fold_in(rng, n - 1))
+            return new_state, fetches
+
+        return multi
+
+    def _get_multi_jit(self, feeds, scanned):
+        """One jit wraps every (feeds, scanned) structure — jax retraces
+        per pytree structure internally.  _SpmdCompiledBlock overrides
+        this to attach per-structure GSPMD shardings."""
+        import jax
+        if not hasattr(self, '_multi_jit'):
+            self._multi_jit = jax.jit(
+                self._make_multi(), static_argnums=(5, ),
+                donate_argnums=(0, ) if self.state_rw else ())
+        return self._multi_jit
+
+    def note_multi_compile(self, steps, scanned):
+        """True exactly when this (steps, scanned shape signature) pair
+        has not run before — i.e. the coming dispatch is a real XLA
+        retrace (`steps` is a static jit argument; each scanned
+        structure/shape retraces too).  Shared compile_count
+        bookkeeping for Executor.run_multi and
+        ParallelExecutor.run_multi."""
+        seen = getattr(self, '_multi_steps_seen', None)
+        if seen is None:
+            seen = self._multi_steps_seen = set()
+        key = (int(steps),
+               feed_signature(scanned) if scanned is not None else None)
+        if key in seen:
+            return False
+        seen.add(key)
+        return True
 
 
 class Executor(object):
@@ -786,32 +899,14 @@ class Executor(object):
         feed_list: a list of per-iteration batches (same shapes/LoD
         bucket) scanned on device — a mini-epoch in one dispatch;
         ``steps`` is then len(feed_list)."""
+        # the guard covers BOTH feed paths: the plain-feed path would
+        # otherwise pop ONE reader minibatch in _resolve_and_compile and
+        # silently train K steps on it
+        program = _reject_reader_fed(program, 'run_multi')
         if feed_list is not None:
             if feed is not None:
                 raise ValueError('run_multi: pass feed OR feed_list')
-            if not feed_list:
-                raise ValueError('run_multi: feed_list is empty')
-            prog_ = program if program is not None else \
-                default_main_program()
-            if any(op.type == 'read' for op in prog_.global_block().ops):
-                # resolving would pop (and then lose) a reader
-                # minibatch before the scan body failed to find it
-                raise RuntimeError(
-                    'run_multi(feed_list=...) does not compose with '
-                    'py_reader-fed programs — feed the batches '
-                    'explicitly or use run() per step')
-            steps = len(feed_list)
-            per_step = [prepare_feed_arrays(dict(f)) for f in feed_list]
-            names = set(per_step[0])
-            shapes = {n: np.shape(per_step[0][n]) for n in names}
-            for i, fa in enumerate(per_step[1:], 1):
-                if set(fa) != names or any(
-                        np.shape(fa[n]) != shapes[n] for n in fa):
-                    raise ValueError(
-                        'run_multi: feed_list[%d] differs in names or '
-                        'shapes from feed_list[0] — all batches must '
-                        'share one shape bucket (pad to it, or group '
-                        'batches by bucket)' % i)
+            steps, per_step = prepare_feed_list(feed_list)
             feed = per_step[0]  # keys the compile signature (already
             # prepared: prepare_feed_arrays passes arrays through, so
             # the resolve path does not re-pad batch 0)
@@ -823,17 +918,17 @@ class Executor(object):
             dev = self.place.jax_device()
             scanned = {
                 n: jax.device_put(
-                    np.stack([np.asarray(fa[n]) for fa in per_step]), dev)
+                    stack_steps([fa[n] for fa in per_step]), dev)
                 for n in per_step[0]
             }
             feed_arrays = {}  # every feed name arrives via the scan
         rng = self._next_rng(program)
-        # each distinct `steps` value is its own XLA compile (static arg)
-        seen = getattr(compiled, '_multi_steps_seen', set())
-        key = (int(steps), scanned is not None)
-        if key not in seen:
-            seen.add(key)
-            compiled._multi_steps_seen = seen
+        # each distinct `steps` value is its own XLA compile (static
+        # arg), and so is each scanned-feed SHAPE signature (the jit
+        # retraces per pytree structure) — the seen-set keys on the
+        # full _multi_jit cache key so recompile-bound tests observe
+        # real XLA retraces, not just distinct step counts
+        if compiled.note_multi_compile(steps, scanned):
             self.compile_count += 1
         from . import profiler as _profiler
         if _profiler.is_profiler_enabled():

@@ -16,12 +16,124 @@ BuildStrategy/ExecutionStrategy are accepted for API parity
 import numpy as np
 
 from . import core
-from .executor import _CompiledBlock, _to_device_value, _current_scope, \
-    as_numpy, prepare_feed_arrays, feed_signature, _is_host_op
+from .executor import _CompiledBlock, _current_scope, \
+    prepare_feed_arrays, feed_signature, _is_host_op, \
+    _reject_reader_fed, check_feed_list_uniform, stack_steps
 from .framework import default_main_program, Variable
 from ..ops import registry
 
 __all__ = ['ParallelExecutor', 'ExecutionStrategy', 'BuildStrategy']
+
+
+def _lead(v):
+    """Leading dim of a feed value (LoDTensor exposes shape() as a
+    method, so np.shape would return the bound method); None for
+    scalars."""
+    shape = v.shape() if isinstance(v, core.LoDTensor) else np.shape(v)
+    return int(shape[0]) if len(shape) >= 1 else None
+
+
+def pad_ragged_batch(feed_arrays, multiple, target=None, force_mask=False,
+                     skip=(), batch_names=None, sizes_only=False,
+                     report=None):
+    """DataBalance parity (details/data_balance_op_handle.cc) under static
+    SPMD shapes: pad the lot's batch dim up to ``target`` (default: the
+    next multiple of the mesh's dp extent) by replicating the last real
+    sample, and inject a ``registry.SAMPLE_MASK_NAME`` feed (1.0 = real
+    row, 0.0 = padding) so batch-mean lowerings — and, through jax.vjp,
+    every gradient flowing out of them — weight by the REAL sample count.
+    An epoch whose final lot isn't divisible by bs*ndev then trains with
+    the numerics of the unpadded lot instead of dying on a raw JAX
+    sharding error.
+
+    The batch row count is the NON-DIVISIBLE leading dim among the
+    dp-sharded feeds (names in ``skip`` — feeds with explicit sharding
+    annotations — never vote): a divisible non-batch feed (a lookup
+    table, a replicated aux input) cannot hijack the inference, and two
+    feeds disagreeing on non-divisible rows is an error, not a guess.
+    ``batch_names`` skips inference entirely — only those feeds are
+    batch-led (run_multi's re-pad pass, where a lot that already
+    divides carries no inference signal of its own).
+
+    Returns (feed_arrays, n_real, n_padded); the input dict is returned
+    untouched when the lot already divides (and no mask is forced).
+    ``sizes_only`` runs just the inference — (None, n_real, n_padded) —
+    so a probing pass over a feed_list never copies device-staged
+    arrays through the host.  ``report`` (a dict) receives
+    ``batch_names``: the feed names treated as batch-led, recorded
+    PRE-padding — post-padding every batch feed shares the padded row
+    count with any coinciding aux feed, so this is the only place the
+    distinction still exists."""
+    dims = set()
+    for n, v in feed_arrays.items():
+        if n in skip or isinstance(v, core.SelectedRows):
+            continue
+        if batch_names is not None and n not in batch_names:
+            continue
+        d = _lead(v)
+        if d is not None:
+            dims.add(d)
+    dims = sorted(dims)
+    if batch_names is not None:
+        if len(dims) != 1:
+            raise ValueError(
+                'ragged lot is ambiguous: batch feeds %s disagree on '
+                'rows %s' % (sorted(batch_names), dims))
+        b = dims[0]
+        if target is not None:
+            tgt = int(target)
+        else:
+            tgt = -(-b // multiple) * multiple if multiple > 1 else b
+    elif target is not None:
+        # a lot that already divides carries no inference signal of its
+        # own — the caller must say which feeds are batch-led
+        raise ValueError(
+            'pad_ragged_batch: target= requires batch_names=')
+    elif multiple > 1:
+        nondiv = [d for d in dims if d % multiple]
+        if len(nondiv) > 1:
+            raise ValueError(
+                'ragged lot is ambiguous: feeds disagree on batch rows '
+                '%s (each %% %d != 0) — pad them to one batch size '
+                'first, or annotate non-batch feeds with '
+                'paddle_tpu.parallel.shard' % (nondiv, multiple))
+        b = nondiv[0] if nondiv else (dims[-1] if dims else 0)
+        tgt = -(-b // multiple) * multiple if nondiv else b
+    else:
+        b = dims[-1] if dims else 0
+        tgt = b
+    if report is not None:
+        report['batch_names'] = {
+            n for n, v in feed_arrays.items()
+            if n not in skip and not isinstance(v, core.SelectedRows)
+            and (batch_names is None or n in batch_names)
+            and _lead(v) == b}
+    if b == 0 or (tgt == b and not force_mask):
+        return (None if sizes_only else feed_arrays), b, b
+    if sizes_only:
+        return None, b, tgt
+    out = {}
+    pad = tgt - b
+    for n, v in feed_arrays.items():
+        if isinstance(v, core.LoDTensor):
+            v = v.numpy()  # lod-free pass-through tensors (lod ones were
+            # already lowered to padded + @SEQLEN by prepare_feed_arrays)
+        if n in skip or isinstance(v, core.SelectedRows) \
+                or (batch_names is not None and n not in batch_names) \
+                or np.ndim(v) < 1 or np.shape(v)[0] != b \
+                or not pad:
+            out[n] = v  # not batch-leading, or nothing to append —
+            # leave device-staged arrays on device
+            continue
+        a = np.asarray(v)
+        # replicate the last REAL sample: always a valid row (in-range
+        # indices, finite activations); its loss/grads are masked out
+        out[n] = np.concatenate(
+            [a, np.broadcast_to(a[-1:], (pad, ) + a.shape[1:])])
+    mask = np.zeros((tgt, ), np.float32)
+    mask[:b] = 1.0
+    out[registry.SAMPLE_MASK_NAME] = mask
+    return out, b, tgt
 
 
 class ExecutionStrategy(object):
@@ -89,6 +201,7 @@ class _SpmdCompiledBlock(_CompiledBlock):
         }
         self._feed_shardings = feed_shardings
         self._state_shardings = dict(rw_shardings, **ro_shardings)
+        self._out_state_shardings = out_state_shardings
         donate = (0, ) if self.state_rw else ()
         self._jit = jax.jit(
             self._fn,
@@ -96,13 +209,18 @@ class _SpmdCompiledBlock(_CompiledBlock):
             out_shardings=(out_state_shardings, None),
             donate_argnums=donate)
 
-    def run(self, scope, feed_values, rng_key, eager=False):
+    def _materialize_args(self, scope, feed_values, cache_ro=False):
+        """Sharded device staging: state and feeds go to the mesh via
+        their GSPMD shardings (device arrays from a double-buffer
+        prefetch reshard device-side).  The base class's run()/
+        run_multi() call this polymorphically, so both the single-step
+        and the K-steps-per-dispatch paths are shared with Executor."""
         import jax
 
         def to_value(val, desc):
             if isinstance(val, core.LoDTensor):
                 val = val.numpy()
-            return val  # device_put with shardings happens via jit
+            return val  # sharded device_put happens below
 
         state_rw = self._state_from_scope(scope, self.state_rw, to_value)
         state_ro = self._state_from_scope(scope, self.state_ro, to_value)
@@ -116,12 +234,41 @@ class _SpmdCompiledBlock(_CompiledBlock):
                 v = v.numpy()
             if not isinstance(v, jax.Array):
                 v = np.asarray(v)
-            # device arrays (double-buffer prefetch) reshard device-side
             feeds[n] = jax.device_put(v, self._feed_shardings[n])
-        new_state, fetches = self._jit(state_rw, state_ro, feeds, rng_key)
-        for name, val in new_state.items():
-            scope.var(name).set_value(val)
-        return fetches
+        return state_rw, state_ro, feeds
+
+    def scanned_sharding(self, name):
+        """Sharding for a scanned feed: the per-step spec shifted right
+        of the leading K (steps) axis, which is never sharded."""
+        from jax.sharding import NamedSharding
+        from ..parallel.api import scanned_spec
+        return NamedSharding(
+            self.mesh, scanned_spec(self._feed_shardings[name].spec))
+
+    def _get_multi_jit(self, feeds, scanned):
+        """The shared K-steps-per-dispatch scan, jitted with this
+        block's GSPMD shardings and RW-state donation.  One executable
+        per (feeds, scanned) name structure — the ragged-tail masked
+        lot and the full lot key different structures, each compiled
+        once."""
+        import jax
+        key = (tuple(sorted(feeds)), tuple(sorted(scanned)))
+        cache = getattr(self, '_multi_jits', None)
+        if cache is None:
+            cache = self._multi_jits = {}
+        jitted = cache.get(key)
+        if jitted is None:
+            rw_sh = {n: self._state_shardings[n] for n in self.state_rw}
+            ro_sh = {n: self._state_shardings[n] for n in self.state_ro}
+            feed_sh = {n: self._feed_shardings[n] for n in feeds}
+            scanned_sh = {n: self.scanned_sharding(n) for n in scanned}
+            jitted = jax.jit(
+                self._make_multi(), static_argnums=(5, ),
+                in_shardings=(rw_sh, ro_sh, feed_sh, scanned_sh, None),
+                out_shardings=(self._out_state_shardings, None),
+                donate_argnums=(0, ) if self.state_rw else ())
+            cache[key] = jitted
+        return jitted
 
 
 class ParallelExecutor(object):
@@ -149,10 +296,47 @@ class ParallelExecutor(object):
         self._rng = None
         self.exec_strategy = exec_strategy or ExecutionStrategy()
         self.build_strategy = build_strategy or BuildStrategy()
+        self._batch_axis = 'dp'
+        # observability (mirrors Executor): compile_count counts XLA
+        # traces (block compiles + multi-step executables); dispatch
+        # accounting lets the contract tests pin K steps per dispatch
+        self.compile_count = 0
+        self.dispatch_count = 0
+        self.steps_dispatched = 0
 
     @property
     def device_count(self):
         return int(np.prod(self._mesh.devices.shape))
+
+    def _dp_extent(self):
+        """Rows-per-lot divisibility requirement: the mesh's extent
+        along the batch axis (1 when the mesh has no 'dp' axis —
+        batch replicated, nothing to pad for)."""
+        axes = dict(zip(self._mesh.axis_names, self._mesh.devices.shape))
+        return int(axes.get(self._batch_axis, 1))
+
+    def _annotated_feed_names(self, feed_arrays):
+        """Feed names carrying an explicit sharding annotation (and
+        their @SEQLEN/@ROWS sidebands): laid out per their spec, not
+        dp-sharded on dim 0, so they must not vote in (or be padded
+        by) ragged-batch inference."""
+        from ..parallel.api import sharding_of
+        block = self._main_program.block(0)
+        skip = set()
+        for n in feed_arrays:
+            base = n
+            for suffix in (registry.SEQLEN_SUFFIX, registry.ROWS_SUFFIX):
+                if base.endswith(suffix):
+                    base = base[:-len(suffix)]
+            v = block.vars.get(base)
+            if v is not None and sharding_of(v) is not None:
+                skip.add(n)
+        return skip
+
+    def _pad_ragged(self, feed_arrays, **kw):
+        return pad_ragged_batch(
+            feed_arrays, self._dp_extent(),
+            skip=self._annotated_feed_names(feed_arrays), **kw)
 
     def _next_rng(self):
         import jax
@@ -162,19 +346,21 @@ class ParallelExecutor(object):
         self._rng, key = jax.random.split(self._rng)
         return key
 
-    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
-        program = self._main_program
-        scope = self._scope
-        feed = feed if feed is not None else (feed_dict or {})
+    def _fetch_names(self, fetch_list):
         if isinstance(fetch_list, (Variable, str)):
             fetch_list = [fetch_list]
-        fetch_names = [
+        return [
             f.name if isinstance(f, Variable) else str(f) for f in fetch_list
         ]
-        from .executor import _pop_readers_into_feed
-        feed = dict(feed)
-        _pop_readers_into_feed(program, feed)
-        feed_arrays = prepare_feed_arrays(feed)
+
+    def _resolve(self, fetch_names, feed_arrays, batch_feed_names=None):
+        """Find (or compile) the sharded executable for this
+        (program version, fetch list, feed signature).
+        batch_feed_names: which feeds the ragged padding treated as
+        batch-led (recorded PRE-padding) — seeds the trace's provenance
+        so an aux feed whose rows coincide with the padded batch size
+        is never masked or trimmed."""
+        program = self._main_program
         sig = feed_signature(feed_arrays)
         key = (id(program), program._version, tuple(fetch_names), sig,
                registry.amp_enabled())
@@ -186,13 +372,150 @@ class ParallelExecutor(object):
                 raise NotImplementedError(
                     'ParallelExecutor cannot run programs containing host '
                     'ops %s — run them with fluid.Executor' % sorted(set(host)))
+            self.compile_count += 1
             compiled = _SpmdCompiledBlock(program, 0, [n for n, _, _ in sig],
-                                          fetch_names, self._mesh, scope)
+                                          fetch_names, self._mesh,
+                                          self._scope,
+                                          batch_axis=self._batch_axis)
+            # the inference is deterministic in the feed signature, so
+            # setting this once at compile time is consistent for every
+            # later cache hit
+            compiled._batch_feed_names = (
+                frozenset(batch_feed_names)
+                if batch_feed_names is not None else None)
             self._cache[key] = compiled
-        fetches = compiled.run(scope, feed_arrays, self._next_rng())
+        return compiled
+
+    def _convert_fetches(self, fetches, return_numpy, real=0, padded=0,
+                         compiled=None):
+        if real != padded:
+            # a per-sample fetch over a padded lot carries fabricated
+            # rows: trim the BATCH-LED ones (per the trace's provenance,
+            # recorded at compile time) back to the REAL count so eval
+            # loops never score the replicated samples — a parameter
+            # whose dim 0 coincides with the padded size stays whole
+            led = getattr(compiled, '_fetch_batch_led', None) or \
+                [False] * len(fetches)
+            fetches = [
+                f[:real] if is_led and getattr(f, 'ndim', 0) >= 1
+                and np.shape(f)[0] == padded else f
+                for f, is_led in zip(fetches, led)
+            ]
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return [core.LoDTensor(np.asarray(f)) for f in fetches]
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        program = self._main_program
+        feed = feed if feed is not None else (feed_dict or {})
+        fetch_names = self._fetch_names(fetch_list)
+        from .executor import _pop_readers_into_feed
+        feed = dict(feed)
+        _pop_readers_into_feed(program, feed)
+        rpt = {}
+        feed_arrays, real, padded = self._pad_ragged(
+            prepare_feed_arrays(feed), report=rpt)
+        compiled = self._resolve(fetch_names, feed_arrays,
+                                 rpt.get('batch_names'))
+        fetches = compiled.run(self._scope, feed_arrays, self._next_rng())
+        # count only dispatches that actually ran
+        self.dispatch_count += 1
+        self.steps_dispatched += 1
+        return self._convert_fetches(fetches, return_numpy, real, padded,
+                                     compiled=compiled)
+
+    def run_multi(self, fetch_list, feed=None, steps=1, feed_list=None,
+                  return_numpy=True):
+        """Run ``steps`` iterations as ONE GSPMD-sharded device dispatch
+        (the SPMD counterpart of Executor.run_multi; the reference
+        amortizes per-iteration overhead with its double-buffered
+        multi-iteration loop, executor.cc:321-339).  Returns the LAST
+        iteration's fetches; state persists to the scope exactly as
+        ``steps`` sequential run() calls would.
+
+        feed: one lot reused every iteration (fori_loop), OR
+        feed_list: per-iteration lots scanned on device (``steps`` is
+        then len(feed_list)).  Ragged lots — including a ragged FINAL
+        lot in feed_list — are padded to the dp extent with masked
+        samples; loss/grad means weight by the real sample count."""
+        import jax
+        _reject_reader_fed(self._main_program, 'ParallelExecutor.run_multi')
+        fetch_names = self._fetch_names(fetch_list)
+        scanned = None
+        if feed_list is not None:
+            if feed is not None:
+                raise ValueError('run_multi: pass feed OR feed_list')
+            if not feed_list:
+                raise ValueError('run_multi: feed_list is empty')
+            per_step = [prepare_feed_arrays(dict(f)) for f in feed_list]
+            steps = len(per_step)
+            # every lot must share one name set BEFORE any cross-lot
+            # inference walks feed_list[0]'s names over the others
+            names0 = set(per_step[0])
+            for i, fa in enumerate(per_step[1:], 1):
+                if set(fa) != names0:
+                    raise ValueError(
+                        'run_multi: feed_list[%d] differs in names from '
+                        'feed_list[0]' % i)
+            # size probe only — no lot is padded (or pulled off device)
+            # unless something is actually ragged
+            padded = [self._pad_ragged(fa, sizes_only=True)
+                      for fa in per_step]
+            target = max(p[2] for p in padded)
+            real, n_padded = padded[-1][1], target
+            batch_feed_names = None
+            if any(p[2] != target or p[1] != target for p in padded):
+                # at least one lot is ragged (or lots disagree in rows):
+                # re-pad EVERY lot to the common target with a mask so
+                # the scan's per-step structure stays uniform.  The
+                # batch feeds are the ones whose rows VARY across lots;
+                # all-identical lots fall back to the first pass's
+                # inference (which already applied the non-divisible
+                # rule) — a divisible aux feed can't vote either way.
+                batch_names = {
+                    n for n in per_step[0]
+                    if len({_lead(fa[n]) for fa in per_step}) > 1
+                } or {n for n, v in per_step[0].items()
+                      if _lead(v) == padded[0][1]}
+                rpt = {}
+                repadded = [self._pad_ragged(fa, target=target,
+                                             force_mask=True,
+                                             batch_names=batch_names,
+                                             report=rpt)
+                            for fa in per_step]
+                per_step = [p[0] for p in repadded]
+                real = repadded[-1][1]
+                batch_feed_names = rpt.get('batch_names')
+            check_feed_list_uniform(per_step)
+            compiled = self._resolve(fetch_names, per_step[0],
+                                     batch_feed_names)
+            scanned = {
+                n: jax.device_put(stack_steps([fa[n] for fa in per_step]),
+                                  compiled.scanned_sharding(n))
+                for n in per_step[0]
+            }
+            feed_arrays = {}  # every feed name arrives via the scan
+        else:
+            rpt = {}
+            feed_arrays, real, n_padded = self._pad_ragged(
+                prepare_feed_arrays(dict(feed if feed is not None else {})),
+                report=rpt)
+            compiled = self._resolve(fetch_names, feed_arrays,
+                                     rpt.get('batch_names'))
+        fetches = compiled.run_multi(self._scope, feed_arrays,
+                                     self._next_rng(), steps,
+                                     scanned_feeds=scanned)
+        # accounting AFTER the dispatch, so a failed call (steps < 1,
+        # shape error inside jit) can't skew the observability
+        # counters.  Each (steps, scanned shape signature) is its own
+        # XLA compile of the multi-step executable (steps is static).
+        if compiled.note_multi_compile(steps, scanned):
+            self.compile_count += 1
+        self.dispatch_count += 1
+        self.steps_dispatched += int(steps)
+        # fetches come from the LAST iteration: trim to its real rows
+        return self._convert_fetches(fetches, return_numpy, real, n_padded,
+                                     compiled=compiled)
 
     def bcast_params(self):
         """Reference BCastParamsToDevices (parallel_executor.cc:169) — a

@@ -12,7 +12,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .registry import register_lowering, amp_matmul, amp_harmonize
+from .registry import register_lowering, amp_matmul, amp_harmonize, \
+    SAMPLE_MASK_NAME
 
 
 def _flatten_2d(x, num_col_dims):
@@ -173,6 +174,20 @@ def _scale(ctx, op):
 def _mean(ctx, op):
     # fluid MeanOp fixes the output dim to {1} (operators/mean_op.cc)
     x = ctx.get(op, 'X')
+    mask = _batch_mask_for(ctx, op, x)
+    if mask is not None:
+        # ragged-batch lot: rows past the real sample count are padding
+        # the data-parallel executor appended for dp divisibility.  The
+        # mean (and, through jax.vjp, every gradient flowing out of it)
+        # must weight by the REAL count: pad rows contribute 0 to the
+        # numerator and nothing to the denominator, so the padded step
+        # equals the unpadded step bit-for-bit in expectation.
+        m = mask.astype(x.dtype).reshape(
+            (mask.shape[0], ) + (1, ) * (x.ndim - 1))
+        per_row = int(np.prod(x.shape[1:])) if x.ndim > 1 else 1
+        denom = jnp.maximum(jnp.sum(mask.astype(x.dtype)), 1) * per_row
+        ctx.set(op, 'Out', jnp.reshape(jnp.sum(x * m) / denom, (1, )))
+        return
     ctx.set(op, 'Out', jnp.reshape(jnp.mean(x), (1, )))
 
 
@@ -185,12 +200,62 @@ def _reduce_dims(x, op):
     return tuple(d % x.ndim for d in dim)
 
 
+def _batch_mask_for(ctx, op, x):
+    """The ragged-batch sample mask, iff it applies to this op's input:
+    the value must be BATCH-LED (derived from the feeds with the batch
+    still on dim 0, per run_op's provenance tracking) — a weight-derived
+    tensor (weight decay on a [56, ...] parameter, or mean(square(w)))
+    whose dim 0 merely coincides with the padded batch size never
+    masks."""
+    mask = ctx.env.get(SAMPLE_MASK_NAME)
+    if mask is None or x.ndim < 1:
+        return None
+    name = op.input('X')[0]
+    if x.shape[0] == mask.shape[0] and name in ctx.batch_led:
+        return mask
+    if (name in ctx.batch_tainted and x.shape[0] != mask.shape[0]
+            and x.shape[0] % mask.shape[0] == 0):
+        # batch ancestry but a [B*k] leading dim: a flattened batch
+        # (reshape [B,T,..] -> [B*T,..] before the loss) — the sample
+        # mask cannot reach this reduction, so the padding rows WILL
+        # contribute.  Trace-time warning (once per compile), loud
+        # enough to catch the seq-model CE idiom on ragged lots.
+        import warnings
+        warnings.warn(
+            'ragged-batch mask cannot reach %r over %r: its leading dim '
+            '%d looks like a FLATTENED batch (mask covers %d rows) — '
+            'padding rows will contribute to this reduction; keep the '
+            'batch on dim 0 through the loss, or drop the ragged tail'
+            % (op.type, name, x.shape[0], mask.shape[0]))
+    return None
+
+
 def _register_reduce(name, fn):
     @register_lowering('reduce_' + name)
     def _lower(ctx, op, fn=fn):
         x = ctx.get(op, 'X')
         dims = _reduce_dims(x, op)
         keep = op.attrs.get('keep_dim', False)
+        # ragged-batch lots: reduce_mean/reduce_sum over the batch dim
+        # must not count the padding rows (same contract as the 'mean'
+        # op; max/min are naturally immune — the padding replicates a
+        # real row — and prod over batch is not masked)
+        if name in ('mean', 'sum') and (dims is None or 0 in dims):
+            mask = _batch_mask_for(ctx, op, x)
+            if mask is not None:
+                m = mask.astype(x.dtype).reshape(
+                    (mask.shape[0], ) + (1, ) * (x.ndim - 1))
+                out = jnp.sum(x * m, axis=dims, keepdims=keep)
+                if name == 'mean':
+                    axes = tuple(range(x.ndim)) if dims is None else dims
+                    other = int(np.prod([x.shape[a] for a in axes
+                                         if a != 0])) if axes else 1
+                    out = out / (jnp.maximum(
+                        jnp.sum(mask.astype(x.dtype)), 1) * other)
+                if dims is None and not keep:
+                    out = jnp.reshape(out, (1, ))
+                ctx.set(op, 'Out', out)
+                return
         out = fn(x, axis=dims, keepdims=keep)
         if dims is None and not keep:
             out = jnp.reshape(out, (1, ))  # fluid keeps rank-1 [1] output

@@ -127,6 +127,18 @@ class LoweringContext(object):
         # may never execute).
         self.cond_uninit = cond_uninit if cond_uninit is not None else set()
         self.conditional_scope = conditional_scope
+        # ragged-batch provenance: env names whose value is derived from
+        # batch-led feeds AND still carries the batch on dim 0.  Seeded
+        # by the executor from the feed dict when a @SAMPLE_MASK rides
+        # along; propagated per op by run_op.  Batch-reduction lowerings
+        # apply the mask ONLY to members — a weight-derived tensor whose
+        # dim 0 merely coincides with the padded batch size never masks.
+        self.batch_led = set()
+        # ...and names with batch ANCESTRY regardless of current dim 0
+        # (a reshape [B,T,..]->[B*T,..] drops out of batch_led but stays
+        # tainted) — lets the masked lowerings WARN when a flattened
+        # batch reaches a reduction the mask can no longer protect
+        self.batch_tainted = set()
 
     # ---- value access ----
     def get(self, op, slot, default=None):
@@ -183,6 +195,10 @@ class LoweringContext(object):
         # sub-blocks): lowerings that need concrete values (lod_reset
         # offsets, tensor-array indices) behave identically there
         sub.concrete = dict(self.concrete)
+        # grad replays and sub-blocks reuse the parent's names: a
+        # forward value's batch-led provenance must survive into them
+        sub.batch_led = set(self.batch_led)
+        sub.batch_tainted = set(self.batch_tainted)
         return sub
 
 
@@ -190,6 +206,13 @@ class LoweringContext(object):
 # propagate entries); every other op's outputs invalidate stale entries
 _CONCRETE_PRESERVING = {'fill_constant', 'increment', 'assign',
                         'assign_value'}
+
+# reserved feed name for the ragged-batch sample mask (float [B]; 1.0 =
+# real row, 0.0 = padding the data-parallel executor appended to make the
+# lot divisible by the mesh's dp extent).  Batch-mean lowerings consult it
+# so loss/grad means weight by REAL sample count — the DataBalance parity
+# answer (details/data_balance_op_handle.cc) under static SPMD shapes.
+SAMPLE_MASK_NAME = '@SAMPLE_MASK'
 
 SEQLEN_SUFFIX = '@SEQLEN'
 # nested (2-level LoD) tensors additionally carry the OUTER level — the
@@ -242,6 +265,29 @@ def run_op(ctx, op):
         for names in op.outputs.values():
             for n in names:
                 ctx.cond_uninit.discard(n)
+    mask = ctx.env.get(SAMPLE_MASK_NAME)
+    if mask is not None and not op.type.endswith('_grad'):
+        # ragged-batch provenance: an output is batch-led iff any input
+        # was AND it still carries the batch on dim 0 (a transposed-away
+        # batch conservatively drops out — the masked lowerings then
+        # leave that value alone); batch ANCESTRY (tainted) survives any
+        # shape change so the lowerings can warn on flattened batches
+        led = any(n in ctx.batch_led
+                  for names in op.inputs.values() for n in names)
+        tainted = led or any(n in ctx.batch_tainted
+                             for names in op.inputs.values() for n in names)
+        b = mask.shape[0]
+        for names in op.outputs.values():
+            for n in names:
+                v = ctx.env.get(n)
+                if led and getattr(v, 'ndim', 0) >= 1 and v.shape[0] == b:
+                    ctx.batch_led.add(n)
+                else:
+                    ctx.batch_led.discard(n)
+                if tainted:
+                    ctx.batch_tainted.add(n)
+                else:
+                    ctx.batch_tainted.discard(n)
     if op.type in _SEQ_CONSUMERS or op.type.endswith('_grad'):
         return
     for suffix in (SEQLEN_SUFFIX, ROWS_SUFFIX):
@@ -336,6 +382,11 @@ def _make_generic_grad(fwd_type):
                     key = n + suffix
                     if ctx.has(key):
                         seq_entries[key] = ctx.lookup(key)
+        # the ragged-batch sample mask is a global side-band: the vjp
+        # replay of a batch-mean forward must see the same mask the
+        # primal trace saw, or pad rows would re-enter the denominator
+        if ctx.has(SAMPLE_MASK_NAME):
+            seq_entries[SAMPLE_MASK_NAME] = ctx.lookup(SAMPLE_MASK_NAME)
 
         def primal(*diff_vals):
             env2 = dict(seq_entries)

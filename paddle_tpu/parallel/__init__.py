@@ -14,7 +14,7 @@ pipeline stages, 'sp' sequence/context parallel, 'ep' expert parallel.
 import numpy as np
 
 from .mesh import make_mesh, mesh_axes, DeviceMesh
-from .api import shard, sharding_of, PartitionSpec
+from .api import shard, sharding_of, scanned_spec, PartitionSpec
 from .context_parallel import (ring_attention, ulysses_attention,
                                dense_attention)
 from .multihost import init_distributed_env, parse_distributed_env
@@ -23,7 +23,7 @@ from .moe import moe_ffn, moe_ffn_spmd, init_moe_params
 
 __all__ = [
     'make_mesh', 'mesh_axes', 'DeviceMesh', 'shard', 'sharding_of',
-    'PartitionSpec', 'ring_attention', 'ulysses_attention',
+    'scanned_spec', 'PartitionSpec', 'ring_attention', 'ulysses_attention',
     'dense_attention', 'init_distributed_env', 'parse_distributed_env',
     'pipeline_spmd', 'pipeline_apply', 'stack_stage_params',
     'moe_ffn', 'moe_ffn_spmd', 'init_moe_params',

@@ -8,7 +8,7 @@ GSPMD insert collectives.
 
 from jax.sharding import PartitionSpec
 
-__all__ = ['shard', 'sharding_of', 'PartitionSpec']
+__all__ = ['shard', 'sharding_of', 'scanned_spec', 'PartitionSpec']
 
 _ATTR = '_sharding_spec'
 
@@ -27,3 +27,10 @@ def shard(var, *spec):
 
 def sharding_of(var, default=None):
     return getattr(var, _ATTR, default)
+
+
+def scanned_spec(spec):
+    """The PartitionSpec for a K-steps-stacked value: the per-step spec
+    shifted right of an UNsharded leading steps axis (run_multi's
+    scanned feeds: [K, B, ...] with B over 'dp', K over nothing)."""
+    return PartitionSpec(*((None, ) + tuple(spec)))

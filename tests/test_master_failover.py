@@ -128,3 +128,42 @@ def test_replica_background_thread_and_seq_skip(tmp_path):
     finally:
         server.close()
         primary.close()
+
+
+def test_snapshot_seq_is_read_before_blob(tmp_path):
+    """The replication door must pair a blob with a seq read BEFORE
+    serialization: a mutator landing mid-snapshot (e.g. a force-
+    snapshotted poison-task discard) bumping _seq after the blob was
+    built would otherwise let a replica durably record an OLD blob
+    under a NEWER seq — and then skip re-pulling the state that seq
+    promised.  The stale-seq direction is safe (the next pull re-
+    mirrors), so the handler must return the pre-read value."""
+    data = str(tmp_path / 'train.recordio')
+    _write_dataset(data)
+    from paddle_tpu.distributed import MasterServer
+    primary = Master(store_path=str(tmp_path / 'a'),
+                     chunk_timeout_secs=30, failure_max=3)
+    server = MasterServer(primary)
+    try:
+        primary.set_dataset([data], records_per_task=RECORDS_PER_TASK)
+        seq_before = primary._seq
+        orig_snapshot = primary._q.snapshot
+
+        def racing_snapshot():
+            blob = orig_snapshot()
+            # a queue mutation lands while/after the blob serializes
+            primary._seq += 1
+            return blob
+
+        primary._q.snapshot = racing_snapshot
+        cli = MasterClient(server.endpoint)
+        try:
+            _, seq = cli.fetch_snapshot()
+        finally:
+            cli.close()
+        # the pre-read seq, never the concurrently-bumped one
+        assert seq == seq_before, (seq, seq_before)
+    finally:
+        primary._q.snapshot = orig_snapshot
+        server.close()
+        primary.close()

@@ -1,0 +1,550 @@
+"""Multi-step SPMD dispatch + ragged-batch padding (ISSUE 1 tentpole).
+
+ParallelExecutor.run_multi runs K GSPMD-sharded steps in ONE device
+dispatch, sharing Executor.run_multi's scan machinery; data-parallel
+runs accept lots whose batch is not divisible by the dp mesh extent via
+masked padding (DataBalance parity, details/data_balance_op_handle.cc),
+with loss/grad means weighted by the REAL sample count.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import parallel
+
+
+def _build_mlp_model(seed=0, lr=0.5):
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name='img', shape=[64], dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        hidden = fluid.layers.fc(input=img, size=128, act='relu')
+        pred = fluid.layers.fc(input=hidden, size=10, act='softmax')
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    return main, startup, loss
+
+
+def _batch(rng, n):
+    w = np.random.RandomState(7).standard_normal((64, 10)).astype('float32')
+    x = rng.standard_normal((n, 64)).astype('float32')
+    y = np.argmax(x @ w, axis=1).astype('int64')[:, None]
+    return {'img': x, 'label': y}
+
+
+def _single_device_run(batches, seed=3):
+    """Reference trajectory: the plain Executor accepts any batch size."""
+    main, startup, loss = _build_mlp_model(seed=seed)
+    scope = fluid.core.Scope()
+    out = []
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for b in batches:
+            lv, = exe.run(main, feed=b, fetch_list=[loss])
+            out.append(float(np.asarray(lv).flatten()[0]))
+    return out
+
+
+def test_ragged_batch_single_step_matches_unpadded():
+    """batch % ndev != 0 must train (not die on a JAX sharding error)
+    and the masked-padded step must equal the unpadded step: the padded
+    rows' loss/grads are masked out and the mean divides by the REAL
+    sample count."""
+    rng = np.random.RandomState(0)
+    b = _batch(rng, 52)  # 52 % 8 != 0
+    single = _single_device_run([b])
+
+    main, startup, loss = _build_mlp_model(seed=3)
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=main, scope=scope)
+        lv, = pe.run([loss.name], feed=b)
+    np.testing.assert_allclose(single[0],
+                               float(np.asarray(lv).flatten()[0]),
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_ragged_final_batch_epoch_matches_drop_last_equivalent():
+    """An epoch whose FINAL lot is ragged trains through ParallelExecutor
+    with the same loss trajectory as the single-device run on the same
+    lots — including the pinned fetch value on the ragged step — instead
+    of crashing.  (The drop-last workaround is thereby obsolete: the
+    full-lot prefix matches the drop-last run by construction, and the
+    ragged tail trains on top of it.)"""
+    rng = np.random.RandomState(1)
+    batches = [_batch(rng, 64) for _ in range(4)] + [_batch(rng, 52)]
+    single = _single_device_run(batches)
+
+    main, startup, loss = _build_mlp_model(seed=3)
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=main, scope=scope)
+        par = []
+        for b in batches:
+            lv, = pe.run([loss.name], feed=b)
+            par.append(float(np.asarray(lv).flatten()[0]))
+    np.testing.assert_allclose(single, par, rtol=2e-4, atol=1e-5)
+    # the padded compile is bounded: the four full lots share one
+    # executable, the ragged tail adds exactly one masked-shape compile
+    assert pe.compile_count == 2, pe.compile_count
+
+
+def test_run_multi_matches_sequential_spmd_steps():
+    """K steps in ONE sharded dispatch == K sequential pe.run calls
+    (state persists to the scope identically), with bounded compiles."""
+    rng = np.random.RandomState(2)
+    b = _batch(rng, 64)
+
+    main1, startup1, loss1 = _build_mlp_model(seed=5)
+    scope1 = fluid.core.Scope()
+    with fluid.scope_guard(scope1):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup1)
+        pe1 = fluid.ParallelExecutor(
+            loss_name=loss1.name, main_program=main1, scope=scope1)
+        for _ in range(4):
+            seq_out, = pe1.run([loss1.name], feed=b)
+
+    main2, startup2, loss2 = _build_mlp_model(seed=5)
+    scope2 = fluid.core.Scope()
+    with fluid.scope_guard(scope2):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup2)
+        pe2 = fluid.ParallelExecutor(
+            loss_name=loss2.name, main_program=main2, scope=scope2)
+        multi_out, = pe2.run_multi([loss2.name], feed=b, steps=4)
+        np.testing.assert_allclose(np.asarray(seq_out),
+                                   np.asarray(multi_out),
+                                   rtol=2e-4, atol=1e-5)
+        # contract: 4 steps rode ONE device dispatch
+        assert pe2.dispatch_count == 1
+        assert pe2.steps_dispatched == 4
+        # block compile + one multi-step executable
+        assert pe2.compile_count == 2, pe2.compile_count
+        # a second dispatch at the same step count recompiles nothing
+        pe2.run_multi([loss2.name], feed=b, steps=4)
+        assert pe2.compile_count == 2
+        assert pe2.dispatch_count == 2
+        assert pe2.steps_dispatched == 8
+        # state persisted: a following single step continues training
+        next_out, = pe2.run([loss2.name], feed=b)
+        assert np.isfinite(float(np.asarray(next_out).flatten()[0]))
+
+
+def test_run_multi_feed_list_scans_epoch_with_ragged_tail():
+    """A mini-epoch with a ragged FINAL lot scans on device in one
+    dispatch and matches the sequential single-device trajectory's
+    final fetch."""
+    rng = np.random.RandomState(4)
+    batches = [_batch(rng, 64) for _ in range(3)] + [_batch(rng, 52)]
+    single = _single_device_run(batches)
+
+    main, startup, loss = _build_mlp_model(seed=3)
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=main, scope=scope)
+        multi_out, = pe.run_multi([loss.name], feed_list=batches)
+        assert pe.dispatch_count == 1
+        assert pe.steps_dispatched == 4
+    np.testing.assert_allclose(single[-1],
+                               float(np.asarray(multi_out).flatten()[0]),
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_run_multi_rejects_reader_fed_program():
+    """The plain-feed path must refuse py_reader-fed programs (it would
+    otherwise pop ONE minibatch and train K steps on it silently)."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.py_reader(
+            capacity=4, shapes=[(-1, 64), (-1, 1)],
+            dtypes=['float32', 'int64'], name='pe_multi_reader')
+        img, label = fluid.layers.read_file(reader)
+        hidden = fluid.layers.fc(input=img, size=8)
+        loss = fluid.layers.mean(hidden)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=main, scope=scope)
+        with pytest.raises(RuntimeError, match='py_reader'):
+            pe.run_multi([loss.name], feed={'img': np.zeros((8, 64), 'f4'),
+                                            'label': np.zeros((8, 1), 'i8')},
+                         steps=3)
+        with pytest.raises(RuntimeError, match='py_reader'):
+            exe.run_multi(main, feed={'img': np.zeros((8, 64), 'f4'),
+                                      'label': np.zeros((8, 1), 'i8')},
+                          fetch_list=[loss], steps=3)
+
+
+def test_executor_run_multi_compile_count_tracks_scanned_shapes():
+    """The seen-set keys on the full _multi_jit cache key: a feed_list
+    scan whose shape signature differs from an earlier one at the same
+    step count is a real XLA retrace and must count."""
+    rng = np.random.RandomState(5)
+    main, startup, loss = _build_mlp_model(seed=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        base = exe.compile_count
+        b8 = [_batch(rng, 8) for _ in range(2)]
+        exe.run_multi(main, feed_list=b8, fetch_list=[loss])
+        after_first = exe.compile_count
+        assert after_first > base
+        # same steps, same shapes: fully cached
+        exe.run_multi(main, feed_list=b8, fetch_list=[loss])
+        assert exe.compile_count == after_first
+        # same step count, DIFFERENT scanned batch shape: a real retrace
+        b16 = [_batch(rng, 16) for _ in range(2)]
+        exe.run_multi(main, feed_list=b16, fetch_list=[loss])
+        assert exe.compile_count > after_first
+
+
+def test_ragged_inference_ignores_divisible_aux_feed():
+    """A divisible non-batch feed with a LARGER leading dim (a lookup
+    table, an aux input) must not hijack the batch inference: the
+    ragged 52-row lot still pads + masks."""
+    from paddle_tpu.fluid.parallel_executor import pad_ragged_batch
+    from paddle_tpu.ops import registry
+    out, real, padded = pad_ragged_batch(
+        {'img': np.zeros((52, 64), 'float32'),
+         'table': np.zeros((200, 16), 'float32')}, 8)
+    assert (real, padded) == (52, 56)
+    assert out['img'].shape == (56, 64)
+    assert out['table'].shape == (200, 16)  # untouched
+    mask = out[registry.SAMPLE_MASK_NAME]
+    assert mask.shape == (56, ) and mask.sum() == 52
+
+
+def test_ragged_inference_rejects_ambiguous_rows():
+    """Two feeds disagreeing on NON-divisible rows is an error, not a
+    guess — padding the wrong one would feed a wrong-length mask."""
+    from paddle_tpu.fluid.parallel_executor import pad_ragged_batch
+    with pytest.raises(ValueError, match='ambiguous'):
+        pad_ragged_batch({'a': np.zeros((52, 4)), 'b': np.zeros((201, 4))},
+                         8)
+
+
+def test_ragged_skips_annotated_feeds():
+    """A feed with an explicit sharding annotation is laid out per its
+    spec (not dp-sharded on dim 0), so it must not vote in the batch
+    inference nor be padded."""
+    from paddle_tpu.fluid.parallel_executor import pad_ragged_batch
+    out, real, padded = pad_ragged_batch(
+        {'img': np.zeros((52, 64), 'float32'),
+         'table': np.zeros((201, 16), 'float32')}, 8, skip={'table'})
+    assert (real, padded) == (52, 56)
+    assert out['table'].shape == (201, 16)
+
+
+def test_ragged_weight_decay_mean_is_not_masked():
+    """A mean over a WEIGHT-DERIVED tensor whose dim 0 equals the padded
+    batch size — mean(square(w)) weight decay on a [56, ...] fc weight
+    at batch 52 -> 56 — must stay unmasked (batch-led provenance, not
+    shape coincidence, decides).  The wd term is fetched DIRECTLY: in a
+    combined loss the CE term would dominate and swallow a wrongly
+    masked wd at any reasonable tolerance."""
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 3
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data(name='img', shape=[56],
+                                    dtype='float32')
+            label = fluid.layers.data(name='label', shape=[1],
+                                      dtype='int64')
+            pred = fluid.layers.fc(input=img, size=10, act='softmax')
+            ce = fluid.layers.mean(
+                fluid.layers.cross_entropy(input=pred, label=label))
+            w = main.all_parameters()[0]  # [56, 10] — dim 0 == padded B
+            wd = fluid.layers.mean(fluid.layers.square(w))
+            loss = fluid.layers.elementwise_add(ce, wd)
+            fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        return main, startup, loss, wd
+
+    rng = np.random.RandomState(8)
+    b = {'img': rng.standard_normal((52, 56)).astype('float32'),
+         'label': rng.randint(0, 10, (52, 1)).astype('int64')}
+
+    main1, startup1, loss1, wd1 = build()
+    scope1 = fluid.core.Scope()
+    with fluid.scope_guard(scope1):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup1)
+        single, wd_single = exe.run(main1, feed=b,
+                                    fetch_list=[loss1, wd1])
+
+    main2, startup2, loss2, wd2 = build()
+    scope2 = fluid.core.Scope()
+    with fluid.scope_guard(scope2):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup2)
+        pe = fluid.ParallelExecutor(
+            loss_name=loss2.name, main_program=main2, scope=scope2)
+        par, wd_par = pe.run([loss2.name, wd2.name], feed=b)
+    # the wd term itself — a masked lowering would divide by 52*10
+    # instead of 56*10 and zero rows 52-55 out of the numerator
+    np.testing.assert_allclose(float(np.asarray(wd_single).flatten()[0]),
+                               float(np.asarray(wd_par).flatten()[0]),
+                               rtol=1e-6, atol=1e-8)
+    np.testing.assert_allclose(float(np.asarray(single).flatten()[0]),
+                               float(np.asarray(par).flatten()[0]),
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_repad_with_batch_names_ignores_small_aux_feed():
+    """run_multi's re-pad pass (target=) must not let a small divisible
+    aux feed hijack the batch inference: with batch_names given, only
+    those feeds pad and the mask covers the REAL batch rows."""
+    from paddle_tpu.fluid.parallel_executor import pad_ragged_batch
+    from paddle_tpu.ops import registry
+    # the review repro: full lot {img:(6,..), aux:(2,..)}, target 6
+    out, real, padded = pad_ragged_batch(
+        {'img': np.zeros((6, 4), 'float32'),
+         'aux': np.zeros((2, 3), 'float32')}, 2, target=6,
+        force_mask=True, batch_names={'img'})
+    assert (real, padded) == (6, 6)
+    assert out[registry.SAMPLE_MASK_NAME].sum() == 6  # no real row masked
+    assert out['aux'].shape == (2, 3)  # untouched
+    # ...and the ragged lot pads img only
+    out, real, padded = pad_ragged_batch(
+        {'img': np.zeros((5, 4), 'float32'),
+         'aux': np.zeros((2, 3), 'float32')}, 2, target=6,
+        force_mask=True, batch_names={'img'})
+    assert (real, padded) == (5, 6)
+    assert out['img'].shape == (6, 4)
+    assert out['aux'].shape == (2, 3)
+    assert out[registry.SAMPLE_MASK_NAME].tolist() == [1, 1, 1, 1, 1, 0]
+
+
+def test_ragged_per_sample_fetches_are_trimmed():
+    """Fetching a per-sample tensor (predictions) over a ragged lot
+    returns exactly the REAL rows — the replicated padding rows never
+    reach an eval loop."""
+    main, startup, loss = _build_mlp_model(seed=3)
+    pred_name = None
+    for op in main.global_block().ops:
+        if op.type == 'softmax':
+            pred_name = op.output('Out')[0]
+    assert pred_name is not None
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=main, scope=scope)
+        rng = np.random.RandomState(6)
+        lv, pv = pe.run([loss.name, pred_name], feed=_batch(rng, 52))
+        assert np.asarray(pv).shape == (52, 10), np.asarray(pv).shape
+        assert np.isfinite(np.asarray(pv)).all()
+
+
+def test_ragged_reduce_mean_loss_matches_unpadded():
+    """The reduce_mean idiom (fluid.layers.reduce_mean over per-sample
+    losses) must weight by the REAL sample count on a ragged lot, same
+    as the 'mean' op."""
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 3
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data(name='img', shape=[64],
+                                    dtype='float32')
+            label = fluid.layers.data(name='label', shape=[1],
+                                      dtype='int64')
+            pred = fluid.layers.fc(input=img, size=10, act='softmax')
+            ce = fluid.layers.cross_entropy(input=pred, label=label)
+            loss = fluid.layers.reduce_mean(ce)
+            fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(9)
+    b = _batch(rng, 52)
+
+    main1, startup1, loss1 = build()
+    scope1 = fluid.core.Scope()
+    with fluid.scope_guard(scope1):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup1)
+        single, = exe.run(main1, feed=b, fetch_list=[loss1])
+
+    main2, startup2, loss2 = build()
+    scope2 = fluid.core.Scope()
+    with fluid.scope_guard(scope2):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup2)
+        pe = fluid.ParallelExecutor(
+            loss_name=loss2.name, main_program=main2, scope=scope2)
+        par, = pe.run([loss2.name], feed=b)
+    np.testing.assert_allclose(float(np.asarray(single).flatten()[0]),
+                               float(np.asarray(par).flatten()[0]),
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_run_multi_feed_list_rejects_mixed_dtypes():
+    """Same shapes but different dtypes must raise the clear uniformity
+    error, not silently promote the stacked scan axis."""
+    main, startup, loss = _build_mlp_model(seed=0)
+    rng = np.random.RandomState(0)
+    b1 = _batch(rng, 8)
+    b2 = _batch(rng, 8)
+    b2['img'] = b2['img'].astype('float64')
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(ValueError, match='dtypes'):
+            exe.run_multi(main, feed_list=[b1, b2], fetch_list=[loss])
+
+
+def test_feed_list_uniform_accepts_lod_free_lodtensors():
+    """Identically-shaped lod-free LoDTensor batches must pass the
+    uniformity check (np.shape on a LoDTensor returns its bound .shape
+    METHOD, which never compares equal across instances)."""
+    from paddle_tpu.fluid.executor import check_feed_list_uniform
+    a = fluid.core.LoDTensor(np.zeros((4, 3), 'float32'))
+    b = fluid.core.LoDTensor(np.ones((4, 3), 'float32'))
+    check_feed_list_uniform([{'x': a}, {'x': b}])  # must not raise
+
+
+def test_ragged_parameter_fetch_is_not_trimmed():
+    """Trimming consults batch-led provenance: a PARAMETER fetch whose
+    dim 0 coincides with the padded batch size ([56, 10] weight at
+    batch 52 -> 56) must come back whole; only batch-led fetches trim."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name='img', shape=[56], dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        pred = fluid.layers.fc(input=img, size=10, act='softmax')
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    w = main.all_parameters()[0]  # [56, 10]
+    rng = np.random.RandomState(8)
+    b = {'img': rng.standard_normal((52, 56)).astype('float32'),
+         'label': rng.randint(0, 10, (52, 1)).astype('int64')}
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=main, scope=scope)
+        wv, pv, lv = pe.run([w.name, pred.name, loss.name], feed=b)
+    assert np.asarray(wv).shape == (56, 10)   # parameter: whole
+    assert np.asarray(pv).shape == (52, 10)   # batch-led: trimmed
+    assert np.isfinite(np.asarray(lv)).all()
+
+
+def test_ragged_flattened_batch_loss_warns():
+    """A loss over a FLATTENED batch (reshape [B,..] -> [B*k,..] before
+    the mean) is beyond the sample mask's reach: the trace must emit a
+    loud warning instead of silently diverging."""
+    import warnings as _warnings
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 3
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name='img', shape=[64], dtype='float32')
+        h = fluid.layers.fc(input=img, size=8)
+        flat = fluid.layers.reshape(h, shape=[-1, 2])  # [B*4, 2]
+        loss = fluid.layers.mean(flat)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    rng = np.random.RandomState(1)
+    b = {'img': rng.standard_normal((52, 64)).astype('float32')}
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=main, scope=scope)
+        with _warnings.catch_warnings(record=True) as caught:
+            _warnings.simplefilter('always')
+            pe.run([loss.name], feed=b)
+        assert any('FLATTENED batch' in str(w.message) for w in caught), \
+            [str(w.message) for w in caught]
+
+
+def test_ragged_coinciding_aux_feed_not_masked_or_trimmed():
+    """An aux feed with exactly padded-batch-size rows (52 -> 56, aux
+    fed with 56 rows) must be neither masked in reductions nor trimmed
+    in fetches: the padding records which feeds were batch PRE-padding
+    and seeds the trace's provenance from that, not from shape
+    coincidence."""
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 3
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data(name='img', shape=[64],
+                                    dtype='float32')
+            label = fluid.layers.data(name='label', shape=[1],
+                                      dtype='int64')
+            tbl = fluid.layers.data(name='tbl', shape=[4],
+                                    dtype='float32')
+            pred = fluid.layers.fc(input=img, size=10, act='softmax')
+            ce = fluid.layers.mean(
+                fluid.layers.cross_entropy(input=pred, label=label))
+            aux = fluid.layers.mean(tbl)
+            loss = fluid.layers.elementwise_add(ce, aux)
+            fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        return main, startup, loss, aux
+
+    rng = np.random.RandomState(11)
+    b = _batch(rng, 52)
+    b['tbl'] = rng.standard_normal((56, 4)).astype('float32')
+
+    main1, startup1, loss1, aux1 = build()
+    scope1 = fluid.core.Scope()
+    with fluid.scope_guard(scope1):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup1)
+        aux_single, = exe.run(main1, feed=b, fetch_list=[aux1])
+
+    main2, startup2, loss2, aux2 = build()
+    scope2 = fluid.core.Scope()
+    with fluid.scope_guard(scope2):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup2)
+        pe = fluid.ParallelExecutor(
+            loss_name=loss2.name, main_program=main2, scope=scope2)
+        aux_par, tbl_back = pe.run([aux2.name, 'tbl'], feed=b)
+    # masked-by-coincidence would give sum(tbl[:52])/(52*4), not the
+    # true mean over all 56 rows
+    np.testing.assert_allclose(float(np.asarray(aux_single).flatten()[0]),
+                               float(np.asarray(aux_par).flatten()[0]),
+                               rtol=1e-6, atol=1e-8)
+    # ...and the aux fetch must come back whole, not trimmed to 52
+    assert np.asarray(tbl_back).shape == (56, 4)
+
+
+def test_run_multi_feed_list_name_mismatch_is_a_clear_error():
+    """Lots disagreeing in NAMES (with one ragged, which routes through
+    the re-pad pass) must raise the uniformity ValueError, not a raw
+    KeyError from the batch-name inference."""
+    main, startup, loss = _build_mlp_model(seed=0)
+    rng = np.random.RandomState(0)
+    b1 = _batch(rng, 64)
+    b2 = {'img': _batch(rng, 52)['img']}  # missing 'label', and ragged
+    exe_scope = fluid.core.Scope()
+    with fluid.scope_guard(exe_scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=main, scope=exe_scope)
+        with pytest.raises(ValueError, match='names'):
+            pe.run_multi([loss.name], feed_list=[b1, b2])
