@@ -21,10 +21,12 @@ step — so nothing rides piggyback on the headline record
 (VERDICT r2 next-#10).
 
 Configs (reference benchmark/fluid suite + the contrib/float16 flow).
-All TRAIN configs are device-true via Executor.run_multi (K steps per
-device dispatch, in-jit fori_loop) and report uniform
-device_true/steps_per_dispatch fields; the inference config remains
-per-dispatch pipelined (the ledger in ROADMAP Open items):
+ALL configs are device-true with uniform device_true/steps_per_dispatch
+fields: TRAIN configs via Executor.run_multi (K steps per device
+dispatch, in-jit fori_loop), the inference config via
+Executor.run_eval_multi (K eval steps per dispatch, in-jit lax.scan
+collecting every step's predictions — the serving engine's executable,
+closing the ROADMAP dispatch-tax ledger):
   resnet             ResNet-50 ImageNet train, bs512 224^2 (models/resnet.py)
   nmt                WMT14 seq2seq+attention 512/512/512 dict30k, bs512 seq32
   transformer        transformer-base 6L d512 ff2048 h8, bs128 seq256
@@ -300,7 +302,14 @@ def bench_resnet_infer_bf16(on_tpu, steps=10):
     the same rewrite on V100): ResNet-50 eval program, f32 vs
     transpiled-bf16, interleaved in THIS process so the ratio is
     drift-free.  value = bf16 imgs/sec; speedup_vs_f32 is the paired
-    ratio."""
+    ratio.
+
+    DEVICE-TRUE (closing the last dispatch-tax ledger row): each timed
+    block is ONE Executor.run_eval_multi dispatch — `steps` eval
+    iterations as an in-jit lax.scan collecting every step's
+    predictions — so wall clock measures the chip, not the ~100ms
+    tunnel round trip per dispatch.  The serving engine
+    (paddle_tpu.serving) rides the same executable."""
     import tempfile
     import paddle_tpu.fluid as fluid
     from paddle_tpu.models import resnet
@@ -308,6 +317,7 @@ def bench_resnet_infer_bf16(on_tpu, steps=10):
     batch = 256 if on_tpu else 4
     shape = (3, 224, 224) if on_tpu else (3, 32, 32)
     blocks = 3 if on_tpu else 1
+    k = steps if on_tpu else 4  # steps per dispatch (CPU smoke: small)
     model = resnet.build(depth=50 if on_tpu else 18, class_dim=1000,
                          image_shape=shape, lr=0.1)
     place = fluid.TPUPlace() if on_tpu else fluid.CPUPlace()
@@ -331,24 +341,20 @@ def bench_resnet_infer_bf16(on_tpu, steps=10):
                     prog, scope=scope, dtype='bfloat16',
                     feeded_var_names=feeds, fetch_var_names=fetches)
             staged = _stage({feeds[0]: x}, on_tpu)
-            # warm BOTH compile-cache entries the timed block hits:
-            # fetch_list=[] and fetch_list=fetches each key a separate
-            # executable (as bench_stacked_lstm warms both of its
-            # single-step entries) — otherwise an off-TPU single-block
-            # run times an XLA compile inside its only block
-            for _ in range(2):
-                exe.run(prog, feed=staged, fetch_list=[])
-                exe.run(prog, feed=staged, fetch_list=fetches)
+            # warm with the SAME k — `steps` is a static jit argument of
+            # the eval scan, so a different-steps warmup would leave the
+            # timed executable uncompiled (the run_multi lesson)
+            exe.run_eval_multi(prog, feed=staged, fetch_list=fetches,
+                               steps=k)
 
         def block():
             with fluid.scope_guard(scope):
                 t0 = time.time()
-                for _ in range(steps - 1):
-                    exe.run(prog, feed=staged, fetch_list=[])
-                out, = exe.run(prog, feed=staged, fetch_list=fetches)
+                out, = exe.run_eval_multi(prog, feed=staged,
+                                          fetch_list=fetches, steps=k)
                 el = time.time() - t0
             assert np.isfinite(np.asarray(out)).all()
-            return batch * steps / el
+            return batch * k / el
 
         return block
 
@@ -364,16 +370,15 @@ def bench_resnet_infer_bf16(on_tpu, steps=10):
     return {
         'metric': 'resnet50_infer_bf16_imgs_per_sec_per_chip',
         'value': round(max(bf16_v), 2), 'unit': 'imgs/sec',
-        'ms_per_step': round(batch * steps / max(bf16_v) / steps * 1000, 2),
+        'ms_per_step': round(batch * k / max(bf16_v) / k * 1000, 2),
         'ms_per_step_mean': None,
         'mfu': None,
         'vs_baseline': None,  # reference published V100 fp16 numbers only
         'f32_imgs_per_sec': round(max(f32_v), 2),
         'speedup_vs_f32': round(max(ratios), 3),
-        # pipelined per-dispatch inference timing (fetch-drain), not the
-        # in-jit multi-step loop — the remaining dispatch-tax ledger
-        # entry (ROADMAP Open items)
-        'device_true': False, 'steps_per_dispatch': 1,
+        # uniform with the train configs: K in-jit eval steps per
+        # dispatch via run_eval_multi (ROADMAP dispatch-tax ledger)
+        'device_true': True, 'steps_per_dispatch': k,
     }
 
 

@@ -32,6 +32,7 @@ from . import reader  # noqa: F401
 from . import dataset  # noqa: F401
 from . import parallel  # noqa: F401
 from . import inference  # noqa: F401
+from . import serving  # noqa: F401  (after fluid: it builds on it)
 
 
 def batch(reader_creator, batch_size, drop_last=False):
@@ -56,5 +57,5 @@ def batch(reader_creator, batch_size, drop_last=False):
 from . import v2  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
 
-__all__ = ['fluid', 'reader', 'dataset', 'parallel', 'inference', 'batch',
-           'v2', 'distributed']
+__all__ = ['fluid', 'reader', 'dataset', 'parallel', 'inference',
+           'serving', 'batch', 'v2', 'distributed']

@@ -236,6 +236,18 @@ def check_feed_list_uniform(per_step):
                 'batches by bucket)' % i)
 
 
+def check_feed_list_names(per_step, what):
+    """Every lot must share feed_list[0]'s NAME set before any
+    cross-lot inference walks those names over the others (shared by
+    run_multi and run_eval_multi on both executors)."""
+    names0 = set(per_step[0])
+    for i, fa in enumerate(per_step[1:], 1):
+        if set(fa) != names0:
+            raise ValueError(
+                '%s: feed_list[%d] differs in names from feed_list[0]'
+                % (what, i))
+
+
 def prepare_feed_list(feed_list):
     """Normalize a run_multi feed_list: one prepared feed dict per
     iteration, uniform across steps.  Returns (steps, per_step).
@@ -258,6 +270,41 @@ def stack_steps(vals):
     if all(isinstance(v, jax.Array) for v in vals):
         return jnp.stack(vals)
     return np.stack([np.asarray(v) for v in vals])
+
+
+def fetch_batch_led(compiled, n):
+    """The trace's batch-led provenance side channel, defaulting to
+    all-False before the first trace: the ONE reading of
+    ``_fetch_batch_led`` shared by every consumer that trims padded
+    rows (convert_eval_fetches, ParallelExecutor._convert_fetches, the
+    serving engine's per-request slicer) — so a change to the side
+    channel's convention has a single place to land."""
+    return getattr(compiled, '_fetch_batch_led', None) or [False] * n
+
+
+def convert_eval_fetches(stacked, reals, target, compiled, steps,
+                         return_numpy):
+    """Host-side back half of run_eval_multi (shared by Executor and
+    ParallelExecutor): convert each [K, ...]-stacked fetch, trimming
+    BATCH-LED fetches (per the trace's provenance side channel) from the
+    padded row count ``target`` back to the per-step real counts.  Equal
+    real counts trim as one slice (still a stacked array); unequal ones
+    come back as a list of K per-step arrays."""
+    led = fetch_batch_led(compiled, len(stacked))
+    out = []
+    for arr, is_led in zip(stacked, led):
+        a = np.asarray(arr)
+        if reals is not None and is_led and a.ndim >= 2 \
+                and a.shape[1] == target:
+            if len(set(reals)) == 1:
+                a = a[:, :reals[0]]
+            else:
+                per = [a[i][:reals[i]] for i in range(steps)]
+                out.append(per if return_numpy else
+                           [core.LoDTensor(p) for p in per])
+                continue
+        out.append(a if return_numpy else core.LoDTensor(a))
+    return out
 
 
 def _reject_reader_fed(program, what):
@@ -665,22 +712,119 @@ class _CompiledBlock(object):
                 donate_argnums=(0, ) if self.state_rw else ())
         return self._multi_jit
 
-    def note_multi_compile(self, steps, scanned):
+    def note_multi_compile(self, steps, scanned, seen_attr='_multi_steps_seen'):
         """True exactly when this (steps, scanned shape signature) pair
         has not run before — i.e. the coming dispatch is a real XLA
         retrace (`steps` is a static jit argument; each scanned
         structure/shape retraces too).  Shared compile_count
         bookkeeping for Executor.run_multi and
-        ParallelExecutor.run_multi."""
-        seen = getattr(self, '_multi_steps_seen', None)
+        ParallelExecutor.run_multi (and, via ``seen_attr``, their
+        run_eval_multi counterparts — the eval scan is a different
+        executable, so its retraces are tracked separately)."""
+        seen = getattr(self, seen_attr, None)
         if seen is None:
-            seen = self._multi_steps_seen = set()
+            seen = set()
+            setattr(self, seen_attr, seen)
         key = (int(steps),
                feed_signature(scanned) if scanned is not None else None)
         if key in seen:
             return False
         seen.add(key)
         return True
+
+    def note_eval_compile(self, steps, scanned):
+        """note_multi_compile for the EVAL scan's executable cache."""
+        return self.note_multi_compile(steps, scanned,
+                                       seen_attr='_eval_steps_seen')
+
+    def _make_eval_multi(self):
+        """The K-EVAL-batches-per-dispatch function: lax.scan over the
+        lots, collecting EVERY iteration's fetches stacked on a leading
+        K axis — inference serving wants all K results, unlike
+        _make_multi's train loop which only surfaces the last step's.
+        State still threads through the carry (an eval program normally
+        writes none, but e.g. metric accumulators stay correct).
+        Shared by the single-device and SPMD executors — only the jit
+        wrapping (shardings) differs, exactly like _make_multi."""
+        import jax
+        import jax.numpy as jnp
+        fn = self._fn
+        rw_keys = list(self.state_rw)
+
+        def eval_multi(state_rw, state_ro, feeds, scanned, rng, n):
+            def body(s, sl):
+                i, per_step = sl
+                merged = dict(feeds)
+                merged.update(per_step)
+                new_state, fetches = fn(s, state_ro, merged,
+                                        jax.random.fold_in(rng, i))
+                return ({k: new_state.get(k, s[k])
+                         for k in rw_keys}, fetches)
+
+            final, stacked = jax.lax.scan(
+                body, state_rw, (jnp.arange(n), scanned))
+            return final, stacked
+
+        return eval_multi
+
+    def _wrap_eval_multi_jit(self, feeds, scanned, donate):
+        """jit wrapping for the eval scan; _SpmdCompiledBlock overrides
+        this to attach per-structure GSPMD shardings."""
+        import jax
+        return jax.jit(self._make_eval_multi(), static_argnums=(5, ),
+                       donate_argnums=donate)
+
+    def _get_eval_multi_jit(self, feeds, scanned):
+        """One eval-scan executable per (feeds, scanned) name structure.
+        The scanned K-lot input block is DONATED: it is dead the moment
+        the scan consumed it, so XLA recycles the buffer in place — two
+        pipelined serving dispatches then double-buffer the feed block
+        instead of holding 2x K lots of input alive."""
+        key = (tuple(sorted(feeds)), tuple(sorted(scanned)))
+        cache = getattr(self, '_eval_jits', None)
+        if cache is None:
+            cache = self._eval_jits = {}
+        jitted = cache.get(key)
+        if jitted is None:
+            donate = (0, ) if self.state_rw else ()
+            if scanned and self._device_platform() != 'cpu':
+                # XLA CPU can't alias the scanned block (it would warn
+                # and copy); on device the donation is the point
+                donate = donate + (3, )
+            jitted = self._wrap_eval_multi_jit(feeds, scanned, donate)
+            cache[key] = jitted
+        return jitted
+
+    def _device_platform(self):
+        try:
+            return self.place.jax_device().platform
+        except Exception:
+            return 'cpu'
+
+    def run_eval_multi(self, scope, feed_values, rng_key, steps,
+                       scanned_feeds=None):
+        """K EVAL iterations in ONE device dispatch, returning every
+        iteration's fetches stacked on a leading K axis (run_multi's
+        inference analog — the remaining dispatch-tax ledger row).
+        feed_values: feeds held constant across iterations (the bench's
+        repeated-batch form); scanned_feeds: {name: [K, ...]} per-lot
+        slices (the serving engine's form)."""
+        if steps < 1:
+            raise ValueError('run_eval_multi: steps must be >= 1, got %r'
+                             % (steps, ))
+        if any(_is_host_op(op) for op in self.ops):
+            raise RuntimeError(
+                'run_eval_multi: the program contains host ops and cannot '
+                'run as one on-device loop — use run() per step')
+        state_rw, state_ro, feeds = self._materialize_args(
+            scope, feed_values, cache_ro=True)
+        scanned = scanned_feeds or {}
+        jitted = self._get_eval_multi_jit(feeds, scanned)
+        new_state, stacked = jitted(state_rw, state_ro, feeds, scanned,
+                                    rng_key, int(steps))
+        for name, val in new_state.items():
+            scope.var(name).set_value(val)
+        return stacked
 
 
 class Executor(object):
@@ -943,6 +1087,101 @@ class Executor(object):
         fetches = compiled.run_multi(scope, feed_arrays, rng, steps,
                                      scanned_feeds=scanned)
         return self._convert_fetches(fetches, return_numpy)
+
+    def _dispatch_eval_multi(self,
+                             program=None,
+                             feed=None,
+                             fetch_list=None,
+                             steps=None,
+                             scope=None,
+                             feed_list=None):
+        """Async front half of run_eval_multi: resolve + compile, pad
+        ragged lots to one shape bucket, dispatch ONE scanned eval, and
+        return ``(stacked_fetches, reals, target, compiled, k)`` with NO
+        host sync — the serving engine drives this directly so the host
+        can feed dispatch N+1 (and trim/deliver N-1) while N still
+        computes on device.  ``reals`` is the per-step real row count
+        (None when nothing was padded), ``target`` the padded rows."""
+        program = _reject_reader_fed(program, 'run_eval_multi')
+        reals, target, batch_feed_names, per_step = None, None, None, None
+        if feed_list is not None:
+            if feed is not None:
+                raise ValueError('run_eval_multi: pass feed OR feed_list')
+            if not feed_list:
+                raise ValueError('run_eval_multi: feed_list is empty')
+            per_step = [prepare_feed_arrays(dict(f)) for f in feed_list]
+            check_feed_list_names(per_step, 'run_eval_multi')
+            from .parallel_executor import pad_ragged_batch, \
+                normalize_ragged_feed_list
+            per_step, reals, target, batch_feed_names = \
+                normalize_ragged_feed_list(
+                    per_step, lambda fa, **kw: pad_ragged_batch(fa, 1, **kw))
+            steps = len(per_step)
+            check_feed_list_uniform(per_step)
+            feed = per_step[0]
+        elif steps is None:
+            raise ValueError('run_eval_multi: pass steps= with feed=')
+        steps = int(steps)
+        program, scope, feed_arrays, compiled = self._resolve_and_compile(
+            program, feed, fetch_list, scope)
+        if batch_feed_names is not None and \
+                getattr(compiled, '_batch_feed_names', None) is None:
+            # deterministic in the feed signature (which keys the cache
+            # entry), so setting it once at first resolve is consistent
+            # for every later hit — same contract as ParallelExecutor
+            compiled._batch_feed_names = frozenset(batch_feed_names)
+        scanned = None
+        if per_step is not None:
+            import jax
+            dev = self.place.jax_device()
+            scanned = {
+                n: jax.device_put(
+                    stack_steps([fa[n] for fa in per_step]), dev)
+                for n in per_step[0]
+            }
+            feed_arrays = {}  # every feed name arrives via the scan
+        rng = self._next_rng(program)
+        if compiled.note_eval_compile(steps, scanned):
+            self.compile_count += 1
+        stacked = compiled.run_eval_multi(scope, feed_arrays, rng, steps,
+                                          scanned_feeds=scanned)
+        return stacked, reals, target, compiled, steps
+
+    def run_eval_multi(self,
+                       program=None,
+                       feed=None,
+                       fetch_list=None,
+                       steps=None,
+                       scope=None,
+                       return_numpy=True,
+                       feed_list=None):
+        """Run ``steps`` EVAL iterations of the program as ONE device
+        dispatch and return EVERY iteration's fetches — the inference
+        analog of run_multi (which surfaces only the last step), closing
+        the dispatch-tax ledger's last row.  Returns one entry per
+        fetch: a [K, ...]-stacked array, except batch-led fetches over
+        ragged lots of UNEQUAL real row counts, which come back as a
+        list of K per-step arrays trimmed to each lot's real rows.
+
+        feed: one batch evaluated ``steps`` times (the bench's
+        device-true timing form), OR feed_list: per-iteration lots
+        scanned on device (the serving engine's form; ``steps`` is then
+        len(feed_list)).  Ragged lots are padded to one shape bucket
+        with masked replicated rows and trimmed on the way out."""
+        from . import profiler as _profiler
+
+        def go():
+            stacked, reals, target, compiled, k = self._dispatch_eval_multi(
+                program, feed=feed, fetch_list=fetch_list, steps=steps,
+                scope=scope, feed_list=feed_list)
+            return convert_eval_fetches(stacked, reals, target, compiled,
+                                        k, return_numpy)
+
+        if _profiler.is_profiler_enabled():
+            with _profiler.record_block(
+                    'executor_run_eval_multi/block0'):
+                return go()  # np.asarray in the conversion drains
+        return go()
 
     def _convert_fetches(self, fetches, return_numpy):
         def convert(f):

@@ -1,6 +1,13 @@
-"""High-level Inferencer (reference: python/paddle/fluid/inferencer.py:31)."""
+"""High-level Inferencer (reference: python/paddle/fluid/inferencer.py:31).
 
-import contextlib
+Re-implemented on paddle_tpu.serving.InferenceEngine: infer() routes
+through the engine's synchronous (inline) mode — same micro-batch
+padding/trim, shape-bucket, and run_eval_multi dispatch path the
+request-facing server uses, so the two surfaces cannot drift.  A
+single-caller Inferencer keeps its old behavior (one lot per call, no
+background thread); pass ``parallel=True`` for dp-sharded eval over the
+device mesh.
+"""
 
 from . import core
 from .framework import Program, program_guard
@@ -35,13 +42,26 @@ class Inferencer(object):
 
         self.inference_program = self.inference_program.clone(for_test=True)
 
+        # the serving package imports fluid submodules, so pull it in at
+        # construction time (this module loads during fluid's own
+        # package init, before serving exists)
+        from .. import serving
+        self._engine = serving.InferenceEngine(
+            self.inference_program,
+            fetch_list=[self.predict_var],
+            place=self.place,
+            scope=self.scope,
+            executor=self.exe,
+            parallel=parallel,
+            config=serving.ServingConfig(steps_per_dispatch=1,
+                                         pipeline_depth=1))
+
     def infer(self, inputs, return_numpy=True):
+        """Run one inference request through the serving engine.  Feeds
+        whose leading (batch) dims disagree raise a clear ValueError
+        (mirroring run_multi's feed guards) instead of failing inside
+        XLA."""
         if not isinstance(inputs, dict):
             raise ValueError('inputs should be a dict of {name: data}')
         with scope_guard(self.scope):
-            results = self.exe.run(
-                self.inference_program,
-                feed=inputs,
-                fetch_list=[self.predict_var.name],
-                return_numpy=return_numpy)
-        return results
+            return self._engine.infer(inputs, return_numpy=return_numpy)

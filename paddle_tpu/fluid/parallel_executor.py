@@ -18,7 +18,8 @@ import numpy as np
 from . import core
 from .executor import _CompiledBlock, _current_scope, \
     prepare_feed_arrays, feed_signature, _is_host_op, \
-    _reject_reader_fed, check_feed_list_uniform, stack_steps
+    _reject_reader_fed, check_feed_list_uniform, stack_steps, \
+    check_feed_list_names
 from .framework import default_main_program, Variable
 from ..ops import registry
 
@@ -134,6 +135,38 @@ def pad_ragged_batch(feed_arrays, multiple, target=None, force_mask=False,
     mask[:b] = 1.0
     out[registry.SAMPLE_MASK_NAME] = mask
     return out, b, tgt
+
+
+def normalize_ragged_feed_list(per_step, pad_fn):
+    """Shared ragged-feed_list normalization behind run_multi and
+    run_eval_multi (single-device and SPMD): size-probe every lot, and
+    when any is ragged (or lots disagree in rows) re-pad ALL of them to
+    the common target with masked samples so the scan's per-step
+    structure stays uniform.  The batch feeds are the ones whose rows
+    VARY across lots; all-identical lots fall back to the first pass's
+    inference — a divisible aux feed can't vote either way.
+
+    pad_fn(feed_arrays, **kw) -> (feed_arrays, n_real, n_padded) — the
+    executor's padding policy (multiple=1 for single-device,
+    ParallelExecutor._pad_ragged for the dp-extent rule).
+
+    Returns (per_step, reals, target, batch_feed_names); ``reals`` is
+    the per-lot real row count, or None when nothing was padded."""
+    probed = [pad_fn(fa, sizes_only=True) for fa in per_step]
+    target = max(p[2] for p in probed)
+    if not any(p[2] != target or p[1] != target for p in probed):
+        return per_step, None, target, None
+    batch_names = {
+        n for n in per_step[0]
+        if len({_lead(fa[n]) for fa in per_step}) > 1
+    } or {n for n, v in per_step[0].items()
+          if _lead(v) == probed[0][1]}
+    rpt = {}
+    repadded = [pad_fn(fa, target=target, force_mask=True,
+                       batch_names=batch_names, report=rpt)
+                for fa in per_step]
+    return ([p[0] for p in repadded], [p[1] for p in repadded], target,
+            rpt.get('batch_names'))
 
 
 class ExecutionStrategy(object):
@@ -270,6 +303,24 @@ class _SpmdCompiledBlock(_CompiledBlock):
             cache[key] = jitted
         return jitted
 
+    def _device_platform(self):
+        return self.mesh.devices.flat[0].platform
+
+    def _wrap_eval_multi_jit(self, feeds, scanned, donate):
+        """The shared K-eval-batches-per-dispatch scan, jitted with this
+        block's GSPMD shardings (feeds/lots sharded batch-dim over 'dp'
+        for sharded serving) and the base class's donation plan."""
+        import jax
+        rw_sh = {n: self._state_shardings[n] for n in self.state_rw}
+        ro_sh = {n: self._state_shardings[n] for n in self.state_ro}
+        feed_sh = {n: self._feed_shardings[n] for n in feeds}
+        scanned_sh = {n: self.scanned_sharding(n) for n in scanned}
+        return jax.jit(
+            self._make_eval_multi(), static_argnums=(5, ),
+            in_shardings=(rw_sh, ro_sh, feed_sh, scanned_sh, None),
+            out_shardings=(self._out_state_shardings, None),
+            donate_argnums=donate)
+
 
 class ParallelExecutor(object):
     """API parity with reference parallel_executor.py:36."""
@@ -394,8 +445,8 @@ class ParallelExecutor(object):
             # recorded at compile time) back to the REAL count so eval
             # loops never score the replicated samples — a parameter
             # whose dim 0 coincides with the padded size stays whole
-            led = getattr(compiled, '_fetch_batch_led', None) or \
-                [False] * len(fetches)
+            from .executor import fetch_batch_led
+            led = fetch_batch_led(compiled, len(fetches))
             fetches = [
                 f[:real] if is_led and getattr(f, 'ndim', 0) >= 1
                 and np.shape(f)[0] == padded else f
@@ -449,43 +500,13 @@ class ParallelExecutor(object):
                 raise ValueError('run_multi: feed_list is empty')
             per_step = [prepare_feed_arrays(dict(f)) for f in feed_list]
             steps = len(per_step)
-            # every lot must share one name set BEFORE any cross-lot
-            # inference walks feed_list[0]'s names over the others
-            names0 = set(per_step[0])
-            for i, fa in enumerate(per_step[1:], 1):
-                if set(fa) != names0:
-                    raise ValueError(
-                        'run_multi: feed_list[%d] differs in names from '
-                        'feed_list[0]' % i)
+            check_feed_list_names(per_step, 'run_multi')
             # size probe only — no lot is padded (or pulled off device)
             # unless something is actually ragged
-            padded = [self._pad_ragged(fa, sizes_only=True)
-                      for fa in per_step]
-            target = max(p[2] for p in padded)
-            real, n_padded = padded[-1][1], target
-            batch_feed_names = None
-            if any(p[2] != target or p[1] != target for p in padded):
-                # at least one lot is ragged (or lots disagree in rows):
-                # re-pad EVERY lot to the common target with a mask so
-                # the scan's per-step structure stays uniform.  The
-                # batch feeds are the ones whose rows VARY across lots;
-                # all-identical lots fall back to the first pass's
-                # inference (which already applied the non-divisible
-                # rule) — a divisible aux feed can't vote either way.
-                batch_names = {
-                    n for n in per_step[0]
-                    if len({_lead(fa[n]) for fa in per_step}) > 1
-                } or {n for n, v in per_step[0].items()
-                      if _lead(v) == padded[0][1]}
-                rpt = {}
-                repadded = [self._pad_ragged(fa, target=target,
-                                             force_mask=True,
-                                             batch_names=batch_names,
-                                             report=rpt)
-                            for fa in per_step]
-                per_step = [p[0] for p in repadded]
-                real = repadded[-1][1]
-                batch_feed_names = rpt.get('batch_names')
+            per_step, reals, target, batch_feed_names = \
+                normalize_ragged_feed_list(per_step, self._pad_ragged)
+            real, n_padded = \
+                (reals[-1] if reals is not None else target), target
             check_feed_list_uniform(per_step)
             compiled = self._resolve(fetch_names, per_step[0],
                                      batch_feed_names)
@@ -516,6 +537,72 @@ class ParallelExecutor(object):
         # fetches come from the LAST iteration: trim to its real rows
         return self._convert_fetches(fetches, return_numpy, real, n_padded,
                                      compiled=compiled)
+
+    def _dispatch_eval_multi(self, fetch_list, feed=None, steps=None,
+                             feed_list=None):
+        """Async front half of the SPMD run_eval_multi (the serving
+        engine's dp>1 path): GSPMD-sharded K-eval-lots-per-dispatch
+        scan, returning ``(stacked_fetches, reals, target, compiled,
+        k)`` with NO host sync.  Ragged lots pad to the dp extent with
+        masked samples exactly as run_multi's do."""
+        import jax
+        _reject_reader_fed(self._main_program,
+                           'ParallelExecutor.run_eval_multi')
+        fetch_names = self._fetch_names(fetch_list)
+        scanned = None
+        if feed_list is not None:
+            if feed is not None:
+                raise ValueError('run_eval_multi: pass feed OR feed_list')
+            if not feed_list:
+                raise ValueError('run_eval_multi: feed_list is empty')
+            per_step = [prepare_feed_arrays(dict(f)) for f in feed_list]
+            steps = len(per_step)
+            check_feed_list_names(per_step, 'run_eval_multi')
+            per_step, reals, target, batch_feed_names = \
+                normalize_ragged_feed_list(per_step, self._pad_ragged)
+            check_feed_list_uniform(per_step)
+            compiled = self._resolve(fetch_names, per_step[0],
+                                     batch_feed_names)
+            scanned = {
+                n: jax.device_put(stack_steps([fa[n] for fa in per_step]),
+                                  compiled.scanned_sharding(n))
+                for n in per_step[0]
+            }
+            feed_arrays = {}  # every feed name arrives via the scan
+        else:
+            if steps is None or int(steps) < 1:
+                raise ValueError(
+                    'run_eval_multi: steps must be >= 1, got %r'
+                    % (steps, ))
+            steps = int(steps)
+            rpt = {}
+            feed_arrays, real, target = self._pad_ragged(
+                prepare_feed_arrays(dict(feed if feed is not None else {})),
+                report=rpt)
+            reals = [real] * steps if real != target else None
+            compiled = self._resolve(fetch_names, feed_arrays,
+                                     rpt.get('batch_names'))
+        rng = self._next_rng()
+        stacked = compiled.run_eval_multi(self._scope, feed_arrays, rng,
+                                          steps, scanned_feeds=scanned)
+        if compiled.note_eval_compile(steps, scanned):
+            self.compile_count += 1
+        self.dispatch_count += 1
+        self.steps_dispatched += int(steps)
+        return stacked, reals, target, compiled, steps
+
+    def run_eval_multi(self, fetch_list, feed=None, steps=None,
+                       feed_list=None, return_numpy=True):
+        """Run ``steps`` EVAL iterations as ONE GSPMD-sharded device
+        dispatch and return EVERY iteration's fetches (the SPMD
+        counterpart of Executor.run_eval_multi — dp>1 sharded serving).
+        Same return convention: one [K, ...]-stacked entry per fetch,
+        batch-led fetches over unequal ragged lots as per-step lists."""
+        from .executor import convert_eval_fetches
+        stacked, reals, target, compiled, k = self._dispatch_eval_multi(
+            fetch_list, feed=feed, steps=steps, feed_list=feed_list)
+        return convert_eval_fetches(stacked, reals, target, compiled, k,
+                                    return_numpy)
 
     def bcast_params(self):
         """Reference BCastParamsToDevices (parallel_executor.cc:169) — a

@@ -27,6 +27,50 @@ _profiler_state = {
     'start_time': None,
 }
 
+# subsystem metrics riding the sidecar: {source name: zero-arg snapshot
+# fn}.  The serving engine registers here so a profiled serving window
+# dumps queue depth / fill ratio / p50/p99 next to its timeline spans
+# (tools/timeline.py renders the spans; the 'metrics' block carries the
+# counters).  Sources returning None (e.g. a dead weakref) are skipped.
+_metrics_sources = {}
+# final snapshots of sources that unregistered MID-profile (the common
+# `with profiler: with engine: ...` nesting stops the engine before
+# stop_profiler collects) — without this the sidecar would lose them
+_final_metrics = {}
+
+
+def register_metrics_source(name, fn):
+    _metrics_sources[name] = fn
+
+
+def unregister_metrics_source(name, fn=None):
+    """Drop a source.  Pass the registered fn to make the removal
+    owner-checked: if another source has since taken the name (two
+    engines registering as 'prod'), the survivor stays registered.
+    Inside an active profile the source's last snapshot is kept for the
+    session's sidecar."""
+    if fn is None or _metrics_sources.get(name) is fn:
+        src = _metrics_sources.pop(name, None)
+        if src is not None and _profiler_state['enabled']:
+            try:
+                snap = src()
+            except Exception:
+                snap = None
+            if snap is not None:
+                _final_metrics[name] = snap
+
+
+def _collect_metrics():
+    out = dict(_final_metrics)
+    for name, fn in list(_metrics_sources.items()):
+        try:
+            snap = fn()
+        except Exception:
+            continue
+        if snap is not None:
+            out[name] = snap
+    return out
+
 
 def is_profiler_enabled():
     return _profiler_state['enabled']
@@ -55,6 +99,7 @@ def record_block(name):
 def reset_profiler():
     _profiler_state['events'] = defaultdict(list)
     _profiler_state['timeline'] = []
+    _final_metrics.clear()
 
 
 def start_profiler(state='All'):
@@ -111,6 +156,7 @@ def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
                         {'name': n, 'start_s': s, 'dur_s': d}
                         for n, s, d in _profiler_state['timeline']],
                     'trace_dir': _profiler_state.get('trace_dir'),
+                    'metrics': _collect_metrics(),
                 }, f)
         except OSError:
             pass

@@ -1,0 +1,24 @@
+"""paddle_tpu.serving — the TPU-native inference serving engine.
+
+Takes a loaded inference program (fluid.io.load_inference_model) and
+serves it request-facing: dynamic micro-batching (MicroBatcher),
+shape-bucketed compiles (ShapeBucketSet), pipelined multi-step eval
+dispatch (Executor.run_eval_multi / ParallelExecutor.run_eval_multi for
+dp>1 sharded serving), and engine metrics surfaced through
+fluid.profiler's timeline.  See engine.py for the design and the README
+'Serving engine' section for the knobs.
+
+    engine = serving.InferenceEngine.from_saved_model('/path/to/model')
+    with engine:                         # starts the worker thread
+        fut = engine.submit({'img': x})  # coalesces with other callers
+        logits, = fut.result()
+    print(engine.metrics())
+"""
+
+from .batcher import InferenceRequest, MicroBatcher  # noqa: F401
+from .buckets import ShapeBucketSet  # noqa: F401
+from .engine import InferenceEngine, ServingConfig  # noqa: F401
+from .metrics import EngineMetrics  # noqa: F401
+
+__all__ = ['InferenceEngine', 'ServingConfig', 'MicroBatcher',
+           'InferenceRequest', 'ShapeBucketSet', 'EngineMetrics']

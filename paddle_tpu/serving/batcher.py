@@ -1,0 +1,148 @@
+"""Dynamic micro-batching queue: coalesce submitted requests into lots.
+
+The reference serves inference through a per-request C-API call
+(paddle_inference_api.h Run); a TPU amortizes its ~100ms tunnel
+dispatch by batching.  The queue's contract:
+
+  * a lot closes when its rows reach ``max_batch_size`` (full flush) OR
+    the OLDEST waiting request has aged ``max_wait_s`` (deadline flush)
+    — latency is bounded by max_wait even at low traffic;
+  * only signature-compatible requests (same feed names, trailing dims
+    and dtypes) coalesce; an incompatible request simply waits its turn
+    as the head of a later lot — order is preserved per signature;
+  * a lone request larger than max_batch_size forms its own lot (the
+    bucket ladder gives it an exact entry) rather than being rejected.
+
+Requests double as futures: ``submit`` returns an InferenceRequest the
+caller blocks on with ``.result()``; the engine's worker thread fills
+it after the trimmed fetches come back.
+"""
+
+import threading
+import time
+from collections import deque
+
+__all__ = ['InferenceRequest', 'MicroBatcher']
+
+
+class InferenceRequest(object):
+    """One submitted feed dict + its future result."""
+
+    def __init__(self, feed, rows, sig, return_numpy=True):
+        self.feed = feed
+        self.rows = rows  # None for unbatchable (LoD / scalar) feeds
+        self.sig = sig
+        self.return_numpy = return_numpy
+        self.enqueue_t = time.time()
+        self.latency_s = None
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+
+    def done(self):
+        return self._event.is_set()
+
+    def set_result(self, result):
+        self.latency_s = time.time() - self.enqueue_t
+        self._result = result
+        self._event.set()
+
+    def set_error(self, exc):
+        self.latency_s = time.time() - self.enqueue_t
+        self._error = exc
+        self._event.set()
+
+    def result(self, timeout=None):
+        """Block until delivered; re-raises the dispatch's exception."""
+        if not self._event.wait(timeout):
+            raise TimeoutError('inference request not completed within '
+                               '%r s' % (timeout, ))
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class MicroBatcher(object):
+    def __init__(self, max_batch_size=32, max_wait_s=0.005):
+        if int(max_batch_size) < 1:
+            raise ValueError('max_batch_size must be >= 1')
+        self.max_batch_size = int(max_batch_size)
+        self.max_wait_s = float(max_wait_s)
+        self._pending = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+
+    def depth(self):
+        with self._cond:
+            return len(self._pending)
+
+    def pending_rows(self):
+        with self._cond:
+            return sum(r.rows or 1 for r in self._pending)
+
+    def submit(self, request):
+        with self._cond:
+            if self._closed:
+                raise RuntimeError('MicroBatcher is closed')
+            self._pending.append(request)
+            self._cond.notify_all()
+        return request
+
+    def close(self):
+        """Stop accepting; wakes waiters so the worker can drain."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def _select_locked(self):
+        """The head request plus every signature-compatible follower
+        that fits under max_batch_size (order preserved; incompatible
+        requests stay queued untouched)."""
+        head = self._pending[0]
+        lot, rows = [head], head.rows or 1
+        if head.rows is None:
+            return lot, rows  # unbatchable: its own lot
+        for req in list(self._pending)[1:]:
+            if req.sig != head.sig or req.rows is None:
+                continue
+            if rows + req.rows > self.max_batch_size:
+                break
+            lot.append(req)
+            rows += req.rows
+        return lot, rows
+
+    def next_lot(self, timeout=None, force=False):
+        """Coalesce the next lot.  Blocks up to ``timeout`` (None =
+        forever) for something flushable; returns [] on timeout, None
+        when closed AND drained.  ``force`` flushes whatever is pending
+        immediately, deadline notwithstanding (the inline/synchronous
+        path and the stop-drain use it)."""
+        deadline_out = None if timeout is None else time.time() + timeout
+        with self._cond:
+            while True:
+                if self._pending:
+                    lot, rows = self._select_locked()
+                    flush_at = lot[0].enqueue_t + self.max_wait_s
+                    now = time.time()
+                    # an unbatchable head (rows None: LoD/scalar feeds)
+                    # can never coalesce — waiting out the deadline
+                    # would be pure added latency
+                    if force or self._closed or lot[0].rows is None or \
+                            rows >= self.max_batch_size or now >= flush_at:
+                        for req in lot:
+                            self._pending.remove(req)
+                        return lot
+                    wait = flush_at - now
+                elif self._closed:
+                    return None
+                elif force:
+                    return []
+                else:
+                    wait = None
+                if deadline_out is not None:
+                    remaining = deadline_out - time.time()
+                    if remaining <= 0:
+                        return []
+                    wait = remaining if wait is None else min(wait,
+                                                              remaining)
+                self._cond.wait(wait)

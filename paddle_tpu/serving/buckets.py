@@ -1,0 +1,115 @@
+"""Shape bucketing: map variable request batch sizes onto a small fixed
+set of compiled entries.
+
+Every distinct feed signature is one XLA compile (the static-shape
+design's recompile cost — Executor keys its cache on the scanned-shape
+signature, executor.py:_resolve_and_compile / note_eval_compile), so a
+serving workload whose request sizes wander over 1..max_batch must not
+mint O(max_batch) executables.  The batch-dim answer mirrors
+executor._bucketed_len's sequence-length ladder, but batch sizes are
+small and latency-bound, so the default ladder is simply the powers of
+two up to ``max_batch_size`` (aligned up to ``multiple`` — the dp mesh
+extent for sharded serving): padding waste < 50%, log2(max_batch)
+batch shapes.  (The engine's lots-per-dispatch count is quantized to
+its own power-of-two ladder — engine._collect_block — so the total
+executable set is bounded at buckets x (log2(steps_per_dispatch)+1),
+not buckets x K.)
+
+The set is BOUNDED: at most ``max_buckets`` buckets stay active, LRU
+evicted beyond that.  Eviction here is accounting — the Executor's own
+LRU (64 entries) owns executable memory — but the report makes the
+compile budget observable: the engine surfaces ``report()`` plus the
+executor's ``compile_count`` through its metrics snapshot.
+"""
+
+import collections
+import threading
+
+__all__ = ['ShapeBucketSet']
+
+
+def _align_up(n, multiple):
+    return -(-int(n) // multiple) * multiple if multiple > 1 else int(n)
+
+
+class ShapeBucketSet(object):
+    """The bounded ladder of padded batch sizes serving requests map to.
+
+    sizes: explicit ladder (sorted, deduped, aligned to ``multiple``);
+    None builds the default powers-of-two ladder up to max_batch_size.
+    """
+
+    def __init__(self, max_batch_size, sizes=None, multiple=1,
+                 max_buckets=16):
+        self.max_batch_size = int(max_batch_size)
+        self.multiple = max(int(multiple), 1)
+        if sizes is None:
+            sizes, s = [], 1
+            while True:
+                aligned = _align_up(s, self.multiple)
+                if aligned >= self.max_batch_size:
+                    sizes.append(_align_up(self.max_batch_size,
+                                           self.multiple))
+                    break
+                sizes.append(aligned)
+                s *= 2
+        else:
+            sizes = [_align_up(s, self.multiple) for s in sizes]
+            top = _align_up(self.max_batch_size, self.multiple)
+            if max(sizes) < top:
+                # the batcher coalesces up to max_batch_size rows no
+                # matter the ladder — a short explicit ladder would send
+                # every above-top lot to its own exact bucket, quietly
+                # voiding the bounded-compile contract
+                sizes.append(top)
+        self.sizes = sorted(set(int(s) for s in sizes))
+        self._max_buckets = int(max_buckets)
+        self._active = collections.OrderedDict()  # bucket -> hit count
+        # bucket_for runs on the engine's worker thread while report()
+        # serves metrics()/the profiler sidecar from user threads — the
+        # OrderedDict must not be iterated mid-mutation
+        self._lock = threading.Lock()
+        self.evictions = 0
+        self.oversized = 0
+
+    def bucket_for(self, rows):
+        """Padded batch size for a lot of ``rows`` real rows: the
+        smallest ladder entry that fits.  A lone request larger than the
+        ladder top gets its own exact (multiple-aligned) bucket rather
+        than being rejected — it still compiles once per distinct size,
+        which the ``oversized`` counter makes visible."""
+        rows = int(rows)
+        if rows < 1:
+            raise ValueError('bucket_for: rows must be >= 1, got %r'
+                             % (rows, ))
+        for s in self.sizes:
+            if rows <= s:
+                bucket = s
+                break
+        else:
+            bucket = _align_up(rows, self.multiple)
+        with self._lock:
+            if bucket > self.sizes[-1]:
+                self.oversized += 1
+            if bucket in self._active:
+                self._active[bucket] += 1
+                self._active.move_to_end(bucket)
+            else:
+                self._active[bucket] = 1
+                if len(self._active) > self._max_buckets:
+                    self._active.popitem(last=False)
+                    self.evictions += 1
+        return bucket
+
+    def report(self):
+        """Observability snapshot: the ladder, the active (bounded) set
+        with hit counts, and the eviction/oversize tallies."""
+        with self._lock:
+            return {
+                'sizes': list(self.sizes),
+                'active': list(self._active),
+                'hits': dict(self._active),
+                'evictions': self.evictions,
+                'oversized': self.oversized,
+                'max_buckets': self._max_buckets,
+            }

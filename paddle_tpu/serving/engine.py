@@ -1,0 +1,546 @@
+"""The TPU-native inference serving engine.
+
+The reference ships inference as a per-request ABI
+(paddle_inference_api.h: PaddlePredictor.Run — one graph execution per
+call).  On TPU every dispatch rides a ~100ms tunnel round trip
+(MFU_BOUND_r03), so a request-per-dispatch server measures the tunnel,
+not the chip.  This engine amortizes the same way Executor.run_multi
+does for training, behind a request-facing surface:
+
+  1. **dynamic micro-batching** — submitted requests coalesce in a
+     MicroBatcher up to max_batch_size rows / a max_wait deadline;
+  2. **shape bucketing** — each lot pads (masked, replicated last real
+     row — the @SAMPLE_MASK machinery) to a bounded ShapeBucketSet
+     ladder entry, so wandering request sizes map to a small fixed set
+     of XLA executables; fetches trim back to real row counts;
+  3. **pipelined multi-step eval dispatch** — up to steps_per_dispatch
+     same-bucket lots ship as ONE Executor.run_eval_multi scan (K eval
+     batches per dispatch, donated scanned block), and up to
+     pipeline_depth dispatches stay in flight so host feed/fetch
+     overlaps device compute; dp>1 serving shards lots batch-dim over
+     the mesh via ParallelExecutor.run_eval_multi;
+  4. **metrics** — queue depth, batch fill ratio, p50/p99 latency,
+     dispatch/compile counts, surfaced through fluid.profiler's
+     timeline sidecar so tools/timeline.py renders serving spans.
+
+Synchronous use needs no thread: an engine that was never ``start()``ed
+dispatches inline on the submitter's thread (fluid.Inferencer runs this
+mode).  ``start()`` spawns the worker loop for the queued mode.
+"""
+
+import threading
+import time
+import weakref
+from collections import deque
+
+import numpy as np
+
+from ..fluid import core
+from ..fluid import profiler as _profiler
+from ..fluid.executor import Executor, feed_signature, _is_host_op, \
+    fetch_batch_led
+from ..fluid.parallel_executor import ParallelExecutor, pad_ragged_batch, \
+    _lead
+from .batcher import InferenceRequest, MicroBatcher
+from .buckets import ShapeBucketSet
+from .metrics import EngineMetrics
+
+__all__ = ['ServingConfig', 'InferenceEngine']
+
+_ENGINE_SEQ = [0]
+_ENGINE_SEQ_LOCK = threading.Lock()
+
+
+class ServingConfig(object):
+    """Engine knobs (documented in README 'Serving engine').
+
+    max_batch_size: rows per lot before a full flush.
+    max_wait_ms: oldest-request age forcing a deadline flush — the
+        latency bound at low traffic.
+    steps_per_dispatch: max same-bucket lots per run_eval_multi scan.
+    pipeline_depth: dispatches kept in flight before the worker blocks
+        on the oldest one's results (2 = double buffering).
+    bucket_sizes: explicit ladder for the ShapeBucketSet (None = powers
+        of two up to max_batch_size).
+    max_buckets: bound on the active bucket set (LRU accounting).
+    """
+
+    def __init__(self, max_batch_size=32, max_wait_ms=5.0,
+                 steps_per_dispatch=4, pipeline_depth=2,
+                 bucket_sizes=None, max_buckets=16):
+        if int(steps_per_dispatch) < 1:
+            raise ValueError('steps_per_dispatch must be >= 1')
+        if int(pipeline_depth) < 1:
+            raise ValueError('pipeline_depth must be >= 1')
+        self.max_batch_size = int(max_batch_size)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.steps_per_dispatch = int(steps_per_dispatch)
+        self.pipeline_depth = int(pipeline_depth)
+        self.bucket_sizes = bucket_sizes
+        self.max_buckets = int(max_buckets)
+
+
+class _Lot(object):
+    """One padded, bucket-shaped batch of coalesced requests."""
+
+    __slots__ = ('requests', 'feed', 'real', 'bucket', 'sig')
+
+    def __init__(self, requests, feed, real, bucket, sig):
+        self.requests = requests
+        self.feed = feed
+        self.real = real  # None for an unbatchable (LoD) lot
+        self.bucket = bucket
+        self.sig = sig
+
+
+class InferenceEngine(object):
+    """Serve a loaded inference program (fluid.io.load_inference_model)
+    through micro-batched, bucketed, pipelined eval dispatches."""
+
+    def __init__(self, program, feed_names=None, fetch_list=None,
+                 place=None, scope=None, executor=None, parallel=False,
+                 mesh=None, config=None, name=None):
+        if fetch_list is None:
+            raise ValueError('InferenceEngine: fetch_list is required '
+                             '(the fetch targets returned by '
+                             'load_inference_model)')
+        self._program = program
+        self._feed_names = list(feed_names) if feed_names else None
+        self._fetch_list = list(fetch_list)
+        self._scope = scope if scope is not None else core.Scope()
+        self.config = config if config is not None else ServingConfig()
+        # host ops (save/print/readers) cannot run inside the eval scan:
+        # such programs serve EAGERLY — one exe.run per request, no
+        # padding/coalescing — preserving the Executor's per-step host-
+        # op semantics (the pre-engine Inferencer behavior)
+        self._eager = any(_is_host_op(op)
+                          for op in program.global_block().ops)
+        self._pe = None
+        if parallel or mesh is not None:
+            if self._eager:
+                raise NotImplementedError(
+                    'sharded serving cannot run host-op programs — '
+                    'remove the host ops or serve with parallel=False')
+            self._pe = ParallelExecutor(main_program=program,
+                                        scope=self._scope, mesh=mesh)
+            multiple = self._pe._dp_extent()
+        else:
+            multiple = 1
+        place = place if place is not None else (
+            core.TPUPlace() if core.is_compiled_with_tpu()
+            else core.CPUPlace())
+        self._exe = executor if executor is not None else Executor(place)
+        self.buckets = ShapeBucketSet(self.config.max_batch_size,
+                                      sizes=self.config.bucket_sizes,
+                                      multiple=multiple,
+                                      max_buckets=self.config.max_buckets)
+        self._batcher = MicroBatcher(self.config.max_batch_size,
+                                     self.config.max_wait_s)
+        self._metrics = EngineMetrics()
+        self._inflight = deque()
+        self._carry = deque()  # flushed lots awaiting a matching block
+        self._inline_lock = threading.Lock()
+        self._thread = None
+        self._closed = False
+        self._warned_unsliced = False
+        with _ENGINE_SEQ_LOCK:
+            _ENGINE_SEQ[0] += 1
+            seq = _ENGINE_SEQ[0]
+        self.name = name or ('serving-engine-%d' % seq)
+        # profiler sidecar: a weakly-bound metrics source, so profiled
+        # runs dump the serving snapshot without keeping dead engines
+        # alive (tools/timeline.py renders the spans; the sidecar's
+        # 'metrics' block carries the counters).  Unregistration is
+        # owner-checked against this fn: a second engine reusing the
+        # same name takes over the slot, and the first one's stop()/GC
+        # must not evict the survivor.
+        ref = weakref.ref(self)
+        self._metrics_fn = lambda: (ref().metrics() if ref() else None)
+        _profiler.register_metrics_source(self.name, self._metrics_fn)
+        # an inline-mode engine may never be stop()ped: drop its
+        # registration at GC so the source table can't grow unbounded
+        weakref.finalize(self, _profiler.unregister_metrics_source,
+                         self.name, self._metrics_fn)
+
+    @classmethod
+    def from_saved_model(cls, dirname, place=None, model_filename=None,
+                         params_filename=None, **kwargs):
+        """Build an engine straight from a save_inference_model dir
+        (own scope + executor; the request-facing analog of
+        create_paddle_predictor)."""
+        from ..fluid import io as fluid_io
+        from ..fluid.executor import scope_guard
+        place = place if place is not None else (
+            core.TPUPlace() if core.is_compiled_with_tpu()
+            else core.CPUPlace())
+        exe = Executor(place)
+        scope = core.Scope()
+        with scope_guard(scope):
+            program, feed_names, fetch_targets = \
+                fluid_io.load_inference_model(
+                    dirname, exe, model_filename=model_filename,
+                    params_filename=params_filename)
+        return cls(program, feed_names=feed_names,
+                   fetch_list=fetch_targets, place=place, scope=scope,
+                   executor=exe, **kwargs)
+
+    # ---- lifecycle ----------------------------------------------------
+
+    def start(self):
+        """Spawn the worker thread (queued mode)."""
+        if self._closed:
+            raise RuntimeError('engine is closed')
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._serve_loop, name=self.name, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        """Drain the queue and all in-flight dispatches, then join."""
+        if self._closed:
+            return
+        self._closed = True
+        self._batcher.close()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        else:
+            self._drain_inline()
+        _profiler.unregister_metrics_source(self.name, self._metrics_fn)
+
+    close = stop
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ---- request surface ----------------------------------------------
+
+    def submit(self, feed, return_numpy=True):
+        """Enqueue one request; returns an InferenceRequest future.
+        When the engine is not start()ed, the dispatch runs inline on
+        this thread (synchronous mode) and the future is already done."""
+        if self._closed:
+            raise RuntimeError('engine is closed')
+        if not isinstance(feed, dict) or not feed:
+            raise ValueError('feed must be a non-empty {name: data} dict')
+        if self._feed_names is not None:
+            missing = set(self._feed_names) - set(feed)
+            extra = set(feed) - set(self._feed_names)
+            if missing or extra:
+                raise ValueError(
+                    'feed names %s do not match the inference program '
+                    '(missing %s, unexpected %s)' %
+                    (sorted(feed), sorted(missing), sorted(extra)))
+        rows, sig = self._request_rows_sig(feed)
+        req = InferenceRequest(feed, rows, sig, return_numpy=return_numpy)
+        self._metrics.note_request(rows or 1)
+        self._batcher.submit(req)
+        if self._thread is None:
+            self._drain_inline()
+        return req
+
+    def infer(self, feed, return_numpy=True, timeout=None):
+        """Synchronous convenience: submit + wait."""
+        return self.submit(feed, return_numpy=return_numpy).result(timeout)
+
+    def metrics(self):
+        """Engine snapshot + bucket report + the executor's own XLA
+        compile counter (the ground truth the bucket policy bounds)."""
+        snap = self._metrics.snapshot(queue_depth=self._batcher.depth())
+        snap['buckets'] = self.buckets.report()
+        snap['executor_compile_count'] = (
+            self._pe.compile_count if self._pe is not None
+            else self._exe.compile_count)
+        snap['inflight'] = len(self._inflight)
+        return snap
+
+    # ---- request -> lot -----------------------------------------------
+
+    def _request_rows_sig(self, feed):
+        """(rows, coalescing signature) for a request; (None, unique)
+        for unbatchable feeds (LoD/PaddedSequence/scalars), which form
+        single-request lots with no padding."""
+        leads, sig = {}, []
+        for name, v in sorted(feed.items()):
+            if self._eager or isinstance(v, core.PaddedSequence) or (
+                    isinstance(v, core.LoDTensor) and v.lod()):
+                return None, object()
+            lead = _lead(v)
+            if lead is None:
+                return None, object()
+            if lead == 0:
+                raise ValueError(
+                    'feed %r has 0 rows — an empty request has no '
+                    'result to serve' % name)
+            leads[name] = lead
+            arr_like = v.numpy() if isinstance(v, core.LoDTensor) else v
+            shape = tuple(np.shape(arr_like))
+            dtype = getattr(arr_like, 'dtype', None)
+            if dtype is None:
+                dtype = np.asarray(arr_like).dtype
+            sig.append((name, shape[1:], str(dtype)))
+        if len(set(leads.values())) > 1:
+            raise ValueError(
+                'feeds disagree on the leading (batch) dim: %s — every '
+                'input of one request must carry the same number of '
+                'rows' % ({n: d for n, d in sorted(leads.items())}, ))
+        return int(next(iter(leads.values()))), tuple(sig)
+
+    def _make_lot(self, requests):
+        if _profiler.is_profiler_enabled():
+            now = time.time()
+            for r in requests:
+                _profiler.record_event('serving/queue_wait',
+                                       now - r.enqueue_t,
+                                       start=r.enqueue_t)
+        head = requests[0]
+        if head.rows is None:
+            # unbatchable (LoD/scalar feeds, or an eager host-op
+            # program): its own lot, no padding — still a lot in the
+            # metrics (real == bucket rows, so the fill ratio is
+            # unaffected) or capacity math reads 'served nothing'
+            self._metrics.note_lot(1, 1, deadline_flush=False)
+            return _Lot(requests, dict(head.feed), None, None,
+                        ('nobatch', id(head)))
+        rows = sum(r.rows for r in requests)
+        bucket = self.buckets.bucket_for(rows)
+        names = set(head.feed)
+        if len(requests) == 1:
+            # pass values through untouched — pad_ragged_batch already
+            # leaves device-staged arrays on device when nothing pads
+            feed = dict(head.feed)
+        else:
+            feed = {n: np.concatenate([
+                np.asarray(r.feed[n].numpy()
+                           if isinstance(r.feed[n], core.LoDTensor)
+                           else r.feed[n]) for r in requests])
+                for n in names}
+        # force_mask keeps ONE signature per bucket: a full lot and a
+        # padded lot compile to the same executable (mask all-ones vs
+        # ragged) instead of doubling the compile set
+        feed, real, target = pad_ragged_batch(
+            feed, 1, target=bucket, force_mask=True, batch_names=names)
+        deadline_flush = rows < self.config.max_batch_size
+        self._metrics.note_lot(real, target, deadline_flush)
+        return _Lot(requests, feed, real, target,
+                    (target, feed_signature(feed)))
+
+    # ---- dispatch / deliver -------------------------------------------
+
+    def _dispatch(self, lots):
+        """ONE run_eval_multi dispatch over K same-bucket lots; tracks
+        it in the in-flight pipeline (no host sync here).  Host-op
+        (eager) programs run one exe.run per lot instead — the scan
+        cannot contain them."""
+        if self._eager:
+            return self._dispatch_eager(lots)
+        t0 = time.time()
+        runner = self._pe if self._pe is not None else self._exe
+        before = runner.compile_count
+        try:
+            if self._pe is not None:
+                stacked, reals, target, compiled, k = \
+                    self._pe._dispatch_eval_multi(
+                        self._fetch_list,
+                        feed_list=[l.feed for l in lots])
+            else:
+                stacked, reals, target, compiled, k = \
+                    self._exe._dispatch_eval_multi(
+                        self._program,
+                        feed_list=[l.feed for l in lots],
+                        fetch_list=self._fetch_list, scope=self._scope)
+        except Exception as exc:
+            self._metrics.note_error()
+            for lot in lots:
+                for req in lot.requests:
+                    req.set_error(exc)
+            return
+        self._metrics.note_dispatch(k, runner.compile_count - before)
+        self._inflight.append((stacked, lots, compiled, t0))
+
+    def _dispatch_eager(self, lots):
+        """Per-lot exe.run for host-op programs (save/print/readers):
+        identical semantics to the pre-engine Inferencer, delivered
+        synchronously — nothing to pipeline when every step round-trips
+        the host anyway."""
+        for lot in lots:
+            t0 = time.time()
+            req = lot.requests[0]  # eager lots are single-request
+            before = self._exe.compile_count
+            try:
+                outs = self._exe.run(self._program, feed=lot.feed,
+                                     fetch_list=self._fetch_list,
+                                     scope=self._scope,
+                                     return_numpy=req.return_numpy)
+            except Exception as exc:
+                self._metrics.note_error()
+                req.set_error(exc)
+                continue
+            self._metrics.note_dispatch(
+                1, self._exe.compile_count - before)
+            req.set_result(outs)
+            if req.latency_s is not None:
+                self._metrics.note_latency(req.latency_s)
+            if _profiler.is_profiler_enabled():
+                _profiler.record_event('serving/dispatch[eager]',
+                                       time.time() - t0, start=t0)
+
+    def _drain_one(self):
+        """Deliver the OLDEST in-flight dispatch: host sync, trim each
+        lot to its real rows, slice per request, resolve futures."""
+        stacked, lots, compiled, t0 = self._inflight.popleft()
+        try:
+            arrays = [np.asarray(a) for a in stacked]  # the sync point
+        except Exception as exc:
+            self._metrics.note_error()
+            for lot in lots:
+                for req in lot.requests:
+                    req.set_error(exc)
+            return
+        led = fetch_batch_led(compiled, len(arrays))
+        if not all(led) and not self._warned_unsliced and \
+                any(len(lot.requests) > 1 for lot in lots):
+            # a batch-REDUCED fetch (a mean/accuracy scalar) from a
+            # coalesced lot is computed over EVERY rider's rows — there
+            # is no per-request value to slice out, so each caller gets
+            # the whole-lot number.  Say so once instead of silently
+            # breaking per-request parity for such fetches.
+            self._warned_unsliced = True
+            import warnings
+            warnings.warn(
+                'serving engine %s: fetches %s are not per-row '
+                '(batch-led) — coalesced requests receive the value '
+                'computed over the WHOLE micro-batch, not their own '
+                'rows.  Fetch per-row outputs, or serve such programs '
+                'with max_batch_size=1.' %
+                (self.name,
+                 [n for n, is_led in zip(
+                     getattr(compiled, 'fetch_names',
+                             range(len(led))), led) if not is_led]))
+        for j, lot in enumerate(lots):
+            offset = 0
+            for req in lot.requests:
+                res = []
+                for a, is_led in zip(arrays, led):
+                    step = a[j]
+                    if lot.real is not None and is_led \
+                            and np.ndim(step) >= 1 \
+                            and np.shape(step)[0] == lot.bucket:
+                        step = step[offset:offset + req.rows]
+                    if not req.return_numpy:
+                        step = core.LoDTensor(np.asarray(step))
+                    res.append(step)
+                offset += req.rows or 0
+                req.set_result(res)
+                if req.latency_s is not None:
+                    self._metrics.note_latency(req.latency_s)
+        if _profiler.is_profiler_enabled():
+            _profiler.record_event(
+                'serving/dispatch[x%d]' % len(lots),
+                time.time() - t0, start=t0)
+
+    # ---- worker -------------------------------------------------------
+
+    def _safe_make_lot(self, requests):
+        """_make_lot that fails the LOT, not the worker: a malformed
+        request must error its own future and leave the engine serving
+        (an unhandled exception here would kill the daemon thread and
+        strand every later caller)."""
+        try:
+            return self._make_lot(requests)
+        except Exception as exc:
+            self._metrics.note_error()
+            for req in requests:
+                req.set_error(exc)
+            return None
+
+    def _collect_block(self, first_lot):
+        """Extend a block with already-flushable same-bucket lots, then
+        TRIM to a power-of-two lot count (extras go back on the carry
+        queue): `steps` is a static jit argument of the eval scan, so a
+        free-running 1..K count would mint up to K executables per
+        bucket under fluctuating traffic — the quantized ladder bounds
+        it at log2(K)+1."""
+        lots = [first_lot]
+        while len(lots) < self.config.steps_per_dispatch:
+            if self._carry:
+                lot = self._carry.popleft()
+            else:
+                more = self._batcher.next_lot(timeout=0)
+                if not more:
+                    break
+                lot = self._safe_make_lot(more)
+                if lot is None:
+                    continue
+            if lot.sig != lots[0].sig:
+                self._carry.appendleft(lot)
+                break
+            lots.append(lot)
+        k = 1
+        while k * 2 <= len(lots):
+            k *= 2
+        self._carry.extend(lots[k:])
+        return lots[:k]
+
+    def _serve_loop(self):
+        poll = max(min(self.config.max_wait_s, 0.005), 0.001)
+        while True:
+            try:
+                if self._carry:
+                    self._dispatch(
+                        self._collect_block(self._carry.popleft()))
+                else:
+                    # idle engine blocks on the queue's condition var
+                    # (submit/close notify); only an awaiting in-flight
+                    # dispatch warrants the short drain poll
+                    reqs = self._batcher.next_lot(
+                        timeout=poll if self._inflight else None)
+                    if reqs is None:
+                        break  # closed and drained
+                    if reqs:
+                        lot = self._safe_make_lot(reqs)
+                        if lot is not None:
+                            self._dispatch(self._collect_block(lot))
+                    elif self._inflight:
+                        self._drain_one()  # idle: deliver early
+                        continue
+                    else:
+                        continue
+                # pipeline backpressure: keep at most pipeline_depth
+                # dispatches in flight — host feeds N+1 while N computes
+                while len(self._inflight) >= self.config.pipeline_depth:
+                    self._drain_one()
+            except Exception:
+                # belt-and-braces: _dispatch/_drain_one already error
+                # their own lots' futures; whatever still escapes must
+                # not kill the serving thread
+                self._metrics.note_error()
+        while self._carry:
+            self._dispatch([self._carry.popleft()])
+        while self._inflight:
+            self._drain_one()
+
+    def _drain_inline(self):
+        """Synchronous mode: flush + dispatch + deliver on the calling
+        thread (no micro-batching across callers, no pipelining).
+        Serialized by _inline_lock — concurrent submitters to a
+        never-start()ed engine must not interleave on _inflight/_carry."""
+        with self._inline_lock:
+            while True:
+                if self._carry:
+                    self._dispatch(
+                        self._collect_block(self._carry.popleft()))
+                else:
+                    reqs = self._batcher.next_lot(timeout=0, force=True)
+                    if not reqs:
+                        break
+                    lot = self._safe_make_lot(reqs)
+                    if lot is None:
+                        continue
+                    self._dispatch(self._collect_block(lot))
+                while self._inflight:
+                    self._drain_one()

@@ -1,0 +1,106 @@
+"""Engine observability: counters, gauges, and a latency reservoir.
+
+The snapshot is the serving analog of the Executor's ``compile_count``:
+every number a capacity planner needs to see whether the engine is
+batching well (fill ratio), keeping up (queue depth, p99), and staying
+inside its compile budget (dispatches vs compiles).  ``fluid.profiler``
+surfaces the same snapshot through its ``.events.json`` sidecar (the
+engine registers itself as a metrics source), so ``tools/timeline.py``
+renders serving spans next to the executor/device slices.
+"""
+
+import threading
+from collections import deque
+
+__all__ = ['EngineMetrics']
+
+
+def _percentile(sorted_vals, p):
+    if not sorted_vals:
+        return None
+    idx = min(int(len(sorted_vals) * p), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+class EngineMetrics(object):
+    """Thread-safe counters shared by the submit path and the worker.
+
+    Latencies keep the last ``reservoir`` request round trips (enqueue
+    to delivery), enough for stable p50/p99 without unbounded growth.
+    """
+
+    def __init__(self, reservoir=2048):
+        self._lock = threading.Lock()
+        self._latencies = deque(maxlen=reservoir)
+        self.requests = 0
+        self.rows = 0
+        self.lots = 0
+        self.padded_rows = 0
+        self.bucket_rows = 0
+        self.deadline_flushes = 0
+        self.full_flushes = 0
+        self.dispatches = 0
+        self.steps_dispatched = 0
+        self.compiles = 0
+        self.errors = 0
+
+    def note_request(self, rows):
+        with self._lock:
+            self.requests += 1
+            self.rows += int(rows)
+
+    def note_lot(self, real_rows, bucket_rows, deadline_flush):
+        with self._lock:
+            self.lots += 1
+            self.bucket_rows += int(bucket_rows)
+            self.padded_rows += int(bucket_rows) - int(real_rows)
+            if deadline_flush:
+                self.deadline_flushes += 1
+            else:
+                self.full_flushes += 1
+
+    def note_dispatch(self, steps, compiles):
+        with self._lock:
+            self.dispatches += 1
+            self.steps_dispatched += int(steps)
+            self.compiles += int(compiles)
+
+    def note_latency(self, seconds):
+        with self._lock:
+            self._latencies.append(float(seconds))
+
+    def note_error(self):
+        with self._lock:
+            self.errors += 1
+
+    def snapshot(self, queue_depth=0):
+        """One coherent dict: counters plus the derived rates the
+        ROADMAP's serving lane cares about (batch fill ratio = real rows
+        over padded-bucket rows across all lots; steps/dispatch is the
+        measured pipelining depth)."""
+        with self._lock:
+            lat = sorted(self._latencies)
+            return {
+                'queue_depth': int(queue_depth),
+                'requests': self.requests,
+                'rows': self.rows,
+                'lots': self.lots,
+                'dispatches': self.dispatches,
+                'steps_dispatched': self.steps_dispatched,
+                'steps_per_dispatch': (
+                    round(self.steps_dispatched / self.dispatches, 3)
+                    if self.dispatches else None),
+                'compiles': self.compiles,
+                'errors': self.errors,
+                'padded_rows': self.padded_rows,
+                'batch_fill_ratio': (
+                    round((self.bucket_rows - self.padded_rows) /
+                          self.bucket_rows, 4)
+                    if self.bucket_rows else None),
+                'deadline_flushes': self.deadline_flushes,
+                'full_flushes': self.full_flushes,
+                'p50_latency_ms': (
+                    round(_percentile(lat, 0.50) * 1e3, 3) if lat else None),
+                'p99_latency_ms': (
+                    round(_percentile(lat, 0.99) * 1e3, 3) if lat else None),
+            }

@@ -99,12 +99,14 @@ def test_single_config_child_runs_cpu():
 
 
 def test_flagship_configs_wired_through_run_multi():
-    """Every flagship TRAIN config (resnet, nmt, transformer,
-    stacked_lstm) is device-true: timed blocks are Executor.run_multi
-    dispatches (K steps per dispatch) with uniform reporting fields.
-    Source-level pin — the functional path is covered by the nmt smoke
-    below and the stacked_lstm child above, all of which route through
-    the same _run/_timed_steps_multi helper."""
+    """Every flagship config is device-true: TRAIN configs (resnet, nmt,
+    transformer, stacked_lstm) time Executor.run_multi dispatches (K
+    steps per dispatch), and the inference config times
+    Executor.run_eval_multi (K eval steps per dispatch — the last
+    dispatch-tax ledger row, ISSUE 2) — all with uniform reporting
+    fields.  Source-level pin — the functional path is covered by the
+    nmt smoke below and the stacked_lstm child above, all of which
+    route through the same _run/_timed_steps_multi helper."""
     import inspect
     import bench
     assert 'run_multi' in inspect.getsource(bench._timed_steps_multi)
@@ -113,9 +115,11 @@ def test_flagship_configs_wired_through_run_multi():
         assert '_run(' in src, fn.__name__
         assert "'device_true': True" in src, fn.__name__
         assert "'steps_per_dispatch': steps" in src, fn.__name__
-    # the inference config stays per-dispatch and says so
+    # the inference config is device-true through the eval scan
     src = inspect.getsource(bench.bench_resnet_infer_bf16)
-    assert "'device_true': False" in src
+    assert 'run_eval_multi' in src
+    assert "'device_true': True" in src
+    assert "'steps_per_dispatch': k" in src
 
 
 def test_nmt_cpu_smoke_is_device_true():

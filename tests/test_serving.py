@@ -1,0 +1,506 @@
+"""TPU-native inference serving engine (ISSUE 2 tentpole).
+
+paddle_tpu.serving: dynamic micro-batching (coalesce + deadline flush),
+shape-bucketed compiles (bounded ladder, compile accounting), pipelined
+multi-step eval dispatch (Executor.run_eval_multi — K eval batches as
+ONE lax.scan dispatch, every step's fetches out), dp>1 sharded serving
+on the 8-device virtual mesh, and metrics through fluid.profiler's
+timeline sidecar.
+
+The acceptance invariant: batched + bucketed + masked-padded engine
+outputs are BITWISE-equal (f32) to unbatched per-request inference on
+the same program.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import serving
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _save_load_model(tmpdir, seed=0):
+    """A real load_inference_model round trip (the engine's contract
+    input): tiny MLP classifier, f32."""
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = seed
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data('x', [6])
+        h = fluid.layers.fc(x, 16, act='relu')
+        pred = fluid.layers.fc(h, 4, act='softmax')
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(tmpdir, ['x'], [pred], exe,
+                                      main_program=prog)
+        loaded, feeds, fetches = fluid.io.load_inference_model(tmpdir, exe)
+    return loaded, feeds, fetches, exe, scope
+
+
+def _requests(rng, sizes):
+    return [{'x': rng.rand(n, 6).astype('float32')} for n in sizes]
+
+
+# ---- run_eval_multi (the dispatch layer) -------------------------------
+
+def test_run_eval_multi_collects_every_step_bitwise():
+    """K eval lots in ONE dispatch return each step's fetches, bitwise
+    equal to per-request exe.run on the same program."""
+    with tempfile.TemporaryDirectory() as td:
+        prog, feeds, fetches, exe, scope = _save_load_model(td)
+        rng = np.random.RandomState(0)
+        lots = _requests(rng, [8, 8, 8])
+        with fluid.scope_guard(scope):
+            outs = exe.run_eval_multi(prog, feed_list=lots,
+                                      fetch_list=fetches)
+            assert outs[0].shape == (3, 8, 4)
+            for k, lot in enumerate(lots):
+                ref, = exe.run(prog, feed=lot, fetch_list=fetches)
+                assert np.array_equal(outs[0][k], ref), 'step %d' % k
+
+
+def test_run_eval_multi_ragged_lots_pad_and_trim():
+    """A ragged feed_list pads to one shape bucket with @SAMPLE_MASK
+    rows and trims each step back to its real row count — bitwise equal
+    to unpadded per-request runs."""
+    with tempfile.TemporaryDirectory() as td:
+        prog, feeds, fetches, exe, scope = _save_load_model(td)
+        rng = np.random.RandomState(1)
+        lots = _requests(rng, [8, 5, 3])
+        with fluid.scope_guard(scope):
+            outs = exe.run_eval_multi(prog, feed_list=lots,
+                                      fetch_list=fetches)
+            per_step = outs[0]
+            assert [np.shape(o)[0] for o in per_step] == [8, 5, 3]
+            for k, lot in enumerate(lots):
+                ref, = exe.run(prog, feed=lot, fetch_list=fetches)
+                assert np.array_equal(per_step[k], ref), 'step %d' % k
+
+
+def test_run_eval_multi_constant_feed_mode():
+    """feed= + steps= (the bench's device-true timing form) repeats one
+    batch K times; every step equals a plain run."""
+    with tempfile.TemporaryDirectory() as td:
+        prog, feeds, fetches, exe, scope = _save_load_model(td)
+        batch = {'x': np.random.RandomState(2).rand(4, 6).astype('float32')}
+        with fluid.scope_guard(scope):
+            outs = exe.run_eval_multi(prog, feed=batch,
+                                      fetch_list=fetches, steps=4)
+            ref, = exe.run(prog, feed=batch, fetch_list=fetches)
+        assert outs[0].shape == (4, 4, 4)
+        for k in range(4):
+            assert np.array_equal(outs[0][k], ref)
+
+
+# ---- engine: batching parity, deadline, buckets ------------------------
+
+def test_engine_batched_bucketed_bitwise_matches_unbatched():
+    """The acceptance bar: requests coalesced into padded, bucketed,
+    multi-lot dispatches come back bitwise-equal (f32) to unbatched
+    per-request inference on the same program."""
+    with tempfile.TemporaryDirectory() as td:
+        prog, feeds, fetches, exe, scope = _save_load_model(td)
+        rng = np.random.RandomState(3)
+        reqs = _requests(rng, [3, 2, 5, 1, 4, 2, 8, 3])
+        refs = []
+        with fluid.scope_guard(scope):
+            for r in reqs:
+                ref, = exe.run(prog, feed=r, fetch_list=fetches)
+                refs.append(ref)
+        eng = serving.InferenceEngine(
+            prog, feed_names=feeds, fetch_list=fetches,
+            scope=scope, executor=exe,
+            config=serving.ServingConfig(max_batch_size=8, max_wait_ms=50,
+                                         steps_per_dispatch=4))
+        with eng:
+            futs = [eng.submit(r) for r in reqs]
+            outs = [f.result(30) for f in futs]
+        for i, (out, ref) in enumerate(zip(outs, refs)):
+            assert out[0].shape == ref.shape, i
+            assert np.array_equal(out[0], ref), 'request %d' % i
+        m = eng.metrics()
+        # coalescing actually happened: fewer lots than requests, and
+        # the micro-batch queue padded at least one ragged tail
+        assert m['requests'] == len(reqs)
+        assert m['lots'] < len(reqs)
+        assert m['dispatches'] <= m['lots']
+        assert m['batch_fill_ratio'] is not None
+
+
+def test_engine_inline_mode_needs_no_thread():
+    """A never-start()ed engine serves synchronously on the caller's
+    thread (the Inferencer mode)."""
+    with tempfile.TemporaryDirectory() as td:
+        prog, feeds, fetches, exe, scope = _save_load_model(td)
+        rng = np.random.RandomState(4)
+        eng = serving.InferenceEngine(prog, feed_names=feeds,
+                                      fetch_list=fetches,
+                                      scope=scope, executor=exe)
+        r = {'x': rng.rand(3, 6).astype('float32')}
+        out, = eng.infer(r)
+        with fluid.scope_guard(scope):
+            ref, = exe.run(prog, feed=r, fetch_list=fetches)
+        assert np.array_equal(out, ref)
+        req = eng.submit(r)
+        assert req.done()  # inline: already delivered on return
+
+
+def test_engine_max_wait_deadline_flush():
+    """At low traffic a partial lot flushes when the OLDEST request has
+    aged max_wait — latency is bounded by the deadline, not by waiting
+    for a full batch."""
+    with tempfile.TemporaryDirectory() as td:
+        prog, feeds, fetches, exe, scope = _save_load_model(td)
+        rng = np.random.RandomState(5)
+        eng = serving.InferenceEngine(
+            prog, feed_names=feeds, fetch_list=fetches,
+            scope=scope, executor=exe,
+            config=serving.ServingConfig(max_batch_size=64,
+                                         max_wait_ms=30))
+        with eng:
+            t0 = time.time()
+            f1 = eng.submit({'x': rng.rand(2, 6).astype('float32')})
+            f2 = eng.submit({'x': rng.rand(3, 6).astype('float32')})
+            f1.result(30)
+            f2.result(30)
+            waited = time.time() - t0
+        m = eng.metrics()
+        # both requests rode ONE deadline-flushed lot (5 rows << 64)
+        assert m['lots'] == 1
+        assert m['deadline_flushes'] == 1 and m['full_flushes'] == 0
+        assert m['requests'] == 2
+        assert waited < 20  # flushed by deadline, not a 64-row wait
+        assert m['p50_latency_ms'] is not None
+
+
+def test_engine_bucket_boundary_recompile_accounting():
+    """Shape bucketing bounds compiles: same-bucket request sizes reuse
+    the executable (compile_count flat); crossing a bucket boundary is
+    exactly one new signature (compile_count rises)."""
+    with tempfile.TemporaryDirectory() as td:
+        prog, feeds, fetches, exe, scope = _save_load_model(td)
+        rng = np.random.RandomState(6)
+        eng = serving.InferenceEngine(
+            prog, feed_names=feeds, fetch_list=fetches,
+            scope=scope, executor=exe,
+            config=serving.ServingConfig(max_batch_size=16,
+                                         bucket_sizes=[4, 8, 16]))
+        eng.infer({'x': rng.rand(3, 6).astype('float32')})   # bucket 4
+        c_after_first = eng.metrics()['compiles']
+        assert c_after_first > 0
+        eng.infer({'x': rng.rand(4, 6).astype('float32')})   # bucket 4
+        eng.infer({'x': rng.rand(2, 6).astype('float32')})   # bucket 4
+        assert eng.metrics()['compiles'] == c_after_first, \
+            'same bucket must not recompile'
+        eng.infer({'x': rng.rand(5, 6).astype('float32')})   # bucket 8
+        c_after_boundary = eng.metrics()['compiles']
+        assert c_after_boundary > c_after_first, \
+            'bucket boundary must be a real compile'
+        eng.infer({'x': rng.rand(7, 6).astype('float32')})   # bucket 8
+        assert eng.metrics()['compiles'] == c_after_boundary
+        assert eng.metrics()['buckets']['active'] == [4, 8]
+        assert eng.metrics()['executor_compile_count'] >= c_after_first
+
+
+def test_bucket_set_policy():
+    """Ladder construction, oversize handling, LRU bound."""
+    bs = serving.ShapeBucketSet(32)
+    assert bs.sizes == [1, 2, 4, 8, 16, 32]
+    assert bs.bucket_for(3) == 4 and bs.bucket_for(32) == 32
+    assert bs.bucket_for(40) == 40  # oversized: exact own bucket
+    assert bs.report()['oversized'] == 1
+    # dp multiple alignment (sharded serving pads to the mesh extent)
+    bs8 = serving.ShapeBucketSet(32, multiple=8)
+    assert all(s % 8 == 0 for s in bs8.sizes)
+    assert bs8.bucket_for(3) == 8
+    # an explicit ladder short of max_batch is extended to cover it —
+    # the batcher coalesces to max_batch regardless, and above-ladder
+    # lots minting exact buckets would void the bounded-compile contract
+    short = serving.ShapeBucketSet(32, sizes=[8, 16])
+    assert short.sizes == [8, 16, 32]
+    assert short.bucket_for(17) == 32
+    assert short.report()['oversized'] == 0
+    # bounded active set: LRU eviction is accounted
+    small = serving.ShapeBucketSet(64, sizes=[1, 2, 4, 8, 16, 32, 64],
+                                   max_buckets=2)
+    for rows in (1, 2, 4, 8):
+        small.bucket_for(rows)
+    rep = small.report()
+    assert len(rep['active']) == 2 and rep['evictions'] == 2
+
+
+def test_unbatchable_request_flushes_without_deadline_wait():
+    """A rows=None (LoD/scalar-feed) request can never coalesce, so the
+    batcher must flush it immediately instead of aging it max_wait."""
+    mb = serving.MicroBatcher(max_batch_size=64, max_wait_s=5.0)
+    mb.submit(serving.InferenceRequest({'x': 0}, None, object()))
+    t0 = time.time()
+    lot = mb.next_lot(timeout=10)
+    assert len(lot) == 1
+    assert time.time() - t0 < 1.0  # not the 5s deadline
+
+
+def test_engine_warns_on_cross_request_reduced_fetch():
+    """A batch-REDUCED fetch (mean over the lot) has no per-request
+    slice: coalesced callers get the whole-lot value, and the engine
+    says so once."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data('x', [6])
+        pred = fluid.layers.fc(x, 4)
+        avg = fluid.layers.mean(pred)
+    test_prog = prog.clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    rng = np.random.RandomState(9)
+    eng = serving.InferenceEngine(
+        test_prog, feed_names=['x'], fetch_list=[pred, avg],
+        scope=scope, executor=exe,
+        config=serving.ServingConfig(max_batch_size=8, max_wait_ms=50))
+    with eng, pytest.warns(UserWarning, match='not per-row'):
+        futs = [eng.submit({'x': rng.rand(2, 6).astype('float32')})
+                for _ in range(3)]
+        outs = [f.result(30) for f in futs]
+    # per-row fetch still slices per request; the reduced one is lot-wide
+    assert all(o[0].shape == (2, 4) for o in outs)
+    assert all(np.shape(o[1]) == () or np.shape(o[1])[0] != 2
+               for o in outs)
+
+
+def test_engine_serves_host_op_programs_eagerly():
+    """A program containing host ops (e.g. a debugging Print) cannot
+    run inside the eval scan — the engine falls back to per-request
+    exe.run with identical semantics (the pre-engine Inferencer path),
+    and still counts lots/dispatches."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data('x', [6])
+        h = fluid.layers.fc(x, 4)
+        fluid.layers.Print(h)  # host op
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    rng = np.random.RandomState(12)
+    eng = serving.InferenceEngine(prog, feed_names=['x'], fetch_list=[h],
+                                  scope=scope, executor=exe)
+    r = {'x': rng.rand(3, 6).astype('float32')}
+    out, = eng.infer(r)
+    with fluid.scope_guard(scope):
+        ref, = exe.run(prog, feed=r, fetch_list=[h])
+    assert np.array_equal(out, ref)
+    m = eng.metrics()
+    assert m['lots'] == 1 and m['dispatches'] == 1
+    with pytest.raises(NotImplementedError, match='host-op'):
+        serving.InferenceEngine(prog, fetch_list=[h], scope=scope,
+                                parallel=True)
+
+
+def test_engine_rejects_disagreeing_leading_dims():
+    with tempfile.TemporaryDirectory() as td:
+        prog, feeds, fetches, exe, scope = _save_load_model(td)
+        eng = serving.InferenceEngine(prog, fetch_list=fetches,
+                                      scope=scope, executor=exe)
+        with pytest.raises(ValueError, match='leading'):
+            eng.submit({'x': np.zeros((3, 6), 'float32'),
+                        'y': np.zeros((2, 6), 'float32')})
+
+
+def test_engine_rejects_empty_request_and_worker_survives():
+    """A 0-row request raises at submit — and even a lot that fails to
+    form mid-worker errors its own future without killing the serving
+    thread (later requests still serve)."""
+    with tempfile.TemporaryDirectory() as td:
+        prog, feeds, fetches, exe, scope = _save_load_model(td)
+        rng = np.random.RandomState(11)
+        eng = serving.InferenceEngine(
+            prog, feed_names=feeds, fetch_list=fetches,
+            scope=scope, executor=exe,
+            config=serving.ServingConfig(max_batch_size=8, max_wait_ms=5))
+        with eng:
+            with pytest.raises(ValueError, match='0 rows'):
+                eng.submit({'x': np.zeros((0, 6), 'float32')})
+            # a request that breaks only at lot formation (bogus rows
+            # smuggled past submit) fails ITS future, not the worker
+            bad = serving.InferenceRequest({'x': 'not-an-array'}, 2,
+                                           ('forged', ))
+            eng._batcher.submit(bad)
+            with pytest.raises(Exception):
+                bad.result(30)
+            out, = eng.infer({'x': rng.rand(2, 6).astype('float32')},
+                             timeout=30)
+            assert out.shape == (2, 4)  # the worker is alive and serving
+
+
+def test_engine_inline_mode_concurrent_submitters():
+    """Concurrent callers on a never-start()ed engine serialize through
+    the inline lock — every future resolves, none crosses wires."""
+    with tempfile.TemporaryDirectory() as td:
+        prog, feeds, fetches, exe, scope = _save_load_model(td)
+        eng = serving.InferenceEngine(prog, feed_names=feeds,
+                                      fetch_list=fetches,
+                                      scope=scope, executor=exe)
+        import threading
+        errors = []
+
+        def client(cid):
+            r = np.random.RandomState(100 + cid)
+            try:
+                for _ in range(10):
+                    n = int(r.randint(1, 5))
+                    x = r.rand(n, 6).astype('float32')
+                    out, = eng.infer({'x': x}, timeout=60)
+                    assert out.shape == (n, 4)
+            except Exception as e:  # surfaced below, not swallowed
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=client, args=(c, ))
+                   for c in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        assert eng.metrics()['requests'] == 40
+
+
+# ---- dp>1 sharded serving ----------------------------------------------
+
+def test_engine_dp_sharded_serving_on_virtual_mesh():
+    """parallel=True serves through ParallelExecutor.run_eval_multi on
+    the 8-device mesh: buckets align to the dp extent, ragged requests
+    pad with masked rows, and results match single-device inference."""
+    with tempfile.TemporaryDirectory() as td:
+        prog, feeds, fetches, exe, scope = _save_load_model(td)
+        rng = np.random.RandomState(7)
+        reqs = _requests(rng, [5, 3, 2, 11])  # none divisible by 8
+        refs = []
+        with fluid.scope_guard(scope):
+            for r in reqs:
+                ref, = exe.run(prog, feed=r, fetch_list=fetches)
+                refs.append(ref)
+        eng = serving.InferenceEngine(
+            prog, feed_names=feeds, fetch_list=fetches,
+            scope=scope, parallel=True,
+            config=serving.ServingConfig(max_batch_size=16,
+                                         max_wait_ms=20))
+        with eng:
+            futs = [eng.submit(r) for r in reqs]
+            outs = [f.result(60) for f in futs]
+        for i, (out, ref) in enumerate(zip(outs, refs)):
+            assert out[0].shape == ref.shape, i
+            np.testing.assert_allclose(out[0], ref, rtol=2e-4,
+                                       atol=1e-5, err_msg='request %d' % i)
+        # every bucket the dp engine compiled is mesh-divisible
+        assert all(b % 8 == 0 for b in eng.metrics()['buckets']['active'])
+
+
+# ---- metrics through the profiler timeline -----------------------------
+
+def test_serving_spans_and_metrics_in_profiler_sidecar():
+    """Engine spans land in fluid.profiler's host timeline and the
+    metrics snapshot rides the .events.json sidecar; tools/timeline.py
+    renders the spans in a dedicated ':serving' process row."""
+    sys.path.insert(0, os.path.join(REPO, 'tools'))
+    try:
+        from timeline import Timeline
+    finally:
+        sys.path.pop(0)
+    with tempfile.TemporaryDirectory() as td:
+        prog, feeds, fetches, exe, scope = _save_load_model(td)
+        rng = np.random.RandomState(8)
+        eng = serving.InferenceEngine(prog, feed_names=feeds,
+                                      fetch_list=fetches,
+                                      scope=scope, executor=exe,
+                                      name='test-engine')
+        p = os.path.join(td, 'prof')
+        with fluid.profiler.profiler('CPU', profile_path=p):
+            eng.infer({'x': rng.rand(3, 6).astype('float32')})
+        sidecar = json.load(open(p + '.events.json'))
+        names = {e['name'] for e in sidecar['host_events']}
+        assert any(n.startswith('serving/dispatch') for n in names), names
+        assert 'serving/queue_wait' in names
+        snap = sidecar['metrics']['test-engine']
+        assert snap['requests'] == 1 and snap['dispatches'] == 1
+        assert snap['batch_fill_ratio'] is not None
+        trace = json.loads(Timeline(
+            {'t': sidecar}).generate_chrome_trace())
+        rows = {e['args']['name'] for e in trace['traceEvents']
+                if e['ph'] == 'M'}
+        assert 't:serving' in rows, rows
+        cats = {e['cat'] for e in trace['traceEvents'] if e['ph'] == 'X'}
+        assert 'serving' in cats
+
+
+def test_engine_stopped_inside_profile_window_keeps_metrics():
+    """The common nesting `with profiler: with engine: ...` stops the
+    engine (unregistering its source) before stop_profiler collects —
+    the sidecar must still carry the engine's final snapshot."""
+    with tempfile.TemporaryDirectory() as td:
+        prog, feeds, fetches, exe, scope = _save_load_model(td)
+        rng = np.random.RandomState(10)
+        p = os.path.join(td, 'prof')
+        with fluid.profiler.profiler('CPU', profile_path=p):
+            eng = serving.InferenceEngine(prog, feed_names=feeds,
+                                          fetch_list=fetches,
+                                          scope=scope, executor=exe,
+                                          name='stopped-engine')
+            with eng:
+                eng.infer({'x': rng.rand(2, 6).astype('float32')})
+        sidecar = json.load(open(p + '.events.json'))
+        snap = sidecar['metrics']['stopped-engine']
+        assert snap['requests'] == 1 and snap['dispatches'] == 1
+
+
+# ---- Inferencer on the engine ------------------------------------------
+
+def _trained_param_dir(tmpdir):
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        with fluid.unique_name.guard():
+            a = fluid.layers.data('a', [4])
+            b = fluid.layers.data('b', [4])
+            fluid.layers.fc(a, 2, name='srv_fc_a')
+            fluid.layers.fc(b, 2, name='srv_fc_b')
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_persistables(exe, tmpdir, main_program=prog)
+
+
+def test_inferencer_guards_disagreeing_feed_dims(tmp_path):
+    """Satellite: Inferencer.infer raises a clear ValueError when feeds
+    disagree on the leading (batch) dim, instead of failing inside
+    XLA — and still serves agreeing feeds (now via the engine)."""
+    pdir = str(tmp_path)
+    _trained_param_dir(pdir)
+
+    def infer_func():
+        a = fluid.layers.data('a', [4])
+        b = fluid.layers.data('b', [4])
+        fa = fluid.layers.fc(a, 2, name='srv_fc_a')
+        fb = fluid.layers.fc(b, 2, name='srv_fc_b')
+        return fluid.layers.elementwise_add(fa, fb)
+
+    inf = fluid.Inferencer(infer_func=infer_func, param_path=pdir,
+                           place=fluid.CPUPlace())
+    with pytest.raises(ValueError, match='leading'):
+        inf.infer({'a': np.zeros((3, 4), 'float32'),
+                   'b': np.zeros((2, 4), 'float32')})
+    out = inf.infer({'a': np.ones((3, 4), 'float32'),
+                     'b': np.ones((3, 4), 'float32')})
+    assert out[0].shape == (3, 2)
+    # the Inferencer really rides the serving engine
+    assert inf._engine.metrics()['requests'] == 1

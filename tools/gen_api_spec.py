@@ -39,8 +39,11 @@ def _walk(prefix, mod, names):
 
 def generate():
     import paddle_tpu.fluid as fluid
+    import paddle_tpu.serving as serving
 
     lines = []
+    lines += _walk('paddle_tpu.serving', serving,
+                   sorted(serving.__all__))
     lines += _walk('paddle_tpu.fluid.layers', fluid.layers,
                    sorted(fluid.layers.__all__))
     lines += _walk('paddle_tpu.fluid.optimizer', fluid.optimizer,

@@ -60,7 +60,20 @@ class Timeline(object):
     def _emit_host(self, label, prof):
         pid = self._allocate_pid()
         self._chrome.emit_pid('%s:host' % label, pid)
+        serving_pid = None
         for ev in prof.get('host_events', []):
+            if ev['name'].startswith('serving/'):
+                # serving-engine spans (queue waits, dispatch->deliver
+                # windows) get their own process row so the micro-batch
+                # pipeline reads at a glance next to executor slices
+                if serving_pid is None:
+                    serving_pid = self._allocate_pid()
+                    self._chrome.emit_pid('%s:serving' % label,
+                                          serving_pid)
+                self._chrome.emit_region(
+                    ev['start_s'] * 1e6, ev['dur_s'] * 1e6, serving_pid,
+                    0, 'serving', ev['name'])
+                continue
             self._chrome.emit_region(
                 ev['start_s'] * 1e6, ev['dur_s'] * 1e6, pid, 0, 'host',
                 ev['name'])
