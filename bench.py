@@ -42,6 +42,15 @@ MFU: analytic model FLOPs (documented per config below) over the v5e
 peak of 197 bf16 TFLOP/s.  All timing is pipelined (fetch-drain): the
 axon dev tunnel costs ~100ms per SYNCED dispatch, which would measure
 the tunnel, not the chip (MFU_BOUND_r03.json).
+
+Every TRAIN config also reports a ``feed_overlap`` block (ISSUE 3):
+fresh batches every step staged through fluid.FeedPipeline, so host
+batch prep + H2D transfer of scan block N+1 overlaps device compute of
+dispatch N — feed_stall ~ 0 after warmup means the device-true numbers
+hold with REAL per-step input, not just a pre-staged constant batch.
+Children share a persistent XLA compilation cache (FLAGS_
+xla_compile_cache_dir; override dir via BENCH_XLA_CACHE, empty
+disables) so re-runs warm-start their compiles from disk.
 """
 
 import json
@@ -94,9 +103,46 @@ def _timed_steps_multi(exe, prog, feed, loss_var, steps, blocks=3):
             float(np.asarray(loss_v).flatten()[0]))
 
 
-def _run(model, feed, on_tpu, steps):
-    """Returns (best_block_elapsed, mean_block_elapsed, steps_per_block);
-    every block runs as one multi-step device dispatch (device-true)."""
+def _feed_overlap_block(exe, prog, loss_var, batch_fn, steps,
+                        pipeline_depth=2, dispatches=2):
+    """The ISSUE 3 paired measurement: FRESH batches every step, staged
+    through fluid.FeedPipeline so host batch prep + H2D transfer of scan
+    block N+1 overlaps device compute of dispatch N.  Times the post-
+    warmup dispatches and reports the pipeline's own stall/overlap
+    counters — the device-true configs' evidence that real per-step
+    input no longer costs host staging on the dispatch path."""
+    import paddle_tpu.fluid as fluid
+    src = (batch_fn(i) for i in range((dispatches + 1) * steps))
+    pipe = fluid.FeedPipeline(exe, fetch_list=[loss_var], program=prog,
+                              source=src, steps=steps,
+                              pipeline_depth=pipeline_depth)
+    it = iter(pipe)
+    next(it)  # warmup dispatch (compiles the scanned executable)
+    t0, n = time.time(), 0
+    for out in it:
+        n += 1
+    # sustained window, not per-yield gaps: the async runtime runs
+    # ahead of the sync points, so individual yield gaps are bimodal
+    elapsed = time.time() - t0
+    assert np.isfinite(np.asarray(out)).all()
+    m = pipe.metrics()
+    return {
+        'steps_per_dispatch': steps,
+        'pipeline_depth': pipeline_depth,
+        'dispatches': m['dispatches'],
+        'ms_per_step_overlapped':
+            round(elapsed / (n * steps) * 1e3, 2) if n else None,
+        'feed_stall_ms_per_dispatch': round(
+            m['feed_stall_s'] / max(m['dispatches'] - 1, 1) * 1e3, 3),
+        'overlap_ratio': round(m['overlap_ratio'], 4),
+    }
+
+
+def _run(model, feed, on_tpu, steps, batch_fn=None, overlap_steps=None):
+    """Returns (best_block_elapsed, mean_block_elapsed, steps_per_block,
+    feed_overlap); every block runs as one multi-step device dispatch
+    (device-true), and batch_fn (fresh batch per step) drives the paired
+    overlapped-input measurement."""
     import paddle_tpu.fluid as fluid
     if not on_tpu:
         steps = 2  # CPU path is a smoke test, not a benchmark
@@ -108,8 +154,13 @@ def _run(model, feed, on_tpu, steps):
         elapsed, mean_elapsed, loss = _timed_steps_multi(
             exe, model['main'], feed, model['loss'], steps,
             blocks=3 if on_tpu else 1)
+        feed_overlap = None
+        if batch_fn is not None:
+            feed_overlap = _feed_overlap_block(
+                exe, model['main'], model['loss'], batch_fn,
+                overlap_steps if on_tpu and overlap_steps else steps)
     assert np.isfinite(loss)
-    return elapsed, mean_elapsed, steps
+    return elapsed, mean_elapsed, steps, feed_overlap
 
 
 def _stage(feed, place_on_tpu):
@@ -135,7 +186,18 @@ def bench_resnet(on_tpu, steps=20):
         'img': rng.standard_normal((batch, ) + shape).astype('float32'),
         'label': rng.randint(0, 1000, size=(batch, 1)).astype('int64'),
     }, on_tpu)
-    elapsed, mean_elapsed, steps = _run(model, feed, on_tpu, steps)
+    brng = np.random.RandomState(1)
+
+    def batch_fn(i):
+        return {'img': brng.standard_normal(
+                    (batch, ) + shape).astype('float32'),
+                'label': brng.randint(
+                    0, 1000, size=(batch, 1)).astype('int64')}
+
+    # overlap block at K=4: a K=20 scanned block of bs512 224^2 images
+    # (2 in flight) would not co-reside with the model on a 16GB chip
+    elapsed, mean_elapsed, steps, feed_overlap = _run(
+        model, feed, on_tpu, steps, batch_fn=batch_fn, overlap_steps=4)
     v = batch * steps / elapsed
     return {
         'metric': 'resnet50_train_imgs_per_sec_per_chip',
@@ -145,6 +207,7 @@ def bench_resnet(on_tpu, steps=20):
         'mfu': round(v * 23.15e9 / PEAK_FLOPS, 4) if on_tpu else None,
         'vs_baseline': round(v / BASELINE_RESNET_IMGS_PER_SEC, 3),
         'device_true': True, 'steps_per_dispatch': steps,
+        'feed_overlap': feed_overlap,
     }
 
 
@@ -180,7 +243,21 @@ def bench_nmt(on_tpu, steps=20, seq_len=32):
     trg = staged(rng.randint(3, dict_dim, size=(batch, seq_len)))
     feed = {'src_word_id': src, 'target_language_word': trg,
             'target_language_next_word': trg}
-    elapsed, mean_elapsed, steps = _run(model, feed, on_tpu, steps)
+    brng = np.random.RandomState(1)
+
+    def batch_fn(i):
+        # the reader's real form: host LoD tensors, padded + staged by
+        # the pipeline's background thread
+        def lod(ids):
+            rows = [r.reshape(-1, 1).tolist() for r in ids]
+            return fluid.create_lod_tensor(rows, [[seq_len] * len(rows)])
+        s = lod(brng.randint(3, dict_dim, size=(batch, seq_len)))
+        t = lod(brng.randint(3, dict_dim, size=(batch, seq_len)))
+        return {'src_word_id': s, 'target_language_word': t,
+                'target_language_next_word': t}
+
+    elapsed, mean_elapsed, steps, feed_overlap = _run(
+        model, feed, on_tpu, steps, batch_fn=batch_fn)
     v = batch * seq_len * steps / elapsed
     return {
         'metric': 'nmt_train_tokens_per_sec_per_chip',
@@ -190,6 +267,7 @@ def bench_nmt(on_tpu, steps=20, seq_len=32):
         'mfu': round(v * 1.404e8 / PEAK_FLOPS, 4) if on_tpu else None,
         'vs_baseline': None,  # reference published no NMT number
         'device_true': True, 'steps_per_dispatch': steps,
+        'feed_overlap': feed_overlap,
     }
 
 
@@ -215,7 +293,15 @@ def bench_transformer(on_tpu, steps=10):
     ids = lambda: rng.randint(1, vocab, size=(batch, seq)).astype('int64')
     feed = _stage({'src_ids': ids(), 'trg_ids': ids(), 'lbl_ids': ids()},
                   on_tpu)
-    elapsed, mean_elapsed, steps = _run(model, feed, on_tpu, steps)
+    brng = np.random.RandomState(1)
+
+    def batch_fn(i):
+        bid = lambda: brng.randint(
+            1, vocab, size=(batch, seq)).astype('int64')
+        return {'src_ids': bid(), 'trg_ids': bid(), 'lbl_ids': bid()}
+
+    elapsed, mean_elapsed, steps, feed_overlap = _run(
+        model, feed, on_tpu, steps, batch_fn=batch_fn, overlap_steps=4)
     v = batch * seq * steps / elapsed
     fpt = _transformer_flops_per_token(n_layer, d, d_ff, seq, vocab)
     return {
@@ -226,6 +312,7 @@ def bench_transformer(on_tpu, steps=10):
         'mfu': round(v * fpt / PEAK_FLOPS, 4) if on_tpu else None,
         'vs_baseline': None,  # reference published no transformer number
         'device_true': True, 'steps_per_dispatch': steps,
+        'feed_overlap': feed_overlap,
     }
 
 
@@ -280,6 +367,20 @@ def bench_stacked_lstm(on_tpu, steps=20, seq_len=64):
             exe.run(model['main'], feed=feed, fetch_list=[])
         exe.run(model['main'], feed=feed, fetch_list=[model['loss']])
         disp_elapsed = time.time() - t0
+        # ISSUE 3 paired block: fresh LoD batches per step, staged
+        # overlapped through the FeedPipeline
+        brng = np.random.RandomState(1)
+
+        def batch_fn(i):
+            rows = [brng.randint(0, 5149, size=(seq_len, 1)).tolist()
+                    for _ in range(batch)]
+            return {'words': fluid.create_lod_tensor(
+                        rows, [[seq_len] * batch]),
+                    'label': brng.randint(
+                        0, 2, size=(batch, 1)).astype('int64')}
+
+        feed_overlap = _feed_overlap_block(
+            exe, model['main'], model['loss'], batch_fn, k)
     assert np.isfinite(np.asarray(loss_v)).all()
     elapsed, mean_elapsed = min(per_block), sum(per_block) / len(per_block)
     v = batch * seq_len * k / elapsed
@@ -293,6 +394,7 @@ def bench_stacked_lstm(on_tpu, steps=20, seq_len=64):
         'vs_baseline': None,  # reference LSTM tables are a different net
         'device_true': True, 'steps_per_dispatch': k,
         'tokens_per_sec_dispatch_bound': round(v_disp, 2),
+        'feed_overlap': feed_overlap,
     }
 
 
@@ -402,6 +504,20 @@ def run_one(name):
         from paddle_tpu.fluid.core import reconcile_platforms
         reconcile_platforms(jax)  # one guard, shared with the library
     import paddle_tpu.fluid as fluid
+    # persistent XLA compilation cache shared by all config children:
+    # a re-run (and configs sharing executables) warm-starts compiles
+    # from disk instead of re-tracing ResNet/transformer from scratch.
+    # BENCH_XLA_CACHE overrides the location; empty disables.
+    cache_dir = os.environ.get('BENCH_XLA_CACHE')
+    if cache_dir is None:
+        import tempfile
+        cache_dir = os.path.join(tempfile.gettempdir(),
+                                 'paddle_tpu_xla_cache')
+    if cache_dir:
+        try:
+            fluid.FLAGS.xla_compile_cache_dir = cache_dir
+        except OSError:
+            pass  # unwritable tmp must not kill the bench
     on_tpu = fluid.core.is_compiled_with_tpu()
     rec = CONFIGS[name](on_tpu)
     print(json.dumps(rec), flush=True)

@@ -23,6 +23,8 @@ from .executor import Executor, global_scope, scope_guard, fetch_var
 from . import parallel_executor
 from .parallel_executor import ParallelExecutor, ExecutionStrategy, \
     BuildStrategy
+from . import dataflow
+from .dataflow import FeedPipeline
 from . import initializer
 from . import layers
 from . import nets
@@ -68,4 +70,5 @@ __all__ = framework.__all__ + executor.__all__ + [
     'regularizer', 'LoDTensor', 'CPUPlace', 'TPUPlace', 'CUDAPlace',
     'CUDAPinnedPlace', 'Tensor', 'ParamAttr', 'WeightNormParamAttr',
     'DataFeeder', 'clip', 'profiler', 'unique_name', 'flags', 'FLAGS',
+    'dataflow', 'FeedPipeline',
 ]

@@ -1,0 +1,506 @@
+"""Overlapped input pipeline: reader-fed multi-step dispatch with
+double-buffered device staging.
+
+The reference Fluid stack overlaps host decode/transfer with device
+compute through py_reader + double_buffer (executor.cc:321-339 pulls
+fresh data every iteration; create_double_buffer_reader_op.cc stages the
+next batch ahead).  Our multi-step scan path (`run_multi`) removed the
+per-step dispatch tax but left feed preparation ON the dispatch critical
+path: every K-step block was stacked and `device_put` synchronously
+before the dispatch could issue.
+
+`FeedPipeline` retires that tax:
+
+  1. a background STAGING thread drains K fresh minibatches per block
+     from a py_reader feeder (or any iterator of feed dicts), prepares
+     them (LoD -> padded + @SEQLEN), stacks them into ONE scanned
+     [K, ...] block, and places it on device — plain `device_put` for
+     `Executor`, dp-sharded placement via the compiled block's
+     `scanned_sharding` (parallel.scanned_spec) for `ParallelExecutor`;
+  2. the DISPATCH loop issues each staged block through the executor's
+     async front half (`_dispatch_multi_scanned` — no host sync), so
+     while dispatch N computes on device, block N+1 is already being
+     staged and block N-1's fetches are being delivered;
+  3. a bounded ``pipeline_depth`` of dispatches stays in flight (2 =
+     double buffering); the scanned block is DONATED on device so two
+     in-flight dispatches recycle the feed buffer instead of holding
+     2x K batches alive;
+  4. feed-stall seconds, overlap ratio and queue depth surface through
+     the `fluid.profiler` metrics-source registry (and ``pipeline/``
+     timeline spans, rendered by tools/timeline.py in a ``:pipeline``
+     row).
+
+`run_multi(reader=..., steps=K)` is the synchronous one-dispatch form:
+it drains K DISTINCT batches from the reader (matching the reference
+per-iteration pull) and trains on them as one scanned dispatch — scope
+state lands exactly as K sequential run() calls over the same batch
+stream would leave it.
+"""
+
+import threading
+import time
+import queue as _queue
+
+from . import core
+from . import profiler as _profiler
+from .executor import (prepare_feed_arrays, feed_signature, stack_steps,
+                       _current_scope)
+from .framework import default_main_program, Variable
+
+__all__ = ['FeedPipeline', 'drain_reader_feed_list']
+
+_PIPELINE_SEQ = [0]
+_PIPELINE_SEQ_LOCK = threading.Lock()
+
+
+def find_read_op(program, reader=None):
+    """The program's read op (optionally the one consuming ``reader``).
+    Reader-driven run_multi composes with exactly ONE reader: a program
+    pulling from several queues has no single batch stream to contract
+    against K sequential run() calls."""
+    ops = [op for op in program.global_block().ops if op.type == 'read']
+    if reader is not None:
+        name = reader.name if isinstance(reader, Variable) else str(reader)
+        ops = [op for op in ops if op.input('Reader')[0] == name]
+        if not ops:
+            raise RuntimeError(
+                'run_multi(reader=...): the program has no read op '
+                'consuming reader %r' % name)
+    if not ops:
+        raise RuntimeError(
+            'run_multi(reader=...): the program is not reader-fed — '
+            'pass feed= or feed_list= instead')
+    if len(ops) > 1:
+        raise RuntimeError(
+            'run_multi(reader=...): the program reads from %d readers; '
+            'reader-driven multi-step dispatch supports exactly one'
+            % len(ops))
+    return ops[0]
+
+
+def _feeder_of(program, reader, place=None):
+    """(feeder, output names) for the program's read op; binds the
+    prefetch target to the consuming executor like run()'s pop path."""
+    from .layers import io as layers_io
+    op = find_read_op(program, reader)
+    reader_name = op.input('Reader')[0]
+    feeder = layers_io.get_reader_feeder(reader_name)
+    if feeder is None:
+        raise RuntimeError('no py_reader registered for %r' % reader_name)
+    if place is not None:
+        feeder._executor_place = place
+    return feeder, list(op.output('Out'))
+
+
+def drain_reader_feed_list(program, reader, steps, place=None):
+    """Pop up to ``steps`` FRESH minibatches from the program's reader
+    queue, as a run_multi-shaped feed_list of PREPARED feed dicts (the
+    reference multi-iteration loop pulls fresh data every iteration,
+    executor.cc:321-339).  The drain stops at a shape-bucket boundary —
+    a ragged drop_last=False tail batch is PUSHED BACK onto the stream
+    for the next call instead of crashing the scan's uniformity check
+    (and losing the drained prefix).  A stream ending mid-block returns
+    the shorter tail; an already-exhausted reader raises
+    core.EOFException exactly like run()."""
+    # NOTE twin of FeedPipeline._next_block's drain loop — same
+    # pop/prepare/bucket-boundary contract, feeder.push_back as the
+    # leftover mechanism (the next CALL re-drains the same feeder) and
+    # pre-pad grouping (padding happens downstream in PE.run_multi's
+    # feed_list normalize).  A boundary-semantics change must land in
+    # BOTH.
+    feeder, names = _feeder_of(program, reader, place)
+    out, sig0 = [], None
+    for _ in range(int(steps)):
+        batch = feeder.pop()
+        if batch is None:
+            break
+        prepared = prepare_feed_arrays(dict(zip(names, batch)))
+        sig = feed_signature(prepared)
+        if out and sig != sig0:
+            feeder.push_back(batch)
+            break
+        sig0 = sig
+        out.append(prepared)
+    if not out:
+        raise core.EOFException(
+            'reader is exhausted — call reader.reset() and '
+            'reader.start() for the next pass')
+    return out
+
+
+class _Block(object):
+    """One staged K-step scan block."""
+
+    __slots__ = ('steps', 'sig_feed', 'scanned', 'placed', 'real',
+                 'padded', 'batch_feed_names')
+
+    def __init__(self, steps, sig_feed, scanned, placed, real=0, padded=0,
+                 batch_feed_names=None):
+        self.steps = steps
+        self.sig_feed = sig_feed  # per_step[0]: keys the compile cache
+        self.scanned = scanned  # {name: [K, ...]}
+        self.placed = placed
+        # the LAST step's real/padded row counts (fetches come from the
+        # last iteration): batch-led fetches of a dp-padded lot trim
+        # back to the real rows, like PE.run_multi's
+        self.real = real
+        self.padded = padded
+        # pre-pad provenance from the padding pass: which feeds are
+        # batch-led, so an aux feed whose rows merely coincide with the
+        # padded lot size is never masked or trimmed (PR 1 contract)
+        self.batch_feed_names = batch_feed_names
+
+
+class FeedPipeline(object):
+    """Reader-fed multi-step training with double-buffered device
+    staging: block N+1 stages on a background thread while dispatch N
+    computes; up to ``pipeline_depth`` dispatches stay in flight.
+
+    executor: `fluid.Executor` or `fluid.ParallelExecutor`.
+    fetch_list: fetch targets (the LAST step of each dispatch delivers).
+    reader: a py_reader Variable the program consumes via read_file, OR
+    source: any iterator of feed dicts (the Trainer's DataFeeder form).
+    steps: minibatches per dispatch (the scan length K).
+    pipeline_depth: staged blocks ahead + dispatches in flight (2 =
+        double buffering).
+
+    Iterate the pipeline to drive it: each item is one dispatch's
+    converted last-step fetches.  ``metrics()`` snapshots feed-stall
+    seconds, overlap ratio and queue depth; inside a profiler window the
+    same snapshot rides the ``.events.json`` sidecar and ``pipeline/``
+    spans land in the timeline (`tools/timeline.py` renders them in a
+    ``:pipeline`` row)."""
+
+    def __init__(self, executor, fetch_list, program=None, reader=None,
+                 source=None, steps=1, pipeline_depth=2, scope=None,
+                 return_numpy=True, name=None):
+        if (reader is None) == (source is None):
+            raise ValueError('FeedPipeline: pass reader= OR source=')
+        if int(steps) < 1:
+            raise ValueError('FeedPipeline: steps must be >= 1')
+        if int(pipeline_depth) < 1:
+            raise ValueError('FeedPipeline: pipeline_depth must be >= 1')
+        self._exe = executor
+        self._is_spmd = hasattr(executor, '_mesh')
+        if self._is_spmd:
+            if program is not None or scope is not None:
+                raise ValueError(
+                    'FeedPipeline: a ParallelExecutor runs its OWN '
+                    'main_program in its own scope — drop program=/'
+                    'scope=, or build the ParallelExecutor over them')
+            self._program = executor._main_program
+            # lots whose batch is not divisible by the dp extent pad
+            # with masked samples on the staging thread (the PR 1
+            # machinery), exactly like PE.run_multi's explicit lots
+            self._pad = executor._pad_ragged
+        else:
+            self._program = (program if program is not None
+                             else default_main_program())
+            self._scope = scope if scope is not None else _current_scope()
+        self._fetch_list = fetch_list
+        self.steps = int(steps)
+        self.pipeline_depth = int(pipeline_depth)
+        self._return_numpy = return_numpy
+        if reader is not None:
+            place = None if self._is_spmd else self._exe.place
+            feeder, names = _feeder_of(self._program, reader, place)
+            self._next_batch = self._reader_batches(feeder, names)
+        else:
+            self._next_batch = iter(source)
+        self._staged = _queue.Queue(maxsize=self.pipeline_depth)
+        self._inflight = []
+        self._pending = None  # a prepared batch held across a bucket split
+        self._placer = None  # set before the first placed block
+        self._error = None
+        self._closed = False
+        self._thread = None
+        self._started = False
+        # metrics: the staging thread owns stage_*, the dispatch loop
+        # owns the rest — disjoint keys, snapshot() copies
+        self._m = {'blocks_staged': 0, 'stage_s': 0.0, 'stage_s_first': 0.0,
+                   'dispatches': 0, 'steps_dispatched': 0,
+                   'feed_stall_s': 0.0, 'partial_blocks': 0, 'eof': False}
+        with _PIPELINE_SEQ_LOCK:
+            _PIPELINE_SEQ[0] += 1
+            seq = _PIPELINE_SEQ[0]
+        self.name = name or ('feed-pipeline-%d' % seq)
+        # sidecar metrics source, weakly bound like the serving engine's
+        # so a profiled window dumps the snapshot without keeping dead
+        # pipelines alive
+        import weakref
+        ref = weakref.ref(self)
+        self._metrics_fn = lambda: (ref().metrics() if ref() else None)
+        _profiler.register_metrics_source(self.name, self._metrics_fn)
+        weakref.finalize(self, _profiler.unregister_metrics_source,
+                         self.name, self._metrics_fn)
+
+    # ---- sources -------------------------------------------------------
+
+    @staticmethod
+    def _reader_batches(feeder, names):
+        while True:
+            batch = feeder.pop()
+            if batch is None:
+                return
+            yield dict(zip(names, batch))
+
+    # ---- staging thread ------------------------------------------------
+
+    def _put(self, item):
+        while not self._closed:
+            try:
+                self._staged.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _next_block(self):
+        # NOTE twin of drain_reader_feed_list's drain loop — same
+        # pop/prepare/bucket-boundary contract, different leftover
+        # mechanism (self._pending here vs feeder.push_back there,
+        # because a plain `source=` iterator has nothing to push back
+        # to) and post-pad grouping here (the sync path's padding
+        # happens downstream in PE.run_multi's feed_list normalize).
+        # A boundary-semantics change must land in BOTH.
+        per_step, sig0, last_rp, bn0 = [], None, (0, 0), None
+        while len(per_step) < self.steps:
+            if self._closed:
+                # close() mid-drain: stop consuming the source — a
+                # zombie stager finishing its K-batch block would
+                # silently eat up to `steps` more reader batches from
+                # a pass the user may keep reading manually
+                return None
+            if self._pending is not None:
+                (prepared, rp, bn), self._pending = self._pending, None
+            else:
+                try:
+                    batch = next(self._next_batch)
+                except StopIteration:
+                    break
+                prepared = prepare_feed_arrays(dict(batch))
+                rp, bn = (0, 0), None
+                if self._is_spmd:
+                    # dp-pad ragged lots (masked samples) BEFORE the
+                    # bucket grouping, so a non-divisible tail becomes
+                    # its own padded block instead of failing the
+                    # sharded device_put on the staging thread; the
+                    # report records pre-pad batch-led provenance
+                    rpt = {}
+                    prepared, real, padded = self._pad(prepared,
+                                                       report=rpt)
+                    rp, bn = (real, padded), rpt.get('batch_names')
+            sig = feed_signature(prepared)
+            if per_step and sig != sig0:
+                # shape-bucket boundary (e.g. a ragged FINAL batch,
+                # drop_last=False): close this block and start the next
+                # one at the new signature — a shorter tail block is
+                # one extra (steps, shape) compile, never a crash
+                self._pending = (prepared, rp, bn)
+                break
+            sig0 = sig
+            if not per_step:
+                bn0 = bn  # the block's compile records step 0's view
+            per_step.append(prepared)
+            last_rp = rp
+        if not per_step:
+            return None
+        # uniformity holds by construction: every step shares sig0
+        stacked = {n: stack_steps([fa[n] for fa in per_step])
+                   for n in per_step[0]}
+        placer = self._placer
+        if placer is not None:
+            stacked = {n: placer(n, v) for n, v in stacked.items()}
+        return _Block(len(per_step), per_step[0], stacked,
+                      placer is not None, last_rp[0], last_rp[1], bn0)
+
+    def _stage_loop(self):
+        first = True
+        try:
+            while not self._closed:
+                t0 = time.time()
+                block = self._next_block()
+                if block is None:
+                    self._m['eof'] = True
+                    break
+                dt = time.time() - t0
+                self._m['blocks_staged'] += 1
+                self._m['stage_s'] += dt
+                if first:
+                    self._m['stage_s_first'] = dt
+                    first = False
+                if block.steps < self.steps:
+                    self._m['partial_blocks'] += 1
+                _profiler.record_event('pipeline/stage[x%d]' % block.steps,
+                                       dt, start=t0)
+                if not self._put(block):
+                    return
+        except BaseException as e:
+            self._error = e
+        finally:
+            self._put(None)
+
+    # ---- dispatch loop -------------------------------------------------
+
+    def start(self):
+        if self._closed:
+            raise RuntimeError('FeedPipeline is closed')
+        if not self._started:
+            self._started = True
+            self._thread = threading.Thread(
+                target=self._stage_loop, name=self.name, daemon=True)
+            self._thread.start()
+        return self
+
+    def _ensure_placer(self, block):
+        """Resolve the executor-specific device placement for scanned
+        blocks.  `Executor` stages to its place; `ParallelExecutor`
+        needs the compiled block's per-feed GSPMD sharding shifted
+        right of the steps axis (`parallel.scanned_spec`), which only
+        exists after the first resolve — so the FIRST block is placed
+        here on the dispatch thread, and every later block is placed by
+        the staging thread."""
+        import jax
+        if self._placer is not None:
+            return
+        if self._is_spmd:
+            fetch_names = self._exe._fetch_names(self._fetch_list)
+            compiled = self._exe._resolve(fetch_names, block.sig_feed,
+                                          block.batch_feed_names)
+
+            def placer(n, v):
+                try:
+                    sharding = compiled.scanned_sharding(n)
+                except KeyError:
+                    # a name outside the first resolve's feed set (the
+                    # @SAMPLE_MASK a padded tail block adds): batch-led
+                    # by construction, so the default dp spec applies
+                    from jax.sharding import NamedSharding, \
+                        PartitionSpec as P
+                    from ..parallel.api import scanned_spec
+                    spec = (P(compiled.batch_axis) if compiled.batch_axis
+                            in compiled.mesh.axis_names else P())
+                    sharding = NamedSharding(compiled.mesh,
+                                             scanned_spec(spec))
+                return jax.device_put(v, sharding)
+
+            self._placer = placer
+        else:
+            dev = self._exe.place.jax_device()
+            self._placer = lambda n, v, _dev=dev: jax.device_put(v, _dev)
+
+    def _dispatch(self, block):
+        self._ensure_placer(block)
+        if not block.placed:
+            block.scanned = {n: self._placer(n, v)
+                             for n, v in block.scanned.items()}
+            block.placed = True
+        if self._is_spmd:
+            fetches, compiled = self._exe._dispatch_multi_scanned(
+                self._fetch_list, block.sig_feed, block.scanned,
+                block.steps, batch_feed_names=block.batch_feed_names)
+        else:
+            fetches, compiled = self._exe._dispatch_multi_scanned(
+                self._program, self._fetch_list, self._scope,
+                block.sig_feed, block.scanned, block.steps)
+        self._m['dispatches'] += 1
+        self._m['steps_dispatched'] += block.steps
+        self._inflight.append((fetches, compiled, block, time.time()))
+
+    def _drain_one(self):
+        fetches, compiled, block, t0 = self._inflight.pop(0)
+        if self._is_spmd:
+            # batch-led fetches of a dp-padded tail lot trim back to
+            # the real row count, exactly like PE.run_multi's
+            out = self._exe._convert_fetches(
+                fetches, self._return_numpy, block.real, block.padded,
+                compiled=compiled)
+        else:
+            out = self._exe._convert_fetches(fetches, self._return_numpy)
+        _profiler.record_event('pipeline/dispatch[x%d]' % block.steps,
+                               time.time() - t0, start=t0)
+        return out
+
+    def __iter__(self):
+        self.start()
+        try:
+            while True:
+                t0 = time.time()
+                block = self._staged.get()
+                stall = time.time() - t0
+                if block is None:
+                    # the EOF sentinel's wait delayed no dispatch — it
+                    # must not count as feed stall (it would skew the
+                    # 'feed_stall ~ 0' acceptance metric)
+                    if self._error is not None:
+                        err, self._error = self._error, None
+                        raise RuntimeError(
+                            'FeedPipeline source failed: %r'
+                            % (err, )) from err
+                    break
+                if self._m['dispatches'] > 0:
+                    # the FIRST get always waits (nothing to overlap
+                    # with yet); only post-warmup waits are feed stall
+                    self._m['feed_stall_s'] += stall
+                    if stall > 1e-4:
+                        _profiler.record_event('pipeline/feed_stall',
+                                               stall, start=t0)
+                self._dispatch(block)
+                while len(self._inflight) >= self.pipeline_depth:
+                    yield self._drain_one()
+            while self._inflight:
+                yield self._drain_one()
+        finally:
+            self.close()
+
+    def run(self):
+        """Drive the pipeline to EOF; returns the per-dispatch list of
+        converted last-step fetches."""
+        return list(self)
+
+    def metrics(self):
+        m = dict(self._m)
+        m['queue_depth'] = self._staged.qsize()
+        m['inflight'] = len(self._inflight)
+        m['pipeline_depth'] = self.pipeline_depth
+        m['steps_per_dispatch'] = self.steps
+        # staging hidden behind compute: of the staging seconds spent
+        # AFTER the first dispatch could run, the fraction the dispatch
+        # loop did NOT wait for (feed_stall ~ 0 => ratio ~ 1)
+        denom = m['stage_s'] - m['stage_s_first']
+        if denom > 0:
+            m['overlap_ratio'] = max(0.0, min(
+                1.0, (denom - m['feed_stall_s']) / denom))
+        else:
+            m['overlap_ratio'] = 1.0 if m['feed_stall_s'] < 1e-3 else 0.0
+        return m
+
+    def _drain_staged(self):
+        try:
+            while True:
+                self._staged.get_nowait()
+        except _queue.Empty:
+            pass
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        # unblock a stager stuck on a full queue...
+        self._drain_staged()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        # ...and drop the block its unblocked put() may have deposited
+        # AFTER the first drain — a staged ResNet-scale device block
+        # pinned in the queue would hold HBM for as long as the caller
+        # keeps the pipeline object (e.g. to read metrics())
+        self._drain_staged()
+        self._inflight = []
+        _profiler.unregister_metrics_source(self.name, self._metrics_fn)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()

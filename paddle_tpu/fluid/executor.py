@@ -308,16 +308,23 @@ def convert_eval_fetches(stacked, reals, target, compiled, steps,
 
 
 def _reject_reader_fed(program, what):
-    """run_multi never composes with py_reader-fed programs: resolving
-    would pop exactly ONE minibatch and the K-step loop would train on
-    it K times with no signal (the reference multi-iteration loop,
-    executor.cc:321-339, pulls fresh data every iteration)."""
+    """The PLAIN-FEED multi paths never compose with py_reader-fed
+    programs: resolving would pop exactly ONE minibatch and the K-step
+    loop would train on it K times with no signal (the reference
+    multi-iteration loop, executor.cc:321-339, pulls fresh data every
+    iteration).  run_multi(reader=..., steps=K) is the composing form:
+    it drains K DISTINCT batches per dispatch (fluid.dataflow)."""
     prog = program if program is not None else default_main_program()
     if any(op.type == 'read' for op in prog.global_block().ops):
+        # run_eval_multi has no reader= mode (ROADMAP follow-up): its
+        # message must not send users to the TRAIN multi path
+        hint = ('pass the reader (run_multi(reader=..., steps=K) '
+                'drains K fresh batches per dispatch), feed the '
+                'batches explicitly,' if 'eval' not in what else
+                'feed the batches explicitly (feed= or feed_list=)')
         raise RuntimeError(
-            '%s does not compose with py_reader-fed programs — feed '
-            'the batches explicitly (feed= or feed_list=) or use '
-            'run() per step' % what)
+            '%s does not compose with py_reader-fed programs through '
+            'feed=/feed_list= — %s or use run() per step' % (what, hint))
     return prog
 
 
@@ -701,16 +708,34 @@ class _CompiledBlock(object):
 
         return multi
 
-    def _get_multi_jit(self, feeds, scanned):
-        """One jit wraps every (feeds, scanned) structure — jax retraces
-        per pytree structure internally.  _SpmdCompiledBlock overrides
+    def _wrap_multi_jit(self, feeds, scanned, donate):
+        """jit wrapping for the train scan; _SpmdCompiledBlock overrides
         this to attach per-structure GSPMD shardings."""
         import jax
-        if not hasattr(self, '_multi_jit'):
-            self._multi_jit = jax.jit(
-                self._make_multi(), static_argnums=(5, ),
-                donate_argnums=(0, ) if self.state_rw else ())
-        return self._multi_jit
+        return jax.jit(self._make_multi(), static_argnums=(5, ),
+                       donate_argnums=donate)
+
+    def _get_multi_jit(self, feeds, scanned):
+        """One train-scan executable per (feeds, scanned) name structure.
+        Like the eval scan, the scanned K-step feed block is DONATED on
+        device: it is dead the moment the scan consumed it, so XLA
+        recycles the buffer in place — the FeedPipeline's two in-flight
+        dispatches then double-buffer the feed block instead of holding
+        2x K batches of input alive."""
+        key = (tuple(sorted(feeds)), tuple(sorted(scanned)))
+        cache = getattr(self, '_multi_jits', None)
+        if cache is None:
+            cache = self._multi_jits = {}
+        jitted = cache.get(key)
+        if jitted is None:
+            donate = (0, ) if self.state_rw else ()
+            if scanned and self._device_platform() != 'cpu':
+                # XLA CPU can't alias the scanned block (it would warn
+                # and copy); on device the donation is the point
+                donate = donate + (3, )
+            jitted = self._wrap_multi_jit(feeds, scanned, donate)
+            cache[key] = jitted
+        return jitted
 
     def note_multi_compile(self, steps, scanned, seen_attr='_multi_steps_seen'):
         """True exactly when this (steps, scanned shape signature) pair
@@ -1030,7 +1055,8 @@ class Executor(object):
                   steps=1,
                   scope=None,
                   return_numpy=True,
-                  feed_list=None):
+                  feed_list=None,
+                  reader=None):
         """Run ``steps`` iterations of the program as ONE device
         dispatch.  Returns the LAST iteration's fetches.  For
         dispatch-bound small steps — e.g. the stacked-LSTM benchmark
@@ -1042,11 +1068,27 @@ class Executor(object):
         feed: one batch reused every iteration (fori_loop), OR
         feed_list: a list of per-iteration batches (same shapes/LoD
         bucket) scanned on device — a mini-epoch in one dispatch;
-        ``steps`` is then len(feed_list)."""
-        # the guard covers BOTH feed paths: the plain-feed path would
-        # otherwise pop ONE reader minibatch in _resolve_and_compile and
-        # silently train K steps on it
-        program = _reject_reader_fed(program, 'run_multi')
+        ``steps`` is then len(feed_list), OR
+        reader: the program's py_reader — ``steps`` DISTINCT fresh
+        minibatches drain from its queue and scan as one dispatch
+        (the reference per-iteration pull, executor.cc:321-339); a
+        stream ending mid-block trains on the shorter tail, an
+        exhausted reader raises core.EOFException exactly like run().
+        Overlapped staging across dispatches is fluid.FeedPipeline."""
+        if reader is not None:
+            if feed is not None or feed_list is not None:
+                raise ValueError(
+                    'run_multi: pass reader= OR feed/feed_list')
+            from .dataflow import drain_reader_feed_list
+            program = program if program is not None else \
+                default_main_program()
+            feed_list = drain_reader_feed_list(program, reader, steps,
+                                               self.place)
+        else:
+            # the guard covers BOTH plain-feed paths: they would
+            # otherwise pop ONE reader minibatch in _resolve_and_compile
+            # and silently train K steps on it
+            program = _reject_reader_fed(program, 'run_multi')
         if feed_list is not None:
             if feed is not None:
                 raise ValueError('run_multi: pass feed OR feed_list')
@@ -1055,7 +1097,7 @@ class Executor(object):
             # prepared: prepare_feed_arrays passes arrays through, so
             # the resolve path does not re-pad batch 0)
         program, scope, feed_arrays, compiled = self._resolve_and_compile(
-            program, feed, fetch_list, scope)
+            program, feed, fetch_list, scope, pop_readers=False)
         scanned = None
         if feed_list is not None:
             import jax
@@ -1087,6 +1129,24 @@ class Executor(object):
         fetches = compiled.run_multi(scope, feed_arrays, rng, steps,
                                      scanned_feeds=scanned)
         return self._convert_fetches(fetches, return_numpy)
+
+    def _dispatch_multi_scanned(self, program, fetch_list, scope,
+                                sig_feed, scanned, steps):
+        """Async front half of a scanned run_multi dispatch (the
+        FeedPipeline drives this): resolve + compile keyed on
+        ``sig_feed`` (the first prepared per-step feed dict), dispatch
+        ONE pre-staged [K, ...] scanned block, and return the raw
+        device fetches with NO host sync — so the host can stage block
+        N+1 (and deliver block N-1) while N still computes.  State
+        write-back to the scope happens inside (async device arrays)."""
+        program, scope, _, compiled = self._resolve_and_compile(
+            program, sig_feed, fetch_list, scope, pop_readers=False)
+        rng = self._next_rng(program)
+        if compiled.note_multi_compile(steps, scanned):
+            self.compile_count += 1
+        fetches = compiled.run_multi(scope, {}, rng, int(steps),
+                                     scanned_feeds=scanned)
+        return fetches, compiled
 
     def _dispatch_eval_multi(self,
                              program=None,

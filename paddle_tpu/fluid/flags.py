@@ -167,6 +167,14 @@ DEFINE_int32('rpc_deadline', 180000,
 DEFINE_bool('eager_delete_scope', True,
             'Drop executor kid scopes eagerly (scope lifetimes are '
             'Python-managed here; kept for launcher parity).')
+DEFINE_string('xla_compile_cache_dir', '',
+              'Persistent XLA compilation cache directory '
+              '(jax_compilation_cache_dir): compiled executables are '
+              'written to disk and reused across PROCESSES, cutting '
+              'warm-start compile time — bench.py points every config '
+              'child at one shared dir (override/disable via '
+              'BENCH_XLA_CACHE).  Env-settable like every flag: '
+              'FLAGS_xla_compile_cache_dir=/path.  Empty disables.')
 DEFINE_string('fused_lstm', 'auto',
               "lstm-op recurrence impl: 'auto' picks the fused Pallas "
               "cell kernel (ops/pallas/lstm.py) when the shape profile "
@@ -177,6 +185,26 @@ DEFINE_string('fused_lstm', 'auto',
               'recurrence) always uses the scan path.')
 
 on_set('check_nan_inf', _toggle_jax_debug_nans)
+
+
+def _apply_xla_compile_cache(path):
+    import jax
+    if path:
+        import os as _os
+        _os.makedirs(path, exist_ok=True)
+        jax.config.update('jax_compilation_cache_dir', path)
+        try:
+            # cache even fast compiles: the bench children are
+            # short-lived, so every skipped retrace is wall clock
+            jax.config.update(
+                'jax_persistent_cache_min_compile_time_secs', 0.0)
+        except AttributeError:
+            pass  # older jax: keep its default threshold
+    else:
+        jax.config.update('jax_compilation_cache_dir', None)
+
+
+on_set('xla_compile_cache_dir', _apply_xla_compile_cache)
 
 
 def _validate_fused_lstm(value):

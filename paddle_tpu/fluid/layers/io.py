@@ -79,6 +79,13 @@ class _PyReaderFeeder(object):
         self._exhausted = False
         self._error = None
         self._shuffle_buffer = 0
+        # one batch handed back by a consumer that drained past a
+        # shape-bucket boundary (reader-fed run_multi): delivered again
+        # by the next pop of the SAME pass
+        self._pushback = None
+        # serializes pass-boundary state (generation, _exhausted,
+        # _error) against a pop() racing reset()+start()
+        self._gen_lock = threading.RLock()
         # set by double_buffer(): batches are padded + device_put on a
         # prefetch thread so transfer of batch N+1 overlaps step N
         self._double_buffer_place = None
@@ -134,9 +141,14 @@ class _PyReaderFeeder(object):
     def start(self):
         if self._provider is None:
             raise RuntimeError('decorate a data source before start()')
-        self.queue.reopen()
-        self._exhausted = False
-        self._error = None
+        with self._gen_lock:
+            self.queue.reopen()
+            self._exhausted = False
+            self._error = None
+            # every pass is one generation: pop()/push_back() compare
+            # against it so an aborted pass can neither hang on a dead
+            # queue nor leak state into a restarted one
+            self._generation = getattr(self, '_generation', 0) + 1
 
         provider = self._provider
         if self._shuffle_buffer > 1:
@@ -192,15 +204,19 @@ class _PyReaderFeeder(object):
 
     def _start_zero_copy_pipeline(self, provider):
         import queue as _queue
-        self._closed = False
-        self._generation = getattr(self, '_generation', 0) + 1
-        gen = self._generation
         end = object()
         # locals captured by the closures: a thread from a PREVIOUS
         # generation that outlives reset() keeps touching ITS queues and
         # can never corrupt the next epoch's state
         ref_q = _queue.Queue(maxsize=max(2, min(int(self.capacity), 8)))
-        dev_q = self._dev_queue = _queue.Queue(maxsize=2)
+        dev_q = _queue.Queue(maxsize=2)
+        with self._gen_lock:
+            # the pass state flips atomically w.r.t. a pop() snapshot:
+            # a consumer never sees the new generation with the OLD (or
+            # a missing) device queue and route/poll the wrong stream
+            self._closed = False
+            gen = self._generation  # bumped by start(), the only caller
+            self._dev_queue = dev_q
 
         def _live():
             return not self._closed and self._generation == gen
@@ -243,8 +259,10 @@ class _PyReaderFeeder(object):
                 _record_error(e)
                 _put(dev_q, None)
 
-        self._thread = threading.Thread(target=produce, daemon=True)
-        self._convert_thread = threading.Thread(target=convert, daemon=True)
+        with self._gen_lock:
+            self._thread = threading.Thread(target=produce, daemon=True)
+            self._convert_thread = threading.Thread(target=convert,
+                                                    daemon=True)
         self._thread.start()
         self._convert_thread.start()
 
@@ -258,11 +276,53 @@ class _PyReaderFeeder(object):
                 'py_reader data provider failed: %r' % (err, )) from err
         return None
 
+    def push_back(self, batch):
+        """Hand ONE popped batch back to the stream: the next pop of
+        this pass delivers it again (reader-fed run_multi drains up to
+        a shape-bucket boundary and returns the first differing batch
+        here instead of dropping it).  Generation-stamped: a batch
+        whose pass was reset() between the pop and the push-back is
+        DROPPED, never leaked into a restarted pass's stream."""
+        with self._gen_lock:
+            if getattr(self, '_generation', 0) == \
+                    getattr(self, '_last_pop_gen', 0):
+                self._pushback = batch
+
     def pop(self):
-        if self._convert_thread is not None:
+        with self._gen_lock:
+            # one consistent pass snapshot: reset()/start() mutate the
+            # pushback, queue, flags and generation under this lock, so
+            # the held batch we deliver, the queue we poll below and
+            # the generation we compare against can never straddle a
+            # pass boundary.  Routing keys on the device queue ALONE
+            # (its presence is the zero-copy pass marker) — no second
+            # field to read consistently.
+            if self._pushback is not None:
+                batch, self._pushback = self._pushback, None
+                return batch
+            dev_q = self._dev_queue
+            gen = self._last_pop_gen = getattr(self, '_generation', 0)
+        if dev_q is not None:
             if self._exhausted:  # the sentinel is delivered only once
                 return None
-            batch = self._dev_queue.get()
+            import queue as _queue_mod
+            while True:
+                try:
+                    batch = dev_q.get(timeout=0.1)
+                    break
+                except _queue_mod.Empty:
+                    if self._closed or self._generation != gen:
+                        # reset() raced this pop: the generation's
+                        # workers exit WITHOUT delivering the sentinel,
+                        # so a bare get() would hang forever.  Under
+                        # the gen lock, signal EOF (or the provider's
+                        # error) for THIS pass — if reset()+start()
+                        # already began the next generation, report
+                        # plain EOF without poisoning its state.
+                        with self._gen_lock:
+                            if getattr(self, '_generation', 0) != gen:
+                                return None
+                            return self._eof_or_raise()
             if batch is None:
                 return self._eof_or_raise()
             return batch
@@ -272,8 +332,10 @@ class _PyReaderFeeder(object):
         return pickle.loads(data)
 
     def reset(self):
-        self.queue.close()
-        self._closed = True
+        with self._gen_lock:
+            self._pushback = None  # a held batch dies with its pass
+            self.queue.close()
+            self._closed = True
         if self._convert_thread is not None:
             self._convert_thread.join(timeout=5)
             self._convert_thread = None

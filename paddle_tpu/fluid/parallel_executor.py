@@ -278,30 +278,23 @@ class _SpmdCompiledBlock(_CompiledBlock):
         return NamedSharding(
             self.mesh, scanned_spec(self._feed_shardings[name].spec))
 
-    def _get_multi_jit(self, feeds, scanned):
+    def _wrap_multi_jit(self, feeds, scanned, donate):
         """The shared K-steps-per-dispatch scan, jitted with this
-        block's GSPMD shardings and RW-state donation.  One executable
-        per (feeds, scanned) name structure — the ragged-tail masked
-        lot and the full lot key different structures, each compiled
-        once."""
+        block's GSPMD shardings and the base class's donation plan
+        (RW state + the scanned feed block on device).  The base
+        class's per-(feeds, scanned)-structure cache keys it — the
+        ragged-tail masked lot and the full lot key different
+        structures, each compiled once."""
         import jax
-        key = (tuple(sorted(feeds)), tuple(sorted(scanned)))
-        cache = getattr(self, '_multi_jits', None)
-        if cache is None:
-            cache = self._multi_jits = {}
-        jitted = cache.get(key)
-        if jitted is None:
-            rw_sh = {n: self._state_shardings[n] for n in self.state_rw}
-            ro_sh = {n: self._state_shardings[n] for n in self.state_ro}
-            feed_sh = {n: self._feed_shardings[n] for n in feeds}
-            scanned_sh = {n: self.scanned_sharding(n) for n in scanned}
-            jitted = jax.jit(
-                self._make_multi(), static_argnums=(5, ),
-                in_shardings=(rw_sh, ro_sh, feed_sh, scanned_sh, None),
-                out_shardings=(self._out_state_shardings, None),
-                donate_argnums=(0, ) if self.state_rw else ())
-            cache[key] = jitted
-        return jitted
+        rw_sh = {n: self._state_shardings[n] for n in self.state_rw}
+        ro_sh = {n: self._state_shardings[n] for n in self.state_ro}
+        feed_sh = {n: self._feed_shardings[n] for n in feeds}
+        scanned_sh = {n: self.scanned_sharding(n) for n in scanned}
+        return jax.jit(
+            self._make_multi(), static_argnums=(5, ),
+            in_shardings=(rw_sh, ro_sh, feed_sh, scanned_sh, None),
+            out_shardings=(self._out_state_shardings, None),
+            donate_argnums=donate)
 
     def _device_platform(self):
         return self.mesh.devices.flat[0].platform
@@ -476,7 +469,7 @@ class ParallelExecutor(object):
                                      compiled=compiled)
 
     def run_multi(self, fetch_list, feed=None, steps=1, feed_list=None,
-                  return_numpy=True):
+                  return_numpy=True, reader=None):
         """Run ``steps`` iterations as ONE GSPMD-sharded device dispatch
         (the SPMD counterpart of Executor.run_multi; the reference
         amortizes per-iteration overhead with its double-buffered
@@ -486,11 +479,25 @@ class ParallelExecutor(object):
 
         feed: one lot reused every iteration (fori_loop), OR
         feed_list: per-iteration lots scanned on device (``steps`` is
-        then len(feed_list)).  Ragged lots — including a ragged FINAL
-        lot in feed_list — are padded to the dp extent with masked
-        samples; loss/grad means weight by the real sample count."""
+        then len(feed_list)), OR
+        reader: the program's py_reader — ``steps`` DISTINCT fresh
+        minibatches drain from its queue and ride the feed_list path
+        (so ragged reader lots pad to the dp extent with masked
+        samples exactly like explicit ones).  Ragged lots — including
+        a ragged FINAL lot in feed_list — are padded to the dp extent
+        with masked samples; loss/grad means weight by the real sample
+        count."""
         import jax
-        _reject_reader_fed(self._main_program, 'ParallelExecutor.run_multi')
+        if reader is not None:
+            if feed is not None or feed_list is not None:
+                raise ValueError(
+                    'run_multi: pass reader= OR feed/feed_list')
+            from .dataflow import drain_reader_feed_list
+            feed_list = drain_reader_feed_list(self._main_program, reader,
+                                               steps)
+        else:
+            _reject_reader_fed(self._main_program,
+                               'ParallelExecutor.run_multi')
         fetch_names = self._fetch_names(fetch_list)
         scanned = None
         if feed_list is not None:
@@ -537,6 +544,26 @@ class ParallelExecutor(object):
         # fetches come from the LAST iteration: trim to its real rows
         return self._convert_fetches(fetches, return_numpy, real, n_padded,
                                      compiled=compiled)
+
+    def _dispatch_multi_scanned(self, fetch_list, sig_feed, scanned,
+                                steps, batch_feed_names=None):
+        """Async front half of a scanned SPMD run_multi dispatch (the
+        FeedPipeline's dp>1 path): resolve the sharded executable keyed
+        on ``sig_feed``, dispatch ONE pre-staged dp-sharded scanned
+        block, and return the raw device fetches with NO host sync —
+        the SPMD mirror of Executor._dispatch_multi_scanned.
+        batch_feed_names: the padding pass's pre-pad provenance (which
+        feeds are batch-led), recorded into the compile exactly like
+        run_multi's feed_list path."""
+        fetch_names = self._fetch_names(fetch_list)
+        compiled = self._resolve(fetch_names, sig_feed, batch_feed_names)
+        fetches = compiled.run_multi(self._scope, {}, self._next_rng(),
+                                     int(steps), scanned_feeds=scanned)
+        if compiled.note_multi_compile(steps, scanned):
+            self.compile_count += 1
+        self.dispatch_count += 1
+        self.steps_dispatched += int(steps)
+        return fetches, compiled
 
     def _dispatch_eval_multi(self, fetch_list, feed=None, steps=None,
                              feed_list=None):

@@ -10,6 +10,7 @@ Perfetto.
 
 import contextlib
 import os
+import threading
 import time
 from collections import defaultdict
 
@@ -26,6 +27,11 @@ _profiler_state = {
     'jax_trace_active': False,
     'start_time': None,
 }
+# record_event is called from background threads too (the serving
+# engine's worker, the FeedPipeline's staging thread): the lock keeps
+# appends atomic against a concurrent stop_profiler/reset_profiler
+# swapping or iterating the event tables mid-profile
+_record_lock = threading.Lock()
 
 # subsystem metrics riding the sidecar: {source name: zero-arg snapshot
 # fn}.  The serving engine registers here so a profiled serving window
@@ -78,10 +84,11 @@ def is_profiler_enabled():
 
 def record_event(name, seconds, start=None):
     if _profiler_state['enabled']:
-        _profiler_state['events'][name].append(seconds)
-        _profiler_state['timeline'].append(
-            (name, (time.time() - seconds) if start is None else start,
-             seconds))
+        with _record_lock:
+            _profiler_state['events'][name].append(seconds)
+            _profiler_state['timeline'].append(
+                (name, (time.time() - seconds) if start is None else start,
+                 seconds))
 
 
 @contextlib.contextmanager
@@ -97,9 +104,10 @@ def record_block(name):
 
 
 def reset_profiler():
-    _profiler_state['events'] = defaultdict(list)
-    _profiler_state['timeline'] = []
-    _final_metrics.clear()
+    with _record_lock:
+        _profiler_state['events'] = defaultdict(list)
+        _profiler_state['timeline'] = []
+        _final_metrics.clear()
 
 
 def start_profiler(state='All'):
@@ -126,7 +134,11 @@ def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
         import jax
         jax.profiler.stop_trace()
         _profiler_state['jax_trace_active'] = False
-    events = _profiler_state['events']
+    with _record_lock:
+        # snapshot against a record_event already past the enabled check
+        # on another thread (serving worker / pipeline stager)
+        events = {n: list(d) for n, d in _profiler_state['events'].items()}
+        _profiler_state['timeline'] = list(_profiler_state['timeline'])
     lines = ['%-40s %8s %12s %12s %12s' %
              ('Event', 'Calls', 'Total(s)', 'Min(s)', 'Max(s)')]
     rows = []

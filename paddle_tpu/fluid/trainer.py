@@ -133,7 +133,27 @@ class Trainer(object):
     def stop(self):
         self.__stop = True
 
-    def train(self, num_epochs, event_handler, reader=None, feed_order=None):
+    def train(self, num_epochs, event_handler, reader=None, feed_order=None,
+              steps_per_dispatch=1, pipeline_depth=2):
+        """Run the event loop.  With ``steps_per_dispatch > 1`` the loop
+        rides the overlapped input pipeline (fluid.dataflow.FeedPipeline):
+        K reader batches train as ONE multi-step device dispatch while
+        the NEXT block stages on a background thread — the reference's
+        py_reader + double_buffer overlap, at scan-block granularity.
+        Step events then fire per DISPATCH and are POST-HOC delivery
+        callbacks: by the time BeginStepEvent/EndStepEvent fire, that
+        dispatch has already executed (and the next may be in flight),
+        so a handler cannot steer the step it names —
+        ``fetch_metrics`` is ignored (metrics are the block's LAST
+        step, always fetched; toggling would recompile the scanned
+        executable) and ``stop()`` takes effect up to
+        ``pipeline_depth`` dispatches late.  Handlers that must run
+        BEFORE each step (per-step LR schedules written to the scope)
+        need the plain ``steps_per_dispatch=1`` loop."""
+        if int(steps_per_dispatch) > 1:
+            return self._train_pipelined(
+                num_epochs, event_handler, reader, feed_order,
+                int(steps_per_dispatch), int(pipeline_depth))
         with scope_guard(self.scope):
             feeder = DataFeeder(
                 feed_list=feed_order, place=self.place,
@@ -154,6 +174,38 @@ class Trainer(object):
                     if self.checkpoint_cfg is not None:
                         self._save_checkpoint(epoch_id, step_id)
                     event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                event_handler(EndEpochEvent(epoch_id))
+
+    def _train_pipelined(self, num_epochs, event_handler, reader,
+                         feed_order, steps, pipeline_depth):
+        """The overlapped event loop: feeder-prepared batches flow
+        through a FeedPipeline per epoch; each iteration is one K-step
+        dispatch whose staging overlapped the previous dispatch's
+        compute."""
+        from .dataflow import FeedPipeline
+        with scope_guard(self.scope):
+            feeder = DataFeeder(
+                feed_list=feed_order, place=self.place,
+                program=self.train_program)
+            for epoch_id in range(num_epochs):
+                event_handler(BeginEpochEvent(epoch_id))
+                pipe = FeedPipeline(
+                    self.exe, fetch_list=self.train_func_outputs,
+                    program=self.train_program,
+                    source=(feeder.feed(data) for data in reader()),
+                    steps=steps, pipeline_depth=pipeline_depth,
+                    scope=self.scope)
+                try:
+                    for step_id, metrics in enumerate(pipe):
+                        if self.__stop:
+                            return
+                        event_handler(BeginStepEvent(epoch_id, step_id))
+                        if self.checkpoint_cfg is not None:
+                            self._save_checkpoint(epoch_id, step_id)
+                        event_handler(
+                            EndStepEvent(epoch_id, step_id, metrics))
+                finally:
+                    pipe.close()
                 event_handler(EndEpochEvent(epoch_id))
 
     def test(self, reader, feed_order):

@@ -96,6 +96,25 @@ def test_single_config_child_runs_cpu():
     assert rec['device_true'] is True
     assert rec['steps_per_dispatch'] > 1
     assert rec['tokens_per_sec_dispatch_bound'] > 0
+    # ISSUE 3: the paired overlapped-input measurement rides along
+    _assert_feed_overlap(rec)
+
+
+FEED_OVERLAP_KEYS = {'steps_per_dispatch', 'pipeline_depth', 'dispatches',
+                     'ms_per_step_overlapped', 'feed_stall_ms_per_dispatch',
+                     'overlap_ratio'}
+
+
+def _assert_feed_overlap(rec):
+    """Every device-true TRAIN record carries the ISSUE 3 feed_overlap
+    block: fresh batches staged through the FeedPipeline, with the
+    stall/overlap counters that evidence staging N+1 overlapped
+    compute N."""
+    fo = rec['feed_overlap']
+    assert FEED_OVERLAP_KEYS <= set(fo), fo
+    assert fo['dispatches'] >= 1
+    assert fo['pipeline_depth'] >= 2
+    assert 0.0 <= fo['overlap_ratio'] <= 1.0
 
 
 def test_flagship_configs_wired_through_run_multi():
@@ -115,6 +134,15 @@ def test_flagship_configs_wired_through_run_multi():
         assert '_run(' in src, fn.__name__
         assert "'device_true': True" in src, fn.__name__
         assert "'steps_per_dispatch': steps" in src, fn.__name__
+    # every device-true TRAIN config pairs its number with the
+    # overlapped-input measurement (ISSUE 3): a FeedPipeline block over
+    # FRESH per-step batches reporting feed_overlap fields
+    assert 'FeedPipeline' in inspect.getsource(bench._feed_overlap_block)
+    for fn in (bench.bench_resnet, bench.bench_nmt, bench.bench_transformer,
+               bench.bench_stacked_lstm):
+        src = inspect.getsource(fn)
+        assert "'feed_overlap': feed_overlap" in src, fn.__name__
+        assert 'batch_fn' in src, fn.__name__
     # the inference config is device-true through the eval scan
     src = inspect.getsource(bench.bench_resnet_infer_bf16)
     assert 'run_eval_multi' in src
@@ -124,9 +152,12 @@ def test_flagship_configs_wired_through_run_multi():
 
 def test_nmt_cpu_smoke_is_device_true():
     """The cheapest flagship config end-to-end in-process (tiny CPU
-    dims): the record must carry the multi-step dispatch contract."""
+    dims): the record must carry the multi-step dispatch contract AND
+    the functional feed_overlap block (the pipeline really ran)."""
     import bench
     rec = bench.bench_nmt(False)
     assert rec['value'] > 0
     assert rec['device_true'] is True
     assert rec['steps_per_dispatch'] == 2  # the CPU smoke step count
+    _assert_feed_overlap(rec)
+    assert rec['feed_overlap']['ms_per_step_overlapped'] > 0

@@ -138,3 +138,28 @@ def test_cpu_deterministic_pins_rng_stream():
     a = run_fresh(warmup=False)
     b = run_fresh(warmup=True)
     np.testing.assert_array_equal(a, b)
+
+
+def test_xla_compile_cache_dir_wires_jax_config(tmp_path):
+    """FLAGS_xla_compile_cache_dir points jax at a persistent on-disk
+    compilation cache (warm-start compiles across processes — bench.py
+    sets it per config child); clearing the flag detaches the cache."""
+    import jax
+    cache = str(tmp_path / 'xla_cache')
+    flags.FLAGS.xla_compile_cache_dir = cache
+    assert jax.config.jax_compilation_cache_dir == cache
+    assert os.path.isdir(cache)  # the setter creates it
+    # a compile lands entries in the cache dir (jax only persists for
+    # known-deterministic backends; tolerate an empty dir on exotic
+    # builds but the config wiring above must hold regardless)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        out = fluid.layers.fc(x, 3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        exe.run(prog, feed={'x': np.ones((2, 4), np.float32)},
+                fetch_list=[out])
+    flags.FLAGS.xla_compile_cache_dir = ''
+    assert jax.config.jax_compilation_cache_dir is None

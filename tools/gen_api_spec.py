@@ -63,6 +63,8 @@ def generate():
         'memory_optimize', 'release_memory', 'Go', 'Select', 'make_channel',
         'channel_send', 'channel_recv', 'channel_close',
     ])
+    lines += _walk('paddle_tpu.fluid.dataflow', fluid.dataflow,
+                   sorted(fluid.dataflow.__all__))
     lines += _walk('paddle_tpu.fluid.io', fluid.io, sorted(
         n for n in fluid.io.__all__ if not n.startswith('_')))
     lines += _walk('paddle_tpu.fluid.metrics', fluid.metrics, [

@@ -9,11 +9,15 @@ throughput vs hand-rolled JAX — gated on the MAX of PER-BLOCK ratios
 max(fw)/max(bd) cross-window pairing).
 
 Run on TPU hardware:
-    python tools/perf_gate.py [resnet|transformer|nmt|resnet_infer|all]
+    python tools/perf_gate.py \
+        [resnet|transformer|nmt|resnet_infer|feed_pipeline|all]
 Prints one JSON line per config; tests/test_perf_gate.py drives it and
 skips cleanly off-TPU.  ``resnet_infer`` (ISSUE 2) has no bound side —
 its deliverable is the paired ``multi_vs_dispatch`` block: the measured
 dispatch tax Executor.run_eval_multi removes from the serving path.
+``feed_pipeline`` (ISSUE 3) likewise pairs overlapped-vs-blocked input
+staging: the throughput fluid.FeedPipeline recovers by staging scan
+block N+1 while dispatch N computes (feed_stall ~ 0 after warmup).
 """
 
 import json
@@ -247,15 +251,117 @@ def build_resnet_infer():
     return timed_block, timed_block_multi, None
 
 
+def build_feed_pipeline():
+    """Overlapped vs blocked input staging at the ResNet operating point
+    (ISSUE 3): FRESH host batches every step, so feed preparation (host
+    generate + stack + device_put through the tunnel) is real work.  The
+    BLOCKED side stages each K-batch scan block synchronously on the
+    dispatch path (run_multi(feed_list=...)); the OVERLAPPED side rides
+    fluid.FeedPipeline — staging on a background thread, pipeline_depth
+    2, donated scanned blocks — so block N+1 stages while N computes.
+    No pure-JAX bound side (the train gates own that invariant); the
+    deliverable is the paired ``overlapped_vs_blocked`` block plus the
+    post-warmup feed_stall (~0 when staging fully hides)."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import resnet
+
+    k = int(os.environ.get('PERF_GATE_FEED_STEPS', '4'))
+    dispatches = int(os.environ.get('PERF_GATE_FEED_DISPATCHES', '2'))
+    model = resnet.build(depth=50, class_dim=1000,
+                         image_shape=(3, 224, 224), lr=0.1)
+    exe = fluid.Executor(fluid.TPUPlace())
+    scope = fluid.core.Scope()
+    rng = np.random.RandomState(0)
+
+    def batch():
+        return {'img': rng.standard_normal(
+                    (RESNET_BATCH, 3, 224, 224)).astype('float32'),
+                'label': rng.randint(
+                    0, 1000, size=(RESNET_BATCH, 1)).astype('int64')}
+
+    with fluid.scope_guard(scope), fluid.amp_guard(True):
+        exe.run(model['startup'])
+        # warm the k-step scanned executable (static jit arg + scanned
+        # feed structure both key compiles)
+        exe.run_multi(model['main'], feed_list=[batch() for _ in range(k)],
+                      fetch_list=[model['loss']])
+
+    def blocked():
+        with fluid.scope_guard(scope), fluid.amp_guard(True):
+            t0 = time.time()
+            for _ in range(dispatches):
+                loss_v, = exe.run_multi(
+                    model['main'], feed_list=[batch() for _ in range(k)],
+                    fetch_list=[model['loss']])
+            elapsed = time.time() - t0
+        assert np.isfinite(np.asarray(loss_v)).all()
+        return RESNET_BATCH * k * dispatches / elapsed
+
+    last_metrics = {}
+
+    def overlapped():
+        from paddle_tpu.fluid.dataflow import FeedPipeline
+        src = (batch() for _ in range((dispatches + 1) * k))
+        with fluid.scope_guard(scope), fluid.amp_guard(True):
+            pipe = FeedPipeline(exe, fetch_list=[model['loss']],
+                                program=model['main'], source=src,
+                                steps=k, pipeline_depth=2, scope=scope)
+            it = iter(pipe)
+            next(it)  # warmup dispatch: the first block can't overlap
+            t0 = time.time()
+            n = sum(1 for _ in it)
+            elapsed = time.time() - t0
+            last_metrics.clear()
+            last_metrics.update(pipe.metrics())
+        assert n == dispatches, n
+        return RESNET_BATCH * k * dispatches / elapsed
+
+    return blocked, overlapped, (k, dispatches, last_metrics)
+
+
+def run_feed_pipeline():
+    """The feed_pipeline record: interleaved blocked/overlapped windows
+    (same pairing rule as the hard gates — each ratio shares a drift
+    window), plus the last overlapped window's pipeline metrics."""
+    blocked, overlapped, (k, dispatches, metrics) = build_feed_pipeline()
+    bl, ov = [], []
+    for _ in range(BLOCKS):
+        bl.append(blocked())
+        ov.append(overlapped())
+    rec = {
+        'config': 'feed_pipeline',
+        'blocked_imgs_per_sec': round(max(bl), 1),
+        'overlapped_imgs_per_sec': round(max(ov), 1),
+        'blocked_blocks': [round(v, 1) for v in bl],
+        'overlapped_blocks': [round(v, 1) for v in ov],
+        # the PAIRED deliverable: how much throughput overlapped staging
+        # recovers from the blocked feed path, per shared window
+        'overlapped_vs_blocked': round(
+            max(o / b for o, b in zip(ov, bl)), 4),
+        # ~0 after warmup when staging fully hides behind compute (the
+        # ISSUE 3 acceptance signal)
+        'feed_stall_s': round(metrics.get('feed_stall_s', 0.0), 4),
+        'overlap_ratio': round(metrics.get('overlap_ratio', 0.0), 4),
+        'steps_per_dispatch': k, 'dispatches_per_block': dispatches,
+        'blocks': BLOCKS,
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 CONFIGS = {
     'resnet': (build_resnet, 'imgs_per_sec'),
     'transformer': (build_transformer, 'tokens_per_sec'),
     'nmt': (build_nmt, 'tokens_per_sec'),
     'resnet_infer': (build_resnet_infer, 'imgs_per_sec'),
+    'feed_pipeline': (build_feed_pipeline, 'imgs_per_sec'),
 }
 
 
 def run_config(name):
+    if name == 'feed_pipeline':
+        return run_feed_pipeline()
     build, unit = CONFIGS[name]
     # both sides compiled first, then INTERLEAVED blocks: a drift window
     # between two monolithic measurements would otherwise decide the

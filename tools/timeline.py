@@ -57,22 +57,28 @@ class Timeline(object):
         self._next_pid += 1
         return pid
 
+    # subsystem spans promoted out of the host row: serving-engine spans
+    # (queue waits, dispatch->deliver windows) and feed-pipeline spans
+    # (staging, feed stalls, dispatch->sync windows) each get their own
+    # process row so the micro-batch / input pipelines read at a glance
+    # next to executor slices
+    ROW_PREFIXES = (('serving/', 'serving'), ('pipeline/', 'pipeline'))
+
     def _emit_host(self, label, prof):
         pid = self._allocate_pid()
         self._chrome.emit_pid('%s:host' % label, pid)
-        serving_pid = None
+        row_pids = {}
         for ev in prof.get('host_events', []):
-            if ev['name'].startswith('serving/'):
-                # serving-engine spans (queue waits, dispatch->deliver
-                # windows) get their own process row so the micro-batch
-                # pipeline reads at a glance next to executor slices
-                if serving_pid is None:
-                    serving_pid = self._allocate_pid()
-                    self._chrome.emit_pid('%s:serving' % label,
-                                          serving_pid)
+            row = next((r for p, r in self.ROW_PREFIXES
+                        if ev['name'].startswith(p)), None)
+            if row is not None:
+                row_pid = row_pids.get(row)
+                if row_pid is None:
+                    row_pid = row_pids[row] = self._allocate_pid()
+                    self._chrome.emit_pid('%s:%s' % (label, row), row_pid)
                 self._chrome.emit_region(
-                    ev['start_s'] * 1e6, ev['dur_s'] * 1e6, serving_pid,
-                    0, 'serving', ev['name'])
+                    ev['start_s'] * 1e6, ev['dur_s'] * 1e6, row_pid,
+                    0, row, ev['name'])
                 continue
             self._chrome.emit_region(
                 ev['start_s'] * 1e6, ev['dur_s'] * 1e6, pid, 0, 'host',
