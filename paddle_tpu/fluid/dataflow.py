@@ -49,6 +49,20 @@ from .framework import default_main_program, Variable
 
 __all__ = ['FeedPipeline', 'drain_reader_feed_list']
 
+
+def check_reader_args(what, feed, feed_list, steps=None,
+                      require_steps=False):
+    """Shared reader-mode argument validation for the four reader-fed
+    multi paths (Executor/ParallelExecutor × run_multi/run_eval_multi):
+    reader= is exclusive with feed=/feed_list=, and the EVAL paths
+    (require_steps) have no default step count — a drain-contract
+    change must not leave the four sites validating differently."""
+    if feed is not None or feed_list is not None:
+        raise ValueError('%s: pass reader= OR feed/feed_list' % what)
+    if require_steps and (steps is None or int(steps) < 1):
+        raise ValueError('%s: reader= needs steps >= 1, got %r'
+                         % (what, steps))
+
 _PIPELINE_SEQ = [0]
 _PIPELINE_SEQ_LOCK = threading.Lock()
 
@@ -230,9 +244,10 @@ class FeedPipeline(object):
         import weakref
         ref = weakref.ref(self)
         self._metrics_fn = lambda: (ref().metrics() if ref() else None)
-        _profiler.register_metrics_source(self.name, self._metrics_fn)
+        self._metrics_key = _profiler.register_metrics_source(
+            self.name, self._metrics_fn)
         weakref.finalize(self, _profiler.unregister_metrics_source,
-                         self.name, self._metrics_fn)
+                         self._metrics_key, self._metrics_fn)
 
     # ---- sources -------------------------------------------------------
 
@@ -497,7 +512,8 @@ class FeedPipeline(object):
         # keeps the pipeline object (e.g. to read metrics())
         self._drain_staged()
         self._inflight = []
-        _profiler.unregister_metrics_source(self.name, self._metrics_fn)
+        _profiler.unregister_metrics_source(self._metrics_key,
+                                            self._metrics_fn)
 
     def __enter__(self):
         return self.start()

@@ -20,6 +20,8 @@ API parity: Executor(place), run(program, feed, fetch_list, ...) matching
 python/paddle/fluid/executor.py:256.
 """
 
+import threading
+
 import numpy as np
 
 from . import core
@@ -316,15 +318,16 @@ def _reject_reader_fed(program, what):
     it drains K DISTINCT batches per dispatch (fluid.dataflow)."""
     prog = program if program is not None else default_main_program()
     if any(op.type == 'read' for op in prog.global_block().ops):
-        # run_eval_multi has no reader= mode (ROADMAP follow-up): its
-        # message must not send users to the TRAIN multi path
-        hint = ('pass the reader (run_multi(reader=..., steps=K) '
-                'drains K fresh batches per dispatch), feed the '
-                'batches explicitly,' if 'eval' not in what else
-                'feed the batches explicitly (feed= or feed_list=)')
+        # each multi path names ITS OWN reader= mode (train and eval
+        # drains are symmetric since ISSUE 4's run_eval_multi reader=)
+        composing = ('run_eval_multi(reader=..., steps=K)'
+                     if 'eval' in what else
+                     'run_multi(reader=..., steps=K)')
         raise RuntimeError(
             '%s does not compose with py_reader-fed programs through '
-            'feed=/feed_list= — %s or use run() per step' % (what, hint))
+            'feed=/feed_list= — pass the reader (%s drains K fresh '
+            'batches per dispatch), feed the batches explicitly, or '
+            'use run() per step' % (what, composing))
     return prog
 
 
@@ -867,6 +870,13 @@ class Executor(object):
         # cost (vs the reference's LoD no-padding design) — each cache
         # miss below is one XLA compile; tests pin bounds on this
         self.compile_count = 0
+        # the compile cache and RNG stream are shared mutable state: the
+        # reference predictor's thread contract
+        # (paddle_inference_api.h:90 — Clone() + concurrent Run()) means
+        # N threads may resolve through ONE executor concurrently, and
+        # an unguarded OrderedDict get/move_to_end/popitem interleaving
+        # corrupts the LRU (or drops a live entry mid-resolve)
+        self._cache_lock = threading.RLock()
 
     def _next_rng(self, program):
         # Keys are built HOST-side as raw uint32[2] threefry keys — a
@@ -882,21 +892,26 @@ class Executor(object):
             # with their program — no unbounded growth, no recycled-id
             # aliasing
             import weakref
-            if not hasattr(self, '_det_steps'):
-                self._det_steps = {}
-            key = weakref.ref(program,
-                              lambda r: self._det_steps.pop(r, None))
-            step = self._det_steps.get(key, 0)
-            self._det_steps[key] = step + 1
+            with self._cache_lock:
+                if not hasattr(self, '_det_steps'):
+                    self._det_steps = {}
+                key = weakref.ref(program,
+                                  lambda r: self._det_steps.pop(r, None))
+                step = self._det_steps.get(key, 0)
+                self._det_steps[key] = step + 1
             return np.array([(program.random_seed or 0) & 0xffffffff, step],
                             np.uint32)
-        if self._rng is None:
-            # mask to the key word width: PRNGKey accepted 64-bit and
-            # negative seeds, so keep accepting them
-            self._rng_seed = int(program.random_seed or 0) & 0xffffffff
-            self._rng = 0
-        self._rng += 1
-        return np.array([self._rng_seed, self._rng], np.uint32)
+        with self._cache_lock:
+            # concurrent predictors (Clone + threaded Run) share this
+            # stream: the counter bump must be atomic or two threads
+            # can mint one key twice
+            if self._rng is None:
+                # mask to the key word width: PRNGKey accepted 64-bit
+                # and negative seeds, so keep accepting them
+                self._rng_seed = int(program.random_seed or 0) & 0xffffffff
+                self._rng = 0
+            self._rng += 1
+            return np.array([self._rng_seed, self._rng], np.uint32)
 
     def as_lodtensor(self, data):
         return core.LoDTensor(np.asarray(data))
@@ -909,13 +924,23 @@ class Executor(object):
         if getattr(obj, attr, None) is not None:
             return
         cache_ref = weakref.ref(self._cache)
+        self_ref = weakref.ref(self)
         oid = id(obj)
 
-        def _purge(cache_ref=cache_ref, oid=oid):
+        def _purge(cache_ref=cache_ref, self_ref=self_ref, oid=oid):
             cache = cache_ref()
             if cache is not None:
-                for k in [k for k in cache if oid in (k[0], k[5])]:
-                    del cache[k]
+                # GC can fire this on any thread: exclude a concurrent
+                # _resolve_and_compile mid-LRU-update (the executor —
+                # and with it the lock — outlives its cache entries)
+                owner = self_ref()
+                lock = owner._cache_lock if owner is not None else None
+                import contextlib
+                with lock if lock is not None \
+                        else contextlib.nullcontext():
+                    for k in [k for k in list(cache)
+                              if oid in (k[0], k[5])]:
+                        cache.pop(k, None)
 
         try:
             setattr(obj, attr, weakref.finalize(obj, _purge))
@@ -957,16 +982,18 @@ class Executor(object):
         # whose id recurs while sibling entries survive)
         self._pin_cache_lifetime(program)
         self._pin_cache_lifetime(scope)
-        compiled = self._cache.get(key)
-        if compiled is None:
-            self.compile_count += 1
-            compiled = _CompiledBlock(program, 0, [n for n, _, _ in sig],
-                                      fetch_names, self.place, scope)
-            self._cache[key] = compiled
-            if len(self._cache) > self._CACHE_MAX:
-                self._cache.popitem(last=False)
-        else:
-            self._cache.move_to_end(key)
+        with self._cache_lock:
+            compiled = self._cache.get(key)
+            if compiled is None:
+                self.compile_count += 1
+                compiled = _CompiledBlock(program, 0,
+                                          [n for n, _, _ in sig],
+                                          fetch_names, self.place, scope)
+                self._cache[key] = compiled
+                if len(self._cache) > self._CACHE_MAX:
+                    self._cache.popitem(last=False)
+            else:
+                self._cache.move_to_end(key)
         return program, scope, feed_arrays, compiled
 
     def memory_analysis(self, program=None, feed=None, fetch_list=None,
@@ -1076,10 +1103,8 @@ class Executor(object):
         exhausted reader raises core.EOFException exactly like run().
         Overlapped staging across dispatches is fluid.FeedPipeline."""
         if reader is not None:
-            if feed is not None or feed_list is not None:
-                raise ValueError(
-                    'run_multi: pass reader= OR feed/feed_list')
-            from .dataflow import drain_reader_feed_list
+            from .dataflow import check_reader_args, drain_reader_feed_list
+            check_reader_args('run_multi', feed, feed_list)
             program = program if program is not None else \
                 default_main_program()
             feed_list = drain_reader_feed_list(program, reader, steps,
@@ -1154,15 +1179,29 @@ class Executor(object):
                              fetch_list=None,
                              steps=None,
                              scope=None,
-                             feed_list=None):
+                             feed_list=None,
+                             reader=None):
         """Async front half of run_eval_multi: resolve + compile, pad
         ragged lots to one shape bucket, dispatch ONE scanned eval, and
         return ``(stacked_fetches, reals, target, compiled, k)`` with NO
         host sync — the serving engine drives this directly so the host
         can feed dispatch N+1 (and trim/deliver N-1) while N still
         computes on device.  ``reals`` is the per-step real row count
-        (None when nothing was padded), ``target`` the padded rows."""
-        program = _reject_reader_fed(program, 'run_eval_multi')
+        (None when nothing was padded), ``target`` the padded rows.
+        ``reader=`` drains up to ``steps`` DISTINCT eval minibatches
+        from the program's py_reader queue onto the feed_list path (the
+        eval twin of run_multi's reader mode, same drain contract:
+        bucket-boundary split pushes the ragged tail back, EOF raises)."""
+        if reader is not None:
+            from .dataflow import check_reader_args, drain_reader_feed_list
+            check_reader_args('run_eval_multi', feed, feed_list, steps,
+                              require_steps=True)
+            program = program if program is not None else \
+                default_main_program()
+            feed_list = drain_reader_feed_list(program, reader, steps,
+                                               self.place)
+        else:
+            program = _reject_reader_fed(program, 'run_eval_multi')
         reals, target, batch_feed_names, per_step = None, None, None, None
         if feed_list is not None:
             if feed is not None:
@@ -1182,8 +1221,11 @@ class Executor(object):
         elif steps is None:
             raise ValueError('run_eval_multi: pass steps= with feed=')
         steps = int(steps)
+        # pop_readers=False: the reader path already drained its batches
+        # above (popping again here would silently eat a minibatch), and
+        # every other path rejects reader-fed programs outright
         program, scope, feed_arrays, compiled = self._resolve_and_compile(
-            program, feed, fetch_list, scope)
+            program, feed, fetch_list, scope, pop_readers=False)
         if batch_feed_names is not None and \
                 getattr(compiled, '_batch_feed_names', None) is None:
             # deterministic in the feed signature (which keys the cache
@@ -1214,7 +1256,8 @@ class Executor(object):
                        steps=None,
                        scope=None,
                        return_numpy=True,
-                       feed_list=None):
+                       feed_list=None,
+                       reader=None):
         """Run ``steps`` EVAL iterations of the program as ONE device
         dispatch and return EVERY iteration's fetches — the inference
         analog of run_multi (which surfaces only the last step), closing
@@ -1226,14 +1269,20 @@ class Executor(object):
         feed: one batch evaluated ``steps`` times (the bench's
         device-true timing form), OR feed_list: per-iteration lots
         scanned on device (the serving engine's form; ``steps`` is then
-        len(feed_list)).  Ragged lots are padded to one shape bucket
-        with masked replicated rows and trimmed on the way out."""
+        len(feed_list)), OR reader: the program's py_reader — up to
+        ``steps`` DISTINCT fresh eval minibatches drain from its queue
+        and scan as one dispatch (the eval sweep's symmetric mode to
+        run_multi's reader=; a stream ending mid-block evaluates the
+        shorter tail, a shape-bucket boundary splits the block with the
+        tail pushed back, an exhausted reader raises core.EOFException
+        exactly like run()).  Ragged lots are padded to one shape
+        bucket with masked replicated rows and trimmed on the way out."""
         from . import profiler as _profiler
 
         def go():
             stacked, reals, target, compiled, k = self._dispatch_eval_multi(
                 program, feed=feed, fetch_list=fetch_list, steps=steps,
-                scope=scope, feed_list=feed_list)
+                scope=scope, feed_list=feed_list, reader=reader)
             return convert_eval_fetches(stacked, reals, target, compiled,
                                         k, return_numpy)
 

@@ -247,7 +247,14 @@ class _SpmdCompiledBlock(_CompiledBlock):
         their GSPMD shardings (device arrays from a double-buffer
         prefetch reshard device-side).  The base class's run()/
         run_multi() call this polymorphically, so both the single-step
-        and the K-steps-per-dispatch paths are shared with Executor."""
+        and the K-steps-per-dispatch paths are shared with Executor.
+        ``cache_ro`` mirrors the base class's host-state caching (the
+        r5 lesson, now for dp serving): READ-ONLY state staged from a
+        host array is written back to the scope as its SHARDED device
+        array, so every later dispatch reshards in place instead of
+        re-uploading all params through the tunnel — and the engine's
+        ``device_footprint()`` sees the buffers the mesh really pins.
+        RW state is never cached (its staged buffer is donated)."""
         import jax
 
         def to_value(val, desc):
@@ -259,8 +266,17 @@ class _SpmdCompiledBlock(_CompiledBlock):
         state_ro = self._state_from_scope(scope, self.state_ro, to_value)
         for name in list(state_rw) + list(state_ro):
             tgt = state_rw if name in state_rw else state_ro
-            tgt[name] = jax.device_put(tgt[name],
-                                       self._state_shardings[name])
+            staged = jax.device_put(tgt[name],
+                                    self._state_shardings[name])
+            tgt[name] = staged
+            if cache_ro and name in state_ro:
+                var = scope.find_var(name)
+                raw = var.value()
+                if not isinstance(raw, jax.Array):
+                    lod = raw.lod() if isinstance(raw, core.LoDTensor) \
+                        else None
+                    if not lod:
+                        var.set_value(staged)
         feeds = {}
         for n, v in feed_values.items():
             if isinstance(v, core.LoDTensor):
@@ -489,10 +505,8 @@ class ParallelExecutor(object):
         count."""
         import jax
         if reader is not None:
-            if feed is not None or feed_list is not None:
-                raise ValueError(
-                    'run_multi: pass reader= OR feed/feed_list')
-            from .dataflow import drain_reader_feed_list
+            from .dataflow import check_reader_args, drain_reader_feed_list
+            check_reader_args('run_multi', feed, feed_list)
             feed_list = drain_reader_feed_list(self._main_program, reader,
                                                steps)
         else:
@@ -566,15 +580,25 @@ class ParallelExecutor(object):
         return fetches, compiled
 
     def _dispatch_eval_multi(self, fetch_list, feed=None, steps=None,
-                             feed_list=None):
+                             feed_list=None, reader=None):
         """Async front half of the SPMD run_eval_multi (the serving
         engine's dp>1 path): GSPMD-sharded K-eval-lots-per-dispatch
         scan, returning ``(stacked_fetches, reals, target, compiled,
         k)`` with NO host sync.  Ragged lots pad to the dp extent with
-        masked samples exactly as run_multi's do."""
+        masked samples exactly as run_multi's do.  ``reader=`` drains up
+        to ``steps`` DISTINCT eval minibatches from the program's
+        py_reader onto the feed_list path (so reader lots ride the same
+        ragged dp-padding), mirroring Executor._dispatch_eval_multi."""
         import jax
-        _reject_reader_fed(self._main_program,
-                           'ParallelExecutor.run_eval_multi')
+        if reader is not None:
+            from .dataflow import check_reader_args, drain_reader_feed_list
+            check_reader_args('run_eval_multi', feed, feed_list, steps,
+                              require_steps=True)
+            feed_list = drain_reader_feed_list(self._main_program, reader,
+                                               steps)
+        else:
+            _reject_reader_fed(self._main_program,
+                               'ParallelExecutor.run_eval_multi')
         fetch_names = self._fetch_names(fetch_list)
         scanned = None
         if feed_list is not None:
@@ -619,15 +643,21 @@ class ParallelExecutor(object):
         return stacked, reals, target, compiled, steps
 
     def run_eval_multi(self, fetch_list, feed=None, steps=None,
-                       feed_list=None, return_numpy=True):
+                       feed_list=None, return_numpy=True, reader=None):
         """Run ``steps`` EVAL iterations as ONE GSPMD-sharded device
         dispatch and return EVERY iteration's fetches (the SPMD
         counterpart of Executor.run_eval_multi — dp>1 sharded serving).
         Same return convention: one [K, ...]-stacked entry per fetch,
-        batch-led fetches over unequal ragged lots as per-step lists."""
+        batch-led fetches over unequal ragged lots as per-step lists.
+        ``reader=``: up to ``steps`` DISTINCT fresh eval minibatches
+        drain from the program's py_reader per dispatch (the eval
+        sweep's symmetric mode; drain contract as Executor's — tail on
+        EOF mid-block, bucket-boundary push-back, EOFException when
+        already exhausted)."""
         from .executor import convert_eval_fetches
         stacked, reals, target, compiled, k = self._dispatch_eval_multi(
-            fetch_list, feed=feed, steps=steps, feed_list=feed_list)
+            fetch_list, feed=feed, steps=steps, feed_list=feed_list,
+            reader=reader)
         return convert_eval_fetches(stacked, reals, target, compiled, k,
                                     return_numpy)
 

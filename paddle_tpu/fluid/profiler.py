@@ -39,6 +39,11 @@ _record_lock = threading.Lock()
 # (tools/timeline.py renders the spans; the 'metrics' block carries the
 # counters).  Sources returning None (e.g. a dead weakref) are skipped.
 _metrics_sources = {}
+# registrations come from user threads, engine GC finalizers, and the
+# registry's loader concurrently: the uniquify scan + assign below is
+# check-then-act and must be atomic or two same-named sources can both
+# land on the bare key (the clobber the uniquify exists to prevent)
+_sources_lock = threading.Lock()
 # final snapshots of sources that unregistered MID-profile (the common
 # `with profiler: with engine: ...` nesting stops the engine before
 # stop_profiler collects) — without this the sidecar would lose them
@@ -46,7 +51,28 @@ _final_metrics = {}
 
 
 def register_metrics_source(name, fn):
-    _metrics_sources[name] = fn
+    """Register a snapshot source; returns the KEY it landed under.
+    A name already held by a DIFFERENT live source is uniquified
+    (``name#2``, ``name#3``, ...) instead of silently clobbered — two
+    same-named engines stopped inside one profiler window must both
+    keep their sidecar snapshot.  Callers unregister by the returned
+    key."""
+    with _sources_lock:
+        key = name
+        n = 1
+        while (key in _metrics_sources
+               and _metrics_sources[key] is not fn) \
+                or (_profiler_state['enabled'] and key in _final_metrics):
+            # _final_metrics holds snapshots of sources already STOPPED
+            # in the ACTIVE profile window: a successor reusing their
+            # name must not shadow them at collection time.  Outside a
+            # window the leftover finals are dead (the next
+            # start_profiler resets them) and must not push a fresh
+            # source onto a #2 key forever.
+            n += 1
+            key = '%s#%d' % (name, n)
+        _metrics_sources[key] = fn
+        return key
 
 
 def unregister_metrics_source(name, fn=None):
@@ -55,15 +81,27 @@ def unregister_metrics_source(name, fn=None):
     engines registering as 'prod'), the survivor stays registered.
     Inside an active profile the source's last snapshot is kept for the
     session's sidecar."""
-    if fn is None or _metrics_sources.get(name) is fn:
+    with _sources_lock:
+        if fn is not None and _metrics_sources.get(name) is not fn:
+            return
         src = _metrics_sources.pop(name, None)
-        if src is not None and _profiler_state['enabled']:
-            try:
-                snap = src()
-            except Exception:
-                snap = None
-            if snap is not None:
-                _final_metrics[name] = snap
+        take_final = src is not None and _profiler_state['enabled']
+    if take_final:
+        try:
+            snap = src()
+        except Exception:
+            snap = None
+        if snap is not None:
+            # never clobber an earlier source's final snapshot: two
+            # same-named engines stopped in one window keep BOTH rows
+            # (the second lands as name#2)
+            with _sources_lock:
+                key = name
+                n = 1
+                while key in _final_metrics:
+                    n += 1
+                    key = '%s#%d' % (name, n)
+                _final_metrics[key] = snap
 
 
 def _collect_metrics():

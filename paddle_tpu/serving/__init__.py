@@ -1,24 +1,34 @@
-"""paddle_tpu.serving — the TPU-native inference serving engine.
+"""paddle_tpu.serving — the TPU-native inference serving stack.
 
-Takes a loaded inference program (fluid.io.load_inference_model) and
-serves it request-facing: dynamic micro-batching (MicroBatcher),
-shape-bucketed compiles (ShapeBucketSet), pipelined multi-step eval
-dispatch (Executor.run_eval_multi / ParallelExecutor.run_eval_multi for
-dp>1 sharded serving), and engine metrics surfaced through
-fluid.profiler's timeline.  See engine.py for the design and the README
-'Serving engine' section for the knobs.
+Single-model: ``InferenceEngine`` serves a loaded inference program
+(fluid.io.load_inference_model) request-facing — dynamic micro-batching
+(MicroBatcher), shape-bucketed compiles (ShapeBucketSet), pipelined
+multi-step eval dispatch (Executor.run_eval_multi /
+ParallelExecutor.run_eval_multi for dp>1 sharded serving), and engine
+metrics surfaced through fluid.profiler's timeline.
 
-    engine = serving.InferenceEngine.from_saved_model('/path/to/model')
-    with engine:                         # starts the worker thread
-        fut = engine.submit({'img': x})  # coalesces with other callers
+Multi-model: ``ModelRegistry`` hosts N named engines over one shared
+device/mesh with cross-model HBM arbitration (``HBMArbiter``) —
+budgeted admission, LRU weight eviction to host memory with transparent
+reload, a fair request router, and per-model ``:serving/<model>``
+timeline rows.  See engine.py / registry.py for the designs and the
+README 'Serving engine' / 'Multi-model serving' sections for the knobs.
+
+    reg = serving.ModelRegistry(hbm_budget_bytes=2 << 30)
+    reg.load('ranker', '/models/ranker')
+    with reg:                                  # starts every worker
+        fut = reg.submit('ranker', {'img': x})
         logits, = fut.result()
-    print(engine.metrics())
+    print(reg.status())
 """
 
+from .arbiter import HBMArbiter, HBMBudgetError  # noqa: F401
 from .batcher import InferenceRequest, MicroBatcher  # noqa: F401
 from .buckets import ShapeBucketSet  # noqa: F401
 from .engine import InferenceEngine, ServingConfig  # noqa: F401
 from .metrics import EngineMetrics  # noqa: F401
+from .registry import ModelRegistry  # noqa: F401
 
 __all__ = ['InferenceEngine', 'ServingConfig', 'MicroBatcher',
-           'InferenceRequest', 'ShapeBucketSet', 'EngineMetrics']
+           'InferenceRequest', 'ShapeBucketSet', 'EngineMetrics',
+           'ModelRegistry', 'HBMArbiter', 'HBMBudgetError']

@@ -28,6 +28,7 @@ dispatches inline on the submitter's thread (fluid.Inferencer runs this
 mode).  ``start()`` spawns the worker loop for the queued mode.
 """
 
+import contextlib
 import threading
 import time
 import weakref
@@ -140,6 +141,16 @@ class InferenceEngine(object):
         self._inflight = deque()
         self._carry = deque()  # flushed lots awaiting a matching block
         self._inline_lock = threading.Lock()
+        # the pause gate: the worker holds it for exactly one
+        # collect->dispatch->drain cycle; paused() (the registry's
+        # eviction window) holds it for the whole pause, excluding new
+        # dispatches while weights move between device and host
+        self._cycle_lock = threading.RLock()
+        # cross-engine fair-dispatch turnstile: None = no gate (a lone
+        # engine); the ModelRegistry shares ONE lock across its engines
+        # so each device dispatch is a bounded critical section and no
+        # model's worker can hog the device between another's dispatches
+        self._gate = None
         self._thread = None
         self._closed = False
         self._warned_unsliced = False
@@ -147,20 +158,24 @@ class InferenceEngine(object):
             _ENGINE_SEQ[0] += 1
             seq = _ENGINE_SEQ[0]
         self.name = name or ('serving-engine-%d' % seq)
+        # timeline spans are KEYED by engine name (serving/<name>/...):
+        # two engines profiled in one window land in separate timeline
+        # rows instead of interleaving in one anonymous ':serving' row
+        self._spans = 'serving/%s/' % self.name
         # profiler sidecar: a weakly-bound metrics source, so profiled
         # runs dump the serving snapshot without keeping dead engines
         # alive (tools/timeline.py renders the spans; the sidecar's
-        # 'metrics' block carries the counters).  Unregistration is
-        # owner-checked against this fn: a second engine reusing the
-        # same name takes over the slot, and the first one's stop()/GC
-        # must not evict the survivor.
+        # 'metrics' block carries the counters).  The registry returns
+        # the KEY the source landed under — a second engine reusing the
+        # same name is uniquified (name#2), so neither snapshot is lost.
         ref = weakref.ref(self)
         self._metrics_fn = lambda: (ref().metrics() if ref() else None)
-        _profiler.register_metrics_source(self.name, self._metrics_fn)
+        self._metrics_key = _profiler.register_metrics_source(
+            self.name, self._metrics_fn)
         # an inline-mode engine may never be stop()ped: drop its
         # registration at GC so the source table can't grow unbounded
         weakref.finalize(self, _profiler.unregister_metrics_source,
-                         self.name, self._metrics_fn)
+                         self._metrics_key, self._metrics_fn)
 
     @classmethod
     def from_saved_model(cls, dirname, place=None, model_filename=None,
@@ -207,9 +222,96 @@ class InferenceEngine(object):
             self._thread = None
         else:
             self._drain_inline()
-        _profiler.unregister_metrics_source(self.name, self._metrics_fn)
+        _profiler.unregister_metrics_source(self._metrics_key,
+                                            self._metrics_fn)
 
     close = stop
+
+    @contextlib.contextmanager
+    def paused(self):
+        """Quiesce the engine: block inline submitters and the worker's
+        dispatch cycles, drain every in-flight dispatch, and hold the
+        engine idle for the duration of the with-block.  The HBM
+        arbiter's eviction window — weights can move device<->host with
+        no dispatch in flight.  submit() keeps queueing; queued requests
+        simply wait out the pause."""
+        with self._inline_lock:
+            with self._cycle_lock:
+                while self._inflight:
+                    self._drain_one()
+                yield self
+
+    # ---- footprint / eviction (the ModelRegistry's arbiter hooks) ------
+
+    def device_footprint(self):
+        """Live HBM bytes attributable to this engine's model: the sum
+        of device-resident (jax.Array) buffers held by its scope — the
+        params the executor's cache_back staging pinned on device.
+        (Executable HBM is XLA-internal; the arbiter carries it in the
+        seed estimate.)  Sharded arrays report their GLOBAL byte size."""
+        import jax
+        total = 0
+        for name in self._scope.local_var_names():
+            v = self._scope.find_var(name).value()
+            if isinstance(v, jax.Array):
+                total += int(v.nbytes)
+        return total
+
+    def drop_executables(self):
+        """Drop every compiled executable for THIS engine's program from
+        its executor(s): the compile-cache entries (and their jitted
+        multi/eval scans) die, releasing XLA's device-side executable
+        buffers.  Returns the number of cache entries dropped.  Only
+        this program's entries go — an executor shared with other
+        models keeps theirs."""
+        pid = id(self._program)
+        dropped = 0
+        for runner in (self._exe, self._pe):
+            cache = getattr(runner, '_cache', None)
+            if not cache:
+                continue
+            # the purge must exclude concurrent resolves: another model
+            # sharing this executor may be between its cache get() and
+            # move_to_end() on another thread (the lock Executor added
+            # for the concurrent-predictor contract; PE has none — its
+            # cache is per-PE and engines never share one)
+            lock = getattr(runner, '_cache_lock', None)
+            with lock if lock is not None else contextlib.nullcontext():
+                for k in [k for k in list(cache) if k[0] == pid]:
+                    cache.pop(k, None)
+                    dropped += 1
+        return dropped
+
+    def evict_to_host(self):
+        """Demote the model to host memory under a paused() window:
+        every device-resident scope buffer is copied back to a host
+        ndarray (bitwise — dtype and values preserved, so the
+        eviction->reload round trip is exact) and the program's
+        executables are dropped.  Returns (bytes_moved,
+        executables_dropped).  Reload is TRANSPARENT: the next dispatch
+        re-stages host arrays through the normal cache_back path and
+        recompiles on first use."""
+        import jax
+        with self.paused():
+            moved = 0
+            for name in self._scope.local_var_names():
+                var = self._scope.find_var(name)
+                v = var.value()
+                if isinstance(v, jax.Array):
+                    arr = np.asarray(v)
+                    var.set_value(arr)
+                    moved += int(arr.nbytes)
+            dropped = self.drop_executables()
+        return moved, dropped
+
+    @contextlib.contextmanager
+    def _gated(self):
+        gate = self._gate
+        if gate is None:
+            yield
+        else:
+            with gate:
+                yield
 
     def __enter__(self):
         return self.start()
@@ -294,7 +396,7 @@ class InferenceEngine(object):
         if _profiler.is_profiler_enabled():
             now = time.time()
             for r in requests:
-                _profiler.record_event('serving/queue_wait',
+                _profiler.record_event(self._spans + 'queue_wait',
                                        now - r.enqueue_t,
                                        start=r.enqueue_t)
         head = requests[0]
@@ -342,17 +444,18 @@ class InferenceEngine(object):
         runner = self._pe if self._pe is not None else self._exe
         before = runner.compile_count
         try:
-            if self._pe is not None:
-                stacked, reals, target, compiled, k = \
-                    self._pe._dispatch_eval_multi(
-                        self._fetch_list,
-                        feed_list=[l.feed for l in lots])
-            else:
-                stacked, reals, target, compiled, k = \
-                    self._exe._dispatch_eval_multi(
-                        self._program,
-                        feed_list=[l.feed for l in lots],
-                        fetch_list=self._fetch_list, scope=self._scope)
+            with self._gated():
+                if self._pe is not None:
+                    stacked, reals, target, compiled, k = \
+                        self._pe._dispatch_eval_multi(
+                            self._fetch_list,
+                            feed_list=[l.feed for l in lots])
+                else:
+                    stacked, reals, target, compiled, k = \
+                        self._exe._dispatch_eval_multi(
+                            self._program,
+                            feed_list=[l.feed for l in lots],
+                            fetch_list=self._fetch_list, scope=self._scope)
         except Exception as exc:
             self._metrics.note_error()
             for lot in lots:
@@ -372,10 +475,11 @@ class InferenceEngine(object):
             req = lot.requests[0]  # eager lots are single-request
             before = self._exe.compile_count
             try:
-                outs = self._exe.run(self._program, feed=lot.feed,
-                                     fetch_list=self._fetch_list,
-                                     scope=self._scope,
-                                     return_numpy=req.return_numpy)
+                with self._gated():
+                    outs = self._exe.run(self._program, feed=lot.feed,
+                                         fetch_list=self._fetch_list,
+                                         scope=self._scope,
+                                         return_numpy=req.return_numpy)
             except Exception as exc:
                 self._metrics.note_error()
                 req.set_error(exc)
@@ -386,7 +490,7 @@ class InferenceEngine(object):
             if req.latency_s is not None:
                 self._metrics.note_latency(req.latency_s)
             if _profiler.is_profiler_enabled():
-                _profiler.record_event('serving/dispatch[eager]',
+                _profiler.record_event(self._spans + 'dispatch[eager]',
                                        time.time() - t0, start=t0)
 
     def _drain_one(self):
@@ -440,7 +544,7 @@ class InferenceEngine(object):
                     self._metrics.note_latency(req.latency_s)
         if _profiler.is_profiler_enabled():
             _profiler.record_event(
-                'serving/dispatch[x%d]' % len(lots),
+                self._spans + 'dispatch[x%d]' % len(lots),
                 time.time() - t0, start=t0)
 
     # ---- worker -------------------------------------------------------
@@ -490,18 +594,25 @@ class InferenceEngine(object):
         poll = max(min(self.config.max_wait_s, 0.005), 0.001)
         while True:
             try:
-                if self._carry:
-                    self._dispatch(
-                        self._collect_block(self._carry.popleft()))
-                else:
+                reqs = []
+                if not self._carry:
                     # idle engine blocks on the queue's condition var
-                    # (submit/close notify); only an awaiting in-flight
-                    # dispatch warrants the short drain poll
+                    # (submit/close notify) OUTSIDE the cycle lock, so a
+                    # paused() window never has to wait for traffic;
+                    # only an awaiting in-flight dispatch warrants the
+                    # short drain poll
                     reqs = self._batcher.next_lot(
                         timeout=poll if self._inflight else None)
                     if reqs is None:
                         break  # closed and drained
-                    if reqs:
+                # one collect->dispatch->drain cycle is the pause unit:
+                # paused() holds the cycle lock while weights move, and
+                # the worker parks HERE between cycles
+                with self._cycle_lock:
+                    if self._carry and not reqs:
+                        self._dispatch(
+                            self._collect_block(self._carry.popleft()))
+                    elif reqs:
                         lot = self._safe_make_lot(reqs)
                         if lot is not None:
                             self._dispatch(self._collect_block(lot))
@@ -510,19 +621,21 @@ class InferenceEngine(object):
                         continue
                     else:
                         continue
-                # pipeline backpressure: keep at most pipeline_depth
-                # dispatches in flight — host feeds N+1 while N computes
-                while len(self._inflight) >= self.config.pipeline_depth:
-                    self._drain_one()
+                    # pipeline backpressure: keep at most pipeline_depth
+                    # dispatches in flight — host feeds N+1 while N
+                    # computes
+                    while len(self._inflight) >= self.config.pipeline_depth:
+                        self._drain_one()
             except Exception:
                 # belt-and-braces: _dispatch/_drain_one already error
                 # their own lots' futures; whatever still escapes must
                 # not kill the serving thread
                 self._metrics.note_error()
-        while self._carry:
-            self._dispatch([self._carry.popleft()])
-        while self._inflight:
-            self._drain_one()
+        with self._cycle_lock:
+            while self._carry:
+                self._dispatch([self._carry.popleft()])
+            while self._inflight:
+                self._drain_one()
 
     def _drain_inline(self):
         """Synchronous mode: flush + dispatch + deliver on the calling

@@ -1,0 +1,369 @@
+"""Multi-model serving: N named InferenceEngines over ONE shared
+device/mesh, with cross-model HBM arbitration.
+
+The single-model engine (engine.py) already amortizes the TPU tunnel;
+what a production server needs on top is the FLEET view the reference
+stack never had (one predictor per process): which models are loaded,
+what each one pins in device memory, and who gets evicted when the next
+model arrives.  ``ModelRegistry`` is that subsystem:
+
+  * **lifecycle** — ``load(name, dirname)`` (a save_inference_model
+    dir) or ``load(name, program=...)`` builds a per-model engine with
+    its own scope + executor over the registry's shared place/mesh;
+    ``unload`` stops and forgets it; ``warm`` pre-compiles the bucket
+    ladder; ``status()`` snapshots the fleet.  All thread-safe against
+    in-flight requests.
+  * **HBM arbiter** (arbiter.py) — every model's weight + executable
+    footprint is accounted (seeded from
+    ``fluid.contrib.memory_usage_calc``, corrected by live jax buffer
+    stats once it serves), admission-controlled against
+    ``hbm_budget_bytes``, and LRU-evicted to HOST memory when the
+    budget forces it: the victim engine is paused (in-flight dispatches
+    drain), its scope buffers demote to host ndarrays bitwise, and its
+    executables drop — the next request to it transparently re-stages
+    and recompiles (counted as a reload).
+  * **router** — ``submit(model, feed)`` ensures residency, bumps the
+    LRU, tracks per-model request/row rates, and forwards to the
+    model's engine queue; each engine's worker drains its own queue
+    while a shared dispatch GATE keeps device dispatches fair across
+    models (one bounded critical section per dispatch — no model can
+    hog the chip between another's dispatches).  The budget binds at
+    ROUTING time: a request already queued on an engine when its model
+    is evicted simply re-stages at its own dispatch (correct, slower),
+    and the account is corrected at the model's next routing.
+  * **observability** — per-model engine snapshots ride the profiler
+    sidecar under the registry's metrics source; spans land in
+    per-model ``:serving/<model>`` timeline rows (tools/timeline.py);
+    ``metrics()`` carries the arbiter's eviction/reload/admission
+    counters next to the router's rates.
+
+    reg = serving.ModelRegistry(hbm_budget_bytes=2 << 30)
+    reg.load('ranker', '/models/ranker')
+    reg.load('retriever', '/models/retriever')
+    with reg:                                  # starts every worker
+        out, = reg.infer('ranker', {'x': batch})
+    print(reg.status(), reg.metrics())
+"""
+
+import threading
+import time
+import weakref
+
+import numpy as np
+
+from ..fluid import core
+from ..fluid import profiler as _profiler
+from .arbiter import HBMArbiter, HBMBudgetError, program_seed_bytes
+from .engine import InferenceEngine, ServingConfig
+
+__all__ = ['ModelRegistry']
+
+
+class _ModelEntry(object):
+    __slots__ = ('name', 'engine', 'dirname', 'loaded_t', 'requests',
+                 'rows', 'first_req_t', 'last_req_t')
+
+    def __init__(self, name, engine, dirname):
+        self.name = name
+        self.engine = engine
+        self.dirname = dirname
+        self.loaded_t = time.time()
+        self.requests = 0
+        self.rows = 0
+        self.first_req_t = None
+        self.last_req_t = None
+
+
+class ModelRegistry(object):
+    """Host N named models behind one router + HBM arbiter (module
+    docstring has the design)."""
+
+    def __init__(self, hbm_budget_bytes=None, place=None, parallel=False,
+                 mesh=None, config=None, name=None):
+        self.place = place if place is not None else (
+            core.TPUPlace() if core.is_compiled_with_tpu()
+            else core.CPUPlace())
+        self.parallel = bool(parallel) or mesh is not None
+        self.mesh = mesh
+        self.config = config  # default ServingConfig for loaded models
+        self.name = name or 'model-registry'
+        self.arbiter = HBMArbiter(hbm_budget_bytes)
+        self._models = {}
+        # ONE reentrant lock over the model table + arbiter decisions:
+        # a submit ensuring residency (which may pause + evict another
+        # model) must never interleave with a load/unload mutating the
+        # table.  Engine queues drain on their own workers, so holding
+        # this across an eviction stalls ROUTING, not in-flight serving.
+        self._lock = threading.RLock()
+        # the fair-dispatch turnstile shared by every hosted engine
+        self._dispatch_gate = threading.Lock()
+        self._started = False
+        self._closed = False
+        ref = weakref.ref(self)
+        self._metrics_fn = lambda: (ref().metrics() if ref() else None)
+        self._metrics_key = _profiler.register_metrics_source(
+            self.name, self._metrics_fn)
+        weakref.finalize(self, _profiler.unregister_metrics_source,
+                         self._metrics_key, self._metrics_fn)
+
+    # ---- lifecycle -----------------------------------------------------
+
+    def load(self, name, dirname=None, program=None, feed_names=None,
+             fetch_list=None, scope=None, executor=None, config=None,
+             model_filename=None, params_filename=None):
+        """Load a model under ``name``: either a save_inference_model
+        ``dirname`` (own scope + executor, the production form) or an
+        explicit ``program`` (+ fetch_list, and a scope holding its
+        params).  Admission-checked against the HBM budget BEFORE any
+        device work: a model that can never fit raises HBMBudgetError
+        with nothing loaded."""
+        if not name or '/' in str(name):
+            raise ValueError(
+                'model name must be a non-empty string without "/" '
+                '(it keys metrics sources and timeline rows), got %r'
+                % (name, ))
+        with self._lock:
+            if self._closed:
+                raise RuntimeError('registry is closed')
+            if name in self._models:
+                raise ValueError(
+                    'model %r is already loaded — unload() it first '
+                    '(in-place replacement would strand its queued '
+                    'requests)' % name)
+            cfg = config or self.config or ServingConfig()
+            if dirname is not None:
+                engine = InferenceEngine.from_saved_model(
+                    dirname, place=self.place,
+                    model_filename=model_filename,
+                    params_filename=params_filename,
+                    parallel=self.parallel, mesh=self.mesh,
+                    config=cfg, name=name)
+            elif program is not None:
+                if fetch_list is None:
+                    raise ValueError('load(program=...): fetch_list is '
+                                     'required')
+                engine = InferenceEngine(
+                    program, feed_names=feed_names, fetch_list=fetch_list,
+                    place=self.place, scope=scope, executor=executor,
+                    parallel=self.parallel, mesh=self.mesh,
+                    config=cfg, name=name)
+            else:
+                raise ValueError('load(): pass dirname= or program=')
+            try:
+                # admission gate: seed the account from the program's
+                # var-sum estimate at the TOP bucket size (weights +
+                # the largest lot's activations the executables pin)
+                seed = program_seed_bytes(engine._program,
+                                          max(engine.buckets.sizes))
+                self.arbiter.admit(name, seed)
+                entry = _ModelEntry(name, engine, dirname)
+                self._models[name] = entry
+                # make room NOW (evicting LRU peers), so the first
+                # request pays staging, not arbitration
+                self.arbiter.ensure(name, self._evict_to_host)
+            except Exception:
+                # ANY failure (budget reject, an estimator choking on
+                # an exotic var, ...) must not leak the constructed
+                # engine — its profiler registration and param scope
+                # would outlive the failed load
+                self.arbiter.drop(name)
+                self._models.pop(name, None)
+                engine.stop()
+                raise
+            engine._gate = self._dispatch_gate
+            if self._started:
+                engine.start()
+            return engine
+
+    def unload(self, name):
+        """Stop the model's engine (drains its queue + in-flight
+        dispatches), drop its account, and forget it."""
+        with self._lock:
+            entry = self._models.pop(name, None)
+            if entry is None:
+                raise KeyError('model %r is not loaded' % name)
+            self.arbiter.drop(name)
+        entry.engine.stop()
+
+    def warm(self, name, bucket_ladder=None):
+        """Pre-compile the model's executables across its bucket ladder
+        (or an explicit one) with zero-filled requests, so first real
+        traffic pays staging, not XLA compiles.  Returns the number of
+        warm requests served."""
+        entry = self._entry(name)
+        engine = entry.engine
+        ladder = list(bucket_ladder if bucket_ladder is not None
+                      else engine.buckets.sizes)
+        feed_names = engine._feed_names
+        if not feed_names:
+            raise ValueError(
+                'warm(%r): the engine has no feed_names — load the '
+                'model from a save_inference_model dir, or pass '
+                'feed_names= at load()' % name)
+        block = engine._program.global_block()
+        served = 0
+        for rows in ladder:
+            feed = {}
+            for fname in feed_names:
+                var = block.vars[fname]
+                shape = [int(d) for d in var.shape]
+                shape[0] = int(rows)
+                if any(d < 0 for d in shape[1:]):
+                    raise ValueError(
+                        'warm(%r): feed %r has a non-batch dynamic dim '
+                        '%s — warm it with real traffic instead'
+                        % (name, fname, var.shape))
+                feed[fname] = np.zeros(shape, dtype=var.np_dtype)
+            self.infer(name, feed, timeout=600)
+            served += 1
+        return served
+
+    def _entry(self, name):
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None:
+                raise KeyError(
+                    'model %r is not loaded (loaded: %s)'
+                    % (name, sorted(self._models)))
+            return entry
+
+    def models(self):
+        with self._lock:
+            return sorted(self._models)
+
+    # ---- arbiter plumbing ----------------------------------------------
+
+    def _evict_to_host(self, victim):
+        """The arbiter's evict callback: pause the victim engine (its
+        in-flight dispatches drain), demote its device buffers to host
+        ndarrays bitwise, drop its executables.  Returns the live bytes
+        moved (the arbiter's account correction)."""
+        entry = self._models[victim]
+        moved, _ = entry.engine.evict_to_host()
+        return moved
+
+    def _ensure_resident(self, name):
+        """Dispatch-time gate: budget-arbitrate ``name`` resident (LRU
+        peers evict as needed) and correct resident accounts to live
+        buffer stats."""
+        with self._lock:
+            entry = self._entry(name)
+            self.arbiter.correct(name, entry.engine.device_footprint())
+            self.arbiter.ensure(name, self._evict_to_host)
+            return entry
+
+    # ---- router --------------------------------------------------------
+
+    def submit(self, model, feed, return_numpy=True):
+        """Route one request to ``model``: ensure it is resident under
+        the HBM budget (transparently reloading it / evicting LRU peers
+        — the caller never sees the arbitration, only the latency), and
+        enqueue on its engine.  Returns the engine's InferenceRequest
+        future."""
+        entry = self._ensure_resident(model)
+        now = time.time()
+        with self._lock:
+            entry.requests += 1
+            if entry.first_req_t is None:
+                entry.first_req_t = now
+            entry.last_req_t = now
+        req = entry.engine.submit(feed, return_numpy=return_numpy)
+        if req.rows:
+            with self._lock:
+                entry.rows += req.rows
+        return req
+
+    def infer(self, model, feed, return_numpy=True, timeout=None):
+        """Synchronous convenience: submit + wait."""
+        return self.submit(model, feed,
+                           return_numpy=return_numpy).result(timeout)
+
+    # ---- start/stop ----------------------------------------------------
+
+    def start(self):
+        """Start every loaded model's worker (queued mode); models
+        loaded later start automatically."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError('registry is closed')
+            self._started = True
+            engines = [e.engine for e in self._models.values()]
+        for eng in engines:
+            eng.start()
+        return self
+
+    def stop(self):
+        """Stop every engine (each drains its queue), then unregister
+        the registry's metrics source."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            engines = [e.engine for e in self._models.values()]
+        for eng in engines:
+            eng.stop()
+        _profiler.unregister_metrics_source(self._metrics_key,
+                                            self._metrics_fn)
+
+    close = stop
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ---- observability -------------------------------------------------
+
+    def status(self):
+        """One fleet snapshot: per-model residency, HBM account (bytes +
+        whether it is the seed estimate or live-corrected), live device
+        footprint, queue depth, and request tallies — plus the arbiter's
+        budget line."""
+        with self._lock:
+            arb = self.arbiter.snapshot()
+            out = {'budget_bytes': arb['budget_bytes'],
+                   'resident_bytes': arb['resident_bytes'],
+                   'models': {}}
+            for name, entry in self._models.items():
+                acct = arb['accounts'].get(name, {})
+                out['models'][name] = {
+                    'resident': acct.get('resident', False),
+                    'hbm_bytes': acct.get('bytes', 0),
+                    'account_source': acct.get('source'),
+                    'device_footprint': entry.engine.device_footprint(),
+                    'queue_depth': entry.engine._batcher.depth(),
+                    'requests': entry.requests,
+                    'rows': entry.rows,
+                    'dirname': entry.dirname,
+                    'parallel': entry.engine._pe is not None,
+                }
+            return out
+
+    def metrics(self):
+        """Router + arbiter + per-model engine snapshots (this is what
+        the profiler sidecar carries under the registry's source)."""
+        with self._lock:
+            entries = dict(self._models)
+        arb = self.arbiter.snapshot()
+        per_model = {}
+        for name, entry in entries.items():
+            snap = entry.engine.metrics()
+            window = ((entry.last_req_t - entry.first_req_t)
+                      if entry.requests > 1 and entry.first_req_t else None)
+            snap['router'] = {
+                'requests': entry.requests,
+                'rows': entry.rows,
+                'req_per_s': (round((entry.requests - 1) / window, 3)
+                              if window else None),
+            }
+            per_model[name] = snap
+        return {
+            'models': per_model,
+            'evictions': arb['evictions'],
+            'reloads': arb['reloads'],
+            'admission_rejects': arb['admission_rejects'],
+            'budget_bytes': arb['budget_bytes'],
+            'resident_bytes': arb['resident_bytes'],
+            'lru_order': arb['lru_order'],
+        }

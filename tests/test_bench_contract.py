@@ -148,6 +148,39 @@ def test_flagship_configs_wired_through_run_multi():
     assert 'run_eval_multi' in src
     assert "'device_true': True" in src
     assert "'steps_per_dispatch': k" in src
+    # ISSUE 4: the inference config pairs its number with the
+    # multi-model measurement — both variants registry-hosted under one
+    # HBM budget, resident vs evict-reload windows with the arbiter's
+    # counters riding along
+    assert 'ModelRegistry' in src
+    assert "'multi_model': mm" in src
+    mm_src = src  # the block builder is nested in the config fn
+    for key in ('resident_imgs_per_sec', 'evict_reload_imgs_per_sec',
+                'reload_tax', 'evictions', 'reloads',
+                'admission_rejects', 'budget_mb'):
+        assert "'%s'" % key in mm_src, key
+
+
+def test_multi_model_perf_gate_config_registered():
+    """tools/perf_gate.py multi_model (ISSUE 4): two models under one
+    budget, paired resident-vs-evict-reload windows.  Structural pin —
+    the functional path is TPU-only (tests/test_perf_gate.py drives the
+    hard gates on hardware); the registry machinery itself is covered
+    functionally by tests/test_model_registry.py."""
+    import inspect
+    sys.path.insert(0, os.path.join(REPO, 'tools'))
+    try:
+        import perf_gate
+    finally:
+        sys.path.pop(0)
+    assert 'multi_model' in perf_gate.CONFIGS
+    src = inspect.getsource(perf_gate.run_multi_model)
+    for key in ('resident_imgs_per_sec', 'evict_reload_imgs_per_sec',
+                'reload_tax', 'evictions', 'reloads',
+                'admission_rejects', 'budget_mb'):
+        assert "'%s'" % key in src, key
+    assert 'ModelRegistry' in inspect.getsource(
+        perf_gate.build_multi_model)
 
 
 def test_nmt_cpu_smoke_is_device_true():

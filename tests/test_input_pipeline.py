@@ -603,3 +603,127 @@ def test_pipeline_close_mid_drain_stops_consuming_the_reader():
     # at most the single in-flight pop completes post-close; a stager
     # without the _closed check would pop a full K-batch block
     assert after - before <= 1, (before, after)
+
+
+# ---- run_eval_multi(reader=..., steps=K): the eval-sweep symmetric mode
+
+
+def _eval_reader_prog(batches, seed=0):
+    """A py_reader-fed EVAL program (no optimizer) + its provider."""
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = seed
+    with fluid.program_guard(prog, startup):
+        rd = fluid.layers.py_reader(capacity=8, shapes=[[-1, 4], [-1, 1]],
+                                    dtypes=['float32', 'int64'])
+        x, label = fluid.layers.read_file(rd)
+        pred = fluid.layers.fc(x, 3, act='softmax')
+    rd.decorate_tensor_provider(lambda: iter(batches))
+    return prog, startup, rd, pred
+
+
+def test_reader_fed_run_eval_multi_bitwise_equals_sequential():
+    """run_eval_multi(reader=..., steps=K) drains K DISTINCT eval
+    batches into ONE scanned dispatch and returns EVERY step's fetches,
+    bitwise-equal to K sequential run() pops over the same stream."""
+    batches = _batches(4, seed=11)
+    prog, startup, rd, pred = _eval_reader_prog(batches)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        rd.start()
+        seq = [np.asarray(exe.run(prog, fetch_list=[pred])[0])
+               for _ in range(4)]
+        rd.reset()
+        rd.start()
+        outs = exe.run_eval_multi(prog, reader=rd, fetch_list=[pred],
+                                  steps=4)
+    assert outs[0].shape == (4, 8, 3)
+    for k in range(4):
+        np.testing.assert_array_equal(seq[k], outs[0][k])
+
+
+def test_reader_fed_run_eval_multi_partial_tail_then_eof():
+    """A stream ending mid-block evaluates the shorter tail; the NEXT
+    reader-fed eval call raises EOFException exactly like run()."""
+    batches = _batches(5, seed=12)
+    prog, startup, rd, pred = _eval_reader_prog(batches)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        rd.start()
+        outs = exe.run_eval_multi(prog, reader=rd, fetch_list=[pred],
+                                  steps=3)
+        assert outs[0].shape[0] == 3
+        tail = exe.run_eval_multi(prog, reader=rd, fetch_list=[pred],
+                                  steps=3)  # only 2 batches remain
+        assert tail[0].shape[0] == 2
+        with pytest.raises(fluid.core.EOFException):
+            exe.run_eval_multi(prog, reader=rd, fetch_list=[pred],
+                               steps=3)
+
+
+def test_reader_fed_run_eval_multi_splits_at_bucket_boundary():
+    """The drain reuses the train path's bucket-boundary contract: a
+    ragged (drop_last=False) tail batch is PUSHED BACK and evaluated as
+    its own shorter dispatch instead of crashing the scan."""
+    rng = np.random.RandomState(13)
+    batches = [(rng.rand(8, 4).astype('float32'),
+                rng.randint(0, 3, (8, 1)).astype('int64'))
+               for _ in range(2)]
+    batches.append((rng.rand(5, 4).astype('float32'),
+                    rng.randint(0, 3, (5, 1)).astype('int64')))
+    prog, startup, rd, pred = _eval_reader_prog(batches)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        rd.start()
+        outs = exe.run_eval_multi(prog, reader=rd, fetch_list=[pred],
+                                  steps=3)
+        assert outs[0].shape == (2, 8, 3)  # boundary split the block
+        tail = exe.run_eval_multi(prog, reader=rd, fetch_list=[pred],
+                                  steps=3)
+        assert np.shape(tail[0])[1] == 5  # the pushed-back ragged tail
+
+
+def test_run_eval_multi_plain_feed_error_names_its_own_reader_mode():
+    """The plain-feed guard on a reader-fed program now points at
+    run_eval_multi's OWN reader= mode (ISSUE 4 satellite), not the
+    train path's."""
+    prog, startup, rd, pred = _eval_reader_prog(_batches(2))
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        with pytest.raises(RuntimeError,
+                           match=r'run_eval_multi\(reader='):
+            exe.run_eval_multi(prog, feed={}, fetch_list=[pred], steps=2)
+        with pytest.raises(ValueError, match='reader= OR'):
+            exe.run_eval_multi(prog, reader=rd, feed={},
+                               fetch_list=[pred], steps=2)
+
+
+def test_reader_fed_run_eval_multi_spmd_on_virtual_mesh():
+    """The SPMD mirror: pe.run_eval_multi(reader=..., steps=K) drains K
+    lots onto the dp-sharded feed_list path on the 8-device mesh and
+    matches sequential pe.run pops (allclose — cross-executable
+    comparisons carry XLA's documented ~1-ulp fusion variance)."""
+    batches = _batches(4, rows=16, seed=14)
+    prog, startup, rd, pred = _eval_reader_prog(batches, seed=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(main_program=prog, scope=scope)
+        assert pe.device_count == 8
+        rd.start()
+        seq = [np.asarray(pe.run([pred])[0]) for _ in range(4)]
+        rd.reset()
+        rd.start()
+        outs = pe.run_eval_multi([pred], reader=rd, steps=4)
+    assert outs[0].shape == (4, 16, 3)
+    for k in range(4):
+        np.testing.assert_allclose(seq[k], outs[0][k], rtol=2e-4,
+                                   atol=1e-6)
+    assert pe.steps_dispatched == 4 + 4 and pe.dispatch_count == 4 + 1

@@ -409,9 +409,10 @@ def test_engine_dp_sharded_serving_on_virtual_mesh():
 # ---- metrics through the profiler timeline -----------------------------
 
 def test_serving_spans_and_metrics_in_profiler_sidecar():
-    """Engine spans land in fluid.profiler's host timeline and the
-    metrics snapshot rides the .events.json sidecar; tools/timeline.py
-    renders the spans in a dedicated ':serving' process row."""
+    """Engine spans land in fluid.profiler's host timeline KEYED by
+    engine name (serving/<name>/...) and the metrics snapshot rides the
+    .events.json sidecar; tools/timeline.py renders the spans in a
+    dedicated per-engine ':serving/<name>' process row."""
     sys.path.insert(0, os.path.join(REPO, 'tools'))
     try:
         from timeline import Timeline
@@ -429,8 +430,9 @@ def test_serving_spans_and_metrics_in_profiler_sidecar():
             eng.infer({'x': rng.rand(3, 6).astype('float32')})
         sidecar = json.load(open(p + '.events.json'))
         names = {e['name'] for e in sidecar['host_events']}
-        assert any(n.startswith('serving/dispatch') for n in names), names
-        assert 'serving/queue_wait' in names
+        assert any(n.startswith('serving/test-engine/dispatch')
+                   for n in names), names
+        assert 'serving/test-engine/queue_wait' in names
         snap = sidecar['metrics']['test-engine']
         assert snap['requests'] == 1 and snap['dispatches'] == 1
         assert snap['batch_fill_ratio'] is not None
@@ -438,9 +440,68 @@ def test_serving_spans_and_metrics_in_profiler_sidecar():
             {'t': sidecar}).generate_chrome_trace())
         rows = {e['args']['name'] for e in trace['traceEvents']
                 if e['ph'] == 'M'}
-        assert 't:serving' in rows, rows
+        assert 't:serving/test-engine' in rows, rows
         cats = {e['cat'] for e in trace['traceEvents'] if e['ph'] == 'X'}
         assert 'serving' in cats
+
+
+def test_two_engines_one_profile_window_keep_distinct_sidecar_rows():
+    """Regression (ISSUE 4 satellite): two engines stopped inside ONE
+    profiler window must not clobber each other's sidecar rows — spans
+    are keyed serving/<name>/..., metrics snapshots keep both entries
+    (same-named sources uniquify instead of overwriting), and the
+    timeline renders one ':serving/<name>' row per engine."""
+    sys.path.insert(0, os.path.join(REPO, 'tools'))
+    try:
+        from timeline import Timeline
+    finally:
+        sys.path.pop(0)
+    with tempfile.TemporaryDirectory() as td:
+        prog, feeds, fetches, exe, scope = _save_load_model(td)
+        rng = np.random.RandomState(21)
+        p = os.path.join(td, 'prof')
+        with fluid.profiler.profiler('CPU', profile_path=p):
+            for name, reqs in (('eng-a', 1), ('eng-b', 2)):
+                eng = serving.InferenceEngine(
+                    prog, feed_names=feeds, fetch_list=fetches,
+                    scope=scope, executor=exe, name=name)
+                with eng:
+                    for _ in range(reqs):
+                        eng.infer({'x': rng.rand(2, 6).astype('float32')})
+        sidecar = json.load(open(p + '.events.json'))
+        names = {e['name'] for e in sidecar['host_events']}
+        assert any(n.startswith('serving/eng-a/dispatch') for n in names)
+        assert any(n.startswith('serving/eng-b/dispatch') for n in names)
+        # BOTH stopped engines' final snapshots survive, keyed by name
+        assert sidecar['metrics']['eng-a']['requests'] == 1
+        assert sidecar['metrics']['eng-b']['requests'] == 2
+        trace = json.loads(Timeline(
+            {'t': sidecar}).generate_chrome_trace())
+        rows = {e['args']['name'] for e in trace['traceEvents']
+                if e['ph'] == 'M'}
+        assert {'t:serving/eng-a', 't:serving/eng-b'} <= rows, rows
+
+
+def test_same_named_engines_do_not_clobber_sidecar_metrics():
+    """The other half of the clobber bug: two engines REUSING one name
+    inside a window keep BOTH snapshots — the second registration
+    uniquifies (name#2) instead of silently taking over the slot."""
+    with tempfile.TemporaryDirectory() as td:
+        prog, feeds, fetches, exe, scope = _save_load_model(td)
+        rng = np.random.RandomState(22)
+        p = os.path.join(td, 'prof')
+        with fluid.profiler.profiler('CPU', profile_path=p):
+            for reqs in (1, 2):
+                eng = serving.InferenceEngine(
+                    prog, feed_names=feeds, fetch_list=fetches,
+                    scope=scope, executor=exe, name='prod')
+                with eng:
+                    for _ in range(reqs):
+                        eng.infer({'x': rng.rand(2, 6).astype('float32')})
+        sidecar = json.load(open(p + '.events.json'))
+        got = {k: v['requests'] for k, v in sidecar['metrics'].items()
+               if k.startswith('prod')}
+        assert sorted(got.values()) == [1, 2], got
 
 
 def test_engine_stopped_inside_profile_window_keeps_metrics():

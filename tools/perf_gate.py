@@ -10,7 +10,7 @@ max(fw)/max(bd) cross-window pairing).
 
 Run on TPU hardware:
     python tools/perf_gate.py \
-        [resnet|transformer|nmt|resnet_infer|feed_pipeline|all]
+        [resnet|transformer|nmt|resnet_infer|feed_pipeline|multi_model|all]
 Prints one JSON line per config; tests/test_perf_gate.py drives it and
 skips cleanly off-TPU.  ``resnet_infer`` (ISSUE 2) has no bound side —
 its deliverable is the paired ``multi_vs_dispatch`` block: the measured
@@ -18,6 +18,11 @@ dispatch tax Executor.run_eval_multi removes from the serving path.
 ``feed_pipeline`` (ISSUE 3) likewise pairs overlapped-vs-blocked input
 staging: the throughput fluid.FeedPipeline recovers by staging scan
 block N+1 while dispatch N computes (feed_stall ~ 0 after warmup).
+``multi_model`` (ISSUE 4) pairs resident-vs-evict-reload serving: two
+models under ONE ModelRegistry HBM budget sized for only one of them —
+the evict-reload window's latency tax is the measured cost of LRU
+weight arbitration (host demotion + re-stage + recompile per swap),
+the resident window the same registry with no arbitration pressure.
 """
 
 import json
@@ -350,18 +355,137 @@ def run_feed_pipeline():
     return rec
 
 
+def build_multi_model():
+    """Two ResNet-18 eval models under ONE ModelRegistry (ISSUE 4),
+    budget sized so only one fits resident: the RESIDENT window serves
+    one model repeatedly (no arbitration), the EVICT-RELOAD window
+    alternates models so EVERY request pays an LRU eviction (weights
+    demoted to host) + transparent reload (re-stage + recompile).  The
+    paired ratio is the measured arbitration tax a capacity planner
+    trades against buying a second chip."""
+    import tempfile
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import serving
+    from paddle_tpu.models import resnet
+
+    batch = int(os.environ.get('PERF_GATE_MM_BATCH', '64'))
+    reqs = int(os.environ.get('PERF_GATE_MM_REQS', '4'))
+    place = fluid.TPUPlace()
+    dirs = []
+    for seed in (0, 1):
+        model = resnet.build(depth=18, class_dim=1000,
+                             image_shape=(3, 224, 224), lr=0.1)
+        model['startup'].random_seed = seed
+        exe = fluid.Executor(place)
+        scope = fluid.core.Scope()
+        td = tempfile.mkdtemp()
+        with fluid.scope_guard(scope):
+            exe.run(model['startup'])
+            fluid.io.save_inference_model(
+                td, model['feeds'][:1], [model['prediction']], exe,
+                main_program=model['test'])
+        dirs.append(td)
+    rng = np.random.RandomState(0)
+    x = rng.standard_normal((batch, 3, 224, 224)).astype('float32')
+    reg = serving.ModelRegistry(
+        place=place,
+        config=serving.ServingConfig(max_batch_size=batch,
+                                     bucket_sizes=[batch]))
+    names = ['mm0', 'mm1']
+    feeds = {}
+    for name, d in zip(names, dirs):
+        eng = reg.load(name, d)
+        feeds[name] = {eng._feed_names[0]: x}
+    # warm both (resident, compiled) then tighten the budget so only
+    # ONE model's LIVE footprint fits at a time.  device_footprint, not
+    # the account's hbm_bytes: accounts may still carry the seed
+    # estimate here (the routing-time correction fires BEFORE a
+    # model's first dispatch stages anything), and a seed-sized budget
+    # would fit both models — measuring no arbitration at all
+    for name in names:
+        out, = reg.infer(name, feeds[name], timeout=600)
+        assert np.isfinite(np.asarray(out)).all()
+    # second pass: the routing-time correction now sees the staged
+    # buffers, pulling each ACCOUNT down from the seed estimate to live
+    # bytes — a seed-sized account under the tightened budget below
+    # would be rejected outright instead of arbitrated
+    for name in names:
+        reg.infer(name, feeds[name], timeout=600)
+    status = reg.status()['models']
+    live = max(s['device_footprint'] for s in status.values())
+    assert live > 0
+    reg.arbiter.set_budget(int(1.5 * live))
+
+    def resident():
+        reg.infer(names[0], feeds[names[0]], timeout=600)  # make resident
+        t0 = time.time()
+        for _ in range(reqs):
+            reg.infer(names[0], feeds[names[0]], timeout=600)
+        return batch * reqs / (time.time() - t0)
+
+    def evict_reload():
+        # the resident window left names[0] resident: start on names[1]
+        # so EVERY timed request pays an eviction + reload
+        t0 = time.time()
+        for i in range(reqs):
+            name = names[(i + 1) % 2]
+            reg.infer(name, feeds[name], timeout=600)
+        return batch * reqs / (time.time() - t0)
+
+    return resident, evict_reload, (reg, batch, reqs)
+
+
+def run_multi_model():
+    """The multi_model record: interleaved resident/evict-reload
+    windows (each ratio shares a drift window, the gates' pairing
+    rule), plus the registry's arbitration counters."""
+    resident, evict_reload, (reg, batch, reqs) = build_multi_model()
+    res, ev = [], []
+    for _ in range(BLOCKS):
+        res.append(resident())
+        ev.append(evict_reload())
+    m = reg.metrics()
+    # the deliverable is the arbitration tax: a record with no forced
+    # evictions would be measuring nothing
+    assert m['evictions'] >= BLOCKS * reqs // 2, m['evictions']
+    rec = {
+        'config': 'multi_model',
+        'models': 2,
+        'budget_mb': round(m['budget_bytes'] / 1024.0 / 1024.0, 2),
+        'resident_imgs_per_sec': round(max(res), 1),
+        'evict_reload_imgs_per_sec': round(max(ev), 1),
+        'resident_blocks': [round(v, 1) for v in res],
+        'evict_reload_blocks': [round(v, 1) for v in ev],
+        # the PAIRED deliverable: throughput kept under forced
+        # per-request arbitration vs the resident baseline, per shared
+        # drift window
+        'reload_tax': round(max(e / r for e, r in zip(ev, res)), 4),
+        'evictions': m['evictions'],
+        'reloads': m['reloads'],
+        'admission_rejects': m['admission_rejects'],
+        'requests_per_window': reqs, 'batch': batch, 'blocks': BLOCKS,
+    }
+    reg.stop()
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 CONFIGS = {
     'resnet': (build_resnet, 'imgs_per_sec'),
     'transformer': (build_transformer, 'tokens_per_sec'),
     'nmt': (build_nmt, 'tokens_per_sec'),
     'resnet_infer': (build_resnet_infer, 'imgs_per_sec'),
     'feed_pipeline': (build_feed_pipeline, 'imgs_per_sec'),
+    'multi_model': (build_multi_model, 'imgs_per_sec'),
 }
 
 
 def run_config(name):
     if name == 'feed_pipeline':
         return run_feed_pipeline()
+    if name == 'multi_model':
+        return run_multi_model()
     build, unit = CONFIGS[name]
     # both sides compiled first, then INTERLEAVED blocks: a drift window
     # between two monolithic measurements would otherwise decide the

@@ -61,16 +61,28 @@ class Timeline(object):
     # (queue waits, dispatch->deliver windows) and feed-pipeline spans
     # (staging, feed stalls, dispatch->sync windows) each get their own
     # process row so the micro-batch / input pipelines read at a glance
-    # next to executor slices
+    # next to executor slices.  Spans keyed by a sub-source — the
+    # multi-model registry's ``serving/<model>/dispatch[...]`` form —
+    # split into one row PER sub-source (``label:serving/<model>``), so
+    # N engines profiled in one window never interleave in one row
     ROW_PREFIXES = (('serving/', 'serving'), ('pipeline/', 'pipeline'))
+
+    @classmethod
+    def _row_of(cls, name):
+        for prefix, row in cls.ROW_PREFIXES:
+            if name.startswith(prefix):
+                rest = name[len(prefix):]
+                if '/' in rest:  # keyed span: serving/<engine>/<event>
+                    return row + '/' + rest.split('/', 1)[0], row
+                return row, row
+        return None, None
 
     def _emit_host(self, label, prof):
         pid = self._allocate_pid()
         self._chrome.emit_pid('%s:host' % label, pid)
         row_pids = {}
         for ev in prof.get('host_events', []):
-            row = next((r for p, r in self.ROW_PREFIXES
-                        if ev['name'].startswith(p)), None)
+            row, cat = self._row_of(ev['name'])
             if row is not None:
                 row_pid = row_pids.get(row)
                 if row_pid is None:
@@ -78,7 +90,7 @@ class Timeline(object):
                     self._chrome.emit_pid('%s:%s' % (label, row), row_pid)
                 self._chrome.emit_region(
                     ev['start_s'] * 1e6, ev['dur_s'] * 1e6, row_pid,
-                    0, row, ev['name'])
+                    0, cat, ev['name'])
                 continue
             self._chrome.emit_region(
                 ev['start_s'] * 1e6, ev['dur_s'] * 1e6, pid, 0, 'host',
