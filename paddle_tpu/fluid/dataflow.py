@@ -37,6 +37,7 @@ state lands exactly as K sequential run() calls over the same batch
 stream would leave it.
 """
 
+import collections
 import threading
 import time
 import queue as _queue
@@ -65,6 +66,10 @@ def check_reader_args(what, feed, feed_list, steps=None,
 
 _PIPELINE_SEQ = [0]
 _PIPELINE_SEQ_LOCK = threading.Lock()
+
+# most recent dispatches kept in FeedPipeline.dispatch_log — far above
+# any contract test's horizon, bounded for open-ended pipelines
+_DISPATCH_LOG_CAP = 4096
 
 
 def find_read_op(program, reader=None):
@@ -146,10 +151,10 @@ class _Block(object):
     """One staged K-step scan block."""
 
     __slots__ = ('steps', 'sig_feed', 'scanned', 'placed', 'real',
-                 'padded', 'batch_feed_names')
+                 'padded', 'batch_feed_names', 'indices')
 
     def __init__(self, steps, sig_feed, scanned, placed, real=0, padded=0,
-                 batch_feed_names=None):
+                 batch_feed_names=None, indices=None):
         self.steps = steps
         self.sig_feed = sig_feed  # per_step[0]: keys the compile cache
         self.scanned = scanned  # {name: [K, ...]}
@@ -163,6 +168,11 @@ class _Block(object):
         # batch-led, so an aux feed whose rows merely coincide with the
         # padded lot size is never masked or trimmed (PR 1 contract)
         self.batch_feed_names = batch_feed_names
+        # source ordinals of the drained batches this block carries —
+        # the bucketed variant reorders across buckets, and
+        # ``FeedPipeline.dispatch_log`` makes the realized training
+        # order observable (and contract-testable)
+        self.indices = indices
 
 
 class FeedPipeline(object):
@@ -177,6 +187,16 @@ class FeedPipeline(object):
     steps: minibatches per dispatch (the scan length K).
     pipeline_depth: staged blocks ahead + dispatches in flight (2 =
         double buffering).
+    bucketed: route each drained batch to its shape-bucket's OPEN
+        block instead of closing a block at every bucket boundary
+        (ISSUE 5) — one scan executable per (batch, trailing) bucket,
+        so a length-skewed reader pipelines full K-step blocks without
+        an upstream bucketing pass.  Batches stay in reader order
+        WITHIN a bucket; dispatches issue in bucket-completion order,
+        recorded per dispatch in ``dispatch_log`` (source ordinals).
+    max_open_buckets: bound on concurrently accumulating buckets; the
+        least-recently-fed one flushes early as a shorter block beyond
+        it (the boundary push-back generalized to bounded memory).
 
     Iterate the pipeline to drive it: each item is one dispatch's
     converted last-step fetches.  ``metrics()`` snapshots feed-stall
@@ -187,13 +207,16 @@ class FeedPipeline(object):
 
     def __init__(self, executor, fetch_list, program=None, reader=None,
                  source=None, steps=1, pipeline_depth=2, scope=None,
-                 return_numpy=True, name=None):
+                 return_numpy=True, name=None, bucketed=False,
+                 max_open_buckets=4):
         if (reader is None) == (source is None):
             raise ValueError('FeedPipeline: pass reader= OR source=')
         if int(steps) < 1:
             raise ValueError('FeedPipeline: steps must be >= 1')
         if int(pipeline_depth) < 1:
             raise ValueError('FeedPipeline: pipeline_depth must be >= 1')
+        if int(max_open_buckets) < 1:
+            raise ValueError('FeedPipeline: max_open_buckets must be >= 1')
         self._exe = executor
         self._is_spmd = hasattr(executor, '_mesh')
         if self._is_spmd:
@@ -224,6 +247,26 @@ class FeedPipeline(object):
         self._staged = _queue.Queue(maxsize=self.pipeline_depth)
         self._inflight = []
         self._pending = None  # a prepared batch held across a bucket split
+        # bucketed variant (ISSUE 5): instead of CLOSING a block at a
+        # shape-bucket boundary, route each drained batch to its
+        # bucket's open block — one scan executable per (batch,
+        # trailing) bucket — so a length-skewed reader pipelines
+        # without an upstream bucketing pass.  ``_open`` maps feed
+        # signature -> the bucket's accumulating per-step list; at most
+        # ``max_open_buckets`` stay open (the LRU one flushes early as
+        # a shorter block — the bucket-boundary push-back generalized:
+        # bounded staging memory instead of a pushed-back tail).
+        self.bucketed = bool(bucketed)
+        self.max_open_buckets = int(max_open_buckets)
+        self._open = collections.OrderedDict()
+        self._drained = 0  # source ordinal of the next drained batch
+        # realized training order (bucketed mode only): one list of
+        # source ordinals per dispatch, appended when the dispatch
+        # issues — non-bucketed dispatches stay in reader order, so
+        # nothing is recorded there.  Bounded: an open-ended source=
+        # pipeline keeps only the most recent window instead of
+        # growing forever
+        self.dispatch_log = collections.deque(maxlen=_DISPATCH_LOG_CAP)
         self._placer = None  # set before the first placed block
         self._error = None
         self._closed = False
@@ -233,7 +276,8 @@ class FeedPipeline(object):
         # owns the rest — disjoint keys, snapshot() copies
         self._m = {'blocks_staged': 0, 'stage_s': 0.0, 'stage_s_first': 0.0,
                    'dispatches': 0, 'steps_dispatched': 0,
-                   'feed_stall_s': 0.0, 'partial_blocks': 0, 'eof': False}
+                   'feed_stall_s': 0.0, 'partial_blocks': 0, 'eof': False,
+                   'bucket_early_flushes': 0}
         with _PIPELINE_SEQ_LOCK:
             _PIPELINE_SEQ[0] += 1
             seq = _PIPELINE_SEQ[0]
@@ -278,7 +322,7 @@ class FeedPipeline(object):
         # to) and post-pad grouping here (the sync path's padding
         # happens downstream in PE.run_multi's feed_list normalize).
         # A boundary-semantics change must land in BOTH.
-        per_step, sig0, last_rp, bn0 = [], None, (0, 0), None
+        per_step, sig0, last_rp, bn0, indices = [], None, (0, 0), None, []
         while len(per_step) < self.steps:
             if self._closed:
                 # close() mid-drain: stop consuming the source — a
@@ -287,54 +331,116 @@ class FeedPipeline(object):
                 # a pass the user may keep reading manually
                 return None
             if self._pending is not None:
-                (prepared, rp, bn), self._pending = self._pending, None
+                (prepared, rp, bn, idx), self._pending = \
+                    self._pending, None
             else:
-                try:
-                    batch = next(self._next_batch)
-                except StopIteration:
+                drained = self._drain_prepared()
+                if drained is None:
                     break
-                prepared = prepare_feed_arrays(dict(batch))
-                rp, bn = (0, 0), None
-                if self._is_spmd:
-                    # dp-pad ragged lots (masked samples) BEFORE the
-                    # bucket grouping, so a non-divisible tail becomes
-                    # its own padded block instead of failing the
-                    # sharded device_put on the staging thread; the
-                    # report records pre-pad batch-led provenance
-                    rpt = {}
-                    prepared, real, padded = self._pad(prepared,
-                                                       report=rpt)
-                    rp, bn = (real, padded), rpt.get('batch_names')
+                prepared, rp, bn, idx = drained
             sig = feed_signature(prepared)
             if per_step and sig != sig0:
                 # shape-bucket boundary (e.g. a ragged FINAL batch,
                 # drop_last=False): close this block and start the next
                 # one at the new signature — a shorter tail block is
                 # one extra (steps, shape) compile, never a crash
-                self._pending = (prepared, rp, bn)
+                self._pending = (prepared, rp, bn, idx)
                 break
             sig0 = sig
             if not per_step:
                 bn0 = bn  # the block's compile records step 0's view
             per_step.append(prepared)
+            indices.append(idx)
             last_rp = rp
         if not per_step:
             return None
-        # uniformity holds by construction: every step shares sig0
+        return self._finish_block(per_step, last_rp, bn0, indices)
+
+    def _drain_prepared(self):
+        """Pop + prepare (+ dp-pad under SPMD) ONE source batch; None at
+        EOF.  Returns (prepared, (real, padded), batch_names, ordinal)."""
+        try:
+            batch = next(self._next_batch)
+        except StopIteration:
+            return None
+        prepared = prepare_feed_arrays(dict(batch))
+        rp, bn = (0, 0), None
+        if self._is_spmd:
+            # dp-pad ragged lots (masked samples) BEFORE the bucket
+            # grouping, so a non-divisible tail becomes its own padded
+            # block instead of failing the sharded device_put on the
+            # staging thread; the report records pre-pad batch-led
+            # provenance
+            rpt = {}
+            prepared, real, padded = self._pad(prepared, report=rpt)
+            rp, bn = (real, padded), rpt.get('batch_names')
+        idx = self._drained
+        self._drained += 1
+        return prepared, rp, bn, idx
+
+    def _finish_block(self, per_step, last_rp, bn0, indices):
+        # uniformity holds by construction: every step shares one sig
         stacked = {n: stack_steps([fa[n] for fa in per_step])
                    for n in per_step[0]}
         placer = self._placer
         if placer is not None:
             stacked = {n: placer(n, v) for n, v in stacked.items()}
         return _Block(len(per_step), per_step[0], stacked,
-                      placer is not None, last_rp[0], last_rp[1], bn0)
+                      placer is not None, last_rp[0], last_rp[1], bn0,
+                      indices)
+
+    def _pop_open(self, last=False):
+        """Flush one open bucket as a (possibly shorter) block — always
+        the least-recently-FED one (appends move_to_end, so the front
+        of ``_open`` is the stalest bucket), both under the
+        max_open_buckets bound and when EOF drains the partials."""
+        _, entry = self._open.popitem(last=last)
+        per_step, last_rp, bn0, indices = entry
+        return self._finish_block(per_step, last_rp, bn0, indices)
+
+    def _next_block_bucketed(self):
+        """The bucketed drain (ISSUE 5): route each drained batch to
+        its feed-signature bucket's OPEN block; a bucket reaching
+        ``steps`` emits.  More than ``max_open_buckets`` distinct
+        shapes in flight flush the least-recently-fed bucket early as
+        a shorter block (bounded staging memory — the generalization
+        of the non-bucketed path's boundary push-back); EOF flushes
+        the remaining partials in least-recently-fed order.  Interleaved
+        shape-skewed readers thus pipeline full K-step blocks — one
+        scan executable per (batch, trailing) bucket — instead of
+        fragmenting into 1-step blocks at every boundary."""
+        while True:
+            if self._closed:
+                return None
+            drained = self._drain_prepared()
+            if drained is None:
+                break
+            prepared, rp, bn, idx = drained
+            sig = feed_signature(prepared)
+            entry = self._open.get(sig)
+            if entry is None:
+                entry = self._open[sig] = [[], (0, 0), bn, []]
+            entry[0].append(prepared)
+            entry[1] = rp
+            entry[3].append(idx)
+            self._open.move_to_end(sig)
+            if len(entry[0]) >= self.steps:
+                del self._open[sig]
+                return self._finish_block(*entry)
+            if len(self._open) > self.max_open_buckets:
+                self._m['bucket_early_flushes'] += 1
+                return self._pop_open(last=False)
+        if self._open:
+            return self._pop_open(last=False)
+        return None
 
     def _stage_loop(self):
         first = True
         try:
             while not self._closed:
                 t0 = time.time()
-                block = self._next_block()
+                block = (self._next_block_bucketed() if self.bucketed
+                         else self._next_block())
                 if block is None:
                     self._m['eof'] = True
                     break
@@ -420,6 +526,11 @@ class FeedPipeline(object):
                 block.sig_feed, block.scanned, block.steps)
         self._m['dispatches'] += 1
         self._m['steps_dispatched'] += block.steps
+        if self.bucketed:
+            # only the bucketed variant reorders across buckets; the
+            # sequential path's order is trivial, and an open-ended
+            # source= pipeline must not grow a log it never reads
+            self.dispatch_log.append(list(block.indices or []))
         self._inflight.append((fetches, compiled, block, time.time()))
 
     def _drain_one(self):
@@ -479,6 +590,8 @@ class FeedPipeline(object):
         m['inflight'] = len(self._inflight)
         m['pipeline_depth'] = self.pipeline_depth
         m['steps_per_dispatch'] = self.steps
+        m['bucketed'] = self.bucketed
+        m['open_buckets'] = len(self._open)
         # staging hidden behind compute: of the staging seconds spent
         # AFTER the first dispatch could run, the fraction the dispatch
         # loop did NOT wait for (feed_stall ~ 0 => ratio ~ 1)

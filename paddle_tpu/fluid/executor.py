@@ -27,6 +27,7 @@ import numpy as np
 from . import core
 from . import flags
 from .framework import default_main_program, Variable
+from .shape_policy import SEQ_BUCKET, bucketed_len
 from ..ops import registry
 
 
@@ -250,6 +251,47 @@ def check_feed_list_names(per_step, what):
                 % (what, i))
 
 
+def normalize_trailing_feed_list(per_step):
+    """Trailing-dim twin of normalize_ragged_feed_list (ISSUE 5): lots
+    whose SEQ feeds disagree on the padded time extent re-quantize onto
+    the shared seq-len ladder instead of failing the scan's uniformity
+    check.  Only feeds carrying a ``<name>@SEQLEN`` lengths companion
+    participate — their lowerings mask by real length, so zero-padding
+    axis 1 up to ``bucketed_len(max extent)`` is exactly the fill
+    ``_lod_to_padded`` already applies per batch (a dense feed with no
+    lengths has no masking contract, and stays an error).  Mutates and
+    returns ``per_step``; device-staged arrays only round-trip the host
+    on the disagreeing (ragged) path."""
+    names0 = per_step[0]
+    for name in list(names0):
+        if name.endswith((registry.SEQLEN_SUFFIX, registry.ROWS_SUFFIX)):
+            continue
+        if (name + registry.SEQLEN_SUFFIX) not in names0:
+            continue
+        extents = []
+        for fa in per_step:
+            v = fa[name]
+            shape = v.shape() if isinstance(v, core.LoDTensor) \
+                else np.shape(v)
+            if len(shape) < 2:
+                extents = None
+                break
+            extents.append(int(shape[1]))
+        if not extents or len(set(extents)) == 1:
+            continue
+        t = _bucketed_len(max(extents))
+        for fa, e in zip(per_step, extents):
+            if e == t:
+                continue
+            arr = np.asarray(fa[name].numpy()
+                             if isinstance(fa[name], core.LoDTensor)
+                             else fa[name])
+            pad = [(0, 0)] * arr.ndim
+            pad[1] = (0, t - e)
+            fa[name] = np.pad(arr, pad)
+    return per_step
+
+
 def prepare_feed_list(feed_list):
     """Normalize a run_multi feed_list: one prepared feed dict per
     iteration, uniform across steps.  Returns (steps, per_step).
@@ -258,6 +300,8 @@ def prepare_feed_list(feed_list):
     if not feed_list:
         raise ValueError('run_multi: feed_list is empty')
     per_step = [prepare_feed_arrays(dict(f)) for f in feed_list]
+    check_feed_list_names(per_step, 'run_multi')
+    normalize_trailing_feed_list(per_step)
     check_feed_list_uniform(per_step)
     return len(per_step), per_step
 
@@ -331,26 +375,11 @@ def _reject_reader_fed(program, what):
     return prog
 
 
-_SEQ_BUCKET = 16
-
-
-def _bucketed_len(max_len, bucket=_SEQ_BUCKET):
-    """Padded T for a batch whose longest row is ``max_len``.
-
-    Multiples of ``bucket`` up to 16*bucket (256 at the default), then
-    GEOMETRIC steps (x1.25, lane-aligned): a length-skewed corpus whose
-    tail reaches L distinct maxima must not mint O(L/bucket) distinct
-    shapes — each shape is one XLA compile and the LRU holds 64, so a
-    linear ladder past ~1024 recompiles forever (tests/
-    test_recompile_bound.py pins the ceiling this policy guarantees:
-    ≤ 16 + log1.25(L/256) buckets, 37 at L=64k; padding waste ≤ 25%)."""
-    linear_top = 16 * bucket
-    if max_len <= linear_top:
-        return max(((max_len + bucket - 1) // bucket) * bucket, bucket)
-    t = linear_top
-    while t < max_len:
-        t = ((t + (t >> 2)) + bucket - 1) // bucket * bucket
-    return t
+# The seq-len ladder policy lives in shape_policy so the serving
+# engine's trailing ladder and the feed_list normalization share ONE
+# tuning knob (ISSUE 5); the old private names stay as aliases.
+_SEQ_BUCKET = SEQ_BUCKET
+_bucketed_len = bucketed_len
 
 
 def _lod_to_padded(lt, bucket=_SEQ_BUCKET):
@@ -1210,6 +1239,7 @@ class Executor(object):
                 raise ValueError('run_eval_multi: feed_list is empty')
             per_step = [prepare_feed_arrays(dict(f)) for f in feed_list]
             check_feed_list_names(per_step, 'run_eval_multi')
+            normalize_trailing_feed_list(per_step)
             from .parallel_executor import pad_ragged_batch, \
                 normalize_ragged_feed_list
             per_step, reals, target, batch_feed_names = \

@@ -19,7 +19,7 @@ from . import core
 from .executor import _CompiledBlock, _current_scope, \
     prepare_feed_arrays, feed_signature, _is_host_op, \
     _reject_reader_fed, check_feed_list_uniform, stack_steps, \
-    check_feed_list_names
+    check_feed_list_names, normalize_trailing_feed_list
 from .framework import default_main_program, Variable
 from ..ops import registry
 
@@ -522,6 +522,7 @@ class ParallelExecutor(object):
             per_step = [prepare_feed_arrays(dict(f)) for f in feed_list]
             steps = len(per_step)
             check_feed_list_names(per_step, 'run_multi')
+            normalize_trailing_feed_list(per_step)
             # size probe only — no lot is padded (or pulled off device)
             # unless something is actually ragged
             per_step, reals, target, batch_feed_names = \
@@ -609,6 +610,7 @@ class ParallelExecutor(object):
             per_step = [prepare_feed_arrays(dict(f)) for f in feed_list]
             steps = len(per_step)
             check_feed_list_names(per_step, 'run_eval_multi')
+            normalize_trailing_feed_list(per_step)
             per_step, reals, target, batch_feed_names = \
                 normalize_ragged_feed_list(per_step, self._pad_ragged)
             check_feed_list_uniform(per_step)

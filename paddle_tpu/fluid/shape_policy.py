@@ -1,0 +1,55 @@
+"""The ONE trailing-dim (sequence-length / resolution) ladder policy.
+
+Every distinct trailing shape is one XLA compile, so a length-skewed
+corpus or request stream must quantize its trailing extents onto a
+bounded ladder.  Three consumers share this policy so the ladders stop
+being parallel inventions (ISSUE 5):
+
+  * ``executor._lod_to_padded`` — LoD feeds lower to padded [B, T, ...]
+    with T = ``bucketed_len(max_len)`` (the original site);
+  * ``serving.TrailingDimBuckets`` — the engine's per-feed trailing
+    ladder, so mixed-length requests coalesce into shared executables;
+  * ``executor.normalize_trailing_feed_list`` — run_multi /
+    run_eval_multi feed_lists whose lots disagree on a seq feed's
+    padded T re-quantize to one rung instead of failing uniformity.
+
+``SEQ_BUCKET`` is the single tuning knob: multiples of it up to
+16*SEQ_BUCKET (256 at the default 16), then geometric x1.25 steps
+(lane-aligned).  tests/test_trailing_buckets.py pins the ladder values;
+tests/test_recompile_bound.py pins the compile ceiling the policy
+guarantees (<= 16 + log1.25(L/256) buckets, padding waste <= 25%).
+"""
+
+__all__ = ['SEQ_BUCKET', 'bucketed_len', 'seq_ladder']
+
+SEQ_BUCKET = 16
+
+
+def bucketed_len(max_len, bucket=SEQ_BUCKET):
+    """Padded T for a batch/request whose longest row is ``max_len``.
+
+    Multiples of ``bucket`` up to 16*bucket, then GEOMETRIC steps
+    (x1.25, lane-aligned): a length-skewed corpus whose tail reaches L
+    distinct maxima must not mint O(L/bucket) distinct shapes — each
+    shape is one XLA compile and the Executor's LRU holds 64, so a
+    linear ladder past ~1024 recompiles forever."""
+    max_len = int(max_len)
+    linear_top = 16 * bucket
+    if max_len <= linear_top:
+        return max(((max_len + bucket - 1) // bucket) * bucket, bucket)
+    t = linear_top
+    while t < max_len:
+        t = ((t + (t >> 2)) + bucket - 1) // bucket * bucket
+    return t
+
+
+def seq_ladder(top, bucket=SEQ_BUCKET):
+    """The ladder ``bucketed_len`` quantizes onto, materialized up to
+    (and including) the rung covering ``top`` — the warm/precompile
+    form of the same policy."""
+    rungs, t = [], bucket
+    while True:
+        rungs.append(t)
+        if t >= int(top):
+            return rungs
+        t = bucketed_len(t + 1, bucket)

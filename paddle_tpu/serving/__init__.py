@@ -24,11 +24,12 @@ README 'Serving engine' / 'Multi-model serving' sections for the knobs.
 
 from .arbiter import HBMArbiter, HBMBudgetError  # noqa: F401
 from .batcher import InferenceRequest, MicroBatcher  # noqa: F401
-from .buckets import ShapeBucketSet  # noqa: F401
+from .buckets import ShapeBucketSet, TrailingDimBuckets  # noqa: F401
 from .engine import InferenceEngine, ServingConfig  # noqa: F401
 from .metrics import EngineMetrics  # noqa: F401
 from .registry import ModelRegistry  # noqa: F401
 
 __all__ = ['InferenceEngine', 'ServingConfig', 'MicroBatcher',
-           'InferenceRequest', 'ShapeBucketSet', 'EngineMetrics',
-           'ModelRegistry', 'HBMArbiter', 'HBMBudgetError']
+           'InferenceRequest', 'ShapeBucketSet', 'TrailingDimBuckets',
+           'EngineMetrics', 'ModelRegistry', 'HBMArbiter',
+           'HBMBudgetError']

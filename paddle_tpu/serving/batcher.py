@@ -7,9 +7,12 @@ dispatch by batching.  The queue's contract:
   * a lot closes when its rows reach ``max_batch_size`` (full flush) OR
     the OLDEST waiting request has aged ``max_wait_s`` (deadline flush)
     — latency is bounded by max_wait even at low traffic;
-  * only signature-compatible requests (same feed names, trailing dims
-    and dtypes) coalesce; an incompatible request simply waits its turn
-    as the head of a later lot — order is preserved per signature;
+  * only signature-compatible requests (same feed names, BUCKETED
+    trailing dims and dtypes — the engine quantizes variable seq-len/
+    resolution dims onto its TrailingDimBuckets ladder before the sig
+    is taken, so mixed-length requests in one rung DO coalesce)
+    coalesce; an incompatible request simply waits its turn as the
+    head of a later lot — order is preserved per signature;
   * a lone request larger than max_batch_size forms its own lot (the
     bucket ladder gives it an exact entry) rather than being rejected.
 
@@ -26,12 +29,19 @@ __all__ = ['InferenceRequest', 'MicroBatcher']
 
 
 class InferenceRequest(object):
-    """One submitted feed dict + its future result."""
+    """One submitted feed dict + its future result.
 
-    def __init__(self, feed, rows, sig, return_numpy=True):
+    ``trailing`` maps a BUCKETED trailing extent back to this request's
+    real extent ({padded_T: real_T}, axis 1) when the engine's
+    trailing-dim ladder padded the request's seq/resolution dims up to
+    a rung — the deliver path trims per-request fetches back to the
+    real extents (engine._drain_one)."""
+
+    def __init__(self, feed, rows, sig, return_numpy=True, trailing=None):
         self.feed = feed
         self.rows = rows  # None for unbatchable (LoD / scalar) feeds
         self.sig = sig
+        self.trailing = trailing or None
         self.return_numpy = return_numpy
         self.enqueue_t = time.time()
         self.latency_s = None

@@ -12,7 +12,13 @@ does for training, behind a request-facing surface:
   2. **shape bucketing** — each lot pads (masked, replicated last real
      row — the @SAMPLE_MASK machinery) to a bounded ShapeBucketSet
      ladder entry, so wandering request sizes map to a small fixed set
-     of XLA executables; fetches trim back to real row counts;
+     of XLA executables; fetches trim back to real row counts.  The
+     TRAILING dims bucket too (ISSUE 5, TrailingDimBuckets): variable
+     seq-len/resolution extents quantize onto the shared
+     fluid.shape_policy ladder (LoD feeds lower to padded + @SEQLEN at
+     submit), so mixed-length requests coalesce instead of fragmenting
+     into per-shape lots and per-shape executables; per-request fetches
+     trim back to real trailing extents;
   3. **pipelined multi-step eval dispatch** — up to steps_per_dispatch
      same-bucket lots ship as ONE Executor.run_eval_multi scan (K eval
      batches per dispatch, donated scanned block), and up to
@@ -39,11 +45,12 @@ import numpy as np
 from ..fluid import core
 from ..fluid import profiler as _profiler
 from ..fluid.executor import Executor, feed_signature, _is_host_op, \
-    fetch_batch_led
+    fetch_batch_led, prepare_feed_arrays
+from ..ops.registry import SEQLEN_SUFFIX, ROWS_SUFFIX, SAMPLE_MASK_NAME
 from ..fluid.parallel_executor import ParallelExecutor, pad_ragged_batch, \
     _lead
 from .batcher import InferenceRequest, MicroBatcher
-from .buckets import ShapeBucketSet
+from .buckets import ShapeBucketSet, TrailingDimBuckets
 from .metrics import EngineMetrics
 
 __all__ = ['ServingConfig', 'InferenceEngine']
@@ -64,21 +71,58 @@ class ServingConfig(object):
     bucket_sizes: explicit ladder for the ShapeBucketSet (None = powers
         of two up to max_batch_size).
     max_buckets: bound on the active bucket set (LRU accounting).
+    trailing_buckets: quantize variable TRAILING dims onto the shared
+        seq-len ladder (fluid.shape_policy — the same policy the
+        executor applies to LoD max-lens), so mixed-length sequence
+        requests share a signature and coalesce: single-level LoD
+        feeds lower to padded [B, T, ...] + @SEQLEN at submit, and
+        PaddedSequence data re-pads to its rung.  Padded positions are
+        masked by the @SEQLEN lowerings, so batched results stay
+        bitwise-equal to per-request runs.  False restores the old
+        behavior (every LoD/PaddedSequence request is its own
+        unbatchable lot).
+    trailing_ladders: EXPLICIT per-feed trailing ladders for DENSE
+        feeds — ``{'img': [224, 256]}`` (axis 1) or
+        ``{'img': {2: [224, 256], 3: [224, 256]}}`` (named axes): the
+        resolution-ladder opt-in.  The engine zero-pads those axes up
+        to the covering rung; because a dense feed carries no @SEQLEN
+        masking contract, this is only output-preserving for models
+        that ignore trailing padding (masked pooling/attention, padded
+        detection inputs) — opting in asserts that.
+    max_trailing_buckets: bound on the active trailing set (LRU
+        accounting, like max_buckets for the batch ladder).
     """
 
     def __init__(self, max_batch_size=32, max_wait_ms=5.0,
                  steps_per_dispatch=4, pipeline_depth=2,
-                 bucket_sizes=None, max_buckets=16):
+                 bucket_sizes=None, max_buckets=16,
+                 trailing_buckets=True, trailing_ladders=None,
+                 max_trailing_buckets=32):
         if int(steps_per_dispatch) < 1:
             raise ValueError('steps_per_dispatch must be >= 1')
         if int(pipeline_depth) < 1:
             raise ValueError('pipeline_depth must be >= 1')
+        if int(max_buckets) < 1:
+            raise ValueError('max_buckets must be >= 1')
+        if int(max_trailing_buckets) < 1:
+            # a 0 bound would make every bucket_for miss insert-then-
+            # evict its own key: an always-empty active set and an
+            # evictions counter equal to the miss count
+            raise ValueError('max_trailing_buckets must be >= 1')
         self.max_batch_size = int(max_batch_size)
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.steps_per_dispatch = int(steps_per_dispatch)
         self.pipeline_depth = int(pipeline_depth)
         self.bucket_sizes = bucket_sizes
         self.max_buckets = int(max_buckets)
+        if trailing_ladders and not trailing_buckets:
+            raise ValueError(
+                'ServingConfig: trailing_ladders= requires trailing '
+                'bucketing — drop trailing_buckets=False, or drop the '
+                'ladders')
+        self.trailing_buckets = bool(trailing_buckets)
+        self.trailing_ladders = trailing_ladders
+        self.max_trailing_buckets = int(max_trailing_buckets)
 
 
 class _Lot(object):
@@ -108,6 +152,17 @@ class InferenceEngine(object):
         self._program = program
         self._feed_names = list(feed_names) if feed_names else None
         self._fetch_list = list(fetch_list)
+        # static axis-1 widths of the fetch targets: a fetch of such a
+        # width (a class/hidden axis — fc(.., 16) under a 16 rung) can
+        # NOT be a mirrored rung-padded seq axis, so _bucket_trailing
+        # voids any rung coinciding with one (same reasoning as the
+        # static-feed guard there); dynamic seq fetches carry -1 on
+        # axis 1 and stay trimmable
+        self._fetch_static_ax1 = set()
+        for v in self._fetch_list:
+            shape = tuple(getattr(v, 'shape', None) or ())
+            if len(shape) >= 2 and int(shape[1]) > 0:
+                self._fetch_static_ax1.add(int(shape[1]))
         self._scope = scope if scope is not None else core.Scope()
         self.config = config if config is not None else ServingConfig()
         # host ops (save/print/readers) cannot run inside the eval scan:
@@ -135,6 +190,14 @@ class InferenceEngine(object):
                                       sizes=self.config.bucket_sizes,
                                       multiple=multiple,
                                       max_buckets=self.config.max_buckets)
+        # the trailing-dim twin (ISSUE 5): None when disabled (or for
+        # eager host-op programs, whose per-request exe.run path never
+        # coalesces anyway)
+        self.trailing = None
+        if self.config.trailing_buckets and not self._eager:
+            self.trailing = TrailingDimBuckets(
+                ladders=self.config.trailing_ladders,
+                max_buckets=self.config.max_trailing_buckets)
         self._batcher = MicroBatcher(self.config.max_batch_size,
                                      self.config.max_wait_s)
         self._metrics = EngineMetrics()
@@ -337,8 +400,9 @@ class InferenceEngine(object):
                     'feed names %s do not match the inference program '
                     '(missing %s, unexpected %s)' %
                     (sorted(feed), sorted(missing), sorted(extra)))
-        rows, sig = self._request_rows_sig(feed)
-        req = InferenceRequest(feed, rows, sig, return_numpy=return_numpy)
+        feed, rows, sig, trims = self._prepare_request(feed)
+        req = InferenceRequest(feed, rows, sig, return_numpy=return_numpy,
+                               trailing=trims)
         self._metrics.note_request(rows or 1)
         self._batcher.submit(req)
         if self._thread is None:
@@ -354,6 +418,8 @@ class InferenceEngine(object):
         compile counter (the ground truth the bucket policy bounds)."""
         snap = self._metrics.snapshot(queue_depth=self._batcher.depth())
         snap['buckets'] = self.buckets.report()
+        snap['trailing_buckets'] = (self.trailing.report()
+                                    if self.trailing is not None else None)
         snap['executor_compile_count'] = (
             self._pe.compile_count if self._pe is not None
             else self._exe.compile_count)
@@ -362,35 +428,169 @@ class InferenceEngine(object):
 
     # ---- request -> lot -----------------------------------------------
 
-    def _request_rows_sig(self, feed):
-        """(rows, coalescing signature) for a request; (None, unique)
-        for unbatchable feeds (LoD/PaddedSequence/scalars), which form
-        single-request lots with no padding."""
-        leads, sig = {}, []
-        for name, v in sorted(feed.items()):
-            if self._eager or isinstance(v, core.PaddedSequence) or (
-                    isinstance(v, core.LoDTensor) and v.lod()):
-                return None, object()
+    def _prepare_request(self, feed):
+        """(feed, rows, coalescing signature, trailing trim map) for a
+        request.  With trailing bucketing on, single-level LoD feeds
+        lower to padded [B, T, ...] + @SEQLEN here (the executor's own
+        lowering, already rung-quantized) and PaddedSequence / dense
+        ladder feeds zero-pad their trailing axes up to the covering
+        TrailingDimBuckets rung — so mixed-length requests in one rung
+        share a signature and coalesce.  Unbatchable feeds (host-op
+        programs, scalars, NESTED LoD — whose outer @ROWS level is not
+        row-aligned for per-request slicing — or any sequence feed with
+        trailing bucketing disabled) come back as (feed, None, unique,
+        None): single-request lots with no padding, the old path."""
+        if self._eager:
+            return feed, None, object(), None
+        seq_like = False
+        for v in feed.values():
+            if isinstance(v, core.PaddedSequence):
+                if self.trailing is None or v.rows is not None:
+                    return feed, None, object(), None
+                seq_like = True
+            elif isinstance(v, core.LoDTensor) and v.lod():
+                if self.trailing is None or len(v.lod()) >= 2:
+                    return feed, None, object(), None
+                seq_like = True
+        items = prepare_feed_arrays(feed) if seq_like else dict(feed)
+        # validate BEFORE bucketing: _bucket_trailing pads in place and
+        # records padding-waste / rung-hit metrics — a request rejected
+        # here (or routed to the unbatchable path) must leave no trace
+        # in the trailing accounting
+        leads = {}
+        for name, v in sorted(items.items()):
             lead = _lead(v)
             if lead is None:
-                return None, object()
+                return feed, None, object(), None
             if lead == 0:
                 raise ValueError(
                     'feed %r has 0 rows — an empty request has no '
                     'result to serve' % name)
             leads[name] = lead
+        if len(set(leads.values())) > 1:
+            raise ValueError(
+                'feeds disagree on the leading (batch) dim: %s — every '
+                'input of one request must carry the same number of '
+                'rows' % ({n: d for n, d in sorted(leads.items())}, ))
+        trims = self._bucket_trailing(items) \
+            if self.trailing is not None else None
+        sig = []
+        for name, v in sorted(items.items()):
             arr_like = v.numpy() if isinstance(v, core.LoDTensor) else v
             shape = tuple(np.shape(arr_like))
             dtype = getattr(arr_like, 'dtype', None)
             if dtype is None:
                 dtype = np.asarray(arr_like).dtype
             sig.append((name, shape[1:], str(dtype)))
-        if len(set(leads.values())) > 1:
-            raise ValueError(
-                'feeds disagree on the leading (batch) dim: %s — every '
-                'input of one request must carry the same number of '
-                'rows' % ({n: d for n, d in sorted(leads.items())}, ))
-        return int(next(iter(leads.values()))), tuple(sig)
+        return (items, int(next(iter(leads.values()))), tuple(sig),
+                trims)
+
+    def _bucket_trailing(self, items):
+        """Quantize ``items``' variable trailing dims onto the
+        TrailingDimBuckets ladder IN PLACE (zero-fill, the same pad
+        _lod_to_padded applies): axis 1 of every feed carrying a
+        @SEQLEN companion rides the shared seq-len policy; feeds named
+        in ``trailing_ladders`` pad their configured axes.  Returns the
+        axis-1 trim map {padded_extent: real_extent} for the deliver
+        path (a padded extent claimed by two feeds with DIFFERENT real
+        extents — including a feed sitting exactly ON the rung, or a
+        NON-bucketed feed's static axis-1 extent, or a FETCH target's
+        static axis-1 width, coinciding with it — is ambiguous and
+        dropped: such fetches deliver at the rung, documented in
+        _drain_one)."""
+        claims = {}  # rung -> set of real axis-1 extents claiming it
+        # extents a trim must never match: the static axis 1 of feeds
+        # NOT bucketed on axis 1 (collected below — including feeds
+        # whose ladders live on axes >= 2) and the fetch targets'
+        # static axis 1 (a [B, 16] softmax under a 16 rung is the
+        # fetch's OWN width, not rung padding)
+        static_ax1 = set(self._fetch_static_ax1)
+        plan = []  # (name, axes, explicit, shape) — validated upfront
+        for name in list(items):
+            if name.endswith((SEQLEN_SUFFIX, ROWS_SUFFIX)) or \
+                    name == SAMPLE_MASK_NAME:
+                continue
+            explicit = set(self.trailing.ladder_axes(name))
+            axes = set(explicit)
+            if (name + SEQLEN_SUFFIX) in items:
+                axes.add(1)
+            v = items[name]
+            shape = tuple(v.shape() if isinstance(v, core.LoDTensor)
+                          else np.shape(v))
+            for ax in sorted(explicit):
+                if ax >= len(shape):
+                    # a configured ladder axis the data doesn't have
+                    # would otherwise be skipped silently — that feed
+                    # would never coalesce and nothing would say why
+                    # (the constructor already rejects axis < 1 for
+                    # the same reason).  Raised HERE, before any feed
+                    # touches bucket hits or padding metrics, so the
+                    # rejected request leaves no trailing trace.
+                    raise ValueError(
+                        'trailing ladder for feed %r names axis '
+                        '%d, but the request has only %d dims — '
+                        'fix trailing_ladders' % (name, ax,
+                                                  len(shape)))
+            for ax in sorted(axes):
+                if 1 <= ax < len(shape) and int(shape[ax]) < 1:
+                    # bucket_for would raise the same complaint, but
+                    # mid-loop — after OTHER feeds already recorded
+                    # rung hits and padding cells
+                    raise ValueError(
+                        'feed %r has zero width on bucketed trailing '
+                        'axis %d — an empty extent has nothing to '
+                        'serve' % (name, ax))
+            if 1 not in axes and len(shape) >= 2:
+                static_ax1.add(int(shape[1]))
+            if axes:
+                plan.append((name, axes, explicit, shape))
+        for name, axes, explicit, shape in plan:
+            v = items[name]
+            rows = max(int(shape[0]), 1) if shape else 1
+            pads, prod_real, prod_rung = [], 1, 1
+            seq_lens_sum, bucketed = None, False
+            for ax in sorted(axes):
+                if ax >= len(shape) or ax < 1:
+                    continue
+                real = int(shape[ax])
+                rung = self.trailing.bucket_for(name, ax, real)
+                bucketed = True
+                if ax == 1 and (name + SEQLEN_SUFFIX) in items:
+                    # the TRUE occupancy of a seq feed's time axis is
+                    # its lengths sum — the rung pad a prepared LoD
+                    # feed already carries (inside _lod_to_padded)
+                    # must count as waste too, not just the extra pad
+                    # this pass adds
+                    seq_lens_sum = max(int(np.sum(np.asarray(
+                        items[name + SEQLEN_SUFFIX]))), 0)
+                    prod_rung *= rung
+                else:
+                    prod_real *= real
+                    prod_rung *= rung
+                if ax == 1:
+                    claims.setdefault(rung, set()).add(real)
+                if rung != real:
+                    pads.append((ax, rung - real))
+            if pads:
+                arr = np.asarray(v.numpy() if isinstance(v, core.LoDTensor)
+                                 else v)
+                width = [(0, 0)] * arr.ndim
+                for ax, p in pads:
+                    width[ax] = (0, p)
+                items[name] = np.pad(arr, width)
+            if bucketed:
+                base = seq_lens_sum if seq_lens_sum is not None else rows
+                self._metrics.note_trailing(base * prod_real,
+                                            rows * prod_rung)
+        # order-independent ambiguity: a rung claimed by two feeds with
+        # different real extents (even one sitting exactly ON it), or
+        # coinciding with a NON-bucketed feed's static axis-1 extent (a
+        # fetch of that width could mirror EITHER axis), has no single
+        # trim answer
+        trims = {rung: reals.pop() for rung, reals in claims.items()
+                 if len(reals) == 1 and rung not in reals
+                 and rung not in static_ax1}
+        return trims or None
 
     def _make_lot(self, requests):
         if _profiler.is_profiler_enabled():
@@ -535,6 +735,29 @@ class InferenceEngine(object):
                             and np.ndim(step) >= 1 \
                             and np.shape(step)[0] == lot.bucket:
                         step = step[offset:offset + req.rows]
+                        if req.trailing is not None \
+                                and np.ndim(step) >= 2:
+                            # trailing-dim trim (ISSUE 5): a per-row
+                            # fetch mirroring a rung-padded input axis
+                            # (axis 1 == a padded extent this request
+                            # recorded) trims back to the request's
+                            # REAL extent — so a PaddedSequence/dense-
+                            # ladder caller gets fetches shaped like
+                            # its own input, not like the rung.
+                            # (Extent-match is a heuristic like the
+                            # batch one above; ambiguous extents were
+                            # dropped at request build and deliver at
+                            # the rung.  Residual: STATIC widths —
+                            # feeds' and fetches' — void their rungs
+                            # upfront, but a fetch whose axis 1 is
+                            # dynamic AND whose runtime width lands on
+                            # a claimed rung without mirroring the
+                            # padded axis is indistinguishable here;
+                            # disable trailing_buckets for such
+                            # programs.)
+                            real = req.trailing.get(np.shape(step)[1])
+                            if real is not None:
+                                step = step[:, :real]
                     if not req.return_numpy:
                         step = core.LoDTensor(np.asarray(step))
                     res.append(step)

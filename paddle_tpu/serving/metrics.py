@@ -43,6 +43,10 @@ class EngineMetrics(object):
         self.steps_dispatched = 0
         self.compiles = 0
         self.errors = 0
+        # trailing-dim bucketing (ISSUE 5): padded vs real CELLS along
+        # bucketed trailing axes (weighted by rows, summed over feeds)
+        self.trailing_real_cells = 0
+        self.trailing_padded_cells = 0
 
     def note_request(self, rows):
         with self._lock:
@@ -58,6 +62,14 @@ class EngineMetrics(object):
                 self.deadline_flushes += 1
             else:
                 self.full_flushes += 1
+
+    def note_trailing(self, real_cells, padded_cells):
+        """One request's trailing-dim padding tax: real vs padded cells
+        (extent x rows, summed over that request's bucketed feed axes).
+        The snapshot derives the padding-waste ratio from the totals."""
+        with self._lock:
+            self.trailing_real_cells += int(real_cells)
+            self.trailing_padded_cells += int(padded_cells)
 
     def note_dispatch(self, steps, compiles):
         with self._lock:
@@ -99,6 +111,12 @@ class EngineMetrics(object):
                     if self.bucket_rows else None),
                 'deadline_flushes': self.deadline_flushes,
                 'full_flushes': self.full_flushes,
+                'trailing_real_cells': self.trailing_real_cells,
+                'trailing_padded_cells': self.trailing_padded_cells,
+                'trailing_padding_waste': (
+                    round(1.0 - self.trailing_real_cells /
+                          self.trailing_padded_cells, 4)
+                    if self.trailing_padded_cells else None),
                 'p50_latency_ms': (
                     round(_percentile(lat, 0.50) * 1e3, 3) if lat else None),
                 'p99_latency_ms': (

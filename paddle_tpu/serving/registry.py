@@ -45,6 +45,7 @@ model arrives.  ``ModelRegistry`` is that subsystem:
     print(reg.status(), reg.metrics())
 """
 
+import itertools
 import threading
 import time
 import weakref
@@ -185,37 +186,134 @@ class ModelRegistry(object):
             self.arbiter.drop(name)
         entry.engine.stop()
 
-    def warm(self, name, bucket_ladder=None):
+    def warm(self, name, bucket_ladder=None, trailing=None):
         """Pre-compile the model's executables across its bucket ladder
         (or an explicit one) with zero-filled requests, so first real
         traffic pays staging, not XLA compiles.  Returns the number of
-        warm requests served."""
+        warm requests served.
+
+        ``trailing`` extends the warm set along the TRAILING dims
+        (ISSUE 5): ``{feed_name: [extents]}`` warms one request per
+        (batch rung x trailing extent) for that feed — an LoD-declared
+        feed warms as a zero-filled LoD batch of that uniform length
+        (so the prepared signature, padded data + @SEQLEN, matches
+        real traffic whose lengths bucket to the same rung), a dense
+        feed substitutes the extent into axis 1.  Several trailing
+        feeds warm the FULL cross-product of their rungs — trailing
+        extents correlate in real traffic (both sides of a translation
+        pair bucket long together), so the correlated multi-feed
+        signatures are exactly the ones that must not stay cold; the
+        warm set is len(ladder) x prod(len(extents)), which the caller
+        bounds through the extents passed."""
         entry = self._entry(name)
         engine = entry.engine
         ladder = list(bucket_ladder if bucket_ladder is not None
                       else engine.buckets.sizes)
+        # materialize ONCE: iterator-valued extents would otherwise be
+        # drained by the empty-check below and the cross-product would
+        # see nothing
+        trailing = {f: list(v) for f, v in (trailing or {}).items()}
         feed_names = engine._feed_names
         if not feed_names:
             raise ValueError(
                 'warm(%r): the engine has no feed_names — load the '
                 'model from a save_inference_model dir, or pass '
                 'feed_names= at load()' % name)
+        unknown = sorted(set(trailing) - set(feed_names))
+        if unknown:
+            # a typo'd key would silently warm NOTHING useful while
+            # reporting served rungs
+            raise ValueError(
+                'warm(%r): trailing names %s are not feeds of this '
+                'model (feeds: %s)' % (name, unknown, sorted(feed_names)))
+        empty = sorted(f for f, extents in trailing.items()
+                       if not list(extents))
+        if empty:
+            # an empty extent list would die later on trailing[f][0]
+            # with a raw IndexError
+            raise ValueError(
+                'warm(%r): trailing extents for %s are empty — pass '
+                'at least one extent per feed' % (name, empty))
         block = engine._program.global_block()
-        served = 0
-        for rows in ladder:
-            feed = {}
-            for fname in feed_names:
-                var = block.vars[fname]
-                shape = [int(d) for d in var.shape]
-                shape[0] = int(rows)
+
+        def zero_feed(fname, rows, extent):
+            var = block.vars[fname]
+            shape = [int(d) for d in var.shape]
+            shape[0] = int(rows)
+            if getattr(var, 'lod_level', 0):
+                if extent is None:
+                    raise ValueError(
+                        'warm(%r): feed %r is a sequence (lod_level=%d) '
+                        '— pass trailing={%r: [extents]} to warm its '
+                        'seq-len rungs' % (name, fname, var.lod_level,
+                                           fname))
                 if any(d < 0 for d in shape[1:]):
+                    # the extent fills the TIME axis, not these: a seq
+                    # feed with another dynamic dim would otherwise die
+                    # inside np.zeros with a raw 'negative dimensions'
+                    # error instead of this message
                     raise ValueError(
                         'warm(%r): feed %r has a non-batch dynamic dim '
                         '%s — warm it with real traffic instead'
                         % (name, fname, var.shape))
-                feed[fname] = np.zeros(shape, dtype=var.np_dtype)
-            self.infer(name, feed, timeout=600)
-            served += 1
+                from ..fluid.lod_tensor import create_lod_tensor
+                t = int(extent)
+                rows_data = [np.zeros((t, ) + tuple(shape[1:]),
+                                      var.np_dtype).tolist()
+                             for _ in range(int(rows))]
+                return create_lod_tensor(rows_data, [[t] * int(rows)])
+            if extent is not None:
+                if len(shape) < 2:
+                    # silently dropping the extent would warm duplicate
+                    # all-zero signatures while reporting them as served
+                    # rungs — the same 'warmed nothing while reporting
+                    # rungs' failure the unknown-name check catches
+                    raise ValueError(
+                        'warm(%r): feed %r has no trailing axis '
+                        '(shape %s) — drop it from trailing='
+                        % (name, fname, var.shape))
+                axes = set(engine.trailing.ladder_axes(fname)) \
+                    if engine.trailing is not None else set()
+                if axes and axes != {1}:
+                    # flat extents substitute axis 1; a dict-form
+                    # ladder on other axes would warm signatures real
+                    # traffic never produces while reporting served
+                    # rungs
+                    raise ValueError(
+                        'warm(%r): feed %r buckets on axes %s — flat '
+                        'trailing extents warm axis 1 only; warm those '
+                        'rungs with real traffic'
+                        % (name, fname, sorted(axes)))
+                if int(var.shape[1]) >= 0:
+                    raise ValueError(
+                        'warm(%r): feed %r has a STATIC axis-1 extent '
+                        '%d — there are no axis-1 rungs to warm; drop '
+                        'it from trailing='
+                        % (name, fname, int(var.shape[1])))
+                shape[1] = int(extent)
+            if any(d < 0 for d in shape[1:]):
+                raise ValueError(
+                    'warm(%r): feed %r has a non-batch dynamic dim '
+                    '%s — warm it with real traffic instead'
+                    % (name, fname, var.shape))
+            return np.zeros(shape, dtype=var.np_dtype)
+
+        # the FULL cross-product of per-feed rungs: trailing extents
+        # correlate in real traffic, so varying one feed while pinning
+        # the others at their first extent would leave exactly the
+        # dominant multi-feed signatures cold
+        t_names = sorted(trailing)
+        combos = list(itertools.product(
+            *(list(dict.fromkeys(trailing[f])) for f in t_names)))
+        served = 0
+        for rows in ladder:
+            for combo in combos or [()]:
+                extents = dict(zip(t_names, combo))
+                feed = {fname: zero_feed(fname, rows,
+                                         extents.get(fname))
+                        for fname in feed_names}
+                self.infer(name, feed, timeout=600)
+                served += 1
         return served
 
     def _entry(self, name):

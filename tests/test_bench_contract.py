@@ -161,6 +161,39 @@ def test_flagship_configs_wired_through_run_multi():
         assert "'%s'" % key in mm_src, key
 
 
+def test_trailing_bucket_blocks_wired():
+    """ISSUE 5: the nmt/transformer configs pair their numbers with a
+    trailing_bucket block (distinct-length request streams served
+    through the trailing-bucketed engine — the helper asserts they
+    REALLY coalesce), and tools/perf_gate.py registers the trailing_dim
+    paired config with the executable-count/padding-waste deliverables.
+    Source-level pin; the functional path is covered by the nmt CPU
+    smoke below and tests/test_trailing_buckets.py."""
+    import inspect
+    import bench
+    helper = inspect.getsource(bench._trailing_bucket_block)
+    assert 'InferenceEngine' in helper
+    assert "m['lots'] < m['requests']" in helper
+    for key in ('distinct_lengths', 'executables',
+                'trailing_padding_waste', 'trailing_hits'):
+        assert "'%s'" % key in helper, key
+    for fn in (bench.bench_nmt, bench.bench_transformer):
+        src = inspect.getsource(fn)
+        assert '_trailing_bucket_block(' in src, fn.__name__
+        assert "'trailing_bucket': trailing_bucket" in src, fn.__name__
+    sys.path.insert(0, os.path.join(REPO, 'tools'))
+    try:
+        import perf_gate
+    finally:
+        sys.path.pop(0)
+    assert 'trailing_dim' in perf_gate.CONFIGS
+    src = inspect.getsource(perf_gate.run_trailing_dim)
+    for key in ('bucketed_vs_exact', 'executables_bucketed',
+                'executables_exact', 'executable_ratio',
+                'padding_waste'):
+        assert "'%s'" % key in src, key
+
+
 def test_multi_model_perf_gate_config_registered():
     """tools/perf_gate.py multi_model (ISSUE 4): two models under one
     budget, paired resident-vs-evict-reload windows.  Structural pin —
@@ -194,3 +227,10 @@ def test_nmt_cpu_smoke_is_device_true():
     assert rec['steps_per_dispatch'] == 2  # the CPU smoke step count
     _assert_feed_overlap(rec)
     assert rec['feed_overlap']['ms_per_step_overlapped'] > 0
+    # ISSUE 5: distinct-length request streams really coalesce in the
+    # trailing_bucket block (the helper asserts lots < requests)
+    tb = rec['trailing_bucket']
+    assert tb['distinct_lengths'] >= 4
+    assert tb['lots'] < tb['requests']
+    assert tb['executables'] <= tb['distinct_lengths']
+    assert 0.0 < tb['trailing_padding_waste'] < 1.0

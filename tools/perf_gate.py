@@ -9,8 +9,8 @@ throughput vs hand-rolled JAX — gated on the MAX of PER-BLOCK ratios
 max(fw)/max(bd) cross-window pairing).
 
 Run on TPU hardware:
-    python tools/perf_gate.py \
-        [resnet|transformer|nmt|resnet_infer|feed_pipeline|multi_model|all]
+    python tools/perf_gate.py [resnet|transformer|nmt|resnet_infer|
+        feed_pipeline|multi_model|trailing_dim|all]
 Prints one JSON line per config; tests/test_perf_gate.py drives it and
 skips cleanly off-TPU.  ``resnet_infer`` (ISSUE 2) has no bound side —
 its deliverable is the paired ``multi_vs_dispatch`` block: the measured
@@ -23,6 +23,12 @@ models under ONE ModelRegistry HBM budget sized for only one of them —
 the evict-reload window's latency tax is the measured cost of LRU
 weight arbitration (host demotion + re-stage + recompile per swap),
 the resident window the same registry with no arbitration pressure.
+``trailing_dim`` (ISSUE 5) pairs bucketed-vs-exact-shape serving on a
+SKEWED synthetic length distribution: the bucketed engine quantizes
+request seq-lens onto the shared TrailingDimBuckets ladder (mixed
+lengths coalesce, bounded executables), the exact engine serves every
+distinct length as its own per-shape lot/executable — the deliverable
+is the executable-count, padding-waste and throughput deltas.
 """
 
 import json
@@ -471,6 +477,130 @@ def run_multi_model():
     return rec
 
 
+def build_trailing_dim():
+    """Bucketed vs exact-shape serving on a SKEWED synthetic length
+    distribution (ISSUE 5): one padding-neutral seq scorer (masked-sum
+    pooling over the time axis, so zero-padded positions contribute
+    nothing) served through TWO engines over the same scope — the
+    BUCKETED one quantizes request seq-lens onto the shared seq-len
+    ladder (fluid.shape_policy — mixed-length requests coalesce,
+    executables bounded by the rung count), the EXACT one disables
+    trailing bucketing so every distinct length is its own per-shape
+    lot + executable (today's fragmentation, the baseline).  Requests
+    are DENSE [rows, T, dim] lots — the path where exact shapes really
+    fragment (LoD feeds already rung-quantize inside the executor's
+    lowering).  Each engine gets its own Executor so compile_count
+    isolates the executable sets."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import serving
+    from paddle_tpu.fluid import shape_policy
+
+    rows = int(os.environ.get('PERF_GATE_TD_ROWS', '8'))
+    reqs_per_window = int(os.environ.get('PERF_GATE_TD_REQS', '16'))
+    dim, classes = 64, 1000
+    # skewed: mass on short lengths, a long tail — 8 distinct lengths
+    # quantizing onto 3 ladder rungs (16, 32, 48)
+    lengths = [3, 6, 9, 12, 18, 24, 35, 45]
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = 0
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data('x', shape=[-1, dim], dtype='float32')
+        pooled = fluid.layers.reduce_sum(x, dim=1)
+        pred = fluid.layers.fc(pooled, classes, act='softmax')
+    test_prog = prog.clone(for_test=True)
+    place = fluid.TPUPlace()
+    scope = fluid.core.Scope()
+    exe0 = fluid.Executor(place)
+    with fluid.scope_guard(scope):
+        exe0.run(startup)
+
+    rng = np.random.RandomState(0)
+    streams = [
+        {'x': rng.standard_normal(
+            (rows, lengths[i % len(lengths)], dim)).astype('float32')}
+        for i in range(reqs_per_window)
+    ]
+
+    def make_engine(trailing):
+        ladder = {'x': shape_policy.seq_ladder(max(lengths))} \
+            if trailing else None
+        # ONE batch bucket + one lot per scan on BOTH sides, so the
+        # executable count isolates the TRAILING dimension: bucketed =
+        # one executable per ladder rung, exact = one per distinct
+        # request length
+        return serving.InferenceEngine(
+            test_prog, feed_names=['x'], fetch_list=[pred],
+            scope=scope, executor=fluid.Executor(place), place=place,
+            config=serving.ServingConfig(
+                max_batch_size=rows * 4, max_wait_ms=2,
+                bucket_sizes=[rows * 4], steps_per_dispatch=1,
+                trailing_buckets=trailing, trailing_ladders=ladder))
+
+    bucketed_eng = make_engine(True).start()
+    exact_eng = make_engine(False).start()
+    for eng in (bucketed_eng, exact_eng):  # warm every stream shape
+        for r in streams:
+            eng.infer(r, timeout=600)
+
+    def window(eng):
+        def run():
+            # open-loop-ish: submit the whole window, then wait — the
+            # micro-batcher coalesces same-rung mixed-length requests
+            # (the bucketed engine's whole point); the exact engine
+            # only coalesces same-shape ones
+            t0 = time.time()
+            futs = [eng.submit(r) for r in streams]
+            for f in futs:
+                out, = f.result(600)
+                assert np.isfinite(np.asarray(out)).all()
+            return len(streams) * rows / (time.time() - t0)
+        return run
+
+    return (window(bucketed_eng), window(exact_eng),
+            (bucketed_eng, exact_eng, rows, reqs_per_window))
+
+
+def run_trailing_dim():
+    """The trailing_dim record: interleaved bucketed/exact windows
+    (each ratio shares a drift window — the gates' pairing rule), plus
+    the executable-count and padding-waste deltas (the ISSUE 5
+    acceptance numbers: bucketed serving must compile at most HALF the
+    exact path's executables on the skewed stream)."""
+    bucketed, exact, (b_eng, e_eng, rows, nreq) = build_trailing_dim()
+    bu, ex = [], []
+    for _ in range(BLOCKS):
+        bu.append(bucketed())
+        ex.append(exact())
+    bm, em = b_eng.metrics(), e_eng.metrics()
+    rec = {
+        'config': 'trailing_dim',
+        'bucketed_rows_per_sec': round(max(bu), 1),
+        'exact_rows_per_sec': round(max(ex), 1),
+        'bucketed_blocks': [round(v, 1) for v in bu],
+        'exact_blocks': [round(v, 1) for v in ex],
+        # the PAIRED deliverable: throughput kept (or recovered) by
+        # coalescing mixed-length requests, per shared drift window
+        'bucketed_vs_exact': round(
+            max(b / e for b, e in zip(bu, ex)), 4),
+        # the executable-count delta: the compile budget trailing-dim
+        # bucketing buys on a length-skewed stream
+        'executables_bucketed': bm['executor_compile_count'],
+        'executables_exact': em['executor_compile_count'],
+        'executable_ratio': round(
+            bm['executor_compile_count'] /
+            max(em['executor_compile_count'], 1), 4),
+        'padding_waste': bm['trailing_padding_waste'],
+        'bucketed_lots': bm['lots'], 'exact_lots': em['lots'],
+        'requests_per_window': nreq, 'rows_per_request': rows,
+        'blocks': BLOCKS,
+    }
+    b_eng.stop()
+    e_eng.stop()
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 CONFIGS = {
     'resnet': (build_resnet, 'imgs_per_sec'),
     'transformer': (build_transformer, 'tokens_per_sec'),
@@ -478,6 +608,7 @@ CONFIGS = {
     'resnet_infer': (build_resnet_infer, 'imgs_per_sec'),
     'feed_pipeline': (build_feed_pipeline, 'imgs_per_sec'),
     'multi_model': (build_multi_model, 'imgs_per_sec'),
+    'trailing_dim': (build_trailing_dim, 'rows_per_sec'),
 }
 
 
@@ -486,6 +617,8 @@ def run_config(name):
         return run_feed_pipeline()
     if name == 'multi_model':
         return run_multi_model()
+    if name == 'trailing_dim':
+        return run_trailing_dim()
     build, unit = CONFIGS[name]
     # both sides compiled first, then INTERLEAVED blocks: a drift window
     # between two monolithic measurements would otherwise decide the
