@@ -25,6 +25,7 @@ from .parallel_executor import ParallelExecutor, ExecutionStrategy, \
     BuildStrategy
 from . import dataflow
 from .dataflow import FeedPipeline
+from . import trace
 from . import initializer
 from . import layers
 from . import nets
@@ -70,5 +71,5 @@ __all__ = framework.__all__ + executor.__all__ + [
     'regularizer', 'LoDTensor', 'CPUPlace', 'TPUPlace', 'CUDAPlace',
     'CUDAPinnedPlace', 'Tensor', 'ParamAttr', 'WeightNormParamAttr',
     'DataFeeder', 'clip', 'profiler', 'unique_name', 'flags', 'FLAGS',
-    'dataflow', 'FeedPipeline',
+    'dataflow', 'FeedPipeline', 'trace',
 ]

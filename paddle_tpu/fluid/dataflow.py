@@ -44,6 +44,7 @@ import queue as _queue
 
 from . import core
 from . import profiler as _profiler
+from . import trace as _trace
 from .executor import (prepare_feed_arrays, feed_signature, stack_steps,
                        _current_scope)
 from .framework import default_main_program, Variable
@@ -197,6 +198,11 @@ class FeedPipeline(object):
     max_open_buckets: bound on concurrently accumulating buckets; the
         least-recently-fed one flushes early as a shorter block beyond
         it (the boundary push-back generalized to bounded memory).
+    watchdog_stall_s: feed-stall threshold (seconds) for the trace
+        watchdog (ISSUE 6) — a started pipeline registers a probe over
+        how long the dispatch loop has currently been blocked on the
+        staging queue; crossing it dumps the flight recorder.  None
+        (default) registers no probe.
 
     Iterate the pipeline to drive it: each item is one dispatch's
     converted last-step fetches.  ``metrics()`` snapshots feed-stall
@@ -208,7 +214,7 @@ class FeedPipeline(object):
     def __init__(self, executor, fetch_list, program=None, reader=None,
                  source=None, steps=1, pipeline_depth=2, scope=None,
                  return_numpy=True, name=None, bucketed=False,
-                 max_open_buckets=4):
+                 max_open_buckets=4, watchdog_stall_s=None):
         if (reader is None) == (source is None):
             raise ValueError('FeedPipeline: pass reader= OR source=')
         if int(steps) < 1:
@@ -272,6 +278,15 @@ class FeedPipeline(object):
         self._closed = False
         self._thread = None
         self._started = False
+        # trace watchdog (ISSUE 6): a feed-stall probe over how long
+        # the dispatch loop has CURRENTLY been waiting on the staging
+        # queue — a stall crossing the threshold dumps the flight
+        # recorder (what the stager and the executors had in flight)
+        self.watchdog_stall_s = (float(watchdog_stall_s)
+                                 if watchdog_stall_s is not None else None)
+        self._watchdog_probe = None
+        self._watchdog_age_fn = None
+        self._waiting_since = None
         # metrics: the staging thread owns stage_*, the dispatch loop
         # owns the rest — disjoint keys, snapshot() copies
         self._m = {'blocks_staged': 0, 'stage_s': 0.0, 'stage_s_first': 0.0,
@@ -463,6 +478,13 @@ class FeedPipeline(object):
 
     # ---- dispatch loop -------------------------------------------------
 
+    def _feed_stall_age(self):
+        """Seconds the dispatch loop has been blocked on the staging
+        queue RIGHT NOW (None when it is not waiting) — the watchdog's
+        feed-stall probe."""
+        since = self._waiting_since
+        return (time.time() - since) if since is not None else None
+
     def start(self):
         if self._closed:
             raise RuntimeError('FeedPipeline is closed')
@@ -471,6 +493,23 @@ class FeedPipeline(object):
             self._thread = threading.Thread(
                 target=self._stage_loop, name=self.name, daemon=True)
             self._thread.start()
+            if self.watchdog_stall_s is not None and \
+                    self._watchdog_probe is None:
+                # weak closure + GC finalizer, like the metrics source:
+                # the global watchdog must not pin a dropped pipeline
+                import weakref
+                ref = weakref.ref(self)
+
+                def age(ref=ref):
+                    pipe = ref()
+                    return pipe._feed_stall_age() if pipe else None
+
+                self._watchdog_probe = _trace.watchdog.register(
+                    'pipeline/%s/feed_stall' % self.name, age,
+                    self.watchdog_stall_s)
+                self._watchdog_age_fn = age
+                weakref.finalize(self, _trace.watchdog.unregister,
+                                 self._watchdog_probe, age)
         return self
 
     def _ensure_placer(self, block):
@@ -511,6 +550,13 @@ class FeedPipeline(object):
             self._placer = lambda n, v, _dev=dev: jax.device_put(v, _dev)
 
     def _dispatch(self, block):
+        # the executors add their own 'multi_dispatch' flight records;
+        # this one carries the PIPELINE's view (block provenance) so a
+        # stall dump shows which source batches were in flight
+        _trace.flight_recorder.record(
+            'pipeline_dispatch', pipeline=self.name, steps=block.steps,
+            indices=list(block.indices or []),
+            trace_id=getattr(_trace.current(), 'trace_id', None))
         self._ensure_placer(block)
         if not block.placed:
             block.scanned = {n: self._placer(n, v)
@@ -552,7 +598,16 @@ class FeedPipeline(object):
         try:
             while True:
                 t0 = time.time()
-                block = self._staged.get()
+                if self._m['dispatches'] > 0:
+                    # the FIRST get is warmup (nothing to overlap with
+                    # yet) — the probe must match the feed_stall metric
+                    # semantics below, or a slow-staging first block
+                    # dumps a spurious 'stall' during normal warmup
+                    self._waiting_since = t0
+                try:
+                    block = self._staged.get()
+                finally:
+                    self._waiting_since = None
                 stall = time.time() - t0
                 if block is None:
                     # the EOF sentinel's wait delayed no dispatch — it
@@ -625,6 +680,10 @@ class FeedPipeline(object):
         # keeps the pipeline object (e.g. to read metrics())
         self._drain_staged()
         self._inflight = []
+        if self._watchdog_probe is not None:
+            _trace.watchdog.unregister(self._watchdog_probe,
+                                       self._watchdog_age_fn)
+            self._watchdog_probe = None
         _profiler.unregister_metrics_source(self._metrics_key,
                                             self._metrics_fn)
 

@@ -353,6 +353,24 @@ def convert_eval_fetches(stacked, reals, target, compiled, steps,
     return out
 
 
+def collect_cost_report(compiled_blocks):
+    """Flatten compiled blocks' captured cost entries into the
+    ``cost_report()`` list form shared by Executor and ParallelExecutor
+    (ISSUE 6): one record per analyzed executable — kind, steps, XLA
+    cost-analysis FLOPs (total and per step), bytes accessed, and the
+    memory-analysis buffer sizes.  Entries exist only for executables
+    dispatched under FLAGS_cost_accounting."""
+    out = []
+    for compiled in compiled_blocks:
+        for key, entry in compiled.cost_entries().items():
+            if entry is None:
+                continue
+            rec = dict(entry)
+            rec['key'] = repr(key)
+            out.append(rec)
+    return out
+
+
 def _reject_reader_fed(program, what):
     """The PLAIN-FEED multi paths never compose with py_reader-fed
     programs: resolving would pop exactly ONE minibatch and the K-step
@@ -654,6 +672,41 @@ class _CompiledBlock(object):
         }
         return state_rw, state_ro, feeds
 
+    # shared by every compiled block: entry inserts and cost_entries()
+    # snapshots race between the dispatch thread and a metrics/bench
+    # caller — one module lock keeps the dict copy coherent (held only
+    # around dict ops, never across the AOT analysis compile)
+    _COST_LOCK = threading.Lock()
+
+    def _capture_cost(self, kind, key, jitted, args, steps=1):
+        """Per-executable cost accounting (ISSUE 6): under
+        FLAGS_cost_accounting, AOT-analyze ``jitted`` once per cache
+        key (two racing first dispatches may both analyze; the result
+        is identical and one wins the insert) and remember XLA's own
+        FLOPs/bytes — the MFU/HBM ground truth behind
+        Executor.cost_report().  Runs BEFORE the dispatch (the abstract
+        twins never touch the soon-to-be-donated buffers); a backend
+        without cost analysis caches None and never retries."""
+        if not flags.FLAGS.cost_accounting:
+            return None
+        from . import trace as _trace
+        full_key = (kind, ) + tuple(key)
+        with self._COST_LOCK:
+            reg = getattr(self, '_cost_entries', None)
+            if reg is None:
+                reg = self._cost_entries = {}
+            if full_key in reg:
+                return reg[full_key]
+        entry = _trace.analyze_cost(jitted, args, kind=kind, steps=steps,
+                                    fetch_names=self.fetch_names)
+        with self._COST_LOCK:
+            return reg.setdefault(full_key, entry)
+
+    def cost_entries(self):
+        """This executable set's captured cost-registry entries."""
+        with self._COST_LOCK:
+            return dict(getattr(self, '_cost_entries', None) or {})
+
     def run(self, scope, feed_values, rng_key, eager=False):
         state_rw, state_ro, feeds = self._materialize_args(
             scope, feed_values, cache_ro=True)
@@ -661,6 +714,8 @@ class _CompiledBlock(object):
             new_state, fetches = self._run_eager(scope, state_rw, state_ro,
                                                  feeds, rng_key)
         else:
+            self._capture_cost('run', (), self._jit,
+                               (state_rw, state_ro, feeds, rng_key))
             new_state, fetches = self._jit(state_rw, state_ro, feeds, rng_key)
             if flags.FLAGS.check_nan_inf:
                 _check_nan_inf(list(new_state.items()), 'state var')
@@ -692,6 +747,12 @@ class _CompiledBlock(object):
             scope, feed_values, cache_ro=True)
         scanned = scanned_feeds or {}
         jitted = self._get_multi_jit(feeds, scanned)
+        self._capture_cost(
+            'multi', (tuple(sorted(feeds)), tuple(sorted(scanned)),
+                      int(steps)),
+            jitted, (state_rw, state_ro, feeds, scanned, rng_key,
+                     int(steps)),
+            steps=steps)
         new_state, fetches = jitted(state_rw, state_ro, feeds,
                                     scanned, rng_key, int(steps))
         for name, val in new_state.items():
@@ -877,6 +938,14 @@ class _CompiledBlock(object):
             scope, feed_values, cache_ro=True)
         scanned = scanned_feeds or {}
         jitted = self._get_eval_multi_jit(feeds, scanned)
+        # the serving engine reads last_eval_cost to derive achieved MFU
+        # for the dispatch it is draining
+        self.last_eval_cost = self._capture_cost(
+            'eval_multi', (tuple(sorted(feeds)), tuple(sorted(scanned)),
+                           int(steps)),
+            jitted, (state_rw, state_ro, feeds, scanned, rng_key,
+                     int(steps)),
+            steps=steps)
         new_state, stacked = jitted(state_rw, state_ro, feeds, scanned,
                                     rng_key, int(steps))
         for name, val in new_state.items():
@@ -1198,6 +1267,11 @@ class Executor(object):
         rng = self._next_rng(program)
         if compiled.note_multi_compile(steps, scanned):
             self.compile_count += 1
+        from . import trace as _trace
+        _trace.flight_recorder.record(
+            'multi_dispatch', executor='Executor', steps=int(steps),
+            fetch_names=list(compiled.fetch_names),
+            trace_id=getattr(_trace.current(), 'trace_id', None))
         fetches = compiled.run_multi(scope, {}, rng, int(steps),
                                      scanned_feeds=scanned)
         return fetches, compiled
@@ -1275,6 +1349,11 @@ class Executor(object):
         rng = self._next_rng(program)
         if compiled.note_eval_compile(steps, scanned):
             self.compile_count += 1
+        from . import trace as _trace
+        _trace.flight_recorder.record(
+            'eval_dispatch', executor='Executor', steps=int(steps),
+            fetch_names=list(compiled.fetch_names),
+            trace_id=getattr(_trace.current(), 'trace_id', None))
         stacked = compiled.run_eval_multi(scope, feed_arrays, rng, steps,
                                           scanned_feeds=scanned)
         return stacked, reals, target, compiled, steps
@@ -1336,6 +1415,15 @@ class Executor(object):
                 np.asarray(f))
 
         return [convert(f) for f in fetches]
+
+    def cost_report(self):
+        """Per-executable cost registry (ISSUE 6): every cached
+        executable's XLA cost/memory analysis captured under
+        FLAGS_cost_accounting — the ground truth behind achieved-MFU
+        serving metrics and bench.py's cost-derived MFU."""
+        with self._cache_lock:
+            blocks = list(self._cache.values())
+        return collect_cost_report(blocks)
 
     def close(self):
         """Reference Executor.Close() notifies pservers (executor.h:51); here

@@ -175,6 +175,15 @@ DEFINE_string('xla_compile_cache_dir', '',
               'child at one shared dir (override/disable via '
               'BENCH_XLA_CACHE).  Env-settable like every flag: '
               'FLAGS_xla_compile_cache_dir=/path.  Empty disables.')
+DEFINE_bool('cost_accounting', False,
+            'Capture XLA cost_analysis FLOPs + memory_analysis bytes '
+            'for every executable the executors dispatch '
+            '(fluid.trace.analyze_cost -> Executor.cost_report()): the '
+            'per-executable ground truth behind achieved-MFU serving '
+            'metrics and bench.py MFU.  Off by default — the AOT '
+            'analysis compile does not share the jit call cache, so '
+            'capture costs one extra XLA compile per executable '
+            '(amortized by FLAGS_xla_compile_cache_dir).')
 DEFINE_string('fused_lstm', 'auto',
               "lstm-op recurrence impl: 'auto' picks the fused Pallas "
               "cell kernel (ops/pallas/lstm.py) when the shape profile "

@@ -13,6 +13,8 @@ BuildStrategy/ExecutionStrategy are accepted for API parity
 ('kReduce') maps to GSPMD's own choice of collectives.
 """
 
+import threading
+
 import numpy as np
 
 from . import core
@@ -353,6 +355,11 @@ class ParallelExecutor(object):
         self._mesh = mesh if mesh is not None else make_mesh()
         self._loss_name = loss_name
         self._cache = {}
+        # guards cache iteration/mutation between the dispatch thread
+        # and metrics/bench readers (cost_report) — and the engine's
+        # drop_executables purge path picks it up by name, like the
+        # Executor's (PR 4 concurrent-predictor contract)
+        self._cache_lock = threading.Lock()
         self._rng = None
         self.exec_strategy = exec_strategy or ExecutionStrategy()
         self.build_strategy = build_strategy or BuildStrategy()
@@ -424,7 +431,8 @@ class ParallelExecutor(object):
         sig = feed_signature(feed_arrays)
         key = (id(program), program._version, tuple(fetch_names), sig,
                registry.amp_enabled())
-        compiled = self._cache.get(key)
+        with self._cache_lock:
+            compiled = self._cache.get(key)
         if compiled is None:
             host = [op.type for op in program.global_block().ops
                     if _is_host_op(op)]
@@ -443,7 +451,8 @@ class ParallelExecutor(object):
             compiled._batch_feed_names = (
                 frozenset(batch_feed_names)
                 if batch_feed_names is not None else None)
-            self._cache[key] = compiled
+            with self._cache_lock:
+                self._cache[key] = compiled
         return compiled
 
     def _convert_fetches(self, fetches, return_numpy, real=0, padded=0,
@@ -572,6 +581,11 @@ class ParallelExecutor(object):
         run_multi's feed_list path."""
         fetch_names = self._fetch_names(fetch_list)
         compiled = self._resolve(fetch_names, sig_feed, batch_feed_names)
+        from . import trace as _trace
+        _trace.flight_recorder.record(
+            'multi_dispatch', executor='ParallelExecutor',
+            steps=int(steps), fetch_names=list(compiled.fetch_names),
+            trace_id=getattr(_trace.current(), 'trace_id', None))
         fetches = compiled.run_multi(self._scope, {}, self._next_rng(),
                                      int(steps), scanned_feeds=scanned)
         if compiled.note_multi_compile(steps, scanned):
@@ -636,6 +650,11 @@ class ParallelExecutor(object):
             compiled = self._resolve(fetch_names, feed_arrays,
                                      rpt.get('batch_names'))
         rng = self._next_rng()
+        from . import trace as _trace
+        _trace.flight_recorder.record(
+            'eval_dispatch', executor='ParallelExecutor',
+            steps=int(steps), fetch_names=list(compiled.fetch_names),
+            trace_id=getattr(_trace.current(), 'trace_id', None))
         stacked = compiled.run_eval_multi(self._scope, feed_arrays, rng,
                                           steps, scanned_feeds=scanned)
         if compiled.note_eval_compile(steps, scanned):
@@ -662,6 +681,15 @@ class ParallelExecutor(object):
             reader=reader)
         return convert_eval_fetches(stacked, reals, target, compiled, k,
                                     return_numpy)
+
+    def cost_report(self):
+        """Per-executable cost registry (ISSUE 6), the SPMD twin of
+        Executor.cost_report(): every cached sharded executable's XLA
+        cost/memory analysis captured under FLAGS_cost_accounting."""
+        from .executor import collect_cost_report
+        with self._cache_lock:
+            blocks = list(self._cache.values())
+        return collect_cost_report(blocks)
 
     def bcast_params(self):
         """Reference BCastParamsToDevices (parallel_executor.cc:169) — a

@@ -14,6 +14,8 @@ import threading
 import time
 from collections import defaultdict
 
+from . import trace as _trace
+
 __all__ = [
     'cuda_profiler', 'reset_profiler', 'profiler', 'start_profiler',
     'stop_profiler',
@@ -121,12 +123,19 @@ def is_profiler_enabled():
 
 
 def record_event(name, seconds, start=None):
-    if _profiler_state['enabled']:
+    enabled = _profiler_state['enabled']
+    if not enabled and not _trace.spans_enabled():
+        return  # the hot path stays one dict lookup when both are off
+    start_t = (time.time() - seconds) if start is None else start
+    # mirror into the trace span log (no-op outside a trace.tracing()
+    # window): every profiler event — executor runs, pipeline staging,
+    # serving dispatches — lands in the Chrome-trace exporter's
+    # per-thread lanes without a second instrumentation pass (ISSUE 6)
+    _trace.record_span(name, start_t, seconds)
+    if enabled:
         with _record_lock:
             _profiler_state['events'][name].append(seconds)
-            _profiler_state['timeline'].append(
-                (name, (time.time() - seconds) if start is None else start,
-                 seconds))
+            _profiler_state['timeline'].append((name, start_t, seconds))
 
 
 @contextlib.contextmanager

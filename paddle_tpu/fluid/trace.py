@@ -1,0 +1,430 @@
+"""Request-level tracing, per-executable cost accounting, and the
+flight recorder (ISSUE 6).
+
+The stack spans five concurrent layers (micro-batcher, trailing-dim
+buckets, registry/arbiter, FeedPipeline staging threads, multi-step
+scan dispatch) but observability stopped at aggregate wall-clock spans
+and p50/p99 — nobody could answer "where did THIS request's 40 ms go"
+or "what was in flight when the worker stalled".  The reference's
+profiler/timeline tooling was exactly this layer over the Executor;
+this module is its TPU-native counterpart, three legs:
+
+  1. **span contexts** — a ``TraceContext`` carries one trace id from
+     the registry router / ``submit()`` across threads and layers
+     (submit thread -> micro-batch queue -> worker -> drain), marking
+     absolute stage boundaries so ``finalize()`` yields a per-request
+     breakdown (arbitration / queue / pad / dispatch / device / trim)
+     whose stages sum to the measured end-to-end latency.  The ambient
+     ``attach()``/``current()`` pair hands a context across an API
+     boundary (the ModelRegistry attaches before calling
+     ``engine.submit``) without widening every signature.  A bounded
+     span log (``record_span`` inside a ``tracing()`` window) feeds the
+     Chrome trace-event exporter (tools/trace_export.py) one lane per
+     thread.
+
+  2. **cost registry** — ``analyze_cost`` AOT-lowers a jitted callable
+     with abstract (ShapeDtypeStruct) twins of its real arguments and
+     extracts XLA's own ``cost_analysis()`` FLOPs + ``memory_analysis``
+     bytes: the per-executable ground truth that replaces hand-derived
+     MFU math (bench.py) and cross-checks the HBM arbiter's accounts.
+     Gated by ``FLAGS_cost_accounting`` because the AOT compile does
+     NOT share the jit call's executable cache — capture costs one
+     extra XLA compile per executable (amortized by the persistent
+     compile cache when FLAGS_xla_compile_cache_dir is set).
+
+  3. **flight recorder** — a bounded ring of the last N dispatch/lot
+     records (trace ids, signatures, shapes, timings) that ``dump()``s
+     on worker error or when the ``watchdog`` trips a registered stall
+     probe (queue age / feed-stall thresholds) — the post-mortem a
+     stalled serving worker otherwise takes to its grave.
+"""
+
+import contextlib
+import itertools
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+
+__all__ = [
+    'TraceContext', 'STAGES', 'new_trace_id', 'attach', 'current',
+    'tracing', 'record_span', 'spans', 'clear_spans', 'dump_spans',
+    'FlightRecorder', 'flight_recorder', 'Watchdog', 'watchdog',
+    'analyze_cost',
+]
+
+# canonical per-request stages, in pipeline order: arbitration (the
+# registry's residency gate, pre-enqueue), queue (enqueue -> lot
+# collection), pad (request prepare + lot padding), dispatch (lot ready
+# -> device dispatch issued, incl. carry/gate waits), device (dispatch
+# -> host sync), trim (sync -> per-request slice delivered)
+STAGES = ('arbitration', 'queue', 'pad', 'dispatch', 'device', 'trim')
+
+_ids = itertools.count(1)
+_id_lock = threading.Lock()
+
+
+def new_trace_id():
+    with _id_lock:
+        return 'tr-%06d' % next(_ids)
+
+
+class TraceContext(object):
+    """One request's trace: an id, absolute stage-boundary marks, and
+    pre-accumulated stage seconds (stages measured where they happen —
+    the registry's arbitration window, the submit path's prepare —
+    before the boundary marks take over).  Thread-crossing is the
+    point: the submit thread marks 'enqueue', the worker marks
+    'collect'/'lot'/'dispatch', the drain marks 'sync', and
+    ``finalize()`` (at delivery) turns the marks into the breakdown."""
+
+    __slots__ = ('trace_id', 't0', 'marks', 'stage_s', 'e2e_s')
+
+    def __init__(self, trace_id=None):
+        self.trace_id = trace_id or new_trace_id()
+        self.t0 = time.time()
+        self.marks = {}
+        self.stage_s = {}
+        self.e2e_s = None
+
+    def add_stage(self, stage, seconds):
+        """Accumulate seconds measured outside the mark chain (e.g.
+        'arbitration' by the registry, the prepare half of 'pad')."""
+        self.stage_s[stage] = self.stage_s.get(stage, 0.0) + float(seconds)
+
+    def mark(self, name, t=None):
+        self.marks[name] = time.time() if t is None else t
+
+    def finalize(self, end=None):
+        """Close the trace: derive the boundary-mark stages and the
+        end-to-end wall clock.  Robust to missing marks (an errored
+        request finalizes with whatever boundaries it reached)."""
+        end = time.time() if end is None else end
+        m = self.marks
+
+        def seg(a, b):
+            return max(m[b] - m[a], 0.0) if a in m and b in m else 0.0
+
+        self.add_stage('queue', seg('enqueue', 'collect'))
+        self.add_stage('pad', seg('collect', 'lot'))
+        self.add_stage('dispatch', seg('lot', 'dispatch'))
+        self.add_stage('device', seg('dispatch', 'sync'))
+        if 'sync' in m:
+            self.add_stage('trim', max(end - m['sync'], 0.0))
+        self.e2e_s = end - self.t0
+        return self.stage_s
+
+    def breakdown(self):
+        """The response-surface view: trace id, end-to-end ms, and the
+        per-stage ms in canonical order (only stages that occurred)."""
+        return {
+            'trace_id': self.trace_id,
+            'e2e_ms': (round(self.e2e_s * 1e3, 3)
+                       if self.e2e_s is not None else None),
+            'stages_ms': {s: round(self.stage_s[s] * 1e3, 3)
+                          for s in STAGES if s in self.stage_s},
+        }
+
+
+# ---- ambient context (cross-layer handoff) ----------------------------
+
+_ambient = threading.local()
+
+
+@contextlib.contextmanager
+def attach(ctx):
+    """Make ``ctx`` the calling thread's ambient trace for the block —
+    the registry router attaches before engine.submit() so the engine
+    threads the SAME trace id instead of minting a new one."""
+    prev = getattr(_ambient, 'ctx', None)
+    _ambient.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _ambient.ctx = prev
+
+
+def current():
+    return getattr(_ambient, 'ctx', None)
+
+
+# ---- span log (the Chrome exporter's source) --------------------------
+
+_SPAN_CAP = 8192
+_span_lock = threading.Lock()
+_span_log = deque(maxlen=_SPAN_CAP)
+_span_state = {'enabled': 0}
+
+
+def spans_enabled():
+    return _span_state['enabled'] > 0
+
+
+@contextlib.contextmanager
+def tracing():
+    """Enable span capture for the block (nested windows stack); spans
+    from a previous window are cleared on the OUTERMOST entry so each
+    session exports its own record."""
+    with _span_lock:
+        if _span_state['enabled'] == 0:
+            _span_log.clear()
+        _span_state['enabled'] += 1
+    try:
+        yield
+    finally:
+        with _span_lock:
+            _span_state['enabled'] -= 1
+
+
+def record_span(name, start_s, dur_s, trace_id=None, lane=None):
+    """One timed slice in the span log; ``lane`` defaults to the
+    CURRENT thread's name — spans land in per-thread lanes, which is
+    exactly how the Chrome exporter renders them."""
+    if not spans_enabled():
+        return
+    span = {
+        'name': name,
+        'start_s': float(start_s),
+        'dur_s': float(dur_s),
+        'lane': lane or threading.current_thread().name,
+    }
+    if trace_id is not None:
+        span['trace_id'] = trace_id
+    with _span_lock:
+        _span_log.append(span)
+
+
+def spans():
+    with _span_lock:
+        return list(_span_log)
+
+
+def clear_spans():
+    with _span_lock:
+        _span_log.clear()
+
+
+def dump_spans(path):
+    """Write the span log as the JSON file tools/trace_export.py
+    consumes; returns the span count."""
+    snapshot = spans()
+    with open(path, 'w') as f:
+        json.dump({'spans': snapshot}, f)
+    return len(snapshot)
+
+
+# ---- flight recorder --------------------------------------------------
+
+class FlightRecorder(object):
+    """Bounded ring of recent dispatch/lot records.  Layers ``record``
+    one small dict per dispatch (trace ids, sig, shape, timings);
+    ``dump`` snapshots the ring on a worker error or a watchdog-tripped
+    stall — the records ARE what was in flight.  ``last_dump`` keeps
+    the most recent dump in memory (tests and post-mortems read it);
+    ``dump_path`` (or the PADDLE_TPU_FLIGHT_DUMP env var) additionally
+    writes each dump as JSON."""
+
+    def __init__(self, capacity=256):
+        self._lock = threading.Lock()
+        self._records = deque(maxlen=int(capacity))
+        self.last_dump = None
+        self.dump_count = 0
+        self.dump_path = None
+
+    def record(self, kind, **fields):
+        rec = dict(fields)
+        rec['kind'] = kind
+        rec['ts'] = time.time()
+        with self._lock:
+            self._records.append(rec)
+        return rec
+
+    def records(self):
+        with self._lock:
+            return list(self._records)
+
+    def clear(self):
+        with self._lock:
+            self._records.clear()
+
+    def dump(self, reason, **extra):
+        dump = {
+            'reason': reason,
+            'ts': time.time(),
+            'extra': extra,
+            'records': self.records(),
+        }
+        with self._lock:
+            self.last_dump = dump
+            self.dump_count += 1
+        path = self.dump_path or os.environ.get('PADDLE_TPU_FLIGHT_DUMP')
+        if path:
+            try:
+                with open(path, 'w') as f:
+                    json.dump(dump, f, default=repr)
+            except OSError:
+                pass  # a read-only fs must not mask the original error
+        logging.getLogger('paddle_tpu').error(
+            'flight recorder dump (%s): %d in-flight records',
+            reason, len(dump['records']))
+        return dump
+
+
+flight_recorder = FlightRecorder()
+
+
+# ---- watchdog ---------------------------------------------------------
+
+class Watchdog(object):
+    """Threshold probes over subsystem ages (oldest queued request,
+    current feed stall).  A probe whose age crosses its threshold trips
+    ONCE per stall episode (re-arming when the age drops back), dumping
+    the flight recorder with the probe's name as the reason.  The
+    polling thread starts with the first registration and exits with
+    the last unregistration; ``check()`` runs one sweep synchronously
+    (deterministic for tests)."""
+
+    def __init__(self, interval_s=1.0):
+        self.interval_s = float(interval_s)
+        self._lock = threading.Lock()
+        self._probes = {}  # name -> [age_fn, threshold_s, tripped]
+        self._thread = None
+        self._stop = threading.Event()
+
+    def register(self, name, age_fn, threshold_s, context_fn=None):
+        """Returns the KEY the probe landed under — a name already held
+        by a live probe is uniquified (``name#2``, ...) instead of
+        silently clobbered (two same-named engines must BOTH keep their
+        stall monitoring; the profiler's metrics sources learned this
+        the hard way).  Callers unregister by the returned key.
+
+        ``context_fn`` (optional, zero-arg) is called when the probe
+        trips and its result lands in the dump — the subsystem's own
+        "what was in flight" view (e.g. the serving engine's queued +
+        undrained trace ids), which the generic ring may not hold for
+        work that stalled BEFORE dispatching."""
+        with self._lock:
+            key, n = name, 1
+            while key in self._probes:
+                n += 1
+                key = '%s#%d' % (name, n)
+            self._probes[key] = [age_fn, float(threshold_s), False,
+                                 context_fn]
+            if self._thread is None:
+                self._stop = threading.Event()
+                self._thread = threading.Thread(
+                    target=self._loop, name='trace-watchdog', daemon=True)
+                self._thread.start()
+        return key
+
+    def unregister(self, name, age_fn=None):
+        """Drop a probe by its registered key.  Pass ``age_fn`` to make
+        the removal owner-checked: a stale GC finalizer whose key has
+        since been re-registered by a NEW subsystem must not kill the
+        survivor's monitoring."""
+        with self._lock:
+            if age_fn is not None and name in self._probes and \
+                    self._probes[name][0] is not age_fn:
+                return
+            self._probes.pop(name, None)
+            if not self._probes and self._thread is not None:
+                self._stop.set()
+                self._thread = None
+
+    def check(self):
+        """One sweep; returns the names that tripped this sweep."""
+        with self._lock:
+            probes = list(self._probes.items())
+        tripped = []
+        for name, state in probes:
+            age_fn, threshold, was_tripped, context_fn = state
+            try:
+                age = age_fn()
+            except Exception:
+                continue  # a dying subsystem must not kill the watchdog
+            if age is None:
+                # nothing aging IS recovery (a drained queue, an idle
+                # dispatch loop): re-arm, or a second stall episode
+                # whose first observed age already exceeds the
+                # threshold would never dump
+                state[2] = False
+                continue
+            if age >= threshold and not was_tripped:
+                state[2] = True
+                tripped.append(name)
+                extra = {}
+                if context_fn is not None:
+                    try:
+                        extra = dict(context_fn() or {})
+                    except Exception:
+                        pass  # the stalled subsystem may be half-dead
+                flight_recorder.dump('stall:%s' % name,
+                                     age_s=round(float(age), 3),
+                                     threshold_s=threshold, **extra)
+            elif age < threshold:
+                state[2] = False
+        return tripped
+
+    def _loop(self):
+        stop = self._stop
+        while not stop.wait(self.interval_s):
+            self.check()
+
+
+watchdog = Watchdog()
+
+
+# ---- per-executable cost accounting -----------------------------------
+
+def _abstract(x):
+    """A ShapeDtypeStruct twin of an array leaf; non-array leaves
+    (static ints like the scan's step count) pass through untouched so
+    jit's static_argnums still see their concrete values."""
+    import jax
+    shape = getattr(x, 'shape', None)
+    dtype = getattr(x, 'dtype', None)
+    if shape is None or dtype is None or callable(shape):
+        return x
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def analyze_cost(jitted, args, kind='run', steps=1, fetch_names=None):
+    """AOT-lower ``jitted`` with abstract twins of ``args`` and extract
+    the compiled executable's XLA cost/memory analyses.  Returns the
+    cost-registry entry dict, or None when the backend exposes no
+    analysis (the caller caches the outcome either way — analysis runs
+    at most once per executable).
+
+    The abstract twins never touch the real buffers, so capture is safe
+    to run BEFORE a dispatch whose arguments will be donated."""
+    import jax
+    try:
+        a_args = jax.tree_util.tree_map(_abstract, args)
+        compiled = jitted.lower(*a_args).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    steps = max(int(steps), 1)
+    flops = float((ca or {}).get('flops', 0.0))
+    entry = {
+        'kind': kind,
+        'steps': steps,
+        'fetch_names': list(fetch_names or []),
+        'flops': flops,
+        'flops_per_step': flops / steps,
+        'bytes_accessed': float((ca or {}).get('bytes accessed', 0.0)),
+    }
+    if ma is not None:
+        entry.update({
+            'argument_bytes': int(getattr(ma, 'argument_size_in_bytes', 0)),
+            'output_bytes': int(getattr(ma, 'output_size_in_bytes', 0)),
+            'temp_bytes': int(getattr(ma, 'temp_size_in_bytes', 0)),
+            'generated_code_bytes': int(
+                getattr(ma, 'generated_code_size_in_bytes', 0)),
+        })
+    return entry

@@ -32,6 +32,7 @@ under the victim engine's ``paused()`` window.
 
 import collections
 import threading
+import time
 
 __all__ = ['HBMArbiter', 'HBMBudgetError', 'program_seed_bytes']
 
@@ -86,6 +87,7 @@ class HBMArbiter(object):
         self.evictions = 0
         self.reloads = 0
         self.admission_rejects = 0
+        self.last_audit = None
 
     def set_budget(self, budget_bytes):
         """Re-point the budget (tightening it does NOT evict eagerly —
@@ -186,6 +188,38 @@ class HBMArbiter(object):
             acct = self._accounts.get(name)
             return bool(acct is not None and acct.resident)
 
+    def audit(self, live_bytes=None):
+        """Cross-check the ledger against the runtime's OWN buffer
+        stats (the ROADMAP's carried-over ``jax.live_arrays()`` item):
+        ``live_bytes`` defaults to the byte sum of every live
+        device-resident jax.Array in the process.  The drift —
+        live minus accounted-resident — is the metric: a ledger
+        matching reality sits near the transient feed/fetch buffer
+        noise; a leak (an evicted model whose buffers never moved, an
+        account stuck on a stale seed) walks away from zero.  The
+        result is kept as ``last_audit`` and rides ``snapshot()`` /
+        ``registry.metrics()``."""
+        if live_bytes is None:
+            import jax
+            live_bytes = 0
+            for arr in jax.live_arrays():
+                try:
+                    if arr.is_deleted():
+                        continue
+                    live_bytes += int(arr.nbytes)
+                except Exception:
+                    continue  # a donated/invalidated array mid-walk
+        with self._lock:
+            accounted = self.resident_bytes()
+            audit = {
+                'live_bytes': int(live_bytes),
+                'accounted_bytes': int(accounted),
+                'drift_bytes': int(live_bytes) - int(accounted),
+                'ts': time.time(),
+            }
+            self.last_audit = audit
+        return dict(audit)
+
     def snapshot(self):
         with self._lock:
             return {
@@ -194,6 +228,8 @@ class HBMArbiter(object):
                 'evictions': self.evictions,
                 'reloads': self.reloads,
                 'admission_rejects': self.admission_rejects,
+                'audit': (dict(self.last_audit)
+                          if self.last_audit else None),
                 'accounts': {
                     n: {'bytes': a.bytes, 'resident': a.resident,
                         'source': a.source}

@@ -35,19 +35,36 @@ class InferenceRequest(object):
     real extent ({padded_T: real_T}, axis 1) when the engine's
     trailing-dim ladder padded the request's seq/resolution dims up to
     a rung — the deliver path trims per-request fetches back to the
-    real extents (engine._drain_one)."""
+    real extents (engine._drain_one).
 
-    def __init__(self, feed, rows, sig, return_numpy=True, trailing=None):
+    ``trace`` is the request's TraceContext (fluid.trace): the engine
+    threads ONE trace id from submit() through the micro-batch lot,
+    dispatch, device sync and per-request trim, so a delivered request
+    answers "where did my latency go" via ``breakdown()``."""
+
+    def __init__(self, feed, rows, sig, return_numpy=True, trailing=None,
+                 trace=None):
         self.feed = feed
         self.rows = rows  # None for unbatchable (LoD / scalar) feeds
         self.sig = sig
         self.trailing = trailing or None
         self.return_numpy = return_numpy
+        self.trace = trace
         self.enqueue_t = time.time()
         self.latency_s = None
         self._event = threading.Event()
         self._result = None
         self._error = None
+
+    @property
+    def trace_id(self):
+        return self.trace.trace_id if self.trace is not None else None
+
+    def breakdown(self):
+        """The per-request stage breakdown (trace id, end-to-end ms,
+        stage ms in pipeline order) — populated at delivery; None for a
+        request created without a trace context."""
+        return self.trace.breakdown() if self.trace is not None else None
 
     def done(self):
         return self._event.is_set()
@@ -89,6 +106,21 @@ class MicroBatcher(object):
     def pending_rows(self):
         with self._cond:
             return sum(r.rows or 1 for r in self._pending)
+
+    def oldest_age(self):
+        """Age (seconds) of the oldest queued request; None when empty.
+        The trace watchdog's queue-age stall probe reads this — a
+        request aging far past max_wait means the worker is stuck."""
+        with self._cond:
+            if not self._pending:
+                return None
+            return time.time() - self._pending[0].enqueue_t
+
+    def pending_trace_ids(self):
+        """Trace ids of every queued request — the stall dump's view of
+        work stuck BEFORE any dispatch record could enter the ring."""
+        with self._cond:
+            return [r.trace_id for r in self._pending]
 
     def submit(self, request):
         with self._cond:

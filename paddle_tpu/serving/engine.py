@@ -44,6 +44,7 @@ import numpy as np
 
 from ..fluid import core
 from ..fluid import profiler as _profiler
+from ..fluid import trace as _trace
 from ..fluid.executor import Executor, feed_signature, _is_host_op, \
     fetch_batch_led, prepare_feed_arrays
 from ..ops.registry import SEQLEN_SUFFIX, ROWS_SUFFIX, SAMPLE_MASK_NAME
@@ -91,13 +92,18 @@ class ServingConfig(object):
         detection inputs) — opting in asserts that.
     max_trailing_buckets: bound on the active trailing set (LRU
         accounting, like max_buckets for the batch ladder).
+    watchdog_stall_s: queue-age stall threshold (seconds) for the
+        trace watchdog (ISSUE 6) — a started engine registers a probe
+        over its oldest queued request's age; crossing the threshold
+        dumps the flight recorder (the post-mortem of a stuck worker).
+        None (default) registers no probe.
     """
 
     def __init__(self, max_batch_size=32, max_wait_ms=5.0,
                  steps_per_dispatch=4, pipeline_depth=2,
                  bucket_sizes=None, max_buckets=16,
                  trailing_buckets=True, trailing_ladders=None,
-                 max_trailing_buckets=32):
+                 max_trailing_buckets=32, watchdog_stall_s=None):
         if int(steps_per_dispatch) < 1:
             raise ValueError('steps_per_dispatch must be >= 1')
         if int(pipeline_depth) < 1:
@@ -123,6 +129,8 @@ class ServingConfig(object):
         self.trailing_buckets = bool(trailing_buckets)
         self.trailing_ladders = trailing_ladders
         self.max_trailing_buckets = int(max_trailing_buckets)
+        self.watchdog_stall_s = (float(watchdog_stall_s)
+                                 if watchdog_stall_s is not None else None)
 
 
 class _Lot(object):
@@ -202,6 +210,7 @@ class InferenceEngine(object):
                                      self.config.max_wait_s)
         self._metrics = EngineMetrics()
         self._inflight = deque()
+        self._last_sync_t = 0.0  # previous drain's sync, clips MFU windows
         self._carry = deque()  # flushed lots awaiting a matching block
         self._inline_lock = threading.Lock()
         # the pause gate: the worker holds it for exactly one
@@ -217,6 +226,8 @@ class InferenceEngine(object):
         self._thread = None
         self._closed = False
         self._warned_unsliced = False
+        self._watchdog_probe = None
+        self._watchdog_age_fn = None
         with _ENGINE_SEQ_LOCK:
             _ENGINE_SEQ[0] += 1
             seq = _ENGINE_SEQ[0]
@@ -272,7 +283,50 @@ class InferenceEngine(object):
             self._thread = threading.Thread(
                 target=self._serve_loop, name=self.name, daemon=True)
             self._thread.start()
+            if self.config.watchdog_stall_s is not None and \
+                    self._watchdog_probe is None:
+                # a queued request aging past the threshold means the
+                # worker is stuck — dump what was in flight before the
+                # stall takes it to its grave.  WEAK closures, like the
+                # metrics source: the global watchdog must not pin a
+                # dropped engine (and its scope's device buffers) alive
+                ref = weakref.ref(self)
+
+                def age(ref=ref):
+                    eng = ref()
+                    return eng._batcher.oldest_age() if eng else None
+
+                def ctx(ref=ref):
+                    eng = ref()
+                    return eng._stall_context() if eng else None
+
+                self._watchdog_probe = _trace.watchdog.register(
+                    'serving/%s/queue_age' % self.name, age,
+                    self.config.watchdog_stall_s, context_fn=ctx)
+                self._watchdog_age_fn = age
+                # a started engine dropped without stop(): the probe
+                # unregisters at GC (owner-checked — the key may have
+                # been reused by a successor by then)
+                weakref.finalize(self, _trace.watchdog.unregister,
+                                 self._watchdog_probe, age)
         return self
+
+    def _stall_context(self):
+        """The stall dump's in-flight view: trace ids still queued (a
+        stuck worker never dispatched them, so the ring has no record)
+        plus those dispatched but not yet drained."""
+        inflight = []
+        try:
+            for _, lots, _, _, _, _ in list(self._inflight):
+                for lot in lots:
+                    inflight.extend(r.trace_id for r in lot.requests)
+        except RuntimeError:
+            # a drain mutated the deque mid-snapshot (the watchdog
+            # thread races the worker); the queued ids below are
+            # independent and must still make the dump
+            pass
+        return {'queued_trace_ids': self._batcher.pending_trace_ids(),
+                'inflight_trace_ids': inflight}
 
     def stop(self):
         """Drain the queue and all in-flight dispatches, then join."""
@@ -285,6 +339,10 @@ class InferenceEngine(object):
             self._thread = None
         else:
             self._drain_inline()
+        if self._watchdog_probe is not None:
+            _trace.watchdog.unregister(self._watchdog_probe,
+                                       self._watchdog_age_fn)
+            self._watchdog_probe = None
         _profiler.unregister_metrics_source(self._metrics_key,
                                             self._metrics_fn)
 
@@ -335,9 +393,9 @@ class InferenceEngine(object):
                 continue
             # the purge must exclude concurrent resolves: another model
             # sharing this executor may be between its cache get() and
-            # move_to_end() on another thread (the lock Executor added
-            # for the concurrent-predictor contract; PE has none — its
-            # cache is per-PE and engines never share one)
+            # move_to_end() on another thread (both executors expose
+            # _cache_lock — Executor's from the concurrent-predictor
+            # contract, ParallelExecutor's from the cost-registry work)
             lock = getattr(runner, '_cache_lock', None)
             with lock if lock is not None else contextlib.nullcontext():
                 for k in [k for k in list(cache) if k[0] == pid]:
@@ -400,10 +458,21 @@ class InferenceEngine(object):
                     'feed names %s do not match the inference program '
                     '(missing %s, unexpected %s)' %
                     (sorted(feed), sorted(missing), sorted(extra)))
+        # ONE trace id per request (ISSUE 6): adopt the ambient context
+        # when a router (the ModelRegistry) attached one — its
+        # arbitration seconds are already accumulated on it — else mint
+        # a fresh one here.  The prepare half of 'pad' (LoD lowering,
+        # trailing-rung padding) happens on THIS thread before the
+        # request ever queues, so it is measured here; the lot-padding
+        # half accrues between the worker's collect/lot marks.
+        ctx = _trace.current() or _trace.TraceContext()
+        t_prep = time.time()
         feed, rows, sig, trims = self._prepare_request(feed)
+        ctx.add_stage('pad', time.time() - t_prep)
         req = InferenceRequest(feed, rows, sig, return_numpy=return_numpy,
-                               trailing=trims)
+                               trailing=trims, trace=ctx)
         self._metrics.note_request(rows or 1)
+        ctx.mark('enqueue')
         self._batcher.submit(req)
         if self._thread is None:
             self._drain_inline()
@@ -593,8 +662,14 @@ class InferenceEngine(object):
         return trims or None
 
     def _make_lot(self, requests):
-        if _profiler.is_profiler_enabled():
-            now = time.time()
+        now = time.time()
+        for r in requests:
+            if r.trace is not None:
+                r.trace.mark('collect', now)
+        if _profiler.is_profiler_enabled() or _trace.spans_enabled():
+            # a tracing()-only window gets these spans too — the
+            # documented contract is that every profiler event mirrors
+            # into the span log, profiler running or not
             for r in requests:
                 _profiler.record_event(self._spans + 'queue_wait',
                                        now - r.enqueue_t,
@@ -606,6 +681,8 @@ class InferenceEngine(object):
             # metrics (real == bucket rows, so the fill ratio is
             # unaffected) or capacity math reads 'served nothing'
             self._metrics.note_lot(1, 1, deadline_flush=False)
+            if head.trace is not None:
+                head.trace.mark('lot')
             return _Lot(requests, dict(head.feed), None, None,
                         ('nobatch', id(head)))
         rows = sum(r.rows for r in requests)
@@ -628,6 +705,10 @@ class InferenceEngine(object):
             feed, 1, target=bucket, force_mask=True, batch_names=names)
         deadline_flush = rows < self.config.max_batch_size
         self._metrics.note_lot(real, target, deadline_flush)
+        t_lot = time.time()
+        for r in requests:
+            if r.trace is not None:
+                r.trace.mark('lot', t_lot)
         return _Lot(requests, feed, real, target,
                     (target, feed_signature(feed)))
 
@@ -643,6 +724,14 @@ class InferenceEngine(object):
         t0 = time.time()
         runner = self._pe if self._pe is not None else self._exe
         before = runner.compile_count
+        trace_ids = [r.trace_id for lot in lots for r in lot.requests]
+        # the flight recorder's lot record goes in BEFORE the dispatch:
+        # when the dispatch itself wedges or errors, the dump must show
+        # what was being dispatched, not just what already succeeded
+        _trace.flight_recorder.record(
+            'serving_dispatch', engine=self.name, lots=len(lots),
+            bucket=lots[0].bucket, sig=repr(lots[0].sig)[:128],
+            rows=[lot.real for lot in lots], trace_ids=trace_ids)
         try:
             with self._gated():
                 if self._pe is not None:
@@ -658,12 +747,24 @@ class InferenceEngine(object):
                             fetch_list=self._fetch_list, scope=self._scope)
         except Exception as exc:
             self._metrics.note_error()
+            _trace.flight_recorder.dump(
+                'worker_error:%s' % self.name, error=repr(exc),
+                trace_ids=trace_ids)
             for lot in lots:
                 for req in lot.requests:
                     req.set_error(exc)
             return
         self._metrics.note_dispatch(k, runner.compile_count - before)
-        self._inflight.append((stacked, lots, compiled, t0))
+        t_disp = time.time()
+        for lot in lots:
+            for req in lot.requests:
+                if req.trace is not None:
+                    req.trace.mark('dispatch', t_disp)
+        # snapshot the per-dispatch cost entry NOW: a later dispatch on
+        # the same compiled block overwrites last_eval_cost before this
+        # one drains (FIFO drain, pipeline_depth > 1 in flight)
+        cost = getattr(compiled, 'last_eval_cost', None)
+        self._inflight.append((stacked, lots, compiled, t0, t_disp, cost))
 
     def _dispatch_eager(self, lots):
         """Per-lot exe.run for host-op programs (save/print/readers):
@@ -674,6 +775,11 @@ class InferenceEngine(object):
             t0 = time.time()
             req = lot.requests[0]  # eager lots are single-request
             before = self._exe.compile_count
+            if req.trace is not None:
+                req.trace.mark('dispatch', t0)
+            _trace.flight_recorder.record(
+                'serving_dispatch', engine=self.name, lots=1, eager=True,
+                trace_ids=[req.trace_id])
             try:
                 with self._gated():
                     outs = self._exe.run(self._program, feed=lot.feed,
@@ -682,29 +788,60 @@ class InferenceEngine(object):
                                          return_numpy=req.return_numpy)
             except Exception as exc:
                 self._metrics.note_error()
+                _trace.flight_recorder.dump(
+                    'worker_error:%s' % self.name, error=repr(exc),
+                    trace_ids=[req.trace_id])
                 req.set_error(exc)
                 continue
             self._metrics.note_dispatch(
                 1, self._exe.compile_count - before)
+            if req.trace is not None:
+                # eager runs are synchronous: the device stage IS the
+                # exe.run window, and delivery follows immediately
+                req.trace.mark('sync')
+                self._metrics.note_stages(req.trace.finalize())
             req.set_result(outs)
             if req.latency_s is not None:
                 self._metrics.note_latency(req.latency_s)
-            if _profiler.is_profiler_enabled():
+            if _profiler.is_profiler_enabled() or _trace.spans_enabled():
                 _profiler.record_event(self._spans + 'dispatch[eager]',
                                        time.time() - t0, start=t0)
 
     def _drain_one(self):
         """Deliver the OLDEST in-flight dispatch: host sync, trim each
         lot to its real rows, slice per request, resolve futures."""
-        stacked, lots, compiled, t0 = self._inflight.popleft()
+        stacked, lots, compiled, t0, t_disp, cost = \
+            self._inflight.popleft()
         try:
             arrays = [np.asarray(a) for a in stacked]  # the sync point
         except Exception as exc:
             self._metrics.note_error()
+            _trace.flight_recorder.dump(
+                'worker_error:%s' % self.name, error=repr(exc),
+                trace_ids=[r.trace_id for lot in lots
+                           for r in lot.requests])
             for lot in lots:
                 for req in lot.requests:
                     req.set_error(exc)
             return
+        t_sync = time.time()
+        for lot in lots:
+            for req in lot.requests:
+                if req.trace is not None:
+                    req.trace.mark('sync', t_sync)
+        # achieved MFU: XLA's own FLOPs for the drained executable over
+        # the wall window the device could have spent on THIS dispatch.
+        # With pipeline_depth > 1 dispatch N+1 is issued while N still
+        # executes, so [t_disp, t_sync] windows of consecutive drains
+        # overlap — summing them double-counts wall time and halves the
+        # reported rate under load.  Clip each window to start no
+        # earlier than the previous drain's sync.  A backend whose
+        # analysis yields no 'flops' must not grow the seconds
+        # denominator either, or mixed entries deflate device_flops_per_s
+        dev_start = max(t_disp, self._last_sync_t)
+        if cost is not None and cost.get('flops') and t_sync > dev_start:
+            self._metrics.note_device(cost['flops'], t_sync - dev_start)
+        self._last_sync_t = t_sync
         led = fetch_batch_led(compiled, len(arrays))
         if not all(led) and not self._warned_unsliced and \
                 any(len(lot.requests) > 1 for lot in lots):
@@ -762,10 +899,17 @@ class InferenceEngine(object):
                         step = core.LoDTensor(np.asarray(step))
                     res.append(step)
                 offset += req.rows or 0
+                if req.trace is not None:
+                    # finalize BEFORE resolving the future: a caller
+                    # woken by result() must see a complete breakdown
+                    self._metrics.note_stages(req.trace.finalize())
+                    _trace.record_span(
+                        self._spans + 'request', req.trace.t0,
+                        req.trace.e2e_s, trace_id=req.trace_id)
                 req.set_result(res)
                 if req.latency_s is not None:
                     self._metrics.note_latency(req.latency_s)
-        if _profiler.is_profiler_enabled():
+        if _profiler.is_profiler_enabled() or _trace.spans_enabled():
             _profiler.record_event(
                 self._spans + 'dispatch[x%d]' % len(lots),
                 time.time() - t0, start=t0)
@@ -849,11 +993,13 @@ class InferenceEngine(object):
                     # computes
                     while len(self._inflight) >= self.config.pipeline_depth:
                         self._drain_one()
-            except Exception:
+            except Exception as exc:
                 # belt-and-braces: _dispatch/_drain_one already error
                 # their own lots' futures; whatever still escapes must
                 # not kill the serving thread
                 self._metrics.note_error()
+                _trace.flight_recorder.dump(
+                    'worker_error:%s' % self.name, error=repr(exc))
         with self._cycle_lock:
             while self._carry:
                 self._dispatch([self._carry.popleft()])

@@ -47,6 +47,17 @@ class EngineMetrics(object):
         # bucketed trailing axes (weighted by rows, summed over feeds)
         self.trailing_real_cells = 0
         self.trailing_padded_cells = 0
+        # request tracing (ISSUE 6): per-stage seconds summed over
+        # delivered traced requests — the aggregate view of the
+        # per-request breakdowns (queue/pad/arbitration/dispatch/
+        # device/trim)
+        self.stage_s = {}
+        self.traced_requests = 0
+        # cost accounting (ISSUE 6): XLA cost-analysis FLOPs executed
+        # vs wall seconds of the drained dispatches that carried a cost
+        # entry — achieved-MFU's numerator/denominator
+        self.device_flops = 0.0
+        self.device_seconds = 0.0
 
     def note_request(self, rows):
         with self._lock:
@@ -85,6 +96,21 @@ class EngineMetrics(object):
         with self._lock:
             self.errors += 1
 
+    def note_stages(self, stage_s):
+        """One delivered request's finalized per-stage seconds."""
+        with self._lock:
+            self.traced_requests += 1
+            for stage, s in stage_s.items():
+                self.stage_s[stage] = self.stage_s.get(stage, 0.0) + \
+                    float(s)
+
+    def note_device(self, flops, seconds):
+        """One drained dispatch's cost-analysis FLOPs + wall seconds
+        (dispatch issue -> host sync) — accumulates achieved MFU."""
+        with self._lock:
+            self.device_flops += float(flops)
+            self.device_seconds += float(seconds)
+
     def snapshot(self, queue_depth=0):
         """One coherent dict: counters plus the derived rates the
         ROADMAP's serving lane cares about (batch fill ratio = real rows
@@ -121,4 +147,13 @@ class EngineMetrics(object):
                     round(_percentile(lat, 0.50) * 1e3, 3) if lat else None),
                 'p99_latency_ms': (
                     round(_percentile(lat, 0.99) * 1e3, 3) if lat else None),
+                'traced_requests': self.traced_requests,
+                'stages_ms_mean': ({
+                    stage: round(s / self.traced_requests * 1e3, 3)
+                    for stage, s in sorted(self.stage_s.items())
+                } if self.traced_requests else None),
+                'device_flops_per_s': (
+                    round(self.device_flops / self.device_seconds, 1)
+                    if self.device_seconds > 0 and self.device_flops > 0
+                    else None),
             }

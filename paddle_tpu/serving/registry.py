@@ -54,6 +54,7 @@ import numpy as np
 
 from ..fluid import core
 from ..fluid import profiler as _profiler
+from ..fluid import trace as _trace
 from .arbiter import HBMArbiter, HBMBudgetError, program_seed_bytes
 from .engine import InferenceEngine, ServingConfig
 
@@ -340,6 +341,13 @@ class ModelRegistry(object):
         moved, _ = entry.engine.evict_to_host()
         return moved
 
+    def audit(self):
+        """Run the arbiter's ``jax.live_arrays()`` cross-check now and
+        return it (also kept on the arbiter and surfaced as the
+        ``audit`` block of ``metrics()``): accounted-resident bytes vs
+        what the runtime actually holds live, drift included."""
+        return self.arbiter.audit()
+
     def _ensure_resident(self, name):
         """Dispatch-time gate: budget-arbitrate ``name`` resident (LRU
         peers evict as needed) and correct resident accounts to live
@@ -357,15 +365,23 @@ class ModelRegistry(object):
         the HBM budget (transparently reloading it / evicting LRU peers
         — the caller never sees the arbitration, only the latency), and
         enqueue on its engine.  Returns the engine's InferenceRequest
-        future."""
+        future — its ``breakdown()`` carries the routed request's
+        per-stage latency INCLUDING the arbitration window paid here
+        (the trace context is attached before engine.submit, so the
+        engine threads the registry's trace id instead of minting its
+        own)."""
+        ctx = _trace.TraceContext()
+        t0 = time.time()
         entry = self._ensure_resident(model)
+        ctx.add_stage('arbitration', time.time() - t0)
         now = time.time()
         with self._lock:
             entry.requests += 1
             if entry.first_req_t is None:
                 entry.first_req_t = now
             entry.last_req_t = now
-        req = entry.engine.submit(feed, return_numpy=return_numpy)
+        with _trace.attach(ctx):
+            req = entry.engine.submit(feed, return_numpy=return_numpy)
         if req.rows:
             with self._lock:
                 entry.rows += req.rows
@@ -463,5 +479,6 @@ class ModelRegistry(object):
             'admission_rejects': arb['admission_rejects'],
             'budget_bytes': arb['budget_bytes'],
             'resident_bytes': arb['resident_bytes'],
+            'audit': arb['audit'],
             'lru_order': arb['lru_order'],
         }

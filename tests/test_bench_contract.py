@@ -98,6 +98,13 @@ def test_single_config_child_runs_cpu():
     assert rec['tokens_per_sec_dispatch_bound'] > 0
     # ISSUE 3: the paired overlapped-input measurement rides along
     _assert_feed_overlap(rec)
+    # ISSUE 6: the child enabled FLAGS_cost_accounting, so the timed
+    # executable's XLA cost analysis rides the record (mfu itself stays
+    # None on CPU — no v5e peak to divide by)
+    assert rec['cost'] is not None, rec
+    assert rec['cost']['source'] == 'xla_cost_analysis'
+    assert rec['cost']['flops_per_step'] > 0
+    assert rec['mfu_analytic'] is None  # CPU smoke
 
 
 FEED_OVERLAP_KEYS = {'steps_per_dispatch', 'pipeline_depth', 'dispatches',
@@ -214,6 +221,46 @@ def test_multi_model_perf_gate_config_registered():
         assert "'%s'" % key in src, key
     assert 'ModelRegistry' in inspect.getsource(
         perf_gate.build_multi_model)
+
+
+def test_cost_mfu_and_trace_overhead_wired():
+    """ISSUE 6: bench.py's MFU is XLA-cost-analysis-derived — every
+    child runs under FLAGS_cost_accounting and every device-true config
+    reports the timed executable's `cost` block (the analytic counts
+    stay as mfu_analytic cross-checks) — and tools/perf_gate.py
+    registers the trace_overhead paired config (tracing-on vs
+    tracing-off engine over one scope) with the bounded-overhead
+    assertion.  Source-level pin; the functional cost-registry path is
+    covered by tests/test_trace.py and the stacked_lstm child below."""
+    import inspect
+    import bench
+    helper = inspect.getsource(bench._cost_block)
+    assert 'cost_report' in helper
+    assert 'xla_cost_analysis' in helper
+    assert 'cost_accounting' in inspect.getsource(bench.run_one)
+    for fn in (bench.bench_resnet, bench.bench_nmt,
+               bench.bench_transformer, bench.bench_stacked_lstm):
+        src = inspect.getsource(fn)
+        assert "'cost': cost" in src, fn.__name__
+        assert "'mfu_analytic': mfu_analytic" in src, fn.__name__
+        # mfu prefers the captured cost entry over the analytic count
+        assert "cost['mfu']" in src, fn.__name__
+    src = inspect.getsource(bench.bench_resnet_infer_bf16)
+    assert "'cost': cost" in src
+    assert "kind='eval_multi'" in src
+    sys.path.insert(0, os.path.join(REPO, 'tools'))
+    try:
+        import perf_gate
+    finally:
+        sys.path.pop(0)
+    assert 'trace_overhead' in perf_gate.CONFIGS
+    src = inspect.getsource(perf_gate.run_trace_overhead)
+    for key in ('traced_vs_untraced', 'untraced_rows_per_sec',
+                'traced_rows_per_sec', 'spans_last_window',
+                'traced_requests', 'stages_ms_mean'):
+        assert "'%s'" % key in src, key
+    assert 'PERF_GATE_TRACE_MIN' in src
+    assert 'tracing()' in inspect.getsource(perf_gate.build_trace_overhead)
 
 
 def test_nmt_cpu_smoke_is_device_true():

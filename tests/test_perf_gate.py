@@ -88,6 +88,27 @@ def _run_gate(config):
     return rec
 
 
+def test_trace_overhead_config_registered():
+    """ISSUE 6 structural pin (runs off-TPU): the trace_overhead paired
+    config exists, interleaves untraced/traced windows of ONE engine,
+    and hard-asserts the bounded-overhead floor.  The functional window
+    is TPU-only like the other paired configs; the tracing machinery
+    itself is covered functionally by tests/test_trace.py."""
+    import inspect
+    sys.path.insert(0, os.path.join(REPO, 'tools'))
+    try:
+        import perf_gate
+    finally:
+        sys.path.pop(0)
+    assert 'trace_overhead' in perf_gate.CONFIGS
+    src = inspect.getsource(perf_gate.run_trace_overhead)
+    assert "'traced_vs_untraced'" in src
+    assert 'PERF_GATE_TRACE_MIN' in src
+    build = inspect.getsource(perf_gate.build_trace_overhead)
+    assert 'tracing()' in build
+    assert 'InferenceEngine' in build
+
+
 @pytest.mark.parametrize('config', ['resnet', 'transformer', 'nmt'])
 def test_framework_beats_or_matches_pure_jax_bound(config):
     rec = _run_gate(config)

@@ -65,6 +65,8 @@ def generate():
     ])
     lines += _walk('paddle_tpu.fluid.dataflow', fluid.dataflow,
                    sorted(fluid.dataflow.__all__))
+    lines += _walk('paddle_tpu.fluid.trace', fluid.trace,
+                   sorted(fluid.trace.__all__))
     lines += _walk('paddle_tpu.fluid.io', fluid.io, sorted(
         n for n in fluid.io.__all__ if not n.startswith('_')))
     lines += _walk('paddle_tpu.fluid.metrics', fluid.metrics, [

@@ -10,7 +10,7 @@ max(fw)/max(bd) cross-window pairing).
 
 Run on TPU hardware:
     python tools/perf_gate.py [resnet|transformer|nmt|resnet_infer|
-        feed_pipeline|multi_model|trailing_dim|all]
+        feed_pipeline|multi_model|trailing_dim|trace_overhead|all]
 Prints one JSON line per config; tests/test_perf_gate.py drives it and
 skips cleanly off-TPU.  ``resnet_infer`` (ISSUE 2) has no bound side —
 its deliverable is the paired ``multi_vs_dispatch`` block: the measured
@@ -29,6 +29,14 @@ request seq-lens onto the shared TrailingDimBuckets ladder (mixed
 lengths coalesce, bounded executables), the exact engine serves every
 distinct length as its own per-shape lot/executable — the deliverable
 is the executable-count, padding-waste and throughput deltas.
+``trace_overhead`` (ISSUE 6) pairs tracing-on vs tracing-off serving
+over ONE engine/scope: the traced window runs inside a
+fluid.trace.tracing() span-capture window (per-request stage
+breakdowns are always on; the window adds the span log every profiler
+event mirrors into), the untraced window is the same engine outside
+it — the record asserts the observability layer's request-path
+overhead stays bounded (traced_vs_untraced >= PERF_GATE_TRACE_MIN,
+default 0.8, on the best shared drift window).
 """
 
 import json
@@ -601,6 +609,104 @@ def run_trailing_dim():
     return rec
 
 
+def build_trace_overhead():
+    """Tracing-on vs tracing-off serving over ONE scope (ISSUE 6): the
+    same engine (dense seq scorer, one batch bucket, one lot per scan)
+    serves the same request stream in paired windows — the TRACED
+    window inside a fluid.trace.tracing() span-capture window, the
+    untraced window outside it.  Per-request TraceContexts (stage
+    breakdowns on every response) are unconditionally on, so the pair
+    isolates the optional layer: the span log every profiler event and
+    delivered request mirrors into, the Chrome exporter's source."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import serving
+    from paddle_tpu.fluid import trace
+
+    rows = int(os.environ.get('PERF_GATE_TR_ROWS', '8'))
+    reqs_per_window = int(os.environ.get('PERF_GATE_TR_REQS', '16'))
+    dim, classes, seq = 64, 1000, 24
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = 0
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data('x', shape=[-1, dim], dtype='float32')
+        pooled = fluid.layers.reduce_sum(x, dim=1)
+        pred = fluid.layers.fc(pooled, classes, act='softmax')
+    test_prog = prog.clone(for_test=True)
+    place = fluid.TPUPlace()
+    scope = fluid.core.Scope()
+    exe0 = fluid.Executor(place)
+    with fluid.scope_guard(scope):
+        exe0.run(startup)
+    rng = np.random.RandomState(0)
+    streams = [
+        {'x': rng.standard_normal((rows, seq, dim)).astype('float32')}
+        for _ in range(reqs_per_window)
+    ]
+    eng = serving.InferenceEngine(
+        test_prog, feed_names=['x'], fetch_list=[pred], scope=scope,
+        executor=fluid.Executor(place), place=place,
+        config=serving.ServingConfig(
+            max_batch_size=rows * 4, max_wait_ms=2,
+            bucket_sizes=[rows * 4], steps_per_dispatch=1)).start()
+    for r in streams:  # warm the executable set
+        eng.infer(r, timeout=600)
+
+    def window():
+        t0 = time.time()
+        futs = [eng.submit(r) for r in streams]
+        for f in futs:
+            out, = f.result(600)
+            assert np.isfinite(np.asarray(out)).all()
+        return len(streams) * rows / (time.time() - t0)
+
+    def traced_window():
+        with trace.tracing():
+            return window()
+
+    return traced_window, window, (eng, trace, rows, reqs_per_window)
+
+
+def run_trace_overhead():
+    """The trace_overhead record: interleaved untraced/traced windows
+    (each ratio shares a drift window — the gates' pairing rule); the
+    HARD assertion is the bounded-overhead acceptance (ISSUE 6): the
+    best shared-window traced/untraced ratio must clear
+    PERF_GATE_TRACE_MIN (default 0.8)."""
+    traced, untraced, (eng, trace, rows, nreq) = build_trace_overhead()
+    tr, un = [], []
+    for _ in range(BLOCKS):
+        un.append(untraced())
+        tr.append(traced())
+    spans = trace.spans()  # the LAST traced window's span log
+    m = eng.metrics()
+    rec = {
+        'config': 'trace_overhead',
+        'untraced_rows_per_sec': round(max(un), 1),
+        'traced_rows_per_sec': round(max(tr), 1),
+        'untraced_blocks': [round(v, 1) for v in un],
+        'traced_blocks': [round(v, 1) for v in tr],
+        # the PAIRED deliverable: throughput kept with the span-capture
+        # window on, per shared drift window
+        'traced_vs_untraced': round(
+            max(t / u for t, u in zip(tr, un)), 4),
+        'spans_last_window': len(spans),
+        'span_lanes': len({s.get('lane') for s in spans}),
+        'traced_requests': m['traced_requests'],
+        'stages_ms_mean': m['stages_ms_mean'],
+        'requests_per_window': nreq, 'rows_per_request': rows,
+        'blocks': BLOCKS,
+    }
+    eng.stop()
+    # the bounded-overhead gate: tracing must not tax the request path
+    # beyond the configured floor on the best shared window
+    floor = float(os.environ.get('PERF_GATE_TRACE_MIN', '0.8'))
+    assert rec['traced_vs_untraced'] >= floor, rec
+    assert rec['spans_last_window'] > 0, rec
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 CONFIGS = {
     'resnet': (build_resnet, 'imgs_per_sec'),
     'transformer': (build_transformer, 'tokens_per_sec'),
@@ -609,6 +715,7 @@ CONFIGS = {
     'feed_pipeline': (build_feed_pipeline, 'imgs_per_sec'),
     'multi_model': (build_multi_model, 'imgs_per_sec'),
     'trailing_dim': (build_trailing_dim, 'rows_per_sec'),
+    'trace_overhead': (build_trace_overhead, 'rows_per_sec'),
 }
 
 
@@ -619,6 +726,8 @@ def run_config(name):
         return run_multi_model()
     if name == 'trailing_dim':
         return run_trailing_dim()
+    if name == 'trace_overhead':
+        return run_trace_overhead()
     build, unit = CONFIGS[name]
     # both sides compiled first, then INTERLEAVED blocks: a drift window
     # between two monolithic measurements would otherwise decide the

@@ -1,0 +1,45 @@
+"""Shared robust loader for the JSON sidecars the tools CLIs consume.
+
+Both tools/timeline.py (the profiler's ``.events.json`` sidecar) and
+tools/trace_export.py (``fluid.trace.dump_spans()`` files) sit at the
+end of best-effort write paths: a crashed profile run, a full disk, or
+a path typo all land here first.  One ladder covers every way the file
+can be bad — unreadable, empty, truncated JSON, wrong shape — as a
+one-line SystemExit (nonzero exit) naming the file, never a raw
+traceback.
+"""
+
+import json
+
+
+def load_json_sidecar(tool, path, required_key, expected_desc,
+                      empty_hint, truncated_hint, label=None):
+    """Read + parse one sidecar, or SystemExit with a one-line error.
+
+    ``tool`` prefixes every message (the CLI's name), ``required_key``
+    must map to a list in the parsed dict, ``expected_desc`` names what
+    kind of file was expected, and the two hints tell the user how the
+    empty / truncated file likely came to be.  ``label`` (timeline's
+    multi-trainer form) is appended to the file name when given.
+    Returns the parsed dict."""
+    where = '%s (%s)' % (path, label) if label else path
+    try:
+        with open(path) as f:
+            raw = f.read()
+    except OSError as e:
+        raise SystemExit('%s: cannot read %s: %s' % (tool, where, e))
+    if not raw.strip():
+        raise SystemExit(
+            '%s: %s is empty — %s' % (tool, where, empty_hint))
+    try:
+        data = json.loads(raw)
+    except ValueError:
+        raise SystemExit(
+            '%s: %s is not valid JSON (truncated?) — %s'
+            % (tool, where, truncated_hint))
+    if not isinstance(data, dict) or \
+            not isinstance(data.get(required_key), list):
+        raise SystemExit(
+            '%s: %s has no "%s" list — expected %s'
+            % (tool, where, required_key, expected_desc))
+    return data

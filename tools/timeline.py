@@ -18,6 +18,10 @@ chrome://tracing or https://ui.perfetto.dev.
 import argparse
 import json
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from sidecar import load_json_sidecar
 
 
 class _ChromeTraceFormatter(object):
@@ -136,6 +140,21 @@ def parse_profile_paths(spec):
     return out
 
 
+def load_profile(label, path):
+    """Parse one .events.json sidecar; an unreadable, empty, truncated
+    or wrong-shaped file is a one-line SystemExit (nonzero exit) naming
+    the file — not a raw traceback."""
+    return load_json_sidecar(
+        'timeline', path, 'host_events',
+        'the .events.json sidecar fluid.profiler writes next to '
+        'profile_path',
+        empty_hint='the profiler session that should have written it '
+                   'likely crashed before stop_profiler; re-run the '
+                   'profiled program',
+        truncated_hint='re-run the profiled program to regenerate it',
+        label=label)
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument('--profile_path', type=str, required=True,
@@ -145,8 +164,7 @@ def main():
     args = ap.parse_args()
     profiles = {}
     for label, path in parse_profile_paths(args.profile_path).items():
-        with open(path) as f:
-            profiles[label] = json.load(f)
+        profiles[label] = load_profile(label, path)
     tl = Timeline(profiles)
     with open(args.timeline_path, 'w') as f:
         f.write(tl.generate_chrome_trace())

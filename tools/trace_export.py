@@ -1,0 +1,89 @@
+"""Chrome trace-event exporter for fluid.trace span logs (ISSUE 6).
+
+``fluid.trace.tracing()`` captures one span per timed slice — executor
+runs, serving queue waits and dispatch windows, pipeline staging, plus
+the per-request ``serving/<engine>/request`` spans carrying trace ids —
+each tagged with the THREAD it ran on.  This tool renders that log as
+Chrome trace-event JSON (the catapult format): one lane (tid) per
+thread, complete ('X') events in microseconds, trace ids in ``args`` so
+Perfetto's search finds every slice of one request across lanes.
+
+    with fluid.trace.tracing():
+        ... serve / train ...
+        fluid.trace.dump_spans('/tmp/spans.json')
+    python tools/trace_export.py /tmp/spans.json -o /tmp/trace.json
+
+Load the output in https://ui.perfetto.dev or chrome://tracing.
+tools/timeline.py renders the PROFILER's aggregate sidecar; this tool
+renders the trace layer's raw spans — per-thread, per-request.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from sidecar import load_json_sidecar
+
+_PID = 1  # one process; lanes are threads
+
+
+def to_chrome_trace(spans):
+    """Spans ([{name, start_s, dur_s, lane, trace_id?}, ...]) -> the
+    chrome trace dict ({'traceEvents': [...], 'displayTimeUnit': 'ms'}).
+    Lanes map to tids in first-seen order, each named by a
+    ``thread_name`` metadata event."""
+    events = []
+    lane_tids = {}
+    for span in spans:
+        lane = span.get('lane') or 'main'
+        tid = lane_tids.get(lane)
+        if tid is None:
+            tid = lane_tids[lane] = len(lane_tids) + 1
+            events.append({
+                'ph': 'M', 'name': 'thread_name', 'pid': _PID,
+                'tid': tid, 'args': {'name': lane}})
+        args = {}
+        if span.get('trace_id') is not None:
+            args['trace_id'] = span['trace_id']
+        events.append({
+            'ph': 'X', 'cat': 'trace',
+            'name': str(span.get('name', '?')),
+            'pid': _PID, 'tid': tid,
+            'ts': float(span['start_s']) * 1e6,
+            'dur': float(span['dur_s']) * 1e6,
+            'args': args})
+    return {'traceEvents': events, 'displayTimeUnit': 'ms'}
+
+
+def load_spans(path):
+    """Read a dump_spans() file; a missing/empty/truncated file is a
+    clear one-line error (SystemExit), not a raw traceback."""
+    return load_json_sidecar(
+        'trace_export', path, 'spans',
+        'a fluid.trace.dump_spans() file',
+        empty_hint='was dump_spans() called inside an active '
+                   'tracing() window?',
+        truncated_hint='re-run the traced session and dump_spans() '
+                       'again')['spans']
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('spans', help='dump_spans() JSON file')
+    ap.add_argument('-o', '--out', required=True,
+                    help='chrome trace JSON output path')
+    ap.add_argument('--pretty', action='store_true')
+    args = ap.parse_args(argv)
+    spans = load_spans(args.spans)
+    trace = to_chrome_trace(spans)
+    with open(args.out, 'w') as f:
+        json.dump(trace, f, indent=4 if args.pretty else None)
+    lanes = len({s.get('lane') or 'main' for s in spans})
+    print('wrote %s: %d spans in %d lanes' % (args.out, len(spans), lanes))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
