@@ -371,6 +371,92 @@ def collect_cost_report(compiled_blocks):
     return out
 
 
+def normalize_decode_spec(decode):
+    """Validate + normalize the ``decode=`` argument shared by BOTH
+    executors' ``run_decode_multi`` (ISSUE 7).  The spec names the
+    autoregressive wiring of a STEP program:
+
+      token:   the feed carrying the current token ([S, 1] int)
+      logits:  the fetch (Variable or name) whose argmax is the next
+               token ([S, vocab] — the greedy-decode selection)
+      state:   ordered (feed_name, fetch) pairs — each step the fetch's
+               value becomes the feed's next value (the KV/hidden slot
+               state threading through the scan carry)
+      context: feed names that live in the slot carry but never update
+               (per-slot read-only state, e.g. encoder outputs)
+      end_id:  the EOS token id (the per-slot stop condition, masked
+               inside the scan next to the per-slot step budget)
+    """
+    if not isinstance(decode, dict):
+        raise ValueError('decode= must be a dict (token/logits/state/'
+                         'end_id), got %r' % (type(decode), ))
+    missing = [k for k in ('token', 'logits', 'state', 'end_id')
+               if k not in decode]
+    if missing:
+        raise ValueError('decode= is missing %s' % missing)
+
+    def name_of(v):
+        return v.name if isinstance(v, Variable) else str(v)
+
+    state = decode['state']
+    if isinstance(state, dict):
+        state = list(state.items())
+    state = [(str(feed_n), name_of(fetch)) for feed_n, fetch in state]
+    if not state:
+        raise ValueError('decode= needs at least one state pair — a '
+                         'stateless step function has nothing to carry '
+                         'between decode steps')
+    return {
+        'token': str(decode['token']),
+        'logits': name_of(decode['logits']),
+        'state': tuple(state),
+        'context': tuple(str(n) for n in decode.get('context', ())),
+        'end_id': int(decode['end_id']),
+    }
+
+
+def canonical_decode_carry(carry):
+    """Canonicalize the decode carry's array leaves to jax's dtype
+    rules ONCE on the way in (shared by both executors'
+    run_decode_multi).  Without jax x64, a host int64 token would
+    compile one executable on the first dispatch and a DIFFERENT one
+    (int32 — the scan's own output dtype) on every later dispatch:
+    the signature must be stable across the carry round trip."""
+    import jax.numpy as jnp
+
+    def c(v):
+        return v if hasattr(v, 'devices') else jnp.asarray(v)
+
+    return {'slots': {n: c(v) for n, v in carry['slots'].items()},
+            'token': c(carry['token']), 'alive': c(carry['alive']),
+            'remaining': c(carry['remaining'])}
+
+
+def check_decode_carry(carry, spec, what):
+    """Fail fast when a decode carry does not match its spec: the slot
+    dict must cover exactly the state + context feeds, and the
+    token/alive/remaining leaves must be present (shared by both
+    executors' run_decode_multi)."""
+    if not isinstance(carry, dict):
+        raise ValueError('%s: carry must be a dict, got %r'
+                         % (what, type(carry)))
+    missing = [k for k in ('slots', 'token', 'alive', 'remaining')
+               if k not in carry]
+    if missing:
+        raise ValueError('%s: carry is missing %s' % (what, missing))
+    want = set(n for n, _ in spec['state']) | set(spec['context'])
+    have = set(carry['slots'])
+    # @SEQLEN/@ROWS companions of context feeds ride along untouched
+    extra = {n for n in have - want
+             if not n.endswith((registry.SEQLEN_SUFFIX,
+                                registry.ROWS_SUFFIX))}
+    if want - have or extra:
+        raise ValueError(
+            '%s: carry slots %s do not match the decode spec (missing '
+            '%s, unexpected %s)' % (what, sorted(have),
+                                    sorted(want - have), sorted(extra)))
+
+
 def _reject_reader_fed(program, what):
     """The PLAIN-FEED multi paths never compose with py_reader-fed
     programs: resolving would pop exactly ONE minibatch and the K-step
@@ -952,6 +1038,145 @@ class _CompiledBlock(object):
             scope.var(name).set_value(val)
         return stacked
 
+    def note_decode_compile(self, steps, carry_sig):
+        """note_multi_compile for the DECODE scan's executable cache."""
+        return self.note_multi_compile(steps, carry_sig,
+                                       seen_attr='_decode_steps_seen')
+
+    def _make_decode_multi(self, spec):
+        """The K-AUTOREGRESSIVE-steps-per-dispatch function (ISSUE 7):
+        lax.scan over K greedy-decode steps of the step program, the
+        whole slot batch at once.  Unlike _make_eval_multi, each step's
+        INPUT comes from the previous step's OUTPUT — the scan carry
+        holds the per-slot decoder state (KV/hidden — the ``state``
+        pairs), the current token, a per-slot alive mask, and a
+        per-slot remaining-step budget.  Stop conditions (EOS emitted /
+        budget exhausted) are masked INSIDE the scan: a finished slot's
+        state and token FREEZE (jnp.where on the alive mask), so dead
+        and free slots ride along at zero semantic cost while live ones
+        keep decoding — the in-jit half of continuous batching.
+        Emits (carry', tokens [K, S], alive_in [K, S]): a token counts
+        for a slot exactly when the slot was alive ENTERING the step
+        (the EOS itself is emitted, then the slot goes dead) — the same
+        accounting as a host-driven greedy loop that appends argmax
+        until it appends end_id or exhausts max_len."""
+        import jax
+        import jax.numpy as jnp
+        fn = self._fn
+        rw_keys = list(self.state_rw)
+        token_name = spec['token']
+        end_id = int(spec['end_id'])
+        updates = [(feed_n, self.fetch_names.index(fetch_n))
+                   for feed_n, fetch_n in spec['state']]
+
+        def decode_multi(state_ro, feeds, carry, rng, n):
+            def body(c, i):
+                s, slots, token = c['state'], c['slots'], c['token']
+                alive, remaining = c['alive'], c['remaining']
+                merged = dict(feeds)
+                merged.update(slots)
+                merged[token_name] = token
+                new_state, fetches = fn(s, state_ro, merged,
+                                        jax.random.fold_in(rng, i))
+                logits = fetches[0]
+                nxt = jnp.argmax(
+                    logits.reshape((logits.shape[0], -1)),
+                    axis=-1).astype(token.dtype)
+                emit = jnp.where(alive, nxt,
+                                 jnp.asarray(end_id, token.dtype))
+                rem = remaining - alive.astype(remaining.dtype)
+                live = alive & (emit != end_id) & (rem > 0)
+                new_slots = dict(slots)
+                for feed_n, fi in updates:
+                    upd = fetches[fi]
+                    keep = alive.reshape(
+                        (-1, ) + (1, ) * (max(upd.ndim, 1) - 1))
+                    new_slots[feed_n] = jnp.where(keep, upd,
+                                                  slots[feed_n])
+                new_token = jnp.where(alive[:, None], emit[:, None],
+                                      token)
+                c2 = {'state': {k: new_state.get(k, s[k])
+                                for k in rw_keys},
+                      'slots': new_slots, 'token': new_token,
+                      'alive': live, 'remaining': rem}
+                return c2, (emit, alive)
+
+            final, (toks, alive_in) = jax.lax.scan(
+                body, carry, jnp.arange(n))
+            return final, toks, alive_in
+
+        return decode_multi
+
+    def _wrap_decode_multi_jit(self, feeds, carry, spec, donate):
+        """jit wrapping for the decode scan; _SpmdCompiledBlock
+        overrides this to attach per-structure GSPMD shardings (slots
+        sharded batch-dim over dp, like eval lots)."""
+        import jax
+        return jax.jit(self._make_decode_multi(spec),
+                       static_argnums=(4, ), donate_argnums=donate)
+
+    def _get_decode_multi_jit(self, feeds, carry, spec):
+        """One decode-scan executable per (constant-feed, slot, spec)
+        name structure.  The CARRY is DONATED on device: the slot
+        state (KV/hidden cache) is dead the moment the scan produced
+        its successor, so XLA updates it IN PLACE — the resident
+        decode cache never doubles during a dispatch."""
+        key = (tuple(sorted(feeds)), tuple(sorted(carry['slots'])),
+               spec['token'], spec['state'], spec['end_id'])
+        cache = getattr(self, '_decode_jits', None)
+        if cache is None:
+            cache = self._decode_jits = {}
+        jitted = cache.get(key)
+        if jitted is None:
+            donate = ()
+            if self._device_platform() != 'cpu':
+                # XLA CPU can't alias the carry (it would warn and
+                # copy); on device the in-place state update is the
+                # point
+                donate = (2, )
+            jitted = self._wrap_decode_multi_jit(feeds, carry, spec,
+                                                 donate)
+            cache[key] = jitted
+        return jitted
+
+    def run_decode_multi(self, scope, feed_values, rng_key, steps, carry,
+                         spec):
+        """K autoregressive decode steps in ONE device dispatch over
+        the whole slot batch (run_eval_multi's generation sibling).
+        ``carry`` is the engine-facing slot view (slots/token/alive/
+        remaining); persistable RW state threads through the scan like
+        every other path and persists back to the scope.  Returns
+        (carry', tokens [K, S], alive_in [K, S]) with NO host sync —
+        all three are async device values."""
+        if steps < 1:
+            raise ValueError('run_decode_multi: steps must be >= 1, '
+                             'got %r' % (steps, ))
+        if any(_is_host_op(op) for op in self.ops):
+            raise RuntimeError(
+                'run_decode_multi: the program contains host ops and '
+                'cannot run as one on-device loop — decode-step '
+                'programs must be pure compute')
+        state_rw, state_ro, feeds = self._materialize_args(
+            scope, feed_values, cache_ro=True)
+        jitted = self._get_decode_multi_jit(feeds, carry, spec)
+        full = {'state': state_rw, 'slots': dict(carry['slots']),
+                'token': carry['token'], 'alive': carry['alive'],
+                'remaining': carry['remaining']}
+        self.last_decode_cost = self._capture_cost(
+            'decode_multi',
+            (tuple(sorted(feeds)), tuple(sorted(carry['slots'])),
+             int(steps)),
+            jitted, (state_ro, feeds, full, rng_key, int(steps)),
+            steps=steps)
+        final, toks, alive_in = jitted(state_ro, feeds, full, rng_key,
+                                       int(steps))
+        for name, val in final['state'].items():
+            scope.var(name).set_value(val)
+        carry_out = {'slots': final['slots'], 'token': final['token'],
+                     'alive': final['alive'],
+                     'remaining': final['remaining']}
+        return carry_out, toks, alive_in
+
 
 class Executor(object):
     """Program runner (reference executor.py:256 / executor.cc:125)."""
@@ -1400,6 +1625,56 @@ class Executor(object):
                     'executor_run_eval_multi/block0'):
                 return go()  # np.asarray in the conversion drains
         return go()
+
+    def run_decode_multi(self, program=None, feed=None, carry=None,
+                         steps=None, decode=None, scope=None):
+        """Run ``steps`` AUTOREGRESSIVE greedy-decode iterations of a
+        STEP program as ONE device dispatch over a whole slot batch
+        (ISSUE 7 — the generation sibling of run_eval_multi, and the
+        serving engine's decode-lane primitive).  Each iteration feeds
+        the previous iteration's outputs back in: ``decode`` names the
+        token feed, the logits fetch (argmax = next token), the
+        (state feed, state fetch) pairs threading KV/hidden state
+        through the scan carry, optional read-only ``context`` slot
+        feeds, and ``end_id``; per-slot stop conditions (EOS emitted /
+        ``carry['remaining']`` exhausted) are masked INSIDE the scan —
+        finished slots freeze, live ones keep decoding.
+
+        carry: {'slots': {name: [S, ...]}, 'token': [S, 1] int,
+        'alive': [S] bool, 'remaining': [S] int32} — the slot-resident
+        decode state (on device it is DONATED and updated in place).
+        feed: feeds held constant across iterations (rarely needed).
+        Returns (carry', tokens [K, S], alive_in [K, S]): tokens[i, s]
+        counts for slot s exactly when alive_in[i, s] — token-identical
+        to a per-slot host-driven greedy loop over the same program."""
+        program = _reject_reader_fed(program, 'run_decode_multi')
+        if carry is None or steps is None or decode is None:
+            raise ValueError('run_decode_multi: carry=, steps= and '
+                             'decode= are required')
+        steps = int(steps)
+        spec = normalize_decode_spec(decode)
+        check_decode_carry(carry, spec, 'run_decode_multi')
+        carry = canonical_decode_carry(carry)
+        fetch_list = [spec['logits']] + [f for _, f in spec['state']]
+        sig_feed = dict(feed or {})
+        sig_feed[spec['token']] = carry['token']
+        sig_feed.update(carry['slots'])
+        program, scope, feed_arrays, compiled = self._resolve_and_compile(
+            program, sig_feed, fetch_list, scope, pop_readers=False)
+        const = {n: v for n, v in feed_arrays.items()
+                 if n not in carry['slots'] and n != spec['token']}
+        rng = self._next_rng(program)
+        carry_sig = dict(carry['slots'])
+        carry_sig[spec['token']] = carry['token']
+        if compiled.note_decode_compile(steps, carry_sig):
+            self.compile_count += 1
+        from . import trace as _trace
+        _trace.flight_recorder.record(
+            'decode_dispatch', executor='Executor', steps=steps,
+            slots=int(np.shape(carry['token'])[0]),
+            trace_id=getattr(_trace.current(), 'trace_id', None))
+        return compiled.run_decode_multi(scope, const, rng, steps,
+                                         carry, spec)
 
     def _convert_fetches(self, fetches, return_numpy):
         def convert(f):

@@ -317,6 +317,38 @@ class _SpmdCompiledBlock(_CompiledBlock):
     def _device_platform(self):
         return self.mesh.devices.flat[0].platform
 
+    def _wrap_decode_multi_jit(self, feeds, carry, spec, donate):
+        """The shared K-decode-steps-per-dispatch scan (ISSUE 7),
+        jitted with this block's GSPMD shardings: every slot-carry leaf
+        (KV/hidden state, token, alive mask, step budget) shards its
+        SLOT dim over the batch axis — the decode cache lives
+        distributed across the mesh and updates in place there — and
+        the emitted [K, S] token/alive stacks shard the slot dim right
+        of the unsharded step axis, like every scanned output."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.api import scanned_spec
+        mesh = self.mesh
+        row_spec = P(self.batch_axis) \
+            if self.batch_axis in mesh.axis_names else P()
+        row = NamedSharding(mesh, row_spec)
+        ro_sh = {n: self._state_shardings[n] for n in self.state_ro}
+        feed_sh = {n: self._feed_shardings[n] for n in feeds}
+        carry_sh = {
+            'state': {n: self._state_shardings[n]
+                      for n in self.state_rw},
+            'slots': {n: self._feed_shardings[n]
+                      for n in carry['slots']},
+            'token': self._feed_shardings[spec['token']],
+            'alive': row, 'remaining': row,
+        }
+        out_row = NamedSharding(mesh, scanned_spec(row_spec))
+        return jax.jit(
+            self._make_decode_multi(spec), static_argnums=(4, ),
+            in_shardings=(ro_sh, feed_sh, carry_sh, None),
+            out_shardings=(carry_sh, out_row, out_row),
+            donate_argnums=donate)
+
     def _wrap_eval_multi_jit(self, feeds, scanned, donate):
         """The shared K-eval-batches-per-dispatch scan, jitted with this
         block's GSPMD shardings (feeds/lots sharded batch-dim over 'dp'
@@ -681,6 +713,59 @@ class ParallelExecutor(object):
             reader=reader)
         return convert_eval_fetches(stacked, reals, target, compiled, k,
                                     return_numpy)
+
+    def run_decode_multi(self, feed=None, carry=None, steps=None,
+                         decode=None):
+        """K autoregressive greedy-decode steps as ONE GSPMD-sharded
+        device dispatch over the whole slot batch (the SPMD counterpart
+        of Executor.run_decode_multi — ISSUE 7).  The slot carry shards
+        its slot dim over 'dp' (the slot count must be a multiple of
+        the dp extent — the engine sizes its cache so), per-slot stop
+        conditions are masked inside the scan, and the carry is donated
+        on device so the distributed decode cache updates in place.
+        Returns (carry', tokens [K, S], alive_in [K, S]), no host
+        sync."""
+        from .executor import normalize_decode_spec, \
+            check_decode_carry, canonical_decode_carry
+        _reject_reader_fed(self._main_program,
+                           'ParallelExecutor.run_decode_multi')
+        if carry is None or steps is None or decode is None:
+            raise ValueError('run_decode_multi: carry=, steps= and '
+                             'decode= are required')
+        steps = int(steps)
+        spec = normalize_decode_spec(decode)
+        check_decode_carry(carry, spec, 'run_decode_multi')
+        carry = canonical_decode_carry(carry)
+        slots = int(np.shape(carry['token'])[0])
+        if slots % self._dp_extent() != 0:
+            raise ValueError(
+                'run_decode_multi: %d slots do not divide over the dp '
+                'extent %d — size the slot batch to a multiple of the '
+                'mesh' % (slots, self._dp_extent()))
+        fetch_names = self._fetch_names(
+            [spec['logits']] + [f for _, f in spec['state']])
+        sig_feed = dict(feed or {})
+        sig_feed[spec['token']] = carry['token']
+        sig_feed.update(carry['slots'])
+        feed_arrays = prepare_feed_arrays(sig_feed)
+        compiled = self._resolve(fetch_names, feed_arrays)
+        const = {n: v for n, v in feed_arrays.items()
+                 if n not in carry['slots'] and n != spec['token']}
+        carry_sig = dict(carry['slots'])
+        carry_sig[spec['token']] = carry['token']
+        if compiled.note_decode_compile(steps, carry_sig):
+            self.compile_count += 1
+        from . import trace as _trace
+        _trace.flight_recorder.record(
+            'decode_dispatch', executor='ParallelExecutor', steps=steps,
+            slots=slots,
+            trace_id=getattr(_trace.current(), 'trace_id', None))
+        out = compiled.run_decode_multi(self._scope, const,
+                                        self._next_rng(), steps, carry,
+                                        spec)
+        self.dispatch_count += 1
+        self.steps_dispatched += steps
+        return out
 
     def cost_report(self):
         """Per-executable cost registry (ISSUE 6), the SPMD twin of

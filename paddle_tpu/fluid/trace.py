@@ -59,8 +59,14 @@ __all__ = [
 # registry's residency gate, pre-enqueue), queue (enqueue -> lot
 # collection), pad (request prepare + lot padding), dispatch (lot ready
 # -> device dispatch issued, incl. carry/gate waits), device (dispatch
-# -> host sync), trim (sync -> per-request slice delivered)
-STAGES = ('arbitration', 'queue', 'pad', 'dispatch', 'device', 'trim')
+# -> host sync), trim (sync -> per-request slice delivered).
+# GENERATION requests (ISSUE 7) replace the post-collection stages with
+# prefill (lot -> slot admission: the prompt's pad/dispatch/device/trim
+# as one stage), decode (admission -> last decode-scan sync) and
+# detokenize (last sync -> delivery); their breakdown also carries a
+# decode_steps count.
+STAGES = ('arbitration', 'queue', 'pad', 'prefill', 'dispatch',
+          'device', 'trim', 'decode', 'detokenize')
 
 _ids = itertools.count(1)
 _id_lock = threading.Lock()
@@ -80,7 +86,7 @@ class TraceContext(object):
     'collect'/'lot'/'dispatch', the drain marks 'sync', and
     ``finalize()`` (at delivery) turns the marks into the breakdown."""
 
-    __slots__ = ('trace_id', 't0', 'marks', 'stage_s', 'e2e_s')
+    __slots__ = ('trace_id', 't0', 'marks', 'stage_s', 'e2e_s', 'counts')
 
     def __init__(self, trace_id=None):
         self.trace_id = trace_id or new_trace_id()
@@ -88,11 +94,18 @@ class TraceContext(object):
         self.marks = {}
         self.stage_s = {}
         self.e2e_s = None
+        self.counts = {}
 
     def add_stage(self, stage, seconds):
         """Accumulate seconds measured outside the mark chain (e.g.
         'arbitration' by the registry, the prepare half of 'pad')."""
         self.stage_s[stage] = self.stage_s.get(stage, 0.0) + float(seconds)
+
+    def add_count(self, name, n):
+        """Accumulate a per-request integer (e.g. ``decode_steps`` —
+        how many decode-scan steps this generation request consumed);
+        rides ``breakdown()`` next to the stage times."""
+        self.counts[name] = self.counts.get(name, 0) + int(n)
 
     def mark(self, name, t=None):
         self.marks[name] = time.time() if t is None else t
@@ -100,7 +113,11 @@ class TraceContext(object):
     def finalize(self, end=None):
         """Close the trace: derive the boundary-mark stages and the
         end-to-end wall clock.  Robust to missing marks (an errored
-        request finalizes with whatever boundaries it reached)."""
+        request finalizes with whatever boundaries it reached).
+        A GENERATION request (an 'admit' mark present — ISSUE 7)
+        derives prefill/decode/detokenize instead of the per-lot
+        pad/dispatch/device/trim splits: its prompt pass IS one stage,
+        and everything after admission belongs to the decode scan."""
         end = time.time() if end is None else end
         m = self.marks
 
@@ -108,24 +125,40 @@ class TraceContext(object):
             return max(m[b] - m[a], 0.0) if a in m and b in m else 0.0
 
         self.add_stage('queue', seg('enqueue', 'collect'))
-        self.add_stage('pad', seg('collect', 'lot'))
-        self.add_stage('dispatch', seg('lot', 'dispatch'))
-        self.add_stage('device', seg('dispatch', 'sync'))
-        if 'sync' in m:
-            self.add_stage('trim', max(end - m['sync'], 0.0))
+        if 'admit' in m:
+            self.add_stage('prefill', seg('collect', 'admit'))
+            if 'decode_end' in m:
+                self.add_stage('decode', seg('admit', 'decode_end'))
+                self.add_stage('detokenize',
+                               max(end - m['decode_end'], 0.0))
+            else:
+                # errored before any scan drained: whatever remains is
+                # decode-lane time
+                self.add_stage('decode', max(end - m['admit'], 0.0))
+        else:
+            self.add_stage('pad', seg('collect', 'lot'))
+            self.add_stage('dispatch', seg('lot', 'dispatch'))
+            self.add_stage('device', seg('dispatch', 'sync'))
+            if 'sync' in m:
+                self.add_stage('trim', max(end - m['sync'], 0.0))
         self.e2e_s = end - self.t0
         return self.stage_s
 
     def breakdown(self):
         """The response-surface view: trace id, end-to-end ms, and the
-        per-stage ms in canonical order (only stages that occurred)."""
-        return {
+        per-stage ms in canonical order (only stages that occurred),
+        plus any per-request counts (generation requests carry
+        ``decode_steps``)."""
+        out = {
             'trace_id': self.trace_id,
             'e2e_ms': (round(self.e2e_s * 1e3, 3)
                        if self.e2e_s is not None else None),
             'stages_ms': {s: round(self.stage_s[s] * 1e3, 3)
                           for s in STAGES if s in self.stage_s},
         }
+        if self.counts:
+            out.update(self.counts)
+        return out
 
 
 # ---- ambient context (cross-layer handoff) ----------------------------

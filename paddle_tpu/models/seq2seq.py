@@ -11,7 +11,7 @@ are batched matmuls on the MXU.
 
 import paddle_tpu.fluid as fluid
 
-__all__ = ['build', 'build_decode']
+__all__ = ['build', 'build_decode', 'build_step_decode']
 
 
 def encoder(src_word_id, src_dict_dim, embedding_dim, encoder_size):
@@ -215,3 +215,63 @@ def build_decode(src_dict_dim=1000,
         feeds=['src_word_id'],
         sentence_ids=sent_ids,
         sentence_scores=sent_scores)
+
+
+def build_step_decode(src_dict_dim=1000,
+                      trg_dict_dim=1000,
+                      embedding_dim=64,
+                      encoder_size=64,
+                      decoder_size=64,
+                      start_id=0,
+                      end_id=1,
+                      max_len=16):
+    """STEPWISE greedy NMT decode for the generation serving lane
+    (ISSUE 7): the same encoder boot ``build_decode`` computes, split
+    into the prefill/step contract ``serving.GenerationSpec`` consumes.
+
+      prefill: src LoD -> the decoder's boot hidden (encoder ->
+          sequence_last_step -> fc tanh — machine_translation.py's
+          decoder_boot), ONE [B, decoder_size] state fetch;
+      step: (token, hidden) -> (vocab logits, hidden') — embedding +
+          fc + one gru_unit, the per-token recurrence of the reference
+          decoder without the beam bookkeeping (greedy, beam 1).
+
+    Every step-program op is row-independent, so the slot-batched
+    decode scan is token-identical to per-request decode.  Both
+    programs' params are disjoint and uniquely named (ONE global
+    unique_name session), so one scope runs both startup programs."""
+    prefill, prefill_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prefill, prefill_startup):
+        src = fluid.layers.data(
+            name='src_word_id', shape=[1], dtype='int64', lod_level=1)
+        encoder_out = encoder(src, src_dict_dim, embedding_dim,
+                              encoder_size)
+        encoder_last = fluid.layers.sequence_last_step(input=encoder_out)
+        boot = fluid.layers.fc(input=encoder_last, size=decoder_size,
+                               act='tanh')
+    step, step_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(step, step_startup):
+        token = fluid.layers.data(name='gen_token', shape=[1],
+                                  dtype='int64')
+        hidden = fluid.layers.data(name='gen_hidden',
+                                   shape=[decoder_size], dtype='float32')
+        pre_word = fluid.layers.embedding(
+            input=token, size=[trg_dict_dim, embedding_dim])
+        decoder_inputs = fluid.layers.fc(
+            input=pre_word, size=decoder_size * 3, bias_attr=False)
+        h, _, _ = fluid.layers.gru_unit(
+            decoder_inputs, hidden, decoder_size * 3)
+        logits = fluid.layers.fc(input=h, size=trg_dict_dim)
+    return dict(
+        prefill=prefill,
+        prefill_startup=prefill_startup,
+        step=step,
+        step_startup=step_startup,
+        prefill_feeds=['src_word_id'],
+        prefill_fetches=[boot],
+        token='gen_token',
+        logits=logits,
+        state=[('gen_hidden', h)],
+        start_id=start_id,
+        end_id=end_id,
+        max_len=max_len)

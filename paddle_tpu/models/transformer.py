@@ -16,7 +16,7 @@ import numpy as np
 
 import paddle_tpu.fluid as fluid
 
-__all__ = ['build', 'position_encoding']
+__all__ = ['build', 'position_encoding', 'build_step_decode']
 
 
 def position_encoding(max_len, d_model):
@@ -119,3 +119,117 @@ def build(src_vocab=1000,
         feeds=['src_ids', 'trg_ids', 'lbl_ids'],
         prediction=prediction,
         loss=avg_cost)
+
+
+def build_step_decode(vocab=1000,
+                      d_model=64,
+                      d_k=64,
+                      max_ctx=32,
+                      start_id=0,
+                      end_id=1,
+                      max_len=16):
+    """STEPWISE KV-cache greedy decode for the generation serving lane
+    (ISSUE 7): a single-layer incremental-attention decoder LM over a
+    dense prompt — the Transformer-shaped workload whose decode state
+    is a REAL per-request KV cache, exercising the slot cache's slab
+    (``[S, max_ctx, d_k]``) rather than a flat hidden vector.
+
+      prefill: (prompt ids [B, T, 1], lengths [B, 1]) -> the prompt's
+          K/V prefix ([B, T, d_k] each — admission zero-pads T up to
+          the ``max_ctx`` slab) + the write position (= prompt length);
+      step: (token, k_cache, v_cache, pos) -> the token's q/k/v
+          projections, k/v scattered into the cache at ``pos`` (one_hot
+          blend), dot-product attention over positions < pos+1
+          (sequence_mask; later rows are masked until written, so slab
+          zero-padding is invisible), logits + advanced state.
+
+    Prefill and step genuinely SHARE weights (ParamAttr-pinned names:
+    the embedding and the K/V projections), so the cached prompt
+    prefix lives in the same projection space the step extends.  All
+    step ops are row-independent: the slot-batched decode scan is
+    token-identical to per-request decode."""
+    shared = {
+        'emb': fluid.ParamAttr(name='gen_tf_emb'),
+        'k': fluid.ParamAttr(name='gen_tf_wk'),
+        'v': fluid.ParamAttr(name='gen_tf_wv'),
+    }
+    prefill, prefill_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prefill, prefill_startup):
+        src = fluid.layers.data(name='gen_src', shape=[-1, 1],
+                                dtype='int64')
+        src_len = fluid.layers.data(name='gen_src_len', shape=[1],
+                                    dtype='float32')
+        embp = fluid.layers.embedding(src, size=[vocab, d_model],
+                                      param_attr=shared['emb'])
+        k0 = fluid.layers.fc(embp, d_k, bias_attr=False,
+                             num_flatten_dims=2, param_attr=shared['k'])
+        v0 = fluid.layers.fc(embp, d_k, bias_attr=False,
+                             num_flatten_dims=2, param_attr=shared['v'])
+        pos0 = fluid.layers.scale(src_len, scale=1.0)
+    step, step_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(step, step_startup):
+        token = fluid.layers.data(name='gen_token', shape=[1],
+                                  dtype='int64')
+        k_cache = fluid.layers.data(name='gen_k', shape=[max_ctx, d_k],
+                                    dtype='float32')
+        v_cache = fluid.layers.data(name='gen_v', shape=[max_ctx, d_k],
+                                    dtype='float32')
+        pos = fluid.layers.data(name='gen_pos', shape=[1],
+                                dtype='float32')
+        embt = fluid.layers.embedding(token, size=[vocab, d_model],
+                                      param_attr=shared['emb'])
+        q = fluid.layers.fc(embt, d_k, bias_attr=False)
+        k_new = fluid.layers.fc(embt, d_k, bias_attr=False,
+                                param_attr=shared['k'])
+        v_new = fluid.layers.fc(embt, d_k, bias_attr=False,
+                                param_attr=shared['v'])
+
+        # scatter this token's k/v into the cache row ``pos``
+        onehot = fluid.layers.one_hot(pos, max_ctx)  # [B, max_ctx]
+        oh3 = fluid.layers.expand(
+            fluid.layers.unsqueeze(onehot, axes=[2]), [1, 1, d_k])
+        keep3 = fluid.layers.scale(oh3, scale=-1.0, bias=1.0)
+
+        def scatter(cache, new):
+            new3 = fluid.layers.expand(
+                fluid.layers.unsqueeze(new, axes=[1]), [1, max_ctx, 1])
+            return fluid.layers.elementwise_add(
+                fluid.layers.elementwise_mul(cache, keep3),
+                fluid.layers.elementwise_mul(new3, oh3))
+
+        k2 = scatter(k_cache, k_new)
+        v2 = scatter(v_cache, v_new)
+
+        # dot-product attention over the written prefix (rows <= pos)
+        q3 = fluid.layers.expand(
+            fluid.layers.unsqueeze(q, axes=[1]), [1, max_ctx, 1])
+        scores = fluid.layers.scale(
+            fluid.layers.reduce_sum(
+                fluid.layers.elementwise_mul(k2, q3), dim=2),
+            scale=1.0 / float(d_k)**0.5)  # [B, max_ctx]
+        pos1 = fluid.layers.scale(pos, scale=1.0, bias=1.0)
+        seqmask = fluid.layers.sequence_mask(pos1, maxlen=max_ctx,
+                                             dtype='float32')
+        masked = fluid.layers.elementwise_add(
+            fluid.layers.elementwise_mul(scores, seqmask),
+            fluid.layers.scale(seqmask, scale=1e9, bias=-1e9))
+        attn = fluid.layers.softmax(masked)
+        attn3 = fluid.layers.expand(
+            fluid.layers.unsqueeze(attn, axes=[2]), [1, 1, d_k])
+        ctxv = fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(v2, attn3), dim=1)  # [B, d_k]
+        h = fluid.layers.fc([ctxv, q], d_model, act='tanh')
+        logits = fluid.layers.fc(h, vocab)
+    return dict(
+        prefill=prefill,
+        prefill_startup=prefill_startup,
+        step=step,
+        step_startup=step_startup,
+        prefill_feeds=['gen_src', 'gen_src_len'],
+        prefill_fetches=[k0, v0, pos0],
+        token='gen_token',
+        logits=logits,
+        state=[('gen_k', k2), ('gen_v', v2), ('gen_pos', pos1)],
+        start_id=start_id,
+        end_id=end_id,
+        max_len=max_len)

@@ -7,6 +7,16 @@ multi-step eval dispatch (Executor.run_eval_multi /
 ParallelExecutor.run_eval_multi for dp>1 sharded serving), and engine
 metrics surfaced through fluid.profiler's timeline.
 
+Generation: an engine built with ``generation=GenerationSpec(...)``
+gains ``submit_generate`` — a continuous-batching autoregressive decode
+lane: prompts prefill through the normal micro-batch/bucketing
+machinery, per-request decoder state (KV/hidden) lives in a slot-based
+``SlotStateCache`` resident in HBM, and an in-jit decode scan
+(Executor.run_decode_multi / ParallelExecutor.run_decode_multi) runs K
+greedy steps per dispatch over the whole slot batch with per-request
+stop conditions masked inside — token-identical to per-request decode
+at a fraction of the dispatches.
+
 Multi-model: ``ModelRegistry`` hosts N named engines over one shared
 device/mesh with cross-model HBM arbitration (``HBMArbiter``) —
 budgeted admission, LRU weight eviction to host memory with transparent
@@ -25,6 +35,8 @@ README 'Serving engine' / 'Multi-model serving' sections for the knobs.
 from .arbiter import HBMArbiter, HBMBudgetError  # noqa: F401
 from .batcher import InferenceRequest, MicroBatcher  # noqa: F401
 from .buckets import ShapeBucketSet, TrailingDimBuckets  # noqa: F401
+from .decode import GenerationRequest, GenerationSpec, \
+    SlotStateCache  # noqa: F401
 from .engine import InferenceEngine, ServingConfig  # noqa: F401
 from .metrics import EngineMetrics  # noqa: F401
 from .registry import ModelRegistry  # noqa: F401
@@ -32,4 +44,5 @@ from .registry import ModelRegistry  # noqa: F401
 __all__ = ['InferenceEngine', 'ServingConfig', 'MicroBatcher',
            'InferenceRequest', 'ShapeBucketSet', 'TrailingDimBuckets',
            'EngineMetrics', 'ModelRegistry', 'HBMArbiter',
-           'HBMBudgetError']
+           'HBMBudgetError', 'GenerationSpec', 'GenerationRequest',
+           'SlotStateCache']

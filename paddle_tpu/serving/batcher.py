@@ -40,7 +40,15 @@ class InferenceRequest(object):
     ``trace`` is the request's TraceContext (fluid.trace): the engine
     threads ONE trace id from submit() through the micro-batch lot,
     dispatch, device sync and per-request trim, so a delivered request
-    answers "where did my latency go" via ``breakdown()``."""
+    answers "where did my latency go" via ``breakdown()``.
+
+    ``kind`` partitions the queue's lot space (ISSUE 7): 'forward'
+    requests coalesce into eval lots, 'generate' ones
+    (GenerationRequest) into PREFILL lots the engine routes to the
+    decode lane — the two kinds never share a lot even if their feed
+    signatures collide."""
+
+    kind = 'forward'
 
     def __init__(self, feed, rows, sig, return_numpy=True, trailing=None,
                  trace=None):
@@ -145,7 +153,10 @@ class MicroBatcher(object):
         if head.rows is None:
             return lot, rows  # unbatchable: its own lot
         for req in list(self._pending)[1:]:
-            if req.sig != head.sig or req.rows is None:
+            # same signature AND same kind: a forward request must
+            # never ride a prefill lot (different program + fetches)
+            if req.sig != head.sig or req.rows is None or \
+                    req.kind != head.kind:
                 continue
             if rows + req.rows > self.max_batch_size:
                 break

@@ -1,0 +1,353 @@
+"""Generation serving: the slot-based decode-state cache and its
+request/spec types (ISSUE 7).
+
+The reference serves generation one graph call per decode step per
+request (the beam-search/decode ops of the Fluid op layer, driven by a
+host loop) — on TPU that measures the ~100ms dispatch tunnel, not the
+chip.  The engine's decode lane amortizes it the same way run_multi
+amortized training steps, with three pieces living here:
+
+  * **GenerationSpec** — the model contract: a PREFILL program (prompt
+    feeds -> initial per-request decoder state) and a STEP program
+    (current token + state -> next-token logits + next state), wired
+    by name.  The step program must be row-independent (each slot's
+    math touches only its own row — the same contract micro-batched
+    forward serving already imposes), so a batched slot dispatch is
+    token-identical to per-request decode.
+  * **SlotStateCache** — S fixed slots of decoder state (KV/hidden)
+    resident in HBM, plus the per-slot token/alive/step-budget leaves
+    the in-jit decode scan carries.  Requests ADMIT into free slots at
+    step boundaries and RELEASE on finish — continuous batching, no
+    drain barrier.  The cache is a first-class ``HBMArbiter`` account
+    in the registry (``<model>:decode-cache``): an idle generation
+    model's slabs evict to host and re-stage transparently.
+  * **GenerationRequest** — the future ``submit_generate`` returns;
+    resolves to the generated token ids (EOS-terminated or cut at
+    ``max_len``), with the PR-6 trace threading prefill/decode/
+    detokenize stages and a ``decode_steps`` count.
+"""
+
+import threading
+
+import numpy as np
+
+from ..fluid.executor import _is_host_op
+from .batcher import InferenceRequest
+
+__all__ = ['GenerationSpec', 'SlotStateCache', 'GenerationRequest']
+
+
+def _slot_shape(program, name, what):
+    """The per-slot (batch-free) shape + dtype a step-program feed
+    declares.  Slot state must be STATIC-shaped: the cache is one
+    resident [S, ...] array per feed, so a dynamic non-batch dim has
+    no single slab to allocate."""
+    var = program.global_block().vars.get(name)
+    if var is None:
+        raise ValueError('%s: %r is not a variable of the step program'
+                         % (what, name))
+    shape = tuple(var.shape)
+    trailing = tuple(int(d) for d in shape[1:])
+    if any(d < 0 for d in trailing):
+        raise ValueError(
+            '%s: feed %r declares a dynamic non-batch dim %s — slot '
+            'state needs a static per-slot shape (size the cache axis, '
+            'e.g. the KV length, to its maximum)' % (what, name, shape))
+    return trailing, var.np_dtype
+
+
+class GenerationSpec(object):
+    """The generation model contract the engine's decode lane serves.
+
+    prefill_program: prompt feeds -> the initial per-request decoder
+        state, ONE fetch per ``state`` + ``context`` feed (in that
+        order).  Served through the engine's normal lot machinery, so
+        prompts micro-batch, shape-bucket, and ride the trailing-dim
+        (seq-len) ladder like any forward request.
+    step_program: ``token_feed`` + state/context feeds -> ``logits``
+        (argmax = next token, greedy) + one fetch per ``state`` feed.
+        Must be host-op free and row-independent.
+    state: ordered (step_feed_name, step_fetch_var) pairs — the
+        decoder state that UPDATES every step (hidden vectors, KV
+        caches, position counters).
+    context: step feed names that are per-request but FROZEN during
+        decode (e.g. encoder outputs); their initial values come from
+        the prefill fetches after the state ones.
+    start_id / end_id: BOS fed at the first step / EOS stop condition.
+    max_len: default (and cap for) per-request generation budget.
+
+    Prefill fetches may be NARROWER than the slot shape on trailing
+    axes (a prompt's KV prefix vs the full cache length): admission
+    zero-pads them up to the slab — the step program masks what it
+    has not written, exactly like rung padding under @SEQLEN.
+    """
+
+    def __init__(self, prefill_program, step_program, prefill_feeds,
+                 prefill_fetches, token_feed, logits, state,
+                 context=(), start_id=0, end_id=1, max_len=32):
+        self.prefill_program = prefill_program
+        self.step_program = step_program
+        self.prefill_feeds = list(prefill_feeds)
+        self.prefill_fetches = list(prefill_fetches)
+        self.token_feed = str(token_feed)
+        self.logits = logits
+        if isinstance(state, dict):
+            state = list(state.items())
+        self.state = [(str(n), v) for n, v in state]
+        self.context = [str(n) for n in context]
+        self.start_id = int(start_id)
+        self.end_id = int(end_id)
+        self.max_len = int(max_len)
+        if self.max_len < 1:
+            raise ValueError('GenerationSpec: max_len must be >= 1')
+        if not self.state:
+            raise ValueError(
+                'GenerationSpec: at least one state pair is required — '
+                'a stateless step function has nothing to carry across '
+                'decode steps')
+        self.slot_feeds = [n for n, _ in self.state] + self.context
+        if len(self.prefill_fetches) != len(self.slot_feeds):
+            raise ValueError(
+                'GenerationSpec: prefill_fetches (%d) must align with '
+                'the state + context feeds (%d: %s) — one initial value '
+                'each, in order' % (len(self.prefill_fetches),
+                                    len(self.slot_feeds),
+                                    self.slot_feeds))
+        for prog, label in ((prefill_program, 'prefill_program'),
+                            (step_program, 'step_program')):
+            if any(_is_host_op(op) for op in prog.global_block().ops):
+                raise ValueError(
+                    'GenerationSpec: %s contains host ops and cannot '
+                    'run inside the decode lane' % label)
+        # per-slot slab shapes/dtypes, from the step program's own feed
+        # declarations (they key the cache allocation AND the admission
+        # padding)
+        self.slot_shapes = {}
+        self.slot_dtypes = {}
+        for name in self.slot_feeds + [self.token_feed]:
+            shape, dtype = _slot_shape(step_program, name,
+                                       'GenerationSpec')
+            self.slot_shapes[name] = shape
+            self.slot_dtypes[name] = dtype
+
+    @classmethod
+    def from_model(cls, model, max_len=None):
+        """Build a spec from the dict contract the model zoo's
+        ``build_step_decode`` builders return (prefill/step programs,
+        feed/fetch wiring, token ids)."""
+        return cls(model['prefill'], model['step'],
+                   model['prefill_feeds'], model['prefill_fetches'],
+                   model['token'], model['logits'], model['state'],
+                   context=model.get('context', ()),
+                   start_id=model['start_id'], end_id=model['end_id'],
+                   max_len=(model['max_len'] if max_len is None
+                            else max_len))
+
+    def decode_arg(self):
+        """The ``decode=`` dict run_decode_multi takes."""
+        return {'token': self.token_feed, 'logits': self.logits,
+                'state': list(self.state), 'context': list(self.context),
+                'end_id': self.end_id}
+
+    def cache_nbytes(self, slots):
+        """The slot cache's HBM bytes at ``slots`` slots — computable
+        BEFORE allocation (the arbiter's admission seed for the
+        ``<model>:decode-cache`` account)."""
+        total = 0
+        for name in self.slot_feeds:
+            shape = (int(slots), ) + self.slot_shapes[name]
+            total += int(np.prod(shape)) * \
+                np.dtype(self.slot_dtypes[name]).itemsize
+        # token [S, 1] + alive [S] + remaining [S]
+        total += int(slots) * (
+            np.dtype(self.slot_dtypes[self.token_feed]).itemsize + 1 + 4)
+        return total
+
+
+class GenerationRequest(InferenceRequest):
+    """One ``submit_generate`` future: resolves to the generated token
+    ids (int64 ndarray; EOS-terminated, or cut at ``max_len``).  The
+    prompt rides the prefill lot exactly like a forward request; then
+    the request occupies ONE decode slot until its stop condition
+    masks it out inside the scan."""
+
+    kind = 'generate'
+
+    def __init__(self, feed, rows, sig, max_len, return_numpy=True,
+                 trace=None):
+        super(GenerationRequest, self).__init__(
+            feed, rows, sig, return_numpy=return_numpy, trace=trace)
+        self.max_len = int(max_len)
+        self.tokens = []
+        self.slot = None
+
+
+class SlotStateCache(object):
+    """S fixed decode slots resident in HBM: one [S, ...] slab per
+    state/context feed plus the scan-carry leaves (token/alive/
+    remaining).  Slot ADMISSION writes a request's prefilled state into
+    a free row (zero-padding narrow trailing axes up to the slab);
+    RELEASE frees the row for the next admission — both at step
+    boundaries, which is all continuous batching needs.
+
+    Array leaves are swapped whole-reference by the owning engine's
+    decode cycle (single worker thread, or the inline lock); the small
+    host-side slot map is lock-guarded so the watchdog's snapshot can
+    race a cycle safely."""
+
+    def __init__(self, spec, slots, multiple=1):
+        if int(slots) < 1:
+            raise ValueError('SlotStateCache: slots must be >= 1')
+        multiple = max(int(multiple), 1)
+        # round UP to the mesh's dp extent: sharded decode needs the
+        # slot dim divisible over the batch axis
+        self.slots = -(-int(slots) // multiple) * multiple
+        self.spec = spec
+        s = self.slots
+        tok_dtype = spec.slot_dtypes[spec.token_feed]
+        self._slabs = {
+            name: np.zeros((s, ) + spec.slot_shapes[name],
+                           spec.slot_dtypes[name])
+            for name in spec.slot_feeds
+        }
+        self._token = np.full((s, 1), spec.end_id, tok_dtype)
+        self._alive = np.zeros((s, ), bool)
+        self._remaining = np.zeros((s, ), np.int32)
+        self._lock = threading.Lock()
+        self._requests = [None] * s
+        self._free = list(range(s))
+
+    # ---- carry plumbing (the decode scan's view) -----------------------
+
+    def carry(self):
+        return {'slots': dict(self._slabs), 'token': self._token,
+                'alive': self._alive, 'remaining': self._remaining}
+
+    def set_carry(self, carry):
+        self._slabs = dict(carry['slots'])
+        self._token = carry['token']
+        self._alive = carry['alive']
+        self._remaining = carry['remaining']
+
+    # ---- admission / release -------------------------------------------
+
+    def free_slots(self):
+        with self._lock:
+            return len(self._free)
+
+    def active_slots(self):
+        with self._lock:
+            return self.slots - len(self._free)
+
+    def any_active(self):
+        return self.active_slots() > 0
+
+    @staticmethod
+    def _write_row(arr, idx, row):
+        if isinstance(arr, np.ndarray):
+            arr = arr.copy() if not arr.flags.writeable else arr
+            arr[idx] = row
+            return arr
+        return arr.at[idx].set(row)
+
+    def admit(self, req, values):
+        """Write one prefilled request into a free slot: ``values`` are
+        the per-request prefill fetches ([1, ...] each, state + context
+        order), zero-padded up to the slab's trailing shape.  Returns
+        the slot index (the caller checked free_slots() first)."""
+        with self._lock:
+            if not self._free:
+                raise RuntimeError('SlotStateCache: no free slot')
+            idx = self._free.pop(0)
+            self._requests[idx] = req
+        for name, val in zip(self.spec.slot_feeds, values):
+            row = np.asarray(val)
+            if row.ndim >= 1 and row.shape[0] == 1:
+                row = row[0]
+            want = self.spec.slot_shapes[name]
+            if row.shape != want:
+                if len(row.shape) != len(want) or \
+                        any(r > w for r, w in zip(row.shape, want)):
+                    raise ValueError(
+                        'decode admission: prefill value for %r has '
+                        'shape %s, slot slab is %s — prefill fetches '
+                        'must match the step program\'s declared state '
+                        'shape (or be narrower on trailing axes)'
+                        % (name, row.shape, want))
+                padded = np.zeros(want, row.dtype)
+                padded[tuple(slice(0, d) for d in row.shape)] = row
+                row = padded
+            self._slabs[name] = self._write_row(
+                self._slabs[name], idx,
+                row.astype(self.spec.slot_dtypes[name], copy=False))
+        self._token = self._write_row(
+            self._token, idx,
+            np.asarray([self.spec.start_id],
+                       self.spec.slot_dtypes[self.spec.token_feed]))
+        self._alive = self._write_row(self._alive, idx, True)
+        self._remaining = self._write_row(
+            self._remaining, idx, np.int32(min(req.max_len,
+                                               self.spec.max_len)))
+        req.slot = idx
+        return idx
+
+    def release(self, idx):
+        with self._lock:
+            req = self._requests[idx]
+            self._requests[idx] = None
+            self._free.append(idx)
+        if req is not None:
+            req.slot = None
+        return req
+
+    def request_at(self, idx):
+        with self._lock:
+            return self._requests[idx]
+
+    def active_requests(self):
+        with self._lock:
+            return [r for r in self._requests if r is not None]
+
+    # ---- accounting / observability ------------------------------------
+
+    def nbytes(self):
+        """Live bytes of every slab + carry leaf (host- or device-
+        resident — the account tracks the slabs wherever they sit)."""
+        total = 0
+        for arr in list(self._slabs.values()) + [
+                self._token, self._alive, self._remaining]:
+            total += int(getattr(arr, 'nbytes', 0))
+        return total
+
+    def to_host(self):
+        """Demote every slab to a host ndarray (bitwise — decode
+        resumes exactly after re-staging).  Returns bytes moved."""
+        moved = 0
+        import jax
+        for name, arr in list(self._slabs.items()):
+            if isinstance(arr, jax.Array):
+                self._slabs[name] = np.asarray(arr)
+                moved += int(arr.nbytes)
+        for attr in ('_token', '_alive', '_remaining'):
+            arr = getattr(self, attr)
+            if isinstance(arr, jax.Array):
+                setattr(self, attr, np.asarray(arr))
+                moved += int(arr.nbytes)
+        return moved
+
+    def snapshot(self):
+        """The flight recorder's slot-map view: who holds each slot
+        (trace ids), occupancy, and the cache's byte size — recorded on
+        decode dispatches and dumped on worker errors / watchdog
+        stalls."""
+        with self._lock:
+            return {
+                'slots': self.slots,
+                'active': self.slots - len(self._free),
+                'free': len(self._free),
+                'bytes': self.nbytes(),
+                'slot_trace_ids': [
+                    (r.trace_id if r is not None else None)
+                    for r in self._requests
+                ],
+            }

@@ -97,13 +97,22 @@ class ServingConfig(object):
         over its oldest queued request's age; crossing the threshold
         dumps the flight recorder (the post-mortem of a stuck worker).
         None (default) registers no probe.
+    decode_slots: slot count of the generation lane's resident decode
+        cache (ISSUE 7) — the continuous-batching degree.  Rounded UP
+        to the mesh's dp extent for sharded serving.  Only meaningful
+        when the engine was built with ``generation=``.
+    decode_steps: decode-scan steps per device dispatch (the K of the
+        in-jit greedy loop) — the generation lane's dispatch-tax
+        amortizer, bounded below the per-request latency a step
+        boundary adds to admission.
     """
 
     def __init__(self, max_batch_size=32, max_wait_ms=5.0,
                  steps_per_dispatch=4, pipeline_depth=2,
                  bucket_sizes=None, max_buckets=16,
                  trailing_buckets=True, trailing_ladders=None,
-                 max_trailing_buckets=32, watchdog_stall_s=None):
+                 max_trailing_buckets=32, watchdog_stall_s=None,
+                 decode_slots=8, decode_steps=4):
         if int(steps_per_dispatch) < 1:
             raise ValueError('steps_per_dispatch must be >= 1')
         if int(pipeline_depth) < 1:
@@ -131,19 +140,29 @@ class ServingConfig(object):
         self.max_trailing_buckets = int(max_trailing_buckets)
         self.watchdog_stall_s = (float(watchdog_stall_s)
                                  if watchdog_stall_s is not None else None)
+        if int(decode_slots) < 1:
+            raise ValueError('decode_slots must be >= 1')
+        if int(decode_steps) < 1:
+            raise ValueError('decode_steps must be >= 1')
+        self.decode_slots = int(decode_slots)
+        self.decode_steps = int(decode_steps)
 
 
 class _Lot(object):
-    """One padded, bucket-shaped batch of coalesced requests."""
+    """One padded, bucket-shaped batch of coalesced requests.
+    ``kind`` ('forward' | 'generate') routes the dispatch: forward lots
+    run the engine's program, generate lots run the generation spec's
+    PREFILL program and their results admit into decode slots."""
 
-    __slots__ = ('requests', 'feed', 'real', 'bucket', 'sig')
+    __slots__ = ('requests', 'feed', 'real', 'bucket', 'sig', 'kind')
 
-    def __init__(self, requests, feed, real, bucket, sig):
+    def __init__(self, requests, feed, real, bucket, sig, kind='forward'):
         self.requests = requests
         self.feed = feed
         self.real = real  # None for an unbatchable (LoD) lot
         self.bucket = bucket
         self.sig = sig
+        self.kind = kind
 
 
 class InferenceEngine(object):
@@ -152,7 +171,7 @@ class InferenceEngine(object):
 
     def __init__(self, program, feed_names=None, fetch_list=None,
                  place=None, scope=None, executor=None, parallel=False,
-                 mesh=None, config=None, name=None):
+                 mesh=None, config=None, name=None, generation=None):
         if fetch_list is None:
             raise ValueError('InferenceEngine: fetch_list is required '
                              '(the fetch targets returned by '
@@ -208,6 +227,32 @@ class InferenceEngine(object):
                 max_buckets=self.config.max_trailing_buckets)
         self._batcher = MicroBatcher(self.config.max_batch_size,
                                      self.config.max_wait_s)
+        # generation lane (ISSUE 7): a GenerationSpec turns on
+        # submit_generate — prompts prefill through the normal lot
+        # machinery, then decode in the slot-batched in-jit scan
+        self.generation = generation
+        self._decode_cache = None
+        self._gen_ready = deque()  # (request, prefill values) awaiting a slot
+        self._pe_prefill = self._pe_step = None
+        if generation is not None:
+            if self._eager:
+                raise NotImplementedError(
+                    'generation serving cannot run host-op programs — '
+                    'the decode scan is pure compute')
+            from .decode import SlotStateCache
+            self._decode_cache = SlotStateCache(
+                generation, self.config.decode_slots, multiple=multiple)
+            self._gen_decode_arg = generation.decode_arg()
+            if self._pe is not None:
+                # PE binds one program each: the prefill and step
+                # programs get their own sharded executors over the
+                # SAME mesh + scope (weights shared)
+                self._pe_prefill = ParallelExecutor(
+                    main_program=generation.prefill_program,
+                    scope=self._scope, mesh=self._pe._mesh)
+                self._pe_step = ParallelExecutor(
+                    main_program=generation.step_program,
+                    scope=self._scope, mesh=self._pe._mesh)
         self._metrics = EngineMetrics()
         self._inflight = deque()
         self._last_sync_t = 0.0  # previous drain's sync, clips MFU windows
@@ -325,8 +370,15 @@ class InferenceEngine(object):
             # thread races the worker); the queued ids below are
             # independent and must still make the dump
             pass
-        return {'queued_trace_ids': self._batcher.pending_trace_ids(),
-                'inflight_trace_ids': inflight}
+        ctx = {'queued_trace_ids': self._batcher.pending_trace_ids(),
+               'inflight_trace_ids': inflight}
+        if self._decode_cache is not None:
+            # the decode lane's view: who holds each slot (a stalled
+            # worker strands THEM mid-generation) and how many
+            # prefilled requests were still waiting for one
+            ctx['decode_slot_map'] = self._decode_cache.snapshot()
+            ctx['decode_pending'] = len(self._gen_ready)
+        return ctx
 
     def stop(self):
         """Drain the queue and all in-flight dispatches, then join."""
@@ -378,16 +430,25 @@ class InferenceEngine(object):
                 total += int(v.nbytes)
         return total
 
-    def drop_executables(self):
-        """Drop every compiled executable for THIS engine's program from
-        its executor(s): the compile-cache entries (and their jitted
-        multi/eval scans) die, releasing XLA's device-side executable
-        buffers.  Returns the number of cache entries dropped.  Only
-        this program's entries go — an executor shared with other
-        models keeps theirs."""
-        pid = id(self._program)
+    def drop_executables(self, programs=None):
+        """Drop every compiled executable for THIS engine's programs
+        from its executor(s): the compile-cache entries (and their
+        jitted multi/eval/decode scans) die, releasing XLA's
+        device-side executable buffers.  Returns the number of cache
+        entries dropped.  Only these programs' entries go — an executor
+        shared with other models keeps theirs.  ``programs`` narrows
+        the purge (the decode-cache eviction drops only the
+        prefill/step executables); the default covers the engine's
+        forward program plus the generation programs, if any."""
+        if programs is None:
+            programs = [self._program]
+            if self.generation is not None:
+                programs += [self.generation.prefill_program,
+                             self.generation.step_program]
+        pids = {id(p) for p in programs}
         dropped = 0
-        for runner in (self._exe, self._pe):
+        for runner in (self._exe, self._pe, self._pe_prefill,
+                       self._pe_step):
             cache = getattr(runner, '_cache', None)
             if not cache:
                 continue
@@ -398,7 +459,7 @@ class InferenceEngine(object):
             # contract, ParallelExecutor's from the cost-registry work)
             lock = getattr(runner, '_cache_lock', None)
             with lock if lock is not None else contextlib.nullcontext():
-                for k in [k for k in list(cache) if k[0] == pid]:
+                for k in [k for k in list(cache) if k[0] in pids]:
                     cache.pop(k, None)
                     dropped += 1
         return dropped
@@ -482,6 +543,75 @@ class InferenceEngine(object):
         """Synchronous convenience: submit + wait."""
         return self.submit(feed, return_numpy=return_numpy).result(timeout)
 
+    def submit_generate(self, feed, max_len=None, return_numpy=True):
+        """Enqueue one GENERATION request (ISSUE 7): ``feed`` is the
+        prompt (the generation spec's prefill feeds, ONE sequence —
+        rows must be 1), ``max_len`` the per-request step budget
+        (capped by the spec's).  Returns a GenerationRequest future
+        resolving to the generated token ids (greedy; EOS-terminated
+        or cut at max_len) — token-identical to a per-request
+        host-driven decode of the same prefill + step programs.
+
+        The prompt coalesces into PREFILL lots with other generation
+        requests (micro-batched, shape-bucketed, seq-len rung-
+        quantized like any forward request); the prefilled state then
+        ADMITS into a free decode slot at the next step boundary and
+        rides the slot-batched in-jit decode scan — continuous
+        batching, no drain barrier against requests already decoding."""
+        from .decode import GenerationRequest
+        if self.generation is None:
+            raise RuntimeError(
+                'submit_generate: this engine serves no generation '
+                'model — construct it with generation=GenerationSpec(...)')
+        if self._closed:
+            raise RuntimeError('engine is closed')
+        spec = self.generation
+        if not isinstance(feed, dict) or not feed:
+            raise ValueError('feed must be a non-empty {name: data} dict')
+        missing = set(spec.prefill_feeds) - set(feed)
+        extra = set(feed) - set(spec.prefill_feeds)
+        if missing or extra:
+            raise ValueError(
+                'submit_generate: feed names %s do not match the '
+                'prefill program (missing %s, unexpected %s)'
+                % (sorted(feed), sorted(missing), sorted(extra)))
+        max_len = spec.max_len if max_len is None else int(max_len)
+        if max_len < 1:
+            raise ValueError('submit_generate: max_len must be >= 1')
+        ctx = _trace.current() or _trace.TraceContext()
+        t_prep = time.time()
+        feed, rows, sig, _trims = self._prepare_request(feed)
+        ctx.add_stage('pad', time.time() - t_prep)
+        if rows is None:
+            # the unbatchable path (nested LoD, or an LoD prompt with
+            # trailing bucketing disabled) has no coalescible prefill
+            # signature — say WHY instead of 'got None rows'
+            raise ValueError(
+                'submit_generate: this prompt cannot ride the batched '
+                'prefill path — nested (2-level) LoD prompts are '
+                'unsupported, and LoD prompts need trailing bucketing '
+                '(drop ServingConfig(trailing_buckets=False))')
+        if rows != 1:
+            raise ValueError(
+                'submit_generate: the prompt must be ONE sequence '
+                '(got %r rows) — submit one request per sequence so '
+                'each occupies one decode slot' % (rows, ))
+        # the 'gen' sig prefix keeps prefill lots out of forward lots
+        # even when the raw feed signatures collide
+        req = GenerationRequest(feed, rows, ('gen', ) + tuple(sig),
+                                min(max_len, spec.max_len),
+                                return_numpy=return_numpy, trace=ctx)
+        self._metrics.note_generate()
+        ctx.mark('enqueue')
+        self._batcher.submit(req)
+        if self._thread is None:
+            self._drain_inline()
+        return req
+
+    def generate(self, feed, max_len=None, timeout=None):
+        """Synchronous convenience: submit_generate + wait."""
+        return self.submit_generate(feed, max_len=max_len).result(timeout)
+
     def metrics(self):
         """Engine snapshot + bucket report + the executor's own XLA
         compile counter (the ground truth the bucket policy bounds)."""
@@ -492,7 +622,18 @@ class InferenceEngine(object):
         snap['executor_compile_count'] = (
             self._pe.compile_count if self._pe is not None
             else self._exe.compile_count)
+        if self._pe is not None and self._pe_step is not None:
+            # sharded generation compiles its prefill/step executables
+            # on their own PEs — fold them into the ground-truth count
+            snap['executor_compile_count'] += (
+                self._pe_prefill.compile_count +
+                self._pe_step.compile_count)
         snap['inflight'] = len(self._inflight)
+        snap['decode'] = (self._metrics.decode_snapshot(
+            active_slots=self._decode_cache.active_slots(),
+            free_slots=self._decode_cache.free_slots(),
+            pending=len(self._gen_ready))
+            if self._decode_cache is not None else None)
         return snap
 
     # ---- request -> lot -----------------------------------------------
@@ -684,7 +825,7 @@ class InferenceEngine(object):
             if head.trace is not None:
                 head.trace.mark('lot')
             return _Lot(requests, dict(head.feed), None, None,
-                        ('nobatch', id(head)))
+                        ('nobatch', id(head)), kind=head.kind)
         rows = sum(r.rows for r in requests)
         bucket = self.buckets.bucket_for(rows)
         names = set(head.feed)
@@ -709,8 +850,11 @@ class InferenceEngine(object):
         for r in requests:
             if r.trace is not None:
                 r.trace.mark('lot', t_lot)
+        # kind is part of the block sig: a prefill lot must never share
+        # a scan block with a forward lot of a coinciding signature
         return _Lot(requests, feed, real, target,
-                    (target, feed_signature(feed)))
+                    (head.kind, target, feed_signature(feed)),
+                    kind=head.kind)
 
     # ---- dispatch / deliver -------------------------------------------
 
@@ -722,7 +866,21 @@ class InferenceEngine(object):
         if self._eager:
             return self._dispatch_eager(lots)
         t0 = time.time()
-        runner = self._pe if self._pe is not None else self._exe
+        prefill = lots[0].kind == 'generate'
+        if prefill:
+            # a prefill lot runs the generation spec's PREFILL program,
+            # fetching the initial decoder state instead of the
+            # engine's fetch list — same scan machinery, different
+            # executable set
+            program = self.generation.prefill_program
+            fetch_list = self.generation.prefill_fetches
+            runner = self._pe_prefill if self._pe is not None \
+                else self._exe
+            self._metrics.note_prefill_lot()
+        else:
+            program = self._program
+            fetch_list = self._fetch_list
+            runner = self._pe if self._pe is not None else self._exe
         before = runner.compile_count
         trace_ids = [r.trace_id for lot in lots for r in lot.requests]
         # the flight recorder's lot record goes in BEFORE the dispatch:
@@ -730,21 +888,22 @@ class InferenceEngine(object):
         # what was being dispatched, not just what already succeeded
         _trace.flight_recorder.record(
             'serving_dispatch', engine=self.name, lots=len(lots),
+            lot_kind=lots[0].kind,
             bucket=lots[0].bucket, sig=repr(lots[0].sig)[:128],
             rows=[lot.real for lot in lots], trace_ids=trace_ids)
         try:
             with self._gated():
                 if self._pe is not None:
                     stacked, reals, target, compiled, k = \
-                        self._pe._dispatch_eval_multi(
-                            self._fetch_list,
+                        runner._dispatch_eval_multi(
+                            fetch_list,
                             feed_list=[l.feed for l in lots])
                 else:
                     stacked, reals, target, compiled, k = \
                         self._exe._dispatch_eval_multi(
-                            self._program,
+                            program,
                             feed_list=[l.feed for l in lots],
-                            fetch_list=self._fetch_list, scope=self._scope)
+                            fetch_list=fetch_list, scope=self._scope)
         except Exception as exc:
             self._metrics.note_error()
             _trace.flight_recorder.dump(
@@ -895,10 +1054,21 @@ class InferenceEngine(object):
                             real = req.trailing.get(np.shape(step)[1])
                             if real is not None:
                                 step = step[:, :real]
-                    if not req.return_numpy:
+                    if not req.return_numpy and req.kind != 'generate':
+                        # a generate request's prefill slices feed slot
+                        # admission — they stay raw arrays regardless
                         step = core.LoDTensor(np.asarray(step))
                     res.append(step)
                 offset += req.rows or 0
+                if req.kind == 'generate':
+                    # a PREFILL result: the per-request state slices
+                    # queue for slot admission at the next decode step
+                    # boundary (continuous batching — no drain barrier
+                    # against slots already decoding); the future
+                    # resolves when the decode lane finishes the
+                    # request
+                    self._gen_ready.append((req, res))
+                    continue
                 if req.trace is not None:
                     # finalize BEFORE resolving the future: a caller
                     # woken by result() must see a complete breakdown
@@ -913,6 +1083,126 @@ class InferenceEngine(object):
             _profiler.record_event(
                 self._spans + 'dispatch[x%d]' % len(lots),
                 time.time() - t0, start=t0)
+
+    # ---- decode lane (ISSUE 7) ----------------------------------------
+
+    def _admit_ready(self):
+        """Admit prefilled generation requests into free decode slots
+        (step-boundary admission — the host half of continuous
+        batching).  Returns how many were admitted."""
+        admitted = 0
+        while self._gen_ready and self._decode_cache.free_slots():
+            req, values = self._gen_ready.popleft()
+            if req.done():
+                continue  # errored upstream; nothing to decode
+            try:
+                self._decode_cache.admit(req, values)
+            except Exception as exc:
+                self._metrics.note_error()
+                req.set_error(exc)
+                continue
+            if req.trace is not None:
+                req.trace.mark('admit')
+            admitted += 1
+        return admitted
+
+    def _decode_cycle(self):
+        """One decode-lane turn: admit whatever prefilled requests fit
+        into free slots, run ONE K-step in-jit decode scan over the
+        whole slot batch (stop conditions masked inside), and deliver
+        the requests the scan finished.  Returns True when a scan
+        dispatched."""
+        cache = self._decode_cache
+        if cache is None:
+            return False
+        self._admit_ready()
+        if not cache.any_active():
+            return False
+        k = self.config.decode_steps
+        snap = cache.snapshot()
+        # slot-map snapshot BEFORE the dispatch: a wedged or erroring
+        # decode scan must leave the occupancy picture in the ring
+        _trace.flight_recorder.record(
+            'decode_lot', engine=self.name, steps=k, slot_map=snap)
+        try:
+            with self._gated():
+                if self._pe is not None:
+                    carry, toks, alive_in = self._pe_step.run_decode_multi(
+                        carry=cache.carry(), steps=k,
+                        decode=self._gen_decode_arg)
+                else:
+                    carry, toks, alive_in = self._exe.run_decode_multi(
+                        self.generation.step_program,
+                        carry=cache.carry(), steps=k,
+                        decode=self._gen_decode_arg, scope=self._scope)
+            toks = np.asarray(toks)          # the sync point
+            alive_in = np.asarray(alive_in)
+            alive_after = np.asarray(carry['alive'])
+        except Exception as exc:
+            self._metrics.note_error()
+            _trace.flight_recorder.dump(
+                'decode_error:%s' % self.name, error=repr(exc),
+                slot_map=snap)
+            for req in cache.active_requests():
+                cache.release(req.slot)
+                req.set_error(exc)
+            return True
+        cache.set_carry(carry)
+        t_sync = time.time()
+        finished = 0
+        for s in range(cache.slots):
+            req = cache.request_at(s)
+            if req is None:
+                continue
+            req.tokens.extend(int(t) for t in toks[alive_in[:, s], s])
+            if not alive_after[s]:
+                if req.trace is not None:
+                    req.trace.mark('decode_end', t_sync)
+                cache.release(s)
+                self._finish_generate(req)
+                finished += 1
+        self._metrics.note_decode_dispatch(
+            k, int(alive_in.sum()), k * cache.slots, finished)
+        if _profiler.is_profiler_enabled() or _trace.spans_enabled():
+            _profiler.record_event(self._spans + 'decode[x%d]' % k,
+                                   time.time() - t_sync, start=t_sync)
+        return True
+
+    def _finish_generate(self, req):
+        """Deliver one finished generation request: token ids out,
+        trace finalized (prefill/decode/detokenize stages + the
+        decode_steps count) BEFORE the future resolves."""
+        out = np.asarray(req.tokens, np.int64)
+        if req.trace is not None:
+            req.trace.add_count('decode_steps', len(req.tokens))
+            self._metrics.note_stages(req.trace.finalize())
+            _trace.record_span(
+                self._spans + 'generate', req.trace.t0,
+                req.trace.e2e_s, trace_id=req.trace_id)
+        req.set_result(out)
+        if req.latency_s is not None:
+            self._metrics.note_latency(req.latency_s)
+
+    def _gen_busy(self):
+        """True while the generation lane has work: prefilled requests
+        awaiting slots, or slots actively decoding."""
+        return self._decode_cache is not None and (
+            bool(self._gen_ready) or self._decode_cache.any_active())
+
+    def evict_decode_cache(self):
+        """Demote the decode slot cache to host memory under a
+        paused() window (bitwise — in-flight generations resume exactly
+        after transparent re-staging) and drop the prefill/step
+        executables.  Returns bytes moved — the registry's arbiter
+        calls this to release an idle generation model's slabs."""
+        if self._decode_cache is None:
+            return 0
+        with self.paused():
+            moved = self._decode_cache.to_host()
+            self.drop_executables(programs=(
+                self.generation.prefill_program,
+                self.generation.step_program))
+        return moved
 
     # ---- worker -------------------------------------------------------
 
@@ -965,16 +1255,18 @@ class InferenceEngine(object):
                 if not self._carry:
                     # idle engine blocks on the queue's condition var
                     # (submit/close notify) OUTSIDE the cycle lock, so a
-                    # paused() window never has to wait for traffic;
-                    # only an awaiting in-flight dispatch warrants the
-                    # short drain poll
+                    # paused() window never has to wait for traffic; an
+                    # awaiting in-flight dispatch — or a busy decode
+                    # lane, which must keep stepping between arrivals —
+                    # warrants the short drain poll
                     reqs = self._batcher.next_lot(
-                        timeout=poll if self._inflight else None)
+                        timeout=poll if (self._inflight or
+                                         self._gen_busy()) else None)
                     if reqs is None:
                         break  # closed and drained
-                # one collect->dispatch->drain cycle is the pause unit:
-                # paused() holds the cycle lock while weights move, and
-                # the worker parks HERE between cycles
+                # one collect->dispatch->drain->decode cycle is the
+                # pause unit: paused() holds the cycle lock while
+                # weights move, and the worker parks HERE between cycles
                 with self._cycle_lock:
                     if self._carry and not reqs:
                         self._dispatch(
@@ -983,16 +1275,26 @@ class InferenceEngine(object):
                         lot = self._safe_make_lot(reqs)
                         if lot is not None:
                             self._dispatch(self._collect_block(lot))
-                    elif self._inflight:
+                    elif self._inflight and not self._gen_busy():
                         self._drain_one()  # idle: deliver early
-                        continue
-                    else:
-                        continue
                     # pipeline backpressure: keep at most pipeline_depth
                     # dispatches in flight — host feeds N+1 while N
                     # computes
                     while len(self._inflight) >= self.config.pipeline_depth:
                         self._drain_one()
+                    if self._decode_cache is not None:
+                        # deliver completed dispatches even while the
+                        # decode lane is busy: a forward future ready
+                        # after one cycle must not wait out every
+                        # active generation, and a prefill stuck in
+                        # the pipeline while slots sit free starves
+                        # admission
+                        if self._inflight and self._gen_busy():
+                            self._drain_one()
+                        # one decode scan per cycle: forward lots and
+                        # decode steps interleave on the worker, so
+                        # neither lane can starve the other
+                        self._decode_cycle()
             except Exception as exc:
                 # belt-and-braces: _dispatch/_drain_one already error
                 # their own lots' futures; whatever still escapes must
@@ -1005,6 +1307,11 @@ class InferenceEngine(object):
                 self._dispatch([self._carry.popleft()])
             while self._inflight:
                 self._drain_one()
+            # run the generation lane dry: admitted requests decode to
+            # their stop conditions, prefilled ones admit as slots free
+            while self._gen_busy():
+                if not self._decode_cycle():
+                    break
 
     def _drain_inline(self):
         """Synchronous mode: flush + dispatch + deliver on the calling
@@ -1013,16 +1320,25 @@ class InferenceEngine(object):
         never-start()ed engine must not interleave on _inflight/_carry."""
         with self._inline_lock:
             while True:
+                progressed = False
                 if self._carry:
                     self._dispatch(
                         self._collect_block(self._carry.popleft()))
+                    progressed = True
                 else:
                     reqs = self._batcher.next_lot(timeout=0, force=True)
-                    if not reqs:
-                        break
-                    lot = self._safe_make_lot(reqs)
-                    if lot is None:
-                        continue
-                    self._dispatch(self._collect_block(lot))
+                    if reqs:
+                        lot = self._safe_make_lot(reqs)
+                        if lot is not None:
+                            self._dispatch(self._collect_block(lot))
+                        progressed = True
                 while self._inflight:
                     self._drain_one()
+                    progressed = True
+                # generation work drains synchronously too: decode
+                # cycles run until every submitted request finished
+                # (inline mode has no worker to step the lane later)
+                if self._gen_busy():
+                    progressed = self._decode_cycle() or progressed
+                if not progressed and not self._carry:
+                    break

@@ -58,6 +58,17 @@ class EngineMetrics(object):
         # entry — achieved-MFU's numerator/denominator
         self.device_flops = 0.0
         self.device_seconds = 0.0
+        # generation lane (ISSUE 7): continuous-batching decode.
+        # decode_tokens counts REAL emitted tokens (alive slot-steps);
+        # decode_slot_steps counts K*S scan capacity — their ratio is
+        # the slot occupancy the admission policy achieved.
+        self.decode_requests = 0
+        self.decode_finished = 0
+        self.decode_dispatches = 0
+        self.decode_scan_steps = 0
+        self.decode_tokens = 0
+        self.decode_slot_steps = 0
+        self.prefill_lots = 0
 
     def note_request(self, rows):
         with self._lock:
@@ -104,12 +115,64 @@ class EngineMetrics(object):
                 self.stage_s[stage] = self.stage_s.get(stage, 0.0) + \
                     float(s)
 
+    def note_generate(self):
+        with self._lock:
+            self.decode_requests += 1
+
+    def note_prefill_lot(self):
+        with self._lock:
+            self.prefill_lots += 1
+
+    def note_decode_dispatch(self, scan_steps, alive_slot_steps,
+                             slot_steps, finished):
+        """One drained decode scan: K scan steps over S slots, of which
+        ``alive_slot_steps`` emitted real tokens and ``finished``
+        requests hit their stop condition inside the scan."""
+        with self._lock:
+            self.decode_dispatches += 1
+            self.decode_scan_steps += int(scan_steps)
+            self.decode_tokens += int(alive_slot_steps)
+            self.decode_slot_steps += int(slot_steps)
+            self.decode_finished += int(finished)
+
     def note_device(self, flops, seconds):
         """One drained dispatch's cost-analysis FLOPs + wall seconds
         (dispatch issue -> host sync) — accumulates achieved MFU."""
         with self._lock:
             self.device_flops += float(flops)
             self.device_seconds += float(seconds)
+
+    def decode_snapshot(self, active_slots=None, free_slots=None,
+                        pending=None):
+        """The generation lane's block of ``snapshot()`` (None when the
+        engine serves no generation model): request/token tallies, the
+        amortization ratios (tokens and scan steps per dispatch), and
+        the occupancy the continuous-batching admission achieved."""
+        with self._lock:
+            if not self.decode_requests:
+                return None
+            return {
+                'requests': self.decode_requests,
+                'finished': self.decode_finished,
+                'tokens': self.decode_tokens,
+                'dispatches': self.decode_dispatches,
+                'prefill_lots': self.prefill_lots,
+                'steps_per_dispatch': (
+                    round(self.decode_scan_steps /
+                          self.decode_dispatches, 3)
+                    if self.decode_dispatches else None),
+                'tokens_per_dispatch': (
+                    round(self.decode_tokens / self.decode_dispatches,
+                          3)
+                    if self.decode_dispatches else None),
+                'slot_occupancy': (
+                    round(self.decode_tokens / self.decode_slot_steps,
+                          4)
+                    if self.decode_slot_steps else None),
+                'active_slots': active_slots,
+                'free_slots': free_slots,
+                'pending': pending,
+            }
 
     def snapshot(self, queue_depth=0):
         """One coherent dict: counters plus the derived rates the

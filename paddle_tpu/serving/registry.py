@@ -60,6 +60,13 @@ from .engine import InferenceEngine, ServingConfig
 
 __all__ = ['ModelRegistry']
 
+# the decode-state cache's arbiter account rides next to its model's
+# weight account under this suffix (ISSUE 7): `<model>:decode-cache` —
+# evictable on its own (an idle generation model's slabs free without
+# demoting its weights) and typed-rejected at load when the cache alone
+# can never fit the budget
+DECODE_CACHE_SUFFIX = ':decode-cache'
+
 
 class _ModelEntry(object):
     __slots__ = ('name', 'engine', 'dirname', 'loaded_t', 'requests',
@@ -112,7 +119,7 @@ class ModelRegistry(object):
 
     def load(self, name, dirname=None, program=None, feed_names=None,
              fetch_list=None, scope=None, executor=None, config=None,
-             model_filename=None, params_filename=None):
+             model_filename=None, params_filename=None, generation=None):
         """Load a model under ``name``: either a save_inference_model
         ``dirname`` (own scope + executor, the production form) or an
         explicit ``program`` (+ fetch_list, and a scope holding its
@@ -134,6 +141,16 @@ class ModelRegistry(object):
                     'requests)' % name)
             cfg = config or self.config or ServingConfig()
             if dirname is not None:
+                if generation is not None:
+                    # checked BEFORE the engine exists: a post-
+                    # construction raise here would leak its profiler
+                    # registration + param scope (the cleanup except
+                    # below only guards the admission path)
+                    raise ValueError(
+                        'load(%r): generation= requires program= (the '
+                        'prefill/step programs reference live '
+                        'Variables, which a saved-model dir cannot '
+                        'carry)' % name)
                 engine = InferenceEngine.from_saved_model(
                     dirname, place=self.place,
                     model_filename=model_filename,
@@ -148,9 +165,10 @@ class ModelRegistry(object):
                     program, feed_names=feed_names, fetch_list=fetch_list,
                     place=self.place, scope=scope, executor=executor,
                     parallel=self.parallel, mesh=self.mesh,
-                    config=cfg, name=name)
+                    config=cfg, name=name, generation=generation)
             else:
                 raise ValueError('load(): pass dirname= or program=')
+            cache_account = name + DECODE_CACHE_SUFFIX
             try:
                 # admission gate: seed the account from the program's
                 # var-sum estimate at the TOP bucket size (weights +
@@ -158,17 +176,30 @@ class ModelRegistry(object):
                 seed = program_seed_bytes(engine._program,
                                           max(engine.buckets.sizes))
                 self.arbiter.admit(name, seed)
+                if engine._decode_cache is not None:
+                    # the decode-state cache is a FIRST-CLASS account:
+                    # its slab bytes are exact (static slot shapes), and
+                    # a cache that alone exceeds the budget is a typed
+                    # reject at load, not an OOM mid-generation
+                    self.arbiter.admit(
+                        cache_account,
+                        engine.generation.cache_nbytes(
+                            engine._decode_cache.slots))
                 entry = _ModelEntry(name, engine, dirname)
                 self._models[name] = entry
                 # make room NOW (evicting LRU peers), so the first
                 # request pays staging, not arbitration
                 self.arbiter.ensure(name, self._evict_to_host)
+                if engine._decode_cache is not None:
+                    self.arbiter.ensure(cache_account,
+                                        self._evict_to_host)
             except Exception:
                 # ANY failure (budget reject, an estimator choking on
                 # an exotic var, ...) must not leak the constructed
                 # engine — its profiler registration and param scope
                 # would outlive the failed load
                 self.arbiter.drop(name)
+                self.arbiter.drop(cache_account)
                 self._models.pop(name, None)
                 engine.stop()
                 raise
@@ -185,9 +216,11 @@ class ModelRegistry(object):
             if entry is None:
                 raise KeyError('model %r is not loaded' % name)
             self.arbiter.drop(name)
+            self.arbiter.drop(name + DECODE_CACHE_SUFFIX)
         entry.engine.stop()
 
-    def warm(self, name, bucket_ladder=None, trailing=None):
+    def warm(self, name, bucket_ladder=None, trailing=None,
+             decode_prefill=None):
         """Pre-compile the model's executables across its bucket ladder
         (or an explicit one) with zero-filled requests, so first real
         traffic pays staging, not XLA compiles.  Returns the number of
@@ -205,9 +238,56 @@ class ModelRegistry(object):
         pair bucket long together), so the correlated multi-feed
         signatures are exactly the ones that must not stay cold; the
         warm set is len(ladder) x prod(len(extents)), which the caller
-        bounds through the extents passed."""
+        bounds through the extents passed.
+
+        ``decode_prefill`` warms the GENERATION lane (ISSUE 7): one
+        zero-filled single-sequence prompt per extent runs through
+        ``submit_generate`` with ``max_len=1`` — compiling the prefill
+        executable at each prompt-length rung plus the decode-step
+        scan executable, so first real generation traffic pays
+        staging, not XLA compiles.  A decode-only call (no
+        bucket_ladder/trailing) skips the forward-surface warm."""
         entry = self._entry(name)
         engine = entry.engine
+        served = 0
+        if decode_prefill is not None:
+            spec = engine.generation
+            if spec is None:
+                raise ValueError(
+                    'warm(%r): decode_prefill= but the model serves no '
+                    'generation lane — load it with generation='
+                    % name)
+            extents = list(decode_prefill)
+            if not extents:
+                raise ValueError(
+                    'warm(%r): decode_prefill is empty — pass at least '
+                    'one prompt-length extent' % name)
+            pblock = spec.prefill_program.global_block()
+            for extent in dict.fromkeys(int(e) for e in extents):
+                feed = {}
+                for fname in spec.prefill_feeds:
+                    var = pblock.vars[fname]
+                    if not getattr(var, 'lod_level', 0):
+                        raise ValueError(
+                            'warm(%r): prefill feed %r is not a '
+                            'sequence (lod_level=0) — decode_prefill '
+                            'warms prompt-length rungs; warm dense '
+                            'prompts with real traffic'
+                            % (name, fname))
+                    from ..fluid.lod_tensor import create_lod_tensor
+                    shape = [int(d) for d in var.shape[1:]]
+                    if any(d < 0 for d in shape):
+                        raise ValueError(
+                            'warm(%r): prefill feed %r has a non-batch '
+                            'dynamic dim %s — warm it with real '
+                            'traffic instead' % (name, fname, var.shape))
+                    rows = np.zeros((extent, ) + tuple(shape),
+                                    var.np_dtype).tolist()
+                    feed[fname] = create_lod_tensor([rows], [[extent]])
+                self.generate(name, feed, max_len=1, timeout=600)
+                served += 1
+            if bucket_ladder is None and not trailing:
+                return served
         ladder = list(bucket_ladder if bucket_ladder is not None
                       else engine.buckets.sizes)
         # materialize ONCE: iterator-valued extents would otherwise be
@@ -306,7 +386,6 @@ class ModelRegistry(object):
         t_names = sorted(trailing)
         combos = list(itertools.product(
             *(list(dict.fromkeys(trailing[f])) for f in t_names)))
-        served = 0
         for rows in ladder:
             for combo in combos or [()]:
                 extents = dict(zip(t_names, combo))
@@ -336,7 +415,12 @@ class ModelRegistry(object):
         """The arbiter's evict callback: pause the victim engine (its
         in-flight dispatches drain), demote its device buffers to host
         ndarrays bitwise, drop its executables.  Returns the live bytes
-        moved (the arbiter's account correction)."""
+        moved (the arbiter's account correction).  A ``:decode-cache``
+        victim demotes its model's decode slabs instead of the weights
+        — an idle generation model's cache frees on its own."""
+        if victim.endswith(DECODE_CACHE_SUFFIX):
+            owner = victim[:-len(DECODE_CACHE_SUFFIX)]
+            return self._models[owner].engine.evict_decode_cache()
         entry = self._models[victim]
         moved, _ = entry.engine.evict_to_host()
         return moved
@@ -348,14 +432,22 @@ class ModelRegistry(object):
         what the runtime actually holds live, drift included."""
         return self.arbiter.audit()
 
-    def _ensure_resident(self, name):
+    def _ensure_resident(self, name, decode=False):
         """Dispatch-time gate: budget-arbitrate ``name`` resident (LRU
         peers evict as needed) and correct resident accounts to live
-        buffer stats."""
+        buffer stats.  ``decode=True`` (a routed generation request)
+        additionally ensures the model's decode-cache account — its
+        slabs re-stage transparently at the next decode dispatch after
+        an eviction."""
         with self._lock:
             entry = self._entry(name)
             self.arbiter.correct(name, entry.engine.device_footprint())
             self.arbiter.ensure(name, self._evict_to_host)
+            if decode:
+                cache = name + DECODE_CACHE_SUFFIX
+                self.arbiter.correct(
+                    cache, entry.engine._decode_cache.nbytes())
+                self.arbiter.ensure(cache, self._evict_to_host)
             return entry
 
     # ---- router --------------------------------------------------------
@@ -391,6 +483,33 @@ class ModelRegistry(object):
         """Synchronous convenience: submit + wait."""
         return self.submit(model, feed,
                            return_numpy=return_numpy).result(timeout)
+
+    def submit_generate(self, model, feed, max_len=None):
+        """Route one GENERATION request (ISSUE 7): ensure the model
+        AND its decode cache are resident under the HBM budget, then
+        enqueue on its engine's decode lane.  Returns the engine's
+        GenerationRequest future; its ``breakdown()`` carries the
+        arbitration window plus the prefill/decode/detokenize stages."""
+        ctx = _trace.TraceContext()
+        t0 = time.time()
+        entry = self._ensure_resident(model, decode=True)
+        ctx.add_stage('arbitration', time.time() - t0)
+        now = time.time()
+        with self._lock:
+            entry.requests += 1
+            if entry.first_req_t is None:
+                entry.first_req_t = now
+            entry.last_req_t = now
+        with _trace.attach(ctx):
+            req = entry.engine.submit_generate(feed, max_len=max_len)
+        with self._lock:
+            entry.rows += 1
+        return req
+
+    def generate(self, model, feed, max_len=None, timeout=None):
+        """Synchronous convenience: submit_generate + wait."""
+        return self.submit_generate(model, feed,
+                                    max_len=max_len).result(timeout)
 
     # ---- start/stop ----------------------------------------------------
 

@@ -201,6 +201,44 @@ def test_trailing_bucket_blocks_wired():
         assert "'%s'" % key in src, key
 
 
+def test_decode_blocks_wired():
+    """ISSUE 7: the nmt/transformer configs pair their numbers with a
+    functional ``decode`` block (mixed-length prompts through the
+    engine's continuous-batching generation lane — the helper asserts
+    the lane really fired and every request finished), and
+    tools/perf_gate.py registers the decode paired config.  Source-
+    level pin; the functional paths are the nmt CPU smoke below,
+    tests/test_generation_serving.py, and the perf_gate decode CPU
+    smoke in tests/test_perf_gate.py."""
+    import inspect
+    import bench
+    helper = inspect.getsource(bench._decode_block)
+    assert 'submit_generate' in helper
+    assert 'GenerationSpec' in helper
+    assert "d['dispatches'] > 0" in helper
+    for key in ('tokens_per_sec', 'steps_per_dispatch',
+                'tokens_per_dispatch', 'slot_occupancy',
+                'decode_dispatches', 'prefill_lots'):
+        assert "'%s'" % key in helper, key
+    for fn, builder in ((bench.bench_nmt, 'seq2seq.build_step_decode'),
+                        (bench.bench_transformer,
+                         'transformer.build_step_decode')):
+        src = inspect.getsource(fn)
+        assert '_decode_block(' in src, fn.__name__
+        assert builder in src, fn.__name__
+        assert "'decode': decode" in src, fn.__name__
+    sys.path.insert(0, os.path.join(REPO, 'tools'))
+    try:
+        import perf_gate
+    finally:
+        sys.path.pop(0)
+    assert 'decode' in perf_gate.CONFIGS
+    src = inspect.getsource(perf_gate.run_decode)
+    for key in ('dispatch_ratio', 'tokens_per_dispatch',
+                'lane_vs_ref', 'slot_occupancy'):
+        assert "'%s'" % key in src, key
+
+
 def test_multi_model_perf_gate_config_registered():
     """tools/perf_gate.py multi_model (ISSUE 4): two models under one
     budget, paired resident-vs-evict-reload windows.  Structural pin —
@@ -281,3 +319,12 @@ def test_nmt_cpu_smoke_is_device_true():
     assert tb['lots'] < tb['requests']
     assert tb['executables'] <= tb['distinct_lengths']
     assert 0.0 < tb['trailing_padding_waste'] < 1.0
+    # ISSUE 7: the decode block really drove the generation lane —
+    # mixed-length prompts, K-step scans, every request finished
+    dec = rec['decode']
+    assert dec['requests'] >= 6
+    assert dec['tokens'] > 0 and dec['tokens_per_sec'] > 0
+    assert dec['steps_per_dispatch'] > 1
+    assert dec['tokens_per_dispatch'] > 1
+    assert 0.0 < dec['slot_occupancy'] <= 1.0
+    assert dec['decode_dispatches'] > 0

@@ -109,6 +109,50 @@ def test_trace_overhead_config_registered():
     assert 'InferenceEngine' in build
 
 
+def _import_perf_gate():
+    import inspect
+    sys.path.insert(0, os.path.join(REPO, 'tools'))
+    try:
+        import perf_gate
+    finally:
+        sys.path.pop(0)
+    return perf_gate, inspect
+
+
+def test_decode_config_registered():
+    """ISSUE 7 structural pin (runs off-TPU): the decode paired config
+    exists, interleaves lane/per-step-reference windows, asserts
+    token-identity, and hard-gates dispatch_ratio +
+    tokens_per_dispatch behind their env knobs."""
+    perf_gate, inspect = _import_perf_gate()
+    assert 'decode' in perf_gate.CONFIGS
+    src = inspect.getsource(perf_gate.run_decode)
+    for pin in ("'dispatch_ratio'", "'tokens_per_dispatch'",
+                'PERF_GATE_DECODE_RATIO_MAX', 'PERF_GATE_DECODE_TPD_MIN',
+                'token-identical'):
+        assert pin in src, pin
+    build = inspect.getsource(perf_gate.build_decode)
+    assert 'submit_generate' in build
+    assert 'GenerationSpec' in build
+
+
+def test_decode_config_cpu_smoke(monkeypatch):
+    """The ISSUE 7 acceptance criterion, functionally on CPU: N >= 6
+    mixed-length generation requests through the decode lane are
+    token-identical to per-request reference decode at <= 1/3 the
+    dispatches (run_decode hard-asserts both gates)."""
+    perf_gate, _ = _import_perf_gate()
+    monkeypatch.setenv('PERF_GATE_DEC_REQS', '6')
+    monkeypatch.setenv('PERF_GATE_DEC_LEN', '8')
+    monkeypatch.setattr(perf_gate, 'BLOCKS', 1)
+    rec = perf_gate.run_decode()
+    assert rec['requests_per_window'] >= 6
+    assert rec['dispatch_ratio'] <= 1.0 / 3.0
+    assert rec['tokens_per_dispatch'] >= 4.0
+    assert rec['lane_dispatches'] < rec['ref_dispatches']
+    assert 0.0 < rec['slot_occupancy'] <= 1.0
+
+
 @pytest.mark.parametrize('config', ['resnet', 'transformer', 'nmt'])
 def test_framework_beats_or_matches_pure_jax_bound(config):
     rec = _run_gate(config)

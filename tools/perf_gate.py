@@ -10,7 +10,8 @@ max(fw)/max(bd) cross-window pairing).
 
 Run on TPU hardware:
     python tools/perf_gate.py [resnet|transformer|nmt|resnet_infer|
-        feed_pipeline|multi_model|trailing_dim|trace_overhead|all]
+        feed_pipeline|multi_model|trailing_dim|trace_overhead|decode|
+        all]
 Prints one JSON line per config; tests/test_perf_gate.py drives it and
 skips cleanly off-TPU.  ``resnet_infer`` (ISSUE 2) has no bound side —
 its deliverable is the paired ``multi_vs_dispatch`` block: the measured
@@ -37,6 +38,15 @@ event mirrors into), the untraced window is the same engine outside
 it — the record asserts the observability layer's request-path
 overhead stays bounded (traced_vs_untraced >= PERF_GATE_TRACE_MIN,
 default 0.8, on the best shared drift window).
+``decode`` (ISSUE 7) pairs continuous-batching generation against
+one-call-per-step per-request decode over the same mixed-length
+request stream: the lane side runs prompts through the engine's
+slot-based decode lane (prefill lots + K-step in-jit decode scans),
+the reference side replays the reference's serving shape (one graph
+call per decode step per request) — outputs are asserted
+token-identical, and the hard gates are ``dispatch_ratio`` <=
+PERF_GATE_DECODE_RATIO_MAX (default 1/3) and ``tokens_per_dispatch``
+>= PERF_GATE_DECODE_TPD_MIN (default 4.0).
 """
 
 import json
@@ -707,6 +717,146 @@ def run_trace_overhead():
     return rec
 
 
+def build_decode():
+    """Continuous-batching decode vs ONE-CALL-PER-STEP per-request
+    decode over the SAME mixed-length request stream (ISSUE 7): the
+    lane side serves N prompts through the engine's generation lane
+    (prefill lots coalesce, K decode steps per in-jit scan over the
+    slot batch, continuous admission), the reference side replays the
+    reference serving shape — per request, one prefill exe.run plus
+    one step exe.run PER TOKEN.  Functional on the CPU smoke (the
+    parity + dispatch-accounting deliverables) and TPU alike; outputs
+    are asserted TOKEN-IDENTICAL between the two sides before any
+    number is reported."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import serving
+    from paddle_tpu.fluid import core
+    from paddle_tpu.models import seq2seq
+
+    n_req = int(os.environ.get('PERF_GATE_DEC_REQS', '8'))
+    slots = int(os.environ.get('PERF_GATE_DEC_SLOTS', '4'))
+    k_steps = int(os.environ.get('PERF_GATE_DEC_STEPS', '4'))
+    max_len = int(os.environ.get('PERF_GATE_DEC_LEN', '12'))
+    m = seq2seq.build_step_decode(src_dict_dim=100, trg_dict_dim=80,
+                                  embedding_dim=16, encoder_size=32,
+                                  decoder_size=32, max_len=max_len)
+    place = fluid.TPUPlace() if core.is_compiled_with_tpu() \
+        else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(m['prefill_startup'])
+        exe.run(m['step_startup'])
+    rng = np.random.RandomState(0)
+    lens = [3 + (i * 5) % 13 for i in range(n_req)]
+    prompts = [fluid.create_lod_tensor(
+        rng.randint(2, 100, size=(l, 1)).tolist(), [[l]]) for l in lens]
+
+    spec = serving.GenerationSpec.from_model(m)
+    eng = serving.InferenceEngine(
+        m['prefill'], fetch_list=m['prefill_fetches'], scope=scope,
+        executor=exe, place=place,
+        config=serving.ServingConfig(
+            max_batch_size=n_req, max_wait_ms=2, decode_slots=slots,
+            decode_steps=k_steps),
+        generation=spec, name='perf-gate-decode').start()
+
+    def lane_window():
+        """(tokens/s, engine dispatches this window, tokens, outputs)."""
+        m0 = eng.metrics()
+        d0 = (m0['decode'] or {})
+        before = m0['dispatches'] + d0.get('dispatches', 0)
+        t0 = time.time()
+        futs = [eng.submit_generate({'src_word_id': p}) for p in prompts]
+        outs = [list(f.result(600)) for f in futs]
+        elapsed = time.time() - t0
+        m1 = eng.metrics()
+        after = m1['dispatches'] + m1['decode']['dispatches']
+        tokens = sum(len(o) for o in outs)
+        return tokens / elapsed, after - before, tokens, outs
+
+    def ref_window():
+        """The per-step serving shape: dispatches = sum(1 + steps)."""
+        outs, dispatches = [], 0
+        t0 = time.time()
+        with fluid.scope_guard(scope):
+            for p in prompts:
+                boot, = exe.run(m['prefill'], feed={'src_word_id': p},
+                                fetch_list=m['prefill_fetches'])
+                dispatches += 1
+                h = boot
+                t = np.array([[m['start_id']]], np.int64)
+                toks = []
+                for _ in range(max_len):
+                    lg, h2 = exe.run(
+                        m['step'],
+                        feed={'gen_token': t, 'gen_hidden': h},
+                        fetch_list=[m['logits'], m['state'][0][1]])
+                    dispatches += 1
+                    nxt = int(np.argmax(lg.reshape(1, -1), axis=-1)[0])
+                    toks.append(nxt)
+                    if nxt == m['end_id']:
+                        break
+                    h, t = h2, np.array([[nxt]], np.int64)
+                outs.append(toks)
+        elapsed = time.time() - t0
+        tokens = sum(len(o) for o in outs)
+        return tokens / elapsed, dispatches, tokens, outs
+
+    return lane_window, ref_window, (eng, n_req, slots, k_steps)
+
+
+def run_decode():
+    """The decode record: interleaved lane/reference windows (each
+    ratio shares a drift window — the gates' pairing rule), with the
+    ISSUE 7 acceptance numbers as HARD asserts: outputs token-identical
+    across the two sides, `dispatch_ratio` (lane dispatches over
+    one-call-per-step dispatches) at most PERF_GATE_DECODE_RATIO_MAX
+    (default 1/3), and `tokens_per_dispatch` at least
+    PERF_GATE_DECODE_TPD_MIN (default 4.0)."""
+    lane, ref, (eng, n_req, slots, k_steps) = build_decode()
+    lane(), ref()  # warm both executable sets outside the windows
+    la, rf = [], []
+    lane_disp = ref_disp = lane_tokens = 0
+    for _ in range(BLOCKS):
+        lv, ld, lt, louts = lane()
+        rv, rd, rt, routs = ref()
+        assert louts == routs, 'decode lane diverged from per-request ' \
+            'reference decode: %r vs %r' % (louts[:2], routs[:2])
+        la.append(lv)
+        rf.append(rv)
+        lane_disp, ref_disp, lane_tokens = ld, rd, lt
+    md = eng.metrics()['decode']
+    rec = {
+        'config': 'decode',
+        'lane_tokens_per_sec': round(max(la), 1),
+        'ref_tokens_per_sec': round(max(rf), 1),
+        'lane_blocks': [round(v, 1) for v in la],
+        'ref_blocks': [round(v, 1) for v in rf],
+        # the PAIRED deliverable: throughput recovered by continuous
+        # batching + the in-jit decode scan, per shared drift window
+        'lane_vs_ref': round(max(l / r for l, r in zip(la, rf)), 4),
+        # the ISSUE 7 acceptance numbers: dispatch amortization
+        'lane_dispatches': lane_disp,
+        'ref_dispatches': ref_disp,
+        'dispatch_ratio': round(lane_disp / max(ref_disp, 1), 4),
+        'tokens_per_dispatch': round(lane_tokens / max(lane_disp, 1), 3),
+        'steps_per_dispatch': md['steps_per_dispatch'],
+        'slot_occupancy': md['slot_occupancy'],
+        'requests_per_window': n_req, 'decode_slots': slots,
+        'decode_steps': k_steps, 'blocks': BLOCKS,
+    }
+    eng.stop()
+    ratio_max = float(os.environ.get('PERF_GATE_DECODE_RATIO_MAX',
+                                     str(1.0 / 3.0)))
+    tpd_min = float(os.environ.get('PERF_GATE_DECODE_TPD_MIN', '4.0'))
+    assert rec['dispatch_ratio'] <= ratio_max, rec
+    assert rec['tokens_per_dispatch'] >= tpd_min, rec
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 CONFIGS = {
     'resnet': (build_resnet, 'imgs_per_sec'),
     'transformer': (build_transformer, 'tokens_per_sec'),
@@ -716,6 +866,7 @@ CONFIGS = {
     'multi_model': (build_multi_model, 'imgs_per_sec'),
     'trailing_dim': (build_trailing_dim, 'rows_per_sec'),
     'trace_overhead': (build_trace_overhead, 'rows_per_sec'),
+    'decode': (build_decode, 'tokens_per_sec'),
 }
 
 
@@ -728,6 +879,8 @@ def run_config(name):
         return run_trailing_dim()
     if name == 'trace_overhead':
         return run_trace_overhead()
+    if name == 'decode':
+        return run_decode()
     build, unit = CONFIGS[name]
     # both sides compiled first, then INTERLEAVED blocks: a drift window
     # between two monolithic measurements would otherwise decide the
