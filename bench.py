@@ -873,13 +873,23 @@ def _emit(configs, partial):
         'configs': configs,
     })
     print(line, flush=True)
+    # atomic partial rewrite with GUARANTEED tmp cleanup: an abort
+    # between write and rename (the SIGALRM bail, a crash mid-emit)
+    # must not strand BENCH_PARTIAL.json.tmp in the repo — it has come
+    # back three times (PR 3, PR 6, PR 8) from exactly that window
+    tmp = PARTIAL_PATH + '.tmp'
     try:
-        tmp = PARTIAL_PATH + '.tmp'
         with open(tmp, 'w') as f:
             f.write(line + '\n')
         os.replace(tmp, PARTIAL_PATH)
     except OSError:
         pass  # read-only fs must not kill the bench
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
     return head
 
 
@@ -935,6 +945,12 @@ def main():
         os._exit(3)
 
     state = {'configs': []}
+    # a PREVIOUS run killed inside _emit's write->rename window left
+    # its tmp behind; clear it so aborted runs stop accreting strays
+    try:
+        os.remove(PARTIAL_PATH + '.tmp')
+    except OSError:
+        pass
     signal.signal(signal.SIGALRM, _bail)
     signal.alarm(total_budget)
 

@@ -65,8 +65,12 @@ __all__ = [
 # as one stage), decode (admission -> last decode-scan sync) and
 # detokenize (last sync -> delivery); their breakdown also carries a
 # decode_steps count.
+# SHED requests (ISSUE 8) end in a 'shed' stage instead: the seconds
+# the request sat before the deadline scheduler dropped it (its future
+# raises DeadlineExceededError — served stages before the shed, e.g. a
+# generation's prefill, still appear).
 STAGES = ('arbitration', 'queue', 'pad', 'prefill', 'dispatch',
-          'device', 'trim', 'decode', 'detokenize')
+          'device', 'trim', 'decode', 'detokenize', 'shed')
 
 _ids = itertools.count(1)
 _id_lock = threading.Lock()

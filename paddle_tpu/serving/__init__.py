@@ -24,6 +24,19 @@ reload, a fair request router, and per-model ``:serving/<model>``
 timeline rows.  See engine.py / registry.py for the designs and the
 README 'Serving engine' / 'Multi-model serving' sections for the knobs.
 
+SLOs (ISSUE 8): requests carry ``priority`` and ``deadline_ms`` —
+lot formation is deadline-aware (EDF within priority classes) and
+past-deadline work is SHED with a typed ``DeadlineExceededError``
+instead of served late; the registry refuses requests at the door with
+``OverloadedError`` (+ retry-after hint) once a model's queue crosses
+its depth/age watermarks; ``registry.warm()`` records a replayable
+compile catalog next to FLAGS_xla_compile_cache_dir and
+``registry.prewarm()`` replays it so a restarted fleet compiles
+nothing on first traffic; and ``OpenLoopLoadGen`` (loadgen.py) drives
+the whole stack with seeded Poisson arrivals, reporting sustained
+req/s, p50/p99/p99.9 and goodput.  README 'Serving SLOs' has the
+operator's view; tools/load_gen.py is the CLI.
+
     reg = serving.ModelRegistry(hbm_budget_bytes=2 << 30)
     reg.load('ranker', '/models/ranker')
     with reg:                                  # starts every worker
@@ -38,6 +51,9 @@ from .buckets import ShapeBucketSet, TrailingDimBuckets  # noqa: F401
 from .decode import GenerationRequest, GenerationSpec, \
     SlotStateCache  # noqa: F401
 from .engine import InferenceEngine, ServingConfig  # noqa: F401
+from .errors import DeadlineExceededError, EngineClosedError, \
+    OverloadedError  # noqa: F401
+from .loadgen import OpenLoopLoadGen, TrafficClass  # noqa: F401
 from .metrics import EngineMetrics  # noqa: F401
 from .registry import ModelRegistry  # noqa: F401
 
@@ -45,4 +61,5 @@ __all__ = ['InferenceEngine', 'ServingConfig', 'MicroBatcher',
            'InferenceRequest', 'ShapeBucketSet', 'TrailingDimBuckets',
            'EngineMetrics', 'ModelRegistry', 'HBMArbiter',
            'HBMBudgetError', 'GenerationSpec', 'GenerationRequest',
-           'SlotStateCache']
+           'SlotStateCache', 'DeadlineExceededError', 'OverloadedError',
+           'EngineClosedError', 'OpenLoopLoadGen', 'TrafficClass']

@@ -12,9 +12,24 @@ dispatch by batching.  The queue's contract:
     resolution dims onto its TrailingDimBuckets ladder before the sig
     is taken, so mixed-length requests in one rung DO coalesce)
     coalesce; an incompatible request simply waits its turn as the
-    head of a later lot — order is preserved per signature;
+    head of a later lot;
   * a lone request larger than max_batch_size forms its own lot (the
     bucket ladder gives it an exact entry) rather than being rejected.
+
+Scheduling (ISSUE 8): under ``scheduling='edf'`` (the default) lot
+formation is deadline-aware the way Clockwork (OSDI '20) serves its
+SLOs — the head of each lot is the highest-PRIORITY pending request,
+earliest-deadline-first within a priority class (requests without a
+deadline order after deadlined peers, by arrival); and requests whose
+deadline has already passed — or can no longer be met within the
+engine's current service estimate — are SHED with a typed
+``DeadlineExceededError`` instead of being served late, so an
+overloaded queue spends the chip on answers that can still arrive in
+time.  Requests carrying neither priority nor deadline degrade to
+exact FIFO order, so pre-SLO callers see no change.
+``scheduling='fifo'`` restores strict arrival order with no shedding
+(the baseline side of the ``slo`` perf gate: under overload it happily
+serves already-dead requests, starving live ones).
 
 Requests double as futures: ``submit`` returns an InferenceRequest the
 caller blocks on with ``.result()``; the engine's worker thread fills
@@ -24,6 +39,8 @@ it after the trimmed fetches come back.
 import threading
 import time
 from collections import deque
+
+from .errors import DeadlineExceededError, EngineClosedError
 
 __all__ = ['InferenceRequest', 'MicroBatcher']
 
@@ -46,19 +63,31 @@ class InferenceRequest(object):
     requests coalesce into eval lots, 'generate' ones
     (GenerationRequest) into PREFILL lots the engine routes to the
     decode lane — the two kinds never share a lot even if their feed
-    signatures collide."""
+    signatures collide.
+
+    ``priority`` / ``deadline_ms`` are the SLO lane (ISSUE 8): higher
+    priority classes form lots first; within a class the scheduler is
+    earliest-deadline-first, and a deadlined request that can no longer
+    answer in time is shed with DeadlineExceededError instead of served
+    late.  ``deadline_t`` is the ABSOLUTE wall-clock deadline (enqueue
+    + deadline_ms); None means the request never expires."""
 
     kind = 'forward'
 
     def __init__(self, feed, rows, sig, return_numpy=True, trailing=None,
-                 trace=None):
+                 trace=None, priority=0, deadline_ms=None):
         self.feed = feed
         self.rows = rows  # None for unbatchable (LoD / scalar) feeds
         self.sig = sig
         self.trailing = trailing or None
         self.return_numpy = return_numpy
         self.trace = trace
+        self.priority = int(priority)
+        self.deadline_ms = (float(deadline_ms)
+                            if deadline_ms is not None else None)
         self.enqueue_t = time.time()
+        self.deadline_t = (self.enqueue_t + self.deadline_ms / 1e3
+                           if self.deadline_ms is not None else None)
         self.latency_s = None
         self._event = threading.Event()
         self._result = None
@@ -97,12 +126,43 @@ class InferenceRequest(object):
         return self._result
 
 
+def _sched_key(req):
+    """EDF-within-priority: higher priority first, then earliest
+    absolute deadline (no deadline = never urgent), then arrival —
+    so undeadlined equal-priority traffic keeps exact FIFO order."""
+    return (-req.priority,
+            req.deadline_t if req.deadline_t is not None else float('inf'),
+            req.enqueue_t)
+
+
 class MicroBatcher(object):
-    def __init__(self, max_batch_size=32, max_wait_s=0.005):
+    """``scheduling``: 'edf' (deadline-aware lot formation + shedding,
+    the default — degrades to FIFO for requests without priorities or
+    deadlines) or 'fifo' (strict arrival order, nothing shed).
+
+    ``on_shed``: callback invoked (queue lock held) with each shed
+    request; the owner errors the future, counts the shed, and marks
+    the trace.  When None the batcher errors the future itself.
+
+    ``service_estimate_fn``: optional () -> seconds — the engine's
+    current estimate of one dispatch's service time.  A deadlined
+    request is shed not just when its deadline HAS passed but when it
+    cannot be met within the estimate (Clockwork's admission rule):
+    serving a request that will miss anyway only delays live ones."""
+
+    def __init__(self, max_batch_size=32, max_wait_s=0.005,
+                 scheduling='edf', on_shed=None,
+                 service_estimate_fn=None):
         if int(max_batch_size) < 1:
             raise ValueError('max_batch_size must be >= 1')
+        if scheduling not in ('edf', 'fifo'):
+            raise ValueError("scheduling must be 'edf' or 'fifo', got %r"
+                             % (scheduling, ))
         self.max_batch_size = int(max_batch_size)
         self.max_wait_s = float(max_wait_s)
+        self.scheduling = scheduling
+        self._on_shed = on_shed
+        self._service_estimate_fn = service_estimate_fn
         self._pending = deque()
         self._cond = threading.Condition()
         self._closed = False
@@ -124,6 +184,21 @@ class MicroBatcher(object):
                 return None
             return time.time() - self._pending[0].enqueue_t
 
+    def age_stats(self):
+        """Queue-age stats (ISSUE 8): oldest/mean queued request age in
+        seconds plus the depth — the registry's admission watermarks
+        read these, and ``engine.metrics()`` surfaces them so a
+        stalling queue is visible without waiting for the watchdog
+        dump.  None when the queue is empty."""
+        with self._cond:
+            if not self._pending:
+                return None
+            now = time.time()
+            ages = [now - r.enqueue_t for r in self._pending]
+            return {'oldest_s': max(ages),
+                    'mean_s': sum(ages) / len(ages),
+                    'depth': len(ages)}
+
     def pending_trace_ids(self):
         """Trace ids of every queued request — the stall dump's view of
         work stuck BEFORE any dispatch record could enter the ring."""
@@ -133,7 +208,7 @@ class MicroBatcher(object):
     def submit(self, request):
         with self._cond:
             if self._closed:
-                raise RuntimeError('MicroBatcher is closed')
+                raise EngineClosedError('MicroBatcher is closed')
             self._pending.append(request)
             self._cond.notify_all()
         return request
@@ -144,15 +219,65 @@ class MicroBatcher(object):
             self._closed = True
             self._cond.notify_all()
 
+    def _shed_locked(self):
+        """Drop every pending request whose deadline has passed — or
+        cannot be met within the engine's current service estimate —
+        before any of them can head a lot (EDF mode only).  The shed
+        callback errors each future with DeadlineExceededError; a shed
+        must never take the worker down, so callback faults fall back
+        to erroring the future directly."""
+        if not self._pending:
+            return
+        now = time.time()
+        est = 0.0
+        if self._service_estimate_fn is not None:
+            try:
+                est = float(self._service_estimate_fn() or 0.0)
+            except Exception:
+                est = 0.0
+        horizon = now + est
+        doomed = [r for r in self._pending
+                  if r.deadline_t is not None and r.deadline_t < horizon]
+        if not doomed:
+            return
+        # one rebuild, not len(doomed) deque.remove scans: a stall can
+        # doom most of an overloaded queue at once, and this runs with
+        # the queue lock held
+        doomed_ids = {id(r) for r in doomed}
+        self._pending = deque(r for r in self._pending
+                              if id(r) not in doomed_ids)
+        for req in doomed:
+            try:
+                if self._on_shed is not None:
+                    self._on_shed(req)
+            except Exception:
+                pass  # the fallback below still resolves the future
+            if not req.done():
+                req.set_error(DeadlineExceededError(
+                    req.trace_id, req.deadline_ms,
+                    round((now - req.deadline_t) * 1e3, 3)))
+
     def _select_locked(self):
         """The head request plus every signature-compatible follower
-        that fits under max_batch_size (order preserved; incompatible
-        requests stay queued untouched)."""
-        head = self._pending[0]
+        that fits under max_batch_size; incompatible requests stay
+        queued untouched.  Head choice and follower order are the
+        scheduling policy: arrival order under 'fifo', priority-then-
+        earliest-deadline under 'edf' (which is arrival order again
+        when nothing carries a priority or deadline)."""
+        if self.scheduling == 'edf' and len(self._pending) > 1 and \
+                any(r.priority != 0 or r.deadline_t is not None
+                    for r in self._pending):
+            # only pay the sort when something actually carries an SLO:
+            # for plain traffic _sched_key is a constant prefix plus
+            # enqueue_t, i.e. exactly arrival order
+            order = sorted(self._pending, key=_sched_key)
+        else:
+            order = list(self._pending)
+        head = order[0]
         lot, rows = [head], head.rows or 1
         if head.rows is None:
             return lot, rows  # unbatchable: its own lot
-        for req in list(self._pending)[1:]:
+        for req in order[1:]:
             # same signature AND same kind: a forward request must
             # never ride a prefill lot (different program + fetches)
             if req.sig != head.sig or req.rows is None or \
@@ -173,9 +298,16 @@ class MicroBatcher(object):
         deadline_out = None if timeout is None else time.time() + timeout
         with self._cond:
             while True:
+                if self.scheduling == 'edf':
+                    self._shed_locked()
                 if self._pending:
                     lot, rows = self._select_locked()
-                    flush_at = lot[0].enqueue_t + self.max_wait_s
+                    # the deadline flush triggers on the OLDEST pending
+                    # request (under EDF the lot head may be a newer,
+                    # more urgent arrival — the latency bound must
+                    # still cover the request left waiting)
+                    flush_at = min(r.enqueue_t for r in self._pending) \
+                        + self.max_wait_s
                     now = time.time()
                     # an unbatchable head (rows None: LoD/scalar feeds)
                     # can never coalesce — waiting out the deadline

@@ -174,9 +174,10 @@ class GenerationRequest(InferenceRequest):
     kind = 'generate'
 
     def __init__(self, feed, rows, sig, max_len, return_numpy=True,
-                 trace=None):
+                 trace=None, priority=0, deadline_ms=None):
         super(GenerationRequest, self).__init__(
-            feed, rows, sig, return_numpy=return_numpy, trace=trace)
+            feed, rows, sig, return_numpy=return_numpy, trace=trace,
+            priority=priority, deadline_ms=deadline_ms)
         self.max_len = int(max_len)
         self.tokens = []
         self.slot = None
@@ -299,6 +300,21 @@ class SlotStateCache(object):
         if req is not None:
             req.slot = None
         return req
+
+    def deactivate(self, idx):
+        """Mask one slot out of the scan NOW (a mid-generation shed,
+        ISSUE 8): alive -> False, remaining -> 0, token -> end_id.
+        ``release`` only frees the host-side slot map; without this the
+        next decode dispatch would keep spending scan steps on a
+        request that no longer has a caller.  Worker-thread only, like
+        set_carry."""
+        self._alive = self._write_row(self._alive, idx, False)
+        self._remaining = self._write_row(self._remaining, idx,
+                                          np.int32(0))
+        self._token = self._write_row(
+            self._token, idx,
+            np.asarray([self.spec.end_id],
+                       self.spec.slot_dtypes[self.spec.token_feed]))
 
     def request_at(self, idx):
         with self._lock:

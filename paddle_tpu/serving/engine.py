@@ -52,6 +52,7 @@ from ..fluid.parallel_executor import ParallelExecutor, pad_ragged_batch, \
     _lead
 from .batcher import InferenceRequest, MicroBatcher
 from .buckets import ShapeBucketSet, TrailingDimBuckets
+from .errors import DeadlineExceededError, EngineClosedError
 from .metrics import EngineMetrics
 
 __all__ = ['ServingConfig', 'InferenceEngine']
@@ -105,6 +106,23 @@ class ServingConfig(object):
         in-jit greedy loop) — the generation lane's dispatch-tax
         amortizer, bounded below the per-request latency a step
         boundary adds to admission.
+    scheduling: 'edf' (default) — deadline-aware lot formation (ISSUE
+        8): highest priority first, earliest-deadline-first within a
+        priority class, and past-deadline (or no-longer-meetable)
+        requests SHED with a typed DeadlineExceededError instead of
+        served late.  Requests without priorities/deadlines keep exact
+        FIFO order, so the default changes nothing for pre-SLO
+        callers.  'fifo' restores strict arrival order with no
+        shedding — the baseline side of the ``slo`` perf gate.
+    admit_queue_depth / admit_queue_age_ms: per-model admission
+        watermarks the ModelRegistry enforces at ROUTING time — a
+        request routed while the engine's queue is at least this deep
+        (or its oldest queued request at least this old) is refused
+        with a typed OverloadedError carrying a retry-after hint,
+        instead of queueing toward certain deadline death.  None
+        (default) disables that watermark; direct engine.submit()
+        callers are never admission-checked (the registry is the
+        fleet's front door).
     """
 
     def __init__(self, max_batch_size=32, max_wait_ms=5.0,
@@ -112,7 +130,8 @@ class ServingConfig(object):
                  bucket_sizes=None, max_buckets=16,
                  trailing_buckets=True, trailing_ladders=None,
                  max_trailing_buckets=32, watchdog_stall_s=None,
-                 decode_slots=8, decode_steps=4):
+                 decode_slots=8, decode_steps=4, scheduling='edf',
+                 admit_queue_depth=None, admit_queue_age_ms=None):
         if int(steps_per_dispatch) < 1:
             raise ValueError('steps_per_dispatch must be >= 1')
         if int(pipeline_depth) < 1:
@@ -146,6 +165,24 @@ class ServingConfig(object):
             raise ValueError('decode_steps must be >= 1')
         self.decode_slots = int(decode_slots)
         self.decode_steps = int(decode_steps)
+        if scheduling not in ('edf', 'fifo'):
+            raise ValueError(
+                "ServingConfig: scheduling must be 'edf' or 'fifo', "
+                'got %r' % (scheduling, ))
+        self.scheduling = scheduling
+        if admit_queue_depth is not None and int(admit_queue_depth) < 1:
+            raise ValueError('admit_queue_depth must be >= 1 (or None '
+                             'to disable the depth watermark)')
+        if admit_queue_age_ms is not None and \
+                float(admit_queue_age_ms) <= 0:
+            raise ValueError('admit_queue_age_ms must be > 0 (or None '
+                             'to disable the age watermark)')
+        self.admit_queue_depth = (int(admit_queue_depth)
+                                  if admit_queue_depth is not None
+                                  else None)
+        self.admit_queue_age_s = (float(admit_queue_age_ms) / 1e3
+                                  if admit_queue_age_ms is not None
+                                  else None)
 
 
 class _Lot(object):
@@ -225,8 +262,28 @@ class InferenceEngine(object):
             self.trailing = TrailingDimBuckets(
                 ladders=self.config.trailing_ladders,
                 max_buckets=self.config.max_trailing_buckets)
-        self._batcher = MicroBatcher(self.config.max_batch_size,
-                                     self.config.max_wait_s)
+        # deadline-aware lot formation (ISSUE 8): the engine owns the
+        # shed side effects (typed error + 'shed' trace stage + the
+        # counter), and feeds the batcher its service estimate so
+        # hopeless requests shed BEFORE burning a dispatch.  The
+        # estimate is 3x the MINIMUM recent dispatch wall: min, not
+        # mean — a compile-heavy cold dispatch (hundreds of ms) would
+        # poison a mean into shedding EVERYTHING under tight deadlines,
+        # and a total shed stops drains, so a poisoned mean could never
+        # recover; min bounds the true service floor.  The 3x margin
+        # matters because EDF always picks the most at-risk request:
+        # with only ~1 dispatch-wall of slack the pick lands AT the
+        # deadline and timing jitter turns it late — 3x leaves a full
+        # dispatch of slack after the pick.
+        ref0 = weakref.ref(self)
+        self._service_walls = deque(maxlen=8)
+        self._batcher = MicroBatcher(
+            self.config.max_batch_size, self.config.max_wait_s,
+            scheduling=self.config.scheduling,
+            on_shed=lambda req: (ref0() and ref0()._shed_request(req)),
+            service_estimate_fn=lambda: (
+                3.0 * min(ref0()._service_walls)
+                if ref0() and ref0()._service_walls else 0.0))
         # generation lane (ISSUE 7): a GenerationSpec turns on
         # submit_generate — prompts prefill through the normal lot
         # machinery, then decode in the slot-batched in-jit scan
@@ -503,12 +560,43 @@ class InferenceEngine(object):
 
     # ---- request surface ----------------------------------------------
 
-    def submit(self, feed, return_numpy=True):
+    def _shed_request(self, req, where='queue'):
+        """Resolve one past-deadline request as SHED (ISSUE 8): typed
+        DeadlineExceededError, a 'shed' trace stage (the seconds the
+        request sat before the scheduler dropped it), a flight-recorder
+        record, and the metrics counter.  Called by the batcher at lot
+        formation, by decode-slot admission, and by the decode lane's
+        step-boundary deadline check."""
+        if req.done():
+            return
+        now = time.time()
+        late_ms = (round((now - req.deadline_t) * 1e3, 3)
+                   if req.deadline_t is not None else None)
+        if req.trace is not None:
+            req.trace.add_stage('shed', now - req.enqueue_t)
+            self._metrics.note_stages(req.trace.finalize(end=now))
+        self._metrics.note_shed()
+        _trace.flight_recorder.record(
+            'serving_shed', engine=self.name, where=where,
+            trace_id=req.trace_id, deadline_ms=req.deadline_ms,
+            late_by_ms=late_ms)
+        req.set_error(DeadlineExceededError(
+            req.trace_id, req.deadline_ms, late_ms, where=where))
+
+    def submit(self, feed, return_numpy=True, priority=0,
+               deadline_ms=None):
         """Enqueue one request; returns an InferenceRequest future.
         When the engine is not start()ed, the dispatch runs inline on
-        this thread (synchronous mode) and the future is already done."""
+        this thread (synchronous mode) and the future is already done.
+
+        ``priority`` / ``deadline_ms`` (ISSUE 8): under the default
+        'edf' scheduling, higher-priority requests form lots first,
+        earliest deadline first within a class, and a request whose
+        deadline passes while it waits is SHED — its future raises
+        DeadlineExceededError and its trace carries a 'shed' stage —
+        instead of being served late."""
         if self._closed:
-            raise RuntimeError('engine is closed')
+            raise EngineClosedError('engine is closed')
         if not isinstance(feed, dict) or not feed:
             raise ValueError('feed must be a non-empty {name: data} dict')
         if self._feed_names is not None:
@@ -531,7 +619,8 @@ class InferenceEngine(object):
         feed, rows, sig, trims = self._prepare_request(feed)
         ctx.add_stage('pad', time.time() - t_prep)
         req = InferenceRequest(feed, rows, sig, return_numpy=return_numpy,
-                               trailing=trims, trace=ctx)
+                               trailing=trims, trace=ctx,
+                               priority=priority, deadline_ms=deadline_ms)
         self._metrics.note_request(rows or 1)
         ctx.mark('enqueue')
         self._batcher.submit(req)
@@ -543,7 +632,8 @@ class InferenceEngine(object):
         """Synchronous convenience: submit + wait."""
         return self.submit(feed, return_numpy=return_numpy).result(timeout)
 
-    def submit_generate(self, feed, max_len=None, return_numpy=True):
+    def submit_generate(self, feed, max_len=None, return_numpy=True,
+                        priority=0, deadline_ms=None):
         """Enqueue one GENERATION request (ISSUE 7): ``feed`` is the
         prompt (the generation spec's prefill feeds, ONE sequence —
         rows must be 1), ``max_len`` the per-request step budget
@@ -557,14 +647,20 @@ class InferenceEngine(object):
         quantized like any forward request); the prefilled state then
         ADMITS into a free decode slot at the next step boundary and
         rides the slot-batched in-jit decode scan — continuous
-        batching, no drain barrier against requests already decoding."""
+        batching, no drain barrier against requests already decoding.
+
+        ``priority`` / ``deadline_ms`` ride the prefill lot like any
+        forward request; the decode lane additionally checks the
+        deadline at every step boundary (between K-step scans) — an
+        expired generation releases its slot and sheds with whatever
+        tokens it had, so dead decodes stop starving live ones."""
         from .decode import GenerationRequest
         if self.generation is None:
             raise RuntimeError(
                 'submit_generate: this engine serves no generation '
                 'model — construct it with generation=GenerationSpec(...)')
         if self._closed:
-            raise RuntimeError('engine is closed')
+            raise EngineClosedError('engine is closed')
         spec = self.generation
         if not isinstance(feed, dict) or not feed:
             raise ValueError('feed must be a non-empty {name: data} dict')
@@ -600,7 +696,9 @@ class InferenceEngine(object):
         # even when the raw feed signatures collide
         req = GenerationRequest(feed, rows, ('gen', ) + tuple(sig),
                                 min(max_len, spec.max_len),
-                                return_numpy=return_numpy, trace=ctx)
+                                return_numpy=return_numpy, trace=ctx,
+                                priority=priority,
+                                deadline_ms=deadline_ms)
         self._metrics.note_generate()
         ctx.mark('enqueue')
         self._batcher.submit(req)
@@ -615,7 +713,9 @@ class InferenceEngine(object):
     def metrics(self):
         """Engine snapshot + bucket report + the executor's own XLA
         compile counter (the ground truth the bucket policy bounds)."""
-        snap = self._metrics.snapshot(queue_depth=self._batcher.depth())
+        snap = self._metrics.snapshot(
+            queue_depth=self._batcher.depth(),
+            queue_age=self._batcher.age_stats())
         snap['buckets'] = self.buckets.report()
         snap['trailing_buckets'] = (self.trailing.report()
                                     if self.trailing is not None else None)
@@ -1000,6 +1100,18 @@ class InferenceEngine(object):
         dev_start = max(t_disp, self._last_sync_t)
         if cost is not None and cost.get('flops') and t_sync > dev_start:
             self._metrics.note_device(cost['flops'], t_sync - dev_start)
+        # service-time window (ISSUE 8): one dispatch's RAW issue->sync
+        # span feeds the batcher's shed horizon — a deadlined request
+        # that cannot be served within ~2x the recent MINIMUM span
+        # sheds instead of burning the dispatch it would miss anyway.
+        # Deliberately NOT the clipped device window above: under
+        # pipeline_depth >= 2 the raw span includes the wait behind
+        # earlier in-flight dispatches, and that wait IS part of the
+        # time a newly formed lot takes to deliver — estimating from
+        # the clipped window makes EDF pick requests it then serves
+        # just past their deadline (measured: the slo gate's edf_late
+        # jumps ~10x).  The min-of-8 still discards compile outliers.
+        self._service_walls.append(max(t_sync - t0, 0.0))
         self._last_sync_t = t_sync
         led = fetch_batch_led(compiled, len(arrays))
         if not all(led) and not self._warned_unsliced and \
@@ -1095,6 +1207,15 @@ class InferenceEngine(object):
             req, values = self._gen_ready.popleft()
             if req.done():
                 continue  # errored upstream; nothing to decode
+            if self.config.scheduling == 'edf' and \
+                    req.deadline_t is not None and \
+                    time.time() > req.deadline_t:
+                # prefilled but dead on arrival at the slot: shedding
+                # here frees the slot-steps its whole generation would
+                # have wasted.  'fifo' admits it anyway — that mode's
+                # contract is serve-everything-late, nothing shed.
+                self._shed_request(req, where='admit')
+                continue
             try:
                 self._decode_cache.admit(req, values)
             except Exception as exc:
@@ -1115,6 +1236,22 @@ class InferenceEngine(object):
         cache = self._decode_cache
         if cache is None:
             return False
+        # per-token deadline budget (ISSUE 8): the step boundary is the
+        # decode lane's scheduling point — an active generation whose
+        # deadline passed releases its slot NOW and sheds (with the
+        # tokens it already has accounted in the trace) instead of
+        # decoding to max_len while live requests wait for a slot
+        if self.config.scheduling == 'edf':
+            now = time.time()
+            for req in cache.active_requests():
+                if req.deadline_t is not None and now > req.deadline_t:
+                    slot = req.slot
+                    cache.release(slot)
+                    cache.deactivate(slot)
+                    if req.trace is not None:
+                        req.trace.add_count('decode_steps',
+                                            len(req.tokens))
+                    self._shed_request(req, where='decode')
         self._admit_ready()
         if not cache.any_active():
             return False

@@ -1,0 +1,249 @@
+"""Open-loop load generation: drive the serving stack at production
+rates and measure the tail (ISSUE 8).
+
+A CLOSED-loop driver (submit, wait, submit) measures the server's
+latency at whatever rate the server happens to sustain — under
+overload it politely slows down with the server and the tail looks
+fine.  Production traffic does not wait: arrivals are an external
+process.  This generator is OPEN-loop the way serving papers measure
+(Clockwork OSDI '20, ORCA OSDI '22): arrival times are pre-drawn from
+a Poisson process at the configured rate, every request fires at its
+arrival time whether or not earlier ones completed, and the report
+separates THROUGHPUT (completed/s) from GOODPUT (completed INSIDE the
+request's deadline) — the number an SLO actually pays for.
+
+Determinism: all randomness (arrival gaps, traffic-class picks, feed
+payloads) comes from one seeded RandomState, and feeds are pre-drawn
+before the clock starts — so two runs with the same seed offer the
+IDENTICAL request stream.  The ``slo`` perf gate leans on this to
+drive a deadline-scheduled engine and a FIFO engine with the same
+traffic and compare goodput and bitwise results.
+
+    gen = OpenLoopLoadGen(
+        reg,
+        classes=[TrafficClass(lambda rng: {'x': rng.rand(4, 6).astype('float32')},
+                              model='ranker', deadline_ms=50),
+                 TrafficClass(make_prompt, model='chat', kind='generate',
+                              weight=0.2, deadline_ms=500, max_len=16)],
+        rate=200.0, n_requests=1000, seed=0)
+    report = gen.run()
+    print(report['goodput_req_s'], report['p99_ms'], report['p999_ms'])
+"""
+
+import time
+
+import numpy as np
+
+from .errors import DeadlineExceededError, OverloadedError
+
+__all__ = ['TrafficClass', 'OpenLoopLoadGen']
+
+
+def _pct(sorted_vals, p):
+    if not sorted_vals:
+        return None
+    idx = min(int(len(sorted_vals) * p), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+class TrafficClass(object):
+    """One slice of the offered mix.
+
+    feed_fn: rng -> feed dict (drawn once per request, pre-clock).
+    model: registry model name (None when the target is a bare engine).
+    kind: 'submit' (forward) or 'generate' (the decode lane).
+    weight: relative share of the offered stream.
+    deadline_ms / priority: the SLO attached to every request of this
+        class (None deadline = never expires; such responses always
+        count toward goodput).
+    max_len: generation budget for kind='generate'.
+    name: report key; defaults to model/kind.
+    """
+
+    def __init__(self, feed_fn, model=None, kind='submit', weight=1.0,
+                 deadline_ms=None, priority=0, max_len=None, name=None):
+        if kind not in ('submit', 'generate'):
+            raise ValueError("TrafficClass: kind must be 'submit' or "
+                             "'generate', got %r" % (kind, ))
+        if float(weight) <= 0:
+            raise ValueError('TrafficClass: weight must be > 0')
+        self.feed_fn = feed_fn
+        self.model = model
+        self.kind = kind
+        self.weight = float(weight)
+        self.deadline_ms = (float(deadline_ms)
+                            if deadline_ms is not None else None)
+        self.priority = int(priority)
+        self.max_len = max_len
+        self.name = name or '%s:%s' % (model or 'engine', kind)
+
+
+class OpenLoopLoadGen(object):
+    """Offer a Poisson stream of mixed traffic to ``target`` (a
+    ModelRegistry or a single InferenceEngine) and report the tail.
+
+    rate: offered arrivals per second (the Poisson intensity).
+    n_requests / duration_s: stream length — an explicit count, or
+        rate x duration when only a duration is given.
+    seed: the stream's identity — same seed, same arrivals, same
+        class picks, same payloads.
+    keep_records: retain per-request outcome records (result arrays,
+        error instance, trace breakdown) on the report under
+        'records' — the slo gate's bitwise-comparison hook.  Off by
+        default: a long soak should not hoard every response.
+    result_timeout_s: per-future wait bound during collection; a
+        future still unresolved then counts as an error (and the
+        timeout is itself report-visible — a hung worker must not
+        hang the harness).
+    """
+
+    def __init__(self, target, classes, rate, n_requests=None,
+                 duration_s=None, seed=0, keep_records=False,
+                 result_timeout_s=120.0):
+        if not classes:
+            raise ValueError('OpenLoopLoadGen: at least one '
+                             'TrafficClass is required')
+        if float(rate) <= 0:
+            raise ValueError('OpenLoopLoadGen: rate must be > 0 req/s')
+        if n_requests is None:
+            if duration_s is None:
+                raise ValueError('OpenLoopLoadGen: pass n_requests= or '
+                                 'duration_s=')
+            n_requests = max(int(float(rate) * float(duration_s)), 1)
+        self.target = target
+        self.classes = list(classes)
+        self.rate = float(rate)
+        self.n_requests = int(n_requests)
+        self.seed = int(seed)
+        self.keep_records = bool(keep_records)
+        self.result_timeout_s = float(result_timeout_s)
+
+    # ---- the stream -----------------------------------------------------
+
+    def _draw(self):
+        """Pre-draw the whole stream: arrival offsets, class picks, and
+        payloads — before the clock starts, so feed generation cost
+        never leaks into the offered timing and the stream is
+        identical across targets."""
+        rng = np.random.RandomState(self.seed)
+        n = self.n_requests
+        arrivals = np.cumsum(rng.exponential(1.0 / self.rate, size=n))
+        weights = np.asarray([c.weight for c in self.classes])
+        picks = rng.choice(len(self.classes), size=n,
+                           p=weights / weights.sum())
+        feeds = [self.classes[k].feed_fn(rng) for k in picks]
+        return arrivals, picks, feeds
+
+    def _fire(self, cls, feed):
+        """One submission; returns the future (or raises)."""
+        if cls.model is not None:
+            if cls.kind == 'generate':
+                return self.target.submit_generate(
+                    cls.model, feed, max_len=cls.max_len,
+                    priority=cls.priority, deadline_ms=cls.deadline_ms)
+            return self.target.submit(cls.model, feed,
+                                      priority=cls.priority,
+                                      deadline_ms=cls.deadline_ms)
+        if cls.kind == 'generate':
+            return self.target.submit_generate(
+                feed, max_len=cls.max_len, priority=cls.priority,
+                deadline_ms=cls.deadline_ms)
+        return self.target.submit(feed, priority=cls.priority,
+                                  deadline_ms=cls.deadline_ms)
+
+    def run(self):
+        """Offer the stream, collect every outcome, report the tail."""
+        arrivals, picks, feeds = self._draw()
+        n = self.n_requests
+        outcomes = [None] * n  # (cls, future | None, submit_error)
+        t0 = time.time()
+        for i in range(n):
+            delay = (t0 + arrivals[i]) - time.time()
+            if delay > 0:
+                # open loop: sleep TO the arrival; when the submitter
+                # itself falls behind (a stalled inline dispatch), fire
+                # immediately — never skip an arrival
+                time.sleep(delay)
+            cls = self.classes[picks[i]]
+            try:
+                outcomes[i] = (cls, self._fire(cls, feeds[i]), None)
+            except Exception as exc:  # OverloadedError and friends
+                outcomes[i] = (cls, None, exc)
+        offered_window = time.time() - t0
+        # collection: block on every future (arrival order — the waits
+        # overlap, so the bound is per-future, not cumulative)
+        records = []
+        lat = []
+        completed = good = shed = rejected = late = errors = 0
+        keep = self.keep_records
+        for i in range(n):
+            cls, fut, submit_err = outcomes[i]
+            # the per-request record (result slices, trace breakdown)
+            # is only materialized under keep_records: a long soak
+            # must not pay a dict + breakdown build per request just
+            # to throw them away
+            rec = ({'i': i, 'class': cls.name, 'status': None,
+                    'latency_ms': None} if keep else None)
+            err = submit_err
+            result = None
+            if fut is not None:
+                try:
+                    result = fut.result(self.result_timeout_s)
+                except Exception as exc:
+                    err = exc
+                if keep:
+                    rec['breakdown'] = fut.breakdown()
+            if err is None:
+                completed += 1
+                latency_ms = fut.latency_s * 1e3
+                lat.append(latency_ms)
+                good_one = (cls.deadline_ms is None or
+                            latency_ms <= cls.deadline_ms)
+                good += 1 if good_one else 0
+                late += 0 if good_one else 1
+                if keep:
+                    rec['latency_ms'] = round(latency_ms, 3)
+                    rec['status'] = 'good' if good_one else 'late'
+                    rec['result'] = result
+            elif isinstance(err, DeadlineExceededError):
+                shed += 1
+                if keep:
+                    rec['status'] = 'shed'
+            elif isinstance(err, OverloadedError):
+                rejected += 1
+                if keep:
+                    rec['status'] = 'rejected'
+                    rec['retry_after_s'] = err.retry_after_s
+            else:
+                errors += 1
+                if keep:
+                    rec['status'] = 'error'
+            if keep:
+                rec['error'] = err
+                records.append(rec)
+        elapsed = time.time() - t0
+        lat.sort()
+        report = {
+            'offered': n,
+            'offered_req_s': round(n / max(arrivals[-1], 1e-9), 3),
+            'offered_window_s': round(offered_window, 4),
+            'elapsed_s': round(elapsed, 4),
+            'completed': completed,
+            'sustained_req_s': round(completed / max(elapsed, 1e-9), 3),
+            # goodput: the SLO number — responses that arrived in time
+            'goodput': good,
+            'goodput_req_s': round(good / max(elapsed, 1e-9), 3),
+            'late': late,
+            'shed': shed,
+            'overload_rejected': rejected,
+            'errors': errors,
+            'p50_ms': (round(_pct(lat, 0.50), 3) if lat else None),
+            'p99_ms': (round(_pct(lat, 0.99), 3) if lat else None),
+            'p999_ms': (round(_pct(lat, 0.999), 3) if lat else None),
+            'classes': [c.name for c in self.classes],
+            'rate': self.rate,
+            'seed': self.seed,
+        }
+        if self.keep_records:
+            report['records'] = records
+        return report

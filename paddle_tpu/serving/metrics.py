@@ -43,6 +43,10 @@ class EngineMetrics(object):
         self.steps_dispatched = 0
         self.compiles = 0
         self.errors = 0
+        # SLO lane (ISSUE 8): requests shed past-deadline instead of
+        # served late — the deadline scheduler's drop counter (typed
+        # DeadlineExceededError on the future; NOT counted as errors)
+        self.shed = 0
         # trailing-dim bucketing (ISSUE 5): padded vs real CELLS along
         # bucketed trailing axes (weighted by rows, summed over feeds)
         self.trailing_real_cells = 0
@@ -106,6 +110,10 @@ class EngineMetrics(object):
     def note_error(self):
         with self._lock:
             self.errors += 1
+
+    def note_shed(self):
+        with self._lock:
+            self.shed += 1
 
     def note_stages(self, stage_s):
         """One delivered request's finalized per-stage seconds."""
@@ -174,15 +182,25 @@ class EngineMetrics(object):
                 'pending': pending,
             }
 
-    def snapshot(self, queue_depth=0):
+    def snapshot(self, queue_depth=0, queue_age=None):
         """One coherent dict: counters plus the derived rates the
         ROADMAP's serving lane cares about (batch fill ratio = real rows
         over padded-bucket rows across all lots; steps/dispatch is the
-        measured pipelining depth)."""
+        measured pipelining depth).  ``queue_age`` is the batcher's
+        age_stats() dict (ISSUE 8) — the admission watermarks' inputs,
+        surfaced so a stalling queue shows up in metrics() without
+        waiting for the watchdog dump."""
         with self._lock:
             lat = sorted(self._latencies)
             return {
                 'queue_depth': int(queue_depth),
+                'queue_age_oldest_s': (
+                    round(queue_age['oldest_s'], 4)
+                    if queue_age else None),
+                'queue_age_mean_s': (
+                    round(queue_age['mean_s'], 4)
+                    if queue_age else None),
+                'shed': self.shed,
                 'requests': self.requests,
                 'rows': self.rows,
                 'lots': self.lots,

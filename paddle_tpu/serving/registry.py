@@ -46,6 +46,8 @@ model arrives.  ``ModelRegistry`` is that subsystem:
 """
 
 import itertools
+import json
+import os
 import threading
 import time
 import weakref
@@ -55,10 +57,22 @@ import numpy as np
 from ..fluid import core
 from ..fluid import profiler as _profiler
 from ..fluid import trace as _trace
+from ..fluid.flags import FLAGS as _FLAGS
 from .arbiter import HBMArbiter, HBMBudgetError, program_seed_bytes
 from .engine import InferenceEngine, ServingConfig
+from .errors import OverloadedError
 
-__all__ = ['ModelRegistry']
+__all__ = ['ModelRegistry', 'WARM_CATALOG_BASENAME']
+
+# the fleet's compile catalog (ISSUE 8): every registry.warm() call is
+# recorded here as a replayable signature set (batch rungs x trailing
+# rungs x decode-prefill extents), persisted NEXT TO the persistent XLA
+# compile cache (FLAGS_xla_compile_cache_dir) — the pairing is the
+# point: the XLA cache holds the compiled executables keyed by traced
+# signature, and the catalog holds WHICH signatures a fresh process
+# must re-trace to hit them.  registry.prewarm(catalog) replays it so a
+# restarted server compiles nothing on first traffic.
+WARM_CATALOG_BASENAME = 'serving_warm_catalog.json'
 
 # the decode-state cache's arbiter account rides next to its model's
 # weight account under this suffix (ISSUE 7): `<model>:decode-cache` —
@@ -70,7 +84,7 @@ DECODE_CACHE_SUFFIX = ':decode-cache'
 
 class _ModelEntry(object):
     __slots__ = ('name', 'engine', 'dirname', 'loaded_t', 'requests',
-                 'rows', 'first_req_t', 'last_req_t')
+                 'rows', 'first_req_t', 'last_req_t', 'overload_rejects')
 
     def __init__(self, name, engine, dirname):
         self.name = name
@@ -81,6 +95,7 @@ class _ModelEntry(object):
         self.rows = 0
         self.first_req_t = None
         self.last_req_t = None
+        self.overload_rejects = 0
 
 
 class ModelRegistry(object):
@@ -98,6 +113,9 @@ class ModelRegistry(object):
         self.name = name or 'model-registry'
         self.arbiter = HBMArbiter(hbm_budget_bytes)
         self._models = {}
+        # the compile catalog (ISSUE 8): replayable records of every
+        # warm() this registry served, persisted next to the XLA cache
+        self._warm_catalog = []
         # ONE reentrant lock over the model table + arbiter decisions:
         # a submit ensuring residency (which may pause + evict another
         # model) must never interleave with a load/unload mutating the
@@ -246,10 +264,31 @@ class ModelRegistry(object):
         executable at each prompt-length rung plus the decode-step
         scan executable, so first real generation traffic pays
         staging, not XLA compiles.  A decode-only call (no
-        bucket_ladder/trailing) skips the forward-surface warm."""
+        bucket_ladder/trailing) skips the forward-surface warm.
+
+        Every successful warm is RECORDED into the registry's compile
+        catalog (ISSUE 8) and — when FLAGS_xla_compile_cache_dir is set
+        — persisted as ``serving_warm_catalog.json`` next to the XLA
+        cache, so ``prewarm()`` on a fresh process can replay the
+        exact signature set this fleet compiled."""
         entry = self._entry(name)
         engine = entry.engine
         served = 0
+        # materialize iterator-valued args ONCE, before anything reads
+        # them: the catalog record and the warm body must see the same
+        # extents (an iterator drained by the record would warm nothing
+        # while recording rungs)
+        if decode_prefill is not None:
+            decode_prefill = [int(e) for e in decode_prefill]
+        trailing = {str(f): [int(e) for e in v]
+                    for f, v in (trailing or {}).items()} or None
+        record = {
+            'model': str(name),
+            'bucket_ladder': ([int(b) for b in bucket_ladder]
+                              if bucket_ladder is not None else None),
+            'trailing': trailing,
+            'decode_prefill': decode_prefill,
+        }
         if decode_prefill is not None:
             spec = engine.generation
             if spec is None:
@@ -287,13 +326,11 @@ class ModelRegistry(object):
                 self.generate(name, feed, max_len=1, timeout=600)
                 served += 1
             if bucket_ladder is None and not trailing:
+                self._record_warm(record)
                 return served
         ladder = list(bucket_ladder if bucket_ladder is not None
                       else engine.buckets.sizes)
-        # materialize ONCE: iterator-valued extents would otherwise be
-        # drained by the empty-check below and the cross-product would
-        # see nothing
-        trailing = {f: list(v) for f, v in (trailing or {}).items()}
+        trailing = trailing or {}
         feed_names = engine._feed_names
         if not feed_names:
             raise ValueError(
@@ -394,7 +431,114 @@ class ModelRegistry(object):
                         for fname in feed_names}
                 self.infer(name, feed, timeout=600)
                 served += 1
+        self._record_warm(record)
         return served
+
+    # ---- prewarm catalog (ISSUE 8) -------------------------------------
+
+    def warm_catalog_path(self):
+        """Where the compile catalog persists: next to the persistent
+        XLA compile cache (FLAGS_xla_compile_cache_dir), or None when
+        no cache dir is configured (the catalog then lives in-memory
+        only — ``warm_catalog()`` still returns it)."""
+        cache_dir = _FLAGS.xla_compile_cache_dir
+        if not cache_dir:
+            return None
+        return os.path.join(cache_dir, WARM_CATALOG_BASENAME)
+
+    def warm_catalog(self):
+        """The recorded warm set: one replayable dict per distinct
+        warm() call (model, bucket_ladder, trailing, decode_prefill)."""
+        with self._lock:
+            return [dict(r) for r in self._warm_catalog]
+
+    def _record_warm(self, record):
+        """Append one warm record (deduped — prewarm replays through
+        warm(), which must not grow the catalog it is replaying) and
+        persist the catalog atomically next to the XLA cache.  The
+        write MERGES with what is already on disk: a staged restart
+        that loaded (and re-warmed) only some models — or a peer
+        process sharing the cache dir — has records there for models
+        THIS registry never warmed, and overwriting would delete their
+        replay set."""
+        path = self.warm_catalog_path()
+        # the read-merge-replace stays under self._lock: two threads
+        # warming concurrently would otherwise race read-vs-replace and
+        # one record would vanish from disk (a lost update).  Peer
+        # PROCESSES sharing the cache dir can still interleave — the
+        # merge shrinks that window but does not close it; same-process
+        # durability is the contract the prewarm acceptance pins.
+        with self._lock:
+            if record not in self._warm_catalog:
+                self._warm_catalog.append(record)
+            if path is None:
+                return
+            catalog = [dict(r) for r in self._warm_catalog]
+            tmp = path + '.tmp'
+            try:
+                try:
+                    with open(path) as f:
+                        on_disk = json.load(f)
+                except (OSError, ValueError):
+                    on_disk = []
+                merged = list(on_disk) + [r for r in catalog
+                                          if r not in on_disk]
+                with open(tmp, 'w') as f:
+                    json.dump(merged, f, indent=1)
+                    f.write('\n')
+                os.replace(tmp, path)
+            except OSError:
+                # an unwritable cache dir must not fail the warm
+                # itself — the in-memory catalog still serves
+                # same-process prewarms
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+
+    def prewarm(self, catalog=None):
+        """Replay a compile catalog on THIS registry (the fleet
+        cold-start path, ISSUE 8): for every record whose model is
+        loaded, re-run ``warm()`` with the recorded bucket ladder x
+        trailing rungs x decode-prefill extents.  With
+        FLAGS_xla_compile_cache_dir pointing at the SAME persistent
+        cache the recording process used, each replayed compile is a
+        disk hit, and first real traffic at the recorded signatures
+        compiles nothing (``compile_count`` delta 0 — the acceptance
+        bar).
+
+        ``catalog``: a path to a catalog JSON, an already-loaded list
+        of records, or None to read the default
+        ``warm_catalog_path()``.  Records for models not currently
+        loaded are skipped (reported, not raised — a fleet restart may
+        stage models in stages).  Returns
+        {'served', 'replayed', 'skipped_models'}."""
+        if catalog is None:
+            catalog = self.warm_catalog_path()
+            if catalog is None:
+                raise ValueError(
+                    'prewarm(): no catalog given and no '
+                    'FLAGS_xla_compile_cache_dir to read the default '
+                    'from — pass a path or a record list')
+        if isinstance(catalog, str):
+            with open(catalog) as f:
+                catalog = json.load(f)
+        served = replayed = 0
+        skipped = []
+        for rec in list(catalog):
+            model = rec.get('model')
+            with self._lock:
+                loaded = model in self._models
+            if not loaded:
+                skipped.append(model)
+                continue
+            served += self.warm(
+                model, bucket_ladder=rec.get('bucket_ladder'),
+                trailing=rec.get('trailing'),
+                decode_prefill=rec.get('decode_prefill'))
+            replayed += 1
+        return {'served': served, 'replayed': replayed,
+                'skipped_models': sorted(set(skipped))}
 
     def _entry(self, name):
         with self._lock:
@@ -452,16 +596,49 @@ class ModelRegistry(object):
 
     # ---- router --------------------------------------------------------
 
-    def submit(self, model, feed, return_numpy=True):
-        """Route one request to ``model``: ensure it is resident under
-        the HBM budget (transparently reloading it / evicting LRU peers
-        — the caller never sees the arbitration, only the latency), and
-        enqueue on its engine.  Returns the engine's InferenceRequest
-        future — its ``breakdown()`` carries the routed request's
-        per-stage latency INCLUDING the arbitration window paid here
-        (the trace context is attached before engine.submit, so the
-        engine threads the registry's trace id instead of minting its
-        own)."""
+    def _check_admission(self, model):
+        """Per-model overload admission (ISSUE 8): when the model's
+        ServingConfig carries queue watermarks (admit_queue_depth /
+        admit_queue_age_ms) and its engine's queue has crossed one,
+        refuse the request at the DOOR with a typed OverloadedError —
+        BEFORE paying arbitration (an eviction on behalf of a request
+        that would only queue toward deadline death helps nobody).  The
+        retry-after hint is one queue-drain window: the oldest queued
+        age (how far behind the worker is) floored at the batching
+        wait.  (The entry lookup is NOT returned: _ensure_resident must
+        re-resolve it under the lock anyway, or it would race an
+        unload between the two calls.)"""
+        entry = self._entry(model)
+        cfg = entry.engine.config
+        depth_wm = cfg.admit_queue_depth
+        age_wm = cfg.admit_queue_age_s
+        if depth_wm is None and age_wm is None:
+            return
+        depth = entry.engine._batcher.depth()
+        age = entry.engine._batcher.oldest_age() or 0.0
+        if (depth_wm is not None and depth >= depth_wm) or \
+                (age_wm is not None and age >= age_wm):
+            with self._lock:
+                entry.overload_rejects += 1
+            raise OverloadedError(
+                model, depth, age,
+                retry_after_s=round(max(age, cfg.max_wait_s), 4))
+
+    def submit(self, model, feed, return_numpy=True, priority=0,
+               deadline_ms=None):
+        """Route one request to ``model``: admission-check it against
+        the model's overload watermarks (typed OverloadedError with a
+        retry-after hint when the queue is past them), ensure the model
+        is resident under the HBM budget (transparently reloading it /
+        evicting LRU peers — the caller never sees the arbitration,
+        only the latency), and enqueue on its engine.  ``priority`` /
+        ``deadline_ms`` ride through to the engine's deadline scheduler
+        (ISSUE 8).  Returns the engine's InferenceRequest future — its
+        ``breakdown()`` carries the routed request's per-stage latency
+        INCLUDING the arbitration window paid here (the trace context
+        is attached before engine.submit, so the engine threads the
+        registry's trace id instead of minting its own)."""
+        self._check_admission(model)
         ctx = _trace.TraceContext()
         t0 = time.time()
         entry = self._ensure_resident(model)
@@ -473,7 +650,9 @@ class ModelRegistry(object):
                 entry.first_req_t = now
             entry.last_req_t = now
         with _trace.attach(ctx):
-            req = entry.engine.submit(feed, return_numpy=return_numpy)
+            req = entry.engine.submit(feed, return_numpy=return_numpy,
+                                      priority=priority,
+                                      deadline_ms=deadline_ms)
         if req.rows:
             with self._lock:
                 entry.rows += req.rows
@@ -484,12 +663,17 @@ class ModelRegistry(object):
         return self.submit(model, feed,
                            return_numpy=return_numpy).result(timeout)
 
-    def submit_generate(self, model, feed, max_len=None):
-        """Route one GENERATION request (ISSUE 7): ensure the model
-        AND its decode cache are resident under the HBM budget, then
-        enqueue on its engine's decode lane.  Returns the engine's
-        GenerationRequest future; its ``breakdown()`` carries the
-        arbitration window plus the prefill/decode/detokenize stages."""
+    def submit_generate(self, model, feed, max_len=None, priority=0,
+                        deadline_ms=None):
+        """Route one GENERATION request (ISSUE 7): admission-check the
+        overload watermarks, ensure the model AND its decode cache are
+        resident under the HBM budget, then enqueue on its engine's
+        decode lane.  ``priority`` / ``deadline_ms`` ride the prefill
+        lot and the decode lane's step-boundary deadline check (ISSUE
+        8).  Returns the engine's GenerationRequest future; its
+        ``breakdown()`` carries the arbitration window plus the
+        prefill/decode/detokenize stages."""
+        self._check_admission(model)
         ctx = _trace.TraceContext()
         t0 = time.time()
         entry = self._ensure_resident(model, decode=True)
@@ -501,7 +685,9 @@ class ModelRegistry(object):
                 entry.first_req_t = now
             entry.last_req_t = now
         with _trace.attach(ctx):
-            req = entry.engine.submit_generate(feed, max_len=max_len)
+            req = entry.engine.submit_generate(feed, max_len=max_len,
+                                               priority=priority,
+                                               deadline_ms=deadline_ms)
         with self._lock:
             entry.rows += 1
         return req
@@ -589,6 +775,7 @@ class ModelRegistry(object):
                 'rows': entry.rows,
                 'req_per_s': (round((entry.requests - 1) / window, 3)
                               if window else None),
+                'overload_rejects': entry.overload_rejects,
             }
             per_model[name] = snap
         return {
@@ -596,6 +783,8 @@ class ModelRegistry(object):
             'evictions': arb['evictions'],
             'reloads': arb['reloads'],
             'admission_rejects': arb['admission_rejects'],
+            'overload_rejects': sum(e.overload_rejects
+                                    for e in entries.values()),
             'budget_bytes': arb['budget_bytes'],
             'resident_bytes': arb['resident_bytes'],
             'audit': arb['audit'],

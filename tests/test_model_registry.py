@@ -415,3 +415,135 @@ def test_cloned_predictors_run_concurrently(model_dirs):
     for t in threads:
         t.join()
     assert not errors, errors
+
+
+# ---- unload/submit races (ISSUE 8 satellite) ---------------------------
+
+def _hammer_outcomes(reg, submit_fn, unload_reload, n_threads=4):
+    """Race ``submit_fn`` from N threads against ``unload_reload``
+    churning the model table; classify every outcome.  The bar: every
+    future RESOLVES (result or a typed error) — 'HANG' and untyped
+    crashes are failures."""
+    import time as _time
+    stop = threading.Event()
+    outcomes, lock = [], threading.Lock()
+
+    def note(tag):
+        with lock:
+            outcomes.append(tag)
+
+    def client():
+        while not stop.is_set():
+            try:
+                fut = submit_fn()
+            except (KeyError, serving.EngineClosedError) as e:
+                note(type(e).__name__)
+                _time.sleep(0.001)
+                continue
+            except Exception as e:  # untyped submit crash = failure
+                note('UNTYPED_SUBMIT:' + repr(e))
+                continue
+            try:
+                fut.result(60)
+                note('ok')
+            except (serving.EngineClosedError,
+                    serving.DeadlineExceededError) as e:
+                note(type(e).__name__)
+            except TimeoutError:
+                note('HANG')
+            except Exception as e:
+                note('UNTYPED_RESULT:' + repr(e))
+
+    threads = [threading.Thread(target=client) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    unload_reload()
+    stop.set()
+    for t in threads:
+        t.join(120)
+    assert not any(t.is_alive() for t in threads), 'client thread hung'
+    return outcomes
+
+
+@pytest.mark.parametrize('parallel', [False, True], ids=['cpu', 'mesh8'])
+def test_unload_submit_race_hammer(model_dirs, parallel):
+    """submit() racing unload()/load() churn, on CPU and the 8-dev
+    mesh: every future resolves to a result or a TYPED error (KeyError
+    for a forgotten model, EngineClosedError for a stopping engine) —
+    never a hang, never an untyped crash."""
+    import time as _time
+    reg = serving.ModelRegistry(parallel=parallel)
+    reg.load('mA', model_dirs['mA'])
+    rng = np.random.RandomState(0)
+    feed = {'x': rng.rand(4, 6).astype('float32')}
+    with reg:
+        reg.infer('mA', feed, timeout=300)  # warm the serving rung
+
+        def churn():
+            for _ in range(2):
+                _time.sleep(0.05)
+                reg.unload('mA')
+                _time.sleep(0.05)
+                reg.load('mA', model_dirs['mA'])
+            _time.sleep(0.05)
+
+        outcomes = _hammer_outcomes(
+            reg, lambda: reg.submit('mA', feed), churn)
+    reg.stop()
+    bad = [o for o in outcomes if o == 'HANG' or o.startswith('UNTYPED')]
+    assert not bad, bad[:5]
+    assert 'ok' in outcomes  # traffic really flowed...
+    assert 'KeyError' in outcomes or 'EngineClosedError' in outcomes, \
+        outcomes[:10]  # ...and really raced the unloads
+
+
+def test_unload_submit_generate_race_hammer():
+    """The decode lane's half of the race bar: submit_generate()
+    against a generation model mid-unload() resolves typed — a prompt
+    caught between prefill and slot admission must still resolve its
+    future when the engine drains."""
+    import time as _time
+    from paddle_tpu.models import seq2seq
+    m = seq2seq.build_step_decode(
+        src_dict_dim=40, trg_dict_dim=30, embedding_dim=8,
+        encoder_size=12, decoder_size=12, max_len=6)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(m['prefill_startup'])
+        exe.run(m['step_startup'])
+    rng = np.random.RandomState(1)
+
+    def load():
+        reg.load('gen', program=m['prefill'],
+                 fetch_list=m['prefill_fetches'], scope=scope,
+                 executor=exe,
+                 generation=serving.GenerationSpec.from_model(m),
+                 config=serving.ServingConfig(
+                     max_batch_size=4, max_wait_ms=1, decode_slots=2,
+                     decode_steps=2))
+
+    def prompt():
+        l = int(rng.randint(2, 5))
+        return {'src_word_id': fluid.create_lod_tensor(
+            rng.randint(2, 40, size=(l, 1)).tolist(), [[l]])}
+
+    reg = serving.ModelRegistry()
+    load()
+    with reg:
+        reg.generate('gen', prompt(), timeout=300)  # warm prefill+scan
+
+        def churn():
+            _time.sleep(0.05)
+            reg.unload('gen')
+            _time.sleep(0.05)
+            load()
+            _time.sleep(0.1)
+
+        outcomes = _hammer_outcomes(
+            reg, lambda: reg.submit_generate('gen', prompt()), churn,
+            n_threads=3)
+    reg.stop()
+    bad = [o for o in outcomes if o == 'HANG' or o.startswith('UNTYPED')]
+    assert not bad, bad[:5]
+    assert 'ok' in outcomes

@@ -1,0 +1,179 @@
+"""Open-loop serving load harness CLI (ISSUE 8).
+
+Drives a ModelRegistry with a seeded Poisson request stream
+(serving.OpenLoopLoadGen) and prints one JSON report line: sustained
+req/s, p50/p99/p99.9 latency, GOODPUT (responses inside their
+deadline), shed / overload-rejected / late counts, plus the registry's
+own metrics snapshot.  Works against synthetic built-in models (the
+default — zero setup, runs on CPU or TPU) or a directory of
+save_inference_model exports.
+
+Examples:
+
+    # overload a single synthetic model 3x past its measured capacity,
+    # 50ms deadlines, deadline scheduling:
+    python tools/load_gen.py --requests 500 --overload 3 --deadline-ms 50
+
+    # absolute rate, two models, mixed priorities, FIFO baseline:
+    python tools/load_gen.py --models 2 --rate 400 --scheduling fifo
+
+    # your own exported model dir:
+    python tools/load_gen.py --model-dir /models/ranker --rate 100
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_synthetic(seed, dim=16, classes=64):
+    """One tiny dense scorer program (f32, softmax head) + its scope —
+    the same padding-neutral shape the serving perf gates use."""
+    import paddle_tpu.fluid as fluid
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = seed
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data('x', shape=[-1, dim], dtype='float32')
+        pooled = fluid.layers.reduce_sum(x, dim=1)
+        pred = fluid.layers.fc(pooled, classes, act='softmax')
+    place = (fluid.TPUPlace() if fluid.core.is_compiled_with_tpu()
+             else fluid.CPUPlace())
+    exe = fluid.Executor(place)
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    return prog.clone(for_test=True), pred, scope, place
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument('--rate', type=float, default=None,
+                   help='offered req/s (Poisson intensity); default: '
+                        'measured capacity x --overload')
+    p.add_argument('--overload', type=float, default=2.0,
+                   help='rate multiplier over measured capacity when '
+                        '--rate is not given (default 2.0)')
+    p.add_argument('--requests', type=int, default=200)
+    p.add_argument('--duration', type=float, default=None,
+                   help='offered seconds (overrides --requests when set)')
+    p.add_argument('--deadline-ms', type=float, default=None,
+                   help='per-request deadline; unset = no deadlines '
+                        '(everything counts toward goodput)')
+    p.add_argument('--priority-frac', type=float, default=0.0,
+                   help='fraction of traffic submitted at priority 1 '
+                        '(the rest at 0)')
+    p.add_argument('--models', type=int, default=1,
+                   help='number of synthetic models to mix across')
+    p.add_argument('--model-dir', default=None,
+                   help='serve this save_inference_model dir instead '
+                        'of synthetic models (single feed)')
+    p.add_argument('--rows', type=int, default=4,
+                   help='rows per request')
+    p.add_argument('--seq', type=int, default=12,
+                   help='synthetic request trailing extent')
+    p.add_argument('--max-batch', type=int, default=16)
+    p.add_argument('--max-wait-ms', type=float, default=2.0)
+    p.add_argument('--scheduling', choices=['edf', 'fifo'], default='edf')
+    p.add_argument('--admit-depth', type=int, default=None,
+                   help='overload admission watermark: queue depth')
+    p.add_argument('--admit-age-ms', type=float, default=None,
+                   help='overload admission watermark: oldest queue age')
+    p.add_argument('--seed', type=int, default=0)
+    args = p.parse_args(argv)
+
+    import numpy as np
+    import paddle_tpu.fluid as fluid  # noqa: F401 (registers flags)
+    from paddle_tpu import serving
+
+    cfg = serving.ServingConfig(
+        max_batch_size=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        scheduling=args.scheduling,
+        admit_queue_depth=args.admit_depth,
+        admit_queue_age_ms=args.admit_age_ms)
+    reg = serving.ModelRegistry(config=cfg)
+    names = []
+    if args.model_dir:
+        reg.load('model', args.model_dir)
+        names.append('model')
+        feed_name = reg._entry('model').engine._feed_names[0]
+
+        def feed_fn(rng, _dim=None):
+            # the exported model declares its own feed shape; fall back
+            # to a flat f32 vector when dims are dynamic
+            var = (reg._entry('model').engine._program
+                   .global_block().vars[feed_name])
+            shape = [int(d) if int(d) > 0 else args.seq
+                     for d in var.shape]
+            shape[0] = args.rows
+            return {feed_name: rng.rand(*shape).astype('float32')}
+    else:
+        dim = 16
+        for i in range(max(args.models, 1)):
+            name = 'syn%d' % i
+            prog, pred, scope, place = _build_synthetic(seed=i + 1,
+                                                        dim=dim)
+            reg.load(name, program=prog, feed_names=['x'],
+                     fetch_list=[pred], scope=scope)
+            names.append(name)
+
+        def feed_fn(rng, _dim=dim):
+            return {'x': rng.rand(args.rows, args.seq,
+                                  _dim).astype('float32')}
+
+    classes = []
+    for name in names:
+        if args.priority_frac > 0:
+            classes.append(serving.TrafficClass(
+                feed_fn, model=name, weight=args.priority_frac,
+                deadline_ms=args.deadline_ms, priority=1,
+                name=name + ':p1'))
+        classes.append(serving.TrafficClass(
+            feed_fn, model=name,
+            weight=max(1.0 - args.priority_frac, 1e-6),
+            deadline_ms=args.deadline_ms, priority=0,
+            name=name + ':p0'))
+
+    with reg:
+        # warm every model's serving signature, then measure capacity
+        # with a short closed burst (the rate anchor for --overload)
+        rng = np.random.RandomState(args.seed)
+        for name in names:
+            reg.infer(name, feed_fn(rng), timeout=600)
+        t0 = time.time()
+        burst = [reg.submit(names[i % len(names)], feed_fn(rng))
+                 for i in range(16)]
+        for f in burst:
+            f.result(600)
+        capacity = 16 / max(time.time() - t0, 1e-9)
+        rate = args.rate if args.rate else capacity * args.overload
+        gen = serving.OpenLoopLoadGen(
+            reg, classes, rate=rate,
+            # --duration overrides --requests (which always has its
+            # default); the loadgen only reads duration_s when
+            # n_requests is None
+            n_requests=None if args.duration else args.requests,
+            duration_s=args.duration, seed=args.seed)
+        report = gen.run()
+        report['measured_capacity_req_s'] = round(capacity, 3)
+        metrics = reg.metrics()
+        report['registry'] = {
+            'overload_rejects': metrics['overload_rejects'],
+            'models': {
+                n: {k: metrics['models'][n][k]
+                    for k in ('shed', 'queue_depth', 'compiles',
+                              'p50_latency_ms', 'p99_latency_ms')}
+                for n in names
+            },
+        }
+    reg.stop()
+    print(json.dumps(report), flush=True)
+    return report
+
+
+if __name__ == '__main__':
+    main()

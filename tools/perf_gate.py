@@ -11,7 +11,7 @@ max(fw)/max(bd) cross-window pairing).
 Run on TPU hardware:
     python tools/perf_gate.py [resnet|transformer|nmt|resnet_infer|
         feed_pipeline|multi_model|trailing_dim|trace_overhead|decode|
-        all]
+        slo|all]
 Prints one JSON line per config; tests/test_perf_gate.py drives it and
 skips cleanly off-TPU.  ``resnet_infer`` (ISSUE 2) has no bound side —
 its deliverable is the paired ``multi_vs_dispatch`` block: the measured
@@ -47,6 +47,15 @@ call per decode step per request) — outputs are asserted
 token-identical, and the hard gates are ``dispatch_ratio`` <=
 PERF_GATE_DECODE_RATIO_MAX (default 1/3) and ``tokens_per_dispatch``
 >= PERF_GATE_DECODE_TPD_MIN (default 4.0).
+``slo`` (ISSUE 8) pairs deadline-scheduled vs FIFO serving under the
+SAME overloaded open-loop Poisson stream (serving.OpenLoopLoadGen,
+one seed — identical arrivals and payloads on both sides): the EDF
+engine schedules earliest-deadline-first and SHEDS past-deadline work
+(typed DeadlineExceededError + 'shed' trace stage), the FIFO engine
+serves everything late.  Within-deadline responses are asserted
+bitwise-identical across the two engines, and the hard gate is
+``goodput_ratio`` (in-deadline responses, EDF over FIFO) >=
+PERF_GATE_SLO_GOODPUT_MIN (default 1.3).
 """
 
 import json
@@ -857,6 +866,216 @@ def run_decode():
     return rec
 
 
+def build_slo():
+    """Deadline-scheduled vs FIFO serving under the SAME overloaded
+    open-loop Poisson stream (ISSUE 8): one padding-neutral dense seq
+    scorer + ONE scope served through TWO engines — the EDF side
+    schedules lots earliest-deadline-first and SHEDS past-deadline work
+    (typed DeadlineExceededError, 'shed' trace stage), the FIFO side is
+    yesterday's engine: strict arrival order, every request served even
+    when its answer is already worthless.  Both sides are driven by
+    serving.OpenLoopLoadGen with the SAME seed (identical arrivals,
+    class picks and payloads), at a rate calibrated to
+    PERF_GATE_SLO_OVERLOAD x the measured closed-burst capacity, with
+    deadlines a few dispatch-walls wide — so the FIFO queue grows
+    without bound and serves ever-deader requests while the EDF queue
+    sheds them and keeps answering live ones in time.  The deliverable
+    is the GOODPUT ratio (responses inside deadline, EDF over FIFO);
+    within-deadline responses are asserted bitwise-identical across
+    the two engines first.  Functional on the CPU smoke and TPU
+    alike."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import serving
+    from paddle_tpu.fluid import core
+
+    rows = int(os.environ.get('PERF_GATE_SLO_ROWS', '4'))
+    n_req = int(os.environ.get('PERF_GATE_SLO_REQS', '96'))
+    # 4x: the closed calibration burst UNDERESTIMATES sustained
+    # capacity (a short burst never reaches steady-state pipelining),
+    # so the multiplier must overshoot or the 'overloaded' stream
+    # barely loads the engine and the pair measures nothing
+    overload = float(os.environ.get('PERF_GATE_SLO_OVERLOAD', '4.0'))
+    # deadline width in dispatch walls: > the 2x-min-wall shed horizon
+    # (or EDF sheds everything), << the offered window (or FIFO meets
+    # most deadlines and the pair measures nothing)
+    dl_walls = float(os.environ.get('PERF_GATE_SLO_DEADLINE_WALLS',
+                                    '4.0'))
+    dim, classes = 16, 64
+    seq = 12
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = 0
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data('x', shape=[-1, dim], dtype='float32')
+        pooled = fluid.layers.reduce_sum(x, dim=1)
+        pred = fluid.layers.fc(pooled, classes, act='softmax')
+    test_prog = prog.clone(for_test=True)
+    place = fluid.TPUPlace() if core.is_compiled_with_tpu() \
+        else fluid.CPUPlace()
+    scope = fluid.core.Scope()
+    exe0 = fluid.Executor(place)
+    with fluid.scope_guard(scope):
+        exe0.run(startup)
+
+    def make_engine(scheduling):
+        # ONE batch bucket, one lot per scan, fixed request shape: each
+        # side compiles exactly one executable, so the paired windows
+        # measure scheduling policy, not compile weather
+        return serving.InferenceEngine(
+            test_prog, feed_names=['x'], fetch_list=[pred],
+            scope=scope, executor=fluid.Executor(place), place=place,
+            config=serving.ServingConfig(
+                max_batch_size=rows * 4, max_wait_ms=2,
+                bucket_sizes=[rows * 4], steps_per_dispatch=1,
+                scheduling=scheduling),
+            name='slo-%s' % scheduling)
+
+    edf_eng = make_engine('edf').start()
+    fifo_eng = make_engine('fifo').start()
+
+    def feed_fn(rng):
+        return {'x': rng.standard_normal(
+            (rows, seq, dim)).astype('float32')}
+
+    warm_rng = np.random.RandomState(99)
+    for eng in (edf_eng, fifo_eng):
+        # warm the executable AND the engine's service-wall window (the
+        # shed horizon's estimator) with a drained burst
+        eng.infer(feed_fn(warm_rng), timeout=600)
+        futs = [eng.submit(feed_fn(warm_rng)) for _ in range(8)]
+        for f in futs:
+            f.result(600)
+    # calibrate in TWO steps.  (1) closed warm burst -> per-dispatch
+    # wall (48 requests = 12 full lots: long enough that thread wakeup
+    # noise stops dominating).  (2) an OPEN-loop probe at the burst
+    # rate -> sustained capacity INCLUDING the submitter thread's own
+    # cost — on a CPU-constrained host the submit path (prepare + lock
+    # + trace) contends with the worker, so the closed burst alone
+    # overestimates what an open-loop stream can actually be served
+    # at, and an 'overload' derived from it is several times deeper
+    # than intended (both goodputs then collapse into timing noise).
+    t0 = time.time()
+    futs = [edf_eng.submit(feed_fn(warm_rng)) for _ in range(48)]
+    for f in futs:
+        f.result(600)
+    burst_s = max(time.time() - t0, 1e-6)
+    wall_s = burst_s / 12.0  # 4 requests per full lot at capacity
+    probe = serving.OpenLoopLoadGen(
+        edf_eng, [serving.TrafficClass(feed_fn, name='probe')],
+        rate=48.0 / burst_s, n_requests=96, seed=7).run()
+    capacity = min(48.0 / burst_s, probe['sustained_req_s'])
+    rate = overload * capacity
+    # deadline a few dispatch walls wide, floored high enough that
+    # scheduler/timer jitter (single-digit ms) stays small against it
+    deadline_ms = max(dl_walls * wall_s * 1e3, 40.0)
+    # keep the offered window >> the deadline (or FIFO meets most
+    # deadlines by default), but bounded — a huge stream just deepens
+    # the queues until submitter overhead IS the bottleneck
+    n_req = max(n_req, min(int(6.0 * (deadline_ms / 1e3) * rate), 800))
+
+    def window(eng, seed=0):
+        gen = serving.OpenLoopLoadGen(
+            eng,
+            [serving.TrafficClass(feed_fn, deadline_ms=deadline_ms,
+                                  name='slo')],
+            rate=rate, n_requests=n_req, seed=seed, keep_records=True)
+        return gen.run()
+
+    return (lambda seed=0: window(edf_eng, seed)), \
+        (lambda seed=0: window(fifo_eng, seed)), \
+        (edf_eng, fifo_eng, rate, deadline_ms, n_req)
+
+
+def run_slo():
+    """The slo record: interleaved EDF/FIFO windows over the identical
+    seeded stream (each ratio shares a drift window — the gates'
+    pairing rule).  HARD asserts (the ISSUE 8 acceptance): every
+    within-deadline EDF response bitwise-equal to the FIFO engine's for
+    the same request; shed requests carry DeadlineExceededError and a
+    'shed' trace stage; goodput_ratio >= PERF_GATE_SLO_GOODPUT_MIN
+    (default 1.3)."""
+    edf, fifo, (edf_eng, fifo_eng, rate, deadline_ms, n_req) = \
+        build_slo()
+    try:
+        rec = _run_slo_blocks(edf, fifo, rate, deadline_ms, n_req)
+    finally:
+        # an assert inside the block loop must not leak two serving
+        # workers into the NEXT config's paired windows ('all' mode)
+        edf_eng.stop()
+        fifo_eng.stop()
+    floor = float(os.environ.get('PERF_GATE_SLO_GOODPUT_MIN', '1.3'))
+    assert rec['edf_goodput'] > 0, rec
+    assert rec['edf_shed'] > 0 and rec['shed_checked'] > 0, rec
+    assert rec['bitwise_checked'] > 0, rec
+    assert rec['goodput_ratio'] >= floor, rec
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def _run_slo_blocks(edf, fifo, rate, deadline_ms, n_req):
+    """The measurement loop run_slo wraps in its engine-stopping
+    try/finally: interleaved windows, per-block bitwise + shed-contract
+    checks, and the best-shared-window record."""
+    import numpy as np
+    from paddle_tpu.serving import DeadlineExceededError
+    ratios, blocks_e, blocks_f = [], [], []
+    shed_checked = bitwise_checked = 0
+    for b in range(BLOCKS):
+        rep_f = fifo()
+        rep_e = edf()
+        # the bitwise bar: a request the EDF engine answered in time
+        # must carry the SAME bytes the FIFO engine produced for it
+        # (deadline scheduling may only change WHEN/WHETHER, never WHAT)
+        frecs = {r['i']: r for r in rep_f['records']}
+        for r in rep_e['records']:
+            if r['status'] in ('good', 'late'):
+                fr = frecs[r['i']]
+                assert fr['status'] in ('good', 'late'), (r, fr)
+                for a, bv in zip(r['result'], fr['result']):
+                    assert np.array_equal(np.asarray(a),
+                                          np.asarray(bv)), \
+                        'EDF result diverged from FIFO for request ' \
+                        '%d' % r['i']
+                    bitwise_checked += 1
+            elif r['status'] == 'shed':
+                # typed + staged: the shed contract
+                assert isinstance(r['error'], DeadlineExceededError), \
+                    r['error']
+                bd = r.get('breakdown')
+                assert bd and 'shed' in bd['stages_ms'], bd
+                shed_checked += 1
+        ratios.append(rep_e['goodput'] / max(rep_f['goodput'], 1.0))
+        blocks_e.append(rep_e)
+        blocks_f.append(rep_f)
+    best = max(range(BLOCKS), key=lambda i: ratios[i])
+    be, bf = blocks_e[best], blocks_f[best]
+    rec = {
+        'config': 'slo',
+        'offered_req_s': round(rate, 1),
+        'deadline_ms': round(deadline_ms, 2),
+        'requests_per_window': n_req,
+        'edf_goodput': be['goodput'],
+        'fifo_goodput': bf['goodput'],
+        'edf_goodput_blocks': [r['goodput'] for r in blocks_e],
+        'fifo_goodput_blocks': [r['goodput'] for r in blocks_f],
+        # the PAIRED deliverable: within-deadline responses kept under
+        # identical overload, deadline scheduler over FIFO, per shared
+        # drift window
+        'goodput_ratio': round(max(ratios), 4),
+        'edf_goodput_req_s': be['goodput_req_s'],
+        'fifo_goodput_req_s': bf['goodput_req_s'],
+        'edf_shed': be['shed'], 'fifo_shed': bf['shed'],
+        'edf_late': be['late'], 'fifo_late': bf['late'],
+        'edf_p50_ms': be['p50_ms'], 'fifo_p50_ms': bf['p50_ms'],
+        'edf_p99_ms': be['p99_ms'], 'fifo_p99_ms': bf['p99_ms'],
+        'edf_p999_ms': be['p999_ms'], 'fifo_p999_ms': bf['p999_ms'],
+        'bitwise_checked': bitwise_checked,
+        'shed_checked': shed_checked,
+        'blocks': BLOCKS,
+    }
+    return rec
+
+
 CONFIGS = {
     'resnet': (build_resnet, 'imgs_per_sec'),
     'transformer': (build_transformer, 'tokens_per_sec'),
@@ -867,6 +1086,7 @@ CONFIGS = {
     'trailing_dim': (build_trailing_dim, 'rows_per_sec'),
     'trace_overhead': (build_trace_overhead, 'rows_per_sec'),
     'decode': (build_decode, 'tokens_per_sec'),
+    'slo': (build_slo, 'goodput_req_s'),
 }
 
 
@@ -881,6 +1101,8 @@ def run_config(name):
         return run_trace_overhead()
     if name == 'decode':
         return run_decode()
+    if name == 'slo':
+        return run_slo()
     build, unit = CONFIGS[name]
     # both sides compiled first, then INTERLEAVED blocks: a drift window
     # between two monolithic measurements would otherwise decide the
