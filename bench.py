@@ -62,8 +62,10 @@ The nmt and transformer configs also report a ``decode`` block
 continuous-batching generation lane (prefill lots + K-step in-jit
 decode scans over the slot cache — GRU hidden state for NMT, a real
 [S, max_ctx, d_k] KV cache for the transformer), CPU-smoked so the
-lane really fires; the numbers are tokens/s, steps-per-dispatch and
-slot occupancy.
+lane really fires; the numbers are tokens/s, steps-per-dispatch, slot
+occupancy, and (ISSUE 9) host-syncs-per-token — the device-idling
+round trips the chained decode lane (decode_pipeline_depth >= 2)
+avoids vs one-per-scan on the synced baseline.
 """
 
 import json
@@ -279,6 +281,12 @@ def _decode_block(model, make_prompt, lens, place, slots=4, k_steps=4,
         'steps_per_dispatch': d['steps_per_dispatch'],
         'tokens_per_dispatch': d['tokens_per_dispatch'],
         'slot_occupancy': d['slot_occupancy'],
+        # pipelined decode (ISSUE 9): device-idling host round trips
+        # per emitted token — the chained lane's whole deliverable
+        # (decode_pipeline_depth >= 2 overlaps harvest with compute)
+        'host_syncs_per_token': d['host_syncs_per_token'],
+        'chain_flushes': d['chain_flushes'],
+        'decode_pipeline_depth': eng.config.decode_pipeline_depth,
         'decode_slots': slots,
         'executables': m['executor_compile_count'],
     }

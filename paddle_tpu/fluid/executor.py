@@ -1647,6 +1647,28 @@ class Executor(object):
         Returns (carry', tokens [K, S], alive_in [K, S]): tokens[i, s]
         counts for slot s exactly when alive_in[i, s] — token-identical
         to a per-slot host-driven greedy loop over the same program."""
+        carry_out, toks, alive_in, _ = self._dispatch_decode_multi(
+            program, feed=feed, carry=carry, steps=steps, decode=decode,
+            scope=scope)
+        return carry_out, toks, alive_in
+
+    def _dispatch_decode_multi(self, program=None, feed=None, carry=None,
+                               steps=None, decode=None, scope=None):
+        """Async front half of run_decode_multi (ISSUE 9 — the engine's
+        PIPELINED decode lane drives this, the decode twin of
+        _dispatch_multi_scanned): resolve + compile the K-step decode
+        scan and dispatch it against a carry whose leaves may be
+        DEVICE-RESIDENT — in particular the untouched (donated) output
+        carry of the PREVIOUS decode dispatch, so scan N+1 chains
+        straight onto scan N with no token block ever materializing on
+        host between them.  Returns (carry', tokens [K, S], alive_in
+        [K, S], compiled) with NO host sync: all three values are async
+        device arrays the caller harvests when it chooses (the chained
+        lane harvests scan N's tokens while N+1 computes).  Device
+        leaves pass through signature/canonicalization untouched
+        (prepare_feed_arrays / canonical_decode_carry are identity on
+        jax.Arrays), so a chained dispatch costs the host only the
+        cache lookup."""
         program = _reject_reader_fed(program, 'run_decode_multi')
         if carry is None or steps is None or decode is None:
             raise ValueError('run_decode_multi: carry=, steps= and '
@@ -1673,8 +1695,9 @@ class Executor(object):
             'decode_dispatch', executor='Executor', steps=steps,
             slots=int(np.shape(carry['token'])[0]),
             trace_id=getattr(_trace.current(), 'trace_id', None))
-        return compiled.run_decode_multi(scope, const, rng, steps,
-                                         carry, spec)
+        carry_out, toks, alive_in = compiled.run_decode_multi(
+            scope, const, rng, steps, carry, spec)
+        return carry_out, toks, alive_in, compiled
 
     def _convert_fetches(self, fetches, return_numpy):
         def convert(f):

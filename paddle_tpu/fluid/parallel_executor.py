@@ -725,6 +725,19 @@ class ParallelExecutor(object):
         on device so the distributed decode cache updates in place.
         Returns (carry', tokens [K, S], alive_in [K, S]), no host
         sync."""
+        carry_out, toks, alive_in, _ = self._dispatch_decode_multi(
+            feed=feed, carry=carry, steps=steps, decode=decode)
+        return carry_out, toks, alive_in
+
+    def _dispatch_decode_multi(self, feed=None, carry=None, steps=None,
+                               decode=None):
+        """Async front half of the SPMD run_decode_multi (ISSUE 9 —
+        the engine's pipelined decode lane, mirroring
+        Executor._dispatch_decode_multi): dispatch one K-step sharded
+        decode scan against a carry whose leaves may be DEVICE-RESIDENT
+        (the previous dispatch's donated output carry — scan N+1 chains
+        onto scan N with no host round trip), returning (carry', tokens
+        [K, S], alive_in [K, S], compiled) with NO host sync."""
         from .executor import normalize_decode_spec, \
             check_decode_carry, canonical_decode_carry
         _reject_reader_fed(self._main_program,
@@ -760,12 +773,11 @@ class ParallelExecutor(object):
             'decode_dispatch', executor='ParallelExecutor', steps=steps,
             slots=slots,
             trace_id=getattr(_trace.current(), 'trace_id', None))
-        out = compiled.run_decode_multi(self._scope, const,
-                                        self._next_rng(), steps, carry,
-                                        spec)
+        carry_out, toks, alive_in = compiled.run_decode_multi(
+            self._scope, const, self._next_rng(), steps, carry, spec)
         self.dispatch_count += 1
         self.steps_dispatched += steps
-        return out
+        return carry_out, toks, alive_in, compiled
 
     def cost_report(self):
         """Per-executable cost registry (ISSUE 6), the SPMD twin of

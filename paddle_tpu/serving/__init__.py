@@ -24,6 +24,15 @@ reload, a fair request router, and per-model ``:serving/<model>``
 timeline rows.  See engine.py / registry.py for the designs and the
 README 'Serving engine' / 'Multi-model serving' sections for the knobs.
 
+Pipelined decode (ISSUE 9): the decode lane keeps up to
+``decode_pipeline_depth`` chained scans in flight — scan N+1 is
+enqueued against scan N's device-resident (donated) output carry while
+the host harvests N's token block asynchronously, so device
+utilization no longer pays a host round trip per scan; shedding and
+admission use per-signature ``ServiceTimeProfile`` estimates and the
+registry's overload watermarks can track drain-vs-arrival rates
+(``ServingConfig(adaptive_admission=True)``).
+
 SLOs (ISSUE 8): requests carry ``priority`` and ``deadline_ms`` —
 lot formation is deadline-aware (EDF within priority classes) and
 past-deadline work is SHED with a typed ``DeadlineExceededError``
@@ -55,6 +64,7 @@ from .errors import DeadlineExceededError, EngineClosedError, \
     OverloadedError  # noqa: F401
 from .loadgen import OpenLoopLoadGen, TrafficClass  # noqa: F401
 from .metrics import EngineMetrics  # noqa: F401
+from .profile import ServiceTimeProfile  # noqa: F401
 from .registry import ModelRegistry  # noqa: F401
 
 __all__ = ['InferenceEngine', 'ServingConfig', 'MicroBatcher',
@@ -62,4 +72,5 @@ __all__ = ['InferenceEngine', 'ServingConfig', 'MicroBatcher',
            'EngineMetrics', 'ModelRegistry', 'HBMArbiter',
            'HBMBudgetError', 'GenerationSpec', 'GenerationRequest',
            'SlotStateCache', 'DeadlineExceededError', 'OverloadedError',
-           'EngineClosedError', 'OpenLoopLoadGen', 'TrafficClass']
+           'EngineClosedError', 'OpenLoopLoadGen', 'TrafficClass',
+           'ServiceTimeProfile']

@@ -148,11 +148,21 @@ class MicroBatcher(object):
     current estimate of one dispatch's service time.  A deadlined
     request is shed not just when its deadline HAS passed but when it
     cannot be met within the estimate (Clockwork's admission rule):
-    serving a request that will miss anyway only delays live ones."""
+    serving a request that will miss anyway only delays live ones.
+
+    ``service_estimate_for``: optional (request) -> seconds — the
+    PER-SIGNATURE form of the horizon (ISSUE 9): the engine's
+    ServiceTimeProfile answers with the estimate for each request's
+    OWN executable signature (falling back to the global floor for an
+    unseen one), so a mixed-shape queue sheds the slow-signature
+    request a global minimum would have admitted toward certain
+    deadline death — and keeps the cheap request the slow signature's
+    wall would have doomed.  Takes precedence over
+    ``service_estimate_fn`` when both are given."""
 
     def __init__(self, max_batch_size=32, max_wait_s=0.005,
                  scheduling='edf', on_shed=None,
-                 service_estimate_fn=None):
+                 service_estimate_fn=None, service_estimate_for=None):
         if int(max_batch_size) < 1:
             raise ValueError('max_batch_size must be >= 1')
         if scheduling not in ('edf', 'fifo'):
@@ -163,6 +173,7 @@ class MicroBatcher(object):
         self.scheduling = scheduling
         self._on_shed = on_shed
         self._service_estimate_fn = service_estimate_fn
+        self._service_estimate_for = service_estimate_for
         self._pending = deque()
         self._cond = threading.Condition()
         self._closed = False
@@ -229,15 +240,32 @@ class MicroBatcher(object):
         if not self._pending:
             return
         now = time.time()
-        est = 0.0
-        if self._service_estimate_fn is not None:
-            try:
-                est = float(self._service_estimate_fn() or 0.0)
-            except Exception:
-                est = 0.0
-        horizon = now + est
-        doomed = [r for r in self._pending
-                  if r.deadline_t is not None and r.deadline_t < horizon]
+        if self._service_estimate_for is not None:
+            # per-signature horizon (ISSUE 9): each pending request is
+            # judged against the estimate for ITS OWN signature; an
+            # estimator fault degrades that request to the bare
+            # past-deadline check, never to a worker death
+            doomed = []
+            for r in self._pending:
+                if r.deadline_t is None:
+                    continue
+                try:
+                    est = float(self._service_estimate_for(r) or 0.0)
+                except Exception:
+                    est = 0.0
+                if r.deadline_t < now + est:
+                    doomed.append(r)
+        else:
+            est = 0.0
+            if self._service_estimate_fn is not None:
+                try:
+                    est = float(self._service_estimate_fn() or 0.0)
+                except Exception:
+                    est = 0.0
+            horizon = now + est
+            doomed = [r for r in self._pending
+                      if r.deadline_t is not None
+                      and r.deadline_t < horizon]
         if not doomed:
             return
         # one rebuild, not len(doomed) deque.remove scans: a stall can

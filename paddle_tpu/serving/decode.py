@@ -204,19 +204,27 @@ class SlotStateCache(object):
         # slot dim divisible over the batch axis
         self.slots = -(-int(slots) // multiple) * multiple
         self.spec = spec
+        self._lock = threading.Lock()
+        self._init_state()
+
+    def _init_state(self):
+        """Fresh host-side slabs + carry leaves + an all-free slot map
+        — shared by construction and reset() so the two can never
+        drift on the slot-state layout."""
         s = self.slots
-        tok_dtype = spec.slot_dtypes[spec.token_feed]
+        spec = self.spec
         self._slabs = {
             name: np.zeros((s, ) + spec.slot_shapes[name],
                            spec.slot_dtypes[name])
             for name in spec.slot_feeds
         }
-        self._token = np.full((s, 1), spec.end_id, tok_dtype)
+        self._token = np.full((s, 1), spec.end_id,
+                              spec.slot_dtypes[spec.token_feed])
         self._alive = np.zeros((s, ), bool)
         self._remaining = np.zeros((s, ), np.int32)
-        self._lock = threading.Lock()
-        self._requests = [None] * s
-        self._free = list(range(s))
+        with self._lock:
+            self._requests = [None] * s
+            self._free = list(range(s))
 
     # ---- carry plumbing (the decode scan's view) -----------------------
 
@@ -315,6 +323,15 @@ class SlotStateCache(object):
             self._token, idx,
             np.asarray([self.spec.end_id],
                        self.spec.slot_dtypes[self.spec.token_feed]))
+
+    def reset(self):
+        """Reinitialize every slab and carry leaf to the fresh host-
+        side state and free every slot (ISSUE 9 — the chained lane's
+        poisoned-carry recovery: after a failed dispatch/harvest the
+        cache's carry references errored device values, so the engine
+        errors the slotted requests and decodes the next admissions
+        from clean slabs).  Worker-thread only, like set_carry."""
+        self._init_state()
 
     def request_at(self, idx):
         with self._lock:

@@ -53,7 +53,8 @@ from ..fluid.parallel_executor import ParallelExecutor, pad_ragged_batch, \
 from .batcher import InferenceRequest, MicroBatcher
 from .buckets import ShapeBucketSet, TrailingDimBuckets
 from .errors import DeadlineExceededError, EngineClosedError
-from .metrics import EngineMetrics
+from .metrics import EngineMetrics, RateWindow
+from .profile import ServiceTimeProfile
 
 __all__ = ['ServingConfig', 'InferenceEngine']
 
@@ -106,6 +107,26 @@ class ServingConfig(object):
         in-jit greedy loop) — the generation lane's dispatch-tax
         amortizer, bounded below the per-request latency a step
         boundary adds to admission.
+    decode_pipeline_depth: decode scans kept in flight (ISSUE 9 — the
+        decode lane's pipeline_depth).  At 2 (the default) scan N+1 is
+        enqueued against scan N's device-resident output carry BEFORE
+        N's token block is harvested, so the host's detokenize/EOS/
+        release bookkeeping overlaps device compute and the device
+        never idles on a host round trip between scans; admission,
+        eviction and shedding happen at chain-FLUSH points (every
+        in-flight scan harvested first), keeping outputs
+        token-identical to the per-scan-sync lane.  1 restores that
+        lane exactly: dispatch, sync, bookkeep, dispatch — one
+        device-idling host sync per scan (the baseline side of the
+        ``decode_overlap`` perf gate).
+    adaptive_admission: scale the overload admission watermarks by the
+        measured queue-drain rate vs the arrival rate (ISSUE 9) — an
+        engine whose drain keeps up with arrivals tolerates a deeper
+        queue (burst absorption, up to 2x the static watermark); one
+        falling behind admits at a proportionally SHALLOWER depth
+        (down to half), shedding load before the queue is hopeless.
+        Only meaningful with admit_queue_depth/admit_queue_age_ms set;
+        False (the PR 8 default) keeps the watermarks static.
     scheduling: 'edf' (default) — deadline-aware lot formation (ISSUE
         8): highest priority first, earliest-deadline-first within a
         priority class, and past-deadline (or no-longer-meetable)
@@ -130,8 +151,9 @@ class ServingConfig(object):
                  bucket_sizes=None, max_buckets=16,
                  trailing_buckets=True, trailing_ladders=None,
                  max_trailing_buckets=32, watchdog_stall_s=None,
-                 decode_slots=8, decode_steps=4, scheduling='edf',
-                 admit_queue_depth=None, admit_queue_age_ms=None):
+                 decode_slots=8, decode_steps=4, decode_pipeline_depth=2,
+                 scheduling='edf', admit_queue_depth=None,
+                 admit_queue_age_ms=None, adaptive_admission=False):
         if int(steps_per_dispatch) < 1:
             raise ValueError('steps_per_dispatch must be >= 1')
         if int(pipeline_depth) < 1:
@@ -165,6 +187,11 @@ class ServingConfig(object):
             raise ValueError('decode_steps must be >= 1')
         self.decode_slots = int(decode_slots)
         self.decode_steps = int(decode_steps)
+        if int(decode_pipeline_depth) < 1:
+            raise ValueError('decode_pipeline_depth must be >= 1 '
+                             '(1 = the per-scan-sync lane)')
+        self.decode_pipeline_depth = int(decode_pipeline_depth)
+        self.adaptive_admission = bool(adaptive_admission)
         if scheduling not in ('edf', 'fifo'):
             raise ValueError(
                 "ServingConfig: scheduling must be 'edf' or 'fifo', "
@@ -183,6 +210,12 @@ class ServingConfig(object):
         self.admit_queue_age_s = (float(admit_queue_age_ms) / 1e3
                                   if admit_queue_age_ms is not None
                                   else None)
+        if self.adaptive_admission and self.admit_queue_depth is None \
+                and self.admit_queue_age_s is None:
+            raise ValueError(
+                'ServingConfig: adaptive_admission needs a watermark '
+                'to adapt — set admit_queue_depth and/or '
+                'admit_queue_age_ms, or drop adaptive_admission')
 
 
 class _Lot(object):
@@ -275,21 +308,38 @@ class InferenceEngine(object):
         # with only ~1 dispatch-wall of slack the pick lands AT the
         # deadline and timing jitter turns it late — 3x leaves a full
         # dispatch of slack after the pick.
+        # ISSUE 9 sharpens WHICH wall: the horizon is now per
+        # SIGNATURE (ServiceTimeProfile, min-of-recent-walls per
+        # coalescing sig, cost-registry seeded) — a mixed-shape queue
+        # sheds the slow-signature request the global minimum would
+        # have admitted; unseen signatures fall back to the global
+        # floor, which is exactly the old estimator.
         ref0 = weakref.ref(self)
         self._service_walls = deque(maxlen=8)
+        self._profile = ServiceTimeProfile()
         self._batcher = MicroBatcher(
             self.config.max_batch_size, self.config.max_wait_s,
             scheduling=self.config.scheduling,
             on_shed=lambda req: (ref0() and ref0()._shed_request(req)),
-            service_estimate_fn=lambda: (
-                3.0 * min(ref0()._service_walls)
-                if ref0() and ref0()._service_walls else 0.0))
+            service_estimate_for=lambda req: (
+                ref0()._service_estimate(req) if ref0() else 0.0))
+        # arrival vs drain rates (ISSUE 9): the adaptive admission
+        # watermarks' inputs — noted at submit and at delivery
+        self._arrivals = RateWindow()
+        self._drains = RateWindow()
         # generation lane (ISSUE 7): a GenerationSpec turns on
         # submit_generate — prompts prefill through the normal lot
         # machinery, then decode in the slot-batched in-jit scan
         self.generation = generation
         self._decode_cache = None
         self._gen_ready = deque()  # (request, prefill values) awaiting a slot
+        # pipelined decode chain (ISSUE 9): in-flight K-step scans not
+        # yet harvested — (toks_dev, alive_in_dev, k, t_disp, slot->req
+        # snapshot, slot-map snap); bounded by decode_pipeline_depth
+        self._decode_inflight = deque()
+        # raw scan walls (dispatch -> harvest sync) — the decode lane's
+        # own service floor for per-token deadline estimates
+        self._decode_walls = deque(maxlen=8)
         self._pe_prefill = self._pe_step = None
         if generation is not None:
             if self._eager:
@@ -431,10 +481,21 @@ class InferenceEngine(object):
                'inflight_trace_ids': inflight}
         if self._decode_cache is not None:
             # the decode lane's view: who holds each slot (a stalled
-            # worker strands THEM mid-generation) and how many
-            # prefilled requests were still waiting for one
+            # worker strands THEM mid-generation), how many prefilled
+            # requests were still waiting for one, and the in-flight
+            # CHAIN (ISSUE 9) — scans dispatched but never harvested
+            # are exactly what a wedged chained lane looks like
             ctx['decode_slot_map'] = self._decode_cache.snapshot()
             ctx['decode_pending'] = len(self._gen_ready)
+            now = time.time()
+            try:
+                ctx['decode_chain'] = [
+                    {'steps': e[2], 'age_s': round(now - e[3], 4)}
+                    for e in list(self._decode_inflight)]
+            except RuntimeError:
+                # a harvest mutated the deque mid-snapshot (watchdog
+                # thread races the worker); the slot map above stands
+                ctx['decode_chain'] = None
         return ctx
 
     def stop(self):
@@ -469,6 +530,12 @@ class InferenceEngine(object):
             with self._cycle_lock:
                 while self._inflight:
                     self._drain_one()
+                if self._decode_cache is not None:
+                    # the decode chain counts as in-flight dispatches
+                    # too (ISSUE 9): an eviction moving slabs while a
+                    # chained scan still references them would tear
+                    # the carry — flush to a consistent boundary
+                    self._decode_flush()
                 yield self
 
     # ---- footprint / eviction (the ModelRegistry's arbiter hooks) ------
@@ -560,6 +627,29 @@ class InferenceEngine(object):
 
     # ---- request surface ----------------------------------------------
 
+    def _service_estimate(self, req):
+        """The shed horizon for ONE pending request (ISSUE 9): 3x the
+        service-floor estimate of the request's OWN coalescing
+        signature (min of that signature's recent dispatch walls,
+        cost-seeded), falling back to the profile's global floor —
+        and, before anything was ever profiled, to the engine-wide
+        min-wall window (exactly the PR 8 global horizon, so the
+        per-signature path only ever sharpens)."""
+        est = self._profile.estimate(req.sig)
+        if est is None:
+            est = self._profile.floor()
+        if est is None:
+            est = (min(self._service_walls)
+                   if self._service_walls else 0.0)
+        return 3.0 * est
+
+    def rate_stats(self):
+        """Measured arrival vs drain rates (requests/s over the recent
+        window; None while idle or single-sample) — the adaptive
+        admission watermarks' inputs, surfaced for metrics()."""
+        return {'arrival_req_s': self._arrivals.rate(),
+                'drain_req_s': self._drains.rate()}
+
     def _shed_request(self, req, where='queue'):
         """Resolve one past-deadline request as SHED (ISSUE 8): typed
         DeadlineExceededError, a 'shed' trace stage (the seconds the
@@ -622,6 +712,7 @@ class InferenceEngine(object):
                                trailing=trims, trace=ctx,
                                priority=priority, deadline_ms=deadline_ms)
         self._metrics.note_request(rows or 1)
+        self._arrivals.note()
         ctx.mark('enqueue')
         self._batcher.submit(req)
         if self._thread is None:
@@ -700,6 +791,7 @@ class InferenceEngine(object):
                                 priority=priority,
                                 deadline_ms=deadline_ms)
         self._metrics.note_generate()
+        self._arrivals.note()
         ctx.mark('enqueue')
         self._batcher.submit(req)
         if self._thread is None:
@@ -732,8 +824,17 @@ class InferenceEngine(object):
         snap['decode'] = (self._metrics.decode_snapshot(
             active_slots=self._decode_cache.active_slots(),
             free_slots=self._decode_cache.free_slots(),
-            pending=len(self._gen_ready))
+            pending=len(self._gen_ready),
+            inflight_scans=len(self._decode_inflight))
             if self._decode_cache is not None else None)
+        # per-signature service profile + the rate pair the adaptive
+        # watermarks read (ISSUE 9)
+        snap['service_profile'] = self._profile.snapshot()
+        rates = self.rate_stats()
+        snap['arrival_req_s'] = (round(rates['arrival_req_s'], 3)
+                                 if rates['arrival_req_s'] else None)
+        snap['drain_req_s'] = (round(rates['drain_req_s'], 3)
+                               if rates['drain_req_s'] else None)
         return snap
 
     # ---- request -> lot -----------------------------------------------
@@ -1111,7 +1212,23 @@ class InferenceEngine(object):
         # the clipped window makes EDF pick requests it then serves
         # just past their deadline (measured: the slo gate's edf_late
         # jumps ~10x).  The min-of-8 still discards compile outliers.
-        self._service_walls.append(max(t_sync - t0, 0.0))
+        wall = max(t_sync - t0, 0.0)
+        self._service_walls.append(wall)
+        # per-signature profile (ISSUE 9): the same raw wall, keyed by
+        # each lot's coalescing signature (every request in a lot
+        # shares it — the batcher's coalescing rule), with a cost-
+        # registry seed the first time a signature drains so the
+        # min-window never bottoms out at a compile-polluted cold
+        # wall.  ONE observation per distinct signature per dispatch:
+        # the lots of a multi-lot scan block share their signature
+        # (_collect_block's rule), and K duplicate appends would
+        # shrink the min-window to ~8/K distinct dispatches of history
+        for key in {lot.requests[0].sig for lot in lots}:
+            if cost is not None and cost.get('flops'):
+                rate = self._metrics.device_rate()
+                if rate:
+                    self._profile.seed(key, cost['flops'] / rate)
+            self._profile.observe(key, wall)
         self._last_sync_t = t_sync
         led = fetch_batch_led(compiled, len(arrays))
         if not all(led) and not self._warned_unsliced and \
@@ -1189,6 +1306,7 @@ class InferenceEngine(object):
                         self._spans + 'request', req.trace.t0,
                         req.trace.e2e_s, trace_id=req.trace_id)
                 req.set_result(res)
+                self._drains.note()
                 if req.latency_s is not None:
                     self._metrics.note_latency(req.latency_s)
         if _profiler.is_profiler_enabled() or _trace.spans_enabled():
@@ -1227,72 +1345,95 @@ class InferenceEngine(object):
             admitted += 1
         return admitted
 
-    def _decode_cycle(self):
-        """One decode-lane turn: admit whatever prefilled requests fit
-        into free slots, run ONE K-step in-jit decode scan over the
-        whole slot batch (stop conditions masked inside), and deliver
-        the requests the scan finished.  Returns True when a scan
-        dispatched."""
+    def _decode_dispatch(self):
+        """Enqueue ONE K-step decode scan against the cache's CURRENT
+        carry — which, mid-chain, is the previous scan's device-
+        resident output (donated in place on device): scan N+1 chains
+        onto scan N with no token block materializing on host (ISSUE
+        9).  The async token/alive outputs go on the in-flight chain
+        for a later harvest.  Returns True when a scan dispatched."""
         cache = self._decode_cache
-        if cache is None:
-            return False
-        # per-token deadline budget (ISSUE 8): the step boundary is the
-        # decode lane's scheduling point — an active generation whose
-        # deadline passed releases its slot NOW and sheds (with the
-        # tokens it already has accounted in the trace) instead of
-        # decoding to max_len while live requests wait for a slot
-        if self.config.scheduling == 'edf':
-            now = time.time()
-            for req in cache.active_requests():
-                if req.deadline_t is not None and now > req.deadline_t:
-                    slot = req.slot
-                    cache.release(slot)
-                    cache.deactivate(slot)
-                    if req.trace is not None:
-                        req.trace.add_count('decode_steps',
-                                            len(req.tokens))
-                    self._shed_request(req, where='decode')
-        self._admit_ready()
-        if not cache.any_active():
-            return False
         k = self.config.decode_steps
         snap = cache.snapshot()
         # slot-map snapshot BEFORE the dispatch: a wedged or erroring
-        # decode scan must leave the occupancy picture in the ring
+        # decode scan must leave the occupancy picture in the ring —
+        # chain_depth records how many scans were already in flight
         _trace.flight_recorder.record(
-            'decode_lot', engine=self.name, steps=k, slot_map=snap)
+            'decode_lot', engine=self.name, steps=k,
+            chain_depth=len(self._decode_inflight), slot_map=snap)
         try:
             with self._gated():
                 if self._pe is not None:
-                    carry, toks, alive_in = self._pe_step.run_decode_multi(
-                        carry=cache.carry(), steps=k,
-                        decode=self._gen_decode_arg)
+                    carry, toks, alive_in, _ = \
+                        self._pe_step._dispatch_decode_multi(
+                            carry=cache.carry(), steps=k,
+                            decode=self._gen_decode_arg)
                 else:
-                    carry, toks, alive_in = self._exe.run_decode_multi(
-                        self.generation.step_program,
-                        carry=cache.carry(), steps=k,
-                        decode=self._gen_decode_arg, scope=self._scope)
-            toks = np.asarray(toks)          # the sync point
-            alive_in = np.asarray(alive_in)
-            alive_after = np.asarray(carry['alive'])
+                    carry, toks, alive_in, _ = \
+                        self._exe._dispatch_decode_multi(
+                            self.generation.step_program,
+                            carry=cache.carry(), steps=k,
+                            decode=self._gen_decode_arg,
+                            scope=self._scope)
         except Exception as exc:
-            self._metrics.note_error()
-            _trace.flight_recorder.dump(
-                'decode_error:%s' % self.name, error=repr(exc),
-                slot_map=snap)
-            for req in cache.active_requests():
-                cache.release(req.slot)
-                req.set_error(exc)
-            return True
+            self._decode_fail(exc, snap)
+            return False
+        # the cache's carry is now the NEW scan's async output: the
+        # next dispatch chains onto it without waiting for this one
         cache.set_carry(carry)
+        # capture the slot->request map AT DISPATCH: a slot released
+        # (and re-admitted) at a later flush must not receive this
+        # scan's tokens — the done() guard at harvest closes the loop
+        reqs = [cache.request_at(s) for s in range(cache.slots)]
+        self._decode_inflight.append(
+            (toks, alive_in, k, time.time(), reqs, snap))
+        return True
+
+    def _decode_harvest_one(self):
+        """Harvest the OLDEST in-flight decode scan (ISSUE 9 — the
+        host half the per-scan-sync lane paid BETWEEN scans now runs
+        while the next scan computes): sync its token block, replay
+        the scan's stop-condition masking host-side (EOS emitted /
+        budget exhausted — the exact in-scan rule, so the host mirror
+        never drifts from the device carry), deliver every request the
+        scan finished, and release their slots.  Returns True unless
+        the chain was poisoned (a deferred device error surfaced)."""
+        toks_dev, alive_dev, k, t_disp, reqs, snap = \
+            self._decode_inflight.popleft()
+        # a harvest with NOTHING in flight behind it is a device-idling
+        # HOST SYNC — the quantity the chained lane minimizes (the
+        # per-scan-sync lane pays one per scan).  Judged at pop,
+        # counted only on a SUCCESSFUL sync: a poisoned harvest must
+        # not inflate the harvests/host_syncs counters the
+        # decode_overlap gate and bench/load_gen reports are built on
+        blocking = not self._decode_inflight
+        cache = self._decode_cache
+        try:
+            toks = np.asarray(toks_dev)      # the sync point
+            alive_in = np.asarray(alive_dev)
+        except Exception as exc:
+            self._decode_fail(exc, snap)
+            return False
+        self._metrics.note_decode_harvest(blocking=blocking)
         t_sync = time.time()
+        self._decode_walls.append(max(t_sync - t_disp, 0.0))
+        end_id = self.generation.end_id
         finished = 0
-        for s in range(cache.slots):
-            req = cache.request_at(s)
-            if req is None:
+        for s, req in enumerate(reqs):
+            if req is None or req.done():
+                # freed before this scan dispatched, or already
+                # delivered/shed — a dead slot's alive_in column is
+                # all-False, so there are no tokens to lose here
                 continue
             req.tokens.extend(int(t) for t in toks[alive_in[:, s], s])
-            if not alive_after[s]:
+            # the scan's own stop rule, replayed host-side: a slot
+            # dies when it emits end_id or exhausts its budget — so
+            # finish-detection needs no extra device read (the carry's
+            # alive leaf stays un-synced, free to chain)
+            budget = min(req.max_len, self.generation.max_len)
+            done = req.tokens and (req.tokens[-1] == end_id or
+                                   len(req.tokens) >= budget)
+            if done and req.slot == s:
                 if req.trace is not None:
                     req.trace.mark('decode_end', t_sync)
                 cache.release(s)
@@ -1304,6 +1445,147 @@ class InferenceEngine(object):
             _profiler.record_event(self._spans + 'decode[x%d]' % k,
                                    time.time() - t_sync, start=t_sync)
         return True
+
+    def _decode_fail(self, exc, snap):
+        """A decode dispatch or harvest failed: the chain behind it is
+        poisoned (every later scan consumed the bad carry), so error
+        EVERY slotted request, drop the chain, and reset the cache to
+        a fresh host-side carry — the worker survives and the next
+        admission decodes from clean slabs."""
+        self._metrics.note_error()
+        _trace.flight_recorder.dump(
+            'decode_error:%s' % self.name, error=repr(exc),
+            slot_map=snap, chain_depth=len(self._decode_inflight))
+        cache = self._decode_cache
+        self._decode_inflight.clear()
+        for req in cache.active_requests():
+            cache.release(req.slot)
+            if not req.done():
+                req.set_error(exc)
+        cache.reset()
+
+    def _decode_flush(self):
+        """Chain-flush point (ISSUE 9): harvest EVERY in-flight scan so
+        the slot map and the carry are consistent — admission, shed
+        deactivation and cache eviction mutate slots, and must never
+        race a scan that was dispatched against the pre-mutation
+        carry.  Returns True unless the chain was poisoned."""
+        flushed = bool(self._decode_inflight)
+        while self._decode_inflight:
+            if not self._decode_harvest_one():
+                return False
+        if flushed:
+            self._metrics.note_decode_flush()
+        return True
+
+    def _decode_mirror_alive(self, req):
+        """The host's view of whether ``req``'s slot can still be
+        alive, from HARVESTED tokens only (in-flight scans unknown —
+        conservatively alive): the same stop rule the scan masks."""
+        budget = min(req.max_len, self.generation.max_len)
+        return len(req.tokens) < budget and (
+            not req.tokens or req.tokens[-1] != self.generation.end_id)
+
+    def _decode_should_dispatch(self):
+        """Dispatch another scan only when some occupied slot can
+        still be alive AFTER the scans already in flight: a request's
+        remaining budget is deterministic (EOS only ends it sooner),
+        so when every active request's budget is provably consumed by
+        in-flight steps, another scan could only run frozen slots —
+        harvest instead."""
+        active = self._decode_cache.active_requests()
+        if not active:
+            return False
+        for req in active:
+            if not self._decode_mirror_alive(req):
+                continue
+            budget = min(req.max_len, self.generation.max_len)
+            inflight_steps = sum(
+                e[2] for e in self._decode_inflight if req in e[4])
+            if budget - len(req.tokens) - inflight_steps > 0:
+                return True
+        return False
+
+    def _decode_doomed(self):
+        """Active generations whose deadline lands before even the
+        NEXT step boundary — one measured scan wall away — can arrive
+        (ISSUE 8, sharpened by ISSUE 9): any further tokens would be
+        late anyway, so the slot is better spent on a live request.
+        ONE predicate shared by _decode_needs_flush and the shed loop:
+        if the two drifted, needs-flush could trip every cycle while
+        the shed loop sheds nothing — silently degrading the chain to
+        per-scan sync with token-identical outputs (no test would
+        trip).  EDF only; 'fifo' never sheds."""
+        if self.config.scheduling != 'edf':
+            return []
+        now = time.time()
+        est = min(self._decode_walls) if self._decode_walls else 0.0
+        return [req for req in self._decode_cache.active_requests()
+                if req.deadline_t is not None and
+                now + est > req.deadline_t]
+
+    def _decode_needs_flush(self):
+        """True when the next cycle must mutate slots: a deadlined
+        active generation to shed, or prefilled requests with a free
+        slot to admit into.  Deliberately NOT 'prefills waiting but no
+        slot free': forcing a flush every cycle to poll for releases
+        would degrade the chain to the per-scan-sync lane exactly when
+        a backlog queues — the opportunistic and backpressure harvests
+        already release finished slots as the chain advances, and the
+        free slot trips this check on the next cycle."""
+        cache = self._decode_cache
+        if self._gen_ready and cache.free_slots():
+            return True
+        return bool(self._decode_doomed())
+
+    def _decode_cycle(self):
+        """One decode-lane turn (ISSUE 9, pipelined): flush the chain
+        when admission or shedding must mutate slots, enqueue the next
+        chained scan FIRST, then harvest the oldest in-flight scan
+        behind it — the dispatch-before-harvest order is the whole
+        point: scan N+1 is already queued on device while the host
+        syncs N's token block, so the harvest round trip never idles
+        the device.  decode_pipeline_depth=1 degenerates to the PR 7
+        per-scan-sync lane: dispatch, harvest, repeat.  Returns True
+        when the lane made progress (dispatched, harvested, admitted
+        or shed)."""
+        cache = self._decode_cache
+        if cache is None:
+            return False
+        progressed = False
+        if self._decode_needs_flush():
+            progressed = True
+            if not self._decode_flush():
+                return True
+            # shed at the flushed boundary: the chain is empty, so
+            # deactivation mutates a consistent carry (the doomed
+            # predicate is shared with _decode_needs_flush)
+            for req in self._decode_doomed():
+                slot = req.slot
+                cache.release(slot)
+                cache.deactivate(slot)
+                if req.trace is not None:
+                    req.trace.add_count('decode_steps',
+                                        len(req.tokens))
+                self._shed_request(req, where='decode')
+            self._admit_ready()
+        if self._decode_should_dispatch():
+            progressed = self._decode_dispatch() or progressed
+        else:
+            # nothing worth another scan: drain the chain so finished
+            # requests deliver and their slots free
+            while self._decode_inflight:
+                progressed = True
+                if not self._decode_harvest_one():
+                    return True
+        # pipeline backpressure: at most decode_pipeline_depth scans
+        # in flight — the oldest harvests while the newest computes
+        while len(self._decode_inflight) >= \
+                self.config.decode_pipeline_depth:
+            progressed = True
+            if not self._decode_harvest_one():
+                break
+        return progressed
 
     def _finish_generate(self, req):
         """Deliver one finished generation request: token ids out,
@@ -1317,14 +1599,17 @@ class InferenceEngine(object):
                 self._spans + 'generate', req.trace.t0,
                 req.trace.e2e_s, trace_id=req.trace_id)
         req.set_result(out)
+        self._drains.note()
         if req.latency_s is not None:
             self._metrics.note_latency(req.latency_s)
 
     def _gen_busy(self):
         """True while the generation lane has work: prefilled requests
-        awaiting slots, or slots actively decoding."""
+        awaiting slots, slots actively decoding, or in-flight chained
+        scans awaiting harvest."""
         return self._decode_cache is not None and (
-            bool(self._gen_ready) or self._decode_cache.any_active())
+            bool(self._gen_ready) or bool(self._decode_inflight) or
+            self._decode_cache.any_active())
 
     def evict_decode_cache(self):
         """Demote the decode slot cache to host memory under a
@@ -1445,10 +1730,13 @@ class InferenceEngine(object):
             while self._inflight:
                 self._drain_one()
             # run the generation lane dry: admitted requests decode to
-            # their stop conditions, prefilled ones admit as slots free
+            # their stop conditions, prefilled ones admit as slots
+            # free, and the in-flight chain harvests to empty
             while self._gen_busy():
                 if not self._decode_cycle():
                     break
+            if self._decode_cache is not None:
+                self._decode_flush()
 
     def _drain_inline(self):
         """Synchronous mode: flush + dispatch + deliver on the calling

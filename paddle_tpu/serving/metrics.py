@@ -10,9 +10,10 @@ renders serving spans next to the executor/device slices.
 """
 
 import threading
+import time
 from collections import deque
 
-__all__ = ['EngineMetrics']
+__all__ = ['EngineMetrics', 'RateWindow']
 
 
 def _percentile(sorted_vals, p):
@@ -20,6 +21,42 @@ def _percentile(sorted_vals, p):
         return None
     idx = min(int(len(sorted_vals) * p), len(sorted_vals) - 1)
     return sorted_vals[idx]
+
+
+class RateWindow(object):
+    """Events-per-second over a sliding window of recent event
+    timestamps (ISSUE 9) — the adaptive admission watermarks compare
+    an engine's request ARRIVAL rate against its delivery DRAIN rate.
+    A timestamp window, not a decaying counter: an idle engine's rate
+    goes to zero instead of freezing at its last busy value."""
+
+    def __init__(self, maxlen=128, horizon_s=10.0):
+        self._times = deque(maxlen=int(maxlen))
+        self._horizon_s = float(horizon_s)
+        self._lock = threading.Lock()
+
+    def note(self, n=1):
+        now = time.time()
+        with self._lock:
+            for _ in range(int(n)):
+                self._times.append(now)
+
+    def rate(self):
+        """Events/s over the retained window clipped to the horizon;
+        None before the second event (one timestamp spans no time).
+        The inter-arrival estimator (n-1 events over the span from the
+        first timestamp): n/span would overestimate by n/(n-1) —
+        2x at n=2, exactly the small-count regime a falling-behind
+        engine's drain window sits in, which would inflate the
+        drain/arrival ratio and delay door-shedding."""
+        now = time.time()
+        with self._lock:
+            times = [t for t in self._times
+                     if now - t <= self._horizon_s]
+            if len(times) < 2:
+                return None
+            span = max(now - times[0], 1e-6)
+            return (len(times) - 1) / span
 
 
 class EngineMetrics(object):
@@ -73,6 +110,16 @@ class EngineMetrics(object):
         self.decode_tokens = 0
         self.decode_slot_steps = 0
         self.prefill_lots = 0
+        # pipelined decode (ISSUE 9): host-sync accounting.  A HOST
+        # SYNC is a harvest that blocked with NO other scan in flight
+        # behind it — the device sat idle while the host round-tripped
+        # (the per-scan-sync lane pays one per scan; the chained lane
+        # pays one per chain FLUSH).  harvests counts every token-block
+        # materialization; chain_flushes counts the admission/eviction/
+        # shed boundaries that drained the whole chain.
+        self.decode_host_syncs = 0
+        self.decode_harvests = 0
+        self.decode_chain_flushes = 0
 
     def note_request(self, rows):
         with self._lock:
@@ -143,6 +190,19 @@ class EngineMetrics(object):
             self.decode_slot_steps += int(slot_steps)
             self.decode_finished += int(finished)
 
+    def note_decode_harvest(self, blocking):
+        """One harvested decode token block (ISSUE 9); ``blocking``
+        marks a device-idling host sync (nothing else in flight behind
+        the harvested scan)."""
+        with self._lock:
+            self.decode_harvests += 1
+            if blocking:
+                self.decode_host_syncs += 1
+
+    def note_decode_flush(self):
+        with self._lock:
+            self.decode_chain_flushes += 1
+
     def note_device(self, flops, seconds):
         """One drained dispatch's cost-analysis FLOPs + wall seconds
         (dispatch issue -> host sync) — accumulates achieved MFU."""
@@ -150,16 +210,35 @@ class EngineMetrics(object):
             self.device_flops += float(flops)
             self.device_seconds += float(seconds)
 
+    def device_rate(self):
+        """Achieved FLOPs/s so far (None before any cost-carrying
+        drain) — the ServiceTimeProfile seeder's denominator (ISSUE
+        9): a signature's cost-analysis FLOPs over this rate is its
+        expected wall."""
+        with self._lock:
+            if self.device_seconds > 0 and self.device_flops > 0:
+                return self.device_flops / self.device_seconds
+            return None
+
     def decode_snapshot(self, active_slots=None, free_slots=None,
-                        pending=None):
+                        pending=None, inflight_scans=None):
         """The generation lane's block of ``snapshot()`` (None when the
         engine serves no generation model): request/token tallies, the
-        amortization ratios (tokens and scan steps per dispatch), and
-        the occupancy the continuous-batching admission achieved."""
+        amortization ratios (tokens and scan steps per dispatch), the
+        occupancy the continuous-batching admission achieved, and the
+        pipelined lane's host-sync accounting (ISSUE 9)."""
         with self._lock:
             if not self.decode_requests:
                 return None
             return {
+                'host_syncs': self.decode_host_syncs,
+                'harvests': self.decode_harvests,
+                'chain_flushes': self.decode_chain_flushes,
+                'inflight_scans': inflight_scans,
+                'host_syncs_per_token': (
+                    round(self.decode_host_syncs / self.decode_tokens,
+                          4)
+                    if self.decode_tokens else None),
                 'requests': self.decode_requests,
                 'finished': self.decode_finished,
                 'tokens': self.decode_tokens,

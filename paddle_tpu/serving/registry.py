@@ -616,6 +616,30 @@ class ModelRegistry(object):
             return
         depth = entry.engine._batcher.depth()
         age = entry.engine._batcher.oldest_age() or 0.0
+        if cfg.adaptive_admission and (
+                (depth_wm is not None and depth >= 0.5 * depth_wm) or
+                (age_wm is not None and age >= 0.5 * age_wm)):
+            # adaptive watermarks (ISSUE 9): scale the static marks by
+            # the measured drain/arrival ratio, clamped to [0.5, 2.0].
+            # An engine whose drain keeps up (ratio >= 1) tolerates a
+            # deeper queue — the static watermark was sized for a
+            # falling-behind worst case, and rejecting an absorbable
+            # burst wastes goodput; one falling behind (ratio < 1)
+            # admits at a proportionally SHALLOWER depth, shedding at
+            # the door while the queue can still drain what it holds.
+            # Before both rates are measurable the static marks stand.
+            # Gated on the queue being at least HALFWAY to a static
+            # mark: below that no clamped scale can change the
+            # verdict, so the hot submit path skips the two
+            # lock-guarded rate() passes entirely.
+            rates = entry.engine.rate_stats()
+            arrival, drain = rates['arrival_req_s'], rates['drain_req_s']
+            if arrival and drain:
+                scale = min(max(drain / arrival, 0.5), 2.0)
+                if depth_wm is not None:
+                    depth_wm = max(depth_wm * scale, 1.0)
+                if age_wm is not None:
+                    age_wm = age_wm * scale
         if (depth_wm is not None and depth >= depth_wm) or \
                 (age_wm is not None and age >= age_wm):
             with self._lock:

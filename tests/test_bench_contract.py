@@ -218,7 +218,10 @@ def test_decode_blocks_wired():
     assert "d['dispatches'] > 0" in helper
     for key in ('tokens_per_sec', 'steps_per_dispatch',
                 'tokens_per_dispatch', 'slot_occupancy',
-                'decode_dispatches', 'prefill_lots'):
+                'decode_dispatches', 'prefill_lots',
+                # ISSUE 9: the pipelined lane's sync accounting
+                'host_syncs_per_token', 'decode_pipeline_depth',
+                'chain_flushes'):
         assert "'%s'" % key in helper, key
     for fn, builder in ((bench.bench_nmt, 'seq2seq.build_step_decode'),
                         (bench.bench_transformer,
@@ -328,3 +331,26 @@ def test_nmt_cpu_smoke_is_device_true():
     assert dec['tokens_per_dispatch'] > 1
     assert 0.0 < dec['slot_occupancy'] <= 1.0
     assert dec['decode_dispatches'] > 0
+    # ISSUE 9: the pipelined lane's host-sync accounting rode the
+    # block — chained by default (depth 2), so syncs per token must
+    # come in strictly below one-per-scan
+    assert dec['decode_pipeline_depth'] >= 2
+    assert dec['host_syncs_per_token'] is not None
+    assert dec['host_syncs_per_token'] * dec['tokens'] <= \
+        dec['decode_dispatches']
+
+
+def test_no_tmp_sidecars_in_repo_root():
+    """ISSUE 9 satellite: the stray ``BENCH_PARTIAL.json.tmp`` kept
+    reappearing (an interrupted bench child leaves its atomic-write
+    temp behind) — such files are transient by contract, so none may
+    ever be TRACKED, and the ignore rule that keeps them out of
+    ``git add`` sweeps must stay."""
+    import subprocess
+    out = subprocess.run(
+        ['git', 'ls-files', '*.json.tmp', '**/*.json.tmp'],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+    tracked = out.stdout.decode().strip()
+    assert not tracked, 'tracked *.json.tmp files: %s' % tracked
+    with open(os.path.join(REPO, '.gitignore')) as f:
+        assert '*.json.tmp' in f.read()

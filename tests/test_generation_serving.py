@@ -367,6 +367,117 @@ def test_mixed_traffic_spmd_mesh(nmt_decode):
                                atol=1e-6)
 
 
+# ---- pipelined decode chain (ISSUE 9) ---------------------------------
+
+
+def test_chained_lane_token_identical_and_fewer_syncs(nmt_decode):
+    """The ISSUE 9 acceptance smoke at engine level: the chained lane
+    (decode_pipeline_depth=2) is bitwise token-identical to the
+    per-scan-sync lane (depth 1) over the same mixed-length stream,
+    with strictly fewer device-idling host syncs, at the same dispatch
+    count (chaining must not add wasted frozen scans here — the
+    budget-aware dispatch bound)."""
+    m, exe, scope = nmt_decode
+    rng = np.random.RandomState(12)
+    lens = [3, 6, 9, 4, 8, 5]
+    prompts = [_prompt(rng, l) for l in lens]
+    spec = serving.GenerationSpec.from_model(m)
+    outs, mets = {}, {}
+    for depth in (1, 2):
+        eng = serving.InferenceEngine(
+            m['prefill'], fetch_list=m['prefill_fetches'], scope=scope,
+            executor=exe, place=fluid.CPUPlace(),
+            config=serving.ServingConfig(
+                max_batch_size=8, max_wait_ms=2, decode_slots=4,
+                decode_steps=3, decode_pipeline_depth=depth),
+            generation=spec, name='gen-chain-d%d' % depth)
+        with eng:
+            futs = [eng.submit_generate({'src_word_id': p}, max_len=8)
+                    for p in prompts]
+            outs[depth] = [list(f.result(120)) for f in futs]
+        mets[depth] = eng.metrics()['decode']
+    assert outs[2] == outs[1]
+    d1, d2 = mets[1], mets[2]
+    # the synced lane pays one device-idling sync per scan by
+    # construction; the chained lane only syncs at flush/tail points
+    assert d1['host_syncs'] == d1['dispatches']
+    assert d2['host_syncs'] < d1['host_syncs']
+    assert d2['dispatches'] <= d1['dispatches'] + 1
+    assert d2['tokens'] == d1['tokens']
+    assert d2['host_syncs_per_token'] < d1['host_syncs_per_token']
+    # the chain really held scans in flight: some harvests were
+    # non-blocking (harvests > syncs)
+    assert d2['harvests'] > d2['host_syncs']
+
+
+def test_stop_races_inflight_decode_chain(nmt_decode):
+    """ISSUE 9 satellite: stop() racing an in-flight decode chain —
+    the stop-drain harvests the chain dry, every generation future
+    resolves (token-correct for admitted work, typed for post-close
+    submits), and nothing hangs."""
+    m, exe, scope = nmt_decode
+    rng = np.random.RandomState(13)
+    lens = [4, 7, 5, 8, 3, 6]
+    prompts = [_prompt(rng, l) for l in lens]
+    refs = [_reference_decode(m, exe, scope, p, 10)[0]
+            for p in prompts]
+    spec = serving.GenerationSpec.from_model(m)
+    for trial in range(3):
+        eng = serving.InferenceEngine(
+            m['prefill'], fetch_list=m['prefill_fetches'], scope=scope,
+            executor=exe, place=fluid.CPUPlace(),
+            config=serving.ServingConfig(
+                max_batch_size=8, max_wait_ms=1, decode_slots=2,
+                decode_steps=1, decode_pipeline_depth=3),
+            generation=spec, name='gen-stoprace-%d' % trial).start()
+        futs = [eng.submit_generate({'src_word_id': p}, max_len=10)
+                for p in prompts]
+        # let the chain build (decode scans in flight), then stop
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            d = eng.metrics()['decode']
+            if d is not None and d['dispatches'] > trial:
+                break
+            time.sleep(0.002)
+        eng.stop()
+        assert not eng._decode_inflight  # the chain drained
+        for f, want in zip(futs, refs):
+            # stop() drains the queue and the lane: every pre-close
+            # submit must deliver its exact tokens
+            assert list(f.result(60)) == want
+        with pytest.raises(serving.EngineClosedError):
+            eng.submit_generate({'src_word_id': prompts[0]})
+
+
+def test_stop_races_inflight_decode_chain_mesh(nmt_decode):
+    """The same stop-vs-chain race on the 8-device mesh (dp-sharded
+    slots): the chain drains, futures resolve token-identical."""
+    m, exe, scope = nmt_decode
+    rng = np.random.RandomState(14)
+    prompts = [_prompt(rng, l) for l in (3, 5, 4)]
+    refs = [_reference_decode(m, exe, scope, p, 5)[0] for p in prompts]
+    spec = serving.GenerationSpec.from_model(m)
+    eng = serving.InferenceEngine(
+        m['prefill'], fetch_list=m['prefill_fetches'], scope=scope,
+        parallel=True, place=fluid.CPUPlace(),
+        config=serving.ServingConfig(
+            max_batch_size=4, max_wait_ms=1, decode_slots=8,
+            decode_steps=1, decode_pipeline_depth=2),
+        generation=spec, name='gen-stoprace-mesh').start()
+    futs = [eng.submit_generate({'src_word_id': p}, max_len=5)
+            for p in prompts]
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        d = eng.metrics()['decode']
+        if d is not None and d['dispatches'] > 0:
+            break
+        time.sleep(0.002)
+    eng.stop()
+    assert not eng._decode_inflight
+    for f, want in zip(futs, refs):
+        assert list(f.result(120)) == want
+
+
 # ---- KV-cache (transformer) state ------------------------------------
 
 
@@ -527,7 +638,7 @@ def test_decode_error_dumps_slot_map(nmt_decode, monkeypatch):
         config=serving.ServingConfig(decode_slots=2, decode_steps=2),
         generation=spec, name='gen-err')
     monkeypatch.setattr(
-        exe, 'run_decode_multi',
+        exe, '_dispatch_decode_multi',
         lambda *a, **k: (_ for _ in ()).throw(RuntimeError('boom')))
     rng = np.random.RandomState(9)
     fut = eng.submit_generate({'src_word_id': _prompt(rng, 4)},

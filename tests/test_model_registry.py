@@ -501,7 +501,9 @@ def test_unload_submit_generate_race_hammer():
     """The decode lane's half of the race bar: submit_generate()
     against a generation model mid-unload() resolves typed — a prompt
     caught between prefill and slot admission must still resolve its
-    future when the engine drains."""
+    future when the engine drains.  decode_pipeline_depth=3 (ISSUE 9)
+    keeps a CHAIN of scans in flight under the unload, so the race
+    also covers stop-drain harvesting a non-empty chain."""
     import time as _time
     from paddle_tpu.models import seq2seq
     m = seq2seq.build_step_decode(
@@ -521,7 +523,7 @@ def test_unload_submit_generate_race_hammer():
                  generation=serving.GenerationSpec.from_model(m),
                  config=serving.ServingConfig(
                      max_batch_size=4, max_wait_ms=1, decode_slots=2,
-                     decode_steps=2))
+                     decode_steps=2, decode_pipeline_depth=3))
 
     def prompt():
         l = int(rng.randint(2, 5))

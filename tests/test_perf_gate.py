@@ -153,6 +153,66 @@ def test_decode_config_cpu_smoke(monkeypatch):
     assert 0.0 < rec['slot_occupancy'] <= 1.0
 
 
+def test_decode_overlap_config_registered():
+    """ISSUE 9 structural pin (runs off-TPU): the decode_overlap
+    paired config exists, pairs a chained (decode_pipeline_depth >= 2)
+    engine against the per-scan-sync (depth 1) lane over one shared
+    scope/executor, asserts token-identity, and hard-gates the
+    host-sync reduction + tokens/s ratio behind their env knobs."""
+    perf_gate, inspect = _import_perf_gate()
+    assert 'decode_overlap' in perf_gate.CONFIGS
+    src = inspect.getsource(perf_gate.run_decode_overlap)
+    for pin in ("'host_sync_reduction'", "'chained_vs_synced'",
+                'PERF_GATE_DECODE_SYNC_RATIO',
+                'PERF_GATE_DECODE_TPS_MIN', 'token-identical'):
+        assert pin in src, pin
+    build = inspect.getsource(perf_gate.build_decode_overlap)
+    assert 'decode_pipeline_depth' in build
+    assert 'submit_generate' in build
+    # the paired engines differ ONLY in pipeline depth: one side is
+    # hard-wired to 1 (the per-scan-sync baseline)
+    assert 'make_engine(1,' in build
+
+
+def test_decode_overlap_cpu_smoke(monkeypatch):
+    """The ISSUE 9 acceptance criterion, functionally on CPU: the
+    chained lane's outputs are bitwise token-identical to the
+    per-scan-sync lane's over the same mixed-length stream, with host
+    syncs per emitted token reduced >= 2x (run_decode_overlap
+    hard-asserts both).  The tokens/s floor is relaxed for this
+    CPU-share-capped container (the sync reduction is the structural
+    deliverable; throughput parity is jitter-bound here and gated at
+    its real floor on hardware)."""
+    perf_gate, _ = _import_perf_gate()
+    monkeypatch.setenv('PERF_GATE_DOV_REQS', '6')
+    monkeypatch.setenv('PERF_GATE_DOV_LEN', '10')
+    monkeypatch.setenv('PERF_GATE_DECODE_TPS_MIN', '0.5')
+    # 2 interleaved blocks judged on the best shared window, like the
+    # slo smoke: one window's ratio is timing-jittery on this host
+    monkeypatch.setattr(perf_gate, 'BLOCKS', 2)
+    rec = perf_gate.run_decode_overlap()
+    assert rec['host_sync_reduction'] >= 2.0
+    assert rec['sync_per_token_chained'] < rec['sync_per_token_synced']
+    assert rec['chained_host_syncs'] < rec['synced_host_syncs']
+    assert rec['tokens_per_window'] > 0
+    assert rec['decode_pipeline_depth'] >= 2
+
+
+def test_slo_profile_shed_check():
+    """ISSUE 9's sharpened slo shed contract, deterministically on
+    CPU: the per-signature horizon sheds the slow-signature request
+    the global min-wall horizon would have admitted (and keeps the
+    fast one either way) — plus the structural pin that run_slo folds
+    the check into its record."""
+    perf_gate, inspect = _import_perf_gate()
+    rec = perf_gate.check_profile_shed()
+    assert rec == {'profile_shed_slow': True, 'profile_kept_fast': True,
+                   'global_horizon_admitted_slow': True}
+    src = inspect.getsource(perf_gate.run_slo)
+    assert 'check_profile_shed' in src
+    assert "'profile_shed_slow'" in src
+
+
 def test_slo_config_registered():
     """ISSUE 8 structural pin (runs off-TPU): the slo paired config
     exists, drives BOTH engines with the same seeded open-loop stream,

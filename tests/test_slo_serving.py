@@ -376,6 +376,128 @@ def test_prewarm_skips_unloaded_models_and_validates(tmp_path):
     reg.stop()
 
 
+# ---- per-signature service profile (ISSUE 9) ---------------------------
+
+
+def test_service_profile_estimates_and_floor():
+    """ServiceTimeProfile unit contract: per-key min-of-window
+    estimates, cost seeds that never override observations, a global
+    floor over all keys, and the bounded-signature eviction."""
+    p = serving.ServiceTimeProfile(window=3, max_signatures=2)
+    assert p.estimate('a') is None and p.floor() is None
+    assert p.seed('a', 0.050)
+    assert p.estimate('a') == pytest.approx(0.050)
+    # a compile-heavy first wall does not poison the estimate: the
+    # seed stays the min
+    p.observe('a', 0.400)
+    assert p.estimate('a') == pytest.approx(0.050)
+    p.observe('a', 0.010)
+    assert p.estimate('a') == pytest.approx(0.010)
+    # a second seed (or one after observations) is refused
+    assert not p.seed('a', 0.001)
+    p.observe('b', 0.200)
+    assert p.floor() == pytest.approx(0.010)
+    # window rolls: three more walls push the 10ms one out
+    for w in (0.030, 0.040, 0.050):
+        p.observe('a', w)
+    assert p.estimate('a') == pytest.approx(0.030)
+    # bounded: a third signature evicts the least recently observed
+    p.observe('c', 0.001)
+    assert p.signatures() == 2
+    snap = p.snapshot()
+    assert len(snap) == 2
+    for rec in snap.values():
+        assert set(rec) == {'est_ms', 'ewma_ms', 'seeded', 'observed'}
+    with pytest.raises(ValueError):
+        serving.ServiceTimeProfile(window=0)
+    with pytest.raises(ValueError):
+        serving.ServiceTimeProfile(alpha=0.0)
+
+
+def test_engine_shed_horizon_is_per_signature():
+    """The MicroBatcher horizon path provably uses per-signature
+    estimates (the ISSUE 9 acceptance pin): with a slow signature
+    profiled at 100ms and a fast one at 1ms, a 50ms-deadline
+    slow-signature request sheds AT LOT FORMATION while the same-
+    deadline fast one is admitted — under the old global min-wall
+    horizon (1ms) both would have been admitted."""
+    shed = []
+    prof = serving.ServiceTimeProfile()
+    for _ in range(3):
+        prof.observe('fast', 0.001)
+        prof.observe('slow', 0.100)
+
+    def est(req):
+        e = prof.estimate(req.sig)
+        return 3.0 * (e if e is not None else (prof.floor() or 0.0))
+
+    mb = serving.MicroBatcher(max_batch_size=8, scheduling='edf',
+                              on_shed=shed.append,
+                              service_estimate_for=est)
+    fast = mb.submit(_req(sig='fast', deadline_ms=50))
+    slow = mb.submit(_req(sig='slow', deadline_ms=50))
+    # an UNSEEN signature falls back to the global floor (the old
+    # estimator): admitted under a 50ms deadline
+    unseen = mb.submit(_req(sig='new', deadline_ms=50))
+    lot = mb.next_lot(timeout=0, force=True)
+    assert shed == [slow]
+    assert fast in lot and slow not in lot
+    lots = [lot]
+    while True:
+        more = mb.next_lot(timeout=0, force=True)
+        if not more:
+            break
+        lots.append(more)
+    assert any(unseen in l for l in lots)
+    # the engine wires exactly this path: structural pin
+    import inspect
+    src = inspect.getsource(
+        __import__('paddle_tpu.serving.engine',
+                   fromlist=['engine']).InferenceEngine._service_estimate)
+    assert 'profile.estimate(req.sig)' in src
+    engine_init = inspect.getsource(
+        __import__('paddle_tpu.serving.engine',
+                   fromlist=['engine']).InferenceEngine.__init__)
+    assert 'service_estimate_for' in engine_init
+
+
+def test_adaptive_admission_scales_watermarks(monkeypatch):
+    """ServingConfig(adaptive_admission=True): the registry's depth
+    watermark scales by the measured drain/arrival ratio — a
+    keeping-up engine (drain >= arrival) absorbs a burst the static
+    mark would have rejected; one falling behind rejects at HALF the
+    static depth.  Rates and queue depth are pinned directly (no
+    timing races)."""
+    prog, pred, scope = _scorer(seed=31)
+    reg = serving.ModelRegistry()
+    eng = reg.load('m', program=prog, feed_names=['x'],
+                   fetch_list=[pred], scope=scope,
+                   config=serving.ServingConfig(
+                       admit_queue_depth=4, adaptive_admission=True))
+    try:
+        monkeypatch.setattr(eng._batcher, 'depth', lambda: 5)
+        monkeypatch.setattr(eng._batcher, 'oldest_age', lambda: 0.0)
+        # drain 2x arrival -> effective depth 8: depth 5 admits
+        monkeypatch.setattr(eng, 'rate_stats', lambda: {
+            'arrival_req_s': 10.0, 'drain_req_s': 20.0})
+        reg._check_admission('m')  # no raise
+        # arrival 2x drain -> effective depth 2: depth 5 rejects
+        monkeypatch.setattr(eng, 'rate_stats', lambda: {
+            'arrival_req_s': 20.0, 'drain_req_s': 10.0})
+        with pytest.raises(OverloadedError):
+            reg._check_admission('m')
+        # unmeasurable rates: the static mark stands (depth 5 >= 4)
+        monkeypatch.setattr(eng, 'rate_stats', lambda: {
+            'arrival_req_s': None, 'drain_req_s': None})
+        with pytest.raises(OverloadedError):
+            reg._check_admission('m')
+    finally:
+        reg.stop()
+    # the contradiction guard: adapting nothing is a typed error
+    with pytest.raises(ValueError, match='adaptive_admission'):
+        serving.ServingConfig(adaptive_admission=True)
+
+
 # ---- decode-lane deadline budget ---------------------------------------
 
 

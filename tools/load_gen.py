@@ -8,6 +8,14 @@ own metrics snapshot.  Works against synthetic built-in models (the
 default — zero setup, runs on CPU or TPU) or a directory of
 save_inference_model exports.
 
+Generate traffic (ISSUE 9): ``--generate-frac`` routes that share of
+the offered stream to a synthetic generation model's continuous-
+batching decode lane (kind='generate' TrafficClass); the report then
+carries a ``decode`` block per generation model — decode tokens/s over
+the offered window and HOST-SYNCS-PER-TOKEN (device-idling host round
+trips the chained decode lane avoids; compare --decode-depth 1 vs 2
+to see the pipelining win under open-loop load).
+
 Examples:
 
     # overload a single synthetic model 3x past its measured capacity,
@@ -16,6 +24,9 @@ Examples:
 
     # absolute rate, two models, mixed priorities, FIFO baseline:
     python tools/load_gen.py --models 2 --rate 400 --scheduling fifo
+
+    # 30% generate traffic through the chained decode lane:
+    python tools/load_gen.py --generate-frac 0.3 --rate 50
 
     # your own exported model dir:
     python tools/load_gen.py --model-dir /models/ranker --rate 100
@@ -28,6 +39,27 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_generation(seed, max_len=8):
+    """One tiny stepwise NMT decode model (prefill + step programs)
+    + its GenerationSpec and scope — the synthetic generate-traffic
+    target (the same toy the decode perf gates drive)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import serving
+    from paddle_tpu.models import seq2seq
+    m = seq2seq.build_step_decode(
+        src_dict_dim=50, trg_dict_dim=40, embedding_dim=8,
+        encoder_size=16, decoder_size=16, max_len=max_len)
+    m['prefill'].random_seed = seed
+    place = (fluid.TPUPlace() if fluid.core.is_compiled_with_tpu()
+             else fluid.CPUPlace())
+    exe = fluid.Executor(place)
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(m['prefill_startup'])
+        exe.run(m['step_startup'])
+    return m, serving.GenerationSpec.from_model(m), scope
 
 
 def _build_synthetic(seed, dim=16, classes=64):
@@ -66,6 +98,16 @@ def main(argv=None):
     p.add_argument('--priority-frac', type=float, default=0.0,
                    help='fraction of traffic submitted at priority 1 '
                         '(the rest at 0)')
+    p.add_argument('--generate-frac', type=float, default=0.0,
+                   help='fraction of traffic routed to a synthetic '
+                        'generation model\'s decode lane '
+                        '(kind=generate; reports decode tokens/s and '
+                        'host-syncs-per-token)')
+    p.add_argument('--gen-max-len', type=int, default=8,
+                   help='generation budget per generate request')
+    p.add_argument('--decode-depth', type=int, default=2,
+                   help='decode_pipeline_depth of the generation '
+                        'model (1 = per-scan-sync baseline)')
     p.add_argument('--models', type=int, default=1,
                    help='number of synthetic models to mix across')
     p.add_argument('--model-dir', default=None,
@@ -125,18 +167,51 @@ def main(argv=None):
             return {'x': rng.rand(args.rows, args.seq,
                                   _dim).astype('float32')}
 
+    gen_names = []
+    if args.generate_frac > 0:
+        if not (0.0 < args.generate_frac < 1.0):
+            raise SystemExit('--generate-frac must be in (0, 1)')
+        gm, gspec, gscope = _build_generation(seed=args.seed + 1,
+                                              max_len=args.gen_max_len)
+        gcfg = serving.ServingConfig(
+            max_batch_size=args.max_batch,
+            max_wait_ms=args.max_wait_ms,
+            scheduling=args.scheduling,
+            decode_pipeline_depth=args.decode_depth)
+        reg.load('gen0', program=gm['prefill'],
+                 feed_names=gm['prefill_feeds'],
+                 fetch_list=gm['prefill_fetches'], scope=gscope,
+                 generation=gspec, config=gcfg)
+        gen_names.append('gen0')
+
+        def gen_feed_fn(rng):
+            import paddle_tpu.fluid as fluid
+            l = int(rng.randint(3, 10))
+            return {'src_word_id': fluid.create_lod_tensor(
+                rng.randint(2, 50, size=(l, 1)).tolist(), [[l]])}
+
     classes = []
+    # the forward share splits across the forward models: per-model
+    # weights must sum to (1 - generate_frac) or the generate class's
+    # documented share of the offered stream dilutes as --models grows
+    fwd_weight = max(1.0 - args.generate_frac, 1e-6) / max(len(names), 1)
     for name in names:
         if args.priority_frac > 0:
             classes.append(serving.TrafficClass(
-                feed_fn, model=name, weight=args.priority_frac,
+                feed_fn, model=name,
+                weight=fwd_weight * args.priority_frac,
                 deadline_ms=args.deadline_ms, priority=1,
                 name=name + ':p1'))
         classes.append(serving.TrafficClass(
             feed_fn, model=name,
-            weight=max(1.0 - args.priority_frac, 1e-6),
+            weight=fwd_weight * max(1.0 - args.priority_frac, 1e-6),
             deadline_ms=args.deadline_ms, priority=0,
             name=name + ':p0'))
+    for name in gen_names:
+        classes.append(serving.TrafficClass(
+            gen_feed_fn, model=name, kind='generate',
+            weight=args.generate_frac, max_len=args.gen_max_len,
+            deadline_ms=args.deadline_ms, name=name + ':generate'))
 
     with reg:
         # warm every model's serving signature, then measure capacity
@@ -144,6 +219,16 @@ def main(argv=None):
         rng = np.random.RandomState(args.seed)
         for name in names:
             reg.infer(name, feed_fn(rng), timeout=600)
+        for name in gen_names:
+            # warm the prefill rungs + the decode-scan executable
+            reg.generate(name, gen_feed_fn(rng), timeout=600)
+        # decode baseline AFTER warmup: the report's tokens/s and
+        # host-syncs-per-token must cover the offered stream only
+        decode_base = {
+            name: dict(reg._entry(name).engine.metrics()['decode']
+                       or {})
+            for name in gen_names
+        }
         t0 = time.time()
         burst = [reg.submit(names[i % len(names)], feed_fn(rng))
                  for i in range(16)]
@@ -167,9 +252,33 @@ def main(argv=None):
                 n: {k: metrics['models'][n][k]
                     for k in ('shed', 'queue_depth', 'compiles',
                               'p50_latency_ms', 'p99_latency_ms')}
-                for n in names
+                for n in names + gen_names
             },
         }
+        if gen_names:
+            # decode-lane deliverables (ISSUE 9): tokens/s over the
+            # measured window and host-syncs-per-token — the number
+            # the chained lane (decode_pipeline_depth >= 2) drives
+            # toward zero vs one-per-scan on the synced baseline
+            report['decode'] = {}
+            for name in gen_names:
+                d = reg._entry(name).engine.metrics()['decode'] or {}
+                base = decode_base.get(name) or {}
+                tokens = (d.get('tokens') or 0) - \
+                    (base.get('tokens') or 0)
+                syncs = (d.get('host_syncs') or 0) - \
+                    (base.get('host_syncs') or 0)
+                report['decode'][name] = {
+                    'tokens': tokens,
+                    'tokens_per_s': round(
+                        tokens / max(report['elapsed_s'], 1e-9), 3),
+                    'host_syncs': syncs,
+                    'host_syncs_per_token': (
+                        round(syncs / tokens, 4) if tokens else None),
+                    'chain_flushes': (d.get('chain_flushes') or 0) -
+                    (base.get('chain_flushes') or 0),
+                    'decode_pipeline_depth': args.decode_depth,
+                }
     reg.stop()
     print(json.dumps(report), flush=True)
     return report

@@ -11,7 +11,7 @@ max(fw)/max(bd) cross-window pairing).
 Run on TPU hardware:
     python tools/perf_gate.py [resnet|transformer|nmt|resnet_infer|
         feed_pipeline|multi_model|trailing_dim|trace_overhead|decode|
-        slo|all]
+        decode_overlap|slo|all]
 Prints one JSON line per config; tests/test_perf_gate.py drives it and
 skips cleanly off-TPU.  ``resnet_infer`` (ISSUE 2) has no bound side —
 its deliverable is the paired ``multi_vs_dispatch`` block: the measured
@@ -55,7 +55,23 @@ engine schedules earliest-deadline-first and SHEDS past-deadline work
 serves everything late.  Within-deadline responses are asserted
 bitwise-identical across the two engines, and the hard gate is
 ``goodput_ratio`` (in-deadline responses, EDF over FIFO) >=
-PERF_GATE_SLO_GOODPUT_MIN (default 1.3).
+PERF_GATE_SLO_GOODPUT_MIN (default 1.3).  ISSUE 9 sharpened the shed
+contract: the record also runs a DETERMINISTIC per-signature horizon
+check — a mixed-shape queue whose slow signature measures 200x the
+fast one sheds the slow-signature request at lot formation while the
+old global min-wall horizon would have admitted it toward certain
+deadline death (and keeps the fast request either way).
+``decode_overlap`` (ISSUE 9) pairs the CHAINED decode lane
+(decode_pipeline_depth >= 2: scan N+1 enqueued against scan N's
+device-resident donated output carry, token blocks harvested while
+the next scan computes) against the per-scan-sync lane
+(decode_pipeline_depth=1 — one device-idling host round trip per
+scan) over the IDENTICAL mixed-length generation stream.  Outputs are
+asserted token-identical; the hard gates are the host-syncs-per-token
+REDUCTION >= PERF_GATE_DECODE_SYNC_RATIO (default 2.0) and the CPU
+tokens/s ratio (chained over synced, best shared block) >=
+PERF_GATE_DECODE_TPS_MIN (default 0.8 — the overlap must never cost
+throughput; on hardware it recovers the harvest round trip).
 """
 
 import json
@@ -866,6 +882,199 @@ def run_decode():
     return rec
 
 
+def build_decode_overlap():
+    """Chained (host-sync-free) vs per-scan-sync decode lanes over the
+    IDENTICAL mixed-length generation stream (ISSUE 9): two engines
+    serve the SAME stepwise NMT decode model (one scope — weights
+    genuinely shared), differing ONLY in decode_pipeline_depth: the
+    synced side (depth 1) pays one device-idling host round trip per
+    K-step scan (dispatch, sync tokens, bookkeep, dispatch), the
+    chained side (depth >= 2) enqueues scan N+1 against scan N's
+    device-resident output carry and harvests N's token block while
+    N+1 computes — admission/shed/eviction ride chain-flush points, so
+    outputs stay token-identical.  The deliverables are the
+    host-syncs-per-token reduction (counted by the engines themselves:
+    a harvest that blocked with nothing in flight behind it) and the
+    paired tokens/s ratio."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import serving
+    from paddle_tpu.fluid import core
+    from paddle_tpu.models import seq2seq
+
+    n_req = int(os.environ.get('PERF_GATE_DOV_REQS', '8'))
+    slots = int(os.environ.get('PERF_GATE_DOV_SLOTS', '4'))
+    k_steps = int(os.environ.get('PERF_GATE_DOV_STEPS', '4'))
+    max_len = int(os.environ.get('PERF_GATE_DOV_LEN', '12'))
+    depth = int(os.environ.get('PERF_GATE_DOV_DEPTH', '2'))
+    m = seq2seq.build_step_decode(src_dict_dim=100, trg_dict_dim=80,
+                                  embedding_dim=16, encoder_size=32,
+                                  decoder_size=32, max_len=max_len)
+    place = fluid.TPUPlace() if core.is_compiled_with_tpu() \
+        else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(m['prefill_startup'])
+        exe.run(m['step_startup'])
+    rng = np.random.RandomState(0)
+    lens = [3 + (i * 5) % 13 for i in range(n_req)]
+    prompts = [fluid.create_lod_tensor(
+        rng.randint(2, 100, size=(l, 1)).tolist(), [[l]]) for l in lens]
+    spec = serving.GenerationSpec.from_model(m)
+
+    def make_engine(pipeline_depth, name):
+        # ONE shared executor: both lanes resolve the same prefill/
+        # step executables, so the paired windows measure the
+        # pipelining policy, not compile weather
+        return serving.InferenceEngine(
+            m['prefill'], fetch_list=m['prefill_fetches'], scope=scope,
+            executor=exe, place=place,
+            config=serving.ServingConfig(
+                max_batch_size=n_req, max_wait_ms=2,
+                decode_slots=slots, decode_steps=k_steps,
+                decode_pipeline_depth=pipeline_depth),
+            generation=spec, name=name).start()
+
+    synced = make_engine(1, 'perf-gate-dov-synced')
+    chained = make_engine(depth, 'perf-gate-dov-chained')
+
+    def window(eng):
+        """(tokens/s, syncs_per_token, tokens, outputs) for one pass
+        of the stream — sync accounting from the engine's own
+        metrics() deltas."""
+        d0 = eng.metrics()['decode'] or \
+            {'host_syncs': 0, 'tokens': 0}
+        t0 = time.time()
+        futs = [eng.submit_generate({'src_word_id': p}) for p in prompts]
+        outs = [list(f.result(600)) for f in futs]
+        elapsed = time.time() - t0
+        d1 = eng.metrics()['decode']
+        syncs = d1['host_syncs'] - d0['host_syncs']
+        tokens = d1['tokens'] - d0['tokens']
+        return tokens / elapsed, syncs / max(tokens, 1), tokens, outs
+
+    return (lambda: window(synced)), (lambda: window(chained)), \
+        (synced, chained, n_req, slots, k_steps, depth)
+
+
+def run_decode_overlap():
+    """The decode_overlap record: interleaved synced/chained windows
+    over the identical stream (each ratio shares a drift window — the
+    gates' pairing rule).  HARD asserts (the ISSUE 9 acceptance):
+    chained outputs bitwise token-identical to the per-scan-sync
+    lane's, host syncs per emitted token reduced by at least
+    PERF_GATE_DECODE_SYNC_RATIO (default 2.0), and the chained lane's
+    CPU tokens/s at least PERF_GATE_DECODE_TPS_MIN (default 0.8) of
+    the synced lane's on the best shared block."""
+    sync_w, chain_w, (synced, chained, n_req, slots, k_steps, depth) = \
+        build_decode_overlap()
+    try:
+        sync_w(), chain_w()  # warm the shared executable set
+        sy, ch, tps_ratios = [], [], []
+        sync_spt = chain_spt = tokens = 0
+        for _ in range(BLOCKS):
+            sv, s_spt, s_tok, s_outs = sync_w()
+            cv, c_spt, c_tok, c_outs = chain_w()
+            assert c_outs == s_outs, \
+                'chained decode lane diverged from the per-scan-sync ' \
+                'lane: %r vs %r' % (c_outs[:2], s_outs[:2])
+            sy.append(sv)
+            ch.append(cv)
+            tps_ratios.append(cv / sv)
+            sync_spt, chain_spt, tokens = s_spt, c_spt, s_tok
+        m_sync = synced.metrics()['decode']
+        m_chain = chained.metrics()['decode']
+    finally:
+        synced.stop()
+        chained.stop()
+    rec = {
+        'config': 'decode_overlap',
+        'chained_tokens_per_sec': round(max(ch), 1),
+        'synced_tokens_per_sec': round(max(sy), 1),
+        'chained_blocks': [round(v, 1) for v in ch],
+        'synced_blocks': [round(v, 1) for v in sy],
+        # the PAIRED deliverables: host-sync reduction + throughput
+        # kept, per shared drift window
+        'chained_vs_synced': round(max(tps_ratios), 4),
+        'sync_per_token_synced': round(sync_spt, 4),
+        'sync_per_token_chained': round(chain_spt, 4),
+        'host_sync_reduction': round(
+            sync_spt / max(chain_spt, 1e-9), 4),
+        'chained_host_syncs': m_chain['host_syncs'],
+        'synced_host_syncs': m_sync['host_syncs'],
+        'chain_flushes': m_chain['chain_flushes'],
+        'tokens_per_window': tokens,
+        'requests_per_window': n_req, 'decode_slots': slots,
+        'decode_steps': k_steps, 'decode_pipeline_depth': depth,
+        'blocks': BLOCKS,
+    }
+    sync_floor = float(os.environ.get('PERF_GATE_DECODE_SYNC_RATIO',
+                                      '2.0'))
+    tps_floor = float(os.environ.get('PERF_GATE_DECODE_TPS_MIN', '0.8'))
+    assert rec['host_sync_reduction'] >= sync_floor, rec
+    assert rec['chained_vs_synced'] >= tps_floor, rec
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def check_profile_shed():
+    """ISSUE 9's sharpened shed contract, checked DETERMINISTICALLY
+    (no model, no timing): a MicroBatcher fed the per-signature
+    ServiceTimeProfile horizon sheds the slow-signature request whose
+    3x-estimate cannot meet its deadline — while the SAME queue under
+    the old global min-wall horizon (dragged down by the fast
+    signature's wall) admits it toward certain deadline death.  The
+    fast-signature request is kept by both.  Returns the record block
+    run_slo folds in."""
+    from paddle_tpu.serving import (DeadlineExceededError,
+                                    InferenceRequest, MicroBatcher,
+                                    ServiceTimeProfile)
+    prof = ServiceTimeProfile()
+    for _ in range(3):
+        prof.observe('fast', 0.001)   # 1ms signature
+        prof.observe('slow', 0.200)   # 200ms signature
+
+    def est(req):
+        e = prof.estimate(req.sig)
+        return 3.0 * (e if e is not None else (prof.floor() or 0.0))
+
+    def drive(batcher):
+        fast = InferenceRequest({'x': 0}, 1, 'fast', deadline_ms=50.0)
+        slow = InferenceRequest({'x': 0}, 1, 'slow', deadline_ms=50.0)
+        batcher.submit(fast)
+        batcher.submit(slow)
+        lots = []
+        while True:
+            lot = batcher.next_lot(timeout=0, force=True)
+            if not lot:
+                break
+            lots.extend(lot)
+        return fast, slow, lots
+
+    fast, slow, lots = drive(MicroBatcher(
+        max_batch_size=4, max_wait_s=0.001, service_estimate_for=est))
+    assert fast in lots and not fast.done(), \
+        'per-signature horizon shed the FAST request'
+    assert slow.done() and slow not in lots, \
+        'per-signature horizon admitted the doomed slow-signature ' \
+        'request'
+    try:
+        slow.result(0)
+        raise AssertionError('slow request resolved without error')
+    except DeadlineExceededError:
+        pass
+    # the counterfactual: the old GLOBAL horizon is the min wall over
+    # ALL signatures (the fast one's 1ms) — it admits the slow request
+    gfast, gslow, glots = drive(MicroBatcher(
+        max_batch_size=4, max_wait_s=0.001,
+        service_estimate_fn=lambda: 3.0 * 0.001))
+    assert gfast in glots and gslow in glots, \
+        'global horizon unexpectedly shed: %r' % ([gfast, gslow], )
+    return {'profile_shed_slow': True, 'profile_kept_fast': True,
+            'global_horizon_admitted_slow': True}
+
+
 def build_slo():
     """Deadline-scheduled vs FIFO serving under the SAME overloaded
     open-loop Poisson stream (ISSUE 8): one padding-neutral dense seq
@@ -1008,6 +1217,11 @@ def run_slo():
     assert rec['edf_shed'] > 0 and rec['shed_checked'] > 0, rec
     assert rec['bitwise_checked'] > 0, rec
     assert rec['goodput_ratio'] >= floor, rec
+    # the ISSUE 9 sharpened shed contract: per-signature horizon sheds
+    # what the global one would have admitted (deterministic check)
+    rec.update(check_profile_shed())
+    assert rec['profile_shed_slow'] and \
+        rec['global_horizon_admitted_slow'], rec
     print(json.dumps(rec), flush=True)
     return rec
 
@@ -1086,6 +1300,7 @@ CONFIGS = {
     'trailing_dim': (build_trailing_dim, 'rows_per_sec'),
     'trace_overhead': (build_trace_overhead, 'rows_per_sec'),
     'decode': (build_decode, 'tokens_per_sec'),
+    'decode_overlap': (build_decode_overlap, 'tokens_per_sec'),
     'slo': (build_slo, 'goodput_req_s'),
 }
 
@@ -1101,6 +1316,8 @@ def run_config(name):
         return run_trace_overhead()
     if name == 'decode':
         return run_decode()
+    if name == 'decode_overlap':
+        return run_decode_overlap()
     if name == 'slo':
         return run_slo()
     build, unit = CONFIGS[name]
