@@ -33,6 +33,14 @@ closing the ROADMAP dispatch-tax ledger):
   stacked_lstm       IMDB stacked dynamic LSTM (3x128), bs128 seq64
   resnet_infer_bf16  ResNet-50 INFERENCE bs256, Float16Transpiler'd to
                      bf16, with a same-process f32 speedup ratio
+  ctr                wide&deep CTR train+serve (ISSUE 11): zipfian id
+                     traffic into a MESH-ROW-SHARDED sparse embedding
+                     table ({dp, mp} mesh — the 8-dev virtual mesh on
+                     the CPU smoke), SparseRows gradients end to end
+                     (no dense [V, D] grad on device), a served
+                     inference block through the ModelRegistry, and
+                     the per-device embed-table arbiter account with
+                     its sharded-vs-unsharded admission counterfactual
 
 Baseline: the reference's best published ResNet-50 training number,
 84.08 imgs/sec (2x Xeon 6148 MKL-DNN, BASELINE.md — the K40m GPU tables
@@ -91,7 +99,7 @@ BASELINE_RESNET_IMGS_PER_SEC = 84.08
 # min patience — the all-hang case is already a dead tunnel, where
 # budget precision stops mattering.
 BUDGETS = {'resnet': 280, 'nmt': 270, 'transformer': 380,
-           'stacked_lstm': 220, 'resnet_infer_bf16': 340}
+           'stacked_lstm': 220, 'resnet_infer_bf16': 340, 'ctr': 240}
 if os.environ.get('BENCH_BUDGET'):  # uniform override, mainly for tests
     BUDGETS = {k: int(os.environ['BENCH_BUDGET']) for k in BUDGETS}
 PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -806,17 +814,257 @@ def bench_resnet_infer_bf16(on_tpu, steps=10):
     }
 
 
+def _ctr_serving_block(test_prog, feeds, pred, scope, mesh, place, vocab,
+                       embed, hidden, batch_fn, reqs=6):
+    """The ISSUE 11 serving half: the trained CTR program loads into a
+    ModelRegistry (row-sharded over the SAME mesh the trainer used —
+    the table's arbiter account is charged at its per-device shard
+    bytes) and ``submit`` serves skewed id-batches through the normal
+    lot machinery.  The block also runs the admission counterfactual
+    when the mesh really splits rows: under a budget sized BELOW the
+    full table (plus headroom above the per-device shard), the sharded
+    load was admitted while the identical UNSHARDED program draws the
+    typed HBMBudgetError."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import parallel, serving
+    from paddle_tpu.serving.arbiter import program_seed_bytes
+    from paddle_tpu.serving.registry import EMBED_TABLE_SUFFIX
+
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    mp = int(axes.get('mp', 1))
+    table_bytes = vocab * embed * 4
+    max_batch = 256
+    # serve from a CLEAN inference scope (trained params copied to
+    # host, optimizer state left behind) — the save/load_inference_model
+    # shape: a trainer scope's [V, D] Adam moments are not part of the
+    # serving footprint the admission budget is sized for
+    serve_scope = fluid.core.Scope()
+    test_vars = {v.name for v in test_prog.global_block().vars.values()
+                 if getattr(v, 'persistable', False)}
+    for n in scope.local_var_names():
+        if n in test_vars:
+            serve_scope.var(n).set_value(
+                np.asarray(scope.find_var(n).value()))
+    scope = serve_scope
+    budget = None
+    if mp > 1:
+        # below the full table + model, above the sharded layout +
+        # model — seeded at the SAME top bucket the registry admits at
+        seed = program_seed_bytes(test_prog, max_batch)
+        budget = int(seed - table_bytes + table_bytes // mp
+                     + table_bytes // 4)
+    reg = serving.ModelRegistry(
+        place=place, mesh=mesh, hbm_budget_bytes=budget,
+        config=serving.ServingConfig(max_batch_size=max_batch,
+                                     max_wait_ms=5))
+    try:
+        reg.load('ctr', program=test_prog, feed_names=list(feeds),
+                 fetch_list=[pred], scope=scope)
+        n_rows = 0
+        t0 = time.time()
+        futs = [reg.submit('ctr', batch_fn(i)) for i in range(reqs)]
+        for f in futs:
+            out, = f.result(600)
+            assert np.isfinite(np.asarray(out)).all()
+            n_rows += np.shape(out)[0]
+        elapsed = time.time() - t0
+        snap = reg.arbiter.snapshot()
+        table_accounts = {n: a for n, a in snap['accounts'].items()
+                          if EMBED_TABLE_SUFFIX in n}
+        m = reg.metrics()['models']['ctr']
+        return _ctr_serving_rec(reqs, n_rows, elapsed, m, table_accounts,
+                                table_bytes, budget, mp, place, vocab,
+                                embed, hidden, max_batch)
+    finally:
+        # a failed serve/assert must not leak the registry's worker
+        # thread and staged device arrays into the rest of the child
+        reg.stop()
+
+
+def _ctr_serving_rec(reqs, n_rows, elapsed, m, table_accounts, table_bytes,
+                     budget, mp, place, vocab, embed, hidden, max_batch):
+    """Back half of _ctr_serving_block: the unsharded admission
+    counterfactual + the record."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import serving
+    rejected_unsharded = None
+    if budget is not None:
+        # the counterfactual: the SAME model shape/budget with no mesh
+        # keeps the table whole on one device — typed reject at load
+        with fluid.unique_name.guard():
+            from paddle_tpu.models import ctr as ctr_model
+            plain = ctr_model.build(
+                sparse_dim=vocab, embed_size=embed, hidden_sizes=hidden,
+                is_sparse=True,
+                optimizer=fluid.optimizer.SGD(learning_rate=0.05))
+        scope2 = fluid.core.Scope()
+        with fluid.scope_guard(scope2):
+            fluid.Executor(place).run(plain['startup'])
+        reg2 = serving.ModelRegistry(
+            place=place, hbm_budget_bytes=budget,
+            config=serving.ServingConfig(max_batch_size=max_batch,
+                                         max_wait_ms=5))
+        try:
+            reg2.load('ctr-unsharded', program=plain['test'],
+                      feed_names=plain['feeds'],
+                      fetch_list=[plain['prediction']], scope=scope2)
+            rejected_unsharded = False
+        except serving.HBMBudgetError:
+            rejected_unsharded = True
+        finally:
+            reg2.stop()
+        assert rejected_unsharded, (
+            'an unsharded table past the per-device budget must draw '
+            'the typed HBMBudgetError')
+    rec = {
+        'requests': reqs,
+        'rows': int(n_rows),
+        'rows_per_sec': round(n_rows / elapsed, 2),
+        'lots': m['lots'],
+        'table_accounts': table_accounts,
+        'table_bytes': table_bytes,
+        'hbm_budget_bytes': budget,
+        'unsharded_rejected_typed': rejected_unsharded,
+    }
+    return rec
+
+
+def bench_ctr(on_tpu, steps=20):
+    """Sharded sparse-embedding CTR workload (ISSUE 11, ROADMAP item
+    4): wide&deep over a row-sharded embedding table, trained
+    device-true through ParallelExecutor.run_multi with
+    ``is_sparse=True`` — the lookup backward is a SparseRows
+    rows/values pytree and the optimizer update is ONE row-subset
+    scatter per step, so the dense [V, D] gradient never exists on
+    device.  Id traffic is skewed (zipfian — the CTR regime), the
+    table + its accumulators row-shard over the mesh's 'mp' axis via
+    the DistributeTranspiler sparse pass, and the serving block loads
+    the trained program into a ModelRegistry over the same mesh.
+    FLOPs/sample (analytic): dense tower MACs x2 x3 (fwd+bwd) —
+    embedding gather/scatter is memory-bound and excluded."""
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import parallel
+    from paddle_tpu.models import ctr as ctr_model
+    from paddle_tpu.dataset import ctr as ctr_data
+
+    batch = 1024 if on_tpu else 64
+    vocab = 1000000 if on_tpu else 8192
+    embed = 64 if on_tpu else 16
+    hidden = (256, 128) if on_tpu else (64, 32)
+    if not on_tpu:
+        steps = 2  # CPU path is a smoke test, not a benchmark
+    devices = jax.devices()
+    mp = 2 if len(devices) >= 2 else 1
+    dp = max(len(devices) // mp, 1)
+    mesh = parallel.make_mesh({'dp': dp, 'mp': mp}, devices[:dp * mp])
+
+    m = ctr_model.build(sparse_dim=vocab, embed_size=embed,
+                        hidden_sizes=hidden, is_sparse=True,
+                        is_distributed=True,
+                        optimizer=fluid.optimizer.Adam(learning_rate=1e-3))
+    t = fluid.DistributeTranspiler()
+    t.config.sparse_shard_axis = 'mp'
+    t.transpile(0, program=m['main'], startup_program=m['startup'],
+                trainers=1)
+    assert t.distributed_lookup_tables == ['ctr_embedding']
+    # the test clone predates the transpile: annotate its table too so
+    # the SERVING side lays rows out over the mesh as well
+    parallel.shard(m['test'].global_block().var('ctr_embedding'),
+                   'mp', None)
+
+    rng = np.random.RandomState(0)
+
+    def batch_fn(i):
+        # zipfian ids: mass on a few hot rows, a long tail — the
+        # skewed traffic the sparse lane exists for (ONE construction
+        # shared with perf_gate sparse_grad and load_gen --ctr-frac)
+        return ctr_data.zipf_batch(rng, batch, vocab)
+
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(m['startup'])
+        pe = fluid.ParallelExecutor(loss_name=m['loss'].name,
+                                    main_program=m['main'], scope=scope,
+                                    mesh=mesh)
+        feeds = [batch_fn(i) for i in range(steps)]
+        # warm the K-step scanned executable (static jit arg)
+        lv, = pe.run_multi([m['loss'].name], feed_list=feeds)
+        per_block = []
+        for _ in range(3 if on_tpu else 1):
+            t0 = time.time()
+            lv, = pe.run_multi([m['loss'].name], feed_list=feeds)
+            per_block.append(time.time() - t0)
+        elapsed, mean_elapsed = min(per_block), np.mean(per_block)
+        loss = float(np.asarray(lv).flatten()[0])
+        assert np.isfinite(loss)
+        table = scope.find_var('ctr_embedding').value()
+        assert hasattr(table, 'sharding') and \
+            not table.sharding.is_fully_replicated, \
+            'the CTR table must really be row-sharded over the mesh'
+        cost = _cost_block(pe, steps / elapsed, on_tpu)
+        serving_block = _ctr_serving_block(
+            m['test'], m['feeds'], m['prediction'], scope, mesh,
+            fluid.TPUPlace() if on_tpu else fluid.CPUPlace(),
+            vocab, embed, hidden, batch_fn)
+
+    v = batch * steps / elapsed
+    touched = batch * ctr_data.SPARSE_SLOTS
+    # dense tower fwd MACs x2 x3 (train); the sparse lane's win is the
+    # MEMORY it never touches, reported as bytes-avoided alongside
+    d_in = ctr_data.DENSE_DIM + ctr_data.SPARSE_SLOTS * embed
+    macs = d_in * hidden[0] + hidden[0] * hidden[1] + hidden[1] \
+        + ctr_data.DENSE_DIM
+    flops_per_sample = macs * 2 * 3
+    mfu_analytic = round(v * flops_per_sample / PEAK_FLOPS, 4) \
+        if on_tpu else None
+    return {
+        'metric': 'ctr_train_samples_per_sec',
+        'value': round(v, 2), 'unit': 'samples/sec',
+        'ms_per_step': round(elapsed / steps * 1000, 2),
+        'ms_per_step_mean': round(mean_elapsed / steps * 1000, 2),
+        'mfu': (cost['mfu'] if cost and cost.get('mfu') is not None
+                else mfu_analytic),
+        'mfu_analytic': mfu_analytic,
+        'cost': cost,
+        'vs_baseline': None,  # reference published no CTR number
+        'device_true': True, 'steps_per_dispatch': steps,
+        'loss': round(loss, 5),
+        'mesh': {'dp': dp, 'mp': mp},
+        'vocab': vocab, 'embed_dim': embed, 'batch': batch,
+        'embedding_rows_per_sec': round(v * ctr_data.SPARSE_SLOTS, 1),
+        # the sparse lane's deliverable: the [V, D] grad bytes each
+        # step never materializes (vs rows x D it actually writes)
+        'sparse_grad_bytes_avoided_per_step':
+            (vocab - touched) * embed * 4,
+        'table_row_sharded': True,
+        'serving': serving_block,
+    }
+
+
 CONFIGS = {
     'resnet': bench_resnet,
     'nmt': bench_nmt,
     'transformer': bench_transformer,
     'stacked_lstm': bench_stacked_lstm,
     'resnet_infer_bf16': bench_resnet_infer_bf16,
+    'ctr': bench_ctr,
 }
 
 
 def run_one(name):
     """Child mode: run a single config, print exactly one JSON line."""
+    if name == 'ctr':
+        # the CTR config trains/serves over a {dp, mp} mesh: on the CPU
+        # smoke that is the 8-dev VIRTUAL mesh, which must be forced
+        # before jax initializes its backend (harmless on real TPUs —
+        # the flag only multiplies the HOST platform)
+        flags = os.environ.get('XLA_FLAGS', '')
+        if '--xla_force_host_platform_device_count' not in flags:
+            os.environ['XLA_FLAGS'] = (
+                flags + ' --xla_force_host_platform_device_count=8'
+            ).strip()
     if os.environ.get('BENCH_FORCE_CPU') == '1':
         # Hermetic escape hatch: the ambient site config registers the
         # TPU backend at interpreter start, so the env var alone is not

@@ -11,11 +11,27 @@ actually learn.
 
 import numpy as np
 
-__all__ = ['train', 'test', 'DENSE_DIM', 'SPARSE_SLOTS', 'SPARSE_DIM']
+__all__ = ['train', 'test', 'zipf_batch', 'DENSE_DIM', 'SPARSE_SLOTS',
+           'SPARSE_DIM']
 
 DENSE_DIM = 13
 SPARSE_SLOTS = 26
 SPARSE_DIM = 10000
+
+
+def zipf_batch(rng, rows, vocab=SPARSE_DIM):
+    """One skewed CTR feed batch (ISSUE 11): zipfian ids — mass on a
+    few hot rows, a long tail — the id distribution the sparse lane
+    exists for, plus dense features and labels.  The ONE construction
+    shared by bench.py's ctr config, perf_gate's sparse_grad stream
+    and load_gen's --ctr-frac traffic class, so the skew parameter and
+    slot layout can never silently diverge between them."""
+    return {
+        'dense': rng.standard_normal((rows, DENSE_DIM)).astype('float32'),
+        'sparse_ids': (rng.zipf(1.2, size=(rows, SPARSE_SLOTS)) % vocab)
+        .astype('int64'),
+        'label': rng.randint(0, 2, (rows, 1)).astype('int64'),
+    }
 
 
 def _reader(seed, n):

@@ -3,4 +3,5 @@
 
 from .master import Master, TaskQueuePyFallback, cloud_reader  # noqa: F401
 from .master_server import MasterServer, MasterClient  # noqa: F401
-from .async_sparse import AsyncSparseEmbedding  # noqa: F401
+from .async_sparse import AsyncSparseEmbedding, \
+    AsyncSparseClosedError  # noqa: F401

@@ -25,7 +25,19 @@ import threading
 
 import numpy as np
 
-__all__ = ['AsyncSparseEmbedding']
+__all__ = ['AsyncSparseEmbedding', 'AsyncSparseClosedError']
+
+
+class AsyncSparseClosedError(RuntimeError):
+    """Typed reject for a gradient pushed after ``close()``: the apply
+    daemon is gone, so a silent enqueue would drop the update forever
+    (the reference's analog is an RPC send to a shut-down pserver)."""
+
+    def __init__(self, what='push_grad'):
+        super(AsyncSparseClosedError, self).__init__(
+            '%s on a closed AsyncSparseEmbedding — the apply daemon has '
+            'shut down; create a new service (or call close() last)'
+            % what)
 
 
 class AsyncSparseEmbedding(object):
@@ -49,6 +61,12 @@ class AsyncSparseEmbedding(object):
         self._applied = 0
         self._pushed = 0
         self._error = None
+        self._closed = False
+        # serializes close() against racing pushers: a push that won
+        # entry before close() set the flag still lands in the queue
+        # close() is about to drain; one that lost raises typed instead
+        # of enqueueing to a dead daemon
+        self._close_lock = threading.Lock()
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
@@ -63,20 +81,29 @@ class AsyncSparseEmbedding(object):
 
     def push_grad(self, ids, grad):
         """Enqueue d(loss)/d(rows) for asynchronous application; returns
-        immediately (the reference's barrier-free send)."""
+        immediately (the reference's barrier-free send).  Raises the
+        typed ``AsyncSparseClosedError`` after ``close()`` — the daemon
+        is gone, so enqueueing would silently drop the update."""
         if self._error is not None:
             raise self._error
         ids = np.asarray(ids).reshape(-1).copy()
         grad = np.asarray(grad, dtype='float32').reshape(
             len(ids), -1).copy()
-        self._pushed += 1
-        self._q.put((ids, grad))
+        with self._close_lock:
+            if self._closed:
+                raise AsyncSparseClosedError()
+            self._pushed += 1
+            self._q.put((ids, grad))
 
     # -- server side (reference listen_and_serv RunAsyncLoop) --
     def _run(self):
         while True:
             item = self._q.get()
             if item is None:
+                # account for the shutdown sentinel too: a drain()
+                # (or table()) issued AFTER close must not hang on
+                # Queue.join()'s unfinished-task count
+                self._q.task_done()
                 return
             ids, grad = item
             try:
@@ -109,6 +136,19 @@ class AsyncSparseEmbedding(object):
             return self._table.copy()
 
     def close(self):
+        """Shut the service down: every update pushed BEFORE close is
+        applied (the pending queue drains fully before this returns),
+        then the daemon exits.  Idempotent; a push that races close
+        either lands in the drained queue or raises the typed
+        ``AsyncSparseClosedError`` — never a silent drop."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
         self.drain()
         self._q.put(None)
         self._worker.join(timeout=10)
+
+    @property
+    def closed(self):
+        return self._closed

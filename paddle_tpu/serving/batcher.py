@@ -126,11 +126,26 @@ class InferenceRequest(object):
         return self._result
 
 
-def _sched_key(req):
+def _sched_key(req, now=None, aging_s=None, max_priority=None):
     """EDF-within-priority: higher priority first, then earliest
     absolute deadline (no deadline = never urgent), then arrival —
-    so undeadlined equal-priority traffic keeps exact FIFO order."""
-    return (-req.priority,
+    so undeadlined equal-priority traffic keeps exact FIFO order.
+
+    ``aging_s`` is the starvation escape hatch (ISSUE 11 satellite;
+    ROADMAP item 5 leftover): strict priority starves a low class
+    forever under saturating high-priority traffic, so each full aging
+    window a request has waited promotes its EFFECTIVE class by one —
+    a request aging ``k * aging_s`` competes as ``priority + k``.
+    Promotion engages ONLY for requests below ``max_priority`` (the
+    highest REAL class currently pending): starvation needs someone
+    above you, and a class alone in the queue must keep pure EDF order
+    — an aged undeadlined request must not cut ahead of a
+    deadline-imminent peer of its own class.  Real priority is
+    untouched; only lot-formation order changes."""
+    pr = req.priority
+    if aging_s and max_priority is not None and pr < max_priority:
+        pr += int((now - req.enqueue_t) / aging_s)
+    return (-pr,
             req.deadline_t if req.deadline_t is not None else float('inf'),
             req.enqueue_t)
 
@@ -158,19 +173,42 @@ class MicroBatcher(object):
     request a global minimum would have admitted toward certain
     deadline death — and keeps the cheap request the slow signature's
     wall would have doomed.  Takes precedence over
-    ``service_estimate_fn`` when both are given."""
+    ``service_estimate_fn`` when both are given.
+
+    ``priority_aging_s``: optional seconds — the starvation escape
+    hatch (ISSUE 11 satellite).  Strict priority-first lot formation
+    starves a saturated-out low class FOREVER; with aging set, every
+    full window a request has waited raises its EFFECTIVE class by one
+    for scheduling only, so a starving request eventually outranks
+    fresh high-priority arrivals.  Promotion engages only for requests
+    BELOW the highest pending real class (cross-class starvation is
+    the target; within one class pure EDF order holds).  None
+    (default) keeps strict priority; EDF scheduling only."""
 
     def __init__(self, max_batch_size=32, max_wait_s=0.005,
                  scheduling='edf', on_shed=None,
-                 service_estimate_fn=None, service_estimate_for=None):
+                 service_estimate_fn=None, service_estimate_for=None,
+                 priority_aging_s=None):
         if int(max_batch_size) < 1:
             raise ValueError('max_batch_size must be >= 1')
         if scheduling not in ('edf', 'fifo'):
             raise ValueError("scheduling must be 'edf' or 'fifo', got %r"
                              % (scheduling, ))
+        if priority_aging_s is not None and float(priority_aging_s) <= 0:
+            raise ValueError('priority_aging_s must be > 0 (or None for '
+                             'strict priority)')
+        if priority_aging_s is not None and scheduling == 'fifo':
+            # mirror ServingConfig's contradiction check: fifo never
+            # sorts, so a silently-ignored aging window would read as
+            # starvation relief that is not actually active
+            raise ValueError("priority_aging_s only applies to 'edf' "
+                             "scheduling — drop scheduling='fifo', or "
+                             'drop the aging window')
         self.max_batch_size = int(max_batch_size)
         self.max_wait_s = float(max_wait_s)
         self.scheduling = scheduling
+        self.priority_aging_s = (float(priority_aging_s)
+                                 if priority_aging_s is not None else None)
         self._on_shed = on_shed
         self._service_estimate_fn = service_estimate_fn
         self._service_estimate_for = service_estimate_for
@@ -297,8 +335,15 @@ class MicroBatcher(object):
                     for r in self._pending):
             # only pay the sort when something actually carries an SLO:
             # for plain traffic _sched_key is a constant prefix plus
-            # enqueue_t, i.e. exactly arrival order
-            order = sorted(self._pending, key=_sched_key)
+            # enqueue_t, i.e. exactly arrival order.  Aging promotes
+            # only BELOW the highest pending real class, so a class
+            # alone in the queue keeps pure EDF/arrival order.
+            now = time.time()
+            maxp = max(r.priority for r in self._pending)
+            order = sorted(
+                self._pending,
+                key=lambda r: _sched_key(r, now, self.priority_aging_s,
+                                         maxp))
         else:
             order = list(self._pending)
         head = order[0]

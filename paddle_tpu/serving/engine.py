@@ -135,6 +135,18 @@ class ServingConfig(object):
         FIFO order, so the default changes nothing for pre-SLO
         callers.  'fifo' restores strict arrival order with no
         shedding — the baseline side of the ``slo`` perf gate.
+    priority_aging_ms: starvation escape hatch for strict priority
+        (ISSUE 11 satellite; ROADMAP item 5 leftover).  Under EDF a
+        saturated high-priority stream starves a low class FOREVER;
+        with aging set, each full window a request has waited promotes
+        its EFFECTIVE class by one at lot formation (a request aging
+        ``k`` windows competes as ``priority + k``), so starving
+        low-priority work eventually outranks fresh high-priority
+        arrivals.  Promotion engages only BELOW the highest pending
+        real class — a class alone in the queue keeps pure EDF order
+        (aging never cuts an undeadlined request ahead of a
+        deadline-imminent peer of its own class).  None (default)
+        keeps strict priority.
     admit_queue_depth / admit_queue_age_ms: per-model admission
         watermarks the ModelRegistry enforces at ROUTING time — a
         request routed while the engine's queue is at least this deep
@@ -153,7 +165,8 @@ class ServingConfig(object):
                  max_trailing_buckets=32, watchdog_stall_s=None,
                  decode_slots=8, decode_steps=4, decode_pipeline_depth=2,
                  scheduling='edf', admit_queue_depth=None,
-                 admit_queue_age_ms=None, adaptive_admission=False):
+                 admit_queue_age_ms=None, adaptive_admission=False,
+                 priority_aging_ms=None):
         if int(steps_per_dispatch) < 1:
             raise ValueError('steps_per_dispatch must be >= 1')
         if int(pipeline_depth) < 1:
@@ -197,6 +210,16 @@ class ServingConfig(object):
                 "ServingConfig: scheduling must be 'edf' or 'fifo', "
                 'got %r' % (scheduling, ))
         self.scheduling = scheduling
+        if priority_aging_ms is not None and float(priority_aging_ms) <= 0:
+            raise ValueError('priority_aging_ms must be > 0 (or None '
+                             'for strict priority)')
+        if priority_aging_ms is not None and scheduling == 'fifo':
+            raise ValueError(
+                'ServingConfig: priority_aging_ms only applies to EDF '
+                "scheduling — drop scheduling='fifo', or drop the aging "
+                'window')
+        self.priority_aging_s = (float(priority_aging_ms) / 1e3
+                                 if priority_aging_ms is not None else None)
         if admit_queue_depth is not None and int(admit_queue_depth) < 1:
             raise ValueError('admit_queue_depth must be >= 1 (or None '
                              'to disable the depth watermark)')
@@ -322,7 +345,8 @@ class InferenceEngine(object):
             scheduling=self.config.scheduling,
             on_shed=lambda req: (ref0() and ref0()._shed_request(req)),
             service_estimate_for=lambda req: (
-                ref0()._service_estimate(req) if ref0() else 0.0))
+                ref0()._service_estimate(req) if ref0() else 0.0),
+            priority_aging_s=self.config.priority_aging_s)
         # arrival vs drain rates (ISSUE 9): the adaptive admission
         # watermarks' inputs — noted at submit and at delivery
         self._arrivals = RateWindow()
@@ -609,6 +633,66 @@ class InferenceEngine(object):
                     moved += int(arr.nbytes)
             dropped = self.drop_executables()
         return moved, dropped
+
+    @staticmethod
+    def _shard_nbytes(v):
+        """ONE device's byte share of a live jax.Array — the shard
+        shape when the sharding exposes it, the whole array otherwise
+        (replicated arrays' shard IS the whole array).  The single
+        per-device-bytes rule shared by ``hbm_footprint`` and
+        ``table_live_bytes`` so arbiter billing and the footprint
+        correction can never disagree."""
+        try:
+            shard = v.sharding.shard_shape(v.shape)
+            return int(np.prod(shard)) * int(v.dtype.itemsize)
+        except Exception:
+            return int(v.nbytes)
+
+    def hbm_footprint(self):
+        """PER-DEVICE live HBM bytes attributable to this engine's
+        scope (ISSUE 11): like ``device_footprint()`` but shard-aware —
+        a mesh-row-sharded array (an 'mp' embedding table, a trainer
+        scope's co-sharded moments) bills only ONE device's shard
+        bytes, because the arbiter's budget is one chip's HBM.
+        Replicated arrays (the plain dp case) are unchanged: their
+        shard is the whole array, so this equals device_footprint()."""
+        import jax
+        total = 0
+        for name in self._scope.local_var_names():
+            v = self._scope.find_var(name).value()
+            if isinstance(v, jax.Array):
+                total += self._shard_nbytes(v)
+        return total
+
+    def table_live_bytes(self, var_name):
+        """(global_bytes, per_device_bytes) of a mesh-row-sharded
+        table's LIVE device array (ISSUE 11) — the arbiter bills the
+        table's own account in per-device units (one chip holds only
+        its shard), while ``device_footprint`` counts global bytes.
+        (0, 0) when the var is host-resident or missing."""
+        import jax
+        var = self._scope.find_var(var_name)
+        v = var.value() if var is not None else None
+        if not isinstance(v, jax.Array):
+            return 0, 0
+        return int(v.nbytes), self._shard_nbytes(v)
+
+    def evict_table_to_host(self, var_name):
+        """Demote ONE mesh-row-sharded embedding table to host under a
+        paused window (ISSUE 11; the arbiter's ``:embed-table`` evict
+        callback): the shards copy back to a single bitwise host
+        ndarray, and the next dispatch re-stages it sharded through the
+        normal path.  Returns the PER-DEVICE bytes freed — the unit the
+        table's account is charged in."""
+        import jax
+        with self.paused():
+            var = self._scope.find_var(var_name)
+            v = var.value() if var is not None else None
+            if not isinstance(v, jax.Array):
+                return 0
+            _, per_dev = self.table_live_bytes(var_name)
+            var.set_value(np.asarray(v))
+        return per_dev
 
     @contextlib.contextmanager
     def _gated(self):

@@ -81,10 +81,54 @@ WARM_CATALOG_BASENAME = 'serving_warm_catalog.json'
 # can never fit the budget
 DECODE_CACHE_SUFFIX = ':decode-cache'
 
+# a MESH-ROW-SHARDED embedding table's arbiter account rides next to
+# its model's weight account under this suffix (ISSUE 11):
+# `<model>:embed-table:<var>` — charged at the table's PER-DEVICE shard
+# bytes (the budget is one chip's HBM; GSPMD lays only 1/extent of the
+# rows on each device), so a table bigger than a single device's budget
+# is admitted SHARDED while the same table unsharded stays inside the
+# model's own full-size seed and draws the typed HBMBudgetError at load
+EMBED_TABLE_SUFFIX = ':embed-table'
+
+
+def _row_sharded_tables(engine):
+    """``{var_name: (global_bytes, per_device_bytes)}`` for every
+    persistable >=2-D var of the engine's program whose sharding
+    annotation row-shards it over a REAL mesh axis of the engine's own
+    mesh.  Empty for single-device engines: an unsharded table lives
+    whole on the one chip and stays inside the model's seed/footprint
+    account."""
+    pe = engine._pe
+    if pe is None:
+        return {}
+    from ..parallel.api import sharding_of
+    mesh_axes = dict(zip(pe._mesh.axis_names, pe._mesh.devices.shape))
+    out = {}
+    for var in engine._program.global_block().vars.values():
+        if not getattr(var, 'persistable', False):
+            continue
+        shape = tuple(var.shape or ())
+        if len(shape) < 2 or any(d is None or int(d) <= 0 for d in shape):
+            continue
+        spec = sharding_of(var)
+        if spec is None or not len(spec) or spec[0] is None:
+            continue
+        axes = spec[0] if isinstance(spec[0], tuple) else (spec[0], )
+        factor = 1
+        for ax in axes:
+            factor *= int(mesh_axes.get(ax, 1))
+        if factor <= 1:
+            continue
+        itemsize = np.dtype(var.np_dtype).itemsize
+        gbytes = int(np.prod([int(d) for d in shape])) * int(itemsize)
+        out[var.name] = (gbytes, -(-gbytes // factor))
+    return out
+
 
 class _ModelEntry(object):
     __slots__ = ('name', 'engine', 'dirname', 'loaded_t', 'requests',
-                 'rows', 'first_req_t', 'last_req_t', 'overload_rejects')
+                 'rows', 'first_req_t', 'last_req_t', 'overload_rejects',
+                 'table_accounts')
 
     def __init__(self, name, engine, dirname):
         self.name = name
@@ -96,6 +140,9 @@ class _ModelEntry(object):
         self.first_req_t = None
         self.last_req_t = None
         self.overload_rejects = 0
+        # {account_name: table var name} for mesh-row-sharded embedding
+        # tables (ISSUE 11) — per-device-charged sibling accounts
+        self.table_accounts = {}
 
 
 class ModelRegistry(object):
@@ -144,10 +191,12 @@ class ModelRegistry(object):
         params).  Admission-checked against the HBM budget BEFORE any
         device work: a model that can never fit raises HBMBudgetError
         with nothing loaded."""
-        if not name or '/' in str(name):
+        if not name or '/' in str(name) or ':' in str(name):
             raise ValueError(
-                'model name must be a non-empty string without "/" '
-                '(it keys metrics sources and timeline rows), got %r'
+                'model name must be a non-empty string without "/" or '
+                '":" (it keys metrics sources, timeline rows, and the '
+                'arbiter account namespace — ":decode-cache" / '
+                '":embed-table:" suffixes route eviction), got %r'
                 % (name, ))
         with self._lock:
             if self._closed:
@@ -187,13 +236,40 @@ class ModelRegistry(object):
             else:
                 raise ValueError('load(): pass dirname= or program=')
             cache_account = name + DECODE_CACHE_SUFFIX
+            tables = _row_sharded_tables(engine)
+            table_accounts = {
+                '%s%s:%s' % (name, EMBED_TABLE_SUFFIX, var): var
+                for var in tables
+            }
             try:
+                for var in tables:
+                    # a pre-staged table (startup ran on the DEFAULT
+                    # device, or a trainer's scope is being served
+                    # directly) sits in the scope as one whole-table
+                    # device array — the first routing correction would
+                    # bill the model account its full GLOBAL bytes and
+                    # reject a budget sized for the sharded layout.
+                    # Demote it once; the first sharded dispatch lays
+                    # it out over the mesh bitwise.
+                    engine.evict_table_to_host(var)
                 # admission gate: seed the account from the program's
                 # var-sum estimate at the TOP bucket size (weights +
                 # the largest lot's activations the executables pin)
                 seed = program_seed_bytes(engine._program,
                                           max(engine.buckets.sizes))
+                if tables:
+                    # mesh-row-sharded tables (ISSUE 11) move out of
+                    # the model's full-size seed into their own
+                    # PER-DEVICE-charged accounts: only 1/extent of the
+                    # rows lands on any one chip, so a table bigger
+                    # than the whole budget still admits sharded —
+                    # while the same table unsharded stays in the seed
+                    # and draws the typed reject below
+                    seed = max(
+                        seed - sum(g for g, _ in tables.values()), 1024)
                 self.arbiter.admit(name, seed)
+                for acct, var in table_accounts.items():
+                    self.arbiter.admit(acct, tables[var][1])
                 if engine._decode_cache is not None:
                     # the decode-state cache is a FIRST-CLASS account:
                     # its slab bytes are exact (static slot shapes), and
@@ -204,10 +280,13 @@ class ModelRegistry(object):
                         engine.generation.cache_nbytes(
                             engine._decode_cache.slots))
                 entry = _ModelEntry(name, engine, dirname)
+                entry.table_accounts = table_accounts
                 self._models[name] = entry
                 # make room NOW (evicting LRU peers), so the first
                 # request pays staging, not arbitration
                 self.arbiter.ensure(name, self._evict_to_host)
+                for acct in table_accounts:
+                    self.arbiter.ensure(acct, self._evict_to_host)
                 if engine._decode_cache is not None:
                     self.arbiter.ensure(cache_account,
                                         self._evict_to_host)
@@ -218,6 +297,8 @@ class ModelRegistry(object):
                 # would outlive the failed load
                 self.arbiter.drop(name)
                 self.arbiter.drop(cache_account)
+                for acct in table_accounts:
+                    self.arbiter.drop(acct)
                 self._models.pop(name, None)
                 engine.stop()
                 raise
@@ -235,6 +316,8 @@ class ModelRegistry(object):
                 raise KeyError('model %r is not loaded' % name)
             self.arbiter.drop(name)
             self.arbiter.drop(name + DECODE_CACHE_SUFFIX)
+            for acct in entry.table_accounts:
+                self.arbiter.drop(acct)
         entry.engine.stop()
 
     def warm(self, name, bucket_ladder=None, trailing=None,
@@ -565,6 +648,13 @@ class ModelRegistry(object):
         if victim.endswith(DECODE_CACHE_SUFFIX):
             owner = victim[:-len(DECODE_CACHE_SUFFIX)]
             return self._models[owner].engine.evict_decode_cache()
+        if EMBED_TABLE_SUFFIX + ':' in victim:
+            # a sharded embedding table demotes on its OWN (ISSUE 11):
+            # the var's mesh shards copy back to one host ndarray under
+            # the owner's paused window; the moved bytes are the
+            # PER-DEVICE share — the unit its account is charged in
+            owner, _, var = victim.partition(EMBED_TABLE_SUFFIX + ':')
+            return self._models[owner].engine.evict_table_to_host(var)
         entry = self._models[victim]
         moved, _ = entry.engine.evict_to_host()
         return moved
@@ -585,8 +675,23 @@ class ModelRegistry(object):
         an eviction."""
         with self._lock:
             entry = self._entry(name)
-            self.arbiter.correct(name, entry.engine.device_footprint())
+            if entry.table_accounts:
+                # sharded-table engines bill the model account at the
+                # shard-aware PER-DEVICE footprint (the budget is one
+                # chip's HBM — a trainer scope's co-sharded moments
+                # must not bill global bytes), with each table's own
+                # per-device share moved onto its account below
+                footprint = entry.engine.hbm_footprint()
+            else:
+                footprint = entry.engine.device_footprint()
+            for acct, var in entry.table_accounts.items():
+                _, per_dev = entry.engine.table_live_bytes(var)
+                footprint = max(footprint - per_dev, 0)
+                self.arbiter.correct(acct, per_dev)
+            self.arbiter.correct(name, footprint)
             self.arbiter.ensure(name, self._evict_to_host)
+            for acct in entry.table_accounts:
+                self.arbiter.ensure(acct, self._evict_to_host)
             if decode:
                 cache = name + DECODE_CACHE_SUFFIX
                 self.arbiter.correct(

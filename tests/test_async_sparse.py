@@ -4,9 +4,11 @@ synchronous dense step, barrier-free gradient push applied by a
 background thread (listen_and_serv RunAsyncLoop analog)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu.fluid as fluid
-from paddle_tpu.distributed import AsyncSparseEmbedding
+from paddle_tpu.distributed import AsyncSparseEmbedding, \
+    AsyncSparseClosedError
 
 VOCAB, DIM, B = 100, 8, 16
 
@@ -104,3 +106,52 @@ def test_concurrent_pushers_no_lost_updates():
     total = -table.sum()
     assert abs(total - 2 * n_per * 4 * DIM) < 1e-3, total
     svc.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 11 satellite: lifecycle hardening — close() drains, push-after-
+# close is a typed error, close is idempotent
+# ---------------------------------------------------------------------------
+
+def test_close_drains_pending_queue():
+    """Every update pushed BEFORE close() must be applied by the time
+    close() returns — a shutdown must never drop queued gradients."""
+    svc = AsyncSparseEmbedding(VOCAB, DIM, lr=1.0, seed=5, init_scale=0.0)
+    rng = np.random.RandomState(0)
+    n = 40
+    for _ in range(n):
+        svc.push_grad(rng.randint(0, VOCAB, size=(4, )),
+                      np.ones((4, DIM), 'float32'))
+    svc.close()
+    stats = svc.stats
+    assert stats['pushed'] == n and stats['applied'] == n, stats
+    assert stats['queued'] == 0
+    # post-close READS stay valid and must not hang: drain() joins a
+    # queue whose shutdown sentinel was task_done'd too, and table()
+    # ('drains first') returns the final snapshot
+    svc.drain()
+    total = -svc.table().sum()
+    assert abs(total - n * 4 * DIM) < 1e-3, total
+
+
+def test_push_after_close_raises_typed():
+    """push_grad on a closed service raises AsyncSparseClosedError
+    instead of silently enqueueing to a dead daemon."""
+    svc = AsyncSparseEmbedding(VOCAB, DIM, seed=6)
+    svc.push_grad([1, 2], np.ones((2, DIM), 'float32'))
+    svc.close()
+    assert svc.closed
+    with pytest.raises(AsyncSparseClosedError):
+        svc.push_grad([3], np.ones((1, DIM), 'float32'))
+    # the rejected push never counted
+    assert svc.stats['pushed'] == 1
+    # reads of the final table remain valid after close
+    assert svc.prefetch([1]).shape == (1, DIM)
+
+
+def test_close_is_idempotent():
+    svc = AsyncSparseEmbedding(VOCAB, DIM, seed=7)
+    svc.close()
+    svc.close()  # second close must not hang on the dead daemon
+    with pytest.raises(AsyncSparseClosedError):
+        svc.push_grad([0], np.ones((1, DIM), 'float32'))

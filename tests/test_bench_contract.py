@@ -33,8 +33,11 @@ def _run_bench(env_extra, timeout):
 def test_every_config_flushes_and_timeouts_are_isolated():
     """Tiny budgets -> every child is killed mid-startup, yet the parent
     emits one contract line per config plus the final line, writes the
-    partial file, and exits on its own (no external timeout needed)."""
-    proc = _run_bench({'BENCH_BUDGET': '3', 'BENCH_FORCE_CPU': '1'}, 120)
+    partial file, and exits on its own (no external timeout needed).
+    The budget must undercut even the interpreter + jax import (~2s):
+    the ctr CPU smoke (ISSUE 11) is light enough to FINISH inside the
+    old 3s budget on a warm page cache."""
+    proc = _run_bench({'BENCH_BUDGET': '1', 'BENCH_FORCE_CPU': '1'}, 120)
     lines = [json.loads(l) for l in proc.stdout.decode().splitlines() if l]
     # N-1 incremental lines + 1 final (the last config's completion IS
     # the final record — no duplicate emission)
@@ -338,6 +341,62 @@ def test_nmt_cpu_smoke_is_device_true():
     assert dec['host_syncs_per_token'] is not None
     assert dec['host_syncs_per_token'] * dec['tokens'] <= \
         dec['decode_dispatches']
+
+
+def test_ctr_config_wired_sharded_sparse():
+    """ISSUE 11 structural pins (no jax in this test): the ctr config
+    is registered + budgeted, trains through ParallelExecutor.run_multi
+    over a {dp, mp} mesh with the table row-sharded via the
+    DistributeTranspiler sparse pass, reports the sparse lane's
+    bytes-avoided, and its serving block loads the trained program into
+    a ModelRegistry with the per-device embed-table account + the
+    sharded-vs-unsharded HBMBudgetError counterfactual."""
+    import inspect
+    from bench import CONFIGS, BUDGETS, bench_ctr, _ctr_serving_block, \
+        _ctr_serving_rec
+    assert 'ctr' in CONFIGS and 'ctr' in BUDGETS
+    src = inspect.getsource(bench_ctr)
+    for pin in ('run_multi', 'DistributeTranspiler', "sparse_shard_axis",
+                'is_sparse=True', 'zipf',
+                "'sparse_grad_bytes_avoided_per_step'",
+                "'embedding_rows_per_sec'", 'is_fully_replicated'):
+        assert pin in src, pin
+    ssrc = inspect.getsource(_ctr_serving_block) \
+        + inspect.getsource(_ctr_serving_rec)
+    for pin in ('ModelRegistry', 'EMBED_TABLE_SUFFIX', 'HBMBudgetError',
+                "'rows_per_sec'", 'hbm_budget_bytes'):
+        assert pin in ssrc, pin
+    # the CPU smoke forces the 8-dev virtual mesh before jax loads
+    import bench
+    assert '--xla_force_host_platform_device_count=8' in \
+        inspect.getsource(bench.run_one)
+
+
+def test_ctr_cpu_smoke_trains_and_serves():
+    """The ISSUE 11 acceptance, functionally in-process on the suite's
+    8-dev virtual mesh: bench_ctr trains device-true with a row-sharded
+    table (sparse lane end to end), serves id-batches through the
+    registry, carries the per-device table account, and the unsharded
+    counterfactual draws the typed HBMBudgetError."""
+    import bench
+    rec = bench.bench_ctr(on_tpu=False)
+    assert rec['value'] > 0 and rec['device_true'] is True
+    assert rec['steps_per_dispatch'] >= 2
+    assert rec['mesh']['mp'] >= 2 and rec['mesh']['dp'] >= 2
+    assert rec['table_row_sharded'] is True
+    assert rec['sparse_grad_bytes_avoided_per_step'] > 0
+    assert rec['embedding_rows_per_sec'] > 0
+    assert rec['cost'] is None or rec['cost']['flops_per_step'] > 0
+    srv = rec['serving']
+    assert srv['rows'] > 0 and srv['rows_per_sec'] > 0
+    assert srv['unsharded_rejected_typed'] is True
+    accounts = srv['table_accounts']
+    assert accounts, 'the sharded table must carry its own account'
+    (acct, ), = [list(accounts)]
+    assert ':embed-table:' in acct
+    # charged at the PER-DEVICE shard, not the global table
+    assert accounts[acct]['bytes'] < srv['table_bytes']
+    assert accounts[acct]['resident'] is True
 
 
 def test_no_tmp_sidecars_in_repo_root():

@@ -236,6 +236,141 @@ def test_budget_accounting_matches_live_buffer_stats(model_dirs,
     reg.stop()
 
 
+def _ctr_sharded_setup(vocab=4096, embed=16, budget_frac=True):
+    """A small CTR model with its table row-sharded over 'mp' on the
+    8-dev virtual mesh, plus a budget strictly between the per-device
+    sharded layout and the full unsharded table — the ISSUE 11
+    admission scenario."""
+    import jax
+    from paddle_tpu import parallel
+    from paddle_tpu.models import ctr as ctr_model
+    mesh = parallel.make_mesh({'dp': 4, 'mp': 2}, jax.devices()[:8])
+    with fluid.unique_name.guard():
+        # SGD: no [V, E] Adam moments in the shared scope — the
+        # admission arithmetic below sizes the budget around ONE table
+        m = ctr_model.build(sparse_dim=vocab, embed_size=embed,
+                            hidden_sizes=(32, 16), is_sparse=True,
+                            optimizer=fluid.optimizer.SGD(
+                                learning_rate=0.05))
+    parallel.shard(m['test'].global_block().var('ctr_embedding'),
+                   'mp', None)
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(m['startup'])
+    table_bytes = vocab * embed * 4
+    seed = program_seed_bytes(m['test'], 64)
+    budget = int(seed - table_bytes + table_bytes // 2
+                 + table_bytes // 4) if budget_frac else None
+    return m, scope, mesh, table_bytes, budget
+
+
+def _ctr_batch(rng, vocab, rows=16):
+    return {'dense': rng.rand(rows, 13).astype('float32'),
+            'sparse_ids': rng.randint(0, vocab, (rows, 26))
+            .astype('int64'),
+            'label': np.zeros((rows, 1), 'int64')}
+
+
+def test_sharded_table_admits_past_per_device_budget():
+    """The ISSUE 11 acceptance: a table sized past a single device's
+    arbiter budget is admitted SHARDED (its account charged at the
+    per-device shard bytes) but the identical unsharded program draws
+    the typed HBMBudgetError — and the sharded model really serves."""
+    from paddle_tpu.serving.registry import EMBED_TABLE_SUFFIX
+    m, scope, mesh, table_bytes, budget = _ctr_sharded_setup()
+    cfg = serving.ServingConfig(max_batch_size=64, max_wait_ms=2)
+    reg = serving.ModelRegistry(mesh=mesh, hbm_budget_bytes=budget,
+                                config=cfg)
+    try:
+        reg.load('ctr', program=m['test'], feed_names=m['feeds'],
+                 fetch_list=[m['prediction']], scope=scope)
+        acct = 'ctr%s:ctr_embedding' % EMBED_TABLE_SUFFIX
+        snap = reg.arbiter.snapshot()
+        assert acct in snap['accounts'], snap['accounts']
+        # seeded at the PER-DEVICE share (mp=2): half the global table
+        assert snap['accounts'][acct]['bytes'] == -(-table_bytes // 2)
+        rng = np.random.RandomState(0)
+        out, = reg.infer('ctr', _ctr_batch(rng, 4096), timeout=600)
+        assert np.isfinite(np.asarray(out)).all()
+        # the SECOND routed request's correction sees the staged
+        # sharded layout: the account tracks LIVE per-device bytes and
+        # stays under the global table size
+        reg.infer('ctr', _ctr_batch(rng, 4096), timeout=600)
+        snap = reg.arbiter.snapshot()
+        assert snap['accounts'][acct]['source'] == 'live'
+        assert snap['accounts'][acct]['bytes'] < table_bytes
+    finally:
+        reg.stop()
+    # the unsharded counterfactual under the SAME budget: typed reject
+    with fluid.unique_name.guard():
+        from paddle_tpu.models import ctr as ctr_model
+        plain = ctr_model.build(sparse_dim=4096, embed_size=16,
+                                hidden_sizes=(32, 16), is_sparse=True,
+                                optimizer=fluid.optimizer.SGD(
+                                    learning_rate=0.05))
+    scope2 = fluid.core.Scope()
+    with fluid.scope_guard(scope2):
+        fluid.Executor(fluid.CPUPlace()).run(plain['startup'])
+    reg2 = serving.ModelRegistry(hbm_budget_bytes=budget, config=cfg)
+    try:
+        with pytest.raises(serving.HBMBudgetError):
+            reg2.load('ctr', program=plain['test'],
+                      feed_names=plain['feeds'],
+                      fetch_list=[plain['prediction']], scope=scope2)
+        assert 'ctr' not in reg2.status()['models']
+    finally:
+        reg2.stop()
+
+
+def test_sharded_table_account_evicts_and_restages():
+    """The table account demotes on its OWN (the shards copy back to
+    one host ndarray; the model keeps serving by transparently
+    re-staging), and unload drops every table account."""
+    import jax
+    from paddle_tpu.serving.registry import EMBED_TABLE_SUFFIX
+    m, scope, mesh, table_bytes, _ = _ctr_sharded_setup(
+        budget_frac=False)
+    reg = serving.ModelRegistry(
+        mesh=mesh,
+        config=serving.ServingConfig(max_batch_size=64, max_wait_ms=2))
+    acct = 'ctr%s:ctr_embedding' % EMBED_TABLE_SUFFIX
+    try:
+        reg.load('ctr', program=m['test'], feed_names=m['feeds'],
+                 fetch_list=[m['prediction']], scope=scope)
+        rng = np.random.RandomState(1)
+        feed = _ctr_batch(rng, 4096)
+        base, = reg.infer('ctr', feed, timeout=600)
+        # demote just the table: the var leaves the device bitwise
+        moved = reg.arbiter.evict(acct, reg._evict_to_host)
+        assert moved > 0
+        v = scope.find_var('ctr_embedding').value()
+        assert not isinstance(v, jax.Array)
+        assert not reg.arbiter.is_resident(acct)
+        # the next routed request re-stages the table transparently and
+        # answers bitwise-identically
+        again, = reg.infer('ctr', feed, timeout=600)
+        np.testing.assert_array_equal(np.asarray(base),
+                                      np.asarray(again))
+        assert reg.arbiter.is_resident(acct)
+        reg.unload('ctr')
+        assert acct not in reg.arbiter.snapshot()['accounts']
+    finally:
+        reg.stop()
+
+
+def test_model_name_colon_rejected():
+    """':' is the arbiter account-suffix namespace (':decode-cache',
+    ':embed-table:'): a model named into it would misroute eviction,
+    so load() rejects it typed, like '/'."""
+    reg = serving.ModelRegistry()
+    try:
+        with pytest.raises(ValueError):
+            reg.load('a:embed-table:b', program=fluid.Program(),
+                     fetch_list=[])
+    finally:
+        reg.stop()
+
+
 def test_arbiter_lru_policy_and_set_budget():
     """Unit: LRU victim selection, reload counting, budget re-pointing."""
     arb = HBMArbiter(budget_bytes=100)

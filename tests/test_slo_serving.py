@@ -46,6 +46,100 @@ def test_edf_orders_priority_then_deadline():
     assert lot == [r_soon_dl, r_late_dl, r_low_dl, r_plain]
 
 
+def test_priority_aging_promotes_starving_request():
+    """The starvation escape hatch (ISSUE 11 satellite; ROADMAP item 5
+    leftover): a low-priority request that has waited k full aging
+    windows competes as priority + k at lot formation, so it eventually
+    outranks FRESH high-priority arrivals — while WITHOUT the knob
+    strict priority starves it forever."""
+    aged = _req(priority=0)
+    aged.enqueue_t -= 1.0  # has starved ~10 aging windows
+    fresh = _req(priority=2)
+
+    mb = serving.MicroBatcher(max_batch_size=1, scheduling='edf',
+                              priority_aging_s=0.1)
+    mb.submit(fresh)
+    mb.submit(aged)
+    lot = mb.next_lot(force=True)
+    assert lot == [aged], 'the aged request must head the lot'
+    assert mb.next_lot(force=True) == [fresh]
+    # real priority is untouched — only the scheduling order moved
+    assert aged.priority == 0
+
+    # the counterfactual: strict priority (no aging) starves it
+    aged2 = _req(priority=0)
+    aged2.enqueue_t -= 1.0
+    fresh2 = _req(priority=2)
+    mb2 = serving.MicroBatcher(max_batch_size=1, scheduling='edf')
+    mb2.submit(fresh2)
+    mb2.submit(aged2)
+    assert mb2.next_lot(force=True) == [fresh2]
+
+
+def test_priority_aging_never_inverts_edf_within_a_class():
+    """Aging targets CROSS-class starvation only: a class alone in the
+    queue keeps pure EDF order — an aged undeadlined request must not
+    cut ahead of a deadline-imminent peer of its own class (promotion
+    engages only below the highest pending real class)."""
+    aged = _req(priority=0)            # undeadlined, waited many windows
+    aged.enqueue_t -= 1.0
+    urgent = _req(priority=0, deadline_ms=5000)
+    mb = serving.MicroBatcher(max_batch_size=1, scheduling='edf',
+                              priority_aging_s=0.1)
+    mb.submit(aged)
+    mb.submit(urgent)
+    assert mb.next_lot(force=True) == [urgent], \
+        'EDF within the class must hold when nothing outranks it'
+
+
+def test_priority_aging_rejects_fifo_contradiction():
+    """MicroBatcher mirrors ServingConfig: fifo never sorts, so a
+    silently-ignored aging window is a typed error, not a no-op."""
+    with pytest.raises(ValueError):
+        serving.MicroBatcher(scheduling='fifo', priority_aging_s=1.0)
+
+
+def test_priority_aging_below_window_keeps_strict_priority():
+    """Inside the first aging window nothing is promoted: fresh
+    high-priority traffic schedules first exactly as before."""
+    low = _req(priority=0)
+    high = _req(priority=1)
+    mb = serving.MicroBatcher(max_batch_size=1, scheduling='edf',
+                              priority_aging_s=30.0)
+    mb.submit(low)
+    mb.submit(high)
+    assert mb.next_lot(force=True) == [high]
+
+
+def test_priority_aging_config_plumbs_and_validates():
+    """ServingConfig(priority_aging_ms=) reaches the engine's batcher;
+    non-positive windows and the fifo contradiction are typed errors."""
+    cfg = serving.ServingConfig(priority_aging_ms=250.0)
+    assert cfg.priority_aging_s == 0.25
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        y = fluid.layers.fc(x, size=2)
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+    eng = serving.InferenceEngine(
+        prog.clone(for_test=True), feed_names=['x'], fetch_list=[y],
+        scope=scope, config=cfg)
+    try:
+        assert eng._batcher.priority_aging_s == 0.25
+    finally:
+        eng.stop()
+    with pytest.raises(ValueError):
+        serving.ServingConfig(priority_aging_ms=0)
+    with pytest.raises(ValueError):
+        serving.ServingConfig(priority_aging_ms=-5)
+    with pytest.raises(ValueError):
+        serving.ServingConfig(scheduling='fifo', priority_aging_ms=100)
+    with pytest.raises(ValueError):
+        serving.MicroBatcher(priority_aging_s=0)
+
+
 def test_edf_degrades_to_fifo_without_slo_fields():
     """No priorities, no deadlines: EDF is arrival order exactly."""
     mb = serving.MicroBatcher(max_batch_size=8, scheduling='edf')

@@ -62,6 +62,28 @@ def _build_generation(seed, max_len=8):
     return m, serving.GenerationSpec.from_model(m), scope
 
 
+def _build_ctr(seed, vocab):
+    """The zipfian-id CTR traffic target (ISSUE 11): a small wide&deep
+    CTR inference program (models/ctr) + its scope.  Requests are
+    skewed id-batches — zipf mass on a few hot rows, a long tail — the
+    sparse-embedding serving shape; the report's ``ctr`` block carries
+    rows/s over the offered window."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import ctr as ctr_model
+    with fluid.unique_name.guard():
+        m = ctr_model.build(sparse_dim=vocab, embed_size=16,
+                            hidden_sizes=(32, 16), is_sparse=True)
+    m['main'].random_seed = seed
+    m['startup'].random_seed = seed
+    place = (fluid.TPUPlace() if fluid.core.is_compiled_with_tpu()
+             else fluid.CPUPlace())
+    exe = fluid.Executor(place)
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(m['startup'])
+    return m, scope
+
+
 def _build_synthetic(seed, dim=16, classes=64):
     """One tiny dense scorer program (f32, softmax head) + its scope —
     the same padding-neutral shape the serving perf gates use."""
@@ -105,6 +127,13 @@ def main(argv=None):
                         'host-syncs-per-token)')
     p.add_argument('--gen-max-len', type=int, default=8,
                    help='generation budget per generate request')
+    p.add_argument('--ctr-frac', type=float, default=0.0,
+                   help='fraction of traffic routed to a sparse-'
+                        'embedding CTR model as seeded ZIPFIAN '
+                        'id-batches (ISSUE 11); the report gains a '
+                        'ctr block with rows/s')
+    p.add_argument('--ctr-vocab', type=int, default=4096,
+                   help='CTR embedding vocab for --ctr-frac traffic')
     p.add_argument('--decode-depth', type=int, default=2,
                    help='decode_pipeline_depth of the generation '
                         'model (1 = per-scan-sync baseline)')
@@ -190,11 +219,29 @@ def main(argv=None):
             return {'src_word_id': fluid.create_lod_tensor(
                 rng.randint(2, 50, size=(l, 1)).tolist(), [[l]])}
 
+    ctr_names = []
+    if args.ctr_frac > 0:
+        if not (0.0 < args.ctr_frac < 1.0) or \
+                args.ctr_frac + args.generate_frac >= 1.0:
+            raise SystemExit('--ctr-frac must be in (0, 1) and leave a '
+                             'forward share with --generate-frac')
+        cm, cscope = _build_ctr(seed=args.seed + 2,
+                                vocab=args.ctr_vocab)
+        reg.load('ctr0', program=cm['test'], feed_names=cm['feeds'],
+                 fetch_list=[cm['prediction']], scope=cscope)
+        ctr_names.append('ctr0')
+
+        def ctr_feed_fn(rng, _v=args.ctr_vocab, _rows=args.rows):
+            from paddle_tpu.dataset import ctr as ctr_data
+            return ctr_data.zipf_batch(rng, _rows, _v)
+
     classes = []
     # the forward share splits across the forward models: per-model
-    # weights must sum to (1 - generate_frac) or the generate class's
-    # documented share of the offered stream dilutes as --models grows
-    fwd_weight = max(1.0 - args.generate_frac, 1e-6) / max(len(names), 1)
+    # weights must sum to (1 - generate_frac - ctr_frac) or the special
+    # classes' documented shares of the offered stream dilute as
+    # --models grows
+    fwd_weight = max(1.0 - args.generate_frac - args.ctr_frac, 1e-6) \
+        / max(len(names), 1)
     for name in names:
         if args.priority_frac > 0:
             classes.append(serving.TrafficClass(
@@ -212,6 +259,10 @@ def main(argv=None):
             gen_feed_fn, model=name, kind='generate',
             weight=args.generate_frac, max_len=args.gen_max_len,
             deadline_ms=args.deadline_ms, name=name + ':generate'))
+    for name in ctr_names:
+        classes.append(serving.TrafficClass(
+            ctr_feed_fn, model=name, weight=args.ctr_frac,
+            deadline_ms=args.deadline_ms, name=name + ':ctr'))
 
     with reg:
         # warm every model's serving signature, then measure capacity
@@ -222,12 +273,18 @@ def main(argv=None):
         for name in gen_names:
             # warm the prefill rungs + the decode-scan executable
             reg.generate(name, gen_feed_fn(rng), timeout=600)
+        for name in ctr_names:
+            reg.infer(name, ctr_feed_fn(rng), timeout=600)
         # decode baseline AFTER warmup: the report's tokens/s and
         # host-syncs-per-token must cover the offered stream only
         decode_base = {
             name: dict(reg._entry(name).engine.metrics()['decode']
                        or {})
             for name in gen_names
+        }
+        ctr_base = {
+            name: int(reg._entry(name).engine.metrics()['rows'])
+            for name in ctr_names
         }
         t0 = time.time()
         burst = [reg.submit(names[i % len(names)], feed_fn(rng))
@@ -252,9 +309,22 @@ def main(argv=None):
                 n: {k: metrics['models'][n][k]
                     for k in ('shed', 'queue_depth', 'compiles',
                               'p50_latency_ms', 'p99_latency_ms')}
-                for n in names + gen_names
+                for n in names + gen_names + ctr_names
             },
         }
+        if ctr_names:
+            # zipfian CTR traffic deliverable (ISSUE 11): embedding
+            # id-rows served per second over the measured window
+            report['ctr'] = {}
+            for name in ctr_names:
+                rows = int(reg._entry(name).engine.metrics()['rows']) \
+                    - ctr_base[name]
+                report['ctr'][name] = {
+                    'rows': rows,
+                    'rows_per_s': round(
+                        rows / max(report['elapsed_s'], 1e-9), 3),
+                    'vocab': args.ctr_vocab,
+                }
         if gen_names:
             # decode-lane deliverables (ISSUE 9): tokens/s over the
             # measured window and host-syncs-per-token — the number

@@ -11,7 +11,7 @@ max(fw)/max(bd) cross-window pairing).
 Run on TPU hardware:
     python tools/perf_gate.py [resnet|transformer|nmt|resnet_infer|
         feed_pipeline|multi_model|trailing_dim|trace_overhead|decode|
-        decode_overlap|slo|all]
+        decode_overlap|slo|sparse_grad|all]
 Prints one JSON line per config; tests/test_perf_gate.py drives it and
 skips cleanly off-TPU.  ``resnet_infer`` (ISSUE 2) has no bound side —
 its deliverable is the paired ``multi_vs_dispatch`` block: the measured
@@ -61,6 +61,22 @@ check — a mixed-shape queue whose slow signature measures 200x the
 fast one sheds the slow-signature request at lot formation while the
 old global min-wall horizon would have admitted it toward certain
 deadline death (and keeps the fast request either way).
+``sparse_grad`` (ISSUE 11) pairs the SPARSE embedding-gradient lane
+(``is_sparse=True``: the lookup backward is a SparseRows rows/values
+pytree and the optimizer applies ONE row-subset scatter-update per
+step — the dense [V, D] gradient is never built inside the jit)
+against the DENSE lane (``is_sparse=False``: scatter-add into a full
+[V, D] grad + a dense optimizer sweep) over the IDENTICAL seeded
+zipfian-id CTR stream, trained K steps per dispatch through
+Executor.run_multi on BOTH sides.  Final params are asserted
+allclose-identical first; the hard gates are ``step_time_ratio``
+(sparse wall over dense wall, best shared drift window) <=
+PERF_GATE_SPARSE_RATIO_MAX (default 1.0 — sparsity must never cost
+step time) and the STRUCTURAL assert that no [V, D]-sized gradient
+buffer appears in the sparse lane's cost report: its timed
+executable's XLA temp-buffer bytes stay BELOW one table's size while
+the dense lane's meet or exceed it (the counterfactual proving the
+probe sees the buffer).
 ``decode_overlap`` (ISSUE 9) pairs the CHAINED decode lane
 (decode_pipeline_depth >= 2: scan N+1 enqueued against scan N's
 device-resident donated output carry, token blocks harvested while
@@ -1018,6 +1034,166 @@ def run_decode_overlap():
     return rec
 
 
+def build_sparse_grad():
+    """Sparse vs dense embedding-gradient training over the IDENTICAL
+    seeded skewed (zipfian) id stream (ISSUE 11): two CTR models — one
+    ``is_sparse=True`` (SparseRows lookup backward + row-subset SGD
+    scatter-update, no [V, D] grad ever built), one ``is_sparse=False``
+    (dense scatter-add grad + full-table update) — with pinned seeds,
+    each trained K steps per dispatch via Executor.run_multi on its own
+    executor/scope under FLAGS_cost_accounting.  SGD is the paired
+    optimizer deliberately: its sparse branch is EXACT (reference
+    sgd_op.h SelectedRows), so final params must match allclose across
+    the whole run; adaptive optimizers are lazy-by-design (untouched
+    rows' moments do not decay — pinned separately in
+    tests/test_sparse.py) and would diverge legitimately."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+    from paddle_tpu.models import ctr as ctr_model
+
+    vocab = int(os.environ.get('PERF_GATE_SP_VOCAB', '20000'))
+    embed = int(os.environ.get('PERF_GATE_SP_EMBED', '32'))
+    batch = int(os.environ.get('PERF_GATE_SP_BATCH', '64'))
+    k_steps = int(os.environ.get('PERF_GATE_SP_STEPS', '8'))
+    fluid.FLAGS.cost_accounting = True
+    place = fluid.TPUPlace() if core.is_compiled_with_tpu() \
+        else fluid.CPUPlace()
+
+    from paddle_tpu.dataset import ctr as ctr_data
+    rng = np.random.RandomState(0)
+    # the skewed CTR id distribution: zipf mass on a few hot ids, a
+    # long tail — the regime the sparse lane exists for (the ONE
+    # construction shared with bench.py ctr and load_gen --ctr-frac)
+    feeds = [ctr_data.zipf_batch(rng, batch, vocab)
+             for _ in range(k_steps)]
+
+    def lane(is_sparse):
+        with fluid.unique_name.guard():
+            # both lanes name their vars identically (fc_0.w_0, ...),
+            # so the final-param parity check covers EVERY weight, not
+            # just the ParamAttr-pinned table
+            m = ctr_model.build(
+                sparse_dim=vocab, embed_size=embed, hidden_sizes=(64, 32),
+                is_sparse=is_sparse,
+                optimizer=fluid.optimizer.SGD(learning_rate=0.05))
+        m['main'].random_seed = 0
+        m['startup'].random_seed = 0
+        exe = fluid.Executor(place)
+        scope = fluid.core.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(m['startup'])
+            # warm the K-step scanned executable (static jit arg)
+            exe.run_multi(m['main'], feed_list=[dict(f) for f in feeds],
+                          fetch_list=[m['loss']])
+
+        def window():
+            with fluid.scope_guard(scope):
+                t0 = time.time()
+                lv, = exe.run_multi(m['main'],
+                                    feed_list=[dict(f) for f in feeds],
+                                    fetch_list=[m['loss']])
+                elapsed = time.time() - t0
+            assert np.isfinite(np.asarray(lv)).all()
+            return batch * k_steps / elapsed
+
+        return window, exe, scope
+
+    sparse_w, sparse_exe, sparse_scope = lane(True)
+    dense_w, dense_exe, dense_scope = lane(False)
+    ctx = {
+        'sparse_exe': sparse_exe, 'dense_exe': dense_exe,
+        'sparse_scope': sparse_scope, 'dense_scope': dense_scope,
+        'vocab': vocab, 'embed': embed, 'batch': batch,
+        'k_steps': k_steps, 'table_bytes': vocab * embed * 4,
+        'touched_rows': batch * 26,
+    }
+    return sparse_w, dense_w, ctx
+
+
+def run_sparse_grad():
+    """The sparse_grad record: interleaved sparse/dense windows over
+    the identical seeded zipfian stream (each ratio shares a drift
+    window — the gates' pairing rule).  HARD asserts (the ISSUE 11
+    acceptance): final params allclose-identical across the two lanes,
+    ``step_time_ratio`` (sparse wall over dense wall, best shared
+    window) <= PERF_GATE_SPARSE_RATIO_MAX (default 1.0), and the
+    structural no-dense-grad-buffer check — the sparse lane's timed
+    executable allocates LESS XLA temp memory than one [V, D] table
+    (the dense gradient cannot be hiding in there), while the dense
+    lane's allocates at least that much (the probe provably sees the
+    buffer it is asserting absent)."""
+    import numpy as np
+    sparse_w, dense_w, ctx = build_sparse_grad()
+    sp, de = [], []
+    for _ in range(BLOCKS):
+        sp.append(sparse_w())
+        de.append(dense_w())
+    # parity first: a fast-but-wrong sparse lane must never pass.  Both
+    # lanes ran the same warm + BLOCKS dispatches over the same feeds.
+    names = sorted(
+        n for n in ctx['sparse_scope'].local_var_names()
+        if ctx['dense_scope'].find_var(n) is not None)
+    params_checked = 0
+    for n in names:
+        a = np.asarray(ctx['sparse_scope'].find_var(n).value())
+        b = np.asarray(ctx['dense_scope'].find_var(n).value())
+        if a.dtype.kind != 'f':
+            continue
+        np.testing.assert_allclose(
+            a, b, rtol=1e-4, atol=1e-5,
+            err_msg='sparse lane diverged from dense at %r' % n)
+        params_checked += 1
+    assert params_checked > 0
+    table_bytes = ctx['table_bytes']
+    # the structural gate: the [V, D] grad buffer is a TEMP in the
+    # dense executable and must not exist in the sparse one
+    def _temp(exe):
+        entries = [e for e in exe.cost_report()
+                   if e.get('kind') == 'multi'
+                   and e.get('temp_bytes') is not None]
+        return max((e['temp_bytes'] for e in entries), default=None)
+    sparse_temp = _temp(ctx['sparse_exe'])
+    dense_temp = _temp(ctx['dense_exe'])
+    rec = {
+        'config': 'sparse_grad',
+        'sparse_rows_per_sec': round(max(sp), 1),
+        'dense_rows_per_sec': round(max(de), 1),
+        'sparse_blocks': [round(v, 1) for v in sp],
+        'dense_blocks': [round(v, 1) for v in de],
+        # the PAIRED deliverable: sparse step time over dense step time
+        # on the best shared drift window (<= 1.0 = sparsity is free or
+        # better); rows/s form alongside
+        'step_time_ratio': round(min(d / s for s, d in zip(sp, de)), 4),
+        'sparse_vs_dense': round(max(s / d for s, d in zip(sp, de)), 4),
+        'vocab': ctx['vocab'], 'embed_dim': ctx['embed'],
+        'batch': ctx['batch'], 'steps_per_dispatch': ctx['k_steps'],
+        'params_checked': params_checked,
+        # the sparse lane's per-step gradient is rows x D, not V x D
+        'grad_bytes_dense': table_bytes,
+        'grad_bytes_sparse': ctx['touched_rows'] * ctx['embed'] * 4,
+        'sparse_grad_bytes_avoided_per_step':
+            table_bytes - ctx['touched_rows'] * ctx['embed'] * 4,
+        'table_bytes': table_bytes,
+        'sparse_temp_bytes': sparse_temp,
+        'dense_temp_bytes': dense_temp,
+        'blocks': BLOCKS,
+    }
+    ratio_max = float(os.environ.get('PERF_GATE_SPARSE_RATIO_MAX', '1.0'))
+    assert rec['step_time_ratio'] <= ratio_max, rec
+    if sparse_temp is not None and dense_temp is not None:
+        # no dense [V, D] gradient buffer in the sparse lane's cost
+        # report — and the dense lane proves the probe detects one
+        assert sparse_temp < table_bytes, rec
+        assert dense_temp >= table_bytes, rec
+    else:
+        # a backend without memory analysis cannot run the structural
+        # half; the step-time + parity gates above still bind
+        rec['temp_analysis'] = 'unavailable'
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 def check_profile_shed():
     """ISSUE 9's sharpened shed contract, checked DETERMINISTICALLY
     (no model, no timing): a MicroBatcher fed the per-signature
@@ -1302,6 +1478,7 @@ CONFIGS = {
     'decode': (build_decode, 'tokens_per_sec'),
     'decode_overlap': (build_decode_overlap, 'tokens_per_sec'),
     'slo': (build_slo, 'goodput_req_s'),
+    'sparse_grad': (build_sparse_grad, 'rows_per_sec'),
 }
 
 
@@ -1320,6 +1497,8 @@ def run_config(name):
         return run_decode_overlap()
     if name == 'slo':
         return run_slo()
+    if name == 'sparse_grad':
+        return run_sparse_grad()
     build, unit = CONFIGS[name]
     # both sides compiled first, then INTERLEAVED blocks: a drift window
     # between two monolithic measurements would otherwise decide the
