@@ -19,17 +19,39 @@ SPARSE_SLOTS = 26
 SPARSE_DIM = 10000
 
 
-def zipf_batch(rng, rows, vocab=SPARSE_DIM):
+def zipf_batch(rng, rows, vocab=SPARSE_DIM, hot_frac=None):
     """One skewed CTR feed batch (ISSUE 11): zipfian ids — mass on a
     few hot rows, a long tail — the id distribution the sparse lane
     exists for, plus dense features and labels.  The ONE construction
-    shared by bench.py's ctr config, perf_gate's sparse_grad stream
-    and load_gen's --ctr-frac traffic class, so the skew parameter and
-    slot layout can never silently diverge between them."""
+    shared by bench.py's ctr config, perf_gate's sparse_grad /
+    embed_cache streams and load_gen's --ctr-frac traffic class, so
+    the skew parameter and slot layout can never silently diverge
+    between them.
+
+    ``hot_frac`` (ISSUE 12) sharpens the skew beyond what zipf(1.2)'s
+    heavy tail gives: with probability hot_frac a lookup folds into a
+    HOT set of vocab/16 ids (the rest spread over the cold range) —
+    the regime where a small HBM hot-row cache absorbs nearly every
+    lookup.  None (the default) keeps the plain zipf stream, drawing
+    the identical rng sequence as before the knob existed."""
+    # draw order (dense, ids[, hot mask], label) is part of the shared-
+    # stream contract: hot_frac=None consumes exactly the pre-knob
+    # sequence
+    dense = rng.standard_normal((rows, DENSE_DIM)).astype('float32')
+    base = rng.zipf(1.2, size=(rows, SPARSE_SLOTS))
+    if hot_frac is not None:
+        if not 0.0 < float(hot_frac) < 1.0:
+            raise ValueError('zipf_batch: hot_frac must be in (0, 1), '
+                             'got %r' % (hot_frac, ))
+        hot_n = max(int(vocab) // 16, 1)
+        hot = rng.random_sample((rows, SPARSE_SLOTS)) < float(hot_frac)
+        ids = np.where(hot, base % hot_n,
+                       hot_n + base % max(int(vocab) - hot_n, 1))
+    else:
+        ids = base % vocab
     return {
-        'dense': rng.standard_normal((rows, DENSE_DIM)).astype('float32'),
-        'sparse_ids': (rng.zipf(1.2, size=(rows, SPARSE_SLOTS)) % vocab)
-        .astype('int64'),
+        'dense': dense,
+        'sparse_ids': ids.astype('int64'),
         'label': rng.randint(0, 2, (rows, 1)).astype('int64'),
     }
 

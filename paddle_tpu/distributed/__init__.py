@@ -5,3 +5,5 @@ from .master import Master, TaskQueuePyFallback, cloud_reader  # noqa: F401
 from .master_server import MasterServer, MasterClient  # noqa: F401
 from .async_sparse import AsyncSparseEmbedding, \
     AsyncSparseClosedError  # noqa: F401
+from .embed_cache import CachedEmbeddingTable, EmbedCacheCapacityError, \
+    optimizer_accumulator_vars  # noqa: F401

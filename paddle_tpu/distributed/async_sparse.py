@@ -51,10 +51,22 @@ class AsyncSparseEmbedding(object):
     """
 
     def __init__(self, vocab, dim, lr=0.01, capacity=64, seed=0,
-                 init_scale=0.01):
-        rng = np.random.RandomState(seed)
-        self._table = (init_scale *
-                       rng.standard_normal((vocab, dim))).astype('float32')
+                 init_scale=0.01, table=None):
+        if table is not None:
+            # adopt an existing master table (the two-tier embedding
+            # cache seeds the host tier from the startup-initialized
+            # value instead of re-drawing it)
+            # copy=True: the source may be a read-only view of a live
+            # jax array — the master must stay writable
+            self._table = np.array(table, dtype='float32', copy=True)
+            if self._table.shape != (int(vocab), int(dim)):
+                raise ValueError(
+                    'AsyncSparseEmbedding: table= has shape %s, expected '
+                    '(%d, %d)' % (self._table.shape, vocab, dim))
+        else:
+            rng = np.random.RandomState(seed)
+            self._table = (init_scale * rng.standard_normal(
+                (vocab, dim))).astype('float32')
         self._lr = float(lr)
         self._q = queue.Queue(maxsize=capacity)
         self._lock = threading.Lock()  # table row read/write atomicity
@@ -94,6 +106,41 @@ class AsyncSparseEmbedding(object):
                 raise AsyncSparseClosedError()
             self._pushed += 1
             self._q.put((ids, grad))
+
+    # -- batched row exchange (ISSUE 12: the two-tier embedding cache's
+    # host-overflow API — the cache fetches a miss set's rows ahead of
+    # the dispatch that needs them and writes dirty evicted rows back) --
+    def fetch_rows(self, ids):
+        """Batched row gather for the hot-row cache's miss set: current
+        values of ``ids`` -> [len(ids), D].  Unlike ``prefetch`` this is
+        the cache-exchange read: callers that need read-your-writes
+        ordering against ``write_rows`` serialize on their own exchange
+        pipeline (the cache's writeback events), not on the grad
+        queue."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        with self._lock:
+            return self._table[ids].copy()
+
+    def write_rows(self, ids, rows):
+        """Batched row SET (not a gradient): the cache's dirty-eviction
+        writeback — the evicted rows' latest trained values replace the
+        host master's.  Ids must be distinct (they are: one slab slot
+        per id).  Raises the typed closed error after ``close()``."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        rows = np.asarray(rows, dtype='float32').reshape(len(ids), -1)
+        with self._close_lock:
+            if self._closed:
+                raise AsyncSparseClosedError('write_rows')
+            with self._lock:
+                self._table[ids] = rows
+
+    @property
+    def shape(self):
+        return self._table.shape
+
+    @property
+    def nbytes(self):
+        return int(self._table.nbytes)
 
     # -- server side (reference listen_and_serv RunAsyncLoop) --
     def _run(self):

@@ -1,0 +1,643 @@
+"""Two-tier embedding store (ISSUE 12): an HBM hot-row cache slab in
+front of a host-resident master table.
+
+PAPER.md's sparse remote updaters keep giant embedding tables OFF the
+trainer chip: the reference's distributed lookup table prefetches only
+the rows a batch touches (operators/prefetch_op.cc) and pushes sparse
+grads back.  PR 10 made tables row-shard over the mesh, but a table
+bigger than the WHOLE mesh still cannot load.  Under the zipfian id
+traffic the CTR workload generates, a small HBM-resident hot-row
+working set absorbs almost every lookup — this module turns that into
+the measured fast path:
+
+  * the MASTER table is host-resident, held by
+    ``AsyncSparseEmbedding`` (``fetch_rows``/``write_rows`` are the
+    batched exchange API); optimizer accumulators (velocity / adagrad
+    accumulator / adam moments) keep host masters alongside;
+  * a fixed ``[C, D]`` device SLAB per table (weight + each
+    accumulator) lives in the scope under the table's own var name —
+    the train scan's gather/scatter and the PR 10 row-subset
+    optimizers run on the slab unchanged, against ids REMAPPED to
+    slots on host (``stage_block``);
+  * between scan dispatches an EXCHANGE swaps rows: dirty evicted
+    rows gather out of the slab (one ``ops.sparse.slab_gather_rows``)
+    and write back to the host master on a background writeback
+    worker, host-fetched miss rows scatter in (one
+    ``slab_scatter_rows``) — slot vectors pad to power-of-two widths
+    so executables stay bounded;
+  * the host half of block N+1's exchange (miss-set computation + the
+    master-table fetch, on a background fetch worker) OVERLAPS scan
+    N's device compute when driven by the ``FeedPipeline`` staging
+    thread; an exchange whose fetch has not landed when its dispatch
+    needs it is a counted ``prefetch_stall`` — the dispatch waits, so
+    a late fetch is never a correctness hazard;
+  * parity is provable: the slab rows are bitwise the rows a
+    full-table run would hold (SGD's one-scatter-add path is EXACT;
+    merged-duplicate adaptive optimizers agree allclose), and
+    ``flush()`` writes every dirty resident row back so
+    ``table()`` == the full-table lane's final table.
+
+Thread contract: ``stage_block`` is called by ONE staging thread (or
+the synchronous caller) in block order; ``apply`` by the dispatch
+thread in the same order; ``flush``/``close`` by anyone (they
+serialize on the apply lock).  The id->slot directory is lock-guarded.
+"""
+
+import collections
+import queue as _queue
+import threading
+import time
+
+import numpy as np
+
+from .async_sparse import AsyncSparseEmbedding
+
+__all__ = ['CachedEmbeddingTable', 'EmbedCacheCapacityError',
+           'optimizer_accumulator_vars']
+
+# optimizer-op input slots holding row-shaped accumulators that must
+# ride the cache (one host master + one slab each); scalar slots
+# (Beta1Pow, LearningRate) update densely and stay plain scope vars
+_ACCUMULATOR_SLOTS = ('Velocity', 'Moment', 'Moment1', 'Moment2')
+
+
+class EmbedCacheCapacityError(RuntimeError):
+    """Typed reject: one scan block touches more unique rows than the
+    cache has slots — the exchange cannot make them all resident at
+    once.  Raise capacity (or shrink the block)."""
+
+    def __init__(self, var, uniq, capacity):
+        self.var = var
+        self.unique_rows = int(uniq)
+        self.capacity = int(capacity)
+        super(EmbedCacheCapacityError, self).__init__(
+            'embed cache %r: one block touches %d unique rows but the '
+            'slab has %d slots — raise capacity above the per-block '
+            'working set (or lower steps per dispatch)'
+            % (var, uniq, capacity))
+
+
+def optimizer_accumulator_vars(program, var_name):
+    """Row-shaped optimizer accumulator var names of ``var_name``'s
+    optimizer op in ``program`` (the vars that must cache alongside the
+    table: momentum velocity, adagrad accumulator, adam moments).
+
+    Raises a typed ValueError when the table's optimizer has no
+    row-subset kernel (``ops.sparse._ROW_SUBSET_APPLY``): such an
+    optimizer would fall back to ``lazy_apply``'s dense [V, D]
+    materialization against the [C, D] slab — an opaque shape crash
+    deep inside the jit — so the unsupported combination must reject
+    at cache construction instead."""
+    from ..ops.sparse import _ROW_SUBSET_APPLY
+    out = []
+    for op in program.global_block().ops:
+        if 'Param' not in op.inputs or op.input('Param') != [var_name]:
+            continue
+        if op.type in _ROW_SUBSET_APPLY:
+            for slot in _ACCUMULATOR_SLOTS:
+                if slot in op.inputs:
+                    out.extend(op.input(slot))
+            continue
+        raise ValueError(
+            'embed cache: optimizer %r updating table %r has no '
+            'row-subset kernel — the two-tier cache supports %s '
+            '(the lazy-dense fallback would materialize the [V, D] '
+            'gradient the slab exists to avoid)'
+            % (op.type, var_name, sorted(_ROW_SUBSET_APPLY)))
+    return out
+
+
+def register_stall_probe(owner, name, cache, threshold):
+    """Arm a trace-watchdog probe over ``cache``'s current
+    prefetch-stall age, unregistered when ``owner`` (the engine or
+    pipeline that started it) is GC'd.  ONE implementation of the
+    weak-closure + finalize-unregister pattern, shared by
+    InferenceEngine.start and FeedPipeline.start — the subtle parts
+    (the probe must not pin a dropped cache, the unregister must pair
+    the exact fn) live here once."""
+    import weakref
+    from ..fluid import trace as _trace
+    cref = weakref.ref(cache)
+
+    def age(cref=cref):
+        c = cref()
+        return c.stall_age() if c is not None else None
+
+    probe = _trace.watchdog.register(name, age, threshold)
+    weakref.finalize(owner, _trace.watchdog.unregister, probe, age)
+    return probe
+
+
+class _Exchange(object):
+    """One block's staged row swap: dirty victims out, misses in."""
+
+    __slots__ = ('seq', 'miss_ids', 'miss_slots', 'victim_ids',
+                 'victim_slots', 'wait_events', 'fetch_done', 'fetched',
+                 'wb_done', 'gathered', 'applied')
+
+    def __init__(self, seq, miss_ids, miss_slots, victim_ids,
+                 victim_slots, wait_events):
+        self.seq = seq
+        self.miss_ids = miss_ids          # np int64 [M]
+        self.miss_slots = miss_slots      # np int32 [M]
+        self.victim_ids = victim_ids      # np int64 [E] (dirty only)
+        self.victim_slots = victim_slots  # np int32 [E]
+        self.wait_events = wait_events    # writebacks this fetch needs
+        self.fetch_done = threading.Event()
+        self.fetched = None               # {table_name: [M, D] np}
+        self.wb_done = threading.Event()
+        self.gathered = None              # {table_name: device [W, D]}
+        self.applied = False
+
+
+class CachedEmbeddingTable(object):
+    """One cached table: host master tier + ``[C, D]`` device slab tier.
+
+    var        : the table's scope/program var name (the slab lives
+                 there; lookups/optimizers touch it unchanged).
+    id_feeds   : feed names carrying this table's lookup ids — the
+                 block staging remaps them to slot indices.
+    capacity   : slot count C of the slab (must cover every block's
+                 unique-row working set; rounds up to ``multiple``).
+    host       : the master-tier ``AsyncSparseEmbedding`` (built by
+                 ``from_scope`` from the startup-initialized value).
+    aux        : {var_name: host ndarray} — optimizer accumulators
+                 co-cached with the weight (same slots, own slabs).
+    scope      : the fluid scope holding the slab vars.
+    """
+
+    def __init__(self, var, id_feeds, capacity, host, scope, aux=None,
+                 multiple=1):
+        self.var = str(var)
+        self.id_feeds = [str(f) for f in id_feeds]
+        if not self.id_feeds:
+            raise ValueError('CachedEmbeddingTable: id_feeds is required '
+                             '(which feeds carry the lookup ids?)')
+        multiple = max(int(multiple), 1)
+        self.capacity = -(-int(capacity) // multiple) * multiple
+        if self.capacity < 1:
+            raise ValueError('CachedEmbeddingTable: capacity must be >= 1')
+        self._host = host
+        self.vocab, self.dim = host.shape
+        if self.capacity > self.vocab:
+            raise ValueError(
+                'CachedEmbeddingTable: capacity %d exceeds the vocab %d '
+                '— a slab covering the whole table needs no overflow '
+                'tier' % (self.capacity, self.vocab))
+        self._scope = scope
+        # copy=True: sources may be read-only views of live jax arrays
+        self._aux_host = {str(n): np.array(a, dtype='float32', copy=True)
+                          for n, a in (aux or {}).items()}
+        for n, a in self._aux_host.items():
+            if a.shape != (self.vocab, self.dim):
+                raise ValueError(
+                    'CachedEmbeddingTable: accumulator %r has shape %s, '
+                    'expected %s' % (n, a.shape,
+                                     (self.vocab, self.dim)))
+        # ---- the id->slot directory (host mirror of the slab) --------
+        self._lock = threading.RLock()       # directory state
+        self._apply_lock = threading.RLock()  # exchange FIFO / flush
+        self._slot_ids = np.full((self.capacity, ), -1, np.int64)
+        self._id2slot = {}
+        self._dirty = np.zeros((self.capacity, ), bool)
+        self._lru = collections.OrderedDict()  # id -> None, LRU order
+        self._free = list(range(self.capacity))
+        self._wb_pending = {}  # id -> _Exchange whose writeback covers it
+        self._exchanges = collections.deque()  # staged, unapplied
+        self._seq = 0
+        # ---- workers -------------------------------------------------
+        self._fetch_q = _queue.Queue()
+        self._wb_q = _queue.Queue()
+        self._closed = False
+        self._stall_since = None
+        self._fetch_worker = threading.Thread(
+            target=self._fetch_loop, daemon=True,
+            name='embed-cache-fetch-%s' % self.var)
+        self._wb_worker = threading.Thread(
+            target=self._wb_loop, daemon=True,
+            name='embed-cache-wb-%s' % self.var)
+        self._fetch_worker.start()
+        self._wb_worker.start()
+        # ---- metrics -------------------------------------------------
+        self._m = {'lookups': 0, 'hits': 0, 'misses': 0, 'blocks': 0,
+                   'steps': 0, 'exchanges': 0, 'prefetch_stalls': 0,
+                   'prefetch_overlapped': 0, 'host_fetch_bytes': 0,
+                   'host_writeback_bytes': 0, 'writeback_rows': 0,
+                   'flushes': 0}
+
+    # ---- construction ----------------------------------------------------
+
+    @classmethod
+    def from_scope(cls, scope, program, var, capacity, id_feeds,
+                   multiple=1):
+        """Build the two-tier store over an EXISTING scope: the
+        startup-initialized ``[V, D]`` value (and its optimizer
+        accumulators, discovered from ``program``'s optimizer ops)
+        demote to host masters, and fresh ``[C, D]`` zero slabs take
+        their places in the scope — from here on the program trains/
+        serves against the slab."""
+        v = scope.find_var(var)
+        if v is None or v.value() is None:
+            raise ValueError(
+                'CachedEmbeddingTable.from_scope: %r is not initialized '
+                'in the scope — run the startup program first' % var)
+        master = np.asarray(v.value())
+        if master.ndim != 2:
+            raise ValueError(
+                'CachedEmbeddingTable.from_scope: %r has shape %s — '
+                'only 2-D embedding tables cache' % (var, master.shape))
+        vocab, dim = master.shape
+        host = AsyncSparseEmbedding(vocab, dim, table=master)
+        aux = {}
+        for name in optimizer_accumulator_vars(program, var):
+            av = scope.find_var(name)
+            if av is None or av.value() is None:
+                continue
+            arr = np.asarray(av.value())
+            if arr.shape == (vocab, dim):
+                aux[name] = arr
+        cache = cls(var, id_feeds, capacity, host, scope, aux=aux,
+                    multiple=multiple)
+        # install the slabs: the scope vars now hold [C, D]
+        zeros = np.zeros((cache.capacity, dim), master.dtype)
+        v.set_value(zeros.copy())
+        for name in cache._aux_host:
+            scope.find_var(name).set_value(zeros.copy())
+        return cache
+
+    # ---- accounting ------------------------------------------------------
+
+    @property
+    def tables(self):
+        """Every slab var name (weight first, then accumulators)."""
+        return [self.var] + sorted(self._aux_host)
+
+    def slab_nbytes(self):
+        """Device bytes of every slab at capacity — the
+        ``:embed-cache`` arbiter account's size."""
+        return len(self.tables) * self.capacity * self.dim * 4
+
+    def master_nbytes(self):
+        """Host bytes of the WEIGHT master (what the program declares
+        as the [V, D] var — the bytes that never go on device)."""
+        return self.vocab * self.dim * 4
+
+    def metrics(self):
+        m = dict(self._m)
+        m['capacity'] = self.capacity
+        m['vocab'] = self.vocab
+        m['resident'] = self.capacity - len(self._free)
+        m['hit_rate'] = (m['hits'] / m['lookups']) if m['lookups'] else None
+        ex = m['exchanges']
+        m['prefetch_overlap_ratio'] = (
+            m['prefetch_overlapped'] / ex) if ex else None
+        host_bytes = m['host_fetch_bytes'] + m['host_writeback_bytes']
+        m['host_bytes'] = host_bytes
+        m['host_bytes_per_step'] = (
+            host_bytes / m['steps']) if m['steps'] else None
+        m['pending_exchanges'] = len(self._exchanges)
+        return m
+
+    def stall_age(self):
+        """Seconds the dispatch thread has CURRENTLY been waiting on a
+        late host fetch (None when not stalled) — the watchdog's
+        prefetch-stall probe."""
+        since = self._stall_since
+        return (time.time() - since) if since is not None else None
+
+    def check_scope(self, scope, who):
+        """The ONE scope-binding invariant (slabs live in exactly one
+        scope), shared by every executor/pipeline/engine integration
+        point: raise a typed error BEFORE any staging mutates the
+        directory when the run's scope is not the cache's."""
+        if self._scope is not scope:
+            raise ValueError(
+                '%s: embed cache %r is bound to a different scope than '
+                'this run — build the cache from the scope that holds '
+                'its slabs' % (who, self.var))
+
+    # ---- staging (the host half; FeedPipeline staging thread) -----------
+
+    def _remap(self, arr, id2slot_sorted):
+        uniq, slots = id2slot_sorted
+        flat = np.asarray(arr, np.int64)
+        idx = np.searchsorted(uniq, flat)
+        return slots[idx].astype(np.int64)
+
+    def stage_block(self, id_arrays, train=True, steps=None):
+        """Stage one scan block's exchange AHEAD of its dispatch: given
+        the block's id feeds (a list over steps of {feed: ndarray}),
+        compute the miss set against the directory, pick victims (LRU
+        among rows the block does not touch), start the host fetch, and
+        return ``(remapped, exchange)`` — the same structure with every
+        id replaced by its slab slot, plus the exchange handle the
+        dispatch applies (None when the block is fully resident).
+        ``train=False`` (the serving lot path) skips dirty-marking:
+        inference never modifies the slab, so its evictions are free."""
+        if self._closed:
+            raise RuntimeError('CachedEmbeddingTable %r is closed'
+                               % self.var)
+        per_step = [{f: np.asarray(d[f], np.int64) for f in self.id_feeds
+                     if f in d} for d in id_arrays]
+        flat = [a.reshape(-1) for d in per_step for a in d.values()]
+        if not flat or not sum(a.size for a in flat):
+            return id_arrays, None
+        all_ids = np.concatenate(flat)
+        if all_ids.min() < 0 or all_ids.max() >= self.vocab:
+            raise ValueError(
+                'embed cache %r: block ids out of range [0, %d)'
+                % (self.var, self.vocab))
+        uniq = np.unique(all_ids)
+        if len(uniq) > self.capacity:
+            raise EmbedCacheCapacityError(self.var, len(uniq),
+                                          self.capacity)
+        with self._lock:
+            block_set = set(uniq.tolist())
+            miss_ids = [i for i in uniq.tolist() if i not in self._id2slot]
+            n_miss = len(miss_ids)
+            miss_slots, victim_ids, victim_slots = [], [], []
+            wait_events = []
+            for mid in miss_ids:
+                if self._free:
+                    slot = self._free.pop()
+                else:
+                    vid = next(i for i in self._lru if i not in block_set)
+                    slot = self._id2slot.pop(vid)
+                    del self._lru[vid]
+                    if self._dirty[slot]:
+                        victim_ids.append(vid)
+                        victim_slots.append(slot)
+                        self._dirty[slot] = False
+                self._id2slot[mid] = slot
+                self._slot_ids[slot] = mid
+                miss_slots.append(slot)
+            for i in uniq.tolist():
+                self._lru[i] = None
+                self._lru.move_to_end(i)
+            if train:
+                for i in uniq.tolist():
+                    self._dirty[self._id2slot[i]] = True
+            # a miss whose latest value is still in flight to the host
+            # (a dirty eviction whose writeback has not landed) must
+            # wait for that exchange's writeback before fetching
+            seen = set()
+            for mid in miss_ids:
+                prior = self._wb_pending.get(mid)
+                if prior is not None and id(prior) not in seen:
+                    seen.add(id(prior))
+                    wait_events.append(prior.wb_done)
+            ex = None
+            if n_miss or victim_ids:
+                self._seq += 1
+                ex = _Exchange(
+                    self._seq, np.asarray(miss_ids, np.int64),
+                    np.asarray(miss_slots, np.int32),
+                    np.asarray(victim_ids, np.int64),
+                    np.asarray(victim_slots, np.int32), wait_events)
+                for vid in victim_ids:
+                    self._wb_pending[vid] = ex
+                self._exchanges.append(ex)
+            # accounting + the remap table
+            lookups = int(sum(a.size for a in flat))
+            self._m['lookups'] += lookups
+            self._m['misses'] += n_miss
+            self._m['hits'] += lookups - n_miss
+            self._m['blocks'] += 1
+            self._m['steps'] += int(steps if steps is not None
+                                    else len(per_step) or 1)
+            slots_for = np.asarray([self._id2slot[i] for i in
+                                    uniq.tolist()], np.int64)
+        if ex is not None:
+            self._m['exchanges'] += 1
+            self._fetch_q.put(ex)
+        remap = (uniq, slots_for)
+        out = []
+        for src, ids in zip(id_arrays, per_step):
+            d = dict(src)
+            for f, a in ids.items():
+                d[f] = self._remap(a, remap)
+            out.append(d)
+        return out, ex
+
+    def stage_feed_list(self, feed_list, train=True, steps=None):
+        """``stage_block`` over run_multi-shaped prepared feed dicts:
+        remaps the id feeds IN PLACE of each dict and returns the
+        exchange handle."""
+        remapped, ex = self.stage_block(feed_list, train=train,
+                                        steps=steps)
+        for dst, src in zip(feed_list, remapped):
+            for f in self.id_feeds:
+                if f in src:
+                    dst[f] = src[f]
+        return ex
+
+    # ---- workers ---------------------------------------------------------
+
+    def _fetch_loop(self):
+        while True:
+            ex = self._fetch_q.get()
+            if ex is None:
+                self._fetch_q.task_done()
+                return
+            try:
+                for ev in ex.wait_events:
+                    ev.wait()
+                fetched = {}
+                if len(ex.miss_ids):
+                    fetched[self.var] = self._host.fetch_rows(ex.miss_ids)
+                    for name, arr in self._aux_host.items():
+                        fetched[name] = arr[ex.miss_ids].copy()
+                    self._m['host_fetch_bytes'] += (
+                        len(ex.miss_ids) * self.dim * 4 *
+                        len(self.tables))
+                ex.fetched = fetched
+            finally:
+                ex.fetch_done.set()
+                self._fetch_q.task_done()
+
+    def _wb_loop(self):
+        while True:
+            ex = self._wb_q.get()
+            if ex is None:
+                self._wb_q.task_done()
+                return
+            try:
+                n = len(ex.victim_ids)
+                if n and ex.gathered is not None:
+                    for name, dev in ex.gathered.items():
+                        rows = np.asarray(dev)[:n]
+                        if name == self.var:
+                            self._host.write_rows(ex.victim_ids, rows)
+                        else:
+                            self._aux_host[name][ex.victim_ids] = rows
+                    self._m['host_writeback_bytes'] += (
+                        n * self.dim * 4 * len(self.tables))
+                    self._m['writeback_rows'] += n
+            finally:
+                with self._lock:
+                    for vid in ex.victim_ids.tolist():
+                        if self._wb_pending.get(vid) is ex:
+                            del self._wb_pending[vid]
+                ex.wb_done.set()
+                self._wb_q.task_done()
+
+    # ---- the device half (dispatch thread) -------------------------------
+
+    def _slab_value(self, name):
+        var = self._scope.find_var(name)
+        if var is None or var.value() is None:
+            raise RuntimeError(
+                'embed cache %r: slab var %r is not in the scope'
+                % (self.var, name))
+        return var.value()
+
+    def _apply_one(self, ex):
+        from ..ops.sparse import (exchange_width, pad_exchange,
+                                  slab_gather_rows, slab_scatter_rows)
+        if not ex.fetch_done.is_set():
+            # the prefetch did not finish ahead of the dispatch: a
+            # counted stall, never a correctness hazard — wait it out
+            self._m['prefetch_stalls'] += 1
+            self._stall_since = time.time()
+            try:
+                ex.fetch_done.wait()
+            finally:
+                self._stall_since = None
+        else:
+            self._m['prefetch_overlapped'] += 1
+        n_evict = len(ex.victim_ids)
+        if n_evict:
+            # gather the dirty evicted rows BEFORE the scatter below
+            # overwrites their slots; the writeback worker syncs them
+            # off the dispatch thread
+            w = exchange_width(n_evict)
+            slots = pad_exchange(ex.victim_slots, w, self.capacity)
+            ex.gathered = {
+                name: slab_gather_rows(self._slab_value(name), slots)
+                for name in self.tables
+            }
+        self._wb_q.put(ex)
+        n_miss = len(ex.miss_ids)
+        if n_miss:
+            w = exchange_width(n_miss)
+            slots = pad_exchange(ex.miss_slots, w, self.capacity)
+            for name in self.tables:
+                rows = ex.fetched[name]
+                padded = np.zeros((w, ) + rows.shape[1:], rows.dtype)
+                padded[:n_miss] = rows
+                new = slab_scatter_rows(self._slab_value(name), slots,
+                                        padded)
+                self._scope.find_var(name).set_value(new)
+        ex.applied = True
+
+    def apply(self, exchange):
+        """Apply one staged exchange (and, defensively, any staged
+        BEFORE it — FIFO order is the correctness contract) right
+        before its block's dispatch.  Idempotent: a flush that already
+        applied it makes this a no-op."""
+        if exchange is None:
+            return
+        with self._apply_lock:
+            while not exchange.applied and self._exchanges:
+                self._apply_one(self._exchanges.popleft())
+
+    # ---- flush / lifecycle ----------------------------------------------
+
+    def flush(self):
+        """The paused-window barrier: apply every staged exchange (a
+        block staged but not yet dispatched just has its rows moved
+        early — value-neutral), drain the writeback queue, then write
+        every DIRTY resident row back to the host masters.  After
+        flush the host tier is the full truth; the slab stays valid
+        (bitwise) so training/serving resumes exactly.
+
+        Caller contract: quiesce staging first (close the FeedPipeline
+        / pause the engine worker) — flush serializes against APPLY,
+        not against a concurrent ``stage_block``."""
+        from ..ops.sparse import exchange_width, pad_exchange, \
+            slab_gather_rows
+        with self._apply_lock:
+            while self._exchanges:
+                self._apply_one(self._exchanges.popleft())
+            self._fetch_q.join()
+            self._wb_q.join()
+            with self._lock:
+                dirty_slots = np.nonzero(self._dirty)[0]
+                dirty_ids = self._slot_ids[dirty_slots]
+                self._dirty[dirty_slots] = False
+            n = len(dirty_slots)
+            if n:
+                w = exchange_width(n)
+                slots = pad_exchange(dirty_slots, w, self.capacity)
+                for name in self.tables:
+                    rows = np.asarray(
+                        slab_gather_rows(self._slab_value(name),
+                                         slots))[:n]
+                    if name == self.var:
+                        self._host.write_rows(dirty_ids, rows)
+                    else:
+                        self._aux_host[name][dirty_ids] = rows
+                self._m['host_writeback_bytes'] += (
+                    n * self.dim * 4 * len(self.tables))
+                self._m['writeback_rows'] += n
+            self._host.drain()
+            self._m['flushes'] += 1
+
+    def invalidate(self):
+        """Flush, then forget every residency: the next block misses
+        everything (the every-step-exchange comparator lane, and the
+        big hammer for external master-table edits)."""
+        with self._apply_lock:
+            self.flush()
+            with self._lock:
+                self._id2slot.clear()
+                self._lru.clear()
+                self._slot_ids[:] = -1
+                self._free = list(range(self.capacity))
+
+    def table(self, name=None):
+        """The full ``[V, D]`` host truth of the weight table (or an
+        accumulator) after a flush — the parity check's view."""
+        self.flush()
+        if name is None or name == self.var:
+            return self._host.table()
+        return self._aux_host[name].copy()
+
+    def evict_to_host(self):
+        """Demote every slab to a host ndarray after a flush (bitwise
+        values — the next dispatch re-stages them through the normal
+        cache_back path).  Returns bytes moved — the ``:embed-cache``
+        arbiter account's eviction unit."""
+        import jax
+        self.flush()
+        moved = 0
+        for name in self.tables:
+            var = self._scope.find_var(name)
+            v = var.value() if var is not None else None
+            if isinstance(v, jax.Array):
+                arr = np.asarray(v)
+                var.set_value(arr)
+                moved += int(arr.nbytes)
+        return moved
+
+    def close(self):
+        if self._closed:
+            return
+        try:
+            self.flush()
+        finally:
+            self._closed = True
+            self._fetch_q.put(None)
+            self._wb_q.put(None)
+            self._fetch_worker.join(timeout=10)
+            self._wb_worker.join(timeout=10)
+            self._host.close()
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def __repr__(self):
+        return ('CachedEmbeddingTable(%r, vocab=%d, dim=%d, capacity=%d, '
+                'tables=%d)' % (self.var, self.vocab, self.dim,
+                                self.capacity, len(self.tables)))

@@ -152,7 +152,7 @@ class _Block(object):
     """One staged K-step scan block."""
 
     __slots__ = ('steps', 'sig_feed', 'scanned', 'placed', 'real',
-                 'padded', 'batch_feed_names', 'indices')
+                 'padded', 'batch_feed_names', 'indices', 'exchanges')
 
     def __init__(self, steps, sig_feed, scanned, placed, real=0, padded=0,
                  batch_feed_names=None, indices=None):
@@ -174,6 +174,11 @@ class _Block(object):
         # ``FeedPipeline.dispatch_log`` makes the realized training
         # order observable (and contract-testable)
         self.indices = indices
+        # (cache, exchange) pairs staged by the prefetch hook (ISSUE
+        # 12): the dispatch loop applies them right before this block's
+        # dispatch — the host fetch they started OVERLAPS the previous
+        # dispatch's device compute
+        self.exchanges = ()
 
 
 class FeedPipeline(object):
@@ -202,7 +207,18 @@ class FeedPipeline(object):
         watchdog (ISSUE 6) — a started pipeline registers a probe over
         how long the dispatch loop has currently been blocked on the
         staging queue; crossing it dumps the flight recorder.  None
-        (default) registers no probe.
+        (default) registers no probe.  With ``embed_caches`` set, the
+        same threshold also arms a prefetch-stall probe per cache
+        (how long the dispatch loop has been waiting on a late host
+        row fetch).
+    embed_caches: two-tier embedding stores (ISSUE 12,
+        ``distributed.CachedEmbeddingTable``) — the STAGING thread
+        remaps each block's id feeds to slab slots and starts the
+        block's host row exchange (miss fetch + dirty-eviction
+        writeback) while the PREVIOUS dispatch still computes; the
+        dispatch loop applies the exchange just before the block
+        dispatches.  A fetch that has not landed in time is a counted
+        ``prefetch_stall``, never a correctness hazard.
 
     Iterate the pipeline to drive it: each item is one dispatch's
     converted last-step fetches.  ``metrics()`` snapshots feed-stall
@@ -214,7 +230,8 @@ class FeedPipeline(object):
     def __init__(self, executor, fetch_list, program=None, reader=None,
                  source=None, steps=1, pipeline_depth=2, scope=None,
                  return_numpy=True, name=None, bucketed=False,
-                 max_open_buckets=4, watchdog_stall_s=None):
+                 max_open_buckets=4, watchdog_stall_s=None,
+                 embed_caches=None):
         if (reader is None) == (source is None):
             raise ValueError('FeedPipeline: pass reader= OR source=')
         if int(steps) < 1:
@@ -253,6 +270,10 @@ class FeedPipeline(object):
         self._staged = _queue.Queue(maxsize=self.pipeline_depth)
         self._inflight = []
         self._pending = None  # a prepared batch held across a bucket split
+        self._embed_caches = list(embed_caches or [])
+        run_scope = (executor._scope if self._is_spmd else self._scope)
+        for cache in self._embed_caches:
+            cache.check_scope(run_scope, 'FeedPipeline')
         # bucketed variant (ISSUE 5): instead of CLOSING a block at a
         # shape-bucket boundary, route each drained batch to its
         # bucket's open block — one scan executable per (batch,
@@ -394,15 +415,24 @@ class FeedPipeline(object):
         return prepared, rp, bn, idx
 
     def _finish_block(self, per_step, last_rp, bn0, indices):
+        # the prefetch hook (ISSUE 12): remap each cache's id feeds to
+        # slab slots and START the block's host row exchange HERE, on
+        # the staging thread — the master-table fetch runs while the
+        # previous dispatch computes on device
+        exchanges = [(cache, cache.stage_feed_list(per_step,
+                                                   steps=len(per_step)))
+                     for cache in self._embed_caches]
         # uniformity holds by construction: every step shares one sig
         stacked = {n: stack_steps([fa[n] for fa in per_step])
                    for n in per_step[0]}
         placer = self._placer
         if placer is not None:
             stacked = {n: placer(n, v) for n, v in stacked.items()}
-        return _Block(len(per_step), per_step[0], stacked,
-                      placer is not None, last_rp[0], last_rp[1], bn0,
-                      indices)
+        block = _Block(len(per_step), per_step[0], stacked,
+                       placer is not None, last_rp[0], last_rp[1], bn0,
+                       indices)
+        block.exchanges = exchanges
+        return block
 
     def _pop_open(self, last=False):
         """Flush one open bucket as a (possibly shorter) block — always
@@ -510,6 +540,16 @@ class FeedPipeline(object):
                 self._watchdog_age_fn = age
                 weakref.finalize(self, _trace.watchdog.unregister,
                                  self._watchdog_probe, age)
+                from ..distributed.embed_cache import register_stall_probe
+                for cache in self._embed_caches:
+                    # a late host row fetch stalls the dispatch loop the
+                    # same way a slow reader does — same threshold, its
+                    # own probe name (ISSUE 12)
+                    register_stall_probe(
+                        self,
+                        'pipeline/%s/embed_cache/%s/prefetch_stall'
+                        % (self.name, cache.var),
+                        cache, self.watchdog_stall_s)
         return self
 
     def _ensure_placer(self, block):
@@ -562,6 +602,11 @@ class FeedPipeline(object):
             block.scanned = {n: self._placer(n, v)
                              for n, v in block.scanned.items()}
             block.placed = True
+        for cache, ex in block.exchanges:
+            # the overlapped prefetch's device half: evicted dirty rows
+            # gather out, fetched miss rows scatter in — right before
+            # the dispatch that needs them (late fetch = counted stall)
+            cache.apply(ex)
         if self._is_spmd:
             fetches, compiled = self._exe._dispatch_multi_scanned(
                 self._fetch_list, block.sig_feed, block.scanned,
@@ -656,6 +701,9 @@ class FeedPipeline(object):
                 1.0, (denom - m['feed_stall_s']) / denom))
         else:
             m['overlap_ratio'] = 1.0 if m['feed_stall_s'] < 1e-3 else 0.0
+        if self._embed_caches:
+            m['embed_cache'] = {c.var: c.metrics()
+                                for c in self._embed_caches}
         return m
 
     def _drain_staged(self):

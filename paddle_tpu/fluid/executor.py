@@ -1406,7 +1406,8 @@ class Executor(object):
                   scope=None,
                   return_numpy=True,
                   feed_list=None,
-                  reader=None):
+                  reader=None,
+                  embed_caches=None):
         """Run ``steps`` iterations of the program as ONE device
         dispatch.  Returns the LAST iteration's fetches.  For
         dispatch-bound small steps — e.g. the stacked-LSTM benchmark
@@ -1424,7 +1425,15 @@ class Executor(object):
         (the reference per-iteration pull, executor.cc:321-339); a
         stream ending mid-block trains on the shorter tail, an
         exhausted reader raises core.EOFException exactly like run().
-        Overlapped staging across dispatches is fluid.FeedPipeline."""
+        Overlapped staging across dispatches is fluid.FeedPipeline.
+
+        embed_caches: two-tier embedding stores (ISSUE 12,
+        ``distributed.CachedEmbeddingTable``) whose tables this program
+        looks up: each cache's id feeds REMAP to slab slots on host,
+        and the block's row exchange (dirty evictions out to the host
+        master, fetched misses in) applies right before the dispatch.
+        Synchronous form — the overlapped prefetch is
+        FeedPipeline(embed_caches=)."""
         if reader is not None:
             from .dataflow import check_reader_args, drain_reader_feed_list
             check_reader_args('run_multi', feed, feed_list)
@@ -1437,13 +1446,34 @@ class Executor(object):
             # otherwise pop ONE reader minibatch in _resolve_and_compile
             # and silently train K steps on it
             program = _reject_reader_fed(program, 'run_multi')
+        exchanges = []
+        if embed_caches:
+            # the scope check must precede ANY staging: a mis-bound
+            # cache must not have its directory/metrics mutated by a
+            # block that will never dispatch
+            run_scope = scope if scope is not None else _current_scope()
+            for cache in embed_caches:
+                cache.check_scope(run_scope, 'run_multi')
         if feed_list is not None:
             if feed is not None:
                 raise ValueError('run_multi: pass feed OR feed_list')
             steps, per_step = prepare_feed_list(feed_list)
+            for cache in (embed_caches or ()):
+                # remap the cache's id feeds to slab slots IN PLACE
+                # (before per_step[0] keys the compile signature)
+                exchanges.append(
+                    (cache, cache.stage_feed_list(per_step, steps=steps)))
             feed = per_step[0]  # keys the compile signature (already
             # prepared: prepare_feed_arrays passes arrays through, so
             # the resolve path does not re-pad batch 0)
+        elif embed_caches:
+            # the constant-batch (fori_loop) form: one id set reused
+            # every iteration — remap it once
+            feed = prepare_feed_arrays(dict(feed if feed is not None
+                                            else {}))
+            for cache in embed_caches:
+                exchanges.append(
+                    (cache, cache.stage_feed_list([feed], steps=steps)))
         program, scope, feed_arrays, compiled = self._resolve_and_compile(
             program, feed, fetch_list, scope, pop_readers=False)
         scanned = None
@@ -1464,6 +1494,10 @@ class Executor(object):
         # real XLA retraces, not just distinct step counts
         if compiled.note_multi_compile(steps, scanned):
             self.compile_count += 1
+        for cache, ex in exchanges:
+            # the block's row exchange lands right before its dispatch
+            # (an unfinished host fetch is a counted prefetch_stall)
+            cache.apply(ex)
         from . import profiler as _profiler
         if _profiler.is_profiler_enabled():
             with _profiler.record_block(

@@ -526,7 +526,7 @@ class ParallelExecutor(object):
                                      compiled=compiled)
 
     def run_multi(self, fetch_list, feed=None, steps=1, feed_list=None,
-                  return_numpy=True, reader=None):
+                  return_numpy=True, reader=None, embed_caches=None):
         """Run ``steps`` iterations as ONE GSPMD-sharded device dispatch
         (the SPMD counterpart of Executor.run_multi; the reference
         amortizes per-iteration overhead with its double-buffered
@@ -555,6 +555,26 @@ class ParallelExecutor(object):
                                'ParallelExecutor.run_multi')
         fetch_names = self._fetch_names(fetch_list)
         scanned = None
+        exchanges = []
+
+        def _stage_caches(per_step_or_feed, k):
+            # ISSUE 12: remap each cache's id feeds to slab slots IN
+            # PLACE before signatures/padding see them (the padded tail
+            # replicates already-remapped rows, so every slot stays
+            # valid), recording the exchange to apply pre-dispatch
+            # EVERY cache's scope binding is checked before ANY cache
+            # stages: a mis-bound second cache must not leave the first
+            # with a staged exchange (and skewed hit-rate metrics) for
+            # a block that never dispatches — same invariant as
+            # Executor.run_multi's pre-staging check
+            for cache in (embed_caches or ()):
+                cache.check_scope(self._scope,
+                                  'ParallelExecutor.run_multi')
+            for cache in (embed_caches or ()):
+                exchanges.append(
+                    (cache,
+                     cache.stage_feed_list(per_step_or_feed, steps=k)))
+
         if feed_list is not None:
             if feed is not None:
                 raise ValueError('run_multi: pass feed OR feed_list')
@@ -563,6 +583,7 @@ class ParallelExecutor(object):
             per_step = [prepare_feed_arrays(dict(f)) for f in feed_list]
             steps = len(per_step)
             check_feed_list_names(per_step, 'run_multi')
+            _stage_caches(per_step, steps)
             normalize_trailing_feed_list(per_step)
             # size probe only — no lot is padded (or pulled off device)
             # unless something is actually ragged
@@ -581,11 +602,16 @@ class ParallelExecutor(object):
             feed_arrays = {}  # every feed name arrives via the scan
         else:
             rpt = {}
+            prepared = prepare_feed_arrays(
+                dict(feed if feed is not None else {}))
+            _stage_caches([prepared], steps)
             feed_arrays, real, n_padded = self._pad_ragged(
-                prepare_feed_arrays(dict(feed if feed is not None else {})),
-                report=rpt)
+                prepared, report=rpt)
             compiled = self._resolve(fetch_names, feed_arrays,
                                      rpt.get('batch_names'))
+        for cache, ex in exchanges:
+            # the block's row exchange lands right before its dispatch
+            cache.apply(ex)
         fetches = compiled.run_multi(self._scope, feed_arrays,
                                      self._next_rng(), steps,
                                      scanned_feeds=scanned)

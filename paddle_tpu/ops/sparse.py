@@ -10,14 +10,20 @@ SparseAdamFunctor, merge/scale math in math/selected_rows_functor.cc).
 TPU-native design: inside a compiled block a sparse gradient is a
 ``SparseRows`` pytree — rows (int32 [N]) + values ([N, D]) + static
 height — so the [V, D] dense gradient is never materialized.  The SGD
-update lowers to one XLA scatter-add; momentum and adam (ISSUE 11) run
-the reference's *lazy* row-subset kernels directly — duplicate ids
-merge by an in-domain scatter-add (``merge_rows``), the touched rows
-of param + moments gather to an [N, D] subset, the dense optimizer
-math runs there, and one scatter-update writes back, O(rows x D) per
-step with untouched rows' moments never decaying.  Remaining adaptive
-optimizers (adagrad/rmsprop/…) fall back to ``lazy_apply``'s
-dense-materialize + mask emulation (identical semantics, O(V x D)).
+update lowers to one XLA scatter-add; momentum, adam (ISSUE 11) and
+adagrad (ISSUE 12) run the reference's *lazy* row-subset kernels
+directly — duplicate ids merge by an in-domain scatter-add
+(``merge_rows``), the touched rows of param + moments gather to an
+[N, D] subset, the dense optimizer math runs there, and one
+scatter-update writes back, O(rows x D) per step with untouched rows'
+moments never decaying.  Remaining adaptive optimizers (rmsprop/ftrl/…)
+fall back to ``lazy_apply``'s dense-materialize + mask emulation
+(identical semantics, O(V x D)).
+
+ISSUE 12 adds the hot-row cache slab exchange kernels at the bottom:
+the two-tier embedding store's device half (one padded gather of
+dirty evicted rows out, one padded scatter of host-fetched miss rows
+in) — see ``distributed.embed_cache``.
 Everything stays jit-compatible: rows/values have static shapes (one
 row per looked-up id), duplicates are resolved by scatter addition —
 the pytree rides ``run_multi``'s scanned train step on both executors.
@@ -25,6 +31,7 @@ the pytree rides ``run_multi``'s scanned train step on both executors.
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .registry import (GRAD_SUFFIX, fwd_structure, register_grad_lowering,
                        register_lowering)
@@ -213,7 +220,26 @@ def _rows_adam(ctx, op, g):
     ctx.set(op, 'Moment2Out', _scatter_rows(m2, rows, m2_new))
 
 
-# The FAST sparse lane (ISSUE 11): gather/merge/scatter row-subset
+def _rows_adagrad(ctx, op, g):
+    """Lazy row-subset adagrad (adagrad_op.cc SelectedRows branch):
+    gather the touched rows of param + accumulator, run the dense
+    adagrad math on the [N, D] subset against the MERGED gradient,
+    scatter both back.  Untouched rows are exactly the dense lane's
+    (their grad is zero, so moment += 0 and the param is untouched) —
+    adagrad's sparse kernel is dense-equivalent, unlike momentum/adam
+    whose untouched moments would decay densely."""
+    p = ctx.get(op, 'Param')
+    mom = ctx.get(op, 'Moment')
+    lr = jnp.reshape(ctx.get(op, 'LearningRate'), ())
+    eps = op.attrs.get('epsilon', 1e-6)
+    rows, grad = merge_rows(g.rows, g.values, g.height)
+    m_new = mom[rows] + jnp.square(grad)
+    p_new = p[rows] - lr * grad / (jnp.sqrt(m_new) + eps)
+    ctx.set(op, 'ParamOut', _scatter_rows(p, rows, p_new))
+    ctx.set(op, 'MomentOut', _scatter_rows(mom, rows, m_new))
+
+
+# The FAST sparse lane (ISSUE 11/12): gather/merge/scatter row-subset
 # kernels for the optimizers the reference ships SelectedRows branches
 # for.  Everything else falls back to lazy_apply's dense-materialize +
 # mask emulation (semantically identical, O(V x D) per step).
@@ -221,7 +247,58 @@ _ROW_SUBSET_APPLY = {
     'sgd': _rows_sgd,
     'momentum': _rows_momentum,
     'adam': _rows_adam,
+    'adagrad': _rows_adagrad,
 }
+
+
+# ----------------------------------------------------------------------------
+# Hot-row cache slab exchange (ISSUE 12): the device half of the
+# two-tier embedding store.  A cached table's [C, D] HBM slab swaps
+# rows with the host master between scan dispatches: one gather reads
+# the dirty evicted rows out (handed to the writeback worker), one
+# scatter stages the host-fetched miss rows in.  Both run over
+# POWER-OF-TWO-padded slot vectors (pad_exchange) so the executable
+# count stays bounded as the per-block miss count varies; padded slots
+# carry the out-of-range sentinel ``C`` — the scatter drops them and
+# the gather clamps harmlessly (the host slices to the real count).
+# ----------------------------------------------------------------------------
+def exchange_width(n):
+    """Smallest power of two >= n (>= 1): the padded slot-vector width
+    one exchange executable serves — bounded compiles over arbitrary
+    per-block miss counts, like the serving engine's batch ladder."""
+    n = max(int(n), 1)
+    w = 1
+    while w < n:
+        w *= 2
+    return w
+
+
+def pad_exchange(slots, width, height):
+    """Pad an int slot vector to ``width`` with the sentinel ``height``
+    (one past the slab), as int32 — the no-op slots a drop-mode scatter
+    ignores."""
+    slots = np.asarray(slots, np.int32).reshape(-1)
+    out = np.full((int(width), ), int(height), np.int32)
+    out[:len(slots)] = slots
+    return out
+
+
+_slab_gather_jit = jax.jit(
+    lambda s, i: jnp.take(s, jnp.clip(i, 0, s.shape[0] - 1), axis=0))
+_slab_scatter_jit = jax.jit(
+    lambda s, i, r: s.at[i].set(r.astype(s.dtype), mode='drop'))
+
+
+def slab_gather_rows(slab, slots):
+    """Gather [W] slot rows out of the [C, D] slab (clip mode: padded
+    sentinel slots read the last row; the host discards them)."""
+    return _slab_gather_jit(slab, slots)
+
+
+def slab_scatter_rows(slab, slots, rows):
+    """Scatter [W] fetched rows into the slab at ``slots``; sentinel
+    (out-of-range) slots drop — the padded tail never lands."""
+    return _slab_scatter_jit(slab, slots, rows)
 
 
 def lazy_apply(ctx, op, dense_fn):

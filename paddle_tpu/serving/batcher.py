@@ -188,7 +188,7 @@ class MicroBatcher(object):
     def __init__(self, max_batch_size=32, max_wait_s=0.005,
                  scheduling='edf', on_shed=None,
                  service_estimate_fn=None, service_estimate_for=None,
-                 priority_aging_s=None):
+                 priority_aging_s=None, shed_by_class=False):
         if int(max_batch_size) < 1:
             raise ValueError('max_batch_size must be >= 1')
         if scheduling not in ('edf', 'fifo'):
@@ -204,11 +204,17 @@ class MicroBatcher(object):
             raise ValueError("priority_aging_s only applies to 'edf' "
                              "scheduling — drop scheduling='fifo', or "
                              'drop the aging window')
+        if shed_by_class and scheduling == 'fifo':
+            # same contradiction shape: fifo never sheds at all
+            raise ValueError("shed_by_class only applies to 'edf' "
+                             "scheduling — drop scheduling='fifo', or "
+                             'drop shed_by_class')
         self.max_batch_size = int(max_batch_size)
         self.max_wait_s = float(max_wait_s)
         self.scheduling = scheduling
         self.priority_aging_s = (float(priority_aging_s)
                                  if priority_aging_s is not None else None)
+        self.shed_by_class = bool(shed_by_class)
         self._on_shed = on_shed
         self._service_estimate_fn = service_estimate_fn
         self._service_estimate_for = service_estimate_for
@@ -278,7 +284,41 @@ class MicroBatcher(object):
         if not self._pending:
             return
         now = time.time()
-        if self._service_estimate_for is not None:
+        if self.shed_by_class and (self._service_estimate_for is not None
+                                   or self._service_estimate_fn
+                                   is not None):
+            # load-shedding by CLASS (ISSUE 12 satellite): walk the
+            # queue in scheduling order (highest class first, EDF
+            # within a class) ACCUMULATING service estimates — a
+            # deadlined request sheds when the backlog scheduled ahead
+            # of it already pushes its finish past its deadline.  Low
+            # classes sort last, so under overload their deadlined work
+            # sheds FIRST; within one class the walk order IS the EDF
+            # order, so nothing reorders.  (Per-request estimates
+            # accumulate without modeling lot coalescing — a
+            # deliberate upper bound: admission errs toward shedding
+            # work the backlog has already doomed.)
+            def est_of(r):
+                try:
+                    if self._service_estimate_for is not None:
+                        return float(self._service_estimate_for(r) or 0.0)
+                    return float(self._service_estimate_fn() or 0.0)
+                except Exception:
+                    return 0.0
+
+            maxp = max(r.priority for r in self._pending)
+            order = sorted(
+                self._pending,
+                key=lambda r: _sched_key(r, now, self.priority_aging_s,
+                                         maxp))
+            doomed, cum = [], 0.0
+            for r in order:
+                e = est_of(r)
+                if r.deadline_t is not None and r.deadline_t < now + cum + e:
+                    doomed.append(r)
+                    continue  # shed work frees its service slot
+                cum += e
+        elif self._service_estimate_for is not None:
             # per-signature horizon (ISSUE 9): each pending request is
             # judged against the estimate for ITS OWN signature; an
             # estimator fault degrades that request to the bare

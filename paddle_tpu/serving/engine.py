@@ -147,6 +147,18 @@ class ServingConfig(object):
         (aging never cuts an undeadlined request ahead of a
         deadline-imminent peer of its own class).  None (default)
         keeps strict priority.
+    shed_by_class: load-shedding by priority CLASS (ISSUE 12
+        satellite; ROADMAP item 5 leftover).  The default shed rule
+        judges each deadlined request against its OWN service estimate
+        only; under overload that serves doomed low-class work at the
+        expense of meetable high-class work.  With shed_by_class the
+        shed pass walks the queue in scheduling order (highest class
+        first, EDF within a class) ACCUMULATING the service estimates
+        of everything ahead — a deadlined request sheds when the
+        backlog in front of it already pushes its finish past the
+        deadline, so the lowest-priority-class deadlined work sheds
+        FIRST (it is served last, so the backlog dooms it first).
+        Same-class EDF order is untouched (pinned).  EDF only.
     admit_queue_depth / admit_queue_age_ms: per-model admission
         watermarks the ModelRegistry enforces at ROUTING time — a
         request routed while the engine's queue is at least this deep
@@ -166,7 +178,7 @@ class ServingConfig(object):
                  decode_slots=8, decode_steps=4, decode_pipeline_depth=2,
                  scheduling='edf', admit_queue_depth=None,
                  admit_queue_age_ms=None, adaptive_admission=False,
-                 priority_aging_ms=None):
+                 priority_aging_ms=None, shed_by_class=False):
         if int(steps_per_dispatch) < 1:
             raise ValueError('steps_per_dispatch must be >= 1')
         if int(pipeline_depth) < 1:
@@ -220,6 +232,12 @@ class ServingConfig(object):
                 'window')
         self.priority_aging_s = (float(priority_aging_ms) / 1e3
                                  if priority_aging_ms is not None else None)
+        if shed_by_class and scheduling == 'fifo':
+            raise ValueError(
+                'ServingConfig: shed_by_class only applies to EDF '
+                "scheduling — drop scheduling='fifo', or drop "
+                'shed_by_class')
+        self.shed_by_class = bool(shed_by_class)
         if admit_queue_depth is not None and int(admit_queue_depth) < 1:
             raise ValueError('admit_queue_depth must be >= 1 (or None '
                              'to disable the depth watermark)')
@@ -264,7 +282,8 @@ class InferenceEngine(object):
 
     def __init__(self, program, feed_names=None, fetch_list=None,
                  place=None, scope=None, executor=None, parallel=False,
-                 mesh=None, config=None, name=None, generation=None):
+                 mesh=None, config=None, name=None, generation=None,
+                 embed_caches=None):
         if fetch_list is None:
             raise ValueError('InferenceEngine: fetch_list is required '
                              '(the fetch targets returned by '
@@ -291,6 +310,30 @@ class InferenceEngine(object):
         # op semantics (the pre-engine Inferencer behavior)
         self._eager = any(_is_host_op(op)
                           for op in program.global_block().ops)
+        # two-tier embedding stores (ISSUE 12): inference lookups hit
+        # the SAME hot-row slab training uses — the worker remaps each
+        # lot's id feeds to slab slots and applies the row exchange
+        # (misses fetch from the host master; inference stages are
+        # never dirty, so its evictions write nothing back).
+        # Validated HERE, before any generation/PE machinery builds:
+        # the unsupported combinations must fail fast and leak nothing.
+        self._embed_caches = list(embed_caches or [])
+        if self._embed_caches and self._eager:
+            raise NotImplementedError(
+                'embed_caches cannot serve host-op (eager) programs — '
+                'the per-request exe.run path has no lot to stage an '
+                'exchange for')
+        if self._embed_caches and generation is not None:
+            # the prefill lots and decode-step dispatches do not remap
+            # id feeds to slab slots: raw vocab ids against the [C, D]
+            # slab would silently gather wrong rows — reject the
+            # combination until the generation lane learns to stage
+            raise NotImplementedError(
+                'embed_caches cannot serve generation= engines yet — '
+                'the prefill/decode dispatch paths do not remap lookup '
+                'ids to slab slots')
+        for _cache in self._embed_caches:
+            _cache.check_scope(self._scope, 'InferenceEngine')
         self._pe = None
         if parallel or mesh is not None:
             if self._eager:
@@ -346,7 +389,8 @@ class InferenceEngine(object):
             on_shed=lambda req: (ref0() and ref0()._shed_request(req)),
             service_estimate_for=lambda req: (
                 ref0()._service_estimate(req) if ref0() else 0.0),
-            priority_aging_s=self.config.priority_aging_s)
+            priority_aging_s=self.config.priority_aging_s,
+            shed_by_class=self.config.shed_by_class)
         # arrival vs drain rates (ISSUE 9): the adaptive admission
         # watermarks' inputs — noted at submit and at delivery
         self._arrivals = RateWindow()
@@ -485,6 +529,16 @@ class InferenceEngine(object):
                 # been reused by a successor by then)
                 weakref.finalize(self, _trace.watchdog.unregister,
                                  self._watchdog_probe, age)
+                from ..distributed.embed_cache import register_stall_probe
+                for cache in self._embed_caches:
+                    # a late host row fetch stalls the worker exactly
+                    # like a stuck queue — same threshold, its own
+                    # prefetch-stall probe (ISSUE 12)
+                    register_stall_probe(
+                        self,
+                        'serving/%s/embed_cache/%s/prefetch_stall'
+                        % (self.name, cache.var),
+                        cache, self.config.watchdog_stall_s)
         return self
 
     def _stall_context(self):
@@ -676,6 +730,41 @@ class InferenceEngine(object):
         if not isinstance(v, jax.Array):
             return 0, 0
         return int(v.nbytes), self._shard_nbytes(v)
+
+    def embed_cache_of(self, var_name):
+        """This engine's two-tier cache serving ``var_name`` (ISSUE
+        12); KeyError when the var is not cached."""
+        for cache in self._embed_caches:
+            if cache.var == var_name:
+                return cache
+        raise KeyError('engine %r has no embed cache for %r'
+                       % (self.name, var_name))
+
+    def embed_cache_live_bytes(self, var_name):
+        """Live DEVICE bytes of one cache's slabs (weight + optimizer
+        accumulators) — the ``:embed-cache`` account's live
+        correction; 0 while the slabs sit on host."""
+        import jax
+        cache = self.embed_cache_of(var_name)
+        total = 0
+        for name in cache.tables:
+            var = self._scope.find_var(name)
+            v = var.value() if var is not None else None
+            if isinstance(v, jax.Array):
+                total += self._shard_nbytes(v)
+        return total
+
+    def evict_embed_cache_to_host(self, var_name):
+        """Demote ONE two-tier cache's slabs to host under a paused
+        window (ISSUE 12; the arbiter's ``:embed-cache`` evict
+        callback).  The flush inside first applies any staged exchange
+        and writes every dirty row back to the host master — no torn
+        slab even with a prefetch in flight — then the slabs demote
+        bitwise and the next dispatch re-stages them transparently.
+        Returns the bytes freed."""
+        cache = self.embed_cache_of(var_name)
+        with self.paused():
+            return cache.evict_to_host()
 
     def evict_table_to_host(self, var_name):
         """Demote ONE mesh-row-sharded embedding table to host under a
@@ -911,6 +1000,11 @@ class InferenceEngine(object):
             pending=len(self._gen_ready),
             inflight_scans=len(self._decode_inflight))
             if self._decode_cache is not None else None)
+        # the two-tier embedding cache's counters (ISSUE 12):
+        # hit/miss/stall/writeback per cached table
+        snap['embed_cache'] = ({c.var: c.metrics()
+                                for c in self._embed_caches}
+                               if self._embed_caches else None)
         # per-signature service profile + the rate pair the adaptive
         # watermarks read (ISSUE 9)
         snap['service_profile'] = self._profile.snapshot()
@@ -1176,18 +1270,31 @@ class InferenceEngine(object):
             lot_kind=lots[0].kind,
             bucket=lots[0].bucket, sig=repr(lots[0].sig)[:128],
             rows=[lot.real for lot in lots], trace_ids=trace_ids)
+        feed_list = [l.feed for l in lots]
         try:
+            if self._embed_caches and not prefill:
+                # inference lookups ride the SAME hot-row slab (ISSUE
+                # 12): remap the lots' id feeds to slots (copies — an
+                # errored lot must keep its raw ids) and land the
+                # exchange before the dispatch that reads the slab.
+                # train=False: serving never dirties rows, evictions
+                # are free.  A staging fault (capacity, out-of-range
+                # ids) errors the lot's futures, never the worker.
+                feed_list = [dict(f) for f in feed_list]
+                for cache in self._embed_caches:
+                    cache.apply(cache.stage_feed_list(
+                        feed_list, train=False, steps=len(feed_list)))
             with self._gated():
                 if self._pe is not None:
                     stacked, reals, target, compiled, k = \
                         runner._dispatch_eval_multi(
                             fetch_list,
-                            feed_list=[l.feed for l in lots])
+                            feed_list=feed_list)
                 else:
                     stacked, reals, target, compiled, k = \
                         self._exe._dispatch_eval_multi(
                             program,
-                            feed_list=[l.feed for l in lots],
+                            feed_list=feed_list,
                             fetch_list=fetch_list, scope=self._scope)
         except Exception as exc:
             self._metrics.note_error()

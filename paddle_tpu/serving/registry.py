@@ -90,6 +90,16 @@ DECODE_CACHE_SUFFIX = ':decode-cache'
 # model's own full-size seed and draws the typed HBMBudgetError at load
 EMBED_TABLE_SUFFIX = ':embed-table'
 
+# a TWO-TIER cached table's account (ISSUE 12) bills the ``[C, D]`` HBM
+# hot-row slab set (weight + optimizer accumulators), NOT the [V, D]
+# master — that stays host-resident in the cache's AsyncSparseEmbedding
+# tier.  `<model>:embed-cache:<var>` — a table bigger than the WHOLE
+# mesh budget therefore ADMITS with overflow='host' semantics, while the
+# identical program served without the cache keeps the full table in its
+# model seed and draws the typed HBMBudgetError (the PR 10 behavior,
+# now the pinned counterfactual).
+EMBED_CACHE_SUFFIX = ':embed-cache'
+
 
 def _row_sharded_tables(engine):
     """``{var_name: (global_bytes, per_device_bytes)}`` for every
@@ -128,7 +138,7 @@ def _row_sharded_tables(engine):
 class _ModelEntry(object):
     __slots__ = ('name', 'engine', 'dirname', 'loaded_t', 'requests',
                  'rows', 'first_req_t', 'last_req_t', 'overload_rejects',
-                 'table_accounts')
+                 'table_accounts', 'embed_cache_accounts')
 
     def __init__(self, name, engine, dirname):
         self.name = name
@@ -143,6 +153,9 @@ class _ModelEntry(object):
         # {account_name: table var name} for mesh-row-sharded embedding
         # tables (ISSUE 11) — per-device-charged sibling accounts
         self.table_accounts = {}
+        # {account_name: table var name} for two-tier cached tables
+        # (ISSUE 12) — slab-bytes-charged sibling accounts
+        self.embed_cache_accounts = {}
 
 
 class ModelRegistry(object):
@@ -184,7 +197,8 @@ class ModelRegistry(object):
 
     def load(self, name, dirname=None, program=None, feed_names=None,
              fetch_list=None, scope=None, executor=None, config=None,
-             model_filename=None, params_filename=None, generation=None):
+             model_filename=None, params_filename=None, generation=None,
+             embed_caches=None):
         """Load a model under ``name``: either a save_inference_model
         ``dirname`` (own scope + executor, the production form) or an
         explicit ``program`` (+ fetch_list, and a scope holding its
@@ -218,6 +232,11 @@ class ModelRegistry(object):
                         'prefill/step programs reference live '
                         'Variables, which a saved-model dir cannot '
                         'carry)' % name)
+                if embed_caches:
+                    raise ValueError(
+                        'load(%r): embed_caches= requires program= '
+                        '(the cache is bound to a live scope holding '
+                        'the slab vars)' % name)
                 engine = InferenceEngine.from_saved_model(
                     dirname, place=self.place,
                     model_filename=model_filename,
@@ -232,7 +251,8 @@ class ModelRegistry(object):
                     program, feed_names=feed_names, fetch_list=fetch_list,
                     place=self.place, scope=scope, executor=executor,
                     parallel=self.parallel, mesh=self.mesh,
-                    config=cfg, name=name, generation=generation)
+                    config=cfg, name=name, generation=generation,
+                    embed_caches=embed_caches)
             else:
                 raise ValueError('load(): pass dirname= or program=')
             cache_account = name + DECODE_CACHE_SUFFIX
@@ -240,6 +260,10 @@ class ModelRegistry(object):
             table_accounts = {
                 '%s%s:%s' % (name, EMBED_TABLE_SUFFIX, var): var
                 for var in tables
+            }
+            embed_cache_accounts = {
+                '%s%s:%s' % (name, EMBED_CACHE_SUFFIX, c.var): c.var
+                for c in engine._embed_caches
             }
             try:
                 for var in tables:
@@ -267,9 +291,23 @@ class ModelRegistry(object):
                     # and draws the typed reject below
                     seed = max(
                         seed - sum(g for g, _ in tables.values()), 1024)
+                if engine._embed_caches:
+                    # TWO-TIER cached tables (ISSUE 12): the [V, D]
+                    # master never goes on device — it moves out of the
+                    # seed entirely, and the slab-sized account below
+                    # is what the budget arbitrates.  A table past the
+                    # WHOLE mesh budget therefore admits with the host
+                    # overflow tier; the identical non-overflow program
+                    # keeps it in the seed and draws the typed reject.
+                    seed = max(
+                        seed - sum(c.master_nbytes()
+                                   for c in engine._embed_caches), 1024)
                 self.arbiter.admit(name, seed)
                 for acct, var in table_accounts.items():
                     self.arbiter.admit(acct, tables[var][1])
+                for acct, var in embed_cache_accounts.items():
+                    self.arbiter.admit(
+                        acct, engine.embed_cache_of(var).slab_nbytes())
                 if engine._decode_cache is not None:
                     # the decode-state cache is a FIRST-CLASS account:
                     # its slab bytes are exact (static slot shapes), and
@@ -281,11 +319,14 @@ class ModelRegistry(object):
                             engine._decode_cache.slots))
                 entry = _ModelEntry(name, engine, dirname)
                 entry.table_accounts = table_accounts
+                entry.embed_cache_accounts = embed_cache_accounts
                 self._models[name] = entry
                 # make room NOW (evicting LRU peers), so the first
                 # request pays staging, not arbitration
                 self.arbiter.ensure(name, self._evict_to_host)
                 for acct in table_accounts:
+                    self.arbiter.ensure(acct, self._evict_to_host)
+                for acct in embed_cache_accounts:
                     self.arbiter.ensure(acct, self._evict_to_host)
                 if engine._decode_cache is not None:
                     self.arbiter.ensure(cache_account,
@@ -298,6 +339,8 @@ class ModelRegistry(object):
                 self.arbiter.drop(name)
                 self.arbiter.drop(cache_account)
                 for acct in table_accounts:
+                    self.arbiter.drop(acct)
+                for acct in embed_cache_accounts:
                     self.arbiter.drop(acct)
                 self._models.pop(name, None)
                 engine.stop()
@@ -317,6 +360,8 @@ class ModelRegistry(object):
             self.arbiter.drop(name)
             self.arbiter.drop(name + DECODE_CACHE_SUFFIX)
             for acct in entry.table_accounts:
+                self.arbiter.drop(acct)
+            for acct in entry.embed_cache_accounts:
                 self.arbiter.drop(acct)
         entry.engine.stop()
 
@@ -655,6 +700,14 @@ class ModelRegistry(object):
             # PER-DEVICE share — the unit its account is charged in
             owner, _, var = victim.partition(EMBED_TABLE_SUFFIX + ':')
             return self._models[owner].engine.evict_table_to_host(var)
+        if EMBED_CACHE_SUFFIX + ':' in victim:
+            # a two-tier cache's slabs demote on their OWN (ISSUE 12):
+            # paused-window flush (dirty rows back to the host master,
+            # any staged exchange applied first) + bitwise slab
+            # demotion; the next dispatch re-stages transparently
+            owner, _, var = victim.partition(EMBED_CACHE_SUFFIX + ':')
+            return self._models[owner].engine.evict_embed_cache_to_host(
+                var)
         entry = self._models[victim]
         moved, _ = entry.engine.evict_to_host()
         return moved
@@ -675,7 +728,7 @@ class ModelRegistry(object):
         an eviction."""
         with self._lock:
             entry = self._entry(name)
-            if entry.table_accounts:
+            if entry.table_accounts or entry.embed_cache_accounts:
                 # sharded-table engines bill the model account at the
                 # shard-aware PER-DEVICE footprint (the budget is one
                 # chip's HBM — a trainer scope's co-sharded moments
@@ -688,9 +741,15 @@ class ModelRegistry(object):
                 _, per_dev = entry.engine.table_live_bytes(var)
                 footprint = max(footprint - per_dev, 0)
                 self.arbiter.correct(acct, per_dev)
+            for acct, var in entry.embed_cache_accounts.items():
+                live = entry.engine.embed_cache_live_bytes(var)
+                footprint = max(footprint - live, 0)
+                self.arbiter.correct(acct, live)
             self.arbiter.correct(name, footprint)
             self.arbiter.ensure(name, self._evict_to_host)
             for acct in entry.table_accounts:
+                self.arbiter.ensure(acct, self._evict_to_host)
+            for acct in entry.embed_cache_accounts:
                 self.arbiter.ensure(acct, self._evict_to_host)
             if decode:
                 cache = name + DECODE_CACHE_SUFFIX

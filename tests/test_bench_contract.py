@@ -397,6 +397,32 @@ def test_ctr_cpu_smoke_trains_and_serves():
     # charged at the PER-DEVICE shard, not the global table
     assert accounts[acct]['bytes'] < srv['table_bytes']
     assert accounts[acct]['resident'] is True
+    # ISSUE 12: the two-tier hot-row cache block — overlapped prefetch
+    # really fired (> 0 is also asserted inside the block itself), the
+    # skewed stream hits, and the host traffic stays a fraction of a
+    # full per-step exchange
+    cb = rec['cache']
+    assert cb['prefetch_overlap_ratio'] > 0
+    assert cb['hit_rate'] >= 0.8
+    assert cb['exchanges'] >= 2
+    assert cb['slab_bytes'] < cb['table_bytes']
+    assert cb['rows_per_sec'] > 0
+
+
+def test_ctr_cache_block_wired():
+    """ISSUE 12 structural pins (no jax in this test): the ctr config's
+    cache block drives the two-tier store through a FeedPipeline (the
+    staging-thread prefetch is what the overlap ratio measures), pins
+    overlap > 0 in the block itself, and reports the cache
+    deliverables."""
+    import inspect
+    from bench import bench_ctr, _ctr_cache_block
+    assert "'cache'" in inspect.getsource(bench_ctr)
+    src = inspect.getsource(_ctr_cache_block)
+    for pin in ('CachedEmbeddingTable', 'FeedPipeline', 'embed_caches',
+                "'prefetch_overlap_ratio'", "'hit_rate'",
+                "'host_bytes_per_step'", 'hot_frac'):
+        assert pin in src, pin
 
 
 def test_no_tmp_sidecars_in_repo_root():

@@ -1,0 +1,684 @@
+"""Two-tier hot-row embedding cache tests (ISSUE 12): HBM slab in
+front of a host-resident master, exchange correctness, overlapped
+prefetch, eviction-vs-prefetch races, and the registry's
+``:embed-cache`` admission counterfactual."""
+
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.dataset import ctr as ctr_data
+from paddle_tpu.distributed import (AsyncSparseEmbedding,
+                                    CachedEmbeddingTable,
+                                    EmbedCacheCapacityError)
+from paddle_tpu.models import ctr as ctr_model
+
+VOCAB, EMBED, CAP = 2048, 8, 1024
+
+
+def _build(optimizer=None, vocab=VOCAB, hidden=(16, )):
+    with fluid.unique_name.guard():
+        m = ctr_model.build(
+            sparse_dim=vocab, embed_size=EMBED, hidden_sizes=hidden,
+            is_sparse=True,
+            optimizer=optimizer or fluid.optimizer.SGD(learning_rate=0.05))
+    m['main'].random_seed = 0
+    m['startup'].random_seed = 0
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(m['startup'])
+    return m, scope
+
+
+def _feeds(n, batch=16, seed=0, vocab=VOCAB, hot_frac=None):
+    rng = np.random.RandomState(seed)
+    return [ctr_data.zipf_batch(rng, batch, vocab, hot_frac=hot_frac)
+            for _ in range(n)]
+
+
+def _scope_params(scope, skip=('ctr_embedding', )):
+    out = {}
+    for n in scope.local_var_names():
+        v = np.asarray(scope.find_var(n).value())
+        if v.dtype.kind == 'f' and n not in skip:
+            out[n] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# unit: exchange plumbing
+# ---------------------------------------------------------------------------
+
+def test_exchange_width_and_pad():
+    from paddle_tpu.ops.sparse import exchange_width, pad_exchange
+    assert [exchange_width(n) for n in (0, 1, 2, 3, 4, 5, 9)] == \
+        [1, 1, 2, 4, 4, 8, 16]
+    padded = pad_exchange([3, 1], 8, 100)
+    assert padded.dtype == np.int32 and padded.shape == (8, )
+    assert padded.tolist() == [3, 1, 100, 100, 100, 100, 100, 100]
+
+
+def test_async_sparse_fetch_write_rows():
+    host = AsyncSparseEmbedding(10, 3, table=np.arange(30, dtype='float32')
+                                .reshape(10, 3))
+    got = host.fetch_rows([2, 5])
+    np.testing.assert_array_equal(got, [[6, 7, 8], [15, 16, 17]])
+    host.write_rows([5], [[0., 0., 0.]])
+    np.testing.assert_array_equal(host.fetch_rows([5]), [[0., 0., 0.]])
+    assert host.shape == (10, 3) and host.nbytes == 120
+    host.close()
+    from paddle_tpu.distributed import AsyncSparseClosedError
+    with pytest.raises(AsyncSparseClosedError):
+        host.write_rows([1], [[1., 1., 1.]])
+
+
+def test_cache_remap_lru_and_dirty_writeback():
+    """The directory's core contract: hits remap to stable slots,
+    misses evict LRU rows the block does not touch, only DIRTY
+    (trained) evicted rows write back, clean rows are free."""
+    scope = fluid.core.Scope()
+    master = np.arange(64 * 4, dtype='float32').reshape(64, 4)
+    scope.var('tab').set_value(master.copy())
+
+    class _Prog(object):
+        def global_block(self):
+            class _B(object):
+                ops = []
+            return _B()
+
+    cache = CachedEmbeddingTable.from_scope(scope, _Prog(), 'tab', 8,
+                                            ['ids'])
+    feeds = [{'ids': np.array([0, 1, 2, 3], 'int64')}]
+    rem, ex = cache.stage_block(feeds, train=True)
+    cache.apply(ex)
+    slab = np.asarray(scope.find_var('tab').value())
+    np.testing.assert_array_equal(slab[rem[0]['ids']], master[:4])
+    # "train" rows 0..3 on device
+    slab2 = slab.copy()
+    slab2[rem[0]['ids']] += 100.0
+    scope.find_var('tab').set_value(slab2)
+    # an INFERENCE block touches 4..11: fills the slab, then evicts —
+    # its own rows are clean, so evicting them writes nothing back
+    rem2, ex2 = cache.stage_block(
+        [{'ids': np.arange(4, 12, dtype='int64')}], train=False)
+    cache.apply(ex2)
+    rem3, ex3 = cache.stage_block(
+        [{'ids': np.arange(12, 18, dtype='int64')}], train=False)
+    cache.apply(ex3)
+    cache.flush()
+    t = cache.table()
+    exp = master.copy()
+    exp[:4] += 100.0
+    np.testing.assert_array_equal(t, exp)
+    m = cache.metrics()
+    assert m['misses'] == 18 and m['hits'] == 0
+    cache.close()
+    assert cache.closed
+
+
+def test_capacity_typed_rejects():
+    m, scope = _build()
+    cache = CachedEmbeddingTable.from_scope(scope, m['main'],
+                                            'ctr_embedding', 64,
+                                            ['sparse_ids'])
+    with pytest.raises(EmbedCacheCapacityError) as ei:
+        cache.stage_block([{'sparse_ids':
+                            np.arange(65, dtype='int64')}])
+    assert ei.value.capacity == 64 and ei.value.unique_rows == 65
+    cache.close()
+    m2, scope2 = _build()
+    with pytest.raises(ValueError, match='capacity'):
+        CachedEmbeddingTable.from_scope(scope2, m2['main'],
+                                        'ctr_embedding', VOCAB * 2,
+                                        ['sparse_ids'])
+
+
+# ---------------------------------------------------------------------------
+# training parity: cached == full-table, through run_multi on both
+# executors
+# ---------------------------------------------------------------------------
+
+_OPTS = {
+    'sgd': lambda: fluid.optimizer.SGD(learning_rate=0.05),
+    'momentum': lambda: fluid.optimizer.Momentum(learning_rate=0.05,
+                                                 momentum=0.9),
+    'adam': lambda: fluid.optimizer.Adam(learning_rate=1e-2),
+    'adagrad': lambda: fluid.optimizer.Adagrad(learning_rate=0.05),
+}
+
+
+def _train_cpu(cached, opt_fn, feeds, k=4):
+    m, scope = _build(opt_fn())
+    exe = fluid.Executor(fluid.CPUPlace())
+    cache = None
+    if cached:
+        cache = CachedEmbeddingTable.from_scope(
+            scope, m['main'], 'ctr_embedding', CAP, ['sparse_ids'])
+    with fluid.scope_guard(scope):
+        for blk in range(len(feeds) // k):
+            exe.run_multi(m['main'],
+                          feed_list=[dict(f)
+                                     for f in feeds[blk * k:(blk + 1) * k]],
+                          fetch_list=[m['loss']],
+                          embed_caches=[cache] if cache else None)
+    if cache:
+        table = cache.table()
+        aux = {n: cache.table(n) for n in cache.tables[1:]}
+        metrics = cache.metrics()
+        cache.close()
+        params = {n: v for n, v in _scope_params(scope).items()
+                  if n not in aux}
+    else:
+        table = np.asarray(scope.find_var('ctr_embedding').value())
+        metrics = None
+        params = _scope_params(scope)
+        aux = None
+    return table, params, aux, metrics
+
+
+@pytest.mark.parametrize('opt_name', sorted(_OPTS))
+def test_cached_train_parity_cpu(opt_name):
+    """Cached-vs-full-table multi-dispatch training over one skewed
+    stream: the flushed host master must equal the full-table result —
+    BITWISE (the slab holds exactly the rows the full table would, and
+    the row-subset math runs on identical values; merge order is
+    preserved because distinct ids map to distinct slots)."""
+    feeds = _feeds(12)
+    t_cached, p_cached, aux, metrics = _train_cpu(True, _OPTS[opt_name],
+                                                  feeds)
+    t_plain, p_plain, _, _ = _train_cpu(False, _OPTS[opt_name], feeds)
+    np.testing.assert_array_equal(t_cached, t_plain)
+    for n in p_cached:
+        np.testing.assert_allclose(p_cached[n], p_plain[n],
+                                   rtol=1e-5, atol=1e-6, err_msg=n)
+    # optimizer accumulators rode the cache too: their flushed host
+    # masters match the full-table lane's accumulator vars
+    for n, v in (aux or {}).items():
+        np.testing.assert_array_equal(
+            v, p_plain[n], err_msg='accumulator %s diverged' % n)
+    if opt_name != 'sgd':
+        assert aux, 'adaptive optimizers must co-cache accumulators'
+    # the stream re-touches hot rows: the cache must actually be hitting
+    assert metrics['hits'] > 0 and metrics['hit_rate'] > 0.3
+    assert metrics['exchanges'] >= 1
+
+
+def test_cached_train_parity_mesh():
+    """The same parity on the 8-dev virtual {dp:4, mp:2} mesh through
+    ParallelExecutor.run_multi — the slab is dp-replicated (no
+    annotation) and the exchange's gather/scatter runs on the sharded
+    value."""
+    import jax
+    from paddle_tpu import parallel
+    feeds = _feeds(8, batch=16)
+
+    def train(cached):
+        m, scope = _build()
+        mesh = parallel.make_mesh({'dp': 4, 'mp': 2}, jax.devices()[:8])
+        cache = None
+        if cached:
+            cache = CachedEmbeddingTable.from_scope(
+                scope, m['main'], 'ctr_embedding', CAP, ['sparse_ids'])
+        pe = fluid.ParallelExecutor(loss_name=m['loss'].name,
+                                    main_program=m['main'], scope=scope,
+                                    mesh=mesh)
+        for blk in range(2):
+            pe.run_multi([m['loss'].name],
+                         feed_list=[dict(f)
+                                    for f in feeds[blk * 4:(blk + 1) * 4]],
+                         embed_caches=[cache] if cache else None)
+        if cache:
+            table = cache.table()
+            cache.close()
+        else:
+            table = np.asarray(scope.find_var('ctr_embedding').value())
+        return table, _scope_params(scope)
+
+    t_cached, p_cached = train(True)
+    t_plain, p_plain = train(False)
+    np.testing.assert_allclose(t_cached, t_plain, rtol=1e-6, atol=1e-7)
+    for n in p_cached:
+        np.testing.assert_allclose(p_cached[n], p_plain[n],
+                                   rtol=1e-5, atol=1e-6, err_msg=n)
+
+
+def test_mp_row_sharded_slab():
+    """The overflow tier composes with PR 10's mesh sharding: the SLAB
+    itself row-shards over 'mp' (capacity divides the extent), the
+    exchange operates on the sharded value, and parity holds."""
+    import jax
+    from paddle_tpu import parallel
+    feeds = _feeds(8, batch=16, seed=3)
+
+    def train(cached):
+        m, scope = _build()
+        mesh = parallel.make_mesh({'dp': 4, 'mp': 2}, jax.devices()[:8])
+        cache = None
+        if cached:
+            cache = CachedEmbeddingTable.from_scope(
+                scope, m['main'], 'ctr_embedding', CAP, ['sparse_ids'],
+                multiple=2)
+            # annotate the program var: the [C, D] slab lays out
+            # row-sharded over 'mp' exactly like a PR 10 table
+            parallel.shard(m['main'].global_block().var('ctr_embedding'),
+                           'mp', None)
+        pe = fluid.ParallelExecutor(loss_name=m['loss'].name,
+                                    main_program=m['main'], scope=scope,
+                                    mesh=mesh)
+        for blk in range(2):
+            pe.run_multi([m['loss'].name],
+                         feed_list=[dict(f)
+                                    for f in feeds[blk * 4:(blk + 1) * 4]],
+                         embed_caches=[cache] if cache else None)
+        if cached:
+            slab = scope.find_var('ctr_embedding').value()
+            assert hasattr(slab, 'sharding') and \
+                not slab.sharding.is_fully_replicated, \
+                'the slab must really row-shard over the mesh'
+            table = cache.table()
+            cache.close()
+        else:
+            table = np.asarray(scope.find_var('ctr_embedding').value())
+        return table
+
+    np.testing.assert_allclose(train(True), train(False),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_scope_mismatch_typed():
+    m, scope = _build()
+    cache = CachedEmbeddingTable.from_scope(scope, m['main'],
+                                            'ctr_embedding', CAP,
+                                            ['sparse_ids'])
+    try:
+        other = fluid.core.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(other):
+            with pytest.raises(ValueError, match='scope'):
+                exe.run_multi(m['main'],
+                              feed_list=[dict(_feeds(1)[0])],
+                              fetch_list=[m['loss']],
+                              embed_caches=[cache])
+        # the check fired BEFORE any staging: the mis-bound run must
+        # not skew the cache's directory or its hit-rate accounting
+        cm = cache.metrics()
+        assert cm['lookups'] == 0 and cm['exchanges'] == 0 and \
+            cm['resident'] == 0, cm
+    finally:
+        cache.close()
+
+
+def test_misbound_second_cache_stages_nothing_spmd():
+    """The check-before-ANY-staging invariant on the SPMD path: with
+    [ok_cache, misbound_cache], the typed reject fires before ok_cache
+    stages — its directory and hit-rate accounting stay untouched by
+    the block that never dispatched."""
+    import jax
+    from paddle_tpu import parallel
+    m, scope = _build()
+    ok = CachedEmbeddingTable.from_scope(scope, m['main'],
+                                         'ctr_embedding', CAP,
+                                         ['sparse_ids'])
+    m2, scope2 = _build()
+    misbound = CachedEmbeddingTable.from_scope(scope2, m2['main'],
+                                               'ctr_embedding', CAP,
+                                               ['sparse_ids'])
+    try:
+        mesh = parallel.make_mesh({'dp': 4, 'mp': 2}, jax.devices()[:8])
+        pe = fluid.ParallelExecutor(loss_name=m['loss'].name,
+                                    main_program=m['main'], scope=scope,
+                                    mesh=mesh)
+        with pytest.raises(ValueError, match='scope'):
+            pe.run_multi([m['loss'].name],
+                         feed_list=[dict(_feeds(1)[0])],
+                         embed_caches=[ok, misbound])
+        cm = ok.metrics()
+        assert cm['lookups'] == 0 and cm['exchanges'] == 0 and \
+            cm['resident'] == 0, cm
+    finally:
+        ok.close()
+        misbound.close()
+
+
+def test_generation_engine_rejects_embed_caches():
+    """Prefill/decode dispatches do not remap ids to slots — the
+    combination is a typed fail-fast at construction, not silent
+    garbage embeddings mid-generation."""
+    from paddle_tpu import serving
+    m, scope = _build()
+    cache = CachedEmbeddingTable.from_scope(scope, m['test'],
+                                            'ctr_embedding', CAP,
+                                            ['sparse_ids'])
+    try:
+        with pytest.raises(NotImplementedError, match='generation'):
+            serving.InferenceEngine(
+                m['test'], feed_names=m['feeds'],
+                fetch_list=[m['prediction']], place=fluid.CPUPlace(),
+                scope=scope, embed_caches=[cache],
+                generation=object())
+    finally:
+        cache.close()
+
+
+def test_uncovered_optimizer_typed_reject():
+    """An optimizer with no row-subset kernel (rmsprop here) would
+    fall back to the lazy-dense [V, D] materialization against the
+    [C, D] slab — an opaque jit shape crash.  The cache rejects the
+    combination typed, at construction."""
+    m, scope = _build(fluid.optimizer.RMSProp(learning_rate=0.05))
+    with pytest.raises(ValueError, match='row-subset'):
+        CachedEmbeddingTable.from_scope(scope, m['main'],
+                                        'ctr_embedding', CAP,
+                                        ['sparse_ids'])
+
+
+# ---------------------------------------------------------------------------
+# overlapped prefetch: the FeedPipeline staging-thread hook
+# ---------------------------------------------------------------------------
+
+def test_feed_pipeline_prefetch_parity_and_metrics():
+    """FeedPipeline(embed_caches=) == synchronous run_multi cached ==
+    full table: the staging-thread prefetch changes WHEN the exchange
+    runs, never what it computes.  The pipeline's metrics surface the
+    cache block."""
+    feeds = _feeds(12, seed=5)
+    t_sync, p_sync, _, _ = _train_cpu(True, _OPTS['sgd'], feeds)
+
+    m, scope = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    cache = CachedEmbeddingTable.from_scope(
+        scope, m['main'], 'ctr_embedding', CAP, ['sparse_ids'])
+    with fluid.scope_guard(scope):
+        pipe = fluid.FeedPipeline(exe, [m['loss']], program=m['main'],
+                                  source=iter([dict(f) for f in feeds]),
+                                  steps=4, scope=scope,
+                                  embed_caches=[cache])
+        outs = pipe.run()
+        pm = pipe.metrics()
+    assert len(outs) == 3
+    assert 'embed_cache' in pm and 'ctr_embedding' in pm['embed_cache']
+    cm = pm['embed_cache']['ctr_embedding']
+    assert cm['exchanges'] >= 1
+    # every exchange either overlapped or was a counted stall — the
+    # two outcomes partition the exchanges
+    assert cm['prefetch_overlapped'] + cm['prefetch_stalls'] == \
+        cm['exchanges']
+    t_pipe = cache.table()
+    cache.close()
+    np.testing.assert_array_equal(t_pipe, t_sync)
+    for n, v in _scope_params(scope).items():
+        np.testing.assert_array_equal(v, p_sync[n], err_msg=n)
+
+
+def test_prefetch_stall_counted_never_corrupting():
+    """The delayed-host-fetch fault injection (the ISSUE 12 acceptance
+    pin): a master-table fetch that cannot finish ahead of the
+    dispatch is a COUNTED prefetch_stall — the dispatch waits, and the
+    final params stay bitwise-identical to the unmolested lane."""
+    feeds = _feeds(12, seed=9)
+    t_ref, p_ref, _, _ = _train_cpu(True, _OPTS['sgd'], feeds)
+
+    m, scope = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    cache = CachedEmbeddingTable.from_scope(
+        scope, m['main'], 'ctr_embedding', CAP, ['sparse_ids'])
+    real_fetch = cache._host.fetch_rows
+
+    def slow_fetch(ids):
+        time.sleep(0.15)
+        return real_fetch(ids)
+
+    cache._host.fetch_rows = slow_fetch
+    with fluid.scope_guard(scope):
+        pipe = fluid.FeedPipeline(exe, [m['loss']], program=m['main'],
+                                  source=iter([dict(f) for f in feeds]),
+                                  steps=4, scope=scope,
+                                  embed_caches=[cache])
+        pipe.run()
+    cm = cache.metrics()
+    assert cm['prefetch_stalls'] >= 1, cm
+    t = cache.table()
+    cache.close()
+    np.testing.assert_array_equal(t, t_ref)
+    for n, v in _scope_params(scope).items():
+        np.testing.assert_array_equal(v, p_ref[n], err_msg=n)
+
+
+# ---------------------------------------------------------------------------
+# eviction racing an in-flight prefetch exchange (the satellite pin)
+# ---------------------------------------------------------------------------
+
+def _evict_race_lane(mesh=None):
+    """Train one block, stage a SECOND block's exchange (prefetch in
+    flight, not yet applied), then flush + demote mid-pipeline —
+    finally dispatch the staged block and a third.  Returns the final
+    host truth; compared against a lane that never evicted."""
+    import jax
+    from paddle_tpu import parallel
+    feeds = _feeds(12, seed=11)
+    k = 4
+
+    def run(evict):
+        m, scope = _build()
+        cache = CachedEmbeddingTable.from_scope(
+            scope, m['main'], 'ctr_embedding', CAP, ['sparse_ids'])
+        if mesh is not None:
+            runner = fluid.ParallelExecutor(
+                loss_name=m['loss'].name, main_program=m['main'],
+                scope=scope,
+                mesh=parallel.make_mesh({'dp': 4, 'mp': 2},
+                                        jax.devices()[:8]))
+            dispatch = lambda fl: runner.run_multi(
+                [m['loss'].name], feed_list=fl, embed_caches=[cache])
+        else:
+            exe = fluid.Executor(fluid.CPUPlace())
+
+            def dispatch(fl):
+                with fluid.scope_guard(scope):
+                    exe.run_multi(m['main'], feed_list=fl,
+                                  fetch_list=[m['loss']],
+                                  embed_caches=[cache])
+        blocks = [[dict(f) for f in feeds[i * k:(i + 1) * k]]
+                  for i in range(3)]
+        dispatch(blocks[0])
+        if evict:
+            # stage block 1's exchange by hand (the prefetch is now in
+            # flight against the post-block-0 residency), then the
+            # paused-window flush runs UNDER it — apply-early, write
+            # back dirty rows, demote the slabs bitwise
+            prepared = [
+                {'sparse_ids': np.asarray(b['sparse_ids'])}
+                for b in blocks[1]]
+            ex = cache.stage_block(prepared, train=True)[1]
+            moved = cache.evict_to_host()
+            assert moved > 0
+            assert ex is None or ex.applied, \
+                'flush must apply the in-flight exchange'
+            # the staged block dispatches AFTER the eviction: remap
+            # again (residency is intact — ids stayed mapped)
+            remapped, ex2 = cache.stage_block(
+                [{'sparse_ids': np.asarray(b['sparse_ids'])}
+                 for b in blocks[1]], train=True)
+            assert ex2 is None, 'no new rows should miss'
+            for b, r in zip(blocks[1], remapped):
+                b['sparse_ids'] = r['sparse_ids']
+            # dispatch WITHOUT the cache hook (already staged by hand)
+            if mesh is not None:
+                runner.run_multi([m['loss'].name], feed_list=blocks[1])
+            else:
+                with fluid.scope_guard(scope):
+                    exe.run_multi(m['main'], feed_list=blocks[1],
+                                  fetch_list=[m['loss']])
+        else:
+            dispatch(blocks[1])
+        dispatch(blocks[2])
+        t = cache.table()
+        params = _scope_params(scope)
+        cache.close()
+        return t, params
+
+    t_evict, p_evict = run(True)
+    t_plain, p_plain = run(False)
+    np.testing.assert_array_equal(t_evict, t_plain)
+    for n in p_plain:
+        np.testing.assert_array_equal(p_evict[n], p_plain[n], err_msg=n)
+
+
+def test_evict_races_inflight_exchange_cpu():
+    """evict/flush with a staged-but-unapplied prefetch exchange: the
+    paused-window flush applies it early (value-neutral row movement),
+    writes dirty rows back, demotes bitwise — training resumes with
+    results identical to the never-evicted lane (no torn slab)."""
+    _evict_race_lane(mesh=None)
+
+
+def test_evict_races_inflight_exchange_mesh():
+    _evict_race_lane(mesh=True)
+
+
+def test_engine_evict_embed_cache_races_prefetch():
+    """The ENGINE-level form of the race (the arbiter's evict callback
+    runs under paused()): stage an exchange, evict the cache account's
+    slabs, keep serving — responses bitwise-identical to an engine
+    that was never evicted."""
+    from paddle_tpu import serving
+    reqs = _feeds(6, batch=8, seed=13)
+
+    def serve(evict):
+        m, scope = _build()
+        cache = CachedEmbeddingTable.from_scope(
+            scope, m['test'], 'ctr_embedding', CAP, ['sparse_ids'])
+        eng = serving.InferenceEngine(
+            m['test'], feed_names=m['feeds'],
+            fetch_list=[m['prediction']], place=fluid.CPUPlace(),
+            scope=scope, embed_caches=[cache]).start()
+        outs = [eng.submit(dict(r)).result(60)[0] for r in reqs[:3]]
+        if evict:
+            # an exchange staged against the serving residency...
+            cache.stage_block(
+                [{'sparse_ids': np.asarray(reqs[3]['sparse_ids'])}],
+                train=False)
+            # ...raced by the paused-window eviction
+            moved = eng.evict_embed_cache_to_host('ctr_embedding')
+            assert moved > 0
+        outs += [eng.submit(dict(r)).result(60)[0] for r in reqs[3:]]
+        eng.stop()
+        cache.close()
+        return outs
+
+    for a, b in zip(serve(True), serve(False)):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# serving lot path + registry admission
+# ---------------------------------------------------------------------------
+
+def test_serving_lot_path_hits_cache():
+    """Inference lookups ride the same slab: a cached engine answers
+    bitwise-identically to a plain engine over identical params, and
+    its metrics carry the embed_cache hit counters."""
+    from paddle_tpu import serving
+    reqs = _feeds(6, batch=8, seed=17)
+
+    def serve(cached):
+        m, scope = _build()
+        cache = None
+        if cached:
+            cache = CachedEmbeddingTable.from_scope(
+                scope, m['test'], 'ctr_embedding', CAP, ['sparse_ids'])
+        eng = serving.InferenceEngine(
+            m['test'], feed_names=m['feeds'],
+            fetch_list=[m['prediction']], place=fluid.CPUPlace(),
+            scope=scope,
+            embed_caches=[cache] if cache else None).start()
+        outs = [eng.submit(dict(r)).result(60)[0] for r in reqs]
+        snap = eng.metrics()
+        eng.stop()
+        if cache:
+            cache.close()
+        return outs, snap
+
+    outs_c, snap_c = serve(True)
+    outs_p, snap_p = serve(False)
+    for a, b in zip(outs_c, outs_p):
+        np.testing.assert_array_equal(a, b)
+    cm = snap_c['embed_cache']['ctr_embedding']
+    assert cm['lookups'] > 0 and cm['hits'] > 0
+    assert snap_p['embed_cache'] is None
+
+
+def test_registry_embed_cache_account_and_counterfactual():
+    """The ISSUE 12 admission pin: under a budget BELOW the full
+    table, the overflow-tier load ADMITS (its ``:embed-cache`` account
+    bills slab bytes), while the identical non-overflow program draws
+    the typed HBMBudgetError.  The account is LRU-evictable on its own
+    and survives audit()."""
+    from paddle_tpu import serving
+    from paddle_tpu.serving.arbiter import program_seed_bytes
+    from paddle_tpu.serving.registry import EMBED_CACHE_SUFFIX
+
+    m, scope = _build()
+    cache = CachedEmbeddingTable.from_scope(
+        scope, m['test'], 'ctr_embedding', CAP, ['sparse_ids'])
+    table_bytes = cache.master_nbytes()
+    seed = program_seed_bytes(m['test'], 32)
+    budget = int(seed - table_bytes + cache.slab_nbytes()
+                 + table_bytes // 8)
+    assert budget < seed  # the budget really is below the full table
+    reg = serving.ModelRegistry(place=fluid.CPUPlace(),
+                                hbm_budget_bytes=budget)
+    try:
+        reg.load('ctr', program=m['test'], feed_names=m['feeds'],
+                 fetch_list=[m['prediction']], scope=scope,
+                 embed_caches=[cache])
+        req = _feeds(1, batch=8, seed=19)[0]
+        out1 = reg.submit('ctr', dict(req)).result(60)[0]
+        acct_name = 'ctr%s:ctr_embedding' % EMBED_CACHE_SUFFIX
+        snap = reg.arbiter.snapshot()
+        assert acct_name in snap['accounts'], snap['accounts']
+        acct = snap['accounts'][acct_name]
+        assert acct['resident']
+        # billed at slab bytes (live-corrected) — a fraction of the
+        # master the old path would have billed
+        assert 0 < acct['bytes'] <= cache.slab_nbytes()
+        # LRU-evictable on its OWN: evicting the account demotes only
+        # the slabs, and serving resumes bitwise after re-staging
+        before = reg.arbiter.evictions
+        reg.arbiter.evict(acct_name, reg._evict_to_host)
+        assert reg.arbiter.evictions == before + 1
+        assert not reg.arbiter.snapshot()['accounts'][acct_name][
+            'resident']
+        out2 = reg.submit('ctr', dict(req)).result(60)[0]
+        np.testing.assert_array_equal(out1, out2)
+        audit = reg.audit()
+        assert 'drift_bytes' in audit
+    finally:
+        reg.stop()
+        cache.close()
+
+    # the pinned counterfactual: the identical program with NO
+    # overflow tier keeps the [V, D] table in its seed and is a typed
+    # reject under the same budget
+    m2, scope2 = _build()
+    reg2 = serving.ModelRegistry(place=fluid.CPUPlace(),
+                                 hbm_budget_bytes=budget)
+    try:
+        with pytest.raises(serving.HBMBudgetError):
+            reg2.load('ctr-plain', program=m2['test'],
+                      feed_names=m2['feeds'],
+                      fetch_list=[m2['prediction']], scope=scope2)
+    finally:
+        reg2.stop()
+
+
+def test_registry_load_dirname_rejects_embed_caches():
+    from paddle_tpu import serving
+    reg = serving.ModelRegistry(place=fluid.CPUPlace())
+    try:
+        with pytest.raises(ValueError, match='embed_caches'):
+            reg.load('x', dirname='/nonexistent', embed_caches=[object()])
+    finally:
+        reg.stop()

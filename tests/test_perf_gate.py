@@ -295,6 +295,46 @@ def test_sparse_grad_cpu_smoke(monkeypatch):
     assert rec['grad_bytes_sparse'] < rec['grad_bytes_dense']
 
 
+def test_embed_cache_config_registered():
+    """ISSUE 12 structural pin (runs off-TPU): the embed_cache paired
+    config exists, trains cached-vs-full-table CTR lanes over one
+    identical seeded hot-zipfian stream, asserts table parity BITWISE
+    (SGD exact), and hard-gates hit rate, the measured
+    every-step-exchange host-byte reduction, and the structural
+    temp-bytes-below-one-table check behind their env knobs."""
+    perf_gate, inspect = _import_perf_gate()
+    assert 'embed_cache' in perf_gate.CONFIGS
+    src = inspect.getsource(perf_gate.run_embed_cache)
+    for pin in ("'hit_rate'", 'PERF_GATE_EMBED_HIT_MIN',
+                "'host_bytes_reduction'", 'PERF_GATE_EMBED_HOST_RATIO',
+                'array_equal', 'invalidate', 'temp_bytes',
+                'table_bytes'):
+        assert pin in src, pin
+    build = inspect.getsource(perf_gate.build_embed_cache)
+    assert 'CachedEmbeddingTable' in build
+    assert 'embed_caches' in build
+    assert 'hot_frac' in build and 'zipf' in build
+
+
+def test_embed_cache_cpu_smoke(monkeypatch):
+    """The ISSUE 12 acceptance criterion, functionally on CPU:
+    cached-vs-uncached final params allclose (table BITWISE — SGD
+    exact), hit rate >= 0.9 at the smoke's skew, host bytes/step
+    >= 4x below the measured every-step-exchange lane, and the
+    structural assert that the timed executable's temp bytes stay
+    below one full table — run_embed_cache hard-asserts all of it."""
+    perf_gate, _ = _import_perf_gate()
+    monkeypatch.setenv('PERF_GATE_EC_STEPS', '8')
+    monkeypatch.setattr(perf_gate, 'BLOCKS', 2)
+    rec = perf_gate.run_embed_cache()
+    assert rec['hit_rate'] >= 0.9
+    assert rec['host_bytes_reduction'] >= 4.0
+    assert rec['prefetch_stalls'] >= 0
+    assert rec['slab_bytes'] < rec['table_bytes']
+    assert rec['cached_temp_bytes'] < rec['table_bytes']
+    assert rec['params_checked'] >= 5
+
+
 def test_resnet_infer_and_feed_pipeline_configs_registered():
     """Back-filled structural pins for the two pre-meta-pin paired
     configs (resnet_infer — ISSUE 2's eval-scan dispatch-tax pair;

@@ -177,6 +177,91 @@ def test_edf_sheds_expired_and_unmeetable_requests():
     assert viable.deadline_t is not None and not viable.done()
 
 
+def test_shed_by_class_sheds_lowest_class_first():
+    """Load-shedding by CLASS (ISSUE 12 satellite; ROADMAP item 5
+    leftover): capacity for ~one request within the shared deadline —
+    the default per-request horizon would keep BOTH (each fits alone),
+    serving the low-class one at the high-class one's expense.  With
+    shed_by_class the backlog accumulates in scheduling order, so the
+    LOW class's deadlined request (served last) is the one that sheds;
+    the high class survives."""
+    est = lambda r: 0.06
+    # the counterfactual: per-request horizon admits both
+    mb0 = serving.MicroBatcher(max_batch_size=1, scheduling='edf',
+                               service_estimate_for=est)
+    hi0 = mb0.submit(_req(sig='a', priority=1, deadline_ms=100))
+    lo0 = mb0.submit(_req(sig='b', priority=0, deadline_ms=100))
+    mb0.next_lot(force=True)
+    assert not lo0.done() or lo0._error is None
+    # shed_by_class: the low class's finish = est(hi) + est(lo) > 100ms
+    mb = serving.MicroBatcher(max_batch_size=1, scheduling='edf',
+                              service_estimate_for=est,
+                              shed_by_class=True)
+    hi = mb.submit(_req(sig='a', priority=1, deadline_ms=100))
+    lo = mb.submit(_req(sig='b', priority=0, deadline_ms=100))
+    lot = mb.next_lot(force=True)
+    assert lot == [hi] and not hi.done()
+    with pytest.raises(DeadlineExceededError):
+        lo.result(1)
+
+
+def test_shed_by_class_preserves_same_class_edf_order():
+    """The pinned counterfactual: within ONE class shed_by_class never
+    reorders — survivors form lots in exactly the EDF order the
+    default scheduler produces, and the cumulative walk dooms the
+    LATEST-deadline request of the class first (it is served last)."""
+    est = lambda r: 0.04
+    mb = serving.MicroBatcher(max_batch_size=8, scheduling='edf',
+                              service_estimate_for=est,
+                              shed_by_class=True)
+    r_soon = mb.submit(_req(sig='s', deadline_ms=100))
+    r_mid = mb.submit(_req(sig='s', deadline_ms=200))
+    r_late = mb.submit(_req(sig='s', deadline_ms=130))
+    # cumulative: soon at 40ms ok, mid at 80ms ok, late (EDF-sorted
+    # between them: 130ms deadline) at 80ms ok... walk order is EDF:
+    # soon(100), late(130), mid(200) — cum 40/80/120ms, all meetable
+    lot = mb.next_lot(force=True)
+    assert lot == [r_soon, r_late, r_mid]
+    # now an unmeetable tail: same class, latest deadline — it sheds,
+    # the earlier-deadline peers keep their exact EDF order
+    mb2 = serving.MicroBatcher(max_batch_size=8, scheduling='edf',
+                               service_estimate_for=est,
+                               shed_by_class=True)
+    a = mb2.submit(_req(sig='s', deadline_ms=50))
+    b = mb2.submit(_req(sig='s', deadline_ms=90))
+    c = mb2.submit(_req(sig='s', deadline_ms=100))  # cum 120ms > 100
+    lot2 = mb2.next_lot(force=True)
+    assert lot2 == [a, b]
+    with pytest.raises(DeadlineExceededError):
+        c.result(1)
+
+
+def test_shed_by_class_config_plumbs_and_validates():
+    cfg = serving.ServingConfig(shed_by_class=True)
+    assert cfg.shed_by_class
+    with pytest.raises(ValueError, match='shed_by_class'):
+        serving.ServingConfig(scheduling='fifo', shed_by_class=True)
+    with pytest.raises(ValueError, match='shed_by_class'):
+        serving.MicroBatcher(scheduling='fifo', shed_by_class=True)
+    # the engine hands the knob to its batcher
+    import paddle_tpu.fluid as fluid
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        pred = fluid.layers.fc(x, 4)
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+    eng = serving.InferenceEngine(
+        prog, feed_names=['x'], fetch_list=[pred],
+        place=fluid.CPUPlace(), scope=scope,
+        config=serving.ServingConfig(shed_by_class=True))
+    try:
+        assert eng._batcher.shed_by_class
+    finally:
+        eng.stop()
+
+
 def test_age_stats():
     mb = serving.MicroBatcher(max_batch_size=8)
     assert mb.age_stats() is None

@@ -257,6 +257,11 @@ _OPTIMIZERS = {
     'momentum': lambda: fluid.optimizer.Momentum(learning_rate=0.1,
                                                  momentum=0.9),
     'adam': lambda: fluid.optimizer.Adam(learning_rate=0.05),
+    # ISSUE 12 satellite: the adagrad row-subset kernel (one
+    # accumulator, same gather/merge/scatter shape as momentum) —
+    # parametrizing it here runs the duplicate-id merge parity on CPU
+    # AND the 8-dev mesh, plus the scanned-train-step contract
+    'adagrad': lambda: fluid.optimizer.Adagrad(learning_rate=0.1),
 }
 
 

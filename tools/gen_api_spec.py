@@ -110,6 +110,14 @@ def generate():
     lines += _walk('paddle_tpu.fluid.recordio_writer', fluid.recordio_writer,
                    ['convert_reader_to_recordio_file',
                     'convert_reader_to_recordio_files'])
+    # the distributed runtime surface (ISSUE 12: the two-tier embedding
+    # cache lives here next to its AsyncSparseEmbedding host tier)
+    import paddle_tpu.distributed as distributed
+    lines += _walk('paddle_tpu.distributed', distributed, [
+        'AsyncSparseEmbedding', 'AsyncSparseClosedError',
+        'CachedEmbeddingTable', 'EmbedCacheCapacityError',
+        'optimizer_accumulator_vars',
+    ])
     return sorted(set(lines))
 
 

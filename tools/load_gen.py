@@ -134,6 +134,11 @@ def main(argv=None):
                         'ctr block with rows/s')
     p.add_argument('--ctr-vocab', type=int, default=4096,
                    help='CTR embedding vocab for --ctr-frac traffic')
+    p.add_argument('--ctr-hot-frac', type=float, default=None,
+                   help='sharpen the CTR id skew (ISSUE 12): this '
+                        'fraction of lookups folds into a hot set of '
+                        'vocab/16 ids — the hot-row embedding cache '
+                        'regime (None keeps the plain zipf stream)')
     p.add_argument('--decode-depth', type=int, default=2,
                    help='decode_pipeline_depth of the generation '
                         'model (1 = per-scan-sync baseline)')
@@ -231,9 +236,10 @@ def main(argv=None):
                  fetch_list=[cm['prediction']], scope=cscope)
         ctr_names.append('ctr0')
 
-        def ctr_feed_fn(rng, _v=args.ctr_vocab, _rows=args.rows):
+        def ctr_feed_fn(rng, _v=args.ctr_vocab, _rows=args.rows,
+                        _hot=args.ctr_hot_frac):
             from paddle_tpu.dataset import ctr as ctr_data
-            return ctr_data.zipf_batch(rng, _rows, _v)
+            return ctr_data.zipf_batch(rng, _rows, _v, hot_frac=_hot)
 
     classes = []
     # the forward share splits across the forward models: per-model

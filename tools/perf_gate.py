@@ -11,7 +11,7 @@ max(fw)/max(bd) cross-window pairing).
 Run on TPU hardware:
     python tools/perf_gate.py [resnet|transformer|nmt|resnet_infer|
         feed_pipeline|multi_model|trailing_dim|trace_overhead|decode|
-        decode_overlap|slo|sparse_grad|all]
+        decode_overlap|slo|sparse_grad|embed_cache|all]
 Prints one JSON line per config; tests/test_perf_gate.py drives it and
 skips cleanly off-TPU.  ``resnet_infer`` (ISSUE 2) has no bound side —
 its deliverable is the paired ``multi_vs_dispatch`` block: the measured
@@ -77,6 +77,20 @@ buffer appears in the sparse lane's cost report: its timed
 executable's XLA temp-buffer bytes stay BELOW one table's size while
 the dense lane's meet or exceed it (the counterfactual proving the
 probe sees the buffer).
+``embed_cache`` (ISSUE 12) pairs the TWO-TIER hot-row embedding cache
+(a [C, D] HBM slab + host-resident [V, D] master, ids remapped to
+slots, row exchange between scan dispatches) against full-table
+training over the IDENTICAL seeded hot-zipfian CTR stream.  Final
+params are asserted allclose with the table itself BITWISE (SGD
+exact); the hard gates are ``hit_rate`` >= PERF_GATE_EMBED_HIT_MIN
+(default 0.9) at the smoke's skew, ``host_bytes_reduction`` — the
+MEASURED every-step-exchange lane's host bytes/step (residency
+invalidated before every single-step dispatch: the reference
+remote-updater traffic shape) over the cached lane's — >=
+PERF_GATE_EMBED_HOST_RATIO (default 4.0), and the STRUCTURAL assert
+that the cached lane's timed executable allocates less XLA temp
+memory than one full table (the device working set really is the
+slab).
 ``decode_overlap`` (ISSUE 9) pairs the CHAINED decode lane
 (decode_pipeline_depth >= 2: scan N+1 enqueued against scan N's
 device-resident donated output carry, token blocks harvested while
@@ -1194,6 +1208,192 @@ def run_sparse_grad():
     return rec
 
 
+def build_embed_cache():
+    """Two-tier hot-row embedding cache vs full-table training over the
+    IDENTICAL seeded hot-zipfian CTR stream (ISSUE 12): the CACHED lane
+    holds only a [C, D] slab on device (the [V, D] master is
+    host-resident in AsyncSparseEmbedding; ids remap to slots, the
+    block row exchange runs between dispatches), the UNCACHED lane is
+    the PR 10 fast path with the whole table resident.  SGD is the
+    paired optimizer: its sparse branch is exact, so the cached lane's
+    flushed host table must match the uncached table BITWISE."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+    from paddle_tpu.models import ctr as ctr_model
+    from paddle_tpu.dataset import ctr as ctr_data
+    from paddle_tpu.distributed import CachedEmbeddingTable
+
+    vocab = int(os.environ.get('PERF_GATE_EC_VOCAB', '16384'))
+    embed = int(os.environ.get('PERF_GATE_EC_EMBED', '16'))
+    batch = int(os.environ.get('PERF_GATE_EC_BATCH', '64'))
+    k_steps = int(os.environ.get('PERF_GATE_EC_STEPS', '8'))
+    capacity = int(os.environ.get('PERF_GATE_EC_CAPACITY', '2048'))
+    hot_frac = float(os.environ.get('PERF_GATE_EC_HOT_FRAC', '0.95'))
+    fluid.FLAGS.cost_accounting = True
+    place = fluid.TPUPlace() if core.is_compiled_with_tpu() \
+        else fluid.CPUPlace()
+
+    rng = np.random.RandomState(0)
+    # the smoke's skew: hot-fraction-sharpened zipf (the ONE shared
+    # construction, dataset.ctr.zipf_batch) — the regime where a small
+    # hot-row working set absorbs nearly every lookup
+    feeds = [ctr_data.zipf_batch(rng, batch, vocab, hot_frac=hot_frac)
+             for _ in range(k_steps * (BLOCKS + 1))]
+
+    def lane(cached, capacity=capacity):
+        with fluid.unique_name.guard():
+            m = ctr_model.build(
+                sparse_dim=vocab, embed_size=embed, hidden_sizes=(64, 32),
+                is_sparse=True,
+                optimizer=fluid.optimizer.SGD(learning_rate=0.05))
+        m['main'].random_seed = 0
+        m['startup'].random_seed = 0
+        exe = fluid.Executor(place)
+        scope = fluid.core.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(m['startup'])
+        cache = None
+        if cached:
+            cache = CachedEmbeddingTable.from_scope(
+                scope, m['main'], 'ctr_embedding', capacity,
+                ['sparse_ids'])
+
+        def window(block):
+            fl = [dict(f) for f in
+                  feeds[block * k_steps:(block + 1) * k_steps]]
+            with fluid.scope_guard(scope):
+                t0 = time.time()
+                lv, = exe.run_multi(
+                    m['main'], feed_list=fl, fetch_list=[m['loss']],
+                    embed_caches=[cache] if cache else None)
+                elapsed = time.time() - t0
+            assert np.isfinite(np.asarray(lv)).all()
+            return batch * k_steps / elapsed
+
+        return window, exe, scope, cache, m
+
+    cached_w, cached_exe, cached_scope, cache, _cm = lane(True)
+    plain_w, plain_exe, plain_scope, _, _pm = lane(False)
+    ctx = {
+        'cached_exe': cached_exe, 'plain_exe': plain_exe,
+        'cached_scope': cached_scope, 'plain_scope': plain_scope,
+        'cache': cache, 'vocab': vocab, 'embed': embed, 'batch': batch,
+        'k_steps': k_steps, 'capacity': capacity, 'hot_frac': hot_frac,
+        'table_bytes': vocab * embed * 4, 'feeds': feeds, 'lane': lane,
+    }
+    return cached_w, plain_w, ctx
+
+
+def run_embed_cache():
+    """The embed_cache record (ISSUE 12 acceptance): cached-vs-uncached
+    lanes over ONE seeded hot-zipfian stream.  HARD asserts — final
+    params allclose across the lanes with the table itself BITWISE
+    (SGD exact); ``hit_rate`` >= PERF_GATE_EMBED_HIT_MIN (0.9) at the
+    smoke's skew; ``host_bytes_reduction`` (the measured
+    every-STEP-exchange lane's host bytes/step over the cached lane's)
+    >= PERF_GATE_EMBED_HOST_RATIO (4.0); and the STRUCTURAL assert
+    that the cached lane's timed executable allocates LESS XLA temp
+    memory than one full [V, D] table — the working set on device
+    really is the slab, not the table."""
+    import numpy as np
+    cached_w, plain_w, ctx = build_embed_cache()
+    ca, pl = [], []
+    for b in range(BLOCKS):
+        ca.append(cached_w(b))
+        pl.append(plain_w(b))
+    cache = ctx['cache']
+    cache.flush()
+    cache_metrics = cache.metrics()
+    # parity FIRST: a fast-but-wrong cache must never pass.  The
+    # flushed host master is the cached lane's full-table truth.
+    cached_table = cache.table()
+    plain_table = np.asarray(
+        ctx['plain_scope'].find_var('ctr_embedding').value())
+    assert np.array_equal(cached_table, plain_table), \
+        'cached lane table diverged from full-table lane (SGD must be ' \
+        'EXACT; max diff %g)' % np.abs(cached_table - plain_table).max()
+    names = sorted(
+        n for n in ctx['cached_scope'].local_var_names()
+        if n != 'ctr_embedding'
+        and ctx['plain_scope'].find_var(n) is not None)
+    params_checked = 1
+    for n in names:
+        a = np.asarray(ctx['cached_scope'].find_var(n).value())
+        b = np.asarray(ctx['plain_scope'].find_var(n).value())
+        if a.dtype.kind != 'f' or a.shape != b.shape:
+            continue
+        np.testing.assert_allclose(
+            a, b, rtol=1e-4, atol=1e-5,
+            err_msg='cached lane diverged from full-table at %r' % n)
+        params_checked += 1
+    assert params_checked > 1
+    # the EVERY-STEP-EXCHANGE comparator (the reference remote-updater
+    # shape): same machinery, residency invalidated before every
+    # single-step dispatch — each step fetches its whole row set from
+    # host and flushes its dirty rows back.  Measured, not modeled.
+    k_steps, batch = ctx['k_steps'], ctx['batch']
+    ex_w, ex_exe, ex_scope, ex_cache, ex_m = ctx['lane'](True)
+    import paddle_tpu.fluid as fluid
+    with fluid.scope_guard(ex_scope):
+        for f in ctx['feeds'][:k_steps]:
+            ex_cache.invalidate()
+            ex_exe.run_multi(ex_m['main'], feed_list=[dict(f)],
+                             fetch_list=[ex_m['loss']],
+                             embed_caches=[ex_cache])
+    ex_cache.flush()
+    ex_metrics = ex_cache.metrics()
+    exchange_bps = ex_metrics['host_bytes'] / k_steps
+    cached_bps = cache_metrics['host_bytes_per_step']
+    table_bytes = ctx['table_bytes']
+
+    def _temp(exe):
+        entries = [e for e in exe.cost_report()
+                   if e.get('kind') == 'multi'
+                   and e.get('temp_bytes') is not None]
+        return max((e['temp_bytes'] for e in entries), default=None)
+
+    cached_temp = _temp(ctx['cached_exe'])
+    rec = {
+        'config': 'embed_cache',
+        'cached_rows_per_sec': round(max(ca), 1),
+        'uncached_rows_per_sec': round(max(pl), 1),
+        'cached_blocks': [round(v, 1) for v in ca],
+        'uncached_blocks': [round(v, 1) for v in pl],
+        'step_time_ratio': round(min(p / c for c, p in zip(ca, pl)), 4),
+        'hit_rate': round(cache_metrics['hit_rate'], 4),
+        'prefetch_stalls': cache_metrics['prefetch_stalls'],
+        'exchanges': cache_metrics['exchanges'],
+        'host_bytes_per_step_cached': round(cached_bps, 1),
+        'host_bytes_per_step_exchange': round(exchange_bps, 1),
+        'host_bytes_reduction': round(exchange_bps /
+                                      max(cached_bps, 1e-9), 2),
+        'table_bytes': table_bytes,
+        'slab_bytes': cache.slab_nbytes(),
+        'cached_temp_bytes': cached_temp,
+        'params_checked': params_checked,
+        'vocab': ctx['vocab'], 'embed_dim': ctx['embed'],
+        'batch': batch, 'steps_per_dispatch': k_steps,
+        'capacity': ctx['capacity'], 'hot_frac': ctx['hot_frac'],
+        'blocks': BLOCKS,
+    }
+    cache.close()
+    ex_cache.close()
+    hit_min = float(os.environ.get('PERF_GATE_EMBED_HIT_MIN', '0.9'))
+    host_ratio = float(os.environ.get('PERF_GATE_EMBED_HOST_RATIO',
+                                      '4.0'))
+    assert rec['hit_rate'] >= hit_min, rec
+    assert rec['host_bytes_reduction'] >= host_ratio, rec
+    if cached_temp is not None:
+        # the structural half: the timed executable's temp buffers stay
+        # below ONE full table — the device working set is the slab
+        assert cached_temp < table_bytes, rec
+    else:
+        rec['temp_analysis'] = 'unavailable'
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 def check_profile_shed():
     """ISSUE 9's sharpened shed contract, checked DETERMINISTICALLY
     (no model, no timing): a MicroBatcher fed the per-signature
@@ -1479,6 +1679,7 @@ CONFIGS = {
     'decode_overlap': (build_decode_overlap, 'tokens_per_sec'),
     'slo': (build_slo, 'goodput_req_s'),
     'sparse_grad': (build_sparse_grad, 'rows_per_sec'),
+    'embed_cache': (build_embed_cache, 'rows_per_sec'),
 }
 
 
@@ -1499,6 +1700,8 @@ def run_config(name):
         return run_slo()
     if name == 'sparse_grad':
         return run_sparse_grad()
+    if name == 'embed_cache':
+        return run_embed_cache()
     build, unit = CONFIGS[name]
     # both sides compiled first, then INTERLEAVED blocks: a drift window
     # between two monolithic measurements would otherwise decide the
