@@ -7,3 +7,5 @@ from .async_sparse import AsyncSparseEmbedding, \
     AsyncSparseClosedError  # noqa: F401
 from .embed_cache import CachedEmbeddingTable, EmbedCacheCapacityError, \
     optimizer_accumulator_vars  # noqa: F401
+from .elastic import ElasticTrainJob, AsyncShardedCheckpoint, \
+    CheckpointWriteError, ElasticJobError  # noqa: F401

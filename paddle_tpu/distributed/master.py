@@ -14,17 +14,126 @@ recovers the queue from the last snapshot with claimed tasks returned to
 the todo queue.
 """
 
+import base64
 import ctypes
 import json
 import os
+import struct
+import threading
 import time
 
 from ..runtime import native
 
+# versioned snapshot envelope (ISSUE 13): the engine blob wrapped with
+# the pass/cursor fields an elastic job checkpoint needs — pass number,
+# todo/doing/done/discarded counts and per-task failure counts all
+# survive a master restart.  Old raw blobs (either engine's) still
+# restore; bump the version when the envelope grows NEW fields so old
+# masters can refuse blobs they cannot represent.
+SNAPSHOT_FMT = 'paddle-tpu-master-snapshot'
+SNAPSHOT_VERSION = 2
+
+_NATIVE_MAGIC = 0x301076736d  # csrc/master.cc kSnapshotMagic
+
+
+def _parse_engine_blob(blob, payloads=False):
+    """Decode either engine's snapshot blob into
+    {'todo': [(tid, failures)], 'done': [...], 'next_id', 'discarded'}
+    — the cursor view the envelope mirrors.  With ``payloads`` each
+    task triple carries its payload bytes too (the rewrite path needs
+    them; the plain cursor view drops them — the blob itself stays the
+    restore authority)."""
+    blob = bytes(blob)
+    if len(blob) >= 8 and struct.unpack('<q', blob[:8])[0] == _NATIVE_MAGIC:
+        pos = [8]
+
+        def i64():
+            v, = struct.unpack_from('<q', blob, pos[0])
+            pos[0] += 8
+            return v
+
+        def tasks():
+            out = []
+            for _ in range(i64()):
+                tid, failures, n = i64(), i64(), i64()
+                payload = blob[pos[0]:pos[0] + n]
+                pos[0] += n
+                out.append((tid, failures, payload) if payloads
+                           else (tid, failures))
+            return out
+
+        todo = tasks()
+        done = tasks()
+        return {'todo': todo, 'done': done, 'next_id': i64(),
+                'discarded': i64()}
+    state = json.loads(blob.decode())
+
+    def conv(items):
+        return [(t, f, p.encode('latin-1')) if payloads else (t, f)
+                for t, f, p in items]
+
+    return {'todo': conv(state['todo']), 'done': conv(state['done']),
+            'next_id': state['next_id'],
+            'discarded': state['discarded']}
+
+
+def complete_tasks_in_blob(blob, tids):
+    """Rewrite a snapshot (envelope or raw engine blob) so ``tids``
+    count as DONE.  The elastic job's checkpoint stores the master
+    cursor AS OF ITS PARAMS: a task whose update is already in the
+    checkpointed params but whose ack is still gated on the manifest
+    commit must not be re-dispatched by a whole-job restore.  Returns
+    a versioned envelope whose engine blob is the portable fallback-
+    JSON format (both engines restore it)."""
+    env = _parse_envelope(blob)
+    pass_num = 0
+    engine = bytes(blob)
+    if env is not None:
+        pass_num = int(env.get('pass_num', 0))
+        engine = base64.b64decode(env['engine'])
+    state = _parse_engine_blob(engine, payloads=True)
+    tids = set(int(t) for t in tids)
+    moved = [t for t in state['todo'] if t[0] in tids]
+    todo = [t for t in state['todo'] if t[0] not in tids]
+    done = state['done'] + moved
+    engine_json = json.dumps({
+        'todo': [(t, f, p.decode('latin-1')) for t, f, p in todo],
+        'done': [(t, f, p.decode('latin-1')) for t, f, p in done],
+        'next_id': state['next_id'],
+        'discarded': state['discarded'],
+    }).encode()
+    return json.dumps({
+        'fmt': SNAPSHOT_FMT,
+        'version': SNAPSHOT_VERSION,
+        'pass_num': pass_num,
+        'counts': [len(todo), 0, len(done), state['discarded']],
+        'failures': {str(t): f for t, f, _ in todo + done if f},
+        'engine': base64.b64encode(engine_json).decode(),
+    }).encode()
+
+
+def _parse_envelope(blob):
+    """The decoded envelope dict, or None when ``blob`` is any legacy
+    format (raw engine binary, fallback JSON, garbage — the caller's
+    legacy path decides what to do with those)."""
+    head = bytes(blob).lstrip()[:1]
+    if head != b'{':
+        return None
+    try:
+        env = json.loads(bytes(blob).decode())
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not isinstance(env, dict) or env.get('fmt') != SNAPSHOT_FMT:
+        return None
+    return env
+
 
 class TaskQueuePyFallback(object):
     """Pure-Python queue engine with the semantics of csrc/master.cc, used
-    when the native lib is unavailable."""
+    when the native lib is unavailable.  Lock-guarded like the native
+    engine's std::mutex: an in-process elastic job drives the queue
+    from several threads at once (staging-thread claims, writer-thread
+    acks, run-thread snapshots)."""
 
     def __init__(self, timeout_secs, failure_max):
         self.timeout_secs = timeout_secs
@@ -34,6 +143,7 @@ class TaskQueuePyFallback(object):
         self.done = []
         self.discarded = 0
         self.next_id = 1
+        self._mu = threading.Lock()
 
     def _requeue(self):
         now = time.monotonic()
@@ -48,67 +158,77 @@ class TaskQueuePyFallback(object):
                     self.todo.append((tid, failures, payload))
 
     def add_task(self, payload):
-        tid = self.next_id
-        self.next_id += 1
-        self.todo.append((tid, 0, payload))
-        return tid
+        with self._mu:
+            tid = self.next_id
+            self.next_id += 1
+            self.todo.append((tid, 0, payload))
+            return tid
 
     def get_task(self):
-        self._requeue()
-        if not self.todo:
-            return (None, None) if self.pending else (-1, None)
-        tid, failures, payload = self.todo.pop(0)
-        self.pending[tid] = (failures, payload,
-                             time.monotonic() + self.timeout_secs)
-        return tid, payload
+        with self._mu:
+            self._requeue()
+            if not self.todo:
+                return (None, None) if self.pending else (-1, None)
+            tid, failures, payload = self.todo.pop(0)
+            self.pending[tid] = (failures, payload,
+                                 time.monotonic() + self.timeout_secs)
+            return tid, payload
 
     def task_finished(self, tid):
-        if tid in self.pending:
-            failures, payload, _ = self.pending.pop(tid)
-            self.done.append((tid, failures, payload))
+        with self._mu:
+            if tid in self.pending:
+                failures, payload, _ = self.pending.pop(tid)
+                self.done.append((tid, failures, payload))
 
     def task_failed(self, tid):
-        if tid not in self.pending:
-            return -1
-        failures, payload, _ = self.pending.pop(tid)
-        failures += 1
-        if failures >= self.failure_max:
-            self.discarded += 1
-            return 1
-        self.todo.append((tid, failures, payload))
-        return 0
+        with self._mu:
+            if tid not in self.pending:
+                return -1
+            failures, payload, _ = self.pending.pop(tid)
+            failures += 1
+            if failures >= self.failure_max:
+                self.discarded += 1
+                return 1
+            self.todo.append((tid, failures, payload))
+            return 0
 
     def new_pass(self):
-        self.todo.extend((tid, 0, payload) for tid, _, payload in self.done)
-        self.done = []
+        with self._mu:
+            self.todo.extend((tid, 0, payload)
+                             for tid, _, payload in self.done)
+            self.done = []
 
     def counts(self):
-        self._requeue()
-        return (len(self.todo), len(self.pending), len(self.done),
-                self.discarded)
+        with self._mu:
+            self._requeue()
+            return (len(self.todo), len(self.pending), len(self.done),
+                    self.discarded)
 
     def snapshot(self):
-        self._requeue()
-        state = {
-            'todo': [(t, f, p.decode('latin-1'))
-                     for t, f, p in self.todo] +
-                    [(t, f, p.decode('latin-1'))
-                     for t, (f, p, _) in self.pending.items()],
-            'done': [(t, f, p.decode('latin-1')) for t, f, p in self.done],
-            'next_id': self.next_id,
-            'discarded': self.discarded,
-        }
-        return json.dumps(state).encode()
+        with self._mu:
+            self._requeue()
+            state = {
+                'todo': [(t, f, p.decode('latin-1'))
+                         for t, f, p in self.todo] +
+                        [(t, f, p.decode('latin-1'))
+                         for t, (f, p, _) in self.pending.items()],
+                'done': [(t, f, p.decode('latin-1'))
+                         for t, f, p in self.done],
+                'next_id': self.next_id,
+                'discarded': self.discarded,
+            }
+            return json.dumps(state).encode()
 
     def restore(self, blob):
         state = json.loads(bytes(blob).decode())
-        self.todo = [(t, f, p.encode('latin-1'))
-                     for t, f, p in state['todo']]
-        self.pending = {}
-        self.done = [(t, f, p.encode('latin-1'))
-                     for t, f, p in state['done']]
-        self.next_id = state['next_id']
-        self.discarded = state['discarded']
+        with self._mu:
+            self.todo = [(t, f, p.encode('latin-1'))
+                         for t, f, p in state['todo']]
+            self.pending = {}
+            self.done = [(t, f, p.encode('latin-1'))
+                         for t, f, p in state['done']]
+            self.next_id = state['next_id']
+            self.discarded = state['discarded']
 
 
 class _NativeQueue(object):
@@ -185,7 +305,7 @@ class Master(object):
     """
 
     def __init__(self, store_path=None, chunk_timeout_secs=60,
-                 failure_max=3):
+                 failure_max=3, worker_lease_secs=10.0):
         lib = native._load()
         if lib is not None:
             self._q = _NativeQueue(lib, chunk_timeout_secs, failure_max)
@@ -194,6 +314,18 @@ class Master(object):
         self.store_path = store_path
         self._lock_fd = None
         self._events = 0
+        # pass cursor (ISSUE 13): which dataset pass the queue is on —
+        # rides the versioned snapshot envelope so a restarted master
+        # (or a job resuming from a checkpointed cursor) knows where the
+        # run was, not just which tasks remain
+        self.pass_num = 0
+        # worker membership (the etcd-registration shape, PAPER.md's EDL
+        # master): worker id -> lease deadline; every join/leave/expiry
+        # bumps the epoch an elastic job re-forms its mesh on
+        self.worker_lease_secs = float(worker_lease_secs)
+        self._members = {}
+        self._membership_epoch = 0
+        self._members_lock = threading.Lock()
         # monotone mutation counter: EVERY queue-state change bumps it
         # (set_dataset, claims, finish/fail, new_pass, restore) — the
         # replication door keys snapshot freshness on this, and keying
@@ -205,8 +337,46 @@ class Master(object):
             snap = os.path.join(store_path, 'master_snapshot.bin')
             if os.path.exists(snap):
                 with open(snap, 'rb') as f:
-                    self._restore_blob(f.read())
-                self._seq += 1
+                    self.restore(f.read())
+
+    def snapshot(self):
+        """The versioned snapshot envelope: the engine blob plus the
+        pass/cursor fields a job checkpoint introspects (pass_num,
+        todo/doing/done/discarded counts, per-task failure counts).
+        ``restore()`` round-trips it; raw engine blobs (old snapshots)
+        still restore."""
+        blob = self._q.snapshot()
+        cursor = _parse_engine_blob(blob)
+        env = {
+            'fmt': SNAPSHOT_FMT,
+            'version': SNAPSHOT_VERSION,
+            'pass_num': self.pass_num,
+            # the engine snapshot folds pending into todo (claimants
+            # presumed dead on recovery), so counts here are the
+            # RESTORED view: (todo+doing, 0, done, discarded)
+            'counts': [len(cursor['todo']), 0, len(cursor['done']),
+                       cursor['discarded']],
+            'failures': {str(t): f for t, f in
+                         cursor['todo'] + cursor['done'] if f},
+            'engine': base64.b64encode(blob).decode(),
+        }
+        return json.dumps(env).encode()
+
+    def restore(self, blob):
+        """Restore from a versioned envelope OR any legacy blob (raw
+        native binary / fallback JSON / cross-engine)."""
+        env = _parse_envelope(blob)
+        if env is not None:
+            if env['version'] > SNAPSHOT_VERSION:
+                raise IOError(
+                    'master snapshot envelope version %d is newer than '
+                    'this master (%d)' % (env['version'],
+                                          SNAPSHOT_VERSION))
+            self._restore_blob(base64.b64decode(env['engine']))
+            self.pass_num = int(env.get('pass_num', 0))
+        else:
+            self._restore_blob(blob)
+        self._seq += 1
 
     def _restore_blob(self, blob):
         """Restore from either engine's snapshot format: the native engine
@@ -320,6 +490,7 @@ class Master(object):
 
     def new_pass(self):
         self._q.new_pass()
+        self.pass_num += 1
         self._seq += 1
 
     def counts(self):
@@ -332,8 +503,52 @@ class Master(object):
         snap = os.path.join(self.store_path, 'master_snapshot.bin')
         tmp = snap + '.tmp'
         with open(tmp, 'wb') as f:
-            f.write(self._q.snapshot())
+            f.write(self.snapshot())
         os.replace(tmp, snap)  # atomic like the etcd transactional put
+
+    # -- worker membership (the etcd registration dir, PAPER.md §EDL:
+    # trainers register under a TTL lease; the master's view of the
+    # live set is what an elastic job re-forms its dp extent on) --
+    def _sweep_members(self, now=None):
+        now = time.monotonic() if now is None else now
+        dead = [w for w, dl in self._members.items() if dl <= now]
+        for w in dead:
+            del self._members[w]
+        if dead:
+            self._membership_epoch += 1
+
+    def register_worker(self, worker_id):
+        """Join (or rejoin) the membership set under a fresh lease;
+        returns (epoch, sorted live worker ids)."""
+        with self._members_lock:
+            now = time.monotonic()
+            self._sweep_members(now)
+            if worker_id not in self._members:
+                self._membership_epoch += 1
+            self._members[worker_id] = now + self.worker_lease_secs
+            return self._membership_epoch, sorted(self._members)
+
+    def heartbeat(self, worker_id):
+        """Renew ``worker_id``'s lease (registering it if its old lease
+        already expired); returns (epoch, sorted live worker ids)."""
+        return self.register_worker(worker_id)
+
+    def deregister_worker(self, worker_id):
+        """Graceful leave (a crashed worker just stops heartbeating and
+        its lease expires); returns (epoch, sorted live worker ids)."""
+        with self._members_lock:
+            self._sweep_members()
+            if worker_id in self._members:
+                del self._members[worker_id]
+                self._membership_epoch += 1
+            return self._membership_epoch, sorted(self._members)
+
+    def members(self):
+        """(epoch, sorted live worker ids) after sweeping expired
+        leases."""
+        with self._members_lock:
+            self._sweep_members()
+            return self._membership_epoch, sorted(self._members)
 
 
 class SnapshotReplica(object):

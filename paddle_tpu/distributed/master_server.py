@@ -51,6 +51,17 @@ class _Handler(socketserver.StreamRequestHandler):
                 elif method == 'new_pass':
                     master.new_pass()
                     resp = {'ok': True}
+                elif method in ('register_worker', 'heartbeat',
+                                'deregister_worker'):
+                    # membership door (the etcd registration dir): a
+                    # worker's TTL lease lives in the master; a crashed
+                    # worker just stops calling and its lease expires
+                    epoch, workers = getattr(master, method)(
+                        str(req['worker_id']))
+                    resp = {'epoch': epoch, 'workers': workers}
+                elif method == 'members':
+                    epoch, workers = master.members()
+                    resp = {'epoch': epoch, 'workers': workers}
                 elif method == 'snapshot':
                     # replication door (go/master etcd_client.go analog):
                     # a standby on ANOTHER filesystem mirrors the queue
@@ -64,7 +75,7 @@ class _Handler(socketserver.StreamRequestHandler):
                     # seq advance and re-mirrors.
                     import base64
                     seq = getattr(master, '_seq', 0)
-                    blob = master._q.snapshot()
+                    blob = master.snapshot()  # versioned envelope
                     resp = {'blob': base64.b64encode(blob).decode(),
                             'seq': seq}
                 else:
@@ -113,10 +124,15 @@ class MasterClient(object):
         self._sock = socket.create_connection((host, int(port)),
                                               timeout=timeout)
         self._rfile = self._sock.makefile('rb')
+        # one socket, strict request/response framing: concurrent
+        # callers (an elastic job's claim/ack/heartbeat threads) must
+        # not interleave their lines
+        self._lock = threading.Lock()
 
     def _call(self, **req):
-        self._sock.sendall((json.dumps(req) + '\n').encode())
-        line = self._rfile.readline()
+        with self._lock:
+            self._sock.sendall((json.dumps(req) + '\n').encode())
+            line = self._rfile.readline()
         if not line:
             raise ConnectionError('master closed the connection')
         resp = json.loads(line.decode())
@@ -139,6 +155,22 @@ class MasterClient(object):
 
     def new_pass(self):
         self._call(method='new_pass')
+
+    def register_worker(self, worker_id):
+        r = self._call(method='register_worker', worker_id=worker_id)
+        return r['epoch'], r['workers']
+
+    def heartbeat(self, worker_id):
+        r = self._call(method='heartbeat', worker_id=worker_id)
+        return r['epoch'], r['workers']
+
+    def deregister_worker(self, worker_id):
+        r = self._call(method='deregister_worker', worker_id=worker_id)
+        return r['epoch'], r['workers']
+
+    def members(self):
+        r = self._call(method='members')
+        return r['epoch'], r['workers']
 
     def fetch_snapshot(self):
         """(blob_bytes, seq) of the master's current queue state."""

@@ -49,7 +49,15 @@ from .executor import (prepare_feed_arrays, feed_signature, stack_steps,
                        _current_scope)
 from .framework import default_main_program, Variable
 
-__all__ = ['FeedPipeline', 'drain_reader_feed_list']
+__all__ = ['FeedPipeline', 'FeedPipelineError', 'drain_reader_feed_list']
+
+
+class FeedPipelineError(RuntimeError):
+    """A FeedPipeline staging-thread failure (the source reader or the
+    stager itself raised).  Raised at most ONCE per pipeline — by the
+    iteration loop when it hits the EOF sentinel, or by ``close()`` for
+    an error that raced the close and was never delivered — with the
+    original exception as ``__cause__``."""
 
 
 def check_reader_args(what, feed, feed_list, steps=None,
@@ -231,7 +239,7 @@ class FeedPipeline(object):
                  source=None, steps=1, pipeline_depth=2, scope=None,
                  return_numpy=True, name=None, bucketed=False,
                  max_open_buckets=4, watchdog_stall_s=None,
-                 embed_caches=None):
+                 embed_caches=None, on_delivered=None):
         if (reader is None) == (source is None):
             raise ValueError('FeedPipeline: pass reader= OR source=')
         if int(steps) < 1:
@@ -294,8 +302,15 @@ class FeedPipeline(object):
         # pipeline keeps only the most recent window instead of
         # growing forever
         self.dispatch_log = collections.deque(maxlen=_DISPATCH_LOG_CAP)
+        # delivery hook (ISSUE 13): called AFTER a dispatch's fetches
+        # convert (i.e. the dispatch has synced) with the dispatch's
+        # source ordinals and converted fetches — the elastic job's
+        # ack-after-sync point (a task is reported finished only once
+        # the dispatch that trained on it has completed on device)
+        self._on_delivered = on_delivered
         self._placer = None  # set before the first placed block
         self._error = None
+        self._error_delivered = False
         self._closed = False
         self._thread = None
         self._started = False
@@ -636,6 +651,8 @@ class FeedPipeline(object):
             out = self._exe._convert_fetches(fetches, self._return_numpy)
         _profiler.record_event('pipeline/dispatch[x%d]' % block.steps,
                                time.time() - t0, start=t0)
+        if self._on_delivered is not None:
+            self._on_delivered(list(block.indices or []), out)
         return out
 
     def __iter__(self):
@@ -658,11 +675,7 @@ class FeedPipeline(object):
                     # the EOF sentinel's wait delayed no dispatch — it
                     # must not count as feed stall (it would skew the
                     # 'feed_stall ~ 0' acceptance metric)
-                    if self._error is not None:
-                        err, self._error = self._error, None
-                        raise RuntimeError(
-                            'FeedPipeline source failed: %r'
-                            % (err, )) from err
+                    self._raise_stage_error()
                     break
                 if self._m['dispatches'] > 0:
                     # the FIRST get always waits (nothing to overlap
@@ -677,7 +690,14 @@ class FeedPipeline(object):
             while self._inflight:
                 yield self._drain_one()
         finally:
-            self.close()
+            # quiet close: the sentinel path above already raised any
+            # stage error into the consumer; an ABANDONED iterator
+            # (break / GC teardown) must not raise from a generator
+            # finally — that masks the primary exception or surfaces
+            # as an ignored-exception warning at GC.  An explicit
+            # pipe.close() by the owner still raises (the close-race
+            # contract).
+            self._close_quiet()
 
     def run(self):
         """Drive the pipeline to EOF; returns the per-dispatch list of
@@ -713,6 +733,20 @@ class FeedPipeline(object):
         except _queue.Empty:
             pass
 
+    def _raise_stage_error(self):
+        """Surface a staging-thread failure exactly ONCE as the typed
+        FeedPipelineError (ISSUE 13 satellite): the iteration loop
+        raises it when the EOF sentinel lands; an error that races
+        close() — the stager crashing while the pipeline shuts down —
+        is raised by close() instead, and a second close() (or the
+        iterator's finally re-entering close) never re-raises."""
+        if self._error is None or self._error_delivered:
+            return
+        self._error_delivered = True
+        err = self._error
+        raise FeedPipelineError(
+            'FeedPipeline source failed: %r' % (err, )) from err
+
     def close(self):
         if self._closed:
             return
@@ -720,6 +754,9 @@ class FeedPipeline(object):
         # unblock a stager stuck on a full queue...
         self._drain_staged()
         if self._thread is not None:
+            # bounded join: _closed is set, so the stager's put() loop
+            # exits and _next_block stops consuming — a stage-thread
+            # exception during this window is captured, not a hang
             self._thread.join(timeout=5)
             self._thread = None
         # ...and drop the block its unblocked put() may have deposited
@@ -734,9 +771,28 @@ class FeedPipeline(object):
             self._watchdog_probe = None
         _profiler.unregister_metrics_source(self._metrics_key,
                                             self._metrics_fn)
+        # a racing stage-thread error nobody iterated into: surface it
+        # here, once, AFTER the pipeline is fully torn down (resources
+        # above are released whether or not this raises)
+        self._raise_stage_error()
+
+    def _close_quiet(self):
+        """close() with a racing stage error recorded but not raised —
+        for paths where raising would mask a primary exception (the
+        error is still marked delivered, so no later close re-raises
+        a half-reported failure)."""
+        try:
+            self.close()
+        except FeedPipelineError:
+            pass
 
     def __enter__(self):
         return self.start()
 
-    def __exit__(self, *exc):
-        self.close()
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            # a primary exception is propagating: never mask it with
+            # the close-race error
+            self._close_quiet()
+        else:
+            self.close()

@@ -5,10 +5,15 @@ callbacks, trainer.py:40-83), checkpointing via CheckpointConfig
 (trainer.py:100), automatic resume from the newest checkpoint.  Distributed
 training maps to SPMD (ParallelExecutor) instead of the transpiled pserver
 path.
+
+Checkpointing rides the elastic subsystem's manifest store (ISSUE 13):
+``distributed.elastic.AsyncShardedCheckpoint`` — per-var shard files,
+atomic manifest commit, bounded retention, and the WRITE on a background
+thread so the event loop never blocks on checkpoint IO.  Pre-manifest
+checkpoints (the old ``<dir>/<serial>/`` layout) still resume.
 """
 
 import os
-import shutil
 
 from . import core
 from .framework import Program, program_guard, default_main_program, \
@@ -119,16 +124,36 @@ class Trainer(object):
                     self.exe, dirname=param_path,
                     main_program=self.startup_program)
 
+        self._ckpt_store = None
         if self.checkpoint_cfg is not None:
-            serial = _latest_serial(self.checkpoint_cfg.checkpoint_dir)
-            if serial is not None:
-                self.checkpoint_cfg.load_serial = serial
+            from ..distributed.elastic import AsyncShardedCheckpoint
+            cfg = self.checkpoint_cfg
+            self._ckpt_store = AsyncShardedCheckpoint(
+                cfg.checkpoint_dir, keep=cfg.max_num_checkpoints)
+            manifest = self._ckpt_store.latest()
+            if manifest is not None:
+                serial, arrays, extras = self._ckpt_store.load(manifest)
+                cfg.load_serial = serial
+                # informational only (the reference surface exposes
+                # them): the event loops do NOT fast-forward past
+                # already-trained epochs/steps — resumed state is the
+                # PARAMS; the data position is the caller's reader
+                cfg.epoch_id = int(extras.get('epoch', 0))
+                cfg.step_id = int(extras.get('step', 0))
                 with scope_guard(self.scope):
-                    fluid_io.load_persistables(
-                        self.exe,
-                        _serial_dir(self.checkpoint_cfg.checkpoint_dir,
-                                    serial),
-                        main_program=self.train_program)
+                    for name, arr in arrays.items():
+                        self.scope.var(name).set_value(arr)
+            else:
+                # pre-manifest checkpoint (the old <dir>/<serial>/
+                # per-var layout): still resumes
+                serial = _latest_serial(cfg.checkpoint_dir)
+                if serial is not None:
+                    cfg.load_serial = serial
+                    with scope_guard(self.scope):
+                        fluid_io.load_persistables(
+                            self.exe,
+                            _serial_dir(cfg.checkpoint_dir, serial),
+                            main_program=self.train_program)
 
     def stop(self):
         self.__stop = True
@@ -154,27 +179,38 @@ class Trainer(object):
             return self._train_pipelined(
                 num_epochs, event_handler, reader, feed_order,
                 int(steps_per_dispatch), int(pipeline_depth))
-        with scope_guard(self.scope):
-            feeder = DataFeeder(
-                feed_list=feed_order, place=self.place,
-                program=self.train_program)
-            for epoch_id in range(num_epochs):
-                event_handler(BeginEpochEvent(epoch_id))
-                for step_id, data in enumerate(reader()):
-                    if self.__stop:
-                        return
-                    begin_event = BeginStepEvent(epoch_id, step_id)
-                    event_handler(begin_event)
-                    fetch_list = self.train_func_outputs \
-                        if begin_event.fetch_metrics else []
-                    metrics = self.exe.run(
-                        self.train_program,
-                        feed=feeder.feed(data),
-                        fetch_list=fetch_list)
-                    if self.checkpoint_cfg is not None:
-                        self._save_checkpoint(epoch_id, step_id)
-                    event_handler(EndStepEvent(epoch_id, step_id, metrics))
-                event_handler(EndEpochEvent(epoch_id))
+        try:
+            with scope_guard(self.scope):
+                feeder = DataFeeder(
+                    feed_list=feed_order, place=self.place,
+                    program=self.train_program)
+                for epoch_id in range(num_epochs):
+                    event_handler(BeginEpochEvent(epoch_id))
+                    for step_id, data in enumerate(reader()):
+                        if self.__stop:
+                            return
+                        begin_event = BeginStepEvent(epoch_id, step_id)
+                        event_handler(begin_event)
+                        fetch_list = self.train_func_outputs \
+                            if begin_event.fetch_metrics else []
+                        metrics = self.exe.run(
+                            self.train_program,
+                            feed=feeder.feed(data),
+                            fetch_list=fetch_list)
+                        if self.checkpoint_cfg is not None:
+                            self._save_checkpoint(epoch_id, step_id)
+                        event_handler(
+                            EndStepEvent(epoch_id, step_id, metrics))
+                    event_handler(EndEpochEvent(epoch_id))
+        finally:
+            # the async writer must have committed before train()
+            # returns — a caller reading the checkpoint dir right
+            # after must see the newest manifest.  On the exception
+            # path the flush goes QUIET: a writer failure must not
+            # mask the primary training error.
+            import sys
+            self._flush_checkpoints(
+                quiet=sys.exc_info()[0] is not None)
 
     def _train_pipelined(self, num_epochs, event_handler, reader,
                          feed_order, steps, pipeline_depth):
@@ -183,30 +219,36 @@ class Trainer(object):
         dispatch whose staging overlapped the previous dispatch's
         compute."""
         from .dataflow import FeedPipeline
-        with scope_guard(self.scope):
-            feeder = DataFeeder(
-                feed_list=feed_order, place=self.place,
-                program=self.train_program)
-            for epoch_id in range(num_epochs):
-                event_handler(BeginEpochEvent(epoch_id))
-                pipe = FeedPipeline(
-                    self.exe, fetch_list=self.train_func_outputs,
-                    program=self.train_program,
-                    source=(feeder.feed(data) for data in reader()),
-                    steps=steps, pipeline_depth=pipeline_depth,
-                    scope=self.scope)
-                try:
-                    for step_id, metrics in enumerate(pipe):
-                        if self.__stop:
-                            return
-                        event_handler(BeginStepEvent(epoch_id, step_id))
-                        if self.checkpoint_cfg is not None:
-                            self._save_checkpoint(epoch_id, step_id)
-                        event_handler(
-                            EndStepEvent(epoch_id, step_id, metrics))
-                finally:
-                    pipe.close()
-                event_handler(EndEpochEvent(epoch_id))
+        try:
+            with scope_guard(self.scope):
+                feeder = DataFeeder(
+                    feed_list=feed_order, place=self.place,
+                    program=self.train_program)
+                for epoch_id in range(num_epochs):
+                    event_handler(BeginEpochEvent(epoch_id))
+                    pipe = FeedPipeline(
+                        self.exe, fetch_list=self.train_func_outputs,
+                        program=self.train_program,
+                        source=(feeder.feed(data) for data in reader()),
+                        steps=steps, pipeline_depth=pipeline_depth,
+                        scope=self.scope)
+                    try:
+                        for step_id, metrics in enumerate(pipe):
+                            if self.__stop:
+                                return
+                            event_handler(BeginStepEvent(epoch_id,
+                                                         step_id))
+                            if self.checkpoint_cfg is not None:
+                                self._save_checkpoint(epoch_id, step_id)
+                            event_handler(
+                                EndStepEvent(epoch_id, step_id, metrics))
+                    finally:
+                        pipe.close()
+                    event_handler(EndEpochEvent(epoch_id))
+        finally:
+            import sys
+            self._flush_checkpoints(
+                quiet=sys.exc_info()[0] is not None)
 
     def test(self, reader, feed_order):
         with scope_guard(self.scope):
@@ -249,13 +291,40 @@ class Trainer(object):
                 step_id % cfg.step_interval != 0:
             return
         serial = (cfg.load_serial or 0) + epoch_id * 100000 + step_id + 1
-        dirname = _serial_dir(cfg.checkpoint_dir, serial)
-        fluid_io.save_persistables(
-            self.exe, dirname=dirname, main_program=self.train_program)
-        serials = sorted(
-            int(d) for d in os.listdir(cfg.checkpoint_dir) if d.isdigit())
-        while len(serials) > cfg.max_num_checkpoints:
-            victim = serials.pop(0)
-            shutil.rmtree(
-                _serial_dir(cfg.checkpoint_dir, victim),
-                ignore_errors=True)
+        arrays = {}
+        for var in self.train_program.list_vars():
+            if not fluid_io.is_persistable(var):
+                continue
+            sv = self.scope.find_var(var.name)
+            if sv is None or sv.value() is None:
+                continue
+            arrays[var.name] = fluid_io._scope_value(self.scope, var.name)
+        # async manifest commit + bounded retention live in the store;
+        # the host copies above are the only work on the event loop
+        self._ckpt_store.save(serial, arrays,
+                              extras={'epoch': epoch_id, 'step': step_id})
+
+    def _flush_checkpoints(self, quiet=False):
+        """Drain the async writer so checkpoints are durable when
+        train() returns.  ``quiet`` is the exception-path form: a
+        checkpoint-writer failure must never mask the primary training
+        exception (the FeedPipeline close-race rule)."""
+        if self._ckpt_store is None:
+            return
+        try:
+            self._ckpt_store.wait()
+        except Exception:
+            if not quiet:
+                raise
+            return
+        # a pre-manifest resume leaves legacy <dir>/<serial>/ trees the
+        # store's own retention never touches: once a manifest is
+        # durably committed they are superseded — drop them so
+        # max_num_checkpoints keeps bounding the directory again
+        cfg = self.checkpoint_cfg
+        if self._ckpt_store.latest() is not None:
+            import shutil
+            for d in os.listdir(cfg.checkpoint_dir):
+                if d.isdigit():
+                    shutil.rmtree(_serial_dir(cfg.checkpoint_dir, d),
+                                  ignore_errors=True)

@@ -21,7 +21,8 @@ keeps the reference's env-var contract:
 
 import os
 
-__all__ = ['init_distributed_env', 'parse_distributed_env']
+__all__ = ['init_distributed_env', 'parse_distributed_env',
+           'parse_elastic_env']
 
 
 def parse_distributed_env(environ=None, require_id=True):
@@ -46,6 +47,25 @@ def parse_distributed_env(environ=None, require_id=True):
         first = endpoints.split(',')[0].strip()
         coordinator = first or None
     return coordinator, num, pid
+
+
+def parse_elastic_env(environ=None):
+    """(worker_id, master_endpoint) for an elastic trainer
+    (``distributed.ElasticTrainJob``) from the same PADDLE_* contract:
+
+        PADDLE_TRAINER_ID       -> worker id ('trainer-<id>')
+        WORKER_TAG              -> overrides the worker id
+        PADDLE_MASTER_ENDPOINT  -> the MasterServer door
+        (or MASTER_ENDPOINT     -> same, the test-harness spelling)
+
+    master_endpoint is None when no master door is configured (an
+    in-process Master job)."""
+    env = environ if environ is not None else os.environ
+    _, _, pid = parse_distributed_env(env, require_id=False)
+    worker_id = env.get('WORKER_TAG') or ('trainer-%d' % pid)
+    endpoint = env.get('PADDLE_MASTER_ENDPOINT') or \
+        env.get('MASTER_ENDPOINT')
+    return worker_id, endpoint
 
 
 def init_distributed_env(coordinator_address=None, num_processes=None,

@@ -1,15 +1,13 @@
 """Stateless EDL trainer for the kill/re-dispatch/resume integration
-test (reference pattern: go/master trainers are stateless — a dead
-trainer's pending task times out and is re-dispatched, go/master/
-service.go:140; high-level Trainer auto-resumes from the newest
-checkpoint, SURVEY §5.3/5.4).
-
-Claims record-range tasks from the MasterServer, trains one step per
-chunk, checkpoints after every finished task, and reports what it did
-as one JSON line: {"tag", "resumed", "start_step", "tasks": [...]}.
+test — now a THIN SHIM over ``distributed.ElasticTrainJob`` (ISSUE 13):
+the job owns claims, ack-after-dispatch-sync, async sharded checkpoints
+and membership heartbeats; the worker just builds the model, decodes
+records, and reports what the job did as one JSON line:
+{"tag", "resumed", "start_step", "tasks": [...]}.
 
 Env: MASTER_ENDPOINT, CKPT_DIR, EDL_HANG_AFTER (finish N tasks then
-hang mid-task — the crash site for the test's kill), DATA_DIM.
+hang holding the NEXT claim — the crash site for the test's kill),
+DATA_DIM.
 """
 
 import json
@@ -34,85 +32,64 @@ def main():
     import numpy as np
     import paddle_tpu.fluid as fluid
     from paddle_tpu import parallel
-    from paddle_tpu.distributed import MasterClient
-    from paddle_tpu.runtime.native import RecordIOScanner
+    from paddle_tpu.distributed import ElasticTrainJob, MasterClient
+    from paddle_tpu.parallel.multihost import parse_elastic_env
 
-    tag = os.environ.get('WORKER_TAG', 'w')
+    tag, endpoint = parse_elastic_env()
     ckpt_dir = os.environ['CKPT_DIR']
     hang_after = int(os.environ.get('EDL_HANG_AFTER', '-1'))
     dim = int(os.environ.get('DATA_DIM', '8'))
 
-    main_prog = fluid.Program()
-    startup = fluid.Program()
-    with fluid.unique_name.guard(), \
-            fluid.program_guard(main_prog, startup):
-        x = fluid.layers.data('x', shape=[dim])
-        y = fluid.layers.data('y', shape=[1])
-        hid = fluid.layers.fc(x, size=4, act='tanh')
-        pred = fluid.layers.fc(hid, size=1)
-        loss = fluid.layers.mean(
-            fluid.layers.square_error_cost(input=pred, label=y))
-        fluid.optimizer.SGD(0.05).minimize(loss)
-    # shard the hidden weight's output dim over the 2-way tp axis: the
-    # checkpoint is written from (and resumed into) a sharded scope
-    parallel.shard(main_prog.all_parameters()[0], None, 'tp')
-    mesh = parallel.make_mesh({'tp': 2})
+    def build():
+        main_prog = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            x = fluid.layers.data('x', shape=[dim])
+            y = fluid.layers.data('y', shape=[1])
+            hid = fluid.layers.fc(x, size=4, act='tanh')
+            pred = fluid.layers.fc(hid, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=pred, label=y))
+            fluid.optimizer.SGD(0.05).minimize(loss)
+        # shard the hidden weight's output dim over the 2-way tp axis:
+        # the checkpoint is written from (and resumed into) a sharded
+        # scope
+        parallel.shard(main_prog.all_parameters()[0], None, 'tp')
+        return main_prog, startup, loss
 
-    exe = fluid.Executor(fluid.CPUPlace())
-    scope = fluid.core.Scope()
-    step_file = os.path.join(ckpt_dir, 'step')
-    with fluid.scope_guard(scope):
-        exe.run(startup)
-        resumed = False
-        start_step = 0
-        if os.path.exists(step_file):
-            fluid.io.load_persistables(exe, ckpt_dir, main_prog)
-            with open(step_file) as f:
-                start_step = int(f.read().strip())
-            resumed = True
+    def batch_fn(records):
+        rows = [pickle.loads(r) for r in records]
+        return {'x': np.stack([r[0] for r in rows]).astype('float32'),
+                'y': np.stack([r[1] for r in rows]).astype('float32')}
 
-        pe = fluid.ParallelExecutor(loss_name=loss.name,
-                                    main_program=main_prog, scope=scope,
-                                    mesh=mesh)
-        client = MasterClient(os.environ['MASTER_ENDPOINT'])
-        step = start_step
-        done_tasks = []
-        scanners = {}
-        while True:
-            tid, task = client.get_task()
-            if tid == -1:
-                break  # pass finished
-            if task is None:
-                time.sleep(0.05)
-                continue
-            if hang_after >= 0 and len(done_tasks) >= hang_after:
-                # crash site: task CLAIMED but never finished
+    client = MasterClient(endpoint)
+    job = ElasticTrainJob(
+        build, client, ckpt_dir, batch_fn, worker_id=tag,
+        steps_per_dispatch=1, checkpoint_every=1,
+        mesh_for=lambda n: {'tp': 2})
+
+    if hang_after >= 0:
+        def hang_hook(tid, task, ordinal):
+            if ordinal >= hang_after:
+                # let the in-flight dispatches deliver + ack so exactly
+                # ``hang_after`` tasks are done, then hang HOLDING this
+                # claim — the crash site (the test SIGKILLs us here and
+                # the claim lease-times-out and re-dispatches)
+                deadline = time.time() + 60
+                while time.time() < deadline and (
+                        len(job.tasks_done) < hang_after or
+                        (job.ckpt.metrics()['last_step'] or 0) <
+                        hang_after):
+                    time.sleep(0.02)  # acks delivered AND ckpt committed
                 print(json.dumps({'tag': tag, 'hanging_on': tid}),
                       flush=True)
                 time.sleep(300)
-            path = task['path']
-            sc = scanners.get(path)
-            if sc is None or sc[1] > task['start']:
-                sc = [RecordIOScanner(path), 0]
-                scanners[path] = sc
-            rows = []
-            while sc[1] < task['start'] + task['count']:
-                rec = next(sc[0])
-                if sc[1] >= task['start']:
-                    rows.append(pickle.loads(rec))
-                sc[1] += 1
-            xs = np.stack([r[0] for r in rows]).astype('float32')
-            ys = np.stack([r[1] for r in rows]).astype('float32')
-            pe.run([loss.name], feed={'x': xs, 'y': ys})
-            step += 1
-            fluid.io.save_persistables(exe, ckpt_dir, main_prog)
-            with open(step_file, 'w') as f:
-                f.write(str(step))
-            client.task_finished(tid)
-            done_tasks.append(tid)
-        print(json.dumps({'tag': tag, 'resumed': resumed,
-                          'start_step': start_step,
-                          'tasks': done_tasks}), flush=True)
+        job.task_hook = hang_hook
+
+    job.run()
+    print(json.dumps({'tag': tag, 'resumed': job.resumed,
+                      'start_step': job.start_step,
+                      'tasks': job.tasks_done}), flush=True)
 
 
 if __name__ == '__main__':

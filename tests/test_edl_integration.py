@@ -120,6 +120,17 @@ def test_master_client_rpc_roundtrip(tmp_path):
         assert c2.task_failed(t2) == 0  # requeued, not discarded
         counts = c1.counts()
         assert counts[2] == 1  # one done
+        # the membership door (ISSUE 13): register/heartbeat/members
+        # round-trip over the same socket protocol
+        e1, workers = c1.register_worker('w1')
+        assert workers == ['w1']
+        e2, workers = c2.register_worker('w2')
+        assert e2 > e1 and workers == ['w1', 'w2']
+        e3, workers = c1.heartbeat('w1')
+        assert e3 == e2 and workers == ['w1', 'w2']
+        e4, workers = c2.deregister_worker('w2')
+        assert e4 > e3 and workers == ['w1']
+        assert c1.members() == (e4, ['w1'])
         c1.close()
         c2.close()
     finally:

@@ -727,3 +727,63 @@ def test_reader_fed_run_eval_multi_spmd_on_virtual_mesh():
         np.testing.assert_allclose(seq[k], outs[0][k], rtol=2e-4,
                                    atol=1e-6)
     assert pe.steps_dispatched == 4 + 4 and pe.dispatch_count == 4 + 1
+
+
+def test_feed_pipeline_close_race_error_surfaces_once_typed():
+    """ISSUE 13 satellite: a stage-thread exception RACING close() must
+    surface exactly once as the typed FeedPipelineError — never hang
+    the join, never raise twice, never vanish.  The fault-injected
+    reader blocks mid-pass and raises only after close() has started
+    tearing the pipeline down."""
+    from paddle_tpu.fluid.dataflow import FeedPipelineError
+
+    gate = threading.Event()
+
+    def faulting_source():
+        yield {'x': np.ones((4, 4), np.float32),
+               'label': np.zeros((4, 1), np.int64)}
+        gate.wait(10)
+        raise ValueError('injected reader fault')
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data('x', [4])
+        label = fluid.layers.data('label', [1], dtype='int64')
+        pred = fluid.layers.fc(x, 3, act='softmax')
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.SGD(0.5).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        pipe = fluid.FeedPipeline(exe, fetch_list=[loss], program=prog,
+                                  source=faulting_source(), steps=2)
+        pipe.start()
+        # let the stager drain the first batch and block on the gate
+        # (steps=2: the block stays OPEN, so the stager is mid-drain)
+        time.sleep(0.3)
+        # release the fault 0.2s into the close: the stager raises
+        # WHILE close() is joining it
+        threading.Timer(0.2, gate.set).start()
+        t0 = time.time()
+        with pytest.raises(FeedPipelineError) as ei:
+            pipe.close()
+        assert time.time() - t0 < 6.0  # the join never hung
+        assert isinstance(ei.value.__cause__, ValueError)
+        # idempotent: a second close is silent (the error was delivered)
+        pipe.close()
+
+    # and the iteration path still delivers the SAME typed error, with
+    # the trailing close() staying silent (no double raise)
+    def bad_source():
+        yield {'x': np.ones((4, 4), np.float32),
+               'label': np.zeros((4, 1), np.int64)}
+        raise ValueError('mid-pass fault')
+
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup)
+        pipe2 = fluid.FeedPipeline(exe2, fetch_list=[loss], program=prog,
+                                   source=bad_source(), steps=1)
+        with pytest.raises(FeedPipelineError):
+            pipe2.run()
+        pipe2.close()

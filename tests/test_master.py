@@ -155,3 +155,102 @@ def test_cross_engine_json_snapshot_restores(tmp_path):
     tid, task = m.get_task()
     assert task == {'path': 'x', 'start': 0, 'count': 2}
     m.close()
+
+
+def test_versioned_snapshot_envelope_roundtrip(tmp_path):
+    """ISSUE 13 satellite: snapshot()/restore() round-trip the
+    pass/cursor fields a job checkpoint needs — pass_num,
+    todo/doing/done/discarded counts and per-task failure counts all
+    survive a master restart (the envelope is versioned; raw engine
+    blobs still restore, pinned below)."""
+    import json
+    p = _write_dataset(tmp_path, 'v.recordio', 8)
+    m = Master(chunk_timeout_secs=60, failure_max=5)
+    m.set_dataset([p], records_per_task=2)
+    tid, _ = m.get_task()
+    m.task_finished(tid)
+    tid2, _ = m.get_task()
+    m.task_failed(tid2)  # one failure on this task
+    blob = m.snapshot()
+    env = json.loads(blob)
+    assert env['fmt'] == 'paddle-tpu-master-snapshot'
+    assert env['version'] >= 2
+    assert env['pass_num'] == 0
+    # restored-view counts: claimed tasks fold into todo
+    assert env['counts'] == [3, 0, 1, 0]
+    assert env['failures'] == {str(tid2): 1}
+    # the pass cursor rides the envelope
+    m.new_pass()
+    assert json.loads(m.snapshot())['pass_num'] == 1
+
+    m2 = Master(chunk_timeout_secs=60, failure_max=5)
+    m2.restore(blob)
+    assert m2.pass_num == 0
+    assert m2.counts() == (3, 0, 1, 0)
+    # the failure count genuinely survived: 4 more failures on that
+    # task reach failure_max=5 and discard it
+    discarded = 0
+    for _ in range(8):
+        t, task = m2.get_task()
+        if t is None or t == -1:
+            break
+        if t == tid2:
+            if m2.task_failed(t) == 1:
+                discarded = 1
+                break
+        else:
+            m2.task_finished(t)
+    # tid2 carried 1 prior failure; it discards after 4 more fails
+    for _ in range(4):
+        if discarded:
+            break
+        t, task = m2.get_task()
+        if t == tid2:
+            discarded = m2.task_failed(t)
+    assert discarded == 1
+    m.close()
+    m2.close()
+
+
+def test_legacy_raw_engine_blob_still_restores():
+    """Pre-envelope snapshots (the raw engine blob) restore unchanged —
+    the envelope is backward-compatible, and a TOO-NEW envelope is a
+    typed refusal, not a silent misparse."""
+    import json
+    m = Master(chunk_timeout_secs=60, failure_max=3)
+    for i in range(3):
+        m._q.add_task(json.dumps({'i': i}).encode())
+    raw = m._q.snapshot()  # what an old master persisted
+    m2 = Master(chunk_timeout_secs=60, failure_max=3)
+    m2.restore(raw)
+    assert m2.counts() == (3, 0, 0, 0)
+    assert m2.pass_num == 0
+    env = json.loads(m.snapshot())
+    env['version'] = 99
+    with pytest.raises(IOError, match='newer'):
+        m2.restore(json.dumps(env).encode())
+    m.close()
+    m2.close()
+
+
+def test_worker_membership_leases_and_epoch():
+    """The etcd-registration shape (ISSUE 13): workers join under a TTL
+    lease, heartbeats renew it, an expired lease leaves the live set,
+    and EVERY membership change bumps the epoch an elastic job re-forms
+    its mesh on."""
+    import time
+    m = Master(worker_lease_secs=0.3)
+    e1, w = m.register_worker('a')
+    assert w == ['a']
+    e2, w = m.register_worker('b')
+    assert e2 > e1 and w == ['a', 'b']
+    # renewals of a live lease do NOT bump the epoch
+    e3, w = m.heartbeat('a')
+    assert e3 == e2 and w == ['a', 'b']
+    time.sleep(0.35)
+    # both leases expired; 'a' heartbeats back in — 'b' is gone
+    e4, w = m.heartbeat('a')
+    assert e4 > e3 and w == ['a']
+    e5, w = m.deregister_worker('a')
+    assert e5 > e4 and w == []
+    m.close()

@@ -335,6 +335,59 @@ def test_embed_cache_cpu_smoke(monkeypatch):
     assert rec['params_checked'] >= 5
 
 
+def test_elastic_config_registered():
+    """ISSUE 13 structural pin (runs off-TPU): the elastic paired
+    config exists, interleaves bare/async/sync checkpoint windows over
+    one warmed executor, hard-gates the async overhead ratio behind
+    its env knob, and folds in the kill-resume check (zero replayed
+    steps, bitwise params, lease re-dispatch observed)."""
+    perf_gate, inspect = _import_perf_gate()
+    assert 'elastic' in perf_gate.CONFIGS
+    src = inspect.getsource(perf_gate.run_elastic)
+    for pin in ("'checkpoint_overhead_ratio'",
+                'PERF_GATE_ELASTIC_OVERHEAD',
+                "'sync_overhead_ratio'", 'check_kill_resume',
+                "'resume_replayed_steps'", "'kill_resume_bitwise'"):
+        assert pin in src, pin
+    build = inspect.getsource(perf_gate.build_elastic)
+    assert 'AsyncShardedCheckpoint' in build
+    assert 'run_multi' in build
+    kill = inspect.getsource(perf_gate.check_kill_resume)
+    assert 'ElasticTrainJob' in kill
+    assert 'array_equal' in kill
+
+
+def test_elastic_config_cpu_smoke(monkeypatch):
+    """The ISSUE 13 acceptance criterion, functionally on CPU: the
+    kill-and-replace run reaches bitwise-identical final params vs an
+    uninterrupted run with the dead worker's task lease observed
+    timing out and re-dispatching, zero replayed steps, and the async
+    checkpoint lane's step-time overhead bounded vs the no-checkpoint
+    lane.  The overhead floor is relaxed for this CPU-share-capped
+    container (the background writer contends with XLA's own thread
+    pool here; the 1.05 default binds at its real floor on hardware —
+    the sparse_grad/decode_overlap smoke precedent)."""
+    perf_gate, _ = _import_perf_gate()
+    monkeypatch.setenv('PERF_GATE_EL_DISPATCHES', '4')
+    # under FULL-SUITE CPU contention the tiny timed windows slow ~2x
+    # while the checkpoint's fixed host cost doesn't, so the smoke's
+    # relaxed floor needs real headroom (1.30 observed at the margin);
+    # the ratio gate's enforcement point is the 1.05 default on
+    # hardware — here the structural half (saves committed, bitwise
+    # kill-resume, zero replays) is the deliverable
+    monkeypatch.setenv('PERF_GATE_ELASTIC_OVERHEAD', '1.6')
+    # 3 interleaved blocks judged on the best shared window (the
+    # gates' pairing rule): single windows are timing-jittery here
+    monkeypatch.setattr(perf_gate, 'BLOCKS', 3)
+    rec = perf_gate.run_elastic()
+    assert rec['checkpoint_overhead_ratio'] <= 1.6
+    assert rec['resume_replayed_steps'] == 0
+    assert rec['kill_resume_bitwise'] and rec['lease_redispatched']
+    assert rec['async_saves'] > 0 and rec['sync_saves'] > 0
+    assert rec['async_bytes_written'] > 0
+    assert rec['kill_resume_rows_per_sec'] > 0
+
+
 def test_resnet_infer_and_feed_pipeline_configs_registered():
     """Back-filled structural pins for the two pre-meta-pin paired
     configs (resnet_infer — ISSUE 2's eval-scan dispatch-tax pair;

@@ -111,12 +111,17 @@ def generate():
                    ['convert_reader_to_recordio_file',
                     'convert_reader_to_recordio_files'])
     # the distributed runtime surface (ISSUE 12: the two-tier embedding
-    # cache lives here next to its AsyncSparseEmbedding host tier)
+    # cache lives here next to its AsyncSparseEmbedding host tier;
+    # ISSUE 13: the elastic job + its checkpoint store and the master's
+    # membership/snapshot doors)
     import paddle_tpu.distributed as distributed
     lines += _walk('paddle_tpu.distributed', distributed, [
         'AsyncSparseEmbedding', 'AsyncSparseClosedError',
         'CachedEmbeddingTable', 'EmbedCacheCapacityError',
         'optimizer_accumulator_vars',
+        'ElasticTrainJob', 'AsyncShardedCheckpoint',
+        'CheckpointWriteError', 'ElasticJobError',
+        'Master', 'MasterServer', 'MasterClient',
     ])
     return sorted(set(lines))
 
