@@ -295,6 +295,12 @@ def _decode_block(model, make_prompt, lens, place, slots=4, k_steps=4,
         'host_syncs_per_token': d['host_syncs_per_token'],
         'chain_flushes': d['chain_flushes'],
         'decode_pipeline_depth': eng.config.decode_pipeline_depth,
+        # chunked prefill (ISSUE 14): these blocks run the monolithic
+        # lane (prefill_chunk=None), so chunks stay 0 and the stall
+        # gauge reports whatever the prompt mix imposed — the chunked
+        # counterfactual is tools/perf_gate.py chunked_prefill
+        'prefill_chunks': d['prefill_chunks'],
+        'max_decode_stall_cycles': d['max_decode_stall_cycles'],
         'decode_slots': slots,
         'executables': m['executor_compile_count'],
     }

@@ -660,7 +660,14 @@ class ElasticTrainJob(object):
     def _task_source(self):
         """The FeedPipeline source: claim -> read -> batch, one yield
         per task, run on the STAGING thread so the whole pull overlaps
-        device compute.  Stops at pass end or a pending resize."""
+        device compute.  Stops at pass end or a pending resize.  Pass
+        advancement is SHARED-safe (ISSUE 14): several workers drain
+        one master and each reports pass end, so the advance is
+        ``new_pass(expected=)`` on the pass this source observed — a
+        peer's earlier advance makes ours a no-op instead of a double
+        cursor bump (or a mid-pass recycle of the next pass's done
+        tasks)."""
+        master_pass = self.master.current_pass()
         while not self._stop and not self._resize_pending:
             tid, task = self.master.get_task()
             if tid == -1:
@@ -668,7 +675,12 @@ class ElasticTrainJob(object):
                 if self._cur_pass >= self.pass_num:
                     self._pass_done = True
                     return
-                self.master.new_pass()
+                if self.master.new_pass(expected=master_pass):
+                    master_pass += 1
+                else:
+                    # a peer worker advanced first: resync to the
+                    # master's cursor instead of double-advancing
+                    master_pass = self.master.current_pass()
                 continue
             if task is None:
                 # nothing claimable RIGHT NOW: either a peer holds

@@ -326,6 +326,11 @@ class Master(object):
         self._members = {}
         self._membership_epoch = 0
         self._members_lock = threading.Lock()
+        # guards the new_pass check-then-advance (ISSUE 14): several
+        # workers share one master and each reports pass end — the
+        # compare must be atomic with the advance or two observers of
+        # the same -1 double-advance the cursor
+        self._pass_lock = threading.Lock()
         # monotone mutation counter: EVERY queue-state change bumps it
         # (set_dataset, claims, finish/fail, new_pass, restore) — the
         # replication door keys snapshot freshness on this, and keying
@@ -488,10 +493,32 @@ class Master(object):
         if force or self._events % self.SNAPSHOT_EVERY == 0:
             self.snapshot_to_store()
 
-    def new_pass(self):
-        self._q.new_pass()
-        self.pass_num += 1
-        self._seq += 1
+    def current_pass(self):
+        """The pass cursor — what a worker passes back as
+        ``new_pass(expected=)`` so pass advancement is shared safely."""
+        return self.pass_num
+
+    def new_pass(self, expected=None):
+        """Recycle done tasks into todo and advance the pass cursor.
+
+        ``expected`` is the multi-worker protocol (ISSUE 14, the PR 12
+        listed-untested gap): several workers drain ONE master, and
+        EACH reports pass end when it observes get_task() == -1 — so
+        the advance must be compare-and-set on the pass the worker was
+        draining.  A stale duplicate (a faster peer already advanced)
+        no-ops instead of double-advancing the cursor — or worse,
+        recycling the NEXT pass's freshly-done tasks back into todo
+        mid-pass, which would serve records twice per pass and skew
+        the ack accounting.  ``expected=None`` (a single-owner caller)
+        advances unconditionally, the pre-ISSUE-14 semantics.
+        Returns True when the pass actually advanced."""
+        with self._pass_lock:
+            if expected is not None and int(expected) != self.pass_num:
+                return False
+            self._q.new_pass()
+            self.pass_num += 1
+            self._seq += 1
+            return True
 
     def counts(self):
         """(todo, pending, done, discarded)"""
@@ -623,11 +650,21 @@ class SnapshotReplica(object):
             self._thread = None
 
 
-def cloud_reader(master, pass_num=1, poll_interval=0.05):
+def cloud_reader(master, pass_num=1, poll_interval=0.05,
+                 base_pass=None):
     """Record iterator over the master's task queue (reference
     python/paddle/v2/reader/creator.py:91 cloud_reader): claims a task,
     streams its record range, reports completion; failures (reader
-    exceptions) report task_failed so another trainer retries the chunk."""
+    exceptions) report task_failed so another trainer retries the chunk.
+
+    ``base_pass`` (ISSUE 14): the JOB's starting pass cursor, for
+    fleets of readers sharing one master — every worker of one job
+    passes the same base (usually 0, or the checkpointed cursor), so
+    ``pass_num`` bounds the MASTER's passes rather than each worker's
+    attach-relative count (a worker attaching after a peer already
+    advanced the cursor must not extend the run by its own pass_num).
+    None anchors at this reader's attach point — exact legacy
+    semantics for a lone reader."""
 
     def reader():
         passes = 0
@@ -655,13 +692,36 @@ def cloud_reader(master, pass_num=1, poll_interval=0.05):
                 entry[1] = pos
             return records
 
+        # the shared-master pass protocol (ISSUE 14): progress is the
+        # MASTER's pass cursor, not this reader's count of -1
+        # sightings — N readers all observe every pass end, so the
+        # advance is new_pass(expected=<the pass being drained>): one
+        # reader wins, the others' duplicates no-op and resync.  A
+        # master without current_pass (a minimal stand-in) keeps the
+        # legacy local counting.
+        if hasattr(master, 'current_pass'):
+            cur = master.current_pass()
+            base = int(base_pass) if base_pass is not None else cur
+        else:
+            cur = base = None
         try:
             while passes < pass_num:
                 tid, task = master.get_task()
                 if tid == -1:
-                    passes += 1
-                    if passes < pass_num:
-                        master.new_pass()
+                    if cur is None:
+                        passes += 1
+                        if passes < pass_num:
+                            master.new_pass()
+                        continue
+                    passes = cur - base + 1
+                    if passes >= pass_num:
+                        continue  # final pass drained: loop exits
+                    if master.new_pass(expected=cur):
+                        cur += 1
+                    else:
+                        # a peer advanced first (maybe further than
+                        # one pass while we were mid-claim): resync
+                        cur = master.current_pass()
                     continue
                 if task is None:
                     time.sleep(poll_interval)

@@ -11,7 +11,8 @@ so the Go version's codec buys nothing here.  One request per line:
     {"method": "task_finished", "tid": N}      -> {"ok": true}
     {"method": "task_failed", "tid": N}        -> {"discarded": 0|1}
     {"method": "counts"}                       -> {"counts": [t,p,d,x]}
-    {"method": "new_pass"}                     -> {"ok": true}
+    {"method": "new_pass", "expected": p|null} -> {"ok": true, "advanced": bool}
+    {"method": "pass_num"}                     -> {"pass_num": p}
 
 The server owns the Master instance; trainers hold a MasterClient.
 Fault tolerance semantics live in the queue itself (timeouts requeue a
@@ -49,8 +50,11 @@ class _Handler(socketserver.StreamRequestHandler):
                 elif method == 'counts':
                     resp = {'counts': list(master.counts())}
                 elif method == 'new_pass':
-                    master.new_pass()
-                    resp = {'ok': True}
+                    advanced = master.new_pass(
+                        expected=req.get('expected'))
+                    resp = {'ok': True, 'advanced': advanced}
+                elif method == 'pass_num':
+                    resp = {'pass_num': master.current_pass()}
                 elif method in ('register_worker', 'heartbeat',
                                 'deregister_worker'):
                     # membership door (the etcd registration dir): a
@@ -153,8 +157,12 @@ class MasterClient(object):
     def counts(self):
         return tuple(self._call(method='counts')['counts'])
 
-    def new_pass(self):
-        self._call(method='new_pass')
+    def new_pass(self, expected=None):
+        return self._call(method='new_pass',
+                          expected=expected)['advanced']
+
+    def current_pass(self):
+        return self._call(method='pass_num')['pass_num']
 
     def register_worker(self, worker_id):
         r = self._call(method='register_worker', worker_id=worker_id)

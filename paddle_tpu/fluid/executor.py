@@ -457,6 +457,75 @@ def check_decode_carry(carry, spec, what):
                                     sorted(want - have), sorted(extra)))
 
 
+def normalize_chunk_spec(chunk):
+    """Validate + normalize the ``chunk=`` argument shared by BOTH
+    executors' ``run_chunk_prefill`` (ISSUE 14).  The spec names the
+    chunked-prefill wiring of a CHUNK program — the C-token-block form
+    of a generation model's prompt consumption:
+
+      token:    the feed carrying one [S, C, 1] token block per slot
+      len:      optional per-slot real-length feed ([S, 1] float — the
+                transformer family masks its in-block scatter with it;
+                the engine always ALSO injects the token feed's @SEQLEN
+                companion for sequence-op masking)
+      state:    ordered (step_feed_name, chunk_fetch) pairs — the
+                chunk program's advanced value for every decode-state
+                slab (must cover the decode spec's state feeds exactly)
+      start_id: the BOS token written into finishing slots' carry
+    """
+    if not isinstance(chunk, dict):
+        raise ValueError('chunk= must be a dict (token/len/state/'
+                         'start_id), got %r' % (type(chunk), ))
+    missing = [k for k in ('token', 'state', 'start_id')
+               if k not in chunk]
+    if missing:
+        raise ValueError('chunk= is missing %s' % missing)
+
+    def name_of(v):
+        return v.name if isinstance(v, Variable) else str(v)
+
+    state = chunk['state']
+    if isinstance(state, dict):
+        state = list(state.items())
+    state = [(str(feed_n), name_of(fetch)) for feed_n, fetch in state]
+    if not state:
+        raise ValueError('chunk= needs at least one state pair — a '
+                         'chunk that advances no slab is a no-op')
+    return {
+        'token': str(chunk['token']),
+        'len': (str(chunk['len'])
+                if chunk.get('len') is not None else None),
+        'state': tuple(state),
+        'start_id': int(chunk['start_id']),
+    }
+
+
+def check_chunk_aux(aux, what, slots=None):
+    """Fail fast when a chunk dispatch's per-slot aux leaves are
+    malformed (shared by both executors' run_chunk_prefill): the
+    active/finish masks and the finishing-slot step budget must all be
+    present, one-dimensional, and ``slots`` long — a transposed or
+    scalar leaf would otherwise surface as an opaque jit broadcasting
+    error (or silently wrong finish masking) inside the chunk
+    kernel."""
+    if not isinstance(aux, dict):
+        raise ValueError('%s: aux must be a dict, got %r'
+                         % (what, type(aux)))
+    missing = [k for k in ('active', 'finish', 'budget')
+               if k not in aux]
+    if missing:
+        raise ValueError('%s: aux is missing %s' % (what, missing))
+    for k in ('active', 'finish', 'budget'):
+        shape = np.shape(aux[k])
+        if len(shape) != 1 or \
+                (slots is not None and int(shape[0]) != int(slots)):
+            raise ValueError(
+                '%s: aux[%r] must be a 1-D per-slot vector%s, got '
+                'shape %s' % (what, k,
+                              ' of length %d' % slots
+                              if slots is not None else '', shape))
+
+
 def _reject_reader_fed(program, what):
     """The PLAIN-FEED multi paths never compose with py_reader-fed
     programs: resolving would pop exactly ONE minibatch and the K-step
@@ -1177,6 +1246,123 @@ class _CompiledBlock(object):
                      'remaining': final['remaining']}
         return carry_out, toks, alive_in
 
+    def note_chunk_compile(self, width, carry_sig):
+        """note_multi_compile for the CHUNK-prefill executable cache
+        (the chunk width is the static shape knob, like steps for the
+        scans)."""
+        return self.note_multi_compile(width, carry_sig,
+                                       seen_attr='_chunk_widths_seen')
+
+    def _make_chunk_prefill(self, spec):
+        """The C-tokens-per-dispatch PREFILL advance (ISSUE 14): run
+        the chunk program over the WHOLE slot batch once — each
+        PREFILLING slot consumes its next block of prompt tokens and
+        its state slabs advance IN PLACE on the carry (the same donated
+        carry the decode scans chain on, so chunk dispatches interleave
+        with decode dispatches with no host round trip).  Slots not in
+        the chunk (``aux['active']`` False: decoding, free, or already
+        past their prompt) keep their slabs bitwise.  Slots whose
+        prompt ENDS inside this block (``aux['finish']``) transition to
+        decoding on the same dispatch: token <- start_id, alive <-
+        True, remaining <- their step budget — the first decode scan
+        dispatched after this chunk picks them up at a step boundary.
+        Returns (carry', alive') where alive' is a separate small
+        output the engine harvests to time the chunk (and surface a
+        deferred device error) without touching the chained carry."""
+        import jax.numpy as jnp
+        fn = self._fn
+        rw_keys = list(self.state_rw)
+        start_id = int(spec['start_id'])
+        updates = [(feed_n, self.fetch_names.index(fetch_n))
+                   for feed_n, fetch_n in spec['state']]
+
+        def chunk_prefill(state_ro, feeds, carry, aux, rng):
+            s, slots = carry['state'], carry['slots']
+            merged = dict(feeds)
+            merged.update(slots)
+            new_state, fetches = fn(s, state_ro, merged, rng)
+            active = aux['active']
+            new_slots = dict(slots)
+            for feed_n, fi in updates:
+                upd = fetches[fi]
+                keep = active.reshape(
+                    (-1, ) + (1, ) * (max(upd.ndim, 1) - 1))
+                new_slots[feed_n] = jnp.where(keep, upd, slots[feed_n])
+            fin = aux['finish']
+            token = jnp.where(fin[:, None],
+                              jnp.asarray(start_id, carry['token'].dtype),
+                              carry['token'])
+            alive = jnp.logical_or(carry['alive'], fin)
+            remaining = jnp.where(fin, aux['budget'].astype(
+                carry['remaining'].dtype), carry['remaining'])
+            c2 = {'state': {k: new_state.get(k, s[k]) for k in rw_keys},
+                  'slots': new_slots, 'token': token, 'alive': alive,
+                  'remaining': remaining}
+            return c2, alive
+
+        return chunk_prefill
+
+    def _wrap_chunk_prefill_jit(self, feeds, carry, spec, donate):
+        """jit wrapping for the chunk-prefill advance; the SPMD block
+        overrides this to shard every slot-leading leaf over dp, like
+        the decode scan."""
+        import jax
+        return jax.jit(self._make_chunk_prefill(spec),
+                       donate_argnums=donate)
+
+    def _get_chunk_prefill_jit(self, feeds, carry, spec):
+        """One chunk-prefill executable per (feed, slot, spec) name
+        structure — the chunk width is part of the token feed's traced
+        SHAPE, so a fixed ``prefill_chunk`` compiles exactly once (the
+        ragged final block pads to the same width).  The carry is
+        DONATED on device like the decode scan's."""
+        key = (tuple(sorted(feeds)), tuple(sorted(carry['slots'])),
+               spec['token'], spec['state'], spec['start_id'])
+        cache = getattr(self, '_chunk_jits', None)
+        if cache is None:
+            cache = self._chunk_jits = {}
+        jitted = cache.get(key)
+        if jitted is None:
+            donate = ()
+            if self._device_platform() != 'cpu':
+                donate = (2, )
+            jitted = self._wrap_chunk_prefill_jit(feeds, carry, spec,
+                                                  donate)
+            cache[key] = jitted
+        return jitted
+
+    def run_chunk_prefill(self, scope, feed_values, rng_key, carry, aux,
+                          spec):
+        """ONE C-token prefill advance over the whole slot batch (the
+        chunked-prefill sibling of run_decode_multi — ISSUE 14).
+        ``feed_values`` carries the chunk program's block feeds (token
+        block + optional per-slot lengths + the token feed's @SEQLEN
+        companion); ``carry`` is the engine-facing slot view; ``aux``
+        the per-slot active/finish/budget leaves.  Returns (carry',
+        alive') with NO host sync."""
+        if any(_is_host_op(op) for op in self.ops):
+            raise RuntimeError(
+                'run_chunk_prefill: the program contains host ops and '
+                'cannot run as one on-device advance — chunk programs '
+                'must be pure compute')
+        state_rw, state_ro, feeds = self._materialize_args(
+            scope, feed_values, cache_ro=True)
+        jitted = self._get_chunk_prefill_jit(feeds, carry, spec)
+        full = {'state': state_rw, 'slots': dict(carry['slots']),
+                'token': carry['token'], 'alive': carry['alive'],
+                'remaining': carry['remaining']}
+        self.last_chunk_cost = self._capture_cost(
+            'chunk_prefill',
+            (tuple(sorted(feeds)), tuple(sorted(carry['slots']))),
+            jitted, (state_ro, feeds, full, aux, rng_key))
+        final, ok = jitted(state_ro, feeds, full, aux, rng_key)
+        for name, val in final['state'].items():
+            scope.var(name).set_value(val)
+        carry_out = {'slots': final['slots'], 'token': final['token'],
+                     'alive': final['alive'],
+                     'remaining': final['remaining']}
+        return carry_out, ok
+
 
 class Executor(object):
     """Program runner (reference executor.py:256 / executor.cc:125)."""
@@ -1732,6 +1918,47 @@ class Executor(object):
         carry_out, toks, alive_in = compiled.run_decode_multi(
             scope, const, rng, steps, carry, spec)
         return carry_out, toks, alive_in, compiled
+
+    def _dispatch_chunk_prefill(self, program=None, feed=None, carry=None,
+                                aux=None, chunk=None, scope=None):
+        """Async front half of chunked prefill (ISSUE 14 — the engine's
+        chunk lane drives this, the chunk twin of
+        _dispatch_decode_multi): resolve + compile the C-token prefill
+        advance of a CHUNK program and dispatch it against a carry
+        whose leaves may be DEVICE-RESIDENT (the chained decode
+        carry), returning (carry', alive', compiled) with NO host
+        sync.  ``feed`` carries the [S, C, 1] token block, its @SEQLEN
+        companion, and the optional per-slot length feed; ``aux`` the
+        active/finish/budget slot masks."""
+        program = _reject_reader_fed(program, 'run_chunk_prefill')
+        if carry is None or aux is None or chunk is None:
+            raise ValueError('run_chunk_prefill: carry=, aux= and '
+                             'chunk= are required')
+        spec = normalize_chunk_spec(chunk)
+        carry = canonical_decode_carry(carry)
+        check_chunk_aux(aux, 'run_chunk_prefill',
+                        slots=int(np.shape(carry['token'])[0]))
+        fetch_list = [f for _, f in spec['state']]
+        sig_feed = dict(feed or {})
+        sig_feed.update(carry['slots'])
+        program, scope, feed_arrays, compiled = self._resolve_and_compile(
+            program, sig_feed, fetch_list, scope, pop_readers=False)
+        block_feed = {n: v for n, v in feed_arrays.items()
+                      if n not in carry['slots']}
+        rng = self._next_rng(program)
+        width = int(np.shape(feed_arrays[spec['token']])[1])
+        carry_sig = dict(carry['slots'])
+        carry_sig[spec['token']] = feed_arrays[spec['token']]
+        if compiled.note_chunk_compile(width, carry_sig):
+            self.compile_count += 1
+        from . import trace as _trace
+        _trace.flight_recorder.record(
+            'chunk_dispatch', executor='Executor', width=width,
+            slots=int(np.shape(carry['token'])[0]),
+            trace_id=getattr(_trace.current(), 'trace_id', None))
+        carry_out, ok = compiled.run_chunk_prefill(
+            scope, block_feed, rng, carry, aux, spec)
+        return carry_out, ok, compiled
 
     def _convert_fetches(self, fetches, return_numpy):
         def convert(f):

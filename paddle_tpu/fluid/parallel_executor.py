@@ -349,6 +349,34 @@ class _SpmdCompiledBlock(_CompiledBlock):
             out_shardings=(carry_sh, out_row, out_row),
             donate_argnums=donate)
 
+    def _wrap_chunk_prefill_jit(self, feeds, carry, spec, donate):
+        """The chunk-prefill advance (ISSUE 14), jitted with this
+        block's GSPMD shardings: the slot carry shards like the decode
+        scan's, the [S, C, 1] token block (and its @SEQLEN/length
+        companions) shards its slot dim over the batch axis, and the
+        aux active/finish/budget leaves ride the same row sharding."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = self.mesh
+        row_spec = P(self.batch_axis) \
+            if self.batch_axis in mesh.axis_names else P()
+        row = NamedSharding(mesh, row_spec)
+        ro_sh = {n: self._state_shardings[n] for n in self.state_ro}
+        feed_sh = {n: self._feed_shardings.get(n, row) for n in feeds}
+        carry_sh = {
+            'state': {n: self._state_shardings[n]
+                      for n in self.state_rw},
+            'slots': {n: self._feed_shardings[n]
+                      for n in carry['slots']},
+            'token': row, 'alive': row, 'remaining': row,
+        }
+        aux_sh = {'active': row, 'finish': row, 'budget': row}
+        return jax.jit(
+            self._make_chunk_prefill(spec),
+            in_shardings=(ro_sh, feed_sh, carry_sh, aux_sh, None),
+            out_shardings=(carry_sh, row),
+            donate_argnums=donate)
+
     def _wrap_eval_multi_jit(self, feeds, scanned, donate):
         """The shared K-eval-batches-per-dispatch scan, jitted with this
         block's GSPMD shardings (feeds/lots sharded batch-dim over 'dp'
@@ -804,6 +832,52 @@ class ParallelExecutor(object):
         self.dispatch_count += 1
         self.steps_dispatched += steps
         return carry_out, toks, alive_in, compiled
+
+    def _dispatch_chunk_prefill(self, feed=None, carry=None, aux=None,
+                                chunk=None):
+        """Async front half of the SPMD chunked prefill (ISSUE 14,
+        mirroring Executor._dispatch_chunk_prefill): one C-token
+        prefill advance of the chunk program over the dp-sharded slot
+        batch, chained on the same device-resident carry the decode
+        scans use.  Returns (carry', alive', compiled), no host
+        sync."""
+        from .executor import normalize_chunk_spec, check_chunk_aux, \
+            canonical_decode_carry
+        _reject_reader_fed(self._main_program,
+                           'ParallelExecutor.run_chunk_prefill')
+        if carry is None or aux is None or chunk is None:
+            raise ValueError('run_chunk_prefill: carry=, aux= and '
+                             'chunk= are required')
+        spec = normalize_chunk_spec(chunk)
+        carry = canonical_decode_carry(carry)
+        slots = int(np.shape(carry['token'])[0])
+        check_chunk_aux(aux, 'run_chunk_prefill', slots=slots)
+        if slots % self._dp_extent() != 0:
+            raise ValueError(
+                'run_chunk_prefill: %d slots do not divide over the dp '
+                'extent %d — size the slot batch to a multiple of the '
+                'mesh' % (slots, self._dp_extent()))
+        fetch_names = self._fetch_names([f for _, f in spec['state']])
+        sig_feed = dict(feed or {})
+        sig_feed.update(carry['slots'])
+        feed_arrays = prepare_feed_arrays(sig_feed)
+        compiled = self._resolve(fetch_names, feed_arrays)
+        block_feed = {n: v for n, v in feed_arrays.items()
+                      if n not in carry['slots']}
+        width = int(np.shape(feed_arrays[spec['token']])[1])
+        carry_sig = dict(carry['slots'])
+        carry_sig[spec['token']] = feed_arrays[spec['token']]
+        if compiled.note_chunk_compile(width, carry_sig):
+            self.compile_count += 1
+        from . import trace as _trace
+        _trace.flight_recorder.record(
+            'chunk_dispatch', executor='ParallelExecutor', width=width,
+            slots=slots,
+            trace_id=getattr(_trace.current(), 'trace_id', None))
+        carry_out, ok = compiled.run_chunk_prefill(
+            self._scope, block_feed, self._next_rng(), carry, aux, spec)
+        self.dispatch_count += 1
+        return carry_out, ok, compiled
 
     def cost_report(self):
         """Per-executable cost registry (ISSUE 6), the SPMD twin of

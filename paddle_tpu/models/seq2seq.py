@@ -224,31 +224,82 @@ def build_step_decode(src_dict_dim=1000,
                       decoder_size=64,
                       start_id=0,
                       end_id=1,
-                      max_len=16):
+                      max_len=16,
+                      chunk=None):
     """STEPWISE greedy NMT decode for the generation serving lane
-    (ISSUE 7): the same encoder boot ``build_decode`` computes, split
-    into the prefill/step contract ``serving.GenerationSpec`` consumes.
+    (ISSUE 7), CHUNKABLE since ISSUE 14: the prompt encoder is a
+    masked GRU recurrence (``dynamic_gru``) whose hidden state IS the
+    decode state, so a prompt can prefill either in ONE pass (the
+    monolithic ``prefill`` program) or as a chain of C-token blocks
+    (the ``chunk`` program) with BITWISE-identical final state — the
+    same masked scan, the same shared weights, merely split across
+    dispatches at token boundaries.
 
-      prefill: src LoD -> the decoder's boot hidden (encoder ->
-          sequence_last_step -> fc tanh — machine_translation.py's
-          decoder_boot), ONE [B, decoder_size] state fetch;
+      prefill: src LoD -> embedding -> fc -> dynamic_gru (h0 = zeros,
+          steps past each row's length frozen by the @SEQLEN mask) ->
+          sequence_last_step: ONE [B, decoder_size] state fetch (the
+          hidden after the prompt's last real token);
+      chunk (``chunk=C`` builds it): (gen_ctok [B, C, 1] token block,
+          gen_hidden) -> the SAME embedding/fc/dynamic_gru (ParamAttr-
+          pinned shared names) seeded with ``h_0=gen_hidden`` and
+          masked by the block's per-row real length (the engine feeds
+          the @SEQLEN companion) -> the advanced hidden.  Chaining
+          ceil(L/C) chunks over a prompt == the monolithic prefill
+          bitwise: a masked lax.scan applies, for every j < L,
+          ``h = gru(x_j, h)`` and freezes the rest — partitioning j
+          over chunk dispatches changes no float op.
       step: (token, hidden) -> (vocab logits, hidden') — embedding +
-          fc + one gru_unit, the per-token recurrence of the reference
-          decoder without the beam bookkeeping (greedy, beam 1).
+          fc + one gru_unit SHARING the prefill GRU's weight (one
+          recurrence consumes the prompt and generates), greedy beam 1.
 
     Every step-program op is row-independent, so the slot-batched
-    decode scan is token-identical to per-request decode.  Both
-    programs' params are disjoint and uniquely named (ONE global
-    unique_name session), so one scope runs both startup programs."""
+    decode scan is token-identical to per-request decode.  The
+    prefill/chunk pair shares ONE gru bias (``dynamic_gru`` always
+    creates one — both adding the same zero-initialized param keeps
+    chaining bitwise), while the step recurrence ``gru_unit`` is
+    bias-free: the two coincide numerically only while that bias
+    stays zero (it is never trained here), so prompt consumption and
+    decode share the [D, 3D] recurrence WEIGHT, not strictly every
+    term.  ``encoder_size`` is retained for call-site compatibility
+    (the GRU prompt encoder is sized by ``decoder_size``)."""
+    del encoder_size  # the GRU prompt encoder is decoder_size-wide
+    shared = {
+        'emb': fluid.ParamAttr(name='gen_nmt_src_emb'),
+        'proj': fluid.ParamAttr(name='gen_nmt_src_proj'),
+        'gru': fluid.ParamAttr(name='gen_nmt_gru_w'),
+        # dynamic_gru always carries a bias; prefill and chunk must add
+        # the SAME one or chaining would not be bitwise
+        'gru_b': fluid.ParamAttr(name='gen_nmt_gru_b'),
+    }
+
+    def _encode(tokens, h_0=None, flatten=1):
+        emb = fluid.layers.embedding(
+            input=tokens, size=[src_dict_dim, embedding_dim],
+            param_attr=shared['emb'])
+        proj = fluid.layers.fc(input=emb, size=decoder_size * 3,
+                               bias_attr=False, num_flatten_dims=flatten,
+                               param_attr=shared['proj'])
+        hidden_seq = fluid.layers.dynamic_gru(
+            proj, decoder_size, param_attr=shared['gru'],
+            bias_attr=shared['gru_b'], h_0=h_0)
+        return fluid.layers.sequence_last_step(input=hidden_seq)
+
     prefill, prefill_startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(prefill, prefill_startup):
         src = fluid.layers.data(
             name='src_word_id', shape=[1], dtype='int64', lod_level=1)
-        encoder_out = encoder(src, src_dict_dim, embedding_dim,
-                              encoder_size)
-        encoder_last = fluid.layers.sequence_last_step(input=encoder_out)
-        boot = fluid.layers.fc(input=encoder_last, size=decoder_size,
-                               act='tanh')
+        boot = _encode(src)
+    chunk_prog = chunk_startup = chunk_h = None
+    if chunk is not None:
+        from ..fluid.shape_policy import bucketed_len
+        chunk = bucketed_len(int(chunk))
+        chunk_prog, chunk_startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(chunk_prog, chunk_startup):
+            ctok = fluid.layers.data(name='gen_ctok', shape=[chunk, 1],
+                                     dtype='int64')
+            hidden_in = fluid.layers.data(
+                name='gen_hidden', shape=[decoder_size], dtype='float32')
+            chunk_h = _encode(ctok, h_0=hidden_in, flatten=2)
     step, step_startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(step, step_startup):
         token = fluid.layers.data(name='gen_token', shape=[1],
@@ -260,9 +311,10 @@ def build_step_decode(src_dict_dim=1000,
         decoder_inputs = fluid.layers.fc(
             input=pre_word, size=decoder_size * 3, bias_attr=False)
         h, _, _ = fluid.layers.gru_unit(
-            decoder_inputs, hidden, decoder_size * 3)
+            decoder_inputs, hidden, decoder_size * 3,
+            param_attr=shared['gru'], bias_attr=False)
         logits = fluid.layers.fc(input=h, size=trg_dict_dim)
-    return dict(
+    out = dict(
         prefill=prefill,
         prefill_startup=prefill_startup,
         step=step,
@@ -272,6 +324,15 @@ def build_step_decode(src_dict_dim=1000,
         token='gen_token',
         logits=logits,
         state=[('gen_hidden', h)],
+        prompt='src_word_id',
         start_id=start_id,
         end_id=end_id,
         max_len=max_len)
+    if chunk is not None:
+        out.update(
+            chunk=chunk_prog,
+            chunk_startup=chunk_startup,
+            chunk_token='gen_ctok',
+            chunk_state=[('gen_hidden', chunk_h)],
+            chunk_width=chunk)
+    return out

@@ -127,7 +127,8 @@ def build_step_decode(vocab=1000,
                       max_ctx=32,
                       start_id=0,
                       end_id=1,
-                      max_len=16):
+                      max_len=16,
+                      chunk=None):
     """STEPWISE KV-cache greedy decode for the generation serving lane
     (ISSUE 7): a single-layer incremental-attention decoder LM over a
     dense prompt — the Transformer-shaped workload whose decode state
@@ -147,7 +148,20 @@ def build_step_decode(vocab=1000,
     the embedding and the K/V projections), so the cached prompt
     prefix lives in the same projection space the step extends.  All
     step ops are row-independent: the slot-batched decode scan is
-    token-identical to per-request decode."""
+    token-identical to per-request decode.
+
+    ``chunk=C`` (ISSUE 14) additionally builds a CHUNK program — the
+    incremental form of prefill over a ``[B, C]`` token block against
+    the KV slab at a per-row position offset: the block's K/V
+    projections (the SAME shared weights) scatter into rows
+    ``pos .. pos+clen-1`` (a per-position one-hot matmul, rows past
+    the block's real length ``clen`` masked out), and ``pos`` advances
+    by ``clen``.  Chaining ceil(L/C) chunks writes exactly the rows
+    the monolithic prefill's admission zero-pad writes (the K/V
+    projections are per-token — no cross-token term exists in this
+    family's prefill state, so no intra-chunk causal attention is
+    needed for exactness), leaving generated tokens identical.  C is
+    quantized up to the shared seq-len rung ladder."""
     shared = {
         'emb': fluid.ParamAttr(name='gen_tf_emb'),
         'k': fluid.ParamAttr(name='gen_tf_wk'),
@@ -220,7 +234,63 @@ def build_step_decode(vocab=1000,
             fluid.layers.elementwise_mul(v2, attn3), dim=1)  # [B, d_k]
         h = fluid.layers.fc([ctxv, q], d_model, act='tanh')
         logits = fluid.layers.fc(h, vocab)
-    return dict(
+    chunk_prog = chunk_startup = None
+    ck = cv = cpos = None
+    if chunk is not None:
+        from ..fluid.shape_policy import bucketed_len
+        chunk = bucketed_len(int(chunk))
+        chunk_prog, chunk_startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(chunk_prog, chunk_startup):
+            ctok = fluid.layers.data(name='gen_ctok', shape=[chunk, 1],
+                                     dtype='int64')
+            clen = fluid.layers.data(name='gen_clen', shape=[1],
+                                     dtype='float32')
+            kc = fluid.layers.data(name='gen_k', shape=[max_ctx, d_k],
+                                   dtype='float32')
+            vc = fluid.layers.data(name='gen_v', shape=[max_ctx, d_k],
+                                   dtype='float32')
+            cp = fluid.layers.data(name='gen_pos', shape=[1],
+                                   dtype='float32')
+            embc = fluid.layers.embedding(ctok, size=[vocab, d_model],
+                                          param_attr=shared['emb'])
+            k_new = fluid.layers.fc(embc, d_k, bias_attr=False,
+                                    num_flatten_dims=2,
+                                    param_attr=shared['k'])
+            v_new = fluid.layers.fc(embc, d_k, bias_attr=False,
+                                    num_flatten_dims=2,
+                                    param_attr=shared['v'])
+            # block position of token j is pos + j, valid while j < clen
+            steps = fluid.layers.assign(
+                np.arange(chunk, dtype='float32')[None, :])  # [1, C]
+            posj = fluid.layers.elementwise_add(
+                fluid.layers.expand(cp, [1, chunk]), steps)  # [B, C]
+            scat = fluid.layers.one_hot(posj, max_ctx)  # [B, C, max_ctx]
+            maskc = fluid.layers.sequence_mask(clen, maxlen=chunk,
+                                               dtype='float32')  # [B, C]
+            scat = fluid.layers.elementwise_mul(
+                scat, fluid.layers.expand(
+                    fluid.layers.unsqueeze(maskc, axes=[2]),
+                    [1, 1, max_ctx]))
+            covered = fluid.layers.reduce_sum(scat, dim=1)  # [B, max_ctx]
+            keep3 = fluid.layers.expand(
+                fluid.layers.unsqueeze(
+                    fluid.layers.scale(covered, scale=-1.0, bias=1.0),
+                    axes=[2]),
+                [1, 1, d_k])
+
+            def chunk_scatter(cache, new):
+                # rows pos..pos+clen-1 replaced by the block's
+                # projections ([B, max_ctx, C] @ [B, C, d_k] — each
+                # covered row receives exactly one new value, every
+                # other summand is 0), untouched rows keep the slab
+                return fluid.layers.elementwise_add(
+                    fluid.layers.elementwise_mul(cache, keep3),
+                    fluid.layers.matmul(scat, new, transpose_x=True))
+
+            ck = chunk_scatter(kc, k_new)
+            cv = chunk_scatter(vc, v_new)
+            cpos = fluid.layers.elementwise_add(cp, clen)
+    out = dict(
         prefill=prefill,
         prefill_startup=prefill_startup,
         step=step,
@@ -230,6 +300,19 @@ def build_step_decode(vocab=1000,
         token='gen_token',
         logits=logits,
         state=[('gen_k', k2), ('gen_v', v2), ('gen_pos', pos1)],
+        prompt='gen_src',
+        prompt_len='gen_src_len',
+        max_ctx=max_ctx,
         start_id=start_id,
         end_id=end_id,
         max_len=max_len)
+    if chunk is not None:
+        out.update(
+            chunk=chunk_prog,
+            chunk_startup=chunk_startup,
+            chunk_token='gen_ctok',
+            chunk_len='gen_clen',
+            chunk_state=[('gen_k', ck), ('gen_v', cv),
+                         ('gen_pos', cpos)],
+            chunk_width=chunk)
+    return out

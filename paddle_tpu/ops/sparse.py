@@ -10,15 +10,15 @@ SparseAdamFunctor, merge/scale math in math/selected_rows_functor.cc).
 TPU-native design: inside a compiled block a sparse gradient is a
 ``SparseRows`` pytree — rows (int32 [N]) + values ([N, D]) + static
 height — so the [V, D] dense gradient is never materialized.  The SGD
-update lowers to one XLA scatter-add; momentum, adam (ISSUE 11) and
-adagrad (ISSUE 12) run the reference's *lazy* row-subset kernels
-directly — duplicate ids merge by an in-domain scatter-add
-(``merge_rows``), the touched rows of param + moments gather to an
-[N, D] subset, the dense optimizer math runs there, and one
-scatter-update writes back, O(rows x D) per step with untouched rows'
-moments never decaying.  Remaining adaptive optimizers (rmsprop/ftrl/…)
-fall back to ``lazy_apply``'s dense-materialize + mask emulation
-(identical semantics, O(V x D)).
+update lowers to one XLA scatter-add; momentum, adam (ISSUE 11),
+adagrad (ISSUE 12) and rmsprop (ISSUE 14) run the reference's *lazy*
+row-subset kernels directly — duplicate ids merge by an in-domain
+scatter-add (``merge_rows``), the touched rows of param + moments
+gather to an [N, D] subset, the dense optimizer math runs there, and
+one scatter-update writes back, O(rows x D) per step with untouched
+rows' moments never decaying.  Remaining adaptive optimizers
+(ftrl/adadelta/…) fall back to ``lazy_apply``'s dense-materialize +
+mask emulation (identical semantics, O(V x D)).
 
 ISSUE 12 adds the hot-row cache slab exchange kernels at the bottom:
 the two-tier embedding store's device half (one padded gather of
@@ -239,15 +239,42 @@ def _rows_adagrad(ctx, op, g):
     ctx.set(op, 'MomentOut', _scatter_rows(mom, rows, m_new))
 
 
-# The FAST sparse lane (ISSUE 11/12): gather/merge/scatter row-subset
-# kernels for the optimizers the reference ships SelectedRows branches
-# for.  Everything else falls back to lazy_apply's dense-materialize +
-# mask emulation (semantically identical, O(V x D) per step).
+def _rows_rmsprop(ctx, op, g):
+    """Lazy row-subset rmsprop (ISSUE 14 satellite; rmsprop_op.cc
+    SelectedRows branch): gather the touched rows of param + mean-
+    square + momentum accumulators, run the dense rmsprop math on the
+    [N, D] subset against the MERGED gradient, scatter all three back.
+    Untouched rows' mean-square does NOT decay (the same lazy
+    semantics as momentum/adam — the reference's sparse functors only
+    visit gradient rows); with fresh (zero) state a single step is
+    dense-equivalent everywhere, which is what the duplicate-id parity
+    pins."""
+    p = ctx.get(op, 'Param')
+    ms = ctx.get(op, 'MeanSquare')
+    mom = ctx.get(op, 'Moment')
+    lr = jnp.reshape(ctx.get(op, 'LearningRate'), ())
+    eps = op.attrs.get('epsilon', 1e-10)
+    decay = op.attrs.get('decay', 0.9)
+    momentum = op.attrs.get('momentum', 0.0)
+    rows, grad = merge_rows(g.rows, g.values, g.height)
+    ms_new = decay * ms[rows] + (1 - decay) * jnp.square(grad)
+    mom_new = momentum * mom[rows] + lr * grad / jnp.sqrt(ms_new + eps)
+    ctx.set(op, 'ParamOut', _scatter_rows(p, rows, p[rows] - mom_new))
+    ctx.set(op, 'MomentOut', _scatter_rows(mom, rows, mom_new))
+    ctx.set(op, 'MeanSquareOut', _scatter_rows(ms, rows, ms_new))
+
+
+# The FAST sparse lane (ISSUE 11/12/14): gather/merge/scatter
+# row-subset kernels for the optimizers the reference ships
+# SelectedRows branches for.  Everything else falls back to
+# lazy_apply's dense-materialize + mask emulation (semantically
+# identical, O(V x D) per step).
 _ROW_SUBSET_APPLY = {
     'sgd': _rows_sgd,
     'momentum': _rows_momentum,
     'adam': _rows_adam,
     'adagrad': _rows_adagrad,
+    'rmsprop': _rows_rmsprop,
 }
 
 

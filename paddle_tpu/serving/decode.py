@@ -18,9 +18,15 @@ amortized training steps, with three pieces living here:
     resident in HBM, plus the per-slot token/alive/step-budget leaves
     the in-jit decode scan carries.  Requests ADMIT into free slots at
     step boundaries and RELEASE on finish — continuous batching, no
-    drain barrier.  The cache is a first-class ``HBMArbiter`` account
-    in the registry (``<model>:decode-cache``): an idle generation
-    model's slabs evict to host and re-stage transparently.
+    drain barrier.  Under chunked prefill (ISSUE 14) a slot can also
+    be in the PREFILLING phase: zeroed slabs + a host-side position
+    cursor, inert in decode scans (alive=False) while C-token chunk
+    dispatches advance the partial state in place — the finishing
+    chunk flips the slot to decoding on device.  The cache is a
+    first-class ``HBMArbiter`` account in the registry
+    (``<model>:decode-cache``): an idle generation model's slabs
+    evict to host and re-stage transparently (mid-prefill too — the
+    cursor is host state).
   * **GenerationRequest** — the future ``submit_generate`` returns;
     resolves to the generated token ids (EOS-terminated or cut at
     ``max_len``), with the PR-6 trace threading prefill/decode/
@@ -84,7 +90,10 @@ class GenerationSpec(object):
 
     def __init__(self, prefill_program, step_program, prefill_feeds,
                  prefill_fetches, token_feed, logits, state,
-                 context=(), start_id=0, end_id=1, max_len=32):
+                 context=(), start_id=0, end_id=1, max_len=32,
+                 prompt_feed=None, prompt_len_feed=None, max_ctx=None,
+                 chunk_program=None, chunk_token=None, chunk_len=None,
+                 chunk_state=None, chunk_width=None):
         self.prefill_program = prefill_program
         self.step_program = step_program
         self.prefill_feeds = list(prefill_feeds)
@@ -129,19 +138,137 @@ class GenerationSpec(object):
                                        'GenerationSpec')
             self.slot_shapes[name] = shape
             self.slot_dtypes[name] = dtype
+        # ---- prompt identity + the decode-context bound (ISSUE 14) ----
+        # prompt_feed names WHICH prefill feed carries the raw token
+        # sequence (the chunked lane slices it into C-token blocks, and
+        # the over-length typed reject measures it); prompt_len_feed
+        # names its explicit length feed for dense prompts (LoD prompts
+        # carry lengths in their LoD).  max_ctx is the decode KV
+        # context bound: a prompt (or prompt + generation budget) past
+        # it would scatter off the slab — submit_generate rejects it
+        # typed instead of surfacing an opaque XLA error mid-prefill.
+        self.prompt_feed = (str(prompt_feed)
+                            if prompt_feed is not None else None)
+        self.prompt_len_feed = (str(prompt_len_feed)
+                                if prompt_len_feed is not None else None)
+        self.max_ctx = int(max_ctx) if max_ctx is not None else None
+        # ---- chunked prefill contract (ISSUE 14) ----------------------
+        self.chunk_program = chunk_program
+        self.chunk_token = (str(chunk_token)
+                            if chunk_token is not None else None)
+        self.chunk_len = str(chunk_len) if chunk_len is not None else None
+        if isinstance(chunk_state, dict):
+            chunk_state = list(chunk_state.items())
+        self.chunk_state = ([(str(n), v) for n, v in chunk_state]
+                            if chunk_state is not None else None)
+        self.chunk_width = (int(chunk_width)
+                            if chunk_width is not None else None)
+        if chunk_program is not None:
+            if self.chunk_token is None or self.chunk_state is None or \
+                    self.chunk_width is None:
+                raise ValueError(
+                    'GenerationSpec: a chunk program needs chunk_token, '
+                    'chunk_state and chunk_width alongside it')
+            if self.prompt_feed is None:
+                raise ValueError(
+                    'GenerationSpec: chunked prefill needs prompt_feed '
+                    '— the engine must slice the raw token sequence '
+                    'into chunk blocks')
+            if self.context:
+                raise ValueError(
+                    'GenerationSpec: chunked prefill does not support '
+                    'context feeds — a chunk advances only the decode '
+                    'STATE slabs, so frozen per-request context has no '
+                    'chunk to initialize it')
+            if [n for n, _ in self.chunk_state] != \
+                    [n for n, _ in self.state]:
+                raise ValueError(
+                    'GenerationSpec: chunk_state must advance exactly '
+                    'the decode state feeds, in order (%s vs %s)'
+                    % ([n for n, _ in self.chunk_state],
+                       [n for n, _ in self.state]))
+            if any(_is_host_op(op)
+                   for op in chunk_program.global_block().ops):
+                raise ValueError(
+                    'GenerationSpec: chunk_program contains host ops '
+                    'and cannot run inside the decode lane')
+            from ..fluid.shape_policy import bucketed_len
+            if bucketed_len(self.chunk_width) != self.chunk_width:
+                raise ValueError(
+                    'GenerationSpec: chunk_width %d is not a seq-len '
+                    'ladder rung — build the model with a rung-'
+                    'quantized chunk (shape_policy.bucketed_len)'
+                    % self.chunk_width)
+            for name, _ in self.state:
+                shape, dtype = _slot_shape(chunk_program, name,
+                                           'GenerationSpec chunk')
+                if shape != self.slot_shapes[name] or \
+                        dtype != self.slot_dtypes[name]:
+                    raise ValueError(
+                        'GenerationSpec: chunk program declares state '
+                        'feed %r as %s %s, step program as %s %s — the '
+                        'chunk must advance the SAME slabs'
+                        % (name, shape, dtype, self.slot_shapes[name],
+                           self.slot_dtypes[name]))
+
+    @property
+    def supports_chunked_prefill(self):
+        return self.chunk_program is not None
+
+    def chunk_arg(self):
+        """The ``chunk=`` dict run_chunk_prefill takes (ISSUE 14)."""
+        return {'token': self.chunk_token, 'len': self.chunk_len,
+                'state': list(self.chunk_state),
+                'start_id': self.start_id}
+
+    def prompt_ids(self, feed):
+        """(token ids [L] int64, L) of one request's prompt, read from
+        the ORIGINAL submit feed: an LoD prompt carries its length in
+        the LoD, a dense one in ``prompt_len_feed`` (falling back to
+        its full padded extent)."""
+        if self.prompt_feed is None:
+            raise ValueError(
+                'GenerationSpec: no prompt_feed declared — the model '
+                'dict must name which prefill feed carries the prompt '
+                'tokens')
+        from ..fluid import core
+        v = feed[self.prompt_feed]
+        if isinstance(v, core.LoDTensor) and v.lod():
+            ids = np.asarray(v.numpy()).reshape(-1)
+            return ids.astype(np.int64), int(ids.shape[0])
+        arr = np.asarray(v.numpy() if isinstance(v, core.LoDTensor)
+                         else v)
+        flat = arr.reshape(-1)
+        length = int(flat.shape[0])
+        if self.prompt_len_feed is not None and \
+                self.prompt_len_feed in feed:
+            lv = feed[self.prompt_len_feed]
+            length = int(np.asarray(
+                lv.numpy() if isinstance(lv, core.LoDTensor) else lv
+            ).reshape(-1)[0])
+        return flat[:length].astype(np.int64), length
 
     @classmethod
     def from_model(cls, model, max_len=None):
         """Build a spec from the dict contract the model zoo's
         ``build_step_decode`` builders return (prefill/step programs,
-        feed/fetch wiring, token ids)."""
+        feed/fetch wiring, token ids, and — when the model was built
+        with ``chunk=C`` — the chunked-prefill programs)."""
         return cls(model['prefill'], model['step'],
                    model['prefill_feeds'], model['prefill_fetches'],
                    model['token'], model['logits'], model['state'],
                    context=model.get('context', ()),
                    start_id=model['start_id'], end_id=model['end_id'],
                    max_len=(model['max_len'] if max_len is None
-                            else max_len))
+                            else max_len),
+                   prompt_feed=model.get('prompt'),
+                   prompt_len_feed=model.get('prompt_len'),
+                   max_ctx=model.get('max_ctx'),
+                   chunk_program=model.get('chunk'),
+                   chunk_token=model.get('chunk_token'),
+                   chunk_len=model.get('chunk_len'),
+                   chunk_state=model.get('chunk_state'),
+                   chunk_width=model.get('chunk_width'))
 
     def decode_arg(self):
         """The ``decode=`` dict run_decode_multi takes."""
@@ -181,6 +308,14 @@ class GenerationRequest(InferenceRequest):
         self.max_len = int(max_len)
         self.tokens = []
         self.slot = None
+        # chunked prefill (ISSUE 14): the raw prompt token sequence the
+        # engine slices into C-token blocks, and the phase flag — a
+        # PREFILLING request occupies a slot whose slabs hold partial
+        # state (alive=False in the carry, so decode scans freeze it)
+        # until its finishing chunk dispatches
+        self.prompt_tokens = None
+        self.prompt_len = None
+        self.prefilling = False
 
 
 class SlotStateCache(object):
@@ -225,6 +360,10 @@ class SlotStateCache(object):
         with self._lock:
             self._requests = [None] * s
             self._free = list(range(s))
+            # chunked prefill (ISSUE 14): slot -> prompt position
+            # cursor for slots in the PREFILLING phase (partial state
+            # in the slabs, inert in decode scans)
+            self._prefill = {}
 
     # ---- carry plumbing (the decode scan's view) -----------------------
 
@@ -300,11 +439,70 @@ class SlotStateCache(object):
         req.slot = idx
         return idx
 
+    def admit_prefilling(self, req):
+        """Admit one request into a free slot in the PREFILLING phase
+        (ISSUE 14 — chunked prefill): every slab row zeroes (the chunk
+        recurrence's initial state — both model families treat the
+        all-zeros slab as position 0), the carry leaves go inert
+        (token=end_id, alive=False, remaining=0 — decode scans freeze
+        the slot), and the position cursor starts at 0.  The engine's
+        chunk dispatches advance the slabs in place; the FINISHING
+        chunk flips the slot to decoding on device.  Worker-thread
+        only, at chain-flush points, like admit()."""
+        with self._lock:
+            if not self._free:
+                raise RuntimeError('SlotStateCache: no free slot')
+            idx = self._free.pop(0)
+            self._requests[idx] = req
+            self._prefill[idx] = 0
+        for name in self.spec.slot_feeds:
+            self._slabs[name] = self._write_row(
+                self._slabs[name], idx,
+                np.zeros(self.spec.slot_shapes[name],
+                         self.spec.slot_dtypes[name]))
+        self._token = self._write_row(
+            self._token, idx,
+            np.asarray([self.spec.end_id],
+                       self.spec.slot_dtypes[self.spec.token_feed]))
+        self._alive = self._write_row(self._alive, idx, False)
+        self._remaining = self._write_row(self._remaining, idx,
+                                          np.int32(0))
+        req.slot = idx
+        req.prefilling = True
+        return idx
+
+    def prefilling_items(self):
+        """[(slot, request, cursor)] for every slot mid-prefill — the
+        engine's chunk assembly reads this, the watchdog snapshot
+        counts it."""
+        with self._lock:
+            return [(idx, self._requests[idx], cur)
+                    for idx, cur in sorted(self._prefill.items())]
+
+    def advance_prefill(self, idx, n):
+        """Move one prefilling slot's cursor by ``n`` consumed prompt
+        tokens (deterministic host mirror of the dispatched chunk —
+        no device read needed)."""
+        with self._lock:
+            self._prefill[idx] += int(n)
+            return self._prefill[idx]
+
+    def finish_prefill(self, idx):
+        """The slot's finishing chunk dispatched: leave the prefilling
+        phase (the chunk kernel already flipped the carry to decoding
+        on device)."""
+        with self._lock:
+            self._prefill.pop(idx, None)
+        req = self.request_at(idx)
+        if req is not None:
+            req.prefilling = False
+
     def release(self, idx):
         with self._lock:
             req = self._requests[idx]
             self._requests[idx] = None
             self._free.append(idx)
+            self._prefill.pop(idx, None)
         if req is not None:
             req.slot = None
         return req
@@ -378,6 +576,7 @@ class SlotStateCache(object):
                 'slots': self.slots,
                 'active': self.slots - len(self._free),
                 'free': len(self._free),
+                'prefilling': len(self._prefill),
                 'bytes': self.nbytes(),
                 'slot_trace_ids': [
                     (r.trace_id if r is not None else None)

@@ -107,6 +107,23 @@ class ServingConfig(object):
         in-jit greedy loop) — the generation lane's dispatch-tax
         amortizer, bounded below the per-request latency a step
         boundary adds to admission.
+    prefill_chunk: chunked prefill (ISSUE 14) — split every prompt
+        into C-token blocks and interleave them with decode scans
+        under DECODE PRIORITY, so the max decode inter-token stall a
+        long prompt can impose is ONE chunk's wall, not the whole
+        prompt's.  The value is quantized up to the shared seq-len
+        rung ladder (fluid.shape_policy) and must match the chunk
+        width the generation model was built with
+        (``build_step_decode(chunk=C)``); requests admit into a
+        ``prefilling`` decode slot (partial state in the slabs, inert
+        in decode scans) and each worker cycle rides AT MOST one chunk
+        dispatch, budgeted by the measured chunk wall against the
+        earliest active decode deadline's headroom (ServiceTimeProfile
+        — a chunk that would push the next step boundary past an
+        imminent deadline waits a cycle).  Chunked prefill is EXACT:
+        generated tokens are identical to the monolithic lane for both
+        model families (the chunk programs chain bitwise).  None (the
+        default) keeps the monolithic PR 9 prefill-lot lane bitwise.
     decode_pipeline_depth: decode scans kept in flight (ISSUE 9 — the
         decode lane's pipeline_depth).  At 2 (the default) scan N+1 is
         enqueued against scan N's device-resident output carry BEFORE
@@ -176,9 +193,10 @@ class ServingConfig(object):
                  trailing_buckets=True, trailing_ladders=None,
                  max_trailing_buckets=32, watchdog_stall_s=None,
                  decode_slots=8, decode_steps=4, decode_pipeline_depth=2,
-                 scheduling='edf', admit_queue_depth=None,
-                 admit_queue_age_ms=None, adaptive_admission=False,
-                 priority_aging_ms=None, shed_by_class=False):
+                 prefill_chunk=None, scheduling='edf',
+                 admit_queue_depth=None, admit_queue_age_ms=None,
+                 adaptive_admission=False, priority_aging_ms=None,
+                 shed_by_class=False):
         if int(steps_per_dispatch) < 1:
             raise ValueError('steps_per_dispatch must be >= 1')
         if int(pipeline_depth) < 1:
@@ -216,6 +234,13 @@ class ServingConfig(object):
             raise ValueError('decode_pipeline_depth must be >= 1 '
                              '(1 = the per-scan-sync lane)')
         self.decode_pipeline_depth = int(decode_pipeline_depth)
+        if prefill_chunk is not None:
+            if int(prefill_chunk) < 1:
+                raise ValueError('prefill_chunk must be >= 1 (or None '
+                                 'for monolithic prefill)')
+            from ..fluid.shape_policy import bucketed_len
+            prefill_chunk = bucketed_len(int(prefill_chunk))
+        self.prefill_chunk = prefill_chunk
         self.adaptive_admission = bool(adaptive_admission)
         if scheduling not in ('edf', 'fifo'):
             raise ValueError(
@@ -401,14 +426,29 @@ class InferenceEngine(object):
         self.generation = generation
         self._decode_cache = None
         self._gen_ready = deque()  # (request, prefill values) awaiting a slot
-        # pipelined decode chain (ISSUE 9): in-flight K-step scans not
-        # yet harvested — (toks_dev, alive_in_dev, k, t_disp, slot->req
-        # snapshot, slot-map snap); bounded by decode_pipeline_depth
+        # pipelined decode chain (ISSUE 9/14): in-flight dispatches not
+        # yet harvested, kind-tagged — ('decode', toks_dev,
+        # alive_in_dev, k, t_disp, slot->req snapshot, slot-map snap)
+        # or ('chunk', ok_dev, None, width, t_disp, None, snap); FIFO =
+        # device order, bounded by decode_pipeline_depth
         self._decode_inflight = deque()
         # raw scan walls (dispatch -> harvest sync) — the decode lane's
         # own service floor for per-token deadline estimates
         self._decode_walls = deque(maxlen=8)
-        self._pe_prefill = self._pe_step = None
+        # chunked prefill (ISSUE 14): prompts awaiting a prefilling
+        # slot, measured chunk walls (the decode-priority budget), and
+        # the prefill-activity flag feeding the inter-token stall gauge
+        self._chunk_pending = deque()
+        self._chunk_walls = deque(maxlen=8)
+        self._prefill_since_harvest = False
+        self._last_harvest_t = None
+        self._last_harvest_alive = frozenset()
+        self._chunking = False
+        self._pe_prefill = self._pe_step = self._pe_chunk = None
+        if generation is None and self.config.prefill_chunk is not None:
+            raise ValueError(
+                'ServingConfig(prefill_chunk=) only applies to '
+                'generation= engines — there is no prefill to chunk')
         if generation is not None:
             if self._eager:
                 raise NotImplementedError(
@@ -418,6 +458,24 @@ class InferenceEngine(object):
             self._decode_cache = SlotStateCache(
                 generation, self.config.decode_slots, multiple=multiple)
             self._gen_decode_arg = generation.decode_arg()
+            if self.config.prefill_chunk is not None:
+                if not generation.supports_chunked_prefill:
+                    raise ValueError(
+                        'ServingConfig(prefill_chunk=%d): this '
+                        'generation model has no chunk program — build '
+                        'it with build_step_decode(chunk=%d) (and run '
+                        'its chunk_startup), or drop prefill_chunk'
+                        % (self.config.prefill_chunk,
+                           self.config.prefill_chunk))
+                if generation.chunk_width != self.config.prefill_chunk:
+                    raise ValueError(
+                        'ServingConfig(prefill_chunk=%d) does not match '
+                        'the model\'s chunk width %d — the chunk '
+                        'executable\'s block shape is fixed at build '
+                        'time' % (self.config.prefill_chunk,
+                                  generation.chunk_width))
+                self._gen_chunk_arg = generation.chunk_arg()
+                self._chunking = True
             if self._pe is not None:
                 # PE binds one program each: the prefill and step
                 # programs get their own sharded executors over the
@@ -428,6 +486,10 @@ class InferenceEngine(object):
                 self._pe_step = ParallelExecutor(
                     main_program=generation.step_program,
                     scope=self._scope, mesh=self._pe._mesh)
+                if self._chunking:
+                    self._pe_chunk = ParallelExecutor(
+                        main_program=generation.chunk_program,
+                        scope=self._scope, mesh=self._pe._mesh)
         self._metrics = EngineMetrics()
         self._inflight = deque()
         self._last_sync_t = 0.0  # previous drain's sync, clips MFU windows
@@ -564,11 +626,13 @@ class InferenceEngine(object):
             # CHAIN (ISSUE 9) — scans dispatched but never harvested
             # are exactly what a wedged chained lane looks like
             ctx['decode_slot_map'] = self._decode_cache.snapshot()
-            ctx['decode_pending'] = len(self._gen_ready)
+            ctx['decode_pending'] = len(self._gen_ready) + \
+                len(self._chunk_pending)
             now = time.time()
             try:
                 ctx['decode_chain'] = [
-                    {'steps': e[2], 'age_s': round(now - e[3], 4)}
+                    {'kind': e[0], 'steps': e[3],
+                     'age_s': round(now - e[4], 4)}
                     for e in list(self._decode_inflight)]
             except RuntimeError:
                 # a harvest mutated the deque mid-snapshot (watchdog
@@ -647,10 +711,12 @@ class InferenceEngine(object):
             if self.generation is not None:
                 programs += [self.generation.prefill_program,
                              self.generation.step_program]
+                if self.generation.chunk_program is not None:
+                    programs.append(self.generation.chunk_program)
         pids = {id(p) for p in programs}
         dropped = 0
         for runner in (self._exe, self._pe, self._pe_prefill,
-                       self._pe_step):
+                       self._pe_step, self._pe_chunk):
             cache = getattr(runner, '_cache', None)
             if not cache:
                 continue
@@ -938,31 +1004,91 @@ class InferenceEngine(object):
         max_len = spec.max_len if max_len is None else int(max_len)
         if max_len < 1:
             raise ValueError('submit_generate: max_len must be >= 1')
+        max_len = min(max_len, spec.max_len)
+        # typed over-length reject (ISSUE 14 satellite): a prompt (or
+        # prompt + generation budget) past the decode KV context would
+        # otherwise surface as an opaque XLA shape/scatter error deep
+        # inside prefill — or scatter silently off the slab mid-decode.
+        # Measured HERE, on the raw feed, before any padding touches it.
+        prompt_ids = prompt_len = None
+        if spec.prompt_feed is not None and spec.prompt_feed in feed \
+                and (self._chunking or spec.max_ctx is not None):
+            # only when someone consumes it: the chunk lane slices it,
+            # the max_ctx reject measures it — a plain monolithic
+            # engine without a context bound must not pay the copy
+            prompt_ids, prompt_len = spec.prompt_ids(feed)
+        if self._chunking and prompt_len is not None and prompt_len < 1:
+            # a zero-length prompt has no chunk to dispatch — without
+            # this it would admit into a prefilling slot whose
+            # finishing chunk never fires (the future would hang and
+            # the slot leak)
+            raise ValueError(
+                'submit_generate: the prompt is empty — chunked '
+                'prefill needs at least one token to consume')
+        if spec.max_ctx is not None and prompt_len is not None:
+            if prompt_len > spec.max_ctx:
+                raise ValueError(
+                    'submit_generate: prompt length %d exceeds the '
+                    'decode context max_ctx=%d — the KV slab has no '
+                    'row to hold token %d'
+                    % (prompt_len, spec.max_ctx, spec.max_ctx))
+            if prompt_len + max_len > spec.max_ctx:
+                raise ValueError(
+                    'submit_generate: prompt length %d + max_len %d '
+                    'exceeds the decode context max_ctx=%d — generated '
+                    'tokens would scatter off the KV slab; shorten the '
+                    'prompt or lower max_len'
+                    % (prompt_len, max_len, spec.max_ctx))
+        if self._chunking and prompt_ids is None:
+            raise ValueError(
+                'submit_generate: chunked prefill needs the prompt '
+                'feed %r in the request' % (spec.prompt_feed, ))
         ctx = _trace.current() or _trace.TraceContext()
-        t_prep = time.time()
-        feed, rows, sig, _trims = self._prepare_request(feed)
-        ctx.add_stage('pad', time.time() - t_prep)
-        if rows is None:
-            # the unbatchable path (nested LoD, or an LoD prompt with
-            # trailing bucketing disabled) has no coalescible prefill
-            # signature — say WHY instead of 'got None rows'
-            raise ValueError(
-                'submit_generate: this prompt cannot ride the batched '
-                'prefill path — nested (2-level) LoD prompts are '
-                'unsupported, and LoD prompts need trailing bucketing '
-                '(drop ServingConfig(trailing_buckets=False))')
-        if rows != 1:
-            raise ValueError(
-                'submit_generate: the prompt must be ONE sequence '
-                '(got %r rows) — submit one request per sequence so '
-                'each occupies one decode slot' % (rows, ))
-        # the 'gen' sig prefix keeps prefill lots out of forward lots
-        # even when the raw feed signatures collide
-        req = GenerationRequest(feed, rows, ('gen', ) + tuple(sig),
-                                min(max_len, spec.max_len),
+        if self._chunking:
+            # chunked prefill never forms a prefill lot, so the
+            # rung-padding pass (_prepare_request) would be a wasted
+            # full-prompt copy on the caller thread — long prompts are
+            # exactly this lane's workload.  Only the one-sequence
+            # check remains; the request carries no feed (the chunk
+            # lane reads prompt_tokens) and a constant coalescing sig
+            # (chunk-pending requests never share an executable).
+            rows = self._chunk_prompt_rows(feed[spec.prompt_feed])
+            if rows != 1:
+                raise ValueError(
+                    'submit_generate: the prompt must be ONE sequence '
+                    '(got %r rows) — submit one request per sequence '
+                    'so each occupies one decode slot' % (rows, ))
+            feed, sig = None, ('gen-chunk', )
+        else:
+            t_prep = time.time()
+            feed, rows, sig, _trims = self._prepare_request(feed)
+            ctx.add_stage('pad', time.time() - t_prep)
+            if rows is None:
+                # the unbatchable path (nested LoD, or an LoD prompt
+                # with trailing bucketing disabled) has no coalescible
+                # prefill signature — say WHY instead of 'got None
+                # rows'
+                raise ValueError(
+                    'submit_generate: this prompt cannot ride the '
+                    'batched prefill path — nested (2-level) LoD '
+                    'prompts are unsupported, and LoD prompts need '
+                    'trailing bucketing (drop '
+                    'ServingConfig(trailing_buckets=False))')
+            if rows != 1:
+                raise ValueError(
+                    'submit_generate: the prompt must be ONE sequence '
+                    '(got %r rows) — submit one request per sequence '
+                    'so each occupies one decode slot' % (rows, ))
+            # the 'gen' sig prefix keeps prefill lots out of forward
+            # lots even when the raw feed signatures collide
+            sig = ('gen', ) + tuple(sig)
+        req = GenerationRequest(feed, 1, sig, max_len,
                                 return_numpy=return_numpy, trace=ctx,
                                 priority=priority,
                                 deadline_ms=deadline_ms)
+        if self._chunking:
+            req.prompt_tokens = prompt_ids
+            req.prompt_len = prompt_len
         self._metrics.note_generate()
         self._arrivals.note()
         ctx.mark('enqueue')
@@ -970,6 +1096,24 @@ class InferenceEngine(object):
         if self._thread is None:
             self._drain_inline()
         return req
+
+    @staticmethod
+    def _chunk_prompt_rows(v):
+        """How many sequences the prompt feed carries (the chunked
+        lane's one-sequence check, without the monolithic path's
+        rung-padding pass): LoD prompts count their top-level
+        sequences (nested LoD rejected — flattening it into chunk
+        blocks would silently concatenate sequences), dense prompts
+        their leading dim."""
+        if isinstance(v, core.LoDTensor) and v.lod():
+            if len(v.lod()) >= 2:
+                raise ValueError(
+                    'submit_generate: nested (2-level) LoD prompts '
+                    'are unsupported under chunked prefill')
+            return max(len(v.lod()[-1]) - 1, 0)
+        shape = np.shape(v.numpy() if isinstance(v, core.LoDTensor)
+                         else v)
+        return int(shape[0]) if shape else 0
 
     def generate(self, feed, max_len=None, timeout=None):
         """Synchronous convenience: submit_generate + wait."""
@@ -988,16 +1132,20 @@ class InferenceEngine(object):
             self._pe.compile_count if self._pe is not None
             else self._exe.compile_count)
         if self._pe is not None and self._pe_step is not None:
-            # sharded generation compiles its prefill/step executables
-            # on their own PEs — fold them into the ground-truth count
+            # sharded generation compiles its prefill/step (and chunk)
+            # executables on their own PEs — fold them into the
+            # ground-truth count
             snap['executor_compile_count'] += (
                 self._pe_prefill.compile_count +
                 self._pe_step.compile_count)
+            if self._pe_chunk is not None:
+                snap['executor_compile_count'] += \
+                    self._pe_chunk.compile_count
         snap['inflight'] = len(self._inflight)
         snap['decode'] = (self._metrics.decode_snapshot(
             active_slots=self._decode_cache.active_slots(),
             free_slots=self._decode_cache.free_slots(),
-            pending=len(self._gen_ready),
+            pending=len(self._gen_ready) + len(self._chunk_pending),
             inflight_scans=len(self._decode_inflight))
             if self._decode_cache is not None else None)
         # the two-tier embedding cache's counters (ISSUE 12):
@@ -1256,6 +1404,9 @@ class InferenceEngine(object):
             runner = self._pe_prefill if self._pe is not None \
                 else self._exe
             self._metrics.note_prefill_lot()
+            # the stall gauge's "prefill in flight" marker (ISSUE 14):
+            # this lot's compute lands between decode scans on device
+            self._prefill_since_harvest = True
         else:
             program = self._program
             fetch_list = self._fetch_list
@@ -1577,19 +1728,151 @@ class InferenceEngine(object):
         # scan's tokens — the done() guard at harvest closes the loop
         reqs = [cache.request_at(s) for s in range(cache.slots)]
         self._decode_inflight.append(
-            (toks, alive_in, k, time.time(), reqs, snap))
+            ('decode', toks, alive_in, k, time.time(), reqs, snap))
+        return True
+
+    # ---- chunked prefill (ISSUE 14) -----------------------------------
+
+    def _admit_chunk_pending(self):
+        """Admit pending chunked-prefill prompts into free slots in the
+        PREFILLING phase (chain-flush points, like _admit_ready).
+        Returns how many were admitted."""
+        admitted = 0
+        while self._chunk_pending and self._decode_cache.free_slots():
+            req = self._chunk_pending.popleft()
+            if req.done():
+                continue
+            if self.config.scheduling == 'edf' and \
+                    req.deadline_t is not None and \
+                    time.time() > req.deadline_t:
+                self._shed_request(req, where='admit')
+                continue
+            self._decode_cache.admit_prefilling(req)
+            admitted += 1
+        return admitted
+
+    def _chunk_estimate(self):
+        """The expected wall of one chunk dispatch: the profile's
+        estimate for the chunk signature (cost-seeded, min-of-recent-
+        walls), falling back to the measured chunk-wall floor."""
+        est = self._profile.estimate(('chunk', self.config.prefill_chunk))
+        if est is None:
+            est = min(self._chunk_walls) if self._chunk_walls else 0.0
+        return est
+
+    def _chunk_should_dispatch(self):
+        """At most ONE prefill chunk rides each worker cycle (the call
+        site enforces the once-per-cycle half) — and only when it fits
+        the decode lane's deadline headroom: under EDF, if some ACTIVE
+        decoding request's deadline lands before the next step boundary
+        plus a chunk wall, the chunk waits a cycle instead of stalling
+        the token that would make that deadline (decode priority — the
+        whole point of chunking).  Without imminent deadlines the chunk
+        always rides."""
+        if not self._chunking:
+            return False
+        cache = self._decode_cache
+        if not any(cur < req.prompt_len
+                   for _, req, cur in cache.prefilling_items()
+                   if req is not None):
+            return False
+        if self.config.scheduling == 'edf':
+            deadlines = [
+                req.deadline_t for req in cache.active_requests()
+                if not req.prefilling and req.deadline_t is not None
+                and not req.done()]
+            if deadlines:
+                est_scan = (min(self._decode_walls)
+                            if self._decode_walls else 0.0)
+                if time.time() + est_scan + self._chunk_estimate() > \
+                        min(deadlines):
+                    return False
+        return True
+
+    def _chunk_dispatch(self):
+        """Dispatch ONE C-token chunk advancing EVERY prefilling slot
+        (batched, masked — the chunk sibling of _decode_dispatch),
+        chained on the cache's current carry.  Slots whose prompt ends
+        inside this block transition to decoding ON DEVICE (the kernel
+        flips token/alive/budget), so the next decode scan picks them
+        up at a step boundary; their cursors/phases mirror host-side
+        deterministically.  Returns True when a chunk dispatched."""
+        cache = self._decode_cache
+        spec = self.generation
+        c = self.config.prefill_chunk
+        s = cache.slots
+        work = [(idx, req, cur) for idx, req, cur
+                in cache.prefilling_items()
+                if req is not None and cur < req.prompt_len]
+        if not work:
+            return False
+        blk = np.zeros((s, c, 1), np.int64)
+        lens = np.zeros((s, ), np.int32)
+        active = np.zeros((s, ), bool)
+        fin = np.zeros((s, ), bool)
+        budget = np.zeros((s, ), np.int32)
+        for idx, req, cur in work:
+            n = min(c, req.prompt_len - cur)
+            blk[idx, :n, 0] = req.prompt_tokens[cur:cur + n]
+            lens[idx] = n
+            active[idx] = True
+            if cur + n >= req.prompt_len:
+                fin[idx] = True
+                budget[idx] = req.max_len
+        feed = {spec.chunk_token: blk,
+                spec.chunk_token + SEQLEN_SUFFIX: lens}
+        if spec.chunk_len is not None:
+            feed[spec.chunk_len] = lens.astype(np.float32)[:, None]
+        aux = {'active': active, 'finish': fin, 'budget': budget}
+        snap = cache.snapshot()
+        _trace.flight_recorder.record(
+            'chunk_lot', engine=self.name, width=int(c),
+            prefilling=len(work), finishing=int(fin.sum()),
+            chain_depth=len(self._decode_inflight), slot_map=snap)
+        try:
+            with self._gated():
+                if self._pe_chunk is not None:
+                    carry, ok, _ = self._pe_chunk._dispatch_chunk_prefill(
+                        feed=feed, carry=cache.carry(), aux=aux,
+                        chunk=self._gen_chunk_arg)
+                else:
+                    carry, ok, _ = self._exe._dispatch_chunk_prefill(
+                        spec.chunk_program, feed=feed,
+                        carry=cache.carry(), aux=aux,
+                        chunk=self._gen_chunk_arg, scope=self._scope)
+        except Exception as exc:
+            self._decode_fail(exc, snap)
+            return False
+        cache.set_carry(carry)
+        self._metrics.note_chunk_dispatch(
+            sum(int(lens[idx]) for idx, _, _ in work))
+        self._prefill_since_harvest = True
+        t_disp = time.time()
+        for idx, req, cur in work:
+            cache.advance_prefill(idx, int(lens[idx]))
+            if fin[idx]:
+                cache.finish_prefill(idx)
+                if req.trace is not None:
+                    # decode begins at this dispatch: the 'prefill'
+                    # trace stage (collect -> admit) ends here
+                    req.trace.mark('admit', t_disp)
+        self._decode_inflight.append(
+            ('chunk', ok, None, int(c), t_disp, None, snap))
         return True
 
     def _decode_harvest_one(self):
-        """Harvest the OLDEST in-flight decode scan (ISSUE 9 — the
-        host half the per-scan-sync lane paid BETWEEN scans now runs
-        while the next scan computes): sync its token block, replay
-        the scan's stop-condition masking host-side (EOS emitted /
-        budget exhausted — the exact in-scan rule, so the host mirror
-        never drifts from the device carry), deliver every request the
-        scan finished, and release their slots.  Returns True unless
-        the chain was poisoned (a deferred device error surfaced)."""
-        toks_dev, alive_dev, k, t_disp, reqs, snap = \
+        """Harvest the OLDEST in-flight decode-lane dispatch (ISSUE 9 —
+        the host half the per-scan-sync lane paid BETWEEN scans now
+        runs while the next scan computes).  A 'chunk' entry (ISSUE
+        14) syncs only its small completion marker: the chunk wall
+        feeds the decode-priority budget (and a deferred device error
+        poisons the chain exactly like a scan's).  A 'decode' entry
+        syncs its token block, replays the scan's stop-condition
+        masking host-side (EOS emitted / budget exhausted — the exact
+        in-scan rule, so the host mirror never drifts from the device
+        carry), delivers every request the scan finished, and releases
+        their slots.  Returns True unless the chain was poisoned."""
+        kind, payload, alive_dev, k, t_disp, reqs, snap = \
             self._decode_inflight.popleft()
         # a harvest with NOTHING in flight behind it is a device-idling
         # HOST SYNC — the quantity the chained lane minimizes (the
@@ -1599,6 +1882,28 @@ class InferenceEngine(object):
         # decode_overlap gate and bench/load_gen reports are built on
         blocking = not self._decode_inflight
         cache = self._decode_cache
+        if kind == 'chunk':
+            try:
+                np.asarray(payload)          # the sync point
+            except Exception as exc:
+                self._decode_fail(exc, snap)
+                return False
+            wall = max(time.time() - t_disp, 0.0)
+            self._chunk_walls.append(wall)
+            self._profile.observe(('chunk', self.config.prefill_chunk),
+                                  wall)
+            # a chunk harvest is a real host sync too: the ISSUE 9
+            # ledger must see a chunk lane degraded to per-dispatch
+            # sync (blocking with nothing behind it), or the gauges
+            # built to catch that would stay flat
+            self._metrics.note_decode_harvest(blocking=blocking)
+            if cache.active_slots() == 0 and not self._decode_inflight:
+                # a chunk entry can be the LAST harvest of a busy
+                # period (everything else shed): same idle reset as
+                # the decode branch below
+                self._reset_stall_gauge()
+            return True
+        toks_dev = payload
         try:
             toks = np.asarray(toks_dev)      # the sync point
             alive_in = np.asarray(alive_dev)
@@ -1608,6 +1913,36 @@ class InferenceEngine(object):
         self._metrics.note_decode_harvest(blocking=blocking)
         t_sync = time.time()
         self._decode_walls.append(max(t_sync - t_disp, 0.0))
+        # inter-token stall gauge (ISSUE 14): the wall gap between
+        # consecutive token-block harvests while PREFILL work (a
+        # monolithic prefill lot or a chunk dispatch) was in flight,
+        # in units of the lane's own min scan wall — "how many step
+        # boundaries did an in-flight decode miss to someone's
+        # prompt".  Counted only when some REQUEST was decoding across
+        # the whole gap (alive at both harvest endpoints — keyed by
+        # request identity, not slot index: a slot released and
+        # re-admitted between harvests carries a DIFFERENT request
+        # whose own prefill is not a stall, it is the prefill).
+        # Chunking bounds the gauge at ~one chunk; the monolithic
+        # lane pays the whole prompt.
+        # the set holds the request OBJECTS (identity hash), not their
+        # id()s: a freed request's recycled id could otherwise alias a
+        # new admission across the gap
+        alive_reqs = frozenset(
+            reqs[int(s)]
+            for s in np.nonzero(alive_in.any(axis=0))[0]
+            if reqs[int(s)] is not None)
+        if self._last_harvest_t is not None and \
+                self._prefill_since_harvest and \
+                (alive_reqs & self._last_harvest_alive):
+            gap = max(t_sync - self._last_harvest_t, 0.0)
+            floor = min(self._decode_walls) if self._decode_walls \
+                else 0.0
+            self._metrics.note_decode_stall(
+                gap / max(floor, 1e-9), gap)
+        self._last_harvest_t = t_sync
+        self._last_harvest_alive = alive_reqs
+        self._prefill_since_harvest = False
         end_id = self.generation.end_id
         finished = 0
         for s, req in enumerate(reqs):
@@ -1632,10 +1967,27 @@ class InferenceEngine(object):
                 finished += 1
         self._metrics.note_decode_dispatch(
             k, int(alive_in.sum()), k * cache.slots, finished)
+        if cache.active_slots() == 0 and not self._decode_inflight:
+            # lane going idle: the NEXT busy period's first harvest
+            # must not measure the idle gap as a prefill stall
+            self._reset_stall_gauge()
         if _profiler.is_profiler_enabled() or _trace.spans_enabled():
             _profiler.record_event(self._spans + 'decode[x%d]' % k,
                                    time.time() - t_sync, start=t_sync)
         return True
+
+    def _reset_stall_gauge(self):
+        """Clear the inter-token stall gauge's episode state (ISSUE
+        14) when the decode lane goes idle — by harvest (either kind),
+        shed, or a poisoned-chain reset.  Without this, the next busy
+        period's first harvest would measure the whole idle gap
+        against a STALE _last_harvest_t (and a recycled slot index
+        could satisfy the alive-across-both-endpoints guard),
+        permanently corrupting the max the chunked_prefill gate and
+        the bench/load_gen reports are built on."""
+        self._last_harvest_t = None
+        self._last_harvest_alive = frozenset()
+        self._prefill_since_harvest = False
 
     def _decode_fail(self, exc, snap):
         """A decode dispatch or harvest failed: the chain behind it is
@@ -1654,6 +2006,7 @@ class InferenceEngine(object):
             if not req.done():
                 req.set_error(exc)
         cache.reset()
+        self._reset_stall_gauge()
 
     def _decode_flush(self):
         """Chain-flush point (ISSUE 9): harvest EVERY in-flight scan so
@@ -1688,11 +2041,17 @@ class InferenceEngine(object):
         if not active:
             return False
         for req in active:
+            if req.prefilling:
+                # a PREFILLING slot (ISSUE 14) is inert in the scan
+                # (alive=False) until its finishing chunk dispatches —
+                # it must not justify a scan of frozen slots
+                continue
             if not self._decode_mirror_alive(req):
                 continue
             budget = min(req.max_len, self.generation.max_len)
             inflight_steps = sum(
-                e[2] for e in self._decode_inflight if req in e[4])
+                e[3] for e in self._decode_inflight
+                if e[0] == 'decode' and req in e[5])
             if budget - len(req.tokens) - inflight_steps > 0:
                 return True
         return False
@@ -1725,7 +2084,8 @@ class InferenceEngine(object):
         already release finished slots as the chain advances, and the
         free slot trips this check on the next cycle."""
         cache = self._decode_cache
-        if self._gen_ready and cache.free_slots():
+        if (self._gen_ready or self._chunk_pending) and \
+                cache.free_slots():
             return True
         return bool(self._decode_doomed())
 
@@ -1759,18 +2119,35 @@ class InferenceEngine(object):
                     req.trace.add_count('decode_steps',
                                         len(req.tokens))
                 self._shed_request(req, where='decode')
+            if cache.active_slots() == 0:
+                # sheds can empty the lane with no harvest to follow:
+                # the chain is flushed here, so idle-reset the stall
+                # gauge before fresh admissions start a new episode
+                self._reset_stall_gauge()
             self._admit_ready()
+            if self._chunking:
+                self._admit_chunk_pending()
+        dispatched = False
         if self._decode_should_dispatch():
-            progressed = self._decode_dispatch() or progressed
-        else:
-            # nothing worth another scan: drain the chain so finished
-            # requests deliver and their slots free
+            dispatched = self._decode_dispatch()
+            progressed = dispatched or progressed
+        # at most ONE prefill chunk rides each cycle, AFTER the decode
+        # dispatch (decode priority — ISSUE 14); it chains on the same
+        # carry, so the max decode stall it can add is one chunk wall
+        if self._chunk_should_dispatch():
+            chunked = self._chunk_dispatch()
+            dispatched = dispatched or chunked
+            progressed = chunked or progressed
+        if not dispatched:
+            # nothing worth another dispatch: drain the chain so
+            # finished requests deliver and their slots free
             while self._decode_inflight:
                 progressed = True
                 if not self._decode_harvest_one():
                     return True
-        # pipeline backpressure: at most decode_pipeline_depth scans
-        # in flight — the oldest harvests while the newest computes
+        # pipeline backpressure: at most decode_pipeline_depth
+        # dispatches in flight — the oldest harvests while the newest
+        # computes
         while len(self._decode_inflight) >= \
                 self.config.decode_pipeline_depth:
             progressed = True
@@ -1795,11 +2172,13 @@ class InferenceEngine(object):
             self._metrics.note_latency(req.latency_s)
 
     def _gen_busy(self):
-        """True while the generation lane has work: prefilled requests
-        awaiting slots, slots actively decoding, or in-flight chained
-        scans awaiting harvest."""
+        """True while the generation lane has work: prefilled (or
+        chunk-pending) requests awaiting slots, slots actively decoding
+        or prefilling, or in-flight chained dispatches awaiting
+        harvest."""
         return self._decode_cache is not None and (
-            bool(self._gen_ready) or bool(self._decode_inflight) or
+            bool(self._gen_ready) or bool(self._chunk_pending) or
+            bool(self._decode_inflight) or
             self._decode_cache.any_active())
 
     def evict_decode_cache(self):
@@ -1812,9 +2191,11 @@ class InferenceEngine(object):
             return 0
         with self.paused():
             moved = self._decode_cache.to_host()
-            self.drop_executables(programs=(
-                self.generation.prefill_program,
-                self.generation.step_program))
+            programs = [self.generation.prefill_program,
+                        self.generation.step_program]
+            if self.generation.chunk_program is not None:
+                programs.append(self.generation.chunk_program)
+            self.drop_executables(programs=programs)
         return moved
 
     # ---- worker -------------------------------------------------------
@@ -1831,6 +2212,24 @@ class InferenceEngine(object):
             for req in requests:
                 req.set_error(exc)
             return None
+
+    def _route_chunked(self, reqs):
+        """Chunked-prefill routing (ISSUE 14): under
+        ``prefill_chunk=C`` a generation lot never forms — the prompt
+        tokens were captured at submit, so the requests queue for a
+        PREFILLING slot and their prompts ride chunk dispatches
+        instead of a prefill-program lot.  (They still travel the
+        batcher for wake-ups, EDF ordering and queue-shed semantics.)
+        Returns the requests that still need a lot; None when all were
+        routed to the chunk lane."""
+        if not self._chunking or not reqs or reqs[0].kind != 'generate':
+            return reqs
+        now = time.time()
+        for req in reqs:
+            if req.trace is not None:
+                req.trace.mark('collect', now)
+            self._chunk_pending.append(req)
+        return None
 
     def _collect_block(self, first_lot):
         """Extend a block with already-flushable same-bucket lots, then
@@ -1881,6 +2280,8 @@ class InferenceEngine(object):
                 # pause unit: paused() holds the cycle lock while
                 # weights move, and the worker parks HERE between cycles
                 with self._cycle_lock:
+                    if reqs:
+                        reqs = self._route_chunked(reqs)
                     if self._carry and not reqs:
                         self._dispatch(
                             self._collect_block(self._carry.popleft()))
@@ -1944,9 +2345,11 @@ class InferenceEngine(object):
                 else:
                     reqs = self._batcher.next_lot(timeout=0, force=True)
                     if reqs:
-                        lot = self._safe_make_lot(reqs)
-                        if lot is not None:
-                            self._dispatch(self._collect_block(lot))
+                        reqs = self._route_chunked(reqs)
+                        if reqs:
+                            lot = self._safe_make_lot(reqs)
+                            if lot is not None:
+                                self._dispatch(self._collect_block(lot))
                         progressed = True
                 while self._inflight:
                     self._drain_one()

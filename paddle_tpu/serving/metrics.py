@@ -120,6 +120,15 @@ class EngineMetrics(object):
         self.decode_host_syncs = 0
         self.decode_harvests = 0
         self.decode_chain_flushes = 0
+        # chunked prefill (ISSUE 14): chunk dispatches + prompt tokens
+        # they consumed, and the decode inter-token stall gauge — the
+        # max wall gap between consecutive token-block harvests while
+        # prefill work was in flight, raw seconds and in units of the
+        # lane's min scan wall ("step boundaries missed to a prompt")
+        self.prefill_chunks = 0
+        self.prefill_chunk_tokens = 0
+        self.max_decode_stall_cycles = 0.0
+        self.max_decode_stall_s = 0.0
 
     def note_request(self, rows):
         with self._lock:
@@ -203,6 +212,22 @@ class EngineMetrics(object):
         with self._lock:
             self.decode_chain_flushes += 1
 
+    def note_chunk_dispatch(self, tokens):
+        """One chunked-prefill dispatch (ISSUE 14) consuming
+        ``tokens`` real prompt tokens across the prefilling slots."""
+        with self._lock:
+            self.prefill_chunks += 1
+            self.prefill_chunk_tokens += int(tokens)
+
+    def note_decode_stall(self, cycles, seconds):
+        """One observed decode inter-token stall under in-flight
+        prefill work (ISSUE 14); the snapshot keeps the max."""
+        with self._lock:
+            self.max_decode_stall_cycles = max(
+                self.max_decode_stall_cycles, float(cycles))
+            self.max_decode_stall_s = max(self.max_decode_stall_s,
+                                          float(seconds))
+
     def note_device(self, flops, seconds):
         """One drained dispatch's cost-analysis FLOPs + wall seconds
         (dispatch issue -> host sync) — accumulates achieved MFU."""
@@ -244,6 +269,14 @@ class EngineMetrics(object):
                 'tokens': self.decode_tokens,
                 'dispatches': self.decode_dispatches,
                 'prefill_lots': self.prefill_lots,
+                'prefill_chunks': self.prefill_chunks,
+                'prefill_chunk_tokens': self.prefill_chunk_tokens,
+                'max_decode_stall_cycles': (
+                    round(self.max_decode_stall_cycles, 3)
+                    if self.max_decode_stall_cycles else 0.0),
+                'max_decode_stall_s': (
+                    round(self.max_decode_stall_s, 6)
+                    if self.max_decode_stall_s else 0.0),
                 'steps_per_dispatch': (
                     round(self.decode_scan_steps /
                           self.decode_dispatches, 3)

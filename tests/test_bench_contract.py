@@ -224,7 +224,11 @@ def test_decode_blocks_wired():
                 'decode_dispatches', 'prefill_lots',
                 # ISSUE 9: the pipelined lane's sync accounting
                 'host_syncs_per_token', 'decode_pipeline_depth',
-                'chain_flushes'):
+                'chain_flushes',
+                # ISSUE 14: the chunked-prefill lane's counters — 0
+                # chunks on these monolithic blocks, with the stall
+                # gauge reporting what the prompt mix imposed
+                'prefill_chunks', 'max_decode_stall_cycles'):
         assert "'%s'" % key in helper, key
     for fn, builder in ((bench.bench_nmt, 'seq2seq.build_step_decode'),
                         (bench.bench_transformer,
@@ -341,6 +345,10 @@ def test_nmt_cpu_smoke_is_device_true():
     assert dec['host_syncs_per_token'] is not None
     assert dec['host_syncs_per_token'] * dec['tokens'] <= \
         dec['decode_dispatches']
+    # ISSUE 14: these blocks run the monolithic lane — zero chunk
+    # dispatches, and the stall gauge field is present (>= 0)
+    assert dec['prefill_chunks'] == 0
+    assert dec['max_decode_stall_cycles'] >= 0.0
 
 
 def test_ctr_config_wired_sharded_sparse():

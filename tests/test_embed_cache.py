@@ -363,11 +363,12 @@ def test_generation_engine_rejects_embed_caches():
 
 
 def test_uncovered_optimizer_typed_reject():
-    """An optimizer with no row-subset kernel (rmsprop here) would
-    fall back to the lazy-dense [V, D] materialization against the
-    [C, D] slab — an opaque jit shape crash.  The cache rejects the
-    combination typed, at construction."""
-    m, scope = _build(fluid.optimizer.RMSProp(learning_rate=0.05))
+    """An optimizer with no row-subset kernel (ftrl here — rmsprop
+    gained its kernel in ISSUE 14) would fall back to the lazy-dense
+    [V, D] materialization against the [C, D] slab — an opaque jit
+    shape crash.  The cache rejects the combination typed, at
+    construction."""
+    m, scope = _build(fluid.optimizer.Ftrl(learning_rate=0.05))
     with pytest.raises(ValueError, match='row-subset'):
         CachedEmbeddingTable.from_scope(scope, m['main'],
                                         'ctr_embedding', CAP,

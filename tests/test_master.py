@@ -124,6 +124,121 @@ def test_new_pass_recycles(tmp_path):
     assert all(seen.count(r) == 3 for r in set(seen))
 
 
+def test_new_pass_expected_cas_semantics(tmp_path):
+    """ISSUE 14 satellite (the PR 12 listed-untested gap): new_pass is
+    compare-and-advance under ``expected=`` — a stale duplicate from a
+    worker that observed the SAME pass end a faster peer already
+    advanced must no-op (neither bumping the cursor nor recycling the
+    next pass's done tasks mid-pass); expected=None keeps the
+    single-owner unconditional semantics."""
+    p = _write_dataset(tmp_path, 'cas.recordio', 4)
+    m = Master(chunk_timeout_secs=60, failure_max=3)
+    m.set_dataset([p], records_per_task=2)
+    while True:
+        tid, task = m.get_task()
+        if task is None:
+            break
+        m.task_finished(tid)
+    assert m.current_pass() == 0
+    # worker A advances pass 0 -> 1
+    assert m.new_pass(expected=0) is True
+    assert m.current_pass() == 1
+    # pass 1 work begins: one task gets done
+    tid, _ = m.get_task()
+    m.task_finished(tid)
+    # worker B's STALE report of pass 0's end: must not advance, and
+    # must NOT recycle pass 1's freshly-done task back into todo
+    before = m.counts()
+    assert m.new_pass(expected=0) is False
+    assert m.current_pass() == 1
+    assert m.counts() == before
+    # expected=None: unconditional (the pre-shared-master contract)
+    assert m.new_pass() is True
+    assert m.current_pass() == 2
+    m.close()
+
+
+def test_concurrent_workers_share_new_pass(tmp_path):
+    """pass_num > 1 with MULTIPLE concurrent workers sharing one
+    master: every record is served exactly once per pass ACROSS the
+    workers (ack accounting), and the pass cursor advances exactly
+    passes-1 times no matter how many workers observed each pass
+    end."""
+    import collections
+    import threading
+    p = _write_dataset(tmp_path, 'mw.recordio', 12)
+    m = Master(chunk_timeout_secs=60, failure_max=3)
+    m.set_dataset([p], records_per_task=2)
+    passes, n_workers = 3, 3
+    seen, lock = [], threading.Lock()
+    # the EDL shape: the fleet starts TOGETHER (each reader's pass_num
+    # anchors at its attach point — a barrier makes that pass 0)
+    barrier = threading.Barrier(n_workers)
+
+    def worker():
+        barrier.wait()
+        got = list(cloud_reader(m, pass_num=passes,
+                                poll_interval=0.002, base_pass=0)())
+        with lock:
+            seen.extend(got)
+
+    threads = [threading.Thread(target=worker)
+               for _ in range(n_workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive()
+    # ack accounting: 12 records x 3 passes, each exactly 3x in total
+    assert len(seen) == 12 * passes
+    counts = collections.Counter(seen)
+    assert len(counts) == 12
+    assert all(c == passes for c in counts.values()), counts
+    # the pass cursor advanced exactly passes-1 times, not once per
+    # worker observation of a pass end
+    assert m.current_pass() == passes - 1
+    m.close()
+
+
+def test_concurrent_rpc_workers_share_new_pass(tmp_path):
+    """The same multi-worker pass protocol over the RPC door: N
+    MasterClient threads drive cloud_reader against one MasterServer —
+    records exact per pass, cursor advanced once per pass."""
+    import collections
+    import threading
+    from paddle_tpu.distributed import MasterClient, MasterServer
+    p = _write_dataset(tmp_path, 'mwr.recordio', 8)
+    m = Master(chunk_timeout_secs=60, failure_max=3)
+    m.set_dataset([p], records_per_task=2)
+    server = MasterServer(m)
+    try:
+        passes, seen, lock = 2, [], threading.Lock()
+        barrier = threading.Barrier(2)
+
+        def worker():
+            client = MasterClient(server.endpoint)
+            barrier.wait()
+            got = list(cloud_reader(client, pass_num=passes,
+                                    poll_interval=0.002,
+                                    base_pass=0)())
+            with lock:
+                seen.extend(got)
+
+        threads = [threading.Thread(target=worker) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive()
+        assert len(seen) == 8 * passes
+        counts = collections.Counter(seen)
+        assert all(c == passes for c in counts.values()), counts
+        assert m.current_pass() == passes - 1
+    finally:
+        server.close()
+    m.close()
+
+
 def test_corrupt_snapshot_rejected(tmp_path):
     store = os.path.join(str(tmp_path), 'store3')
     os.makedirs(store)

@@ -198,6 +198,48 @@ def test_decode_overlap_cpu_smoke(monkeypatch):
     assert rec['decode_pipeline_depth'] >= 2
 
 
+def test_chunked_prefill_config_registered():
+    """ISSUE 14 structural pin (runs off-TPU): the chunked_prefill
+    paired config exists, pairs a prefill_chunk=C engine against the
+    monolithic lane over one shared scope/executor, asserts token
+    identity, and hard-gates the stall reduction, chunk dispatches and
+    the bounded-executable structural check behind their env knobs."""
+    perf_gate, inspect = _import_perf_gate()
+    assert 'chunked_prefill' in perf_gate.CONFIGS
+    src = inspect.getsource(perf_gate.run_chunked_prefill)
+    for pin in ("'stall_reduction'", "'prefill_chunks'",
+                'PERF_GATE_CP_STALL_RATIO',
+                "'chunked_new_len_compiles'",
+                "'mono_new_rung_compiles'", 'token-identical'):
+        assert pin in src, pin
+    build = inspect.getsource(perf_gate.build_chunked_prefill)
+    assert 'prefill_chunk' in build
+    assert 'submit_generate' in build
+    assert 'chunk=chunk' in build  # the model is built chunk-capable
+    # the paired engines differ ONLY in prefill_chunk: one side is
+    # hard-wired to the monolithic lane (None)
+    assert 'chunk if chunked else None' in build
+
+
+def test_chunked_prefill_cpu_smoke(monkeypatch):
+    """The ISSUE 14 acceptance criterion, functionally on CPU: one
+    seeded mixed long-prompt + decode stream through chunked vs
+    monolithic engines (shared scope) — outputs token-identical, the
+    max decode inter-token stall reduced >= 2x, chunk dispatches
+    fired, and the chunked lane recompiles NOTHING for new prompt
+    lengths while the monolithic lane mints a fresh-rung executable
+    (run_chunked_prefill hard-asserts all four)."""
+    perf_gate, _ = _import_perf_gate()
+    monkeypatch.setattr(perf_gate, 'BLOCKS', 2)
+    rec = perf_gate.run_chunked_prefill()
+    assert rec['outputs_token_identical']
+    assert rec['stall_reduction_s'] >= 2.0
+    assert rec['prefill_chunks'] > 0
+    assert rec['chunked_new_len_compiles'] == 0
+    assert rec['mono_new_rung_compiles'] > 0
+    assert rec['mono_prefill_lots'] > 0
+
+
 def test_slo_profile_shed_check():
     """ISSUE 9's sharpened slo shed contract, deterministically on
     CPU: the per-signature horizon sheds the slow-signature request

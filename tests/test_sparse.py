@@ -262,6 +262,9 @@ _OPTIMIZERS = {
     # parametrizing it here runs the duplicate-id merge parity on CPU
     # AND the 8-dev mesh, plus the scanned-train-step contract
     'adagrad': lambda: fluid.optimizer.Adagrad(learning_rate=0.1),
+    # ISSUE 14 satellite: the rmsprop row-subset kernel (mean-square +
+    # momentum accumulators, the same gather/merge/scatter shape)
+    'rmsprop': lambda: fluid.optimizer.RMSProp(learning_rate=0.1),
 }
 
 

@@ -41,16 +41,19 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _build_generation(seed, max_len=8):
+def _build_generation(seed, max_len=8, chunk=None):
     """One tiny stepwise NMT decode model (prefill + step programs)
     + its GenerationSpec and scope — the synthetic generate-traffic
-    target (the same toy the decode perf gates drive)."""
+    target (the same toy the decode perf gates drive).  ``chunk``
+    (ISSUE 14) builds the chunked-prefill program too, for
+    --gen-chunk traffic."""
     import paddle_tpu.fluid as fluid
     from paddle_tpu import serving
     from paddle_tpu.models import seq2seq
     m = seq2seq.build_step_decode(
         src_dict_dim=50, trg_dict_dim=40, embedding_dim=8,
-        encoder_size=16, decoder_size=16, max_len=max_len)
+        encoder_size=16, decoder_size=16, max_len=max_len,
+        chunk=chunk)
     m['prefill'].random_seed = seed
     place = (fluid.TPUPlace() if fluid.core.is_compiled_with_tpu()
              else fluid.CPUPlace())
@@ -58,6 +61,8 @@ def _build_generation(seed, max_len=8):
     scope = fluid.core.Scope()
     with fluid.scope_guard(scope):
         exe.run(m['prefill_startup'])
+        if chunk is not None:
+            exe.run(m['chunk_startup'])
         exe.run(m['step_startup'])
     return m, serving.GenerationSpec.from_model(m), scope
 
@@ -127,6 +132,18 @@ def main(argv=None):
                         'host-syncs-per-token)')
     p.add_argument('--gen-max-len', type=int, default=8,
                    help='generation budget per generate request')
+    p.add_argument('--gen-prompt-len', type=int, default=None,
+                   help='LONG-prompt generate traffic (ISSUE 14): '
+                        'prompts draw lengths up to this bound '
+                        '(default: the short 3..9 mix) — the regime '
+                        'where monolithic prefill stalls in-flight '
+                        'decodes; pair with --gen-chunk to bound the '
+                        'stall')
+    p.add_argument('--gen-chunk', type=int, default=None,
+                   help='serve generate traffic with CHUNKED prefill '
+                        '(ServingConfig prefill_chunk=C, rung-'
+                        'quantized); the decode report then carries '
+                        'prefill_chunks and the bounded stall gauge')
     p.add_argument('--ctr-frac', type=float, default=0.0,
                    help='fraction of traffic routed to a sparse-'
                         'embedding CTR model as seeded ZIPFIAN '
@@ -206,21 +223,27 @@ def main(argv=None):
         if not (0.0 < args.generate_frac < 1.0):
             raise SystemExit('--generate-frac must be in (0, 1)')
         gm, gspec, gscope = _build_generation(seed=args.seed + 1,
-                                              max_len=args.gen_max_len)
+                                              max_len=args.gen_max_len,
+                                              chunk=args.gen_chunk)
         gcfg = serving.ServingConfig(
             max_batch_size=args.max_batch,
             max_wait_ms=args.max_wait_ms,
             scheduling=args.scheduling,
-            decode_pipeline_depth=args.decode_depth)
+            decode_pipeline_depth=args.decode_depth,
+            prefill_chunk=(gspec.chunk_width
+                           if args.gen_chunk is not None else None))
         reg.load('gen0', program=gm['prefill'],
                  feed_names=gm['prefill_feeds'],
                  fetch_list=gm['prefill_fetches'], scope=gscope,
                  generation=gspec, config=gcfg)
         gen_names.append('gen0')
+        lo = 3
+        hi = (max(args.gen_prompt_len, lo + 1)
+              if args.gen_prompt_len is not None else 9)
 
-        def gen_feed_fn(rng):
+        def gen_feed_fn(rng, _lo=lo, _hi=hi):
             import paddle_tpu.fluid as fluid
-            l = int(rng.randint(3, 10))
+            l = int(rng.randint(_lo, _hi + 1))
             return {'src_word_id': fluid.create_lod_tensor(
                 rng.randint(2, 50, size=(l, 1)).tolist(), [[l]])}
 
@@ -354,6 +377,16 @@ def main(argv=None):
                     'chain_flushes': (d.get('chain_flushes') or 0) -
                     (base.get('chain_flushes') or 0),
                     'decode_pipeline_depth': args.decode_depth,
+                    # chunked prefill (ISSUE 14): chunk dispatches over
+                    # the measured window + the cumulative inter-token
+                    # stall gauge (worker-cycle units; bounded by one
+                    # chunk under --gen-chunk, by the longest prompt
+                    # without it)
+                    'prefill_chunks': (d.get('prefill_chunks') or 0) -
+                    (base.get('prefill_chunks') or 0),
+                    'prefill_chunk': args.gen_chunk,
+                    'max_decode_stall_cycles':
+                        d.get('max_decode_stall_cycles'),
                 }
     reg.stop()
     print(json.dumps(report), flush=True)

@@ -11,7 +11,7 @@ max(fw)/max(bd) cross-window pairing).
 Run on TPU hardware:
     python tools/perf_gate.py [resnet|transformer|nmt|resnet_infer|
         feed_pipeline|multi_model|trailing_dim|trace_overhead|decode|
-        decode_overlap|slo|sparse_grad|embed_cache|all]
+        decode_overlap|chunked_prefill|slo|sparse_grad|embed_cache|all]
 Prints one JSON line per config; tests/test_perf_gate.py drives it and
 skips cleanly off-TPU.  ``resnet_infer`` (ISSUE 2) has no bound side —
 its deliverable is the paired ``multi_vs_dispatch`` block: the measured
@@ -117,6 +117,20 @@ REDUCTION >= PERF_GATE_DECODE_SYNC_RATIO (default 2.0) and the CPU
 tokens/s ratio (chained over synced, best shared block) >=
 PERF_GATE_DECODE_TPS_MIN (default 0.8 — the overlap must never cost
 throughput; on hardware it recovers the harvest round trip).
+``chunked_prefill`` (ISSUE 14) pairs CHUNKED prefill
+(ServingConfig(prefill_chunk=C): a prompt admits into a PREFILLING
+decode slot and its tokens ride C-wide chunk dispatches interleaved
+with decode scans under decode priority) against the monolithic
+prefill-lot lane over the IDENTICAL mixed long-prompt + decode stream
+(one scope/executor).  Outputs are asserted token-identical; the hard
+gates are the max decode inter-token stall REDUCTION (the gauge:
+worker cycles — wall over the lane's min scan wall — between a slot's
+consecutive harvests while prefill work was in flight) >=
+PERF_GATE_CP_STALL_RATIO (default 2.0), chunk dispatches > 0, and the
+STRUCTURAL executable bound: new prompt lengths recompile NOTHING on
+the chunked lane (every length decomposes into the same C-wide
+blocks) while the monolithic lane mints one executable per fresh rung
+— the counterfactual proving the probe bites.
 """
 
 import json
@@ -1063,6 +1077,193 @@ def run_decode_overlap():
     return rec
 
 
+def build_chunked_prefill():
+    """Chunked vs monolithic prefill over the IDENTICAL mixed
+    long-prompt + decode stream (ISSUE 14): two engines serve the SAME
+    chunk-capable stepwise NMT decode model (one scope + executor —
+    weights and executables genuinely shared), differing ONLY in
+    ServingConfig(prefill_chunk=): the monolithic side prefills each
+    prompt as ONE rung-padded lot whose drain freezes every in-flight
+    decode slot for the whole prompt's wall, the chunked side admits
+    the prompt into a PREFILLING slot and rides at most one C-token
+    chunk per worker cycle between decode scans — so the max decode
+    inter-token stall is one chunk, not one prompt.  Each window:
+    decode-active short generations, then a LONG prompt lands
+    mid-stream; deliverables are token identity, the stall-gauge
+    reduction, and the bounded-executable structural check (new prompt
+    lengths recompile NOTHING on the chunked lane)."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import serving
+    from paddle_tpu.fluid import core
+    from paddle_tpu.models import seq2seq
+
+    chunk = int(os.environ.get('PERF_GATE_CP_CHUNK', '64'))
+    # the long prompt must be COMPUTE-dominated (many recurrence steps)
+    # or every gap measures the dispatch overhead both lanes share and
+    # the ratio compresses toward 1
+    long_len = int(os.environ.get('PERF_GATE_CP_LONG', '4096'))
+    # one slot stays free for the long prompt, so its chunks interleave
+    # with the shorts' decode scans from the first cycle
+    n_short = int(os.environ.get('PERF_GATE_CP_SHORT', '3'))
+    slots = int(os.environ.get('PERF_GATE_CP_SLOTS', '4'))
+    k_steps = int(os.environ.get('PERF_GATE_CP_STEPS', '2'))
+    max_len = int(os.environ.get('PERF_GATE_CP_LEN', '24'))
+    dim = int(os.environ.get('PERF_GATE_CP_DIM', '96'))
+    m = seq2seq.build_step_decode(src_dict_dim=100, trg_dict_dim=80,
+                                  embedding_dim=16, encoder_size=dim,
+                                  decoder_size=dim, max_len=max_len,
+                                  chunk=chunk)
+    place = fluid.TPUPlace() if core.is_compiled_with_tpu() \
+        else fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(m['prefill_startup'])
+        exe.run(m['chunk_startup'])
+        exe.run(m['step_startup'])
+    rng = np.random.RandomState(0)
+
+    def prompt(l):
+        return fluid.create_lod_tensor(
+            rng.randint(2, 100, size=(l, 1)).tolist(), [[l]])
+
+    short_lens = [3 + (i * 3) % 7 for i in range(n_short)]
+    shorts = [prompt(l) for l in short_lens]
+    long_prompt = prompt(long_len)
+    spec = serving.GenerationSpec.from_model(m)
+
+    def make_engine(chunked, name):
+        return serving.InferenceEngine(
+            m['prefill'], fetch_list=m['prefill_fetches'], scope=scope,
+            executor=exe, place=place,
+            config=serving.ServingConfig(
+                max_batch_size=n_short + 1, max_wait_ms=1,
+                decode_slots=slots, decode_steps=k_steps,
+                prefill_chunk=chunk if chunked else None),
+            generation=spec, name=name).start()
+
+    def window(eng):
+        """One pass of the mixed stream: short generations get the
+        decode lane busy, then the long prompt lands mid-decode (the
+        stall gauge needs a harvest before AND after the prefill).
+        Returns (all outputs, decode-metrics snapshot)."""
+        d0 = eng.metrics()['decode'] or {'harvests': 0}
+        # staggered budgets: the shorts finish at DIFFERENT step
+        # boundaries, keeping the decode lane live (and its harvests
+        # observing the prefill) for the whole prefill window
+        futs = [eng.submit_generate({'src_word_id': p},
+                                    max_len=max_len - 2 * i)
+                for i, p in enumerate(shorts)]
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            d = eng.metrics()['decode']
+            if d and d['harvests'] > d0['harvests']:
+                break
+            time.sleep(0.0005)
+        futs.append(eng.submit_generate({'src_word_id': long_prompt},
+                                        max_len=8))
+        outs = [list(f.result(600)) for f in futs]
+        return outs, eng.metrics()['decode']
+
+    return (make_engine, window, prompt,
+            (exe, chunk, long_len, short_lens, slots, k_steps))
+
+
+def run_chunked_prefill():
+    """The chunked_prefill record (ISSUE 14 acceptance): one seeded
+    mixed long-prompt + decode stream through chunked vs monolithic
+    engines over ONE shared scope/executor.  HARD asserts: every
+    generated output token-identical across the lanes, the max decode
+    inter-token stall (worker cycles between a slot's consecutive
+    harvests while a prefill is in flight) reduced by at least
+    PERF_GATE_CP_STALL_RATIO (default 2.0), chunk dispatches really
+    happened, and the chunked lane's prefill executables are bounded
+    by the rung ladder — serving NEW prompt lengths after warm
+    recompiles NOTHING (while the monolithic lane mints one executable
+    per fresh rung — the counterfactual proving the probe bites)."""
+    make_engine, window, prompt, \
+        (exe, chunk, long_len, short_lens, slots, k_steps) = \
+        build_chunked_prefill()
+    # warm pass on throwaway engines: compiles (prefill rungs, chunk
+    # block, decode scans) land outside the measured windows, so the
+    # stall gauges never see a compile wall
+    warm_m, warm_c = make_engine(False, 'perf-gate-cp-warm-mono'), \
+        make_engine(True, 'perf-gate-cp-warm-chunk')
+    try:
+        window(warm_m), window(warm_c)
+    finally:
+        warm_m.stop()
+        warm_c.stop()
+    mono = make_engine(False, 'perf-gate-cp-mono')
+    chunked = make_engine(True, 'perf-gate-cp-chunked')
+    try:
+        identical = True
+        for _ in range(BLOCKS):
+            mo, _dm = window(mono)
+            co, _dc = window(chunked)
+            assert co == mo, \
+                'chunked prefill diverged from the monolithic lane: ' \
+                '%r vs %r' % (co[:2], mo[:2])
+            identical = identical and co == mo
+        dm = mono.metrics()['decode']
+        dc = chunked.metrics()['decode']
+        # structural executable bound: NEW lengths (fresh rungs) after
+        # warm — the chunked lane serves them through the same C-wide
+        # chunk executable (delta 0); the monolithic lane compiles the
+        # fresh rung (delta > 0), proving the counter really counts
+        cc0 = chunked.metrics()['executor_compile_count']
+        chunked.submit_generate({'src_word_id': prompt(75)},
+                                max_len=4).result(600)
+        chunked.submit_generate({'src_word_id': prompt(130)},
+                                max_len=4).result(600)
+        chunked_new_len_compiles = \
+            chunked.metrics()['executor_compile_count'] - cc0
+        cm0 = mono.metrics()['executor_compile_count']
+        mono.submit_generate({'src_word_id': prompt(200)},
+                             max_len=4).result(600)
+        mono_new_rung_compiles = \
+            mono.metrics()['executor_compile_count'] - cm0
+    finally:
+        mono.stop()
+        chunked.stop()
+    stall_ratio = dm['max_decode_stall_cycles'] / \
+        max(dc['max_decode_stall_cycles'], 1e-9)
+    rec = {
+        'config': 'chunked_prefill',
+        'outputs_token_identical': identical,
+        'mono_max_stall_cycles': dm['max_decode_stall_cycles'],
+        'chunked_max_stall_cycles': dc['max_decode_stall_cycles'],
+        'mono_max_stall_s': dm['max_decode_stall_s'],
+        'chunked_max_stall_s': dc['max_decode_stall_s'],
+        'stall_reduction': round(stall_ratio, 4),
+        'stall_reduction_s': round(
+            dm['max_decode_stall_s'] /
+            max(dc['max_decode_stall_s'], 1e-9), 4),
+        'prefill_chunks': dc['prefill_chunks'],
+        'prefill_chunk_tokens': dc['prefill_chunk_tokens'],
+        'mono_prefill_lots': dm['prefill_lots'],
+        'chunked_new_len_compiles': chunked_new_len_compiles,
+        'mono_new_rung_compiles': mono_new_rung_compiles,
+        'chunk': chunk, 'long_len': long_len,
+        'short_lens': short_lens, 'decode_slots': slots,
+        'decode_steps': k_steps, 'blocks': BLOCKS,
+    }
+    stall_floor = float(os.environ.get('PERF_GATE_CP_STALL_RATIO',
+                                       '2.0'))
+    assert rec['outputs_token_identical'], rec
+    assert rec['prefill_chunks'] > 0, rec
+    # gate on the WALL ratio: the cycles gauge normalizes each lane by
+    # its OWN min scan wall (right for absolute readings, but the two
+    # engines' floors differ under interleaved load), while the raw
+    # max-stall walls compare in one unit
+    assert rec['stall_reduction_s'] >= stall_floor, rec
+    assert rec['chunked_new_len_compiles'] == 0, rec
+    assert rec['mono_new_rung_compiles'] > 0, rec
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 def build_sparse_grad():
     """Sparse vs dense embedding-gradient training over the IDENTICAL
     seeded skewed (zipfian) id stream (ISSUE 11): two CTR models — one
@@ -1959,6 +2160,7 @@ CONFIGS = {
     'trace_overhead': (build_trace_overhead, 'rows_per_sec'),
     'decode': (build_decode, 'tokens_per_sec'),
     'decode_overlap': (build_decode_overlap, 'tokens_per_sec'),
+    'chunked_prefill': (build_chunked_prefill, 'tokens_per_sec'),
     'slo': (build_slo, 'goodput_req_s'),
     'sparse_grad': (build_sparse_grad, 'rows_per_sec'),
     'embed_cache': (build_embed_cache, 'rows_per_sec'),
@@ -1979,6 +2181,8 @@ def run_config(name):
         return run_decode()
     if name == 'decode_overlap':
         return run_decode_overlap()
+    if name == 'chunked_prefill':
+        return run_chunked_prefill()
     if name == 'slo':
         return run_slo()
     if name == 'sparse_grad':
