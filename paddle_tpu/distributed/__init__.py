@@ -1,8 +1,12 @@
 """Distributed/EDL runtime pieces outside the SPMD compute path
 (reference: go/ — master task queue, pserver; SURVEY §2.2)."""
 
-from .master import Master, TaskQueuePyFallback, cloud_reader  # noqa: F401
+from .master import Master, TaskQueuePyFallback, cloud_reader, \
+    SnapshotReplica  # noqa: F401
 from .master_server import MasterServer, MasterClient  # noqa: F401
+from .transport import ResilientMasterClient, RetryPolicy, \
+    MasterUnavailableError, MasterProtocolError  # noqa: F401
+from .faults import FaultInjector, InjectedFault  # noqa: F401
 from .async_sparse import AsyncSparseEmbedding, \
     AsyncSparseClosedError  # noqa: F401
 from .embed_cache import CachedEmbeddingTable, EmbedCacheCapacityError, \

@@ -74,6 +74,7 @@ class AsyncSparseEmbedding(object):
         self._pushed = 0
         self._error = None
         self._closed = False
+        self._join_timeouts = 0
         # serializes close() against racing pushers: a push that won
         # entry before close() set the flag still lands in the queue
         # close() is about to drain; one that lost raises typed instead
@@ -174,7 +175,8 @@ class AsyncSparseEmbedding(object):
     @property
     def stats(self):
         return {'pushed': self._pushed, 'applied': self._applied,
-                'queued': self._q.qsize()}
+                'queued': self._q.qsize(),
+                'close_join_timeouts': self._join_timeouts}
 
     def table(self):
         """A consistent snapshot of the table (drains first)."""
@@ -194,7 +196,21 @@ class AsyncSparseEmbedding(object):
             self._closed = True
         self.drain()
         self._q.put(None)
-        self._worker.join(timeout=10)
+        self._worker.join(timeout=self.JOIN_TIMEOUT_S)
+        if self._worker.is_alive():
+            # a wedged apply daemon must not masquerade as a clean
+            # close: count it and say so — the table snapshot above
+            # already drained, but the thread is still out there
+            self._join_timeouts += 1
+            import logging
+            logging.getLogger(__name__).warning(
+                'AsyncSparseEmbedding.close(): apply daemon did not '
+                'join within %.1fs — thread left running (stats: %r)',
+                self.JOIN_TIMEOUT_S, self.stats)
+
+    # close()'s bound on waiting for the apply daemon to exit; a
+    # timeout is counted in stats['close_join_timeouts'] and logged
+    JOIN_TIMEOUT_S = 10.0
 
     @property
     def closed(self):

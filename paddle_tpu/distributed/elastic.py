@@ -379,7 +379,18 @@ class ElasticTrainJob(object):
     master: an in-process ``distributed.Master`` or a ``MasterClient``
         dialing the job's ``MasterServer`` — the job only uses the
         shared get_task/task_finished/task_failed/new_pass/heartbeat/
-        snapshot surface.
+        snapshot surface.  Pass ``endpoints=`` instead (master=None)
+        to have the job own a ``ResilientMasterClient`` over that
+        endpoint list (ISSUE 15): master RPCs then retry through
+        transient faults, reconnect across a master restart and fail
+        over in order to promoted standbys — the job rides a master
+        restart mid-pass (reconnect -> the heartbeat re-registers ->
+        epoch bump -> the existing mesh re-form path) instead of
+        crashing on the first broken socket.  A task the failed-over
+        master re-dispatches after THIS job already trained it (its
+        ack died with the primary) is recognized by its record range
+        and acked WITHOUT retraining — zero double-processed records
+        across failover.
     ckpt_dir: the ``AsyncShardedCheckpoint`` directory; a newest
         manifest there is resumed from (params + optimizer
         accumulators + RNG restored; the master cursor rides the
@@ -405,7 +416,20 @@ class ElasticTrainJob(object):
                  mesh_for=None, pass_num=1, poll_interval=0.05,
                  heartbeat_interval=1.0, task_hook=None, name=None,
                  watchdog_stall_s=None, restore_master=False,
-                 fetch_list=None):
+                 fetch_list=None, endpoints=None, retry_policy=None):
+        self._owns_master = False
+        if endpoints is not None:
+            if master is not None:
+                raise ElasticJobError(
+                    'pass master= OR endpoints=, not both')
+            from .transport import ResilientMasterClient
+            master = ResilientMasterClient(endpoints,
+                                           retry=retry_policy)
+            self._owns_master = True
+        elif retry_policy is not None:
+            raise ElasticJobError(
+                'retry_policy= only applies to the endpoints= lane '
+                '(an explicit master= owns its own fault handling)')
         if int(pipeline_depth) > 1 and checkpoint_every:
             # the checkpoint cursor reads the scope at delivery time;
             # a dispatch issued AHEAD of the delivered one would already
@@ -414,6 +438,9 @@ class ElasticTrainJob(object):
                 'a checkpointing ElasticTrainJob needs pipeline_depth=1 '
                 '(the cursor must not run ahead of acked tasks); got '
                 'depth %d' % int(pipeline_depth))
+        if master is None:
+            raise ElasticJobError(
+                'ElasticTrainJob needs master= or endpoints=')
         self.build_fn = build_fn
         self.master = master
         self.ckpt_dir = ckpt_dir
@@ -444,8 +471,17 @@ class ElasticTrainJob(object):
         self._scope = None
         self._main = self._startup = self._loss = None
         self._scanners = {}
-        self._claims = {}
+        self._claims = {}  # ordinal -> (tid, task key)
         self._claims_lock = threading.Lock()
+        # record ranges THIS job has delivered, mapped to the step
+        # whose dispatch delivered them (their updates are in the live
+        # params as of that step): a failed-over master re-dispatching
+        # one — the ack died with the primary — is acked without
+        # retraining.  The step gates that ack on durability when
+        # checkpointing is on: ack-after-durability holds for dedup
+        # acks exactly like trained acks.
+        self._processed = {}
+        self._dedup_pending = []  # staged dedup acks: (step, tid)
         # delivered-but-unacked tasks, each tagged with the step whose
         # manifest must COMMIT before the ack may go out (the
         # ack-after-durability contract; flushed by the store's
@@ -467,7 +503,8 @@ class ElasticTrainJob(object):
         self._hb_stop = None
         self._hb_thread = None
         self._m = {'tasks_done': 0, 'tasks_failed': 0,
-                   'tasks_requeued': 0, 'membership_epoch': 0,
+                   'tasks_requeued': 0, 'tasks_deduped': 0,
+                   'membership_epoch': 0,
                    'resizes': 0, 'dispatches': 0, 'heartbeats': 0,
                    'heartbeat_errors': 0, 'dp_extent': 0}
         self._metrics_key = None
@@ -672,6 +709,11 @@ class ElasticTrainJob(object):
             tid, task = self.master.get_task()
             if tid == -1:
                 self._cur_pass += 1
+                # the dedup set is PER PASS: the next pass's re-
+                # dispatch of every range is legitimate new work — a
+                # stale entry would silently skip training the whole
+                # pass (it also bounds the set's growth)
+                self._processed.clear()
                 if self._cur_pass >= self.pass_num:
                     self._pass_done = True
                     return
@@ -691,9 +733,33 @@ class ElasticTrainJob(object):
                 self._maybe_flush_frontier()
                 time.sleep(self.poll_interval)
                 continue
+            key = (task['path'], int(task['start']),
+                   int(task['count']))
+            done_step = self._processed.get(key)
+            if done_step is not None:
+                # a failed-over (or restarted) master re-dispatched a
+                # range this job already trained — the ack died with
+                # the primary.  The update is in our params: ack it,
+                # never retrain it (double-processing would skew the
+                # final params vs a fault-free run).  Under
+                # checkpointing the ack gates on durability like any
+                # other: immediate only once a manifest covering the
+                # delivering step committed, else staged for the
+                # store's on_commit release.
+                durable = True
+                if self.checkpoint_every and self.ckpt is not None:
+                    last = self.ckpt.metrics()['last_step']
+                    durable = last is not None and last >= done_step
+                if durable:
+                    self.master.task_finished(tid)
+                    self._m['tasks_deduped'] += 1
+                else:
+                    with self._acks_lock:
+                        self._dedup_pending.append((done_step, tid))
+                continue
             ordinal = self._ordinal
             with self._claims_lock:
-                self._claims[ordinal] = tid
+                self._claims[ordinal] = (tid, key)
             if self.task_hook is not None:
                 # crash site for the fault tests: an exception here is
                 # a worker death — the claim above lease-times-out and
@@ -738,9 +804,10 @@ class ElasticTrainJob(object):
         delivered = []
         with self._claims_lock:
             for o in ordinals:
-                tid = self._claims.pop(o, None)
-                if tid is not None:
-                    delivered.append(tid)
+                ent = self._claims.pop(o, None)
+                if ent is not None:
+                    delivered.append(ent[0])
+                    self._processed[ent[1]] = self.step + len(ordinals)
         self.step += len(ordinals)
         self._m['dispatches'] += 1
         self._delivered_dispatches += 1
@@ -767,14 +834,24 @@ class ElasticTrainJob(object):
 
     def _flush_acks_up_to(self, committed_step):
         """The store's on_commit callback: release every staged ack
-        whose covering step is now durable."""
+        whose covering step is now durable — trained acks and staged
+        DEDUP acks (re-dispatched ranges whose delivering step had
+        not committed yet) alike."""
         with self._acks_lock:
             ready = [tid for s, tid in self._pending_acks
                      if s <= committed_step]
             self._pending_acks = [(s, tid) for s, tid in
                                   self._pending_acks
                                   if s > committed_step]
+            dedup_ready = [tid for s, tid in self._dedup_pending
+                           if s <= committed_step]
+            self._dedup_pending = [(s, tid) for s, tid in
+                                   self._dedup_pending
+                                   if s > committed_step]
         self._send_acks(ready)
+        for tid in dedup_ready:
+            self.master.task_finished(tid)
+            self._m['tasks_deduped'] += 1
 
     def _maybe_flush_frontier(self):
         """Ack-after-durability's liveness guard: when every claim is
@@ -786,7 +863,7 @@ class ElasticTrainJob(object):
         if not self.checkpoint_every or self.ckpt is None:
             return
         with self._acks_lock:
-            if not self._pending_acks:
+            if not self._pending_acks and not self._dedup_pending:
                 return
         with self._claims_lock:
             if self._claims:
@@ -807,7 +884,11 @@ class ElasticTrainJob(object):
         already hold.  Staged acks are read BEFORE the snapshot, so an
         ack flushing in between is completed twice — a no-op."""
         with self._acks_lock:
-            staged = [tid for _s, tid in self._pending_acks]
+            # staged DEDUP acks are in the params too (their update
+            # landed at their original delivery): the cursor rewrite
+            # completes both kinds
+            staged = [tid for _s, tid in self._pending_acks] + \
+                [tid for _s, tid in self._dedup_pending]
         try:
             if hasattr(self.master, 'snapshot'):
                 blob = self.master.snapshot()
@@ -876,7 +957,7 @@ class ElasticTrainJob(object):
         with self._claims_lock:
             pending = list(self._claims.items())
             self._claims.clear()
-        for _ordinal, tid in pending:
+        for _ordinal, (tid, _key) in pending:
             try:
                 self.master.task_failed(tid)
                 self._m['tasks_requeued'] += 1
@@ -913,6 +994,21 @@ class ElasticTrainJob(object):
             self._watchdog_age_fn = age
             weakref.finalize(self, _trace.watchdog.unregister,
                              self._watchdog_probe, age)
+            if hasattr(self.master, 'unreachable_age'):
+                # master-unreachable probe (ISSUE 15): the resilient
+                # client tracks how long the control plane has been
+                # continuously failing — a dead master past the stall
+                # threshold dumps the flight recorder once per episode
+                def m_age(ref=ref):
+                    job = ref()
+                    return job.master.unreachable_age() if job \
+                        else None
+                self._master_probe = _trace.watchdog.register(
+                    'elastic/%s/master_unreachable' % self.name,
+                    m_age, float(self.watchdog_stall_s))
+                self._master_age_fn = m_age
+                weakref.finalize(self, _trace.watchdog.unregister,
+                                 self._master_probe, m_age)
 
     def run(self):
         """Drive the job to the end of its pass budget.  Crash
@@ -996,6 +1092,15 @@ class ElasticTrainJob(object):
             m['checkpoint_bytes'] = ck['bytes_written']
             m['checkpoint_stalls'] = ck['stalls']
             m['checkpoint'] = ck
+        if hasattr(self.master, 'metrics'):
+            # the resilient-lane gauges (ISSUE 15): how hard the
+            # control plane is working to stay connected
+            mc = self.master.metrics()
+            m['master_retries'] = mc.get('retries', 0)
+            m['master_reconnects'] = mc.get('reconnects', 0)
+            m['master_failovers'] = mc.get('failovers', 0)
+            m['master_unreachable_s'] = mc.get('unreachable_s')
+            m['master_client'] = mc
         return m
 
     def close(self):
@@ -1003,3 +1108,8 @@ class ElasticTrainJob(object):
         self._stop_heartbeat()
         if self.ckpt is not None:
             self.ckpt.close()
+        if self._owns_master:
+            try:
+                self.master.close()
+            except Exception:
+                pass

@@ -1,0 +1,141 @@
+"""Deterministic fault injection for the control-plane transport
+(ISSUE 15).
+
+The reference stack earns its fault-tolerance claims the hard way —
+etcd re-election, RPC retries — but nothing in EITHER tree can *test*
+those paths on demand: you wait for a flaky network.  ``FaultInjector``
+is a seeded, scriptable seam at the newline-JSON transport boundary,
+checked by ``ResilientMasterClient`` (sites ``client_send`` /
+``client_recv``) and the ``MasterServer`` handler (sites
+``server_recv`` / ``server_send``):
+
+    fi = FaultInjector(seed=0)
+    # drop the SECOND get_task response on the wire (processed
+    # server-side, never delivered): the client must retry with the
+    # same request id and the dedup window must replay the claim
+    fi.script('server_send', 'get_task', 'drop_response', nth=2)
+    # stretch every heartbeat by 0.8s (just under a 1s lease)
+    fi.script('client_send', 'heartbeat', 'delay', nth=1,
+              times=1000, delay_s=0.8)
+    server = MasterServer(master, fault_injector=fi)
+
+Actions (the classic network-fault menu):
+
+    ``drop_request``   the request is swallowed before processing
+                       (client_send / server_recv)
+    ``drop_response``  processed, but the response never goes out
+                       (server_send / client_recv)
+    ``delay``          the call proceeds after ``delay_s`` (any site)
+    ``garbage``        a non-JSON line goes out instead of the
+                       response (server_send)
+    ``close``          the connection is torn down mid-conversation
+                       (client_send / server_recv / server_send)
+
+``script()`` rejects an (site, action) pair its call sites do not
+implement — a scheduled fault either fires or is a typed error, never
+a silently-counted no-op.
+
+Rules match on (site, method, per-(site,method) call ordinal) — an
+``nth``/``times`` window — or probabilistically via ``prob`` drawn
+from the injector's own seeded rng, so a chaos schedule is REPLAYABLE:
+same seed + same call sequence = same faults.  Every applied fault is
+appended to ``log`` and counted in ``applied``.
+"""
+
+import random
+import threading
+
+__all__ = ['FaultInjector', 'InjectedFault']
+
+_SITES = ('client_send', 'client_recv', 'server_recv', 'server_send')
+_ACTIONS = ('drop_request', 'drop_response', 'delay', 'close',
+            'garbage')
+# which actions each injection site actually implements — a rule the
+# call sites would ignore must be a typed error at script() time, or
+# the schedule counts a "fault" that never happened
+_SITE_ACTIONS = {
+    'client_send': ('drop_request', 'delay', 'close'),
+    'client_recv': ('drop_response', 'delay'),
+    'server_recv': ('drop_request', 'delay', 'close'),
+    'server_send': ('drop_response', 'delay', 'close', 'garbage'),
+}
+
+
+class InjectedFault(ConnectionError):
+    """Raised at a client-side injection point to simulate the wire
+    failing (a ConnectionError, so the resilient client's transient
+    path retries it like any real socket death)."""
+
+
+class FaultInjector(object):
+    """Seeded, scriptable transport-fault schedule (see module doc)."""
+
+    def __init__(self, seed=0):
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self._rules = []
+        self._counts = {}  # (site, method) -> calls observed
+        self._lock = threading.Lock()
+        self.log = []      # applied faults, in order
+        self.applied = 0
+
+    def script(self, site, method, action, nth=1, times=1,
+               delay_s=0.0, prob=None):
+        """Schedule ``action`` at ``site`` for ``method`` (or ``'*'``).
+
+        Deterministic window: fires on the ``nth``-th through
+        ``nth+times-1``-th call of (site, method) through this
+        injector (1-based).  ``prob`` switches the rule to seeded
+        coin-flip mode instead (fires with probability ``prob`` on
+        every call in the window — window defaults stay 1/1, so pass
+        a wide ``times`` for an open-ended probabilistic rule)."""
+        if site not in _SITES:
+            raise ValueError('FaultInjector: unknown site %r (one of '
+                             '%s)' % (site, ', '.join(_SITES)))
+        if action not in _ACTIONS:
+            raise ValueError('FaultInjector: unknown action %r (one '
+                             'of %s)' % (action, ', '.join(_ACTIONS)))
+        if action not in _SITE_ACTIONS[site]:
+            raise ValueError(
+                'FaultInjector: action %r is not implemented at site '
+                '%r (supported there: %s)'
+                % (action, site, ', '.join(_SITE_ACTIONS[site])))
+        if int(nth) < 1 or int(times) < 1:
+            raise ValueError('FaultInjector: nth/times are 1-based '
+                             'positive counts')
+        self._rules.append({
+            'site': site, 'method': method, 'action': action,
+            'nth': int(nth), 'times': int(times),
+            'delay_s': float(delay_s),
+            'prob': None if prob is None else float(prob),
+        })
+        return self
+
+    def check(self, site, method):
+        """One transport event: returns the first matching rule (a
+        dict with ``action``/``delay_s``) or None.  The CALLER
+        interprets the action — the injector only decides and
+        records."""
+        with self._lock:
+            key = (site, method)
+            n = self._counts.get(key, 0) + 1
+            self._counts[key] = n
+            for rule in self._rules:
+                if rule['site'] != site:
+                    continue
+                if rule['method'] not in ('*', method):
+                    continue
+                if not (rule['nth'] <= n < rule['nth'] + rule['times']):
+                    continue
+                if rule['prob'] is not None and \
+                        self._rng.random() >= rule['prob']:
+                    continue
+                self.applied += 1
+                self.log.append((site, method, n, rule['action']))
+                return rule
+        return None
+
+    def counts(self):
+        """{(site, method): calls observed} — schedule-writing aid."""
+        with self._lock:
+            return dict(self._counts)

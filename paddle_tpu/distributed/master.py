@@ -21,6 +21,7 @@ import os
 import struct
 import threading
 import time
+from collections import OrderedDict
 
 from ..runtime import native
 
@@ -29,9 +30,11 @@ from ..runtime import native
 # todo/doing/done/discarded counts and per-task failure counts all
 # survive a master restart.  Old raw blobs (either engine's) still
 # restore; bump the version when the envelope grows NEW fields so old
-# masters can refuse blobs they cannot represent.
+# masters can refuse blobs they cannot represent.  v3 (ISSUE 15) added
+# the per-client RPC dedup window, so exactly-once across retries
+# survives failover to a standby restored from a replicated snapshot.
 SNAPSHOT_FMT = 'paddle-tpu-master-snapshot'
-SNAPSHOT_VERSION = 2
+SNAPSHOT_VERSION = 3
 
 _NATIVE_MAGIC = 0x301076736d  # csrc/master.cc kSnapshotMagic
 
@@ -108,6 +111,10 @@ def complete_tasks_in_blob(blob, tids):
         'pass_num': pass_num,
         'counts': [len(todo), 0, len(done), state['discarded']],
         'failures': {str(t): f for t, f, _ in todo + done if f},
+        # the dedup window rides the rewrite untouched: a restored
+        # master must still replay recorded responses for retries in
+        # flight across the restore
+        'dedup': (env.get('dedup') or {}) if env is not None else {},
         'engine': base64.b64encode(engine_json).decode(),
     }).encode()
 
@@ -336,6 +343,15 @@ class Master(object):
         # replication door keys snapshot freshness on this, and keying
         # on _events alone let set_dataset-only state slip past pull()
         self._seq = 0
+        # per-client RPC dedup window (ISSUE 15): client -> OrderedDict
+        # of request id -> recorded response.  A retried mutation whose
+        # first response was lost replays the record instead of
+        # re-executing (exactly-once across retries); rides the
+        # snapshot envelope so it survives failover.  RLock: recording
+        # a forced snapshot (task_failed discard) re-enters through
+        # snapshot()'s own dedup read.
+        self._dedup = OrderedDict()
+        self._dedup_lock = threading.RLock()
         if store_path:
             os.makedirs(store_path, exist_ok=True)
             self._acquire_lock()
@@ -344,14 +360,54 @@ class Master(object):
                 with open(snap, 'rb') as f:
                     self.restore(f.read())
 
+    # bounds for the RPC dedup window: retries always carry the
+    # client's LATEST request id (calls are serialized client-side),
+    # so a short per-client history suffices; the client LRU keeps a
+    # worker churn from growing the envelope without bound
+    DEDUP_WINDOW = 64
+    DEDUP_CLIENTS = 64
+
+    def dedup_execute(self, client, rid, fn):
+        """Run ``fn()`` (one RPC dispatch returning a response dict)
+        exactly once per (client, rid): a repeat — a client retrying
+        after a lost response — REPLAYS the recorded response.  Error
+        responses are recorded too (a refusal must replay as the same
+        refusal).  The window is bounded per client and across
+        clients (LRU)."""
+        with self._dedup_lock:
+            win = self._dedup.get(client)
+            if win is not None and rid in win:
+                self._dedup.move_to_end(client)
+                return win[rid]
+            resp = fn()
+            if win is None:
+                win = self._dedup[client] = OrderedDict()
+                while len(self._dedup) > self.DEDUP_CLIENTS:
+                    self._dedup.popitem(last=False)
+            self._dedup.move_to_end(client)
+            win[rid] = resp
+            while len(win) > self.DEDUP_WINDOW:
+                win.popitem(last=False)
+            # deliberately NO _seq bump for the record itself: any
+            # call that MUTATED queue state already bumped it (so the
+            # replica re-pulls and its window replays too), while a
+            # no-op's record (an idle get_task poll, a task_failed
+            # miss) is safe to lose — re-executing it on a standby
+            # returns the identical response.  Bumping here would
+            # make every idle poll re-mirror the whole snapshot.
+            return resp
+
     def snapshot(self):
         """The versioned snapshot envelope: the engine blob plus the
         pass/cursor fields a job checkpoint introspects (pass_num,
-        todo/doing/done/discarded counts, per-task failure counts).
-        ``restore()`` round-trips it; raw engine blobs (old snapshots)
-        still restore."""
+        todo/doing/done/discarded counts, per-task failure counts)
+        and the RPC dedup window.  ``restore()`` round-trips it; raw
+        engine blobs (old snapshots) still restore."""
         blob = self._q.snapshot()
         cursor = _parse_engine_blob(blob)
+        with self._dedup_lock:
+            dedup = {c: [[r, resp] for r, resp in win.items()]
+                     for c, win in self._dedup.items()}
         env = {
             'fmt': SNAPSHOT_FMT,
             'version': SNAPSHOT_VERSION,
@@ -363,6 +419,7 @@ class Master(object):
                        cursor['discarded']],
             'failures': {str(t): f for t, f in
                          cursor['todo'] + cursor['done'] if f},
+            'dedup': dedup,
             'engine': base64.b64encode(blob).decode(),
         }
         return json.dumps(env).encode()
@@ -379,8 +436,14 @@ class Master(object):
                                           SNAPSHOT_VERSION))
             self._restore_blob(base64.b64decode(env['engine']))
             self.pass_num = int(env.get('pass_num', 0))
+            dedup = env.get('dedup') or {}
         else:
             self._restore_blob(blob)
+            dedup = {}
+        with self._dedup_lock:
+            self._dedup = OrderedDict(
+                (c, OrderedDict((r, resp) for r, resp in win))
+                for c, win in dedup.items())
         self._seq += 1
 
     def _restore_blob(self, blob):

@@ -14,23 +14,117 @@ so the Go version's codec buys nothing here.  One request per line:
     {"method": "new_pass", "expected": p|null} -> {"ok": true, "advanced": bool}
     {"method": "pass_num"}                     -> {"pass_num": p}
 
-The server owns the Master instance; trainers hold a MasterClient.
-Fault tolerance semantics live in the queue itself (timeouts requeue a
-dead trainer's pending task; failure_max caps retries) — the server is
-a thin door onto them.
+Error responses carry the server-side exception TYPE next to the
+message — ``{"error": msg, "etype": "ValueError"}`` — so the client
+can classify transient vs permanent instead of flattening everything
+into RuntimeError (``transport.error_from_response``).
+
+Exactly-once mutations (ISSUE 15): a request may carry ``client`` +
+``rid`` (the resilient client mints one per LOGICAL mutating call and
+reuses it across retries).  The server routes such requests through
+the master's bounded per-client dedup window
+(``Master.dedup_execute``): a retried request whose first response was
+lost REPLAYS the recorded response instead of re-executing — a
+replayed ``task_failed`` does not advance the failure count, a
+replayed ``get_task`` returns the same claim instead of leaking the
+first one until its lease expires.  The window rides the versioned
+snapshot envelope, so dedup survives failover to a promoted standby.
+
+The server owns the Master instance; trainers hold a MasterClient (or
+the retrying ``transport.ResilientMasterClient``).  Fault tolerance
+semantics live in the queue itself (timeouts requeue a dead trainer's
+pending task; failure_max caps retries) — the server is a thin door
+onto them.  ``fault_injector`` wires a ``faults.FaultInjector`` into
+the handler's ``server_recv``/``server_send`` sites for the chaos
+suite.
 """
 
 import json
 import socket
 import socketserver
 import threading
+import time
+
+from .transport import MasterUnavailableError, error_from_response
 
 __all__ = ['MasterServer', 'MasterClient']
 
 
 class _Handler(socketserver.StreamRequestHandler):
+    def setup(self):
+        socketserver.StreamRequestHandler.setup(self)
+        # tracked so MasterServer.close() can force-close live
+        # conversations: a client blocked on readline gets EOF (a
+        # typed error), never a hang on a half-shut-down server
+        self.server.track(self.connection)
+
+    def finish(self):
+        self.server.untrack(self.connection)
+        socketserver.StreamRequestHandler.finish(self)
+
+    def _dispatch(self, master, method, req):
+        """One request -> one response dict (errors included — the
+        recorded-response dedup window must replay refusals too)."""
+        try:
+            if method == 'get_task':
+                tid, task = master.get_task()
+                return {'tid': tid, 'task': task}
+            elif method == 'task_finished':
+                master.task_finished(int(req['tid']))
+                return {'ok': True}
+            elif method == 'task_failed':
+                return {'discarded': master.task_failed(int(req['tid']))}
+            elif method == 'counts':
+                return {'counts': list(master.counts())}
+            elif method == 'new_pass':
+                advanced = master.new_pass(expected=req.get('expected'))
+                return {'ok': True, 'advanced': advanced}
+            elif method == 'pass_num':
+                return {'pass_num': master.current_pass()}
+            elif method in ('register_worker', 'heartbeat',
+                            'deregister_worker'):
+                # membership door (the etcd registration dir): a
+                # worker's TTL lease lives in the master; a crashed
+                # worker just stops calling and its lease expires
+                epoch, workers = getattr(master, method)(
+                    str(req['worker_id']))
+                return {'epoch': epoch, 'workers': workers}
+            elif method == 'members':
+                epoch, workers = master.members()
+                return {'epoch': epoch, 'workers': workers}
+            elif method == 'snapshot':
+                # replication door (go/master etcd_client.go analog):
+                # a standby on ANOTHER filesystem mirrors the queue
+                # state so master-host loss doesn't lose the pass.
+                # Read _seq BEFORE serializing: a mutator landing
+                # between the two would otherwise pair an OLD blob
+                # with a NEWER seq, and the replica would durably
+                # skip re-pulling the state that seq promised (e.g.
+                # a force-snapshotted poison-task discard).  The
+                # stale-seq direction is safe — the next pull sees
+                # seq advance and re-mirrors.
+                import base64
+                seq = getattr(master, '_seq', 0)
+                blob = master.snapshot()  # versioned envelope
+                return {'blob': base64.b64encode(blob).decode(),
+                        'seq': seq}
+            return {'error': 'unknown method %r' % method,
+                    'etype': 'ValueError'}
+        except Exception as e:  # surface to the client, keep serving
+            return {'error': str(e), 'etype': type(e).__name__}
+
     def handle(self):
+        # connection teardown (a dying client, or close() force-
+        # shutting the socket under us) ends the conversation, never
+        # an unhandled-exception traceback in the handler thread
+        try:
+            self._serve_lines()
+        except OSError:
+            return
+
+    def _serve_lines(self):
         master = self.server.master
+        fi = self.server.fault_injector
         for line in self.rfile:
             line = line.strip()
             if not line:
@@ -38,73 +132,91 @@ class _Handler(socketserver.StreamRequestHandler):
             try:
                 req = json.loads(line.decode())
                 method = req.get('method')
-                if method == 'get_task':
-                    tid, task = master.get_task()
-                    resp = {'tid': tid, 'task': task}
-                elif method == 'task_finished':
-                    master.task_finished(int(req['tid']))
-                    resp = {'ok': True}
-                elif method == 'task_failed':
-                    r = master.task_failed(int(req['tid']))
-                    resp = {'discarded': r}
-                elif method == 'counts':
-                    resp = {'counts': list(master.counts())}
-                elif method == 'new_pass':
-                    advanced = master.new_pass(
-                        expected=req.get('expected'))
-                    resp = {'ok': True, 'advanced': advanced}
-                elif method == 'pass_num':
-                    resp = {'pass_num': master.current_pass()}
-                elif method in ('register_worker', 'heartbeat',
-                                'deregister_worker'):
-                    # membership door (the etcd registration dir): a
-                    # worker's TTL lease lives in the master; a crashed
-                    # worker just stops calling and its lease expires
-                    epoch, workers = getattr(master, method)(
-                        str(req['worker_id']))
-                    resp = {'epoch': epoch, 'workers': workers}
-                elif method == 'members':
-                    epoch, workers = master.members()
-                    resp = {'epoch': epoch, 'workers': workers}
-                elif method == 'snapshot':
-                    # replication door (go/master etcd_client.go analog):
-                    # a standby on ANOTHER filesystem mirrors the queue
-                    # state so master-host loss doesn't lose the pass.
-                    # Read _seq BEFORE serializing: a mutator landing
-                    # between the two would otherwise pair an OLD blob
-                    # with a NEWER seq, and the replica would durably
-                    # skip re-pulling the state that seq promised (e.g.
-                    # a force-snapshotted poison-task discard).  The
-                    # stale-seq direction is safe — the next pull sees
-                    # seq advance and re-mirrors.
-                    import base64
-                    seq = getattr(master, '_seq', 0)
-                    blob = master.snapshot()  # versioned envelope
-                    resp = {'blob': base64.b64encode(blob).decode(),
-                            'seq': seq}
-                else:
-                    resp = {'error': 'unknown method %r' % method}
-            except Exception as e:  # surface to the client, keep serving
-                resp = {'error': str(e)}
-            try:
-                self.wfile.write((json.dumps(resp) + '\n').encode())
-                self.wfile.flush()
-            except (BrokenPipeError, ConnectionResetError):
+            except (ValueError, UnicodeDecodeError) as e:
+                # a half-written or corrupted line must not wedge the
+                # handler: answer typed, keep reading
+                self._write({'error': 'malformed request line: %s' % e,
+                             'etype': type(e).__name__})
+                continue
+            if fi is not None:
+                rule = fi.check('server_recv', method)
+                if rule is not None:
+                    act = rule['action']
+                    if act == 'delay':
+                        time.sleep(rule['delay_s'])
+                    elif act in ('drop_request', 'drop_response'):
+                        continue  # the request never "arrived"
+                    elif act == 'close':
+                        return
+            rid, client = req.get('rid'), req.get('client')
+            if rid is not None and hasattr(master, 'dedup_execute'):
+                resp = master.dedup_execute(
+                    str(client), str(rid),
+                    lambda: self._dispatch(master, method, req))
+            else:
+                resp = self._dispatch(master, method, req)
+            if fi is not None:
+                rule = fi.check('server_send', method)
+                if rule is not None:
+                    act = rule['action']
+                    if act == 'delay':
+                        time.sleep(rule['delay_s'])
+                    elif act == 'drop_response':
+                        continue  # processed, response lost on the wire
+                    elif act == 'close':
+                        return
+                    elif act == 'garbage':
+                        try:
+                            self.wfile.write(b'\x00!garbage!\n')
+                            self.wfile.flush()
+                        except (BrokenPipeError, ConnectionResetError,
+                                OSError):
+                            return
+                        continue
+            if not self._write(resp):
                 return
+
+    def _write(self, resp):
+        try:
+            self.wfile.write((json.dumps(resp) + '\n').encode())
+            self.wfile.flush()
+            return True
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            return False
 
 
 class _TCPServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
+    def __init__(self, addr, handler):
+        socketserver.ThreadingTCPServer.__init__(self, addr, handler)
+        self._conns = set()
+        self._conns_lock = threading.Lock()
+
+    def track(self, conn):
+        with self._conns_lock:
+            self._conns.add(conn)
+
+    def untrack(self, conn):
+        with self._conns_lock:
+            self._conns.discard(conn)
+
+    def live_connections(self):
+        with self._conns_lock:
+            return list(self._conns)
+
 
 class MasterServer(object):
     """Serve a Master over TCP from a daemon thread."""
 
-    def __init__(self, master, host='127.0.0.1', port=0):
+    def __init__(self, master, host='127.0.0.1', port=0,
+                 fault_injector=None):
         self.master = master
+        self.fault_injector = fault_injector
         self._srv = _TCPServer((host, port), _Handler)
         self._srv.master = master
+        self._srv.fault_injector = fault_injector
         self.host, self.port = self._srv.server_address
         self._thread = threading.Thread(
             target=self._srv.serve_forever, daemon=True)
@@ -117,11 +229,26 @@ class MasterServer(object):
     def close(self):
         self._srv.shutdown()
         self._srv.server_close()
+        # force-close live conversations: a handler thread blocked in
+        # readline (its client is quiet) or a client blocked waiting
+        # for a response must both observe EOF now — racing callers
+        # get the typed connection error, never a hang on a server
+        # that stopped accepting but kept old sockets open
+        for conn in self._srv.live_connections():
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
 
 class MasterClient(object):
     """Trainer-side connection (reference v2/master/client.py ctypes
-    shim -> go client).  Blocking request/response on one socket."""
+    shim -> go client).  Blocking request/response on one socket; one
+    hiccup is fatal — use ``transport.ResilientMasterClient`` for the
+    retrying/failing-over lane.  Errors are typed: connection-level
+    failures raise ``MasterUnavailableError`` (a ConnectionError),
+    in-band server refusals raise ``MasterProtocolError`` (a
+    RuntimeError) carrying the wire ``etype`` in the message."""
 
     def __init__(self, endpoint, timeout=30.0):
         host, port = endpoint.rsplit(':', 1)
@@ -135,13 +262,22 @@ class MasterClient(object):
 
     def _call(self, **req):
         with self._lock:
-            self._sock.sendall((json.dumps(req) + '\n').encode())
-            line = self._rfile.readline()
+            try:
+                self._sock.sendall((json.dumps(req) + '\n').encode())
+                line = self._rfile.readline()
+            except OSError as e:
+                raise MasterUnavailableError(
+                    'master connection failed: %s' % e) from e
         if not line:
-            raise ConnectionError('master closed the connection')
-        resp = json.loads(line.decode())
+            raise MasterUnavailableError(
+                'master closed the connection')
+        try:
+            resp = json.loads(line.decode())
+        except ValueError as e:
+            raise MasterUnavailableError(
+                'corrupt master response line: %s' % e) from e
         if 'error' in resp:
-            raise RuntimeError('master error: %s' % resp['error'])
+            raise error_from_response(resp)
         return resp
 
     def get_task(self):
@@ -187,7 +323,10 @@ class MasterClient(object):
         return base64.b64decode(r['blob']), r.get('seq', 0)
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        # the buffered reader wraps its own dup of the socket fd:
+        # closing only the socket leaked it (ISSUE 15 satellite)
+        for closer in (self._rfile, self._sock):
+            try:
+                closer.close()
+            except OSError:
+                pass

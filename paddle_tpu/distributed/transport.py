@@ -1,0 +1,362 @@
+"""Resilient control-plane RPC lane (ISSUE 15).
+
+The reference survives control-plane faults by construction: the
+go/master registers through etcd so a crashed master is re-elected and
+clients transparently re-resolve it (go/master/etcd_client.go), and
+the Fluid send/recv ops retry RPCs against a restarted pserver
+(operators/send_op.cc's grpc retry loop).  The bare ``MasterClient``
+is ONE blocking socket that dies on the first hiccup; this module is
+the lane that makes master RPCs survivable:
+
+* a typed error taxonomy — ``MasterUnavailableError`` (transient: the
+  socket broke, the host is gone, the response never came; a retry or
+  failover may succeed) vs ``MasterProtocolError`` (permanent: the
+  server ANSWERED and said no; a rid-carrying mutation's outcome is
+  recorded in the dedup window, so retrying the identical call could
+  only replay the identical refusal — in-band errors are final).
+  The server carries the exception TYPE name over the wire
+  (``{'error': ..., 'etype': ...}``) for diagnosis, so the client
+  stops flattening everything into one RuntimeError;
+
+* ``RetryPolicy`` — per-call deadline, exponential backoff with
+  SEEDED jitter (deterministic chaos runs), max attempts;
+
+* ``ResilientMasterClient`` — the ``MasterClient`` surface over a
+  LIST of endpoints (primary + promoted standbys, tried in order),
+  owning reconnect-on-broken-socket and failover.  Mutating methods
+  (``get_task``/``task_finished``/``task_failed``/``new_pass``) carry
+  a client-minted request id; the ``MasterServer`` keeps a bounded
+  per-client dedup window replaying the recorded response, so a retry
+  after a LOST RESPONSE is exactly-once: a replayed ``task_failed``
+  does not advance the failure count toward ``failure_max``, and a
+  replayed ``get_task`` returns the SAME claimed task instead of
+  leaking the first claim until its lease expires.  The window rides
+  the versioned snapshot envelope, so dedup survives failover to a
+  standby restored from a replicated snapshot.
+"""
+
+import json
+import random
+import socket
+import threading
+import time
+import uuid
+
+from .faults import InjectedFault
+
+__all__ = ['RetryPolicy', 'ResilientMasterClient',
+           'MasterUnavailableError', 'MasterProtocolError']
+
+
+class MasterUnavailableError(ConnectionError):
+    """Transient: the master could not be reached (connect refused,
+    socket broke mid-call, response never arrived, all endpoints
+    down).  A retry — possibly against a promoted standby — may
+    succeed.  Subclasses ConnectionError so pre-taxonomy callers
+    (``except ConnectionError``) keep working."""
+
+
+class MasterProtocolError(RuntimeError):
+    """Permanent: the master answered and refused (unknown method, a
+    server-side exception, a snapshot-version refusal).  Retrying the
+    identical call cannot help.  Subclasses RuntimeError so
+    pre-taxonomy callers (``except RuntimeError``) keep working."""
+
+
+def error_from_response(resp):
+    """The typed exception for an IN-BAND error response.  The server
+    ANSWERED — the conversation works and (for a rid-carrying
+    mutation) the outcome is recorded in the dedup window, so a retry
+    of the identical call can only replay the identical refusal:
+    every in-band error is FINAL for its logical call
+    (MasterProtocolError).  Only transport-level failures (no answer
+    at all) are transient.  ``etype`` (the server-side exception
+    class name) rides the message for diagnosis."""
+    etype = resp.get('etype')
+    msg = 'master error: %s' % resp.get('error')
+    if etype:
+        msg += ' [server %s]' % etype
+    return MasterProtocolError(msg)
+
+
+class RetryPolicy(object):
+    """Backoff/deadline contract for one logical master call.
+
+    max_attempts: total attempts (first try included).
+    base_backoff_s / max_backoff_s: exponential schedule —
+        ``base * 2**(attempt-1)`` capped at ``max_backoff_s``.
+    deadline_s: wall bound for the WHOLE call across retries and
+        failovers; exhausting it raises MasterUnavailableError.
+    jitter: each backoff is scaled by ``1 + U(0, jitter)`` drawn from
+        a SEEDED rng — deterministic schedules for the chaos suite,
+        decorrelated retries in a fleet (each worker seeds with its
+        own id).
+    """
+
+    def __init__(self, max_attempts=6, base_backoff_s=0.05,
+                 max_backoff_s=2.0, deadline_s=30.0, jitter=0.5,
+                 seed=0):
+        if int(max_attempts) < 1:
+            raise ValueError('RetryPolicy: max_attempts must be >= 1')
+        self.max_attempts = int(max_attempts)
+        self.base_backoff_s = float(base_backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
+        self.deadline_s = float(deadline_s)
+        self.jitter = float(jitter)
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+
+    def backoff(self, attempt):
+        """Sleep before attempt ``attempt+1`` (1-based failed
+        attempt)."""
+        base = min(self.base_backoff_s * (2.0 ** (attempt - 1)),
+                   self.max_backoff_s)
+        return base * (1.0 + self._rng.random() * self.jitter)
+
+
+# methods whose server-side effect is NOT idempotent across a lost
+# response: these carry a request id and ride the dedup window
+_MUTATING = frozenset(['get_task', 'task_finished', 'task_failed',
+                       'new_pass'])
+
+
+class ResilientMasterClient(object):
+    """The ``MasterClient`` surface with reconnect, retry, failover
+    and exactly-once mutations (see module doc).
+
+    endpoints: ``'host:port'`` list tried IN ORDER — the primary
+        first, promoted standbys after; a working endpoint sticks
+        until it breaks.
+    retry: a ``RetryPolicy`` (default constructed when None).
+    timeout: per-attempt socket timeout — a dropped response turns
+        into a retry after this long, so keep it a small multiple of
+        the expected RPC latency, well under ``retry.deadline_s``.
+    fault_injector: optional ``FaultInjector`` checked at the
+        ``client_send``/``client_recv`` sites.
+    client_id: the dedup-window identity; defaults to a fresh uuid —
+        pass a stable id only if YOU guarantee request ids never
+        repeat under it.
+    """
+
+    def __init__(self, endpoints, retry=None, timeout=5.0,
+                 fault_injector=None, client_id=None):
+        if isinstance(endpoints, str):
+            endpoints = [endpoints]
+        self.endpoints = [str(e) for e in endpoints]
+        if not self.endpoints:
+            raise ValueError('ResilientMasterClient: endpoints is '
+                             'empty')
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.timeout = float(timeout)
+        self.fault_injector = fault_injector
+        self._client_id = client_id or uuid.uuid4().hex[:16]
+        self._rid = 0
+        self._sock = None
+        self._rfile = None
+        self._ep_idx = 0
+        self._ever_connected = False
+        self._closed = False
+        # one socket, strict request/response framing: concurrent
+        # callers (heartbeat + staging threads) serialize here — the
+        # same contract as the bare MasterClient
+        self._lock = threading.RLock()
+        self._unreachable_since = None
+        self._m = {'calls': 0, 'retries': 0, 'reconnects': 0,
+                   'failovers': 0, 'injected_faults': 0}
+
+    # ---- connection ----------------------------------------------------
+
+    def _drop_conn(self):
+        for closer in (self._rfile, self._sock):
+            if closer is not None:
+                try:
+                    closer.close()
+                except OSError:
+                    pass
+        self._rfile = self._sock = None
+
+    def _ensure_conn(self, deadline):
+        if self._sock is not None:
+            return
+        last = None
+        n = len(self.endpoints)
+        for off in range(n):
+            idx = (self._ep_idx + off) % n
+            host, port = self.endpoints[idx].rsplit(':', 1)
+            budget = max(min(self.timeout,
+                             deadline - time.monotonic()), 0.05)
+            try:
+                sock = socket.create_connection((host, int(port)),
+                                                timeout=budget)
+            except OSError as e:
+                last = e
+                continue
+            sock.settimeout(self.timeout)
+            self._sock = sock
+            self._rfile = sock.makefile('rb')
+            if self._ever_connected:
+                self._m['reconnects'] += 1
+            self._ever_connected = True
+            if idx != self._ep_idx:
+                # the lane moved to a standby (or back): failover
+                self._m['failovers'] += 1
+                self._ep_idx = idx
+            return
+        raise MasterUnavailableError(
+            'no master endpoint reachable (%s): %s'
+            % (', '.join(self.endpoints), last))
+
+    # ---- the call loop -------------------------------------------------
+
+    def _attempt(self, req, deadline):
+        self._ensure_conn(deadline)
+        fi = self.fault_injector
+        method = req['method']
+        if fi is not None:
+            rule = fi.check('client_send', method)
+            if rule is not None:
+                self._m['injected_faults'] += 1
+                act = rule['action']
+                if act == 'delay':
+                    time.sleep(rule['delay_s'])
+                elif act == 'close':
+                    self._drop_conn()
+                    raise InjectedFault('client_send close (%s)'
+                                        % method)
+                elif act == 'drop_request':
+                    raise InjectedFault('client_send drop_request '
+                                        '(%s)' % method)
+        self._sock.sendall((json.dumps(req) + '\n').encode())
+        line = self._rfile.readline()
+        if fi is not None:
+            rule = fi.check('client_recv', method)
+            if rule is not None:
+                self._m['injected_faults'] += 1
+                act = rule['action']
+                if act == 'delay':
+                    time.sleep(rule['delay_s'])
+                else:
+                    raise InjectedFault('client_recv %s (%s)'
+                                        % (act, method))
+        if not line:
+            raise MasterUnavailableError(
+                'master closed the connection')
+        resp = json.loads(line.decode())  # ValueError -> transient
+        if 'error' in resp:
+            raise error_from_response(resp)
+        return resp
+
+    def _call(self, method, **kw):
+        req = dict(kw)
+        req['method'] = method
+        with self._lock:
+            if self._closed:
+                raise MasterUnavailableError(
+                    'ResilientMasterClient is closed')
+            self._m['calls'] += 1
+            if method in _MUTATING:
+                # the exactly-once identity: RETRIES of this logical
+                # call reuse the id, so the server's dedup window
+                # replays the recorded response instead of
+                # re-executing the mutation
+                self._rid += 1
+                req['client'] = self._client_id
+                req['rid'] = str(self._rid)
+            deadline = time.monotonic() + self.retry.deadline_s
+            attempt = 0
+            while True:
+                attempt += 1
+                try:
+                    resp = self._attempt(req, deadline)
+                except MasterProtocolError:
+                    # the transport WORKED; the refusal is permanent
+                    self._unreachable_since = None
+                    raise
+                except (OSError, ValueError) as e:
+                    # OSError covers socket death, timeouts, refused
+                    # connects, InjectedFault and the typed
+                    # MasterUnavailableError; ValueError is a
+                    # corrupted (non-JSON) line
+                    self._drop_conn()
+                    if self._unreachable_since is None:
+                        self._unreachable_since = time.monotonic()
+                    out_of_time = (time.monotonic() >= deadline)
+                    if attempt >= self.retry.max_attempts or \
+                            out_of_time:
+                        raise MasterUnavailableError(
+                            'master call %r failed after %d attempt'
+                            '(s) over %r: %s'
+                            % (method, attempt, self.endpoints,
+                               e)) from e
+                    self._m['retries'] += 1
+                    time.sleep(max(min(self.retry.backoff(attempt),
+                                       deadline - time.monotonic()),
+                                   0.0))
+                else:
+                    self._unreachable_since = None
+                    return resp
+
+    # ---- observability -------------------------------------------------
+
+    def unreachable_age(self):
+        """Seconds the master has been continuously unreachable (None
+        when the last call succeeded) — the watchdog's
+        master-unreachable probe."""
+        since = self._unreachable_since
+        return (time.monotonic() - since) if since is not None \
+            else None
+
+    def metrics(self):
+        m = dict(self._m)
+        m['endpoint'] = self.endpoints[self._ep_idx]
+        m['endpoints'] = list(self.endpoints)
+        m['unreachable_s'] = self.unreachable_age()
+        return m
+
+    # ---- the MasterClient surface --------------------------------------
+
+    def get_task(self):
+        r = self._call('get_task')
+        return r['tid'], r['task']
+
+    def task_finished(self, tid):
+        self._call('task_finished', tid=tid)
+
+    def task_failed(self, tid):
+        return self._call('task_failed', tid=tid)['discarded']
+
+    def counts(self):
+        return tuple(self._call('counts')['counts'])
+
+    def new_pass(self, expected=None):
+        return self._call('new_pass', expected=expected)['advanced']
+
+    def current_pass(self):
+        return self._call('pass_num')['pass_num']
+
+    def register_worker(self, worker_id):
+        r = self._call('register_worker', worker_id=worker_id)
+        return r['epoch'], r['workers']
+
+    def heartbeat(self, worker_id):
+        r = self._call('heartbeat', worker_id=worker_id)
+        return r['epoch'], r['workers']
+
+    def deregister_worker(self, worker_id):
+        r = self._call('deregister_worker', worker_id=worker_id)
+        return r['epoch'], r['workers']
+
+    def members(self):
+        r = self._call('members')
+        return r['epoch'], r['workers']
+
+    def fetch_snapshot(self):
+        """(blob_bytes, seq) of the master's current queue state."""
+        import base64
+        r = self._call('snapshot')
+        return base64.b64decode(r['blob']), r.get('seq', 0)
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            self._drop_conn()

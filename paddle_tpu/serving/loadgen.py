@@ -95,11 +95,20 @@ class OpenLoopLoadGen(object):
         future still unresolved then counts as an error (and the
         timeout is itself report-visible — a hung worker must not
         hang the harness).
+    retry_overloaded: honor the ``OverloadedError.retry_after_s``
+        hint (ISSUE 15 satellite) with ONE bounded re-submit per
+        rejected request, scheduled at rejection time + the hint + a
+        small seeded jitter (decorrelated resubmits) and fired
+        without perturbing the offered arrival times.  The report
+        gains ``overload_retries`` (re-submits fired) and
+        ``retry_success`` (retried requests that completed) — so the
+        harness exercises the documented client contract instead of
+        just recording the hint.
     """
 
     def __init__(self, target, classes, rate, n_requests=None,
                  duration_s=None, seed=0, keep_records=False,
-                 result_timeout_s=120.0):
+                 result_timeout_s=120.0, retry_overloaded=False):
         if not classes:
             raise ValueError('OpenLoopLoadGen: at least one '
                              'TrafficClass is required')
@@ -117,6 +126,7 @@ class OpenLoopLoadGen(object):
         self.seed = int(seed)
         self.keep_records = bool(keep_records)
         self.result_timeout_s = float(result_timeout_s)
+        self.retry_overloaded = bool(retry_overloaded)
 
     # ---- the stream -----------------------------------------------------
 
@@ -132,7 +142,12 @@ class OpenLoopLoadGen(object):
         picks = rng.choice(len(self.classes), size=n,
                            p=weights / weights.sum())
         feeds = [self.classes[k].feed_fn(rng) for k in picks]
-        return arrivals, picks, feeds
+        # seeded retry jitter, drawn LAST so enabling retries leaves
+        # the arrival/pick/payload stream bit-identical to a run
+        # without them
+        jitter = (rng.uniform(0.0, 0.05, size=n)
+                  if self.retry_overloaded else None)
+        return arrivals, picks, feeds, jitter
 
     def _fire(self, cls, feed):
         """One submission; returns the future (or raises)."""
@@ -151,14 +166,42 @@ class OpenLoopLoadGen(object):
         return self.target.submit(feed, priority=cls.priority,
                                   deadline_ms=cls.deadline_ms)
 
+    def _fire_due_retries(self, outcomes, feeds, pending, fired,
+                          until=None):
+        """Fire scheduled overload re-submits whose due time lands
+        before ``until`` (None = drain all, sleeping to each due) —
+        between arrivals, so the offered stream's timing is never
+        perturbed by a retry."""
+        pending.sort()
+        while pending:
+            due, i = pending[0]
+            if until is not None and due >= until:
+                return
+            pending.pop(0)
+            delay = due - time.time()
+            if delay > 0:
+                time.sleep(delay)
+            cls = outcomes[i][0]
+            fired.add(i)
+            try:
+                outcomes[i] = (cls, self._fire(cls, feeds[i]), None)
+            except Exception as exc:  # still overloaded: final answer
+                outcomes[i] = (cls, None, exc)
+
     def run(self):
         """Offer the stream, collect every outcome, report the tail."""
-        arrivals, picks, feeds = self._draw()
+        arrivals, picks, feeds, retry_jitter = self._draw()
         n = self.n_requests
         outcomes = [None] * n  # (cls, future | None, submit_error)
+        retry_pending = []  # (due_t, request index) — one shot each
+        retry_fired = set()
         t0 = time.time()
         for i in range(n):
-            delay = (t0 + arrivals[i]) - time.time()
+            target = t0 + arrivals[i]
+            if retry_pending:
+                self._fire_due_retries(outcomes, feeds, retry_pending,
+                                       retry_fired, until=target)
+            delay = target - time.time()
             if delay > 0:
                 # open loop: sleep TO the arrival; when the submitter
                 # itself falls behind (a stalled inline dispatch), fire
@@ -169,12 +212,23 @@ class OpenLoopLoadGen(object):
                 outcomes[i] = (cls, self._fire(cls, feeds[i]), None)
             except Exception as exc:  # OverloadedError and friends
                 outcomes[i] = (cls, None, exc)
+                if self.retry_overloaded and \
+                        isinstance(exc, OverloadedError):
+                    # the documented client contract: back off for the
+                    # server's hint, then ONE re-submit
+                    retry_pending.append(
+                        (time.time() + max(exc.retry_after_s, 0.0) +
+                         retry_jitter[i], i))
+        if retry_pending:
+            self._fire_due_retries(outcomes, feeds, retry_pending,
+                                   retry_fired)
         offered_window = time.time() - t0
         # collection: block on every future (arrival order — the waits
         # overlap, so the bound is per-future, not cumulative)
         records = []
         lat = []
         completed = good = shed = rejected = late = errors = 0
+        retry_success = 0
         keep = self.keep_records
         for i in range(n):
             cls, fut, submit_err = outcomes[i]
@@ -184,6 +238,8 @@ class OpenLoopLoadGen(object):
             # to throw them away
             rec = ({'i': i, 'class': cls.name, 'status': None,
                     'latency_ms': None} if keep else None)
+            if keep and i in retry_fired:
+                rec['retried'] = True
             err = submit_err
             result = None
             if fut is not None:
@@ -195,6 +251,8 @@ class OpenLoopLoadGen(object):
                     rec['breakdown'] = fut.breakdown()
             if err is None:
                 completed += 1
+                if i in retry_fired:
+                    retry_success += 1
                 latency_ms = fut.latency_s * 1e3
                 lat.append(latency_ms)
                 good_one = (cls.deadline_ms is None or
@@ -236,6 +294,10 @@ class OpenLoopLoadGen(object):
             'late': late,
             'shed': shed,
             'overload_rejected': rejected,
+            # the retry-the-hint contract (ISSUE 15): one bounded
+            # re-submit per overload-rejected request when enabled
+            'overload_retries': len(retry_fired),
+            'retry_success': retry_success,
             'errors': errors,
             'p50_ms': (round(_pct(lat, 0.50), 3) if lat else None),
             'p99_ms': (round(_pct(lat, 0.99), 3) if lat else None),

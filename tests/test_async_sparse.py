@@ -155,3 +155,32 @@ def test_close_is_idempotent():
     svc.close()  # second close must not hang on the dead daemon
     with pytest.raises(AsyncSparseClosedError):
         svc.push_grad([0], np.ones((1, DIM), 'float32'))
+
+
+def test_close_join_timeout_is_counted_not_silent(caplog):
+    """ISSUE 15 satellite: a wedged apply daemon must not let close()
+    return as if clean — the failed join is logged and counted in
+    stats['close_join_timeouts'] (the happy path stays zero)."""
+    import logging
+    import threading
+    svc = AsyncSparseEmbedding(VOCAB, DIM, seed=8)
+    svc.close()
+    assert svc.stats['close_join_timeouts'] == 0
+
+    svc2 = AsyncSparseEmbedding(VOCAB, DIM, seed=9)
+    # replace the (already started) daemon with a thread that ignores
+    # the shutdown sentinel — the wedged-daemon shape
+    hang = threading.Event()
+    wedged = threading.Thread(target=hang.wait, daemon=True)
+    wedged.start()
+    real_worker = svc2._worker
+    svc2._worker = wedged
+    svc2.JOIN_TIMEOUT_S = 0.2
+    with caplog.at_level(logging.WARNING,
+                         'paddle_tpu.distributed.async_sparse'):
+        svc2.close()
+    assert svc2.stats['close_join_timeouts'] == 1
+    assert any('did not join' in r.message for r in caplog.records)
+    hang.set()
+    real_worker.join(timeout=5)  # the real daemon DID exit cleanly
+    assert not real_worker.is_alive()

@@ -495,3 +495,58 @@ def test_trainer_checkpoints_ride_the_manifest_store(tmp_path):
              feed_order=['x', 'y'])
     assert any(f.startswith('MANIFEST-') for f in os.listdir(legacy))
     assert not os.path.isdir(os.path.join(legacy, '7'))
+
+
+# ---------------------------------------------------------------------
+# resilient control plane (ISSUE 15)
+# ---------------------------------------------------------------------
+
+def test_elastic_endpoints_lane_runs_and_exports_gauges(tmp_path):
+    """endpoints= builds (and owns) a ResilientMasterClient: the job
+    runs a normal fault-free pass over the RPC door, exports the
+    retry-lane gauges, and close() releases the owned client."""
+    from paddle_tpu.distributed import MasterServer, RetryPolicy
+    data = str(tmp_path / 'ep.recordio')
+    _write_dataset(data)
+    master = Master(chunk_timeout_secs=60)
+    master.set_dataset([data], records_per_task=RECORDS_PER_TASK)
+    server = MasterServer(master)
+    job = ElasticTrainJob(
+        _build, None, str(tmp_path / 'job'), _batch_fn,
+        worker_id='ep-w', checkpoint_every=2,
+        endpoints=[server.endpoint],
+        retry_policy=RetryPolicy(seed=3))
+    try:
+        job.run()
+        meta = job.metrics()
+        assert meta['tasks_done'] == N_TASKS, meta
+        assert meta['tasks_deduped'] == 0, meta
+        assert meta['master_retries'] == 0, meta
+        assert meta['master_failovers'] == 0, meta
+        assert meta['master_client']['calls'] > N_TASKS, meta
+        assert meta['master_unreachable_s'] is None, meta
+        assert master.counts() == (0, 0, N_TASKS, 0)
+    finally:
+        job.close()
+        server.close()
+        master.close()
+    # close() closed the owned client: further calls are typed
+    from paddle_tpu.distributed import MasterUnavailableError
+    with pytest.raises(MasterUnavailableError):
+        job.master.counts()
+
+
+def test_elastic_endpoints_construction_contract(tmp_path):
+    """master= XOR endpoints=; retry_policy= belongs to the
+    endpoints= lane only."""
+    from paddle_tpu.distributed import ElasticJobError, RetryPolicy
+    m = Master(chunk_timeout_secs=60)
+    with pytest.raises(ElasticJobError, match='not both'):
+        ElasticTrainJob(_build, m, str(tmp_path), _batch_fn,
+                        endpoints=['h:1'])
+    with pytest.raises(ElasticJobError, match='retry_policy'):
+        ElasticTrainJob(_build, m, str(tmp_path), _batch_fn,
+                        retry_policy=RetryPolicy())
+    with pytest.raises(ElasticJobError, match='master= or endpoints='):
+        ElasticTrainJob(_build, None, str(tmp_path), _batch_fn)
+    m.close()

@@ -369,3 +369,51 @@ def test_worker_membership_leases_and_epoch():
     e5, w = m.deregister_worker('a')
     assert e5 > e4 and w == []
     m.close()
+
+
+def test_snapshot_envelope_v3_carries_dedup_window(tmp_path):
+    """ISSUE 15: the envelope's v3 field — the per-client RPC dedup
+    window rides snapshot()/restore() (and the checkpoint-cursor
+    rewrite complete_tasks_in_blob), so exactly-once across retries
+    survives failover; a pre-v3 envelope (no dedup field) restores
+    with an empty window."""
+    import json
+    from paddle_tpu.distributed.master import (SNAPSHOT_VERSION,
+                                               complete_tasks_in_blob)
+    assert SNAPSHOT_VERSION >= 3
+    p = _write_dataset(tmp_path, 'd.recordio', 4)
+    m = Master(chunk_timeout_secs=60, failure_max=3)
+    m.set_dataset([p], records_per_task=2)
+    tid, _ = m.get_task()
+    rec = m.dedup_execute(
+        'w0', '5', lambda: {'discarded': m.task_failed(tid)})
+    env = json.loads(m.snapshot())
+    assert env['version'] == SNAPSHOT_VERSION
+    assert env['dedup'] == {'w0': [['5', rec]]}, env['dedup']
+
+    m2 = Master(chunk_timeout_secs=60, failure_max=3)
+    m2.restore(m.snapshot())
+    executed = []
+    assert m2.dedup_execute(
+        'w0', '5', lambda: executed.append(1) or {}) == rec
+    assert not executed  # replayed, never re-executed
+
+    # the cursor rewrite preserves the window
+    rewritten = complete_tasks_in_blob(m.snapshot(), [tid])
+    env2 = json.loads(rewritten)
+    assert env2['dedup'] == env['dedup']
+    m3 = Master(chunk_timeout_secs=60, failure_max=3)
+    m3.restore(rewritten)
+    assert m3.dedup_execute(
+        'w0', '5', lambda: executed.append(1) or {}) == rec
+    assert not executed
+
+    # a pre-v3 envelope restores clean (empty window)
+    old = json.loads(m.snapshot())
+    old['version'] = 2
+    del old['dedup']
+    m4 = Master(chunk_timeout_secs=60, failure_max=3)
+    m4.restore(json.dumps(old).encode())
+    assert m4._dedup == {}
+    for mm in (m, m2, m3, m4):
+        mm.close()

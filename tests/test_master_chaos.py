@@ -1,0 +1,391 @@
+"""Control-plane chaos suite (ISSUE 15): an elastic job under a
+seeded fault schedule — master SIGKILL + standby promotion mid-pass,
+dropped acks, delayed heartbeats — finishes with zero lost and zero
+double-processed task records and bitwise-identical final params
+(SGD) vs the fault-free run; retried mutations provably dedup, and
+the dedup window survives failover through the replicated snapshot
+envelope."""
+
+import json
+import os
+import pickle
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed import (ElasticTrainJob, FaultInjector,
+                                    Master, MasterClient, MasterServer,
+                                    ResilientMasterClient, RetryPolicy)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _perf_gate():
+    sys.path.insert(0, os.path.join(REPO, 'tools'))
+    try:
+        import perf_gate
+    finally:
+        sys.path.pop(0)
+    return perf_gate
+
+
+def _seed_tasks(master, n):
+    for i in range(n):
+        master._q.add_task(json.dumps(
+            {'path': 'mem', 'start': i * 4, 'count': 4}).encode())
+    master._seq += 1
+
+
+# ---------------------------------------------------------------------
+# the headline chaos run (the ISSUE 15 acceptance criterion)
+# ---------------------------------------------------------------------
+
+def test_elastic_job_survives_master_kill_and_chaos_bitwise(tmp_path):
+    """The canonical seeded chaos contract, shared with the perf gate
+    (tools/perf_gate.py check_master_chaos): ElasticTrainJob through a
+    ResilientMasterClient over [primary, standby]; the fault schedule
+    drops a task_finished response and a get_task response on the
+    primary and delays heartbeats to just under the lease; the
+    primary dies mid-pass holding a claim (no final flush) and the
+    standby promotes from a replicated snapshot.  Zero lost, zero
+    double-processed, bitwise params vs fault-free, >= 1 failover,
+    >= 1 dedup-acked re-dispatch, no membership flap."""
+    rec = _perf_gate().check_master_chaos(str(tmp_path))
+    assert rec['chaos_bitwise_params']
+    assert rec['chaos_lost'] == 0
+    assert rec['chaos_double_processed'] == 0
+    assert rec['chaos_deduped_acks'] >= 1
+    assert rec['chaos_failovers'] >= 1
+    assert rec['chaos_retries'] >= 1
+
+
+# ---------------------------------------------------------------------
+# dedup mechanics (the "provably dedup" pins)
+# ---------------------------------------------------------------------
+
+def test_replayed_task_failed_does_not_advance_failure_count():
+    """The adversarial interleave: task_failed processed, response
+    lost, the task RE-CLAIMED, then the retry lands — a bare
+    re-execution would fail the new claim and discard the task at
+    failure_max=2; the dedup window replays the recorded response
+    instead, and only a genuinely new request id (the counterfactual)
+    executes."""
+    m = Master(chunk_timeout_secs=60, failure_max=2)
+    _seed_tasks(m, 1)
+    tid, _ = m.get_task()
+
+    def fail():
+        return {'discarded': m.task_failed(tid)}
+
+    assert m.dedup_execute('w0', '1', fail) == {'discarded': 0}
+    tid2, _ = m.get_task()  # re-claimed between loss and retry
+    assert tid2 == tid
+    # the RETRY (same client+rid): replays, does NOT touch the claim
+    assert m.dedup_execute('w0', '1', fail) == {'discarded': 0}
+    assert m.counts() == (0, 1, 0, 0), m.counts()
+    # counterfactual: a fresh rid executes for real -> second failure
+    # -> discarded at failure_max=2
+    assert m.dedup_execute('w0', '2', fail) == {'discarded': 1}
+    assert m.counts()[3] == 1, m.counts()
+    m.close()
+
+
+def test_replayed_get_task_returns_same_claim():
+    """A retried get_task must replay the SAME claim — without dedup
+    the retry claims the NEXT task and the first leaks until its
+    lease expires (reordering training and skewing lease
+    accounting)."""
+    m = Master(chunk_timeout_secs=60)
+    _seed_tasks(m, 3)
+
+    def claim():
+        tid, task = m.get_task()
+        return {'tid': tid, 'task': task}
+
+    r1 = m.dedup_execute('w0', '1', claim)
+    r2 = m.dedup_execute('w0', '1', claim)  # the retry
+    assert r1 == r2
+    assert m.counts() == (2, 1, 0, 0), m.counts()  # ONE claim only
+
+
+def test_dedup_window_bounded_per_client_and_across_clients():
+    m = Master(chunk_timeout_secs=60)
+    for i in range(m.DEDUP_WINDOW + 10):
+        m.dedup_execute('c0', str(i), lambda: {'i': 1})
+    assert len(m._dedup['c0']) == m.DEDUP_WINDOW
+    # the oldest rids aged out; the newest replay
+    assert '0' not in m._dedup['c0']
+    assert str(m.DEDUP_WINDOW + 9) in m._dedup['c0']
+    for k in range(m.DEDUP_CLIENTS + 5):
+        m.dedup_execute('client-%03d' % k, '1', lambda: {})
+    assert len(m._dedup) <= m.DEDUP_CLIENTS
+
+
+def test_dedup_window_survives_snapshot_failover(tmp_path):
+    """The envelope carries the window: a standby restored from the
+    primary's snapshot replays a retry whose first response was
+    recorded BEFORE the primary died — exactly-once across
+    failover."""
+    primary = Master(chunk_timeout_secs=60, failure_max=2)
+    _seed_tasks(primary, 2)
+    tid, _ = primary.get_task()
+    rec = primary.dedup_execute(
+        'w0', '7', lambda: {'discarded': primary.task_failed(tid)})
+    assert rec == {'discarded': 0}
+    blob = primary.snapshot()
+
+    standby = Master(store_path=str(tmp_path / 'b'),
+                     chunk_timeout_secs=60, failure_max=2)
+    standby.restore(blob)
+    # the retry lands on the standby: replayed, not executed — even
+    # though the standby's restored queue has the task back in todo
+    # (a re-execution would return -1 and, after a re-claim, would
+    # double-count the failure)
+    executed = []
+
+    def fail_again():
+        executed.append(True)
+        return {'discarded': standby.task_failed(tid)}
+
+    assert standby.dedup_execute('w0', '7', fail_again) == rec
+    assert not executed, 'retry was re-executed on the standby'
+    standby.close()
+    primary.close()
+
+
+def test_server_routes_rid_requests_through_dedup_window():
+    """Over the wire: two bare clients sharing a (client, rid) pair
+    observe the recorded response — the server's dedup door, driven
+    without the resilient client's retry machinery."""
+    m = Master(chunk_timeout_secs=60)
+    _seed_tasks(m, 2)
+    srv = MasterServer(m)
+    try:
+        a = MasterClient(srv.endpoint)
+        b = MasterClient(srv.endpoint)
+        r1 = a._call(method='get_task', client='shared', rid='1')
+        # the "retry" arrives on a DIFFERENT connection (the real
+        # retry shape: the first socket died with the response)
+        r2 = b._call(method='get_task', client='shared', rid='1')
+        assert r1 == r2
+        assert m.counts()[1] == 1, m.counts()
+        a.close()
+        b.close()
+    finally:
+        srv.close()
+        m.close()
+
+
+# ---------------------------------------------------------------------
+# focused chaos scenarios
+# ---------------------------------------------------------------------
+
+def _mini_dataset(path, n_tasks=4, rpt=4, dim=6):
+    from paddle_tpu.runtime.native import RecordIOWriter
+    rng = np.random.RandomState(0)
+    w = RecordIOWriter(str(path))
+    for _ in range(rpt * n_tasks):
+        x = rng.standard_normal(dim).astype('float32')
+        w.write(pickle.dumps((x, np.array([x.sum() * 0.5],
+                                          'float32'))))
+    w.close()
+    return dim, rpt, n_tasks
+
+
+def _mini_build(dim):
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data('x', shape=[dim])
+            y = fluid.layers.data('y', shape=[1])
+            pred = fluid.layers.fc(x, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=pred, label=y))
+            fluid.optimizer.SGD(0.05).minimize(loss)
+        return main, startup, loss
+    return build
+
+
+def _mini_batch(records):
+    rows = [pickle.loads(r) for r in records]
+    return {'x': np.stack([r[0] for r in rows]).astype('float32'),
+            'y': np.stack([r[1] for r in rows]).astype('float32')}
+
+
+@pytest.mark.parametrize('checkpoint_every', [0, 100])
+def test_elastic_endpoints_lane_rides_master_restart(tmp_path,
+                                                     checkpoint_every):
+    """The reconnect (same endpoint, no standby) path: the master's
+    host restarts mid-pass — server dies with no flush, a NEW master
+    recovers from the store on the SAME port — and the endpoints=
+    job rides it: reconnect, heartbeat re-register, every task
+    trained exactly once (the re-dispatched in-flight claim is
+    dedup-acked), gauges exported.  checkpoint_every=100 exercises
+    the STAGED dedup-ack lane: with acks gated on manifest commits
+    and no periodic commit due, a re-dispatched already-trained range
+    may not be durable yet — its ack stages on the delivering step
+    and the frontier checkpoint's commit releases it
+    (ack-after-durability holds for dedup acks too)."""
+    data = tmp_path / 'restart.recordio'
+    dim, rpt, n_tasks = _mini_dataset(data)
+    store = str(tmp_path / 'store')
+    m1 = Master(store_path=store, chunk_timeout_secs=60,
+                worker_lease_secs=2.0)
+    m1.set_dataset([str(data)], records_per_task=rpt)
+    srv1 = MasterServer(m1)
+    host, port = srv1.host, srv1.port
+    state = {}
+
+    def restart_hook(tid, task, ordinal):
+        if ordinal == 1 and 'm2' not in state:
+            # host restart: force the current queue state down (the
+            # periodic snapshot stands in for it), kill the server
+            # WITHOUT master.close()'s final flush, release the
+            # flock the way a dead process would
+            m1.snapshot_to_store()
+            srv1.close()
+            os.close(m1._lock_fd)
+            m1._lock_fd = None
+            m2 = Master(store_path=store, chunk_timeout_secs=60,
+                        worker_lease_secs=2.0)
+            state['m2'] = m2
+            state['srv2'] = MasterServer(m2, host=host, port=port)
+
+    job = ElasticTrainJob(
+        _mini_build(dim), None, str(tmp_path / 'job'), _mini_batch,
+        worker_id='w0', checkpoint_every=checkpoint_every,
+        heartbeat_interval=0.1,
+        poll_interval=0.02, task_hook=restart_hook,
+        endpoints=['%s:%d' % (host, port)],
+        retry_policy=RetryPolicy(max_attempts=10,
+                                 base_backoff_s=0.05,
+                                 deadline_s=30.0, seed=0))
+    try:
+        job.run()
+        meta = job.metrics()
+        assert meta['tasks_done'] == n_tasks, meta
+        assert job._dedup_pending == [], job._dedup_pending
+        # the claim in flight at the kill was re-dispatched by the
+        # restarted master and dedup-acked, never retrained
+        assert meta['tasks_deduped'] >= 1, meta
+        assert meta['master_reconnects'] >= 1, meta
+        assert meta['master_failovers'] == 0, meta  # same endpoint
+        assert meta['master_client']['calls'] > 0, meta
+        assert state['m2'].counts() == (0, 0, n_tasks, 0)
+        # the restarted master saw the worker re-register via the
+        # heartbeat (membership survived the restart)
+        _epoch, workers = state['m2'].members()
+        assert workers == [] or workers == ['w0']  # post-deregister
+    finally:
+        job.close()
+        state['srv2'].close()
+        state['m2'].close()
+        try:
+            m1.close()
+        except Exception:
+            pass
+
+
+def test_delayed_heartbeats_under_lease_do_not_flap_membership(
+        tmp_path):
+    """Heartbeats stretched to just under the lease TTL are LATE but
+    LIVE: the membership epoch must not churn and no resize fires —
+    the lease math, not luck, keeps the worker in the set."""
+    data = tmp_path / 'hb.recordio'
+    dim, rpt, n_tasks = _mini_dataset(data)
+    m = Master(chunk_timeout_secs=60, worker_lease_secs=1.5)
+    m.set_dataset([str(data)], records_per_task=rpt)
+    fi = FaultInjector(seed=0)
+    fi.script('client_send', 'heartbeat', 'delay', nth=1, times=1000,
+              delay_s=0.4)
+    srv = MasterServer(m)
+    cli = ResilientMasterClient(
+        [srv.endpoint], timeout=2.0, fault_injector=fi,
+        retry=RetryPolicy(max_attempts=6, base_backoff_s=0.02,
+                          deadline_s=20.0, seed=0))
+    job = ElasticTrainJob(
+        _mini_build(dim), cli, str(tmp_path / 'job'), _mini_batch,
+        worker_id='w0', checkpoint_every=0, heartbeat_interval=0.3,
+        poll_interval=0.02)
+    try:
+        job.run()
+        meta = job.metrics()
+        assert meta['tasks_done'] == n_tasks, meta
+        assert meta['heartbeat_errors'] == 0, meta
+        assert meta['resizes'] == 0, meta
+        # epoch bumped exactly once for OUR join (and once for the
+        # deregister at the end) — never for an expiry flap
+        epoch, workers = m.members()
+        assert workers == [], workers
+        assert epoch == 2, epoch
+        assert fi.applied >= 1, fi.counts()
+    finally:
+        job.close()
+        cli.close()
+        srv.close()
+        m.close()
+
+
+def test_master_unreachable_watchdog_probe_registers(tmp_path):
+    """The endpoints= lane with a watchdog threshold registers BOTH
+    probes: checkpoint-stall and master-unreachable; the latter ages
+    only while the master is down."""
+    from paddle_tpu.fluid import trace as _trace
+    data = tmp_path / 'wd.recordio'
+    dim, rpt, n_tasks = _mini_dataset(data, n_tasks=2)
+    m = Master(chunk_timeout_secs=60)
+    m.set_dataset([str(data)], records_per_task=rpt)
+    srv = MasterServer(m)
+    job = ElasticTrainJob(
+        _mini_build(dim), None, str(tmp_path / 'job'), _mini_batch,
+        worker_id='w0', checkpoint_every=0, watchdog_stall_s=30.0,
+        endpoints=[srv.endpoint])
+    try:
+        job.run()
+        assert job._watchdog_probe is not None
+        assert getattr(job, '_master_probe', None) is not None
+        with _trace.watchdog._lock:
+            assert job._master_probe in _trace.watchdog._probes
+        # reachable master -> probe quiescent
+        assert job.master.unreachable_age() is None
+        srv.close()
+        with pytest.raises(ConnectionError):
+            job.master.counts()
+        assert job.master.unreachable_age() is not None
+    finally:
+        job.close()
+        try:
+            srv.close()
+        except Exception:
+            pass
+        m.close()
+
+
+def test_multi_pass_job_retrains_every_pass_no_stale_dedup(tmp_path):
+    """Review-round regression pin: the processed-range dedup set is
+    PER PASS — a pass_num=2 job must train every range twice (the
+    next pass's re-dispatches are legitimate new work, not failover
+    duplicates), with zero dedup acks."""
+    data = tmp_path / 'mp.recordio'
+    dim, rpt, n_tasks = _mini_dataset(data)
+    m = Master(chunk_timeout_secs=60)
+    m.set_dataset([str(data)], records_per_task=rpt)
+    srv = MasterServer(m)
+    job = ElasticTrainJob(
+        _mini_build(dim), None, str(tmp_path / 'job'), _mini_batch,
+        worker_id='w0', checkpoint_every=0, pass_num=2,
+        poll_interval=0.02, endpoints=[srv.endpoint])
+    try:
+        job.run()
+        meta = job.metrics()
+        assert meta['tasks_done'] == 2 * n_tasks, meta
+        assert meta['tasks_deduped'] == 0, meta
+        assert len(job.losses) == 2 * n_tasks, len(job.losses)
+        assert m.current_pass() == 1
+    finally:
+        job.close()
+        srv.close()
+        m.close()

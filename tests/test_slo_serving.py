@@ -745,9 +745,10 @@ def test_loadgen_stream_is_deterministic_and_report_consistent():
                                  n_requests=24, seed=4)
     g2 = serving.OpenLoopLoadGen(eng, classes, rate=500.0,
                                  n_requests=24, seed=4)
-    a1, p1, f1 = g1._draw()
-    a2, p2, f2 = g2._draw()
+    a1, p1, f1, j1 = g1._draw()
+    a2, p2, f2, j2 = g2._draw()
     assert np.array_equal(a1, a2) and np.array_equal(p1, p2)
+    assert j1 is None and j2 is None  # retry jitter only when enabled
     assert all(np.array_equal(x1['x'], x2['x'])
                for x1, x2 in zip(f1, f2))
     rep = g1.run()
@@ -833,3 +834,80 @@ def test_sustained_open_loop_mixed_traffic_harness():
         assert shed_counted + metrics['overload_rejects'] >= \
             rep['shed'] + rep['overload_rejected']
     reg.stop()
+
+
+def test_loadgen_retries_overloaded_once_honoring_hint():
+    """ISSUE 15 satellite: retry_overloaded honors the typed
+    OverloadedError's retry_after_s hint with exactly ONE bounded
+    re-submit per rejected request — retried requests that then land
+    count as completions (retry_success), a request overloaded on its
+    retry too stays rejected, and nothing retries with the flag
+    off."""
+    import time as _time
+    from paddle_tpu.serving import OverloadedError
+
+    class _Fut(object):
+        latency_s = 0.001
+
+        def result(self, timeout=None):
+            return ['ok']
+
+        def breakdown(self):
+            return {}
+
+    class _Target(object):
+        """Rejects every request's FIRST submission (with a 10ms
+        retry-after hint); the retry succeeds — except when
+        always_reject, where every submission is rejected."""
+
+        def __init__(self, always_reject=False):
+            self.attempts = {}
+            self.times = {}
+            self.always_reject = always_reject
+
+        def submit(self, feed, priority=0, deadline_ms=None):
+            k = id(feed)
+            n = self.attempts[k] = self.attempts.get(k, 0) + 1
+            self.times.setdefault(k, []).append(_time.time())
+            if n == 1 or self.always_reject:
+                raise OverloadedError('m', 3, 0.0, retry_after_s=0.01)
+            return _Fut()
+
+    def feed_fn(rng):
+        return {'x': rng.rand(1)}
+
+    n = 12
+    tgt = _Target()
+    rep = serving.OpenLoopLoadGen(
+        tgt, [serving.TrafficClass(feed_fn)], rate=400.0,
+        n_requests=n, seed=3, retry_overloaded=True).run()
+    assert rep['overload_retries'] == n, rep
+    assert rep['retry_success'] == n, rep
+    assert rep['completed'] == n and rep['overload_rejected'] == 0
+    # ONE retry per request, never more
+    assert all(v == 2 for v in tgt.attempts.values()), tgt.attempts
+    # the hint was honored: every retry fired >= retry_after_s after
+    # its rejection (plus the small seeded jitter)
+    for times in tgt.times.values():
+        assert times[1] - times[0] >= 0.01 - 1e-4, times
+
+    # still overloaded on the retry: stays rejected, retry bounded
+    tgt2 = _Target(always_reject=True)
+    rep2 = serving.OpenLoopLoadGen(
+        tgt2, [serving.TrafficClass(feed_fn)], rate=400.0,
+        n_requests=n, seed=3, retry_overloaded=True,
+        keep_records=True).run()
+    assert rep2['overload_retries'] == n and rep2['retry_success'] == 0
+    assert rep2['overload_rejected'] == n, rep2
+    assert all(v == 2 for v in tgt2.attempts.values())
+    assert all(r.get('retried') for r in rep2['records']), \
+        rep2['records'][:2]
+
+    # flag off: the hint is recorded, nothing retries
+    tgt3 = _Target()
+    rep3 = serving.OpenLoopLoadGen(
+        tgt3, [serving.TrafficClass(feed_fn)], rate=400.0,
+        n_requests=n, seed=3).run()
+    assert rep3['overload_retries'] == 0 and rep3['retry_success'] == 0
+    assert rep3['overload_rejected'] == n
+    assert all(v == 1 for v in tgt3.attempts.values())

@@ -1,0 +1,311 @@
+"""Resilient master RPC lane (ISSUE 15): typed error taxonomy,
+seeded retry/backoff, reconnect-on-broken-socket, in-order endpoint
+failover, request-id dedup over the wire, and server-side connection
+hygiene (racing close() is a typed error, a half-written request line
+never wedges a handler)."""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed import (FaultInjector, Master,
+                                    MasterClient, MasterProtocolError,
+                                    MasterServer,
+                                    MasterUnavailableError,
+                                    ResilientMasterClient, RetryPolicy)
+
+
+def _seed_tasks(master, n, start=0):
+    for i in range(start, start + n):
+        master._q.add_task(json.dumps(
+            {'path': 'mem', 'start': i * 4, 'count': 4}).encode())
+    master._seq += 1
+
+
+# ---------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------
+
+def test_retry_policy_backoff_seeded_and_bounded():
+    a = RetryPolicy(base_backoff_s=0.1, max_backoff_s=0.5, jitter=0.5,
+                    seed=7)
+    b = RetryPolicy(base_backoff_s=0.1, max_backoff_s=0.5, jitter=0.5,
+                    seed=7)
+    seq_a = [a.backoff(i) for i in range(1, 8)]
+    seq_b = [b.backoff(i) for i in range(1, 8)]
+    assert seq_a == seq_b  # same seed, same jitter draw
+    # exponential base, capped, jitter within [1, 1.5]x
+    for i, v in enumerate(seq_a, start=1):
+        base = min(0.1 * 2 ** (i - 1), 0.5)
+        assert base <= v <= base * 1.5 + 1e-9
+    assert RetryPolicy(seed=1).backoff(1) != \
+        RetryPolicy(seed=2).backoff(1)
+    with pytest.raises(ValueError, match='max_attempts'):
+        RetryPolicy(max_attempts=0)
+
+
+# ---------------------------------------------------------------------
+# typed taxonomy
+# ---------------------------------------------------------------------
+
+def test_typed_error_taxonomy():
+    """Server-side refusals are permanent (MasterProtocolError, a
+    RuntimeError); transport death is transient
+    (MasterUnavailableError, a ConnectionError) — and the legacy
+    except clauses keep working through the subclassing."""
+    m = Master(chunk_timeout_secs=30)
+    srv = MasterServer(m)
+    try:
+        cli = MasterClient(srv.endpoint)
+        with pytest.raises(MasterProtocolError):
+            cli._call(method='no_such_method')
+        # the wire carries the server-side exception type: a KeyError
+        # in the handler (missing tid field) classifies permanent too
+        with pytest.raises(MasterProtocolError):
+            cli._call(method='task_finished')
+        with pytest.raises(RuntimeError):  # back-compat alias
+            cli._call(method='no_such_method')
+        cli.close()
+    finally:
+        srv.close()
+        m.close()
+    # transient: nothing listening on a fresh port
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    rc = ResilientMasterClient(
+        ['127.0.0.1:%d' % port],
+        retry=RetryPolicy(max_attempts=2, base_backoff_s=0.01,
+                          deadline_s=2.0, seed=0), timeout=0.3)
+    with pytest.raises(MasterUnavailableError):
+        rc.counts()
+    assert rc.unreachable_age() is not None
+    with pytest.raises(ConnectionError):  # back-compat alias
+        rc.counts()
+    rc.close()
+
+
+def test_client_close_releases_reader_and_socket():
+    """ISSUE 15 satellite: close() must close the buffered reader too
+    (it wraps its own dup of the socket fd — closing only the socket
+    leaked it)."""
+    m = Master(chunk_timeout_secs=30)
+    srv = MasterServer(m)
+    try:
+        cli = MasterClient(srv.endpoint)
+        assert cli.counts() == (0, 0, 0, 0)
+        cli.close()
+        assert cli._rfile.closed
+        assert cli._sock.fileno() == -1
+        cli.close()  # idempotent
+    finally:
+        srv.close()
+        m.close()
+
+
+# ---------------------------------------------------------------------
+# reconnect / failover
+# ---------------------------------------------------------------------
+
+def test_reconnect_after_server_drops_connection():
+    """An injected mid-conversation connection close is survived by a
+    reconnect + retry; the mutating call stays exactly-once through
+    the dedup window."""
+    m = Master(chunk_timeout_secs=30)
+    _seed_tasks(m, 2)
+    fi = FaultInjector(seed=0)
+    fi.script('server_recv', 'get_task', 'close', nth=2)
+    srv = MasterServer(m, fault_injector=fi)
+    try:
+        cli = ResilientMasterClient(
+            [srv.endpoint],
+            retry=RetryPolicy(max_attempts=5, base_backoff_s=0.01,
+                              deadline_s=10.0, seed=0), timeout=0.5)
+        t1, _ = cli.get_task()
+        t2, _ = cli.get_task()  # connection torn down, reconnected
+        assert t1 != t2
+        assert cli.metrics()['reconnects'] >= 1
+        assert cli.metrics()['retries'] >= 1
+        assert m.counts()[1] == 2  # exactly two claims, no leak
+        cli.close()
+    finally:
+        srv.close()
+        m.close()
+
+
+def test_failover_tries_endpoints_in_order_and_sticks():
+    """The endpoint list is primary + promoted standbys IN ORDER: the
+    client serves from the first reachable one, fails over when it
+    dies, and keeps serving from the survivor."""
+    m1 = Master(chunk_timeout_secs=30)
+    m2 = Master(chunk_timeout_secs=30)
+    _seed_tasks(m2, 1)
+    srv1 = MasterServer(m1)
+    srv2 = MasterServer(m2)
+    try:
+        cli = ResilientMasterClient(
+            [srv1.endpoint, srv2.endpoint],
+            retry=RetryPolicy(max_attempts=6, base_backoff_s=0.01,
+                              deadline_s=10.0, seed=0), timeout=0.5)
+        assert cli.counts() == (0, 0, 0, 0)  # primary answers
+        assert cli.metrics()['failovers'] == 0
+        srv1.close()
+        m1.close()
+        assert cli.counts() == (1, 0, 0, 0)  # the standby's view
+        assert cli.metrics()['failovers'] == 1
+        assert cli.metrics()['endpoint'] == srv2.endpoint
+        # sticks: further calls add no failovers
+        tid, task = cli.get_task()
+        assert task is not None
+        assert cli.metrics()['failovers'] == 1
+        cli.close()
+    finally:
+        srv2.close()
+        m2.close()
+
+
+def test_dropped_response_retries_are_deduped_over_the_wire():
+    """The wire-level exactly-once contract: a dropped get_task
+    response is retried under the SAME request id and the dedup
+    window replays the SAME claim — no second task leaks into
+    pending; a dropped task_failed response replayed does not advance
+    the failure count toward failure_max."""
+    m = Master(chunk_timeout_secs=30, failure_max=2)
+    _seed_tasks(m, 3)
+    fi = FaultInjector(seed=0)
+    fi.script('server_send', 'get_task', 'drop_response', nth=1)
+    fi.script('server_send', 'task_failed', 'drop_response', nth=1)
+    fi.script('server_send', 'task_finished', 'garbage', nth=1)
+    srv = MasterServer(m, fault_injector=fi)
+    try:
+        cli = ResilientMasterClient(
+            [srv.endpoint],
+            retry=RetryPolicy(max_attempts=6, base_backoff_s=0.01,
+                              deadline_s=15.0, seed=0), timeout=0.4)
+        t1, _ = cli.get_task()  # response dropped once -> replayed
+        assert m.counts()[1] == 1, m.counts()  # ONE claim, no leak
+        assert cli.task_failed(t1) == 0  # dropped once -> replayed
+        # one logical failure only: the task survived (failure_max=2)
+        assert m.counts()[3] == 0, m.counts()
+        t2, _ = cli.get_task()
+        cli.task_finished(t2)  # garbage response -> retried, deduped
+        assert m.counts()[2] == 1, m.counts()
+        assert cli.metrics()['retries'] >= 3
+        assert fi.applied == 3, fi.log
+        cli.close()
+    finally:
+        srv.close()
+        m.close()
+
+
+# ---------------------------------------------------------------------
+# server-side connection hygiene (ISSUE 15 satellite)
+# ---------------------------------------------------------------------
+
+def test_concurrent_callers_racing_server_close_get_typed_error():
+    """N clients hammering counts() while the server closes: every
+    thread ends with the typed transient error (or clean results),
+    none hang — close() force-shuts live conversations so a blocked
+    readline sees EOF instead of waiting forever."""
+    m = Master(chunk_timeout_secs=30)
+    srv = MasterServer(m)
+    clients = [MasterClient(srv.endpoint) for _ in range(4)]
+    outcomes = [None] * len(clients)
+
+    def hammer(k):
+        try:
+            while True:
+                clients[k].counts()
+        except MasterUnavailableError:
+            outcomes[k] = 'typed'
+        except Exception as e:  # pragma: no cover - the failure shape
+            outcomes[k] = repr(e)
+
+    threads = [threading.Thread(target=hammer, args=(k,), daemon=True)
+               for k in range(len(clients))]
+    for t in threads:
+        t.start()
+    time.sleep(0.15)
+    srv.close()
+    for t in threads:
+        t.join(timeout=10)
+    assert not any(t.is_alive() for t in threads), outcomes
+    assert outcomes == ['typed'] * len(clients), outcomes
+    for c in clients:
+        c.close()
+    m.close()
+
+
+def test_half_written_request_line_does_not_wedge_handler():
+    """A client killed mid-send (partial JSON, no newline) must not
+    wedge its handler thread or the server: a parallel well-formed
+    client keeps being served, and when the half-writer's socket
+    closes, the partial line answers typed (or dies with the
+    connection) instead of crashing the handler."""
+    m = Master(chunk_timeout_secs=30)
+    _seed_tasks(m, 1)
+    srv = MasterServer(m)
+    try:
+        half = socket.create_connection((srv.host, srv.port),
+                                        timeout=5)
+        half.sendall(b'{"method": "get_ta')  # no newline, mid-send
+        time.sleep(0.1)
+        # the server is not wedged: a second connection works fine
+        cli = MasterClient(srv.endpoint)
+        assert cli.counts() == (1, 0, 0, 0)
+        # and the half-open conversation's later completion parses:
+        # finish the line as garbage -> typed error response, the
+        # handler keeps serving THIS connection afterwards
+        half.sendall(b'!!\n{"method": "counts"}\n')
+        rf = half.makefile('rb')
+        err = json.loads(rf.readline().decode())
+        assert 'error' in err and 'etype' in err, err
+        ok = json.loads(rf.readline().decode())
+        assert ok['counts'] == [1, 0, 0, 0], ok
+        rf.close()
+        half.close()
+        # a mid-send death (close with no newline) is also clean
+        dead = socket.create_connection((srv.host, srv.port),
+                                        timeout=5)
+        dead.sendall(b'{"method": "coun')
+        dead.close()
+        time.sleep(0.1)
+        assert cli.counts() == (1, 0, 0, 0)  # server alive and sane
+        cli.close()
+    finally:
+        srv.close()
+        m.close()
+
+
+def test_fault_injector_schedule_validation_and_log():
+    fi = FaultInjector(seed=3)
+    with pytest.raises(ValueError, match='site'):
+        fi.script('nowhere', '*', 'delay')
+    with pytest.raises(ValueError, match='action'):
+        fi.script('server_send', '*', 'explode')
+    with pytest.raises(ValueError, match='1-based'):
+        fi.script('server_send', '*', 'delay', nth=0)
+    fi.script('server_send', 'get_task', 'drop_response', nth=2,
+              times=2)
+    assert fi.check('server_send', 'get_task') is None        # 1st
+    assert fi.check('server_send', 'get_task')['action'] == \
+        'drop_response'                                       # 2nd
+    assert fi.check('server_send', 'counts') is None  # other method
+    assert fi.check('server_send', 'get_task') is not None    # 3rd
+    assert fi.check('server_send', 'get_task') is None        # 4th
+    assert fi.applied == 2 and len(fi.log) == 2
+    assert fi.counts()[('server_send', 'get_task')] == 4
+    # seeded probabilistic rules replay identically
+    a, b = FaultInjector(seed=5), FaultInjector(seed=5)
+    for inj in (a, b):
+        inj.script('client_send', '*', 'delay', nth=1, times=1000,
+                   prob=0.3)
+    seq_a = [a.check('client_send', 'x') is not None
+             for _ in range(50)]
+    seq_b = [b.check('client_send', 'x') is not None
+             for _ in range(50)]
+    assert seq_a == seq_b and any(seq_a) and not all(seq_a)

@@ -113,7 +113,8 @@ def generate():
     # the distributed runtime surface (ISSUE 12: the two-tier embedding
     # cache lives here next to its AsyncSparseEmbedding host tier;
     # ISSUE 13: the elastic job + its checkpoint store and the master's
-    # membership/snapshot doors)
+    # membership/snapshot doors; ISSUE 15: the resilient transport
+    # lane + the fault-injection seam + snapshot replication)
     import paddle_tpu.distributed as distributed
     lines += _walk('paddle_tpu.distributed', distributed, [
         'AsyncSparseEmbedding', 'AsyncSparseClosedError',
@@ -122,6 +123,9 @@ def generate():
         'ElasticTrainJob', 'AsyncShardedCheckpoint',
         'CheckpointWriteError', 'ElasticJobError',
         'Master', 'MasterServer', 'MasterClient',
+        'ResilientMasterClient', 'RetryPolicy',
+        'MasterUnavailableError', 'MasterProtocolError',
+        'FaultInjector', 'InjectedFault', 'SnapshotReplica',
     ])
     return sorted(set(lines))
 

@@ -16,6 +16,13 @@ the offered window and HOST-SYNCS-PER-TOKEN (device-idling host round
 trips the chained decode lane avoids; compare --decode-depth 1 vs 2
 to see the pipelining win under open-loop load).
 
+Overload retries (ISSUE 15): ``--retry-overloaded`` honors the typed
+``OverloadedError``'s ``retry_after_s`` hint — ONE seeded re-submit
+per rejected request, fired between arrivals so the offered stream's
+timing is untouched; the report gains ``overload_retries`` and
+``retry_success``, so the harness exercises the documented client
+contract instead of just recording the hint.
+
 Examples:
 
     # overload a single synthetic model 3x past its measured capacity,
@@ -175,6 +182,11 @@ def main(argv=None):
                    help='overload admission watermark: queue depth')
     p.add_argument('--admit-age-ms', type=float, default=None,
                    help='overload admission watermark: oldest queue age')
+    p.add_argument('--retry-overloaded', action='store_true',
+                   help='honor the OverloadedError.retry_after_s hint '
+                        'with ONE seeded re-submit per rejected '
+                        'request (ISSUE 15); the report gains '
+                        'overload_retries/retry_success')
     p.add_argument('--seed', type=int, default=0)
     args = p.parse_args(argv)
 
@@ -316,8 +328,25 @@ def main(argv=None):
             for name in ctr_names
         }
         t0 = time.time()
-        burst = [reg.submit(names[i % len(names)], feed_fn(rng))
-                 for i in range(16)]
+        burst = []
+        deadline = time.time() + 60.0
+        for i in range(16):
+            while True:
+                try:
+                    burst.append(reg.submit(names[i % len(names)],
+                                            feed_fn(rng)))
+                    break
+                except serving.OverloadedError as e:
+                    # a tight --admit-depth can reject the closed
+                    # calibration burst itself: under
+                    # --retry-overloaded honor the hint (the
+                    # documented client contract), bounded by a
+                    # deadline so a wedged registry surfaces the
+                    # typed error instead of hanging the CLI
+                    if not args.retry_overloaded or \
+                            time.time() >= deadline:
+                        raise
+                    time.sleep(max(e.retry_after_s, 1e-3))
         for f in burst:
             f.result(600)
         capacity = 16 / max(time.time() - t0, 1e-9)
@@ -328,7 +357,8 @@ def main(argv=None):
             # default); the loadgen only reads duration_s when
             # n_requests is None
             n_requests=None if args.duration else args.requests,
-            duration_s=args.duration, seed=args.seed)
+            duration_s=args.duration, seed=args.seed,
+            retry_overloaded=args.retry_overloaded)
         report = gen.run()
         report['measured_capacity_req_s'] = round(capacity, 3)
         metrics = reg.metrics()

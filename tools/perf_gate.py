@@ -11,7 +11,8 @@ max(fw)/max(bd) cross-window pairing).
 Run on TPU hardware:
     python tools/perf_gate.py [resnet|transformer|nmt|resnet_infer|
         feed_pipeline|multi_model|trailing_dim|trace_overhead|decode|
-        decode_overlap|chunked_prefill|slo|sparse_grad|embed_cache|all]
+        decode_overlap|chunked_prefill|slo|sparse_grad|embed_cache|
+        elastic|master_chaos|all]
 Prints one JSON line per config; tests/test_perf_gate.py drives it and
 skips cleanly off-TPU.  ``resnet_infer`` (ISSUE 2) has no bound side —
 its deliverable is the paired ``multi_vs_dispatch`` block: the measured
@@ -106,6 +107,27 @@ holding a claim, the claim's lease observed timing out and
 re-dispatching, the replacement resuming from the newest manifest
 with ZERO replayed steps and BITWISE-identical final params to an
 uninterrupted run (SGD).
+``master_chaos`` (ISSUE 15) pairs bare-``MasterClient`` vs
+``ResilientMasterClient`` ELASTIC windows — each window one full
+``ElasticTrainJob`` pass over the same seeded dataset, NO faults
+injected: the hard gate ``retry_layer_overhead_ratio`` (resilient
+wall over bare wall, best shared window) <= PERF_GATE_CHAOS_OVERHEAD
+(default 1.05) bounds what request-id minting + the server dedup
+window + the reconnect machinery cost a training job on the happy
+path; a secondary pure-RPC claim+finish drain pair isolates the
+per-RPC tax (``rpc_drain_overhead_ratio``, tripwire-bounded by
+PERF_GATE_CHAOS_RPC_MAX, default 1.6 — an accidental extra round
+trip per call would read ~2x).  The record
+then folds in the FUNCTIONAL chaos contract: ``check_master_chaos``
+(an ElasticTrainJob under a seeded FaultInjector — dropped
+task_finished/get_task responses, heartbeats delayed to just under
+the lease TTL, the primary master killed mid-pass with a claim
+outstanding and a standby promoted from a replicated snapshot —
+finishing with zero lost / zero double-processed records and
+BITWISE-identical final params vs the fault-free run) and
+``check_dedup_replay`` (a replayed task_failed does NOT advance the
+failure count even when the task was re-claimed in between; a fresh
+request id — the counterfactual — discards at failure_max).
 ``decode_overlap`` (ISSUE 9) pairs the CHAINED decode lane
 (decode_pipeline_depth >= 2: scan N+1 enqueued against scan N's
 device-resident donated output carry, token blocks harvested while
@@ -1710,31 +1732,26 @@ def build_elastic():
     return window('none'), window('async'), window('sync'), ctx
 
 
-def check_kill_resume(tmpdir):
-    """The kill-resume goodput check (ISSUE 13 acceptance), functional
-    and deterministic: an ElasticTrainJob killed holding its LAST
-    claim; the claim's lease observed timing out and re-dispatching; a
-    replacement job resumes from the newest manifest, replays ZERO
-    steps, and final params are BITWISE-identical to an uninterrupted
-    run (SGD).  Returns the record block run_elastic folds in."""
+def _elastic_toy_dataset(path, dim=8, rpt=8, n_tasks=6):
+    """The seeded (x, y) RecordIO dataset every elastic toy job
+    trains on — ONE definition so the kill-resume, chaos and window
+    lanes provably share a stream."""
     import pickle
     import numpy as np
-    import paddle_tpu.fluid as fluid
-    from paddle_tpu.distributed import ElasticTrainJob, Master
-    from paddle_tpu.fluid.dataflow import FeedPipelineError
     from paddle_tpu.runtime.native import RecordIOWriter
-
-    dim, rpt, n_tasks = 8, 8, 6
-    data = os.path.join(tmpdir, 'kill_resume.recordio')
     rng = np.random.RandomState(0)
-    w = RecordIOWriter(data)
+    w = RecordIOWriter(path)
     for _ in range(rpt * n_tasks):
         xv = rng.standard_normal(dim).astype('float32')
         w.write(pickle.dumps((xv, np.array([xv.sum() * 0.5],
                                            'float32'))))
     w.close()
 
+
+def _elastic_toy_build(dim=8):
+    """build_fn for the elastic toy jobs (fc/tanh/fc, SGD)."""
     def build():
+        import paddle_tpu.fluid as fluid
         main, startup = fluid.Program(), fluid.Program()
         with fluid.program_guard(main, startup):
             x = fluid.layers.data('x', shape=[dim])
@@ -1745,17 +1762,42 @@ def check_kill_resume(tmpdir):
                 fluid.layers.square_error_cost(input=pred, label=y))
             fluid.optimizer.SGD(0.05).minimize(loss)
         return main, startup, loss
+    return build
 
-    def batch_fn(records):
-        rows = [pickle.loads(r) for r in records]
-        return {'x': np.stack([r[0] for r in rows]).astype('float32'),
-                'y': np.stack([r[1] for r in rows]).astype('float32')}
 
-    def params_of(job):
-        return {n: np.asarray(job._scope.find_var(n).value())
-                for n in job._persistable_names()
-                if job._scope.find_var(n) is not None
-                and job._scope.find_var(n).value() is not None}
+def _elastic_toy_batch(records):
+    import pickle
+    import numpy as np
+    rows = [pickle.loads(r) for r in records]
+    return {'x': np.stack([r[0] for r in rows]).astype('float32'),
+            'y': np.stack([r[1] for r in rows]).astype('float32')}
+
+
+def _elastic_toy_params(job):
+    import numpy as np
+    return {n: np.asarray(job._scope.find_var(n).value())
+            for n in job._persistable_names()
+            if job._scope.find_var(n) is not None
+            and job._scope.find_var(n).value() is not None}
+
+
+def check_kill_resume(tmpdir):
+    """The kill-resume goodput check (ISSUE 13 acceptance), functional
+    and deterministic: an ElasticTrainJob killed holding its LAST
+    claim; the claim's lease observed timing out and re-dispatching; a
+    replacement job resumes from the newest manifest, replays ZERO
+    steps, and final params are BITWISE-identical to an uninterrupted
+    run (SGD).  Returns the record block run_elastic folds in."""
+    import numpy as np
+    from paddle_tpu.distributed import ElasticTrainJob, Master
+    from paddle_tpu.fluid.dataflow import FeedPipelineError
+
+    dim, rpt, n_tasks = 8, 8, 6
+    data = os.path.join(tmpdir, 'kill_resume.recordio')
+    _elastic_toy_dataset(data, dim=dim, rpt=rpt, n_tasks=n_tasks)
+    build = _elastic_toy_build(dim)
+    batch_fn = _elastic_toy_batch
+    params_of = _elastic_toy_params
 
     # uninterrupted reference
     m0 = Master(chunk_timeout_secs=120)
@@ -1873,6 +1915,349 @@ def run_elastic():
             except Exception:
                 pass
         ctx['cleanup']()
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def build_master_chaos():
+    """Resilient-vs-bare ELASTIC windows (ISSUE 15): each window runs
+    one full ``ElasticTrainJob`` pass over the SAME seeded dataset
+    against its own Master/MasterServer — the bare side holds a plain
+    ``MasterClient``, the resilient side takes the ``endpoints=`` lane
+    (request-id minting, the server's dedup window, the reconnect/
+    backoff machinery — all on the no-fault happy path).  The paired
+    ratio is what control-plane fault tolerance costs a training job
+    when NOTHING is failing.  A secondary pure-RPC drain pair
+    (claim+finish every task through each client, no training)
+    isolates the per-RPC tax as a diagnostic — on loopback the dedup
+    bookkeeping + request-id fields are visible there (~1.1-1.2x of a
+    ~20us no-op RPC) while staying invisible at job scale.  The chaos
+    contract itself is functional, not timed — run_master_chaos folds
+    in ``check_master_chaos`` and ``check_dedup_replay``."""
+    import shutil
+    import tempfile
+    from paddle_tpu.distributed import (ElasticTrainJob, Master,
+                                        MasterClient, MasterServer,
+                                        ResilientMasterClient,
+                                        RetryPolicy)
+
+    dim = 8
+    rpt = int(os.environ.get('PERF_GATE_CHAOS_RPT', '8'))
+    n_tasks = int(os.environ.get('PERF_GATE_CHAOS_TASKS', '6'))
+    drain_tasks = int(os.environ.get('PERF_GATE_CHAOS_DRAIN_TASKS',
+                                     '64'))
+    tmpdir = tempfile.mkdtemp(prefix='perf_gate_mchaos_')
+    data = os.path.join(tmpdir, 'train.recordio')
+    _elastic_toy_dataset(data, dim=dim, rpt=rpt, n_tasks=n_tasks)
+    build = _elastic_toy_build(dim)
+    batch_fn = _elastic_toy_batch
+    counter = [0]
+
+    def elastic_window(resilient):
+        def run():
+            counter[0] += 1
+            master = Master(chunk_timeout_secs=120)
+            master.set_dataset([data], records_per_task=rpt)
+            server = MasterServer(master)
+            ckpt = os.path.join(tmpdir, 'w%03d' % counter[0])
+            cli = None
+            kwargs = {}
+            if resilient:
+                kwargs['endpoints'] = [server.endpoint]
+                kwargs['retry_policy'] = RetryPolicy(seed=0)
+                job_master = None
+            else:
+                cli = job_master = MasterClient(server.endpoint)
+            t0 = time.time()
+            job = ElasticTrainJob(build, job_master, ckpt, batch_fn,
+                                  worker_id='w%d' % counter[0],
+                                  checkpoint_every=0, **kwargs)
+            job.run()
+            wall = time.time() - t0
+            assert len(job.tasks_done) == n_tasks, job.metrics()
+            job.close()
+            if cli is not None:
+                cli.close()
+            server.close()
+            master.close()
+            return n_tasks * rpt / wall, wall
+        return run
+
+    def drain_window(resilient):
+        """Pure control-plane drain: the per-RPC diagnostic pair."""
+        def run():
+            master = Master(chunk_timeout_secs=60)
+            for i in range(drain_tasks):
+                master._q.add_task(json.dumps(
+                    {'path': 'mem', 'start': i * 8,
+                     'count': 8}).encode())
+            master._seq += 1
+            server = MasterServer(master)
+            cli = (ResilientMasterClient([server.endpoint],
+                                         retry=RetryPolicy(seed=0))
+                   if resilient else MasterClient(server.endpoint))
+            t0 = time.time()
+            done = 0
+            while True:
+                tid, task = cli.get_task()
+                if tid == -1:
+                    break
+                if task is None:
+                    time.sleep(0.001)
+                    continue
+                cli.task_finished(tid)
+                done += 1
+            wall = time.time() - t0
+            assert done == drain_tasks, (done, drain_tasks)
+            cli.close()
+            server.close()
+            master.close()
+            return drain_tasks / wall, wall
+        return run
+
+    ctx = {'n_tasks': n_tasks, 'rpt': rpt,
+           'drain_tasks': drain_tasks,
+           'drain_windows': (drain_window(False), drain_window(True)),
+           'cleanup': lambda: shutil.rmtree(tmpdir,
+                                            ignore_errors=True)}
+    return elastic_window(False), elastic_window(True), ctx
+
+
+def check_dedup_replay():
+    """The exactly-once pin (ISSUE 15 acceptance): a replayed
+    ``task_failed`` must NOT advance the failure count.  The
+    adversarial interleave — response lost, the task re-claimed, THEN
+    the retry lands — is exactly where a bare re-execution would fail
+    the NEW claim and discard the task at failure_max=2; the dedup
+    window replays the recorded response instead.  The counterfactual
+    (a genuinely new request id) proves the probe bites."""
+    from paddle_tpu.distributed import Master
+    m = Master(chunk_timeout_secs=60, failure_max=2)
+    m._q.add_task(b'{"path": "mem", "start": 0, "count": 1}')
+    m._seq += 1
+    tid, _ = m.get_task()
+
+    def fail():
+        return {'discarded': m.task_failed(tid)}
+
+    r1 = m.dedup_execute('worker-0', '1', fail)
+    assert r1 == {'discarded': 0}, r1
+    tid2, _ = m.get_task()  # re-claimed between the loss and the retry
+    assert tid2 == tid, (tid2, tid)
+    r2 = m.dedup_execute('worker-0', '1', fail)  # the RETRY: replays
+    assert r2 == r1, (r2, r1)
+    assert m.counts()[3] == 0, m.counts()  # failure count NOT advanced
+    # counterfactual: a NEW rid executes for real and discards
+    r3 = m.dedup_execute('worker-0', '2', fail)
+    assert r3 == {'discarded': 1}, r3
+    m.close()
+    return {'replayed_task_failed_deduped': True,
+            'dedup_counterfactual_discards': True}
+
+
+def check_master_chaos(tmpdir):
+    """The seeded chaos contract (ISSUE 15 acceptance), functional
+    and deterministic: an ElasticTrainJob driven through a
+    ``ResilientMasterClient`` over [primary, standby] endpoints while
+    a seeded ``FaultInjector`` drops a ``task_finished`` response and
+    a ``get_task`` response on the primary (retries must dedup-replay
+    — a leaked claim would reorder training and break bitwise parity)
+    and stretches heartbeats to just under the lease TTL (late but
+    live: no membership flap).  Mid-pass, while the job holds a
+    claim, the primary dies with NO final flush (host loss) and a
+    standby promoted from a replicated snapshot takes over at the
+    second endpoint.  The job finishes with ZERO lost and ZERO
+    double-processed task records and BITWISE-identical final params
+    (SGD) vs the fault-free run."""
+    import socket as socket_mod
+    import numpy as np
+    from paddle_tpu.distributed import (ElasticTrainJob, FaultInjector,
+                                        Master, MasterServer,
+                                        ResilientMasterClient,
+                                        RetryPolicy, SnapshotReplica)
+
+    dim, rpt, n_tasks = 8, 8, 6
+    data = os.path.join(tmpdir, 'chaos.recordio')
+    _elastic_toy_dataset(data, dim=dim, rpt=rpt, n_tasks=n_tasks)
+    build = _elastic_toy_build(dim)
+    batch_fn = _elastic_toy_batch
+    params_of = _elastic_toy_params
+
+    # fault-free reference (same seeds, no faults, no failover)
+    m0 = Master(chunk_timeout_secs=120)
+    m0.set_dataset([data], records_per_task=rpt)
+    ref = ElasticTrainJob(build, m0, os.path.join(tmpdir, 'ref'),
+                          batch_fn, worker_id='ref',
+                          checkpoint_every=0)
+    ref.run()
+    ref_params = params_of(ref)
+    ref.close()
+    m0.close()
+
+    # the chaos lane: primary on store A, standby endpoint reserved
+    primary = Master(store_path=os.path.join(tmpdir, 'chaos_a'),
+                     chunk_timeout_secs=60, worker_lease_secs=2.0)
+    primary.set_dataset([data], records_per_task=rpt)
+    server_fi = FaultInjector(seed=0)
+    server_fi.script('server_send', 'task_finished', 'drop_response',
+                     nth=1)
+    server_fi.script('server_send', 'get_task', 'drop_response',
+                     nth=2)
+    server = MasterServer(primary, fault_injector=server_fi)
+    sock = socket_mod.socket()
+    sock.bind(('127.0.0.1', 0))
+    standby_port = sock.getsockname()[1]
+    sock.close()
+    endpoints = [server.endpoint, '127.0.0.1:%d' % standby_port]
+    replica = SnapshotReplica(server.endpoint,
+                              os.path.join(tmpdir, 'chaos_b'))
+    client_fi = FaultInjector(seed=1)
+    # delayed heartbeats just under the 2s lease: late but live — the
+    # membership set must not flap (no spurious resize/epoch churn)
+    client_fi.script('client_send', 'heartbeat', 'delay', nth=1,
+                     times=4, delay_s=0.5)
+    cli = ResilientMasterClient(
+        endpoints, timeout=0.75, fault_injector=client_fi,
+        retry=RetryPolicy(max_attempts=10, base_backoff_s=0.05,
+                          deadline_s=60.0, seed=0))
+
+    promoted = {}
+    trained = []
+
+    def chaos_hook(tid, task, ordinal):
+        trained.append((task['path'], task['start']))
+        if ordinal == 3 and not promoted:
+            # mirror the freshest queue state, then HOST LOSS: the
+            # primary's server dies with a claim outstanding and no
+            # final snapshot flush; the standby promotes from the
+            # replica at the pre-agreed second endpoint
+            replica.pull()
+            server.close()
+            sm = Master(store_path=os.path.join(tmpdir, 'chaos_b'),
+                        chunk_timeout_secs=60, worker_lease_secs=2.0)
+            promoted['master'] = sm
+            promoted['server'] = MasterServer(sm, port=standby_port)
+
+    job = ElasticTrainJob(build, cli, os.path.join(tmpdir, 'chaos_j'),
+                          batch_fn, worker_id='chaos',
+                          checkpoint_every=0, heartbeat_interval=0.2,
+                          poll_interval=0.02, task_hook=chaos_hook)
+    try:
+        job.run()
+        got = params_of(job)
+        jm = job.metrics()
+        cm = cli.metrics()
+        standby = promoted['master']
+        counts = standby.counts()
+        # zero lost, zero double-processed, in original order
+        assert counts == (0, 0, n_tasks, 0), counts
+        assert len(trained) == n_tasks, trained
+        assert len(set(trained)) == n_tasks, trained
+        assert trained == sorted(trained), trained
+        bitwise = all(np.array_equal(ref_params[n], got[n])
+                      for n in ref_params)
+        assert bitwise, \
+            'chaos-run params diverged from the fault-free run'
+        assert jm['tasks_deduped'] >= 1, jm
+        assert cm['failovers'] >= 1, cm
+        assert cm['retries'] >= 1, cm
+        assert jm['resizes'] == 0, jm  # late heartbeats never flapped
+        rec = {
+            'chaos_bitwise_params': True,
+            'chaos_lost': 0,
+            'chaos_double_processed': 0,
+            'chaos_tasks_trained': len(trained),
+            'chaos_deduped_acks': jm['tasks_deduped'],
+            'chaos_failovers': cm['failovers'],
+            'chaos_retries': cm['retries'],
+            'chaos_reconnects': cm['reconnects'],
+            'chaos_injected_faults': server_fi.applied +
+            client_fi.applied,
+        }
+    finally:
+        job.close()
+        cli.close()
+        for k in ('server',):
+            if k in promoted:
+                promoted[k].close()
+        if 'master' in promoted:
+            promoted['master'].close()
+        try:
+            server.close()
+        except Exception:
+            pass
+    return rec
+
+
+def run_master_chaos():
+    """The master_chaos record (ISSUE 15): interleaved bare/resilient
+    ELASTIC windows (one full job pass each; ratios share a drift
+    window) + the pure-RPC drain diagnostic pair + the functional
+    chaos contract.  HARD asserts: ``retry_layer_overhead_ratio``
+    (resilient job wall over bare job wall, best shared window, NO
+    faults injected) <= PERF_GATE_CHAOS_OVERHEAD (default 1.05); the
+    rpc drain tripwire <= PERF_GATE_CHAOS_RPC_MAX (default 1.6); the
+    seeded chaos run's no-loss / no-duplicate / bitwise-params
+    contract; and the replayed-task_failed dedup pin with its
+    discarding counterfactual."""
+    import shutil
+    import tempfile
+    bare_w, res_w, ctx = build_master_chaos()
+    drain_bare_w, drain_res_w = ctx['drain_windows']
+    bare, res, dbare, dres = [], [], [], []
+    try:
+        # warm both lanes once (first-job trace/compile weather would
+        # otherwise land entirely on the bare side of block 1)
+        bare_w()
+        res_w()
+        for _ in range(BLOCKS):
+            # the GATED pair stays adjacent per block
+            bare.append(bare_w())
+            res.append(res_w())
+            dbare.append(drain_bare_w())
+            dres.append(drain_res_w())
+    finally:
+        ctx['cleanup']()
+    rec = {
+        'config': 'master_chaos',
+        'bare_rows_per_sec': round(max(r for r, _ in bare), 1),
+        'resilient_rows_per_sec': round(max(r for r, _ in res), 1),
+        'bare_blocks': [round(r, 1) for r, _ in bare],
+        'resilient_blocks': [round(r, 1) for r, _ in res],
+        # the HARD gate: what the retry layer costs an elastic
+        # training job when nothing is failing, best shared window
+        'retry_layer_overhead_ratio': round(
+            min(rw / bw for (_, rw), (_, bw) in zip(res, bare)), 4),
+        # the per-RPC diagnostic pair: claim+finish drains with no
+        # training — the dedup bookkeeping IS visible here on
+        # loopback (no-op RPCs are ~20us), bounded loosely as a
+        # catastrophic-regression tripwire (an accidental extra
+        # round trip per call would read ~2x)
+        'rpc_drain_overhead_ratio': round(
+            min(rw / bw for (_, rw), (_, bw) in zip(dres, dbare)), 4),
+        'rpc_bare_tasks_per_sec': round(max(r for r, _ in dbare), 1),
+        'rpc_resilient_tasks_per_sec': round(
+            max(r for r, _ in dres), 1),
+        'tasks_per_window': ctx['n_tasks'],
+        'rows_per_task': ctx['rpt'],
+        'drain_tasks_per_window': ctx['drain_tasks'],
+        'blocks': BLOCKS,
+    }
+    tmpdir = tempfile.mkdtemp(prefix='perf_gate_chaos_')
+    try:
+        rec.update(check_master_chaos(tmpdir))
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    rec.update(check_dedup_replay())
+    floor = float(os.environ.get('PERF_GATE_CHAOS_OVERHEAD', '1.05'))
+    assert rec['retry_layer_overhead_ratio'] <= floor, rec
+    rpc_max = float(os.environ.get('PERF_GATE_CHAOS_RPC_MAX', '1.6'))
+    assert rec['rpc_drain_overhead_ratio'] <= rpc_max, rec
+    assert rec['chaos_bitwise_params'], rec
+    assert rec['chaos_lost'] == 0, rec
+    assert rec['chaos_double_processed'] == 0, rec
+    assert rec['chaos_failovers'] >= 1, rec
+    assert rec['replayed_task_failed_deduped'], rec
     print(json.dumps(rec), flush=True)
     return rec
 
@@ -2165,6 +2550,7 @@ CONFIGS = {
     'sparse_grad': (build_sparse_grad, 'rows_per_sec'),
     'embed_cache': (build_embed_cache, 'rows_per_sec'),
     'elastic': (build_elastic, 'rows_per_sec'),
+    'master_chaos': (build_master_chaos, 'rows_per_sec'),
 }
 
 
@@ -2191,6 +2577,8 @@ def run_config(name):
         return run_embed_cache()
     if name == 'elastic':
         return run_elastic()
+    if name == 'master_chaos':
+        return run_master_chaos()
     build, unit = CONFIGS[name]
     # both sides compiled first, then INTERLEAVED blocks: a drift window
     # between two monolithic measurements would otherwise decide the
