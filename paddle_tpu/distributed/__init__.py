@@ -4,8 +4,10 @@
 from .master import Master, TaskQueuePyFallback, cloud_reader, \
     SnapshotReplica  # noqa: F401
 from .master_server import MasterServer, MasterClient  # noqa: F401
-from .transport import ResilientMasterClient, RetryPolicy, \
-    MasterUnavailableError, MasterProtocolError  # noqa: F401
+from .transport import ResilientMasterClient, ResilientServiceClient, \
+    RetryPolicy, ServiceServer, DedupWindow, \
+    MasterUnavailableError, MasterProtocolError, \
+    ServiceUnavailableError, ServiceProtocolError  # noqa: F401
 from .faults import FaultInjector, InjectedFault  # noqa: F401
 from .async_sparse import AsyncSparseEmbedding, \
     AsyncSparseClosedError  # noqa: F401

@@ -67,6 +67,10 @@ MANIFEST_FMT = 'paddle-tpu-elastic-manifest'
 MANIFEST_VERSION = 1
 _MANIFEST_PREFIX = 'MANIFEST-'
 _SHARDS_DIR = 'shards'
+# liveness marker (ISSUE 17 satellite): written at store open, removed
+# at close — AsyncShardedCheckpoint.gc() never touches a dir carrying
+# one, so cross-job retention cannot eat a running job's manifests
+_ACTIVE_MARKER = 'ACTIVE'
 
 
 class CheckpointWriteError(RuntimeError):
@@ -125,6 +129,8 @@ class AsyncShardedCheckpoint(object):
         self._m = {'saves': 0, 'stalls': 0, 'errors': 0,
                    'bytes_written': 0, 'last_step': None,
                    'last_commit_t': None}
+        with open(os.path.join(directory, _ACTIVE_MARKER), 'w') as f:
+            json.dump({'pid': os.getpid(), 'opened_t': time.time()}, f)
         self._sweep()  # crashed-write hygiene from a previous life
 
     # ---- paths ---------------------------------------------------------
@@ -355,6 +361,47 @@ class AsyncShardedCheckpoint(object):
         m['age_s'] = (time.time() - last) if last else None
         return m
 
+    @classmethod
+    def gc(cls, root, keep_jobs=2):
+        """Cross-job retention (ISSUE 17 satellite): ``root`` holds one
+        checkpoint directory per job (the per-job stores already bound
+        their own step retention with ``keep=``; what grows without
+        bound is the number of FINISHED jobs).  Removes dead job dirs —
+        committed manifests, shards and all — keeping the newest
+        ``keep_jobs`` of them by last-manifest mtime.  Never touched:
+        dirs carrying the ``ACTIVE`` marker (a live store; a crashed
+        job's stale marker is the operator's to clear) and dirs that
+        don't look like checkpoint stores at all (no manifests, no
+        shards/).  Returns the removed paths."""
+        if int(keep_jobs) < 0:
+            raise ValueError('gc: keep_jobs must be >= 0')
+        dead = []
+        for name in sorted(os.listdir(root)):
+            d = os.path.join(root, name)
+            if not os.path.isdir(d):
+                continue
+            try:
+                entries = os.listdir(d)
+            except OSError:
+                continue
+            manifests = [f for f in entries
+                         if f.startswith(_MANIFEST_PREFIX)
+                         and f.endswith('.json')]
+            if not manifests and _SHARDS_DIR not in entries:
+                continue  # not a checkpoint store: never touch
+            if _ACTIVE_MARKER in entries:
+                continue  # live job: never touch
+            newest = max([os.path.getmtime(os.path.join(d, f))
+                          for f in manifests] or
+                         [os.path.getmtime(d)])
+            dead.append((newest, d))
+        dead.sort()
+        removed = []
+        for _, d in dead[:max(0, len(dead) - int(keep_jobs))]:
+            shutil.rmtree(d, ignore_errors=True)
+            removed.append(d)
+        return removed
+
     def close(self):
         with self._cond:
             self._closed = True
@@ -362,6 +409,10 @@ class AsyncShardedCheckpoint(object):
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        try:
+            os.remove(os.path.join(self.directory, _ACTIVE_MARKER))
+        except OSError:
+            pass
         if self._error is not None:
             err, self._error = self._error, None
             raise CheckpointWriteError(

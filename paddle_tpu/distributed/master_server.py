@@ -5,7 +5,11 @@ python/paddle/v2/master/client.py).
 
 Transport: newline-delimited JSON over TCP — the control plane carries a
 few small messages per task (payloads are record RANGES, not records),
-so the Go version's codec buys nothing here.  One request per line:
+so the Go version's codec buys nothing here.  The wire shell (daemon
+server thread, tracked connections, fault-injection sites, malformed
+lines answered typed, rid-routed dedup) is the shared
+``transport.ServiceServer`` (ISSUE 17); this module owns only the
+master's dispatch table.  One request per line:
 
     {"method": "get_task"}                     -> {"tid": N, "task": {...}}
     {"method": "task_finished", "tid": N}      -> {"ok": true}
@@ -41,204 +45,90 @@ suite.
 
 import json
 import socket
-import socketserver
 import threading
-import time
 
-from .transport import MasterUnavailableError, error_from_response
+from .transport import MasterUnavailableError, ServiceServer, \
+    error_from_response
 
 __all__ = ['MasterServer', 'MasterClient']
 
 
-class _Handler(socketserver.StreamRequestHandler):
-    def setup(self):
-        socketserver.StreamRequestHandler.setup(self)
-        # tracked so MasterServer.close() can force-close live
-        # conversations: a client blocked on readline gets EOF (a
-        # typed error), never a hang on a half-shut-down server
-        self.server.track(self.connection)
-
-    def finish(self):
-        self.server.untrack(self.connection)
-        socketserver.StreamRequestHandler.finish(self)
-
-    def _dispatch(self, master, method, req):
-        """One request -> one response dict (errors included — the
-        recorded-response dedup window must replay refusals too)."""
-        try:
-            if method == 'get_task':
-                tid, task = master.get_task()
-                return {'tid': tid, 'task': task}
-            elif method == 'task_finished':
-                master.task_finished(int(req['tid']))
-                return {'ok': True}
-            elif method == 'task_failed':
-                return {'discarded': master.task_failed(int(req['tid']))}
-            elif method == 'counts':
-                return {'counts': list(master.counts())}
-            elif method == 'new_pass':
-                advanced = master.new_pass(expected=req.get('expected'))
-                return {'ok': True, 'advanced': advanced}
-            elif method == 'pass_num':
-                return {'pass_num': master.current_pass()}
-            elif method in ('register_worker', 'heartbeat',
-                            'deregister_worker'):
-                # membership door (the etcd registration dir): a
-                # worker's TTL lease lives in the master; a crashed
-                # worker just stops calling and its lease expires
-                epoch, workers = getattr(master, method)(
-                    str(req['worker_id']))
-                return {'epoch': epoch, 'workers': workers}
-            elif method == 'members':
-                epoch, workers = master.members()
-                return {'epoch': epoch, 'workers': workers}
-            elif method == 'snapshot':
-                # replication door (go/master etcd_client.go analog):
-                # a standby on ANOTHER filesystem mirrors the queue
-                # state so master-host loss doesn't lose the pass.
-                # Read _seq BEFORE serializing: a mutator landing
-                # between the two would otherwise pair an OLD blob
-                # with a NEWER seq, and the replica would durably
-                # skip re-pulling the state that seq promised (e.g.
-                # a force-snapshotted poison-task discard).  The
-                # stale-seq direction is safe — the next pull sees
-                # seq advance and re-mirrors.
-                import base64
-                seq = getattr(master, '_seq', 0)
-                blob = master.snapshot()  # versioned envelope
-                return {'blob': base64.b64encode(blob).decode(),
-                        'seq': seq}
-            return {'error': 'unknown method %r' % method,
-                    'etype': 'ValueError'}
-        except Exception as e:  # surface to the client, keep serving
-            return {'error': str(e), 'etype': type(e).__name__}
-
-    def handle(self):
-        # connection teardown (a dying client, or close() force-
-        # shutting the socket under us) ends the conversation, never
-        # an unhandled-exception traceback in the handler thread
-        try:
-            self._serve_lines()
-        except OSError:
-            return
-
-    def _serve_lines(self):
-        master = self.server.master
-        fi = self.server.fault_injector
-        for line in self.rfile:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                req = json.loads(line.decode())
-                method = req.get('method')
-            except (ValueError, UnicodeDecodeError) as e:
-                # a half-written or corrupted line must not wedge the
-                # handler: answer typed, keep reading
-                self._write({'error': 'malformed request line: %s' % e,
-                             'etype': type(e).__name__})
-                continue
-            if fi is not None:
-                rule = fi.check('server_recv', method)
-                if rule is not None:
-                    act = rule['action']
-                    if act == 'delay':
-                        time.sleep(rule['delay_s'])
-                    elif act in ('drop_request', 'drop_response'):
-                        continue  # the request never "arrived"
-                    elif act == 'close':
-                        return
-            rid, client = req.get('rid'), req.get('client')
-            if rid is not None and hasattr(master, 'dedup_execute'):
-                resp = master.dedup_execute(
-                    str(client), str(rid),
-                    lambda: self._dispatch(master, method, req))
-            else:
-                resp = self._dispatch(master, method, req)
-            if fi is not None:
-                rule = fi.check('server_send', method)
-                if rule is not None:
-                    act = rule['action']
-                    if act == 'delay':
-                        time.sleep(rule['delay_s'])
-                    elif act == 'drop_response':
-                        continue  # processed, response lost on the wire
-                    elif act == 'close':
-                        return
-                    elif act == 'garbage':
-                        try:
-                            self.wfile.write(b'\x00!garbage!\n')
-                            self.wfile.flush()
-                        except (BrokenPipeError, ConnectionResetError,
-                                OSError):
-                            return
-                        continue
-            if not self._write(resp):
-                return
-
-    def _write(self, resp):
-        try:
-            self.wfile.write((json.dumps(resp) + '\n').encode())
-            self.wfile.flush()
-            return True
-        except (BrokenPipeError, ConnectionResetError, OSError):
-            return False
-
-
-class _TCPServer(socketserver.ThreadingTCPServer):
-    allow_reuse_address = True
-    daemon_threads = True
-
-    def __init__(self, addr, handler):
-        socketserver.ThreadingTCPServer.__init__(self, addr, handler)
-        self._conns = set()
-        self._conns_lock = threading.Lock()
-
-    def track(self, conn):
-        with self._conns_lock:
-            self._conns.add(conn)
-
-    def untrack(self, conn):
-        with self._conns_lock:
-            self._conns.discard(conn)
-
-    def live_connections(self):
-        with self._conns_lock:
-            return list(self._conns)
+def _dispatch_master(master, method, req):
+    """One request -> one response dict (errors included — the
+    recorded-response dedup window must replay refusals too; the
+    ServiceServer wraps raised exceptions the same way)."""
+    try:
+        if method == 'get_task':
+            tid, task = master.get_task()
+            return {'tid': tid, 'task': task}
+        elif method == 'task_finished':
+            master.task_finished(int(req['tid']))
+            return {'ok': True}
+        elif method == 'task_failed':
+            return {'discarded': master.task_failed(int(req['tid']))}
+        elif method == 'counts':
+            return {'counts': list(master.counts())}
+        elif method == 'new_pass':
+            advanced = master.new_pass(expected=req.get('expected'))
+            return {'ok': True, 'advanced': advanced}
+        elif method == 'pass_num':
+            return {'pass_num': master.current_pass()}
+        elif method in ('register_worker', 'heartbeat',
+                        'deregister_worker'):
+            # membership door (the etcd registration dir): a
+            # worker's TTL lease lives in the master; a crashed
+            # worker just stops calling and its lease expires
+            epoch, workers = getattr(master, method)(
+                str(req['worker_id']))
+            return {'epoch': epoch, 'workers': workers}
+        elif method == 'members':
+            epoch, workers = master.members()
+            return {'epoch': epoch, 'workers': workers}
+        elif method == 'snapshot':
+            # replication door (go/master etcd_client.go analog):
+            # a standby on ANOTHER filesystem mirrors the queue
+            # state so master-host loss doesn't lose the pass.
+            # Read _seq BEFORE serializing: a mutator landing
+            # between the two would otherwise pair an OLD blob
+            # with a NEWER seq, and the replica would durably
+            # skip re-pulling the state that seq promised (e.g.
+            # a force-snapshotted poison-task discard).  The
+            # stale-seq direction is safe — the next pull sees
+            # seq advance and re-mirrors.
+            import base64
+            seq = getattr(master, '_seq', 0)
+            blob = master.snapshot()  # versioned envelope
+            return {'blob': base64.b64encode(blob).decode(),
+                    'seq': seq}
+        return {'error': 'unknown method %r' % method,
+                'etype': 'ValueError'}
+    except Exception as e:  # surface to the client, keep serving
+        return {'error': str(e), 'etype': type(e).__name__}
 
 
 class MasterServer(object):
-    """Serve a Master over TCP from a daemon thread."""
+    """Serve a Master over TCP from a daemon thread (the shared
+    ``ServiceServer`` shell with the master dispatch table and the
+    master's own snapshot-riding dedup window)."""
 
     def __init__(self, master, host='127.0.0.1', port=0,
                  fault_injector=None):
         self.master = master
         self.fault_injector = fault_injector
-        self._srv = _TCPServer((host, port), _Handler)
-        self._srv.master = master
-        self._srv.fault_injector = fault_injector
-        self.host, self.port = self._srv.server_address
-        self._thread = threading.Thread(
-            target=self._srv.serve_forever, daemon=True)
-        self._thread.start()
+        self._srv = ServiceServer(
+            lambda method, req: _dispatch_master(master, method, req),
+            host=host, port=port, fault_injector=fault_injector,
+            dedup_execute=(master.dedup_execute
+                           if hasattr(master, 'dedup_execute')
+                           else None))
+        self.host, self.port = self._srv.host, self._srv.port
 
     @property
     def endpoint(self):
-        return '%s:%d' % (self.host, self.port)
+        return self._srv.endpoint
 
     def close(self):
-        self._srv.shutdown()
-        self._srv.server_close()
-        # force-close live conversations: a handler thread blocked in
-        # readline (its client is quiet) or a client blocked waiting
-        # for a response must both observe EOF now — racing callers
-        # get the typed connection error, never a hang on a server
-        # that stopped accepting but kept old sockets open
-        for conn in self._srv.live_connections():
-            try:
-                conn.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
+        self._srv.close()
 
 
 class MasterClient(object):

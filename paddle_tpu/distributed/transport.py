@@ -1,92 +1,125 @@
-"""Resilient control-plane RPC lane (ISSUE 15).
+"""Shared resilient RPC substrate (ISSUE 15, generalized in ISSUE 17).
 
 The reference survives control-plane faults by construction: the
 go/master registers through etcd so a crashed master is re-elected and
 clients transparently re-resolve it (go/master/etcd_client.go), and
 the Fluid send/recv ops retry RPCs against a restarted pserver
-(operators/send_op.cc's grpc retry loop).  The bare ``MasterClient``
-is ONE blocking socket that dies on the first hiccup; this module is
-the lane that makes master RPCs survivable:
+(operators/send_op.cc's grpc retry loop).  This module is that lane,
+SERVICE-AGNOSTIC — the master control plane rides it (master_server.py
+/ elastic.py) and so does the serving fleet tier (serving/fleet.py):
 
-* a typed error taxonomy — ``MasterUnavailableError`` (transient: the
+* a typed error taxonomy — ``ServiceUnavailableError`` (transient: the
   socket broke, the host is gone, the response never came; a retry or
-  failover may succeed) vs ``MasterProtocolError`` (permanent: the
+  failover may succeed) vs ``ServiceProtocolError`` (permanent: the
   server ANSWERED and said no; a rid-carrying mutation's outcome is
   recorded in the dedup window, so retrying the identical call could
   only replay the identical refusal — in-band errors are final).
-  The server carries the exception TYPE name over the wire
-  (``{'error': ..., 'etype': ...}``) for diagnosis, so the client
-  stops flattening everything into one RuntimeError;
+  ``MasterUnavailableError`` / ``MasterProtocolError`` are back-compat
+  ALIASES of the same classes, so every pre-generalization
+  ``except``/``isinstance`` site keeps working.  The server carries
+  the exception TYPE name over the wire (``{'error': ..., 'etype':
+  ...}``) for diagnosis and typed re-raising, so the client stops
+  flattening everything into one RuntimeError;
 
 * ``RetryPolicy`` — per-call deadline, exponential backoff with
   SEEDED jitter (deterministic chaos runs), max attempts;
 
-* ``ResilientMasterClient`` — the ``MasterClient`` surface over a
-  LIST of endpoints (primary + promoted standbys, tried in order),
-  owning reconnect-on-broken-socket and failover.  Mutating methods
-  (``get_task``/``task_finished``/``task_failed``/``new_pass``) carry
-  a client-minted request id; the ``MasterServer`` keeps a bounded
-  per-client dedup window replaying the recorded response, so a retry
-  after a LOST RESPONSE is exactly-once: a replayed ``task_failed``
-  does not advance the failure count toward ``failure_max``, and a
-  replayed ``get_task`` returns the SAME claimed task instead of
-  leaking the first claim until its lease expires.  The window rides
-  the versioned snapshot envelope, so dedup survives failover to a
-  standby restored from a replicated snapshot.
+* ``ResilientServiceClient`` — a blocking request/response client over
+  a LIST of endpoints (primary + promoted standbys, tried in order),
+  owning reconnect-on-broken-socket and failover.  Methods named in
+  its ``mutating`` set carry a client-minted request id reused across
+  retries of the same LOGICAL call; the server's bounded per-client
+  dedup window replays the recorded response, so a retry after a LOST
+  RESPONSE is exactly-once.  ``ResilientMasterClient`` is this client
+  with the master's method surface and mutating set
+  (``get_task``/``task_finished``/``task_failed``/``new_pass``);
+
+* ``DedupWindow`` — the bounded per-client exactly-once window as a
+  standalone piece (OrderedDict LRU over clients and rids, refusals
+  recorded too) for services whose state object does not carry its
+  own (the ``Master`` keeps its internal window: it rides the
+  versioned snapshot envelope so dedup survives failover to a
+  promoted standby);
+
+* ``ServiceServer`` — the newline-delimited-JSON-over-TCP server
+  shell (daemon thread, tracked connections force-closed on
+  ``close()``, ``server_recv``/``server_send`` fault-injection sites,
+  malformed lines answered typed, rid-carrying requests routed
+  through a ``dedup_execute`` hook) factored out of the master server
+  so any dispatch table can stand behind the same wire behavior.
 """
 
 import json
 import random
 import socket
+import socketserver
 import threading
 import time
 import uuid
+from collections import OrderedDict
 
 from .faults import InjectedFault
 
-__all__ = ['RetryPolicy', 'ResilientMasterClient',
+__all__ = ['RetryPolicy', 'ResilientServiceClient',
+           'ResilientMasterClient', 'ServiceServer', 'DedupWindow',
+           'ServiceUnavailableError', 'ServiceProtocolError',
            'MasterUnavailableError', 'MasterProtocolError']
 
 
-class MasterUnavailableError(ConnectionError):
-    """Transient: the master could not be reached (connect refused,
+class ServiceUnavailableError(ConnectionError):
+    """Transient: the service could not be reached (connect refused,
     socket broke mid-call, response never arrived, all endpoints
-    down).  A retry — possibly against a promoted standby — may
+    down).  A retry — possibly against another endpoint — may
     succeed.  Subclasses ConnectionError so pre-taxonomy callers
     (``except ConnectionError``) keep working."""
 
 
-class MasterProtocolError(RuntimeError):
-    """Permanent: the master answered and refused (unknown method, a
+class ServiceProtocolError(RuntimeError):
+    """Permanent: the service answered and refused (unknown method, a
     server-side exception, a snapshot-version refusal).  Retrying the
     identical call cannot help.  Subclasses RuntimeError so
-    pre-taxonomy callers (``except RuntimeError``) keep working."""
+    pre-taxonomy callers (``except RuntimeError``) keep working.
+    ``resp`` carries the raw wire response so a caller can re-raise
+    the server-side type (``etype``) as a richer typed error (the
+    fleet router re-mints ``OverloadedError`` from it)."""
+
+    def __init__(self, msg, resp=None):
+        RuntimeError.__init__(self, msg)
+        self.resp = resp or {}
 
 
-def error_from_response(resp):
+# back-compat aliases (ISSUE 15 names): same classes, so existing
+# ``except MasterUnavailableError`` sites and isinstance checks keep
+# working against errors raised by the generic substrate
+MasterUnavailableError = ServiceUnavailableError
+MasterProtocolError = ServiceProtocolError
+
+
+def error_from_response(resp, service='master'):
     """The typed exception for an IN-BAND error response.  The server
     ANSWERED — the conversation works and (for a rid-carrying
     mutation) the outcome is recorded in the dedup window, so a retry
     of the identical call can only replay the identical refusal:
     every in-band error is FINAL for its logical call
-    (MasterProtocolError).  Only transport-level failures (no answer
+    (ServiceProtocolError).  Only transport-level failures (no answer
     at all) are transient.  ``etype`` (the server-side exception
-    class name) rides the message for diagnosis."""
+    class name) rides the message for diagnosis and the raw response
+    rides ``.resp`` for typed re-raising."""
     etype = resp.get('etype')
-    msg = 'master error: %s' % resp.get('error')
+    msg = '%s error: %s' % (service, resp.get('error'))
     if etype:
         msg += ' [server %s]' % etype
-    return MasterProtocolError(msg)
+    return ServiceProtocolError(msg, resp=resp)
 
 
 class RetryPolicy(object):
-    """Backoff/deadline contract for one logical master call.
+    """Backoff/deadline contract for one logical service call.
 
     max_attempts: total attempts (first try included).
     base_backoff_s / max_backoff_s: exponential schedule —
         ``base * 2**(attempt-1)`` capped at ``max_backoff_s``.
     deadline_s: wall bound for the WHOLE call across retries and
-        failovers; exhausting it raises MasterUnavailableError.
+        failovers; exhausting it raises ServiceUnavailableError.
     jitter: each backoff is scaled by ``1 + U(0, jitter)`` drawn from
         a SEEDED rng — deterministic schedules for the chaos suite,
         decorrelated retries in a fleet (each worker seeds with its
@@ -114,19 +147,23 @@ class RetryPolicy(object):
         return base * (1.0 + self._rng.random() * self.jitter)
 
 
-# methods whose server-side effect is NOT idempotent across a lost
-# response: these carry a request id and ride the dedup window
+# master methods whose server-side effect is NOT idempotent across a
+# lost response: these carry a request id and ride the dedup window
 _MUTATING = frozenset(['get_task', 'task_finished', 'task_failed',
                        'new_pass'])
 
 
-class ResilientMasterClient(object):
-    """The ``MasterClient`` surface with reconnect, retry, failover
-    and exactly-once mutations (see module doc).
+class ResilientServiceClient(object):
+    """Blocking request/response client with reconnect, retry,
+    failover and exactly-once mutations (see module doc).
 
     endpoints: ``'host:port'`` list tried IN ORDER — the primary
-        first, promoted standbys after; a working endpoint sticks
-        until it breaks.
+        first, standbys after; a working endpoint sticks until it
+        breaks.
+    mutating: method names that carry a client-minted request id
+        (reused across retries of one logical call) so the server's
+        dedup window can replay a lost response instead of
+        re-executing.
     retry: a ``RetryPolicy`` (default constructed when None).
     timeout: per-attempt socket timeout — a dropped response turns
         into a retry after this long, so keep it a small multiple of
@@ -136,19 +173,24 @@ class ResilientMasterClient(object):
     client_id: the dedup-window identity; defaults to a fresh uuid —
         pass a stable id only if YOU guarantee request ids never
         repeat under it.
+    service: the label used in error messages ('master', 'replica',
+        ...) so a stack trace names the lane that failed.
     """
 
     def __init__(self, endpoints, retry=None, timeout=5.0,
-                 fault_injector=None, client_id=None):
+                 fault_injector=None, client_id=None, mutating=(),
+                 service='service'):
         if isinstance(endpoints, str):
             endpoints = [endpoints]
         self.endpoints = [str(e) for e in endpoints]
         if not self.endpoints:
-            raise ValueError('ResilientMasterClient: endpoints is '
-                             'empty')
+            raise ValueError('%s: endpoints is empty'
+                             % type(self).__name__)
         self.retry = retry if retry is not None else RetryPolicy()
         self.timeout = float(timeout)
         self.fault_injector = fault_injector
+        self.mutating = frozenset(mutating)
+        self.service = str(service)
         self._client_id = client_id or uuid.uuid4().hex[:16]
         self._rid = 0
         self._sock = None
@@ -202,9 +244,9 @@ class ResilientMasterClient(object):
                 self._m['failovers'] += 1
                 self._ep_idx = idx
             return
-        raise MasterUnavailableError(
-            'no master endpoint reachable (%s): %s'
-            % (', '.join(self.endpoints), last))
+        raise ServiceUnavailableError(
+            'no %s endpoint reachable (%s): %s'
+            % (self.service, ', '.join(self.endpoints), last))
 
     # ---- the call loop -------------------------------------------------
 
@@ -239,22 +281,24 @@ class ResilientMasterClient(object):
                     raise InjectedFault('client_recv %s (%s)'
                                         % (act, method))
         if not line:
-            raise MasterUnavailableError(
-                'master closed the connection')
+            raise ServiceUnavailableError(
+                '%s closed the connection' % self.service)
         resp = json.loads(line.decode())  # ValueError -> transient
         if 'error' in resp:
-            raise error_from_response(resp)
+            raise error_from_response(resp, service=self.service)
         return resp
 
-    def _call(self, method, **kw):
+    def call(self, method, **kw):
+        """One logical call: retries/failovers inside, exactly-once
+        when ``method`` is in the mutating set."""
         req = dict(kw)
         req['method'] = method
         with self._lock:
             if self._closed:
-                raise MasterUnavailableError(
-                    'ResilientMasterClient is closed')
+                raise ServiceUnavailableError(
+                    '%s is closed' % type(self).__name__)
             self._m['calls'] += 1
-            if method in _MUTATING:
+            if method in self.mutating:
                 # the exactly-once identity: RETRIES of this logical
                 # call reuse the id, so the server's dedup window
                 # replays the recorded response instead of
@@ -268,14 +312,14 @@ class ResilientMasterClient(object):
                 attempt += 1
                 try:
                     resp = self._attempt(req, deadline)
-                except MasterProtocolError:
+                except ServiceProtocolError:
                     # the transport WORKED; the refusal is permanent
                     self._unreachable_since = None
                     raise
                 except (OSError, ValueError) as e:
                     # OSError covers socket death, timeouts, refused
                     # connects, InjectedFault and the typed
-                    # MasterUnavailableError; ValueError is a
+                    # ServiceUnavailableError; ValueError is a
                     # corrupted (non-JSON) line
                     self._drop_conn()
                     if self._unreachable_since is None:
@@ -283,11 +327,11 @@ class ResilientMasterClient(object):
                     out_of_time = (time.monotonic() >= deadline)
                     if attempt >= self.retry.max_attempts or \
                             out_of_time:
-                        raise MasterUnavailableError(
-                            'master call %r failed after %d attempt'
+                        raise ServiceUnavailableError(
+                            '%s call %r failed after %d attempt'
                             '(s) over %r: %s'
-                            % (method, attempt, self.endpoints,
-                               e)) from e
+                            % (self.service, method, attempt,
+                               self.endpoints, e)) from e
                     self._m['retries'] += 1
                     time.sleep(max(min(self.retry.backoff(attempt),
                                        deadline - time.monotonic()),
@@ -296,12 +340,15 @@ class ResilientMasterClient(object):
                     self._unreachable_since = None
                     return resp
 
+    # internal spelling kept for the pre-generalization subclasses
+    _call = call
+
     # ---- observability -------------------------------------------------
 
     def unreachable_age(self):
-        """Seconds the master has been continuously unreachable (None
+        """Seconds the service has been continuously unreachable (None
         when the last call succeeded) — the watchdog's
-        master-unreachable probe."""
+        unreachable probe."""
         since = self._unreachable_since
         return (time.monotonic() - since) if since is not None \
             else None
@@ -312,6 +359,29 @@ class ResilientMasterClient(object):
         m['endpoints'] = list(self.endpoints)
         m['unreachable_s'] = self.unreachable_age()
         return m
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            self._drop_conn()
+
+    @property
+    def closed(self):
+        return self._closed
+
+
+class ResilientMasterClient(ResilientServiceClient):
+    """The ``MasterClient`` surface over the shared substrate:
+    reconnect, retry, failover and exactly-once mutations
+    (``get_task``/``task_finished``/``task_failed``/``new_pass``
+    carry the dedup rid) — see the module doc and ISSUE 15."""
+
+    def __init__(self, endpoints, retry=None, timeout=5.0,
+                 fault_injector=None, client_id=None):
+        ResilientServiceClient.__init__(
+            self, endpoints, retry=retry, timeout=timeout,
+            fault_injector=fault_injector, client_id=client_id,
+            mutating=_MUTATING, service='master')
 
     # ---- the MasterClient surface --------------------------------------
 
@@ -356,7 +426,252 @@ class ResilientMasterClient(object):
         r = self._call('snapshot')
         return base64.b64decode(r['blob']), r.get('seq', 0)
 
-    def close(self):
+
+class _InProgress(object):
+    """Placeholder for a (client, rid) whose first execution is still
+    running: a RETRY of the same logical call (the client timed out
+    waiting, the response is merely slow) parks on the event and
+    replays the eventual record instead of re-executing."""
+
+    __slots__ = ('event', 'resp')
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.resp = None
+
+
+class DedupWindow(object):
+    """Bounded per-client exactly-once window, standalone (the
+    ``Master`` keeps its own so it can ride the snapshot envelope —
+    same semantics, same bounds).  ``execute(client, rid, fn)`` runs
+    ``fn()`` (one RPC dispatch returning a response dict) exactly once
+    per (client, rid): a repeat — a client retrying after a lost OR
+    SLOW response — REPLAYS the recorded response (waiting for the
+    in-flight first execution when it hasn't finished yet).  Error
+    responses are recorded too (a refusal must replay as the same
+    refusal).  The window is bounded per client and across clients
+    (LRU).  Unlike the master's window, ``fn()`` runs OUTSIDE the
+    window lock: a replica's long-running generate dispatch must not
+    serialize every other request behind it."""
+
+    def __init__(self, window=64, clients=64):
+        if int(window) < 1 or int(clients) < 1:
+            raise ValueError('DedupWindow: window and clients must '
+                             'be >= 1')
+        self.window = int(window)
+        self.clients = int(clients)
+        self.replays = 0
+        self._win = OrderedDict()
+        self._lock = threading.Lock()
+
+    def execute(self, client, rid, fn):
+        marker = None
         with self._lock:
-            self._closed = True
-            self._drop_conn()
+            win = self._win.get(client)
+            rec = win.get(rid) if win is not None else None
+            if rec is not None:
+                self._win.move_to_end(client)
+                self.replays += 1
+                if not isinstance(rec, _InProgress):
+                    return rec
+                marker = rec  # first execution still running: wait
+            else:
+                if win is None:
+                    win = self._win[client] = OrderedDict()
+                    while len(self._win) > self.clients:
+                        self._win.popitem(last=False)
+                self._win.move_to_end(client)
+                win[rid] = _InProgress()
+        if marker is not None:
+            marker.event.wait()
+            resp = marker.resp
+            if resp is None:  # the first execution died mid-call
+                resp = {'error': 'deduplicated call failed before a '
+                                 'response was recorded',
+                        'etype': 'RuntimeError'}
+            return resp
+        try:
+            resp = fn()
+        except BaseException:
+            # clear the marker so a retry re-executes instead of
+            # replaying a phantom; wake any parked waiters
+            with self._lock:
+                win = self._win.get(client)
+                rec = win.pop(rid, None) if win is not None else None
+            if isinstance(rec, _InProgress):
+                rec.event.set()
+            raise
+        with self._lock:
+            win = self._win.get(client)
+            rec = None
+            if win is not None:
+                rec = win.get(rid)
+                win[rid] = resp
+                while len(win) > self.window:
+                    win.popitem(last=False)
+        if isinstance(rec, _InProgress):
+            rec.resp = resp
+            rec.event.set()
+        return resp
+
+
+class _ServiceHandler(socketserver.StreamRequestHandler):
+    def setup(self):
+        socketserver.StreamRequestHandler.setup(self)
+        # tracked so ServiceServer.close() can force-close live
+        # conversations: a client blocked on readline gets EOF (a
+        # typed error), never a hang on a half-shut-down server
+        self.server.track(self.connection)
+
+    def finish(self):
+        self.server.untrack(self.connection)
+        socketserver.StreamRequestHandler.finish(self)
+
+    def handle(self):
+        # connection teardown (a dying client, or close() force-
+        # shutting the socket under us) ends the conversation, never
+        # an unhandled-exception traceback in the handler thread
+        try:
+            self._serve_lines()
+        except OSError:
+            return
+
+    def _safe_dispatch(self, method, req):
+        """One request -> one response dict.  Errors become in-band
+        responses INSIDE this call so a dedup window records refusals
+        too (a replayed refusal must replay identically)."""
+        try:
+            return self.server.dispatch(method, req)
+        except Exception as e:  # surface to the client, keep serving
+            return {'error': str(e), 'etype': type(e).__name__}
+
+    def _serve_lines(self):
+        fi = self.server.fault_injector
+        for line in self.rfile:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line.decode())
+                method = req.get('method')
+            except (ValueError, UnicodeDecodeError) as e:
+                # a half-written or corrupted line must not wedge the
+                # handler: answer typed, keep reading
+                self._write({'error': 'malformed request line: %s' % e,
+                             'etype': type(e).__name__})
+                continue
+            if fi is not None:
+                rule = fi.check('server_recv', method)
+                if rule is not None:
+                    act = rule['action']
+                    if act == 'delay':
+                        time.sleep(rule['delay_s'])
+                    elif act in ('drop_request', 'drop_response'):
+                        continue  # the request never "arrived"
+                    elif act == 'close':
+                        return
+            rid, client = req.get('rid'), req.get('client')
+            dedup = self.server.dedup_execute
+            if rid is not None and dedup is not None:
+                resp = dedup(str(client), str(rid),
+                             lambda: self._safe_dispatch(method, req))
+            else:
+                resp = self._safe_dispatch(method, req)
+            if fi is not None:
+                rule = fi.check('server_send', method)
+                if rule is not None:
+                    act = rule['action']
+                    if act == 'delay':
+                        time.sleep(rule['delay_s'])
+                    elif act == 'drop_response':
+                        continue  # processed, response lost on the wire
+                    elif act == 'close':
+                        return
+                    elif act == 'garbage':
+                        try:
+                            self.wfile.write(b'\x00!garbage!\n')
+                            self.wfile.flush()
+                        except (BrokenPipeError, ConnectionResetError,
+                                OSError):
+                            return
+                        continue
+            if not self._write(resp):
+                return
+
+    def _write(self, resp):
+        try:
+            self.wfile.write((json.dumps(resp) + '\n').encode())
+            self.wfile.flush()
+            return True
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            return False
+
+
+class _TrackedTCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr, handler):
+        socketserver.ThreadingTCPServer.__init__(self, addr, handler)
+        self._conns = set()
+        self._conns_lock = threading.Lock()
+
+    def track(self, conn):
+        with self._conns_lock:
+            self._conns.add(conn)
+
+    def untrack(self, conn):
+        with self._conns_lock:
+            self._conns.discard(conn)
+
+    def live_connections(self):
+        with self._conns_lock:
+            return list(self._conns)
+
+
+class ServiceServer(object):
+    """Serve a dispatch table over newline-delimited JSON TCP from a
+    daemon thread.
+
+    dispatch: ``fn(method, req) -> response dict`` — exceptions become
+        typed in-band error responses (``{'error', 'etype'}``);
+        unknown methods should return one too.
+    dedup_execute: optional ``fn(client, rid, dispatch_thunk)`` — a
+        rid-carrying request routes through it so retried mutations
+        replay their recorded response (pass ``Master.dedup_execute``
+        or a ``DedupWindow().execute``).
+    fault_injector: optional ``FaultInjector`` wired into the
+        ``server_recv``/``server_send`` handler sites.
+    """
+
+    def __init__(self, dispatch, host='127.0.0.1', port=0,
+                 fault_injector=None, dedup_execute=None):
+        self.dispatch = dispatch
+        self.fault_injector = fault_injector
+        self.dedup_execute = dedup_execute
+        self._srv = _TrackedTCPServer((host, port), _ServiceHandler)
+        self._srv.dispatch = dispatch
+        self._srv.fault_injector = fault_injector
+        self._srv.dedup_execute = dedup_execute
+        self.host, self.port = self._srv.server_address
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+
+    @property
+    def endpoint(self):
+        return '%s:%d' % (self.host, self.port)
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+        # force-close live conversations: a handler thread blocked in
+        # readline (its client is quiet) or a client blocked waiting
+        # for a response must both observe EOF now — racing callers
+        # get the typed connection error, never a hang on a server
+        # that stopped accepting but kept old sockets open
+        for conn in self._srv.live_connections():
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass

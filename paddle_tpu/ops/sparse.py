@@ -11,14 +11,14 @@ TPU-native design: inside a compiled block a sparse gradient is a
 ``SparseRows`` pytree — rows (int32 [N]) + values ([N, D]) + static
 height — so the [V, D] dense gradient is never materialized.  The SGD
 update lowers to one XLA scatter-add; momentum, adam (ISSUE 11),
-adagrad (ISSUE 12) and rmsprop (ISSUE 14) run the reference's *lazy*
-row-subset kernels directly — duplicate ids merge by an in-domain
-scatter-add (``merge_rows``), the touched rows of param + moments
-gather to an [N, D] subset, the dense optimizer math runs there, and
-one scatter-update writes back, O(rows x D) per step with untouched
-rows' moments never decaying.  Remaining adaptive optimizers
-(ftrl/adadelta/…) fall back to ``lazy_apply``'s dense-materialize +
-mask emulation (identical semantics, O(V x D)).
+adagrad (ISSUE 12), rmsprop (ISSUE 14) and ftrl (ISSUE 17) run the
+reference's *lazy* row-subset kernels directly — duplicate ids merge
+by an in-domain scatter-add (``merge_rows``), the touched rows of
+param + moments gather to an [N, D] subset, the dense optimizer math
+runs there, and one scatter-update writes back, O(rows x D) per step
+with untouched rows' moments never decaying.  Remaining adaptive
+optimizers (adadelta/adamax/…) fall back to ``lazy_apply``'s
+dense-materialize + mask emulation (identical semantics, O(V x D)).
 
 ISSUE 12 adds the hot-row cache slab exchange kernels at the bottom:
 the two-tier embedding store's device half (one padded gather of
@@ -264,7 +264,39 @@ def _rows_rmsprop(ctx, op, g):
     ctx.set(op, 'MeanSquareOut', _scatter_rows(ms, rows, ms_new))
 
 
-# The FAST sparse lane (ISSUE 11/12/14): gather/merge/scatter
+def _rows_ftrl(ctx, op, g):
+    """Lazy row-subset ftrl (ISSUE 17 satellite; ftrl_op.cc): gather
+    the touched rows of param + squared/linear accumulators, run the
+    dense ftrl math on the [N, D] subset against the MERGED gradient,
+    scatter all three back.  FTRL re-derives the param from
+    accumulator state at every visit — a dense step with zero grad
+    still rewrites a row toward the l1-shrunk solution of its
+    accumulators — so untouched rows keeping param AND accumulators is
+    the meaningful lazy semantics here (and exactly what lazy_apply's
+    masked fallback computed, O(V x D); this kernel is O(rows x D))."""
+    p = ctx.get(op, 'Param')
+    sq = ctx.get(op, 'SquaredAccumulator')
+    lin = ctx.get(op, 'LinearAccumulator')
+    lr = jnp.reshape(ctx.get(op, 'LearningRate'), ())
+    l1 = op.attrs.get('l1', 0.0)
+    l2 = op.attrs.get('l2', 0.0)
+    lr_power = op.attrs.get('lr_power', -0.5)
+    rows, grad = merge_rows(g.rows, g.values, g.height)
+    sq_old = sq[rows]
+    sq_new = sq_old + jnp.square(grad)
+    pow_new = jnp.power(sq_new, -lr_power)
+    pow_old = jnp.power(sq_old, -lr_power)
+    lin_new = lin[rows] + grad - (pow_new - pow_old) / lr * p[rows]
+    x = l1 * jnp.sign(lin_new) - lin_new
+    y = pow_new / lr + 2 * l2
+    p_new = jnp.where(jnp.abs(lin_new) > l1, x / y,
+                      jnp.zeros_like(lin_new))
+    ctx.set(op, 'ParamOut', _scatter_rows(p, rows, p_new))
+    ctx.set(op, 'SquaredAccumOut', _scatter_rows(sq, rows, sq_new))
+    ctx.set(op, 'LinearAccumOut', _scatter_rows(lin, rows, lin_new))
+
+
+# The FAST sparse lane (ISSUE 11/12/14/17): gather/merge/scatter
 # row-subset kernels for the optimizers the reference ships
 # SelectedRows branches for.  Everything else falls back to
 # lazy_apply's dense-materialize + mask emulation (semantically
@@ -275,6 +307,7 @@ _ROW_SUBSET_APPLY = {
     'adam': _rows_adam,
     'adagrad': _rows_adagrad,
     'rmsprop': _rows_rmsprop,
+    'ftrl': _rows_ftrl,
 }
 
 

@@ -46,6 +46,15 @@ the whole stack with seeded Poisson arrivals, reporting sustained
 req/s, p50/p99/p99.9 and goodput.  README 'Serving SLOs' has the
 operator's view; tools/load_gen.py is the CLI.
 
+Fleet tier (ISSUE 17): ``ReplicaServer`` serves one registry over the
+shared RPC substrate (distributed/transport.py — typed errors, seeded
+retries, exactly-once dedup) and ``FleetRouter`` fronts N replicas
+with load-balanced dispatch, decode-session affinity (``session=``
+pins a generation's decode state to one replica), fleet-level typed
+overload, and replica-death failover that re-prefills in-flight
+generations on a survivor — token-identical under greedy decode.  See
+fleet.py and the README 'Serving fleet' section.
+
     reg = serving.ModelRegistry(hbm_budget_bytes=2 << 30)
     reg.load('ranker', '/models/ranker')
     with reg:                                  # starts every worker
@@ -62,6 +71,7 @@ from .decode import GenerationRequest, GenerationSpec, \
 from .engine import InferenceEngine, ServingConfig  # noqa: F401
 from .errors import DeadlineExceededError, EngineClosedError, \
     OverloadedError  # noqa: F401
+from .fleet import FleetFuture, FleetRouter, ReplicaServer  # noqa: F401
 from .loadgen import OpenLoopLoadGen, TrafficClass  # noqa: F401
 from .metrics import EngineMetrics  # noqa: F401
 from .profile import ServiceTimeProfile  # noqa: F401
@@ -73,4 +83,5 @@ __all__ = ['InferenceEngine', 'ServingConfig', 'MicroBatcher',
            'HBMBudgetError', 'GenerationSpec', 'GenerationRequest',
            'SlotStateCache', 'DeadlineExceededError', 'OverloadedError',
            'EngineClosedError', 'OpenLoopLoadGen', 'TrafficClass',
-           'ServiceTimeProfile']
+           'ServiceTimeProfile', 'ReplicaServer', 'FleetRouter',
+           'FleetFuture']

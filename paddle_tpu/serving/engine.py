@@ -889,6 +889,13 @@ class InferenceEngine(object):
         return {'arrival_req_s': self._arrivals.rate(),
                 'drain_req_s': self._drains.rate()}
 
+    def queue_depth(self):
+        """Current micro-batch queue depth — the cheap load gauge
+        (no metrics snapshot, no arbiter walk) the registry's
+        status() and the fleet replica's per-response load report
+        read (ISSUE 17)."""
+        return self._batcher.depth()
+
     def _shed_request(self, req, where='queue'):
         """Resolve one past-deadline request as SHED (ISSUE 8): typed
         DeadlineExceededError, a 'shed' trace stage (the seconds the

@@ -1,0 +1,670 @@
+"""Serving fleet tier (ISSUE 17): N replica registries behind a
+resilient, affinity-aware router.
+
+One ``ModelRegistry`` saturates one device mesh; a serving FLEET runs N
+replica registry processes (each its own mesh/host in production, its
+own ``ReplicaServer`` here) behind a ``FleetRouter`` that fronts one
+traffic stream.  The wire is the shared RPC substrate extracted from
+the PR-15 master transport (``distributed/transport.py``): newline-
+delimited JSON over TCP, typed in-band errors, seeded-backoff retries,
+and the client-minted ``client``+``rid`` exactly-once dedup window —
+so a replica that executed a request whose response line was lost
+REPLAYS the recorded response on retry instead of running the request
+twice.
+
+``ReplicaServer`` is the door onto one registry: ``infer`` /
+``generate`` / ``status`` / ``metrics`` over the wire, numpy arrays
+and LoDTensors codec'd losslessly (``__nd__`` / ``__lod__`` envelopes,
+dtype + shape pinned).  Every response piggybacks a load report —
+the registry's cheap ``queue_depths()`` sum — so the router's view of
+replica load refreshes on the traffic itself, no polling lane.
+Registry refusals (``OverloadedError``) cross the wire TYPED, with the
+``retry_after_s`` hint attached, and are re-minted on the client side.
+
+``FleetRouter`` dispatch:
+
+* **Balance** — replica score is ``(reported_depth + in_flight + 1) *
+  service_time_estimate``; each replica carries its own
+  ``ServiceTimeProfile`` fed by observed RPC walls, so a replica that
+  is slower (cold caches, worse bucketing) naturally receives less
+  offered load than its queue depth alone would suggest.
+* **Affinity** — a generation request with a ``session=`` key PINS the
+  replica holding its decode state (``SlotStateCache`` slots): every
+  subsequent generate on that session lands on the same replica, while
+  plain forward lots float freely to the least-loaded replica.  A
+  pinned session migrates only when its replica DIES — never for load.
+* **Overload** — a single saturated replica is routed around (its
+  typed refusal excludes it for that dispatch); when EVERY live
+  replica refuses, the router raises the fleet-level
+  ``OverloadedError`` with the smallest ``retry_after_s`` any replica
+  offered.  A pinned session's refusal is final for that request —
+  migrating decode state for load would pay a re-prefill to dodge a
+  queue.
+* **Failure** — a dead replica (connect/retry budget exhausted on the
+  resilient client) is marked and excluded; its in-flight requests are
+  re-dispatched to a survivor as NEW logical calls (fresh rid — the
+  dead replica's dedup window is gone with it).  For a generation this
+  is a RE-PREFILL: greedy decode is deterministic, so the survivor's
+  token stream is identical to what the dead replica would have
+  produced.  Chaos-tested with the seeded ``FaultInjector`` exactly
+  like PR 15's master kill: scripted lost responses exercise the dedup
+  replay, a mid-stream ``ReplicaServer.close()`` exercises failover,
+  and the gate asserts zero lost / zero duplicated responses and
+  token-identical output vs the fault-free single-registry run.
+"""
+
+import itertools
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..distributed.transport import (
+    RetryPolicy, ResilientServiceClient, ServiceServer,
+    DedupWindow, ServiceUnavailableError, ServiceProtocolError)
+from .errors import OverloadedError, DeadlineExceededError
+from .profile import ServiceTimeProfile
+
+__all__ = ['ReplicaServer', 'FleetRouter', 'FleetFuture']
+
+# router-side logical-call names that mutate replica state (claim a
+# queue slot, run a decode) — the resilient client mints a dedup rid
+# for these so a retried request is executed exactly once
+_FLEET_MUTATING = frozenset(['infer', 'generate'])
+
+
+# ---------------------------------------------------------------------------
+# wire codec — numpy arrays and LoDTensors over the JSON line protocol
+# ---------------------------------------------------------------------------
+
+def _wire_encode(v):
+    """Lossless JSON envelope for feed/fetch values: ndarray ->
+    ``__nd__`` (dtype + shape pinned — a (0, 4) empty or a float32
+    round-trips exactly), LoDTensor -> ``__lod__`` (level-of-detail
+    offsets ride along)."""
+    if isinstance(v, np.ndarray):
+        return {'__nd__': {'dtype': str(v.dtype),
+                           'shape': list(v.shape),
+                           'data': v.ravel().tolist()}}
+    if hasattr(v, 'lod') and hasattr(v, 'numpy'):  # fluid LoDTensor
+        arr = np.asarray(v.numpy())
+        return {'__lod__': {'dtype': str(arr.dtype),
+                            'shape': list(arr.shape),
+                            'data': arr.ravel().tolist(),
+                            'lod': [list(l) for l in v.lod()]}}
+    if isinstance(v, dict):
+        return {k: _wire_encode(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_wire_encode(x) for x in v]
+    if isinstance(v, (np.integer, np.floating, np.bool_)):
+        return v.item()
+    return v
+
+
+def _wire_decode(v):
+    if isinstance(v, dict):
+        if '__nd__' in v and len(v) == 1:
+            d = v['__nd__']
+            return np.asarray(d['data'], dtype=d['dtype']) \
+                .reshape(d['shape'])
+        if '__lod__' in v and len(v) == 1:
+            d = v['__lod__']
+            arr = np.asarray(d['data'], dtype=d['dtype']) \
+                .reshape(d['shape'])
+            from ..fluid import core  # lazy: codec is import-light
+            return core.LoDTensor(arr, [list(l) for l in d['lod']])
+        return {k: _wire_decode(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_wire_decode(x) for x in v]
+    return v
+
+
+def _jsonable(v):
+    """Best-effort JSON projection for status/metrics payloads (numpy
+    scalars -> python, arrays -> lists, opaque objects -> str)."""
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, (np.integer, np.floating, np.bool_)):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, (int, float, str, bool)) or v is None:
+        return v
+    return str(v)
+
+
+# ---------------------------------------------------------------------------
+# replica side
+# ---------------------------------------------------------------------------
+
+class ReplicaServer(object):
+    """One fleet replica: a ``ModelRegistry`` served over the shared
+    RPC substrate.
+
+    Methods on the wire: ``infer`` (submit + wait, outputs codec'd),
+    ``generate`` (submit_generate + wait, token ids), ``status``,
+    ``metrics``, ``load_report``.  Mutating methods arrive with
+    ``client``+``rid`` from the router's resilient clients and route
+    through a standalone ``DedupWindow`` whose critical section does
+    NOT hold the window lock while the registry runs — a long decode
+    dedups without serializing the replica (only a RETRY of that same
+    rid waits on its in-progress marker, then replays).
+
+    Registry refusals cross typed: ``OverloadedError`` becomes
+    ``{'error': ..., 'etype': 'OverloadedError', 'retry_after_s': ...}``
+    so the router can route around one hot replica and re-mint the
+    typed error when the whole fleet is saturated.  Every response
+    carries ``'load': {'depth': N}`` (sum of the registry's per-model
+    queue depths) — the router's freshness-on-traffic load feed.
+    """
+
+    def __init__(self, registry, host='127.0.0.1', port=0,
+                 fault_injector=None, result_timeout_s=120.0,
+                 dedup_window=64, dedup_clients=64):
+        self.registry = registry
+        self.fault_injector = fault_injector
+        self.result_timeout_s = float(result_timeout_s)
+        self._dedup = DedupWindow(window=dedup_window,
+                                  clients=dedup_clients)
+        self._m = {'infers': 0, 'generates': 0, 'overloads': 0}
+        self._mlock = threading.Lock()
+        self._closed = False
+        self._srv = ServiceServer(self._dispatch, host=host, port=port,
+                                  fault_injector=fault_injector,
+                                  dedup_execute=self._dedup.execute)
+        self.host, self.port = self._srv.host, self._srv.port
+
+    @property
+    def endpoint(self):
+        return self._srv.endpoint
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def _load(self):
+        try:
+            depths = self.registry.queue_depths()
+        except Exception:
+            depths = {}
+        return {'depth': int(sum(depths.values()))}
+
+    def _count(self, key):
+        with self._mlock:
+            self._m[key] += 1
+
+    def _dispatch(self, method, req):
+        try:
+            if method == 'infer':
+                fut = self.registry.submit(
+                    req['model'], _wire_decode(req['feed']),
+                    priority=int(req.get('priority') or 0),
+                    deadline_ms=req.get('deadline_ms'))
+                outs = fut.result(
+                    timeout=req.get('timeout') or self.result_timeout_s)
+                self._count('infers')
+                return {'outputs': [_wire_encode(np.asarray(o))
+                                    for o in outs],
+                        'load': self._load()}
+            if method == 'generate':
+                fut = self.registry.submit_generate(
+                    req['model'], _wire_decode(req['feed']),
+                    max_len=req.get('max_len'),
+                    priority=int(req.get('priority') or 0),
+                    deadline_ms=req.get('deadline_ms'))
+                tokens = fut.result(
+                    timeout=req.get('timeout') or self.result_timeout_s)
+                self._count('generates')
+                return {'tokens': [int(t) for t in
+                                   np.asarray(tokens).ravel()],
+                        'load': self._load()}
+        except OverloadedError as e:
+            # typed refusal, recorded by the dedup window like any
+            # response — a replayed refusal is still a refusal
+            self._count('overloads')
+            return {'error': str(e), 'etype': 'OverloadedError',
+                    'model': e.model,
+                    'queue_depth': int(e.queue_depth),
+                    'retry_after_s': float(e.retry_after_s),
+                    'load': self._load()}
+        except DeadlineExceededError as e:
+            return {'error': str(e), 'etype': 'DeadlineExceededError',
+                    'deadline_ms': e.deadline_ms,
+                    'late_by_ms': e.late_by_ms,
+                    'load': self._load()}
+        if method == 'status':
+            return {'status': _jsonable(self.registry.status()),
+                    'load': self._load()}
+        if method == 'metrics':
+            with self._mlock:
+                served = dict(self._m)
+            served['dedup_replays'] = self._dedup.replays
+            return {'metrics': _jsonable(self.registry.metrics()),
+                    'served': served, 'load': self._load()}
+        if method == 'load_report':
+            return {'load': self._load()}
+        return {'error': 'unknown method %r' % method,
+                'etype': 'ValueError'}
+
+    def close(self):
+        """Stop serving (the chaos harness's replica kill — the
+        registry itself is owned by the caller and stays up)."""
+        if not self._closed:
+            self._closed = True
+            self._srv.close()
+
+
+# ---------------------------------------------------------------------------
+# router side
+# ---------------------------------------------------------------------------
+
+class FleetFuture(object):
+    """Handle for one fleet request.  Satisfies the loadgen future
+    contract: ``result(timeout)``, ``latency_s`` (set on success),
+    ``breakdown()``.  ``replica`` is the index that ultimately served
+    the request (after any failover)."""
+
+    def __init__(self, kind, model):
+        self.kind = kind
+        self.model = model
+        self.replica = None
+        self.latency_s = None
+        self.redispatches = 0
+        self._done = threading.Event()
+        self._result = None
+        self._exc = None
+        self._t0 = time.time()
+
+    def _finish(self, result=None, exc=None):
+        self._result, self._exc = result, exc
+        if exc is None:
+            self.latency_s = time.time() - self._t0
+        self._done.set()
+
+    def done(self):
+        return self._done.is_set()
+
+    def result(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                'fleet %s request not done within %r s'
+                % (self.kind, timeout))
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def breakdown(self):
+        return {'replica': self.replica,
+                'redispatches': self.redispatches,
+                'latency_s': self.latency_s}
+
+
+class _Replica(object):
+    """Router-side state for one replica endpoint: liveness, the
+    piggybacked load report, a service-time profile fed by observed
+    RPC walls, and an idle-client pool (one resilient client is one
+    socket with strict request/response framing — concurrent
+    dispatches each check out their own)."""
+
+    def __init__(self, idx, endpoint):
+        self.idx = idx
+        self.endpoint = endpoint
+        self.dead = False
+        self.death_reason = None
+        self.reported_depth = 0
+        self.inflight = 0
+        self.dispatches = 0
+        self.overloads = 0
+        self.profile = ServiceTimeProfile()
+        self._idle = []
+        self._serial = itertools.count()
+        self.lock = threading.Lock()
+
+    def checkout(self, make_client):
+        with self.lock:
+            if self._idle:
+                return self._idle.pop()
+            serial = next(self._serial)
+        return make_client(self, serial)
+
+    def checkin(self, client):
+        with self.lock:
+            if not self.dead and not client.closed:
+                self._idle.append(client)
+                return
+        client.close()
+
+    def drain(self):
+        with self.lock:
+            idle, self._idle = self._idle, []
+        for c in idle:
+            c.close()
+
+
+class FleetRouter(object):
+    """Replica-aware dispatch over a fleet of ``ReplicaServer``
+    endpoints — load-balanced, session-affine, overload-typed,
+    failure-riding.  See the module docstring for the policy; the
+    surface mirrors ``ModelRegistry`` (``submit`` / ``infer`` /
+    ``submit_generate`` / ``generate`` / ``status`` / ``metrics``) so
+    the load generator and callers target either interchangeably.
+
+    ``replicas`` may be ``ReplicaServer`` instances or ``host:port``
+    endpoint strings.  ``fault_injectors`` optionally maps replica
+    index -> ``FaultInjector`` wired into that replica's CLIENT-side
+    sites (``client_send``/``client_recv``) for chaos runs.
+    """
+
+    def __init__(self, replicas, retry=None, timeout=120.0,
+                 max_workers=16, client_id=None,
+                 fault_injectors=None, session_log_bound=256):
+        if not replicas:
+            raise ValueError('FleetRouter: need at least one replica')
+        endpoints = [r.endpoint if hasattr(r, 'endpoint') else str(r)
+                     for r in replicas]
+        self._replicas = [_Replica(i, ep)
+                          for i, ep in enumerate(endpoints)]
+        self._retry = retry or RetryPolicy()
+        self._timeout = float(timeout)
+        self._client_id = client_id or ('fleet-%06x' % (id(self) & 0xffffff))
+        self._fault_injectors = dict(fault_injectors or {})
+        self._lock = threading.Lock()
+        self._affinity = {}            # session -> replica idx
+        self._session_log = OrderedDict()  # session -> [idx, ...]
+        self._session_log_bound = int(session_log_bound)
+        self._m = {'dispatches': 0, 'failovers': 0, 're_prefills': 0,
+                   'replica_deaths': 0, 'fleet_overloads': 0,
+                   'routed_around_overload': 0}
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers,
+            thread_name_prefix='fleet-router')
+        self._closed = False
+
+    # -- client plumbing ---------------------------------------------------
+
+    def _make_client(self, rep, serial):
+        t = self._retry
+        retry = RetryPolicy(
+            max_attempts=t.max_attempts, base_backoff_s=t.base_backoff_s,
+            max_backoff_s=t.max_backoff_s, deadline_s=t.deadline_s,
+            jitter=t.jitter, seed=t.seed + 1009 * rep.idx + serial)
+        return ResilientServiceClient(
+            [rep.endpoint], retry=retry, timeout=self._timeout,
+            fault_injector=self._fault_injectors.get(rep.idx),
+            client_id='%s-r%d-c%d' % (self._client_id, rep.idx, serial),
+            mutating=_FLEET_MUTATING, service='replica')
+
+    def _rpc(self, rep, method, **kw):
+        cli = rep.checkout(self._make_client)
+        try:
+            resp = cli.call(method, **kw)
+        except ServiceUnavailableError:
+            cli.close()
+            raise
+        except ServiceProtocolError:
+            rep.checkin(cli)  # in-band refusal; the socket is fine
+            raise
+        rep.checkin(cli)
+        return resp
+
+    # -- dispatch policy ---------------------------------------------------
+
+    def _score(self, rep, sig):
+        est = rep.profile.estimate(sig)
+        if est is None:
+            # optimistic start: an unprobed (replica, signature) pair
+            # scores best-possible, so every replica gets explored
+            # before measured estimates drive the balance — otherwise
+            # the first-probed replica's sub-millisecond estimate
+            # beats the fallback forever and monopolizes the stream
+            est = rep.profile.floor() or 1e-4
+        return (rep.reported_depth + rep.inflight + 1) * max(est, 1e-4)
+
+    def _pick(self, kind, model, session, exclude):
+        """Choose a replica under the router lock.  Returns
+        (replica, pinned): pinned=True means session affinity chose it
+        (an overload there is final, not routed around)."""
+        sig = (kind, model)
+        affine = session is not None and kind == 'generate'
+        with self._lock:
+            rep, pinned = None, False
+            if affine:
+                idx = self._affinity.get(session)
+                if idx is not None:
+                    cand = self._replicas[idx]
+                    if not cand.dead and idx not in exclude:
+                        rep, pinned = cand, True
+                    # else: pinned replica is gone — re-pin below
+            if rep is None:
+                best, best_score = None, None
+                for r in self._replicas:
+                    if r.dead or r.idx in exclude:
+                        continue
+                    score = self._score(r, sig)
+                    if best is None or score < best_score or \
+                            (score == best_score
+                             and r.dispatches < best.dispatches):
+                        best, best_score = r, score
+                rep = best
+                if rep is not None and affine:
+                    self._affinity[session] = rep.idx
+            if rep is not None:
+                rep.inflight += 1
+                rep.dispatches += 1
+                self._m['dispatches'] += 1
+                if affine:
+                    log = self._session_log.get(session)
+                    if log is None:
+                        while len(self._session_log) >= \
+                                self._session_log_bound:
+                            self._session_log.popitem(last=False)
+                        log = self._session_log[session] = []
+                    log.append(rep.idx)
+            return rep, pinned
+
+    def _mark_dead(self, rep, reason):
+        with self._lock:
+            first = not rep.dead
+            rep.dead = True
+            rep.death_reason = str(reason)
+            if first:
+                self._m['replica_deaths'] += 1
+        rep.drain()
+
+    def _observe(self, rep, sig, wall_s, resp):
+        rep.profile.observe(sig, wall_s)
+        load = resp.get('load')
+        if isinstance(load, dict) and 'depth' in load:
+            rep.reported_depth = int(load['depth'])
+
+    def _overload_from(self, model, resp):
+        return OverloadedError(
+            model, int(resp.get('queue_depth') or 0), 0.0,
+            float(resp.get('retry_after_s') or 0.05))
+
+    def _dispatch(self, fut, kind, model, payload, session):
+        """Worker: route one logical request to completion — balance,
+        route around single-replica overload, fail over on replica
+        death (re-dispatch = fresh rid on a survivor; for a generate
+        that's the deterministic re-prefill)."""
+        sig = (kind, model)
+        overloaded = {}    # idx -> retry_after_s hint
+        dead_tried = set()
+        while True:
+            rep, pinned = self._pick(
+                kind, model, session,
+                exclude=set(overloaded) | dead_tried)
+            if rep is None:
+                with self._lock:
+                    alive = [r for r in self._replicas if not r.dead]
+                if alive and overloaded:
+                    with self._lock:
+                        self._m['fleet_overloads'] += 1
+                    depth = max(r.reported_depth for r in alive)
+                    raise OverloadedError(model, depth, 0.0,
+                                          min(overloaded.values()))
+                with self._lock:
+                    n_dead = len(dead_tried | {
+                        r.idx for r in self._replicas if r.dead})
+                raise ServiceUnavailableError(
+                    'no live fleet replica for %r (%d dead)'
+                    % (model, n_dead))
+            fut.replica = rep.idx
+            t0 = time.time()
+            try:
+                resp = self._rpc(rep, kind, model=model, **payload)
+            except ServiceUnavailableError as e:
+                self._mark_dead(rep, e)
+                dead_tried.add(rep.idx)
+                fut.redispatches += 1
+                with self._lock:
+                    self._m['failovers'] += 1
+                    if kind == 'generate':
+                        self._m['re_prefills'] += 1
+                    if session is not None and \
+                            self._affinity.get(session) == rep.idx:
+                        del self._affinity[session]
+                continue
+            except ServiceProtocolError as e:
+                etype = (getattr(e, 'resp', None) or {}).get('etype')
+                if etype == 'OverloadedError':
+                    with self._lock:
+                        rep.overloads += 1
+                    if pinned:
+                        # the pinned replica's refusal is final: decode
+                        # state doesn't migrate for load
+                        raise self._overload_from(model, e.resp)
+                    overloaded[rep.idx] = float(
+                        e.resp.get('retry_after_s') or 0.05)
+                    with self._lock:
+                        self._m['routed_around_overload'] += 1
+                    continue
+                if etype == 'DeadlineExceededError':
+                    r = e.resp
+                    raise DeadlineExceededError(
+                        deadline_ms=r.get('deadline_ms'),
+                        late_by_ms=r.get('late_by_ms'),
+                        where='fleet') from e
+                raise
+            finally:
+                with self._lock:
+                    rep.inflight -= 1
+            self._observe(rep, sig, time.time() - t0, resp)
+            if kind == 'infer':
+                return [_wire_decode(o) for o in resp['outputs']]
+            return np.asarray(resp['tokens'], dtype=np.int64)
+
+    def _submit(self, kind, model, payload, session):
+        if self._closed:
+            raise RuntimeError('FleetRouter is closed')
+        fut = FleetFuture(kind, model)
+
+        def worker():
+            try:
+                res = self._dispatch(fut, kind, model, payload, session)
+            except BaseException as e:
+                fut._finish(exc=e)
+            else:
+                fut._finish(result=res)
+
+        self._pool.submit(worker)
+        return fut
+
+    # -- public surface (mirrors ModelRegistry) ---------------------------
+
+    def submit(self, model, feed, return_numpy=True, priority=0,
+               deadline_ms=None, timeout=None):
+        """Async forward: returns a ``FleetFuture`` resolving to the
+        list of output arrays.  Forward lots float freely — each
+        dispatch picks the best-scored live replica."""
+        if not return_numpy:
+            raise ValueError('FleetRouter.submit: outputs cross a '
+                             'wire — return_numpy=False unsupported')
+        payload = {'feed': _wire_encode(feed), 'priority': int(priority),
+                   'deadline_ms': deadline_ms,
+                   'timeout': timeout or self._timeout}
+        return self._submit('infer', model, payload, session=None)
+
+    def infer(self, model, feed, return_numpy=True, timeout=None):
+        return self.submit(model, feed, return_numpy=return_numpy,
+                           timeout=timeout).result(
+                               timeout or self._timeout)
+
+    def submit_generate(self, model, feed, max_len=None, priority=0,
+                        deadline_ms=None, timeout=None, session=None):
+        """Async generation: resolves to the int64 token-id array.
+        ``session`` pins all generates sharing the key to one replica
+        (the decode-state affinity); omitted, each generate floats."""
+        payload = {'feed': _wire_encode(feed), 'max_len': max_len,
+                   'priority': int(priority),
+                   'deadline_ms': deadline_ms,
+                   'timeout': timeout or self._timeout}
+        return self._submit('generate', model, payload, session=session)
+
+    def generate(self, model, feed, max_len=None, timeout=None,
+                 session=None):
+        return self.submit_generate(model, feed, max_len=max_len,
+                                    timeout=timeout,
+                                    session=session).result(
+                                        timeout or self._timeout)
+
+    def status(self):
+        """Fleet status: per-replica liveness + the replica's own
+        ``status()`` fetched over the wire for live replicas."""
+        out = {}
+        for rep in self._replicas:
+            if rep.dead:
+                out[rep.idx] = {'dead': True,
+                                'reason': rep.death_reason}
+                continue
+            try:
+                resp = self._rpc(rep, 'status')
+            except ServiceUnavailableError as e:
+                self._mark_dead(rep, e)
+                out[rep.idx] = {'dead': True, 'reason': str(e)}
+                continue
+            out[rep.idx] = {'dead': False,
+                            'depth': resp['load']['depth'],
+                            'status': resp['status']}
+        return out
+
+    def metrics(self):
+        """Router-local counters — no RPCs.  ``replicas`` carries the
+        per-replica dispatch/overload/liveness view the perf gate's
+        affinity and failover asserts read."""
+        with self._lock:
+            m = dict(self._m)
+            m['replicas'] = {
+                rep.idx: {'endpoint': rep.endpoint, 'dead': rep.dead,
+                          'dispatches': rep.dispatches,
+                          'overloads': rep.overloads,
+                          'reported_depth': rep.reported_depth}
+                for rep in self._replicas}
+            m['sessions'] = len(self._affinity)
+        return m
+
+    def session_dispatches(self):
+        """Per-session dispatch log (bounded): session -> ordered list
+        of replica indices its generates were dispatched to.  The
+        structural affinity assert: fault-free, each list holds ONE
+        distinct index; with one replica kill, at most two."""
+        with self._lock:
+            return {s: list(log)
+                    for s, log in self._session_log.items()}
+
+    def close(self):
+        self._closed = True
+        for rep in self._replicas:
+            rep.drain()
+        self._pool.shutdown(wait=False)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -939,13 +939,22 @@ class ModelRegistry(object):
                     'hbm_bytes': acct.get('bytes', 0),
                     'account_source': acct.get('source'),
                     'device_footprint': entry.engine.device_footprint(),
-                    'queue_depth': entry.engine._batcher.depth(),
+                    'queue_depth': entry.engine.queue_depth(),
                     'requests': entry.requests,
                     'rows': entry.rows,
                     'dirname': entry.dirname,
                     'parallel': entry.engine._pe is not None,
                 }
             return out
+
+    def queue_depths(self):
+        """Cheap per-model queue depths — the fleet replica's
+        per-response load report (ISSUE 17): no arbiter snapshot, no
+        device-footprint walk, just each engine's batcher depth."""
+        with self._lock:
+            entries = dict(self._models)
+        return {name: entry.engine.queue_depth()
+                for name, entry in entries.items()}
 
     def metrics(self):
         """Router + arbiter + per-model engine snapshots (this is what

@@ -12,6 +12,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, 'bench.py')
 sys.path.insert(0, REPO)  # for `from bench import CONFIGS` (no jax)
@@ -82,7 +84,11 @@ def test_incremental_lines_are_each_driver_parseable():
     assert len(rec['configs']) == 1
 
 
+@pytest.mark.slow
 def test_single_config_child_runs_cpu():
+    # slow-marked (~12 s subprocess soak): the child-isolation
+    # contract keeps tier-1 coverage via
+    # test_every_config_flushes_and_timeouts_are_isolated
     """The cheapest config end-to-end on CPU through the child entry."""
     env = dict(os.environ)
     env.pop('XLA_FLAGS', None)
@@ -311,10 +317,14 @@ def test_cost_mfu_and_trace_overhead_wired():
     assert 'tracing()' in inspect.getsource(perf_gate.build_trace_overhead)
 
 
+@pytest.mark.slow
 def test_nmt_cpu_smoke_is_device_true():
     """The cheapest flagship config end-to-end in-process (tiny CPU
     dims): the record must carry the multi-step dispatch contract AND
-    the functional feed_overlap block (the pipeline really ran)."""
+    the functional feed_overlap block (the pipeline really ran).
+    Slow-marked: ~40 s of wall, the single heaviest test in the
+    suite — the tier-1 window keeps the subprocess-contract tests
+    while this in-process soak rides the slow lane."""
     import bench
     rec = bench.bench_nmt(False)
     assert rec['value'] > 0
@@ -380,7 +390,11 @@ def test_ctr_config_wired_sharded_sparse():
         inspect.getsource(bench.run_one)
 
 
+@pytest.mark.slow
 def test_ctr_cpu_smoke_trains_and_serves():
+    # slow-marked (~11 s in-process soak): the ctr bench contract
+    # keeps tier-1 coverage via tests/test_sparse.py's train/serve
+    # lanes
     """The ISSUE 11 acceptance, functionally in-process on the suite's
     8-dev virtual mesh: bench_ctr trains device-true with a row-sharded
     table (sparse lane end to end), serves id-batches through the

@@ -94,6 +94,53 @@ def test_checkpoint_writer_error_surfaces(tmp_path):
     store.close()
 
 
+def test_checkpoint_cross_job_gc_spares_live_jobs(tmp_path):
+    """Cross-job retention (ISSUE 17 satellite): gc(root, keep_jobs=)
+    removes only DEAD job dirs beyond the bound, oldest-manifest
+    first.  A LIVE job (open store, ACTIVE marker present) is never
+    touched — its manifests survive byte-for-byte — and neither is a
+    dir that isn't a checkpoint store at all."""
+    root = str(tmp_path)
+    dirs = {n: os.path.join(root, n) for n in 'abcd'}
+    stores = {}
+    for i, n in enumerate('abcd'):
+        stores[n] = AsyncShardedCheckpoint(dirs[n], keep=2, sync=True)
+        stores[n].save(10 + i, _arrays(i), wait=True)
+        # pin distinct manifest mtimes: a oldest ... d newest
+        t = 1_000_000_000 + 100 * i
+        os.utime(os.path.join(
+            dirs[n], 'MANIFEST-%012d.json' % (10 + i)), (t, t))
+    for n in 'bcd':
+        stores[n].close()           # dead jobs; 'a' stays live
+    os.makedirs(os.path.join(root, 'misc'))
+    with open(os.path.join(root, 'misc', 'notes.txt'), 'w') as f:
+        f.write('not a checkpoint dir')
+    before_a = sorted(os.listdir(dirs['a']))
+
+    removed = AsyncShardedCheckpoint.gc(root, keep_jobs=1)
+    # dead jobs b, c pruned (oldest first); newest dead d kept
+    assert removed == [dirs['b'], dirs['c']]
+    assert not os.path.exists(dirs['b'])
+    assert sorted(os.listdir(dirs['a'])) == before_a  # live: untouched
+    assert os.path.exists(os.path.join(root, 'misc', 'notes.txt'))
+    # the surviving dead job still loads (reopening re-marks it live,
+    # so close again before the final sweep)
+    reopened = AsyncShardedCheckpoint(dirs['d'], keep=2, sync=True)
+    step, arrays, _ = reopened.load()
+    assert step == 13
+    np.testing.assert_array_equal(arrays['w'], _arrays(3)['w'])
+    reopened.close()
+    # the live store keeps working after gc, then counts as dead once
+    # closed
+    stores['a'].save(20, _arrays(9), wait=True)
+    stores['a'].close()
+    with pytest.raises(ValueError, match='keep_jobs'):
+        AsyncShardedCheckpoint.gc(root, keep_jobs=-1)
+    removed2 = AsyncShardedCheckpoint.gc(root, keep_jobs=0)
+    assert dirs['a'] in removed2
+    assert sorted(os.listdir(root)) == ['misc']
+
+
 # ---------------------------------------------------------------------
 # ElasticTrainJob
 # ---------------------------------------------------------------------

@@ -178,7 +178,12 @@ def _train_cpu(cached, opt_fn, feeds, k=4):
     return table, params, aux, metrics
 
 
-@pytest.mark.parametrize('opt_name', sorted(_OPTS))
+# momentum/adagrad ride the slow lane (~11 s combined): sgd keeps the
+# plain-accumulator bitwise class in tier-1 and adam the moment-carrying
+# class — the full family still runs under `-m slow` and on hardware
+@pytest.mark.parametrize('opt_name', [
+    pytest.param(n, marks=pytest.mark.slow)
+    if n in ('momentum', 'adagrad') else n for n in sorted(_OPTS)])
 def test_cached_train_parity_cpu(opt_name):
     """Cached-vs-full-table multi-dispatch training over one skewed
     stream: the flushed host master must equal the full-table result —
@@ -363,12 +368,12 @@ def test_generation_engine_rejects_embed_caches():
 
 
 def test_uncovered_optimizer_typed_reject():
-    """An optimizer with no row-subset kernel (ftrl here — rmsprop
-    gained its kernel in ISSUE 14) would fall back to the lazy-dense
-    [V, D] materialization against the [C, D] slab — an opaque jit
-    shape crash.  The cache rejects the combination typed, at
-    construction."""
-    m, scope = _build(fluid.optimizer.Ftrl(learning_rate=0.05))
+    """An optimizer with no row-subset kernel (adadelta here — rmsprop
+    gained its kernel in ISSUE 14, ftrl in ISSUE 17) would fall back
+    to the lazy-dense [V, D] materialization against the [C, D] slab —
+    an opaque jit shape crash.  The cache rejects the combination
+    typed, at construction."""
+    m, scope = _build(fluid.optimizer.Adadelta(learning_rate=0.05))
     with pytest.raises(ValueError, match='row-subset'):
         CachedEmbeddingTable.from_scope(scope, m['main'],
                                         'ctr_embedding', CAP,

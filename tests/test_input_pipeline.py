@@ -563,7 +563,10 @@ def test_push_back_is_dropped_across_reset():
     rd.reset()
 
 
+@pytest.mark.slow
 def test_pipeline_close_mid_drain_stops_consuming_the_reader():
+    # slow-marked (~11 s of deliberate drain sleeps): rides the slow
+    # lane so tier-1 holds its wall-clock budget
     """Breaking out of the pipeline early must stop the staging thread
     BETWEEN pops: after close(), at most the one in-flight pop
     completes — the thread must not keep draining the reader until its

@@ -209,7 +209,10 @@ def test_maxout_and_cmrnorm_image_path():
     assert np.isfinite(vals).all()
 
 
+@pytest.mark.slow
 def test_vgg_16_network_builds_and_runs():
+    # slow-marked (~6 s compile soak): the conv/pool breadth is
+    # covered by the cheaper networks in this module
     """The reference's flagship preset, on a 32x32 input."""
     tch.settings(batch_size=2, learning_rate=0.01)
     img = tch.data_layer(name='img', size=3 * 32 * 32)

@@ -11,11 +11,15 @@ import time
 
 import pytest
 
-from paddle_tpu.distributed import (FaultInjector, Master,
+from paddle_tpu.distributed import (DedupWindow, FaultInjector, Master,
                                     MasterClient, MasterProtocolError,
                                     MasterServer,
                                     MasterUnavailableError,
-                                    ResilientMasterClient, RetryPolicy)
+                                    ResilientMasterClient,
+                                    ResilientServiceClient, RetryPolicy,
+                                    ServiceProtocolError, ServiceServer,
+                                    ServiceUnavailableError)
+from paddle_tpu.distributed.transport import error_from_response
 
 
 def _seed_tasks(master, n, start=0):
@@ -309,3 +313,108 @@ def test_fault_injector_schedule_validation_and_log():
     seq_b = [b.check('client_send', 'x') is not None
              for _ in range(50)]
     assert seq_a == seq_b and any(seq_a) and not all(seq_a)
+
+
+# ---------------------------------------------------------------------
+# service-agnostic substrate (ISSUE 17): the same retry/failover/dedup
+# machinery behind a toy NON-master service
+# ---------------------------------------------------------------------
+
+class _Counter(object):
+    """Toy service: ``bump`` mutates (the exactly-once probe),
+    ``value`` reads, ``boom`` raises server-side."""
+
+    def __init__(self):
+        self.n = 0
+        self.bumps = 0
+
+    def dispatch(self, method, req):
+        if method == 'bump':
+            self.bumps += 1
+            self.n += int(req.get('by', 1))
+            return {'n': self.n}
+        if method == 'value':
+            return {'n': self.n}
+        if method == 'boom':
+            raise KeyError('kaput')
+        return {'error': 'unknown method %r' % method,
+                'etype': 'ValueError'}
+
+
+def test_generic_service_dedups_mutations_exactly_once():
+    """A service that is NOT the master gets the wire-level
+    exactly-once contract from the substrate alone: client-minted rid
+    + standalone DedupWindow — a dropped bump response is retried and
+    REPLAYED, not re-executed."""
+    c = _Counter()
+    dw = DedupWindow(window=8, clients=4)
+    fi = FaultInjector(seed=1)
+    fi.script('server_send', 'bump', 'drop_response', nth=1)
+    srv = ServiceServer(c.dispatch, fault_injector=fi,
+                        dedup_execute=dw.execute)
+    try:
+        cli = ResilientServiceClient(
+            [srv.endpoint],
+            retry=RetryPolicy(max_attempts=4, base_backoff_s=0.01,
+                              deadline_s=10.0, seed=0), timeout=0.4,
+            mutating=('bump', ), service='counter')
+        assert cli.call('bump', by=5)['n'] == 5
+        assert c.bumps == 1 and c.n == 5  # executed ONCE
+        assert dw.replays == 1
+        assert cli.metrics()['retries'] >= 1
+        assert fi.applied == 1
+        assert cli.call('value')['n'] == 5
+        cli.close()
+    finally:
+        srv.close()
+
+
+def test_generic_service_failover_and_typed_errors():
+    """Endpoint failover and the typed taxonomy, service-agnostic:
+    transport death is ServiceUnavailableError naming the SERVICE,
+    in-band refusals are ServiceProtocolError with the raw response
+    (and its wire etype) attached."""
+    a, b = _Counter(), _Counter()
+    s1, s2 = ServiceServer(a.dispatch), ServiceServer(b.dispatch)
+    try:
+        cli = ResilientServiceClient(
+            [s1.endpoint, s2.endpoint],
+            retry=RetryPolicy(max_attempts=6, base_backoff_s=0.01,
+                              deadline_s=10.0, seed=0), timeout=0.5,
+            mutating=('bump', ), service='kv')
+        assert cli.call('bump')['n'] == 1  # primary
+        s1.close()
+        assert cli.call('value')['n'] == 0  # the survivor's state
+        assert cli.metrics()['failovers'] == 1
+        assert cli.metrics()['endpoint'] == s2.endpoint
+        with pytest.raises(ServiceProtocolError) as ei:
+            cli.call('boom')
+        assert ei.value.resp.get('etype') == 'KeyError'
+        cli.close()
+        s2.close()
+        # both endpoints down: transient, message names the service
+        cli2 = ResilientServiceClient(
+            [s1.endpoint, s2.endpoint],
+            retry=RetryPolicy(max_attempts=2, base_backoff_s=0.01,
+                              deadline_s=2.0, seed=0), timeout=0.3,
+            service='kv')
+        with pytest.raises(ServiceUnavailableError, match='kv'):
+            cli2.call('value')
+        cli2.close()
+    finally:
+        s1.close()
+        s2.close()
+
+
+def test_master_error_names_alias_the_service_taxonomy():
+    """Back-compat pin: the master-specific error names ARE the
+    service-level classes — every legacy except/isinstance site keeps
+    matching errors raised by the generic substrate."""
+    assert MasterUnavailableError is ServiceUnavailableError
+    assert MasterProtocolError is ServiceProtocolError
+    assert issubclass(ServiceUnavailableError, ConnectionError)
+    assert issubclass(ServiceProtocolError, RuntimeError)
+    e = error_from_response({'error': 'nope', 'etype': 'ValueError'},
+                            service='kv')
+    assert isinstance(e, ServiceProtocolError)
+    assert e.resp['etype'] == 'ValueError' and 'kv' in str(e)

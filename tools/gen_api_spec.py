@@ -114,7 +114,9 @@ def generate():
     # cache lives here next to its AsyncSparseEmbedding host tier;
     # ISSUE 13: the elastic job + its checkpoint store and the master's
     # membership/snapshot doors; ISSUE 15: the resilient transport
-    # lane + the fault-injection seam + snapshot replication)
+    # lane + the fault-injection seam + snapshot replication; ISSUE 17:
+    # the transport generalized into a service-agnostic substrate —
+    # the Master* error names are back-compat aliases)
     import paddle_tpu.distributed as distributed
     lines += _walk('paddle_tpu.distributed', distributed, [
         'AsyncSparseEmbedding', 'AsyncSparseClosedError',
@@ -123,8 +125,10 @@ def generate():
         'ElasticTrainJob', 'AsyncShardedCheckpoint',
         'CheckpointWriteError', 'ElasticJobError',
         'Master', 'MasterServer', 'MasterClient',
-        'ResilientMasterClient', 'RetryPolicy',
+        'ResilientMasterClient', 'ResilientServiceClient',
+        'RetryPolicy', 'ServiceServer', 'DedupWindow',
         'MasterUnavailableError', 'MasterProtocolError',
+        'ServiceUnavailableError', 'ServiceProtocolError',
         'FaultInjector', 'InjectedFault', 'SnapshotReplica',
     ])
     return sorted(set(lines))

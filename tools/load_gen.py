@@ -16,6 +16,14 @@ the offered window and HOST-SYNCS-PER-TOKEN (device-idling host round
 trips the chained decode lane avoids; compare --decode-depth 1 vs 2
 to see the pipelining win under open-loop load).
 
+Fleet (ISSUE 17): ``--replicas N`` serves the SAME offered stream
+through N replica registries behind ``serving.ReplicaServer`` +
+``serving.FleetRouter`` (the resilient, affinity-aware fleet tier) —
+the report gains a ``fleet`` block with the router's dispatch /
+failover / overload counters and one per-replica block each carrying
+that replica's registry view.  Synthetic forward + generate traffic
+only (``--model-dir`` and ``--ctr-frac`` stay single-registry).
+
 Overload retries (ISSUE 15): ``--retry-overloaded`` honors the typed
 ``OverloadedError``'s ``retry_after_s`` hint — ONE seeded re-submit
 per rejected request, fired between arrivals so the offered stream's
@@ -115,6 +123,157 @@ def _build_synthetic(seed, dim=16, classes=64):
     return prog.clone(for_test=True), pred, scope, place
 
 
+def _run_fleet(args):
+    """--replicas N (ISSUE 17): N replica registries — identical
+    synthetic weights (same build seeds) — behind ReplicaServer +
+    FleetRouter, serving ONE offered stream.  The report keeps the
+    loadgen surface (goodput, percentiles, shed/overload counts) and
+    gains ``fleet`` (router dispatch/failover/overload counters, per-
+    replica dispatch shares) plus one block per replica with that
+    registry's own overload/queue view."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import serving
+
+    if args.model_dir:
+        raise SystemExit('--replicas serves the synthetic fleet; '
+                         '--model-dir is single-registry only')
+    if args.ctr_frac > 0:
+        raise SystemExit('--replicas does not combine with --ctr-frac '
+                         '(the ctr report block reads single-registry '
+                         'engine internals)')
+
+    def _mk_cfg(**extra):
+        return serving.ServingConfig(
+            max_batch_size=args.max_batch,
+            max_wait_ms=args.max_wait_ms,
+            scheduling=args.scheduling,
+            admit_queue_depth=args.admit_depth,
+            admit_queue_age_ms=args.admit_age_ms, **extra)
+
+    dim = 16
+    names = ['syn%d' % i for i in range(max(args.models, 1))]
+    gen_names = []
+    regs = []
+    for _ in range(args.replicas):
+        reg = serving.ModelRegistry(config=_mk_cfg())
+        for i, name in enumerate(names):
+            # same seed per model across replicas: identical weights,
+            # so any replica answers any request identically
+            prog, pred, scope, _ = _build_synthetic(seed=i + 1, dim=dim)
+            reg.load(name, program=prog, feed_names=['x'],
+                     fetch_list=[pred], scope=scope)
+        regs.append(reg)
+
+    def feed_fn(rng, _dim=dim):
+        return {'x': rng.rand(args.rows, args.seq,
+                              _dim).astype('float32')}
+
+    gen_feed_fn = None
+    if args.generate_frac > 0:
+        if not (0.0 < args.generate_frac < 1.0):
+            raise SystemExit('--generate-frac must be in (0, 1)')
+        for reg in regs:
+            gm, gspec, gscope = _build_generation(
+                seed=args.seed + 1, max_len=args.gen_max_len,
+                chunk=args.gen_chunk)
+            reg.load('gen0', program=gm['prefill'],
+                     feed_names=gm['prefill_feeds'],
+                     fetch_list=gm['prefill_fetches'], scope=gscope,
+                     generation=gspec, config=_mk_cfg(
+                         decode_pipeline_depth=args.decode_depth,
+                         prefill_chunk=(gspec.chunk_width
+                                        if args.gen_chunk is not None
+                                        else None)))
+        gen_names.append('gen0')
+        lo = 3
+        hi = (max(args.gen_prompt_len, lo + 1)
+              if args.gen_prompt_len is not None else 9)
+
+        def gen_feed_fn(rng, _lo=lo, _hi=hi):
+            l = int(rng.randint(_lo, _hi + 1))
+            return {'src_word_id': fluid.create_lod_tensor(
+                rng.randint(2, 50, size=(l, 1)).tolist(), [[l]])}
+
+    classes = []
+    fwd_weight = max(1.0 - args.generate_frac, 1e-6) / len(names)
+    for name in names:
+        if args.priority_frac > 0:
+            classes.append(serving.TrafficClass(
+                feed_fn, model=name,
+                weight=fwd_weight * args.priority_frac,
+                deadline_ms=args.deadline_ms, priority=1,
+                name=name + ':p1'))
+        classes.append(serving.TrafficClass(
+            feed_fn, model=name,
+            weight=fwd_weight * max(1.0 - args.priority_frac, 1e-6),
+            deadline_ms=args.deadline_ms, priority=0,
+            name=name + ':p0'))
+    for name in gen_names:
+        classes.append(serving.TrafficClass(
+            gen_feed_fn, model=name, kind='generate',
+            weight=args.generate_frac, max_len=args.gen_max_len,
+            deadline_ms=args.deadline_ms, name=name + ':generate'))
+
+    servers, router = [], None
+    try:
+        rng = np.random.RandomState(args.seed)
+        for reg in regs:
+            reg.start()
+            # warm every replica's serving signatures DIRECTLY (the
+            # router would only warm whichever replica it picked)
+            for name in names:
+                reg.infer(name, feed_fn(rng), timeout=600)
+            for name in gen_names:
+                reg.generate(name, gen_feed_fn(rng), timeout=600)
+        servers = [serving.ReplicaServer(reg) for reg in regs]
+        router = serving.FleetRouter(servers, timeout=600.0)
+        t0 = time.time()
+        burst = [router.submit(names[i % len(names)], feed_fn(rng))
+                 for i in range(16)]
+        for f in burst:
+            f.result(600)
+        capacity = 16 / max(time.time() - t0, 1e-9)
+        rate = args.rate if args.rate else capacity * args.overload
+        gen = serving.OpenLoopLoadGen(
+            router, classes, rate=rate,
+            n_requests=None if args.duration else args.requests,
+            duration_s=args.duration, seed=args.seed,
+            retry_overloaded=args.retry_overloaded)
+        report = gen.run()
+        report['measured_capacity_req_s'] = round(capacity, 3)
+        fleet = router.metrics()
+        report['fleet'] = fleet
+        report['replicas'] = {}
+        for idx, reg in enumerate(regs):
+            metrics = reg.metrics()
+            block = {
+                'dispatches': fleet['replicas'][idx]['dispatches'],
+                'overload_rejects': metrics['overload_rejects'],
+                'models': {
+                    n: {k: metrics['models'][n][k]
+                        for k in ('shed', 'queue_depth', 'compiles',
+                                  'p50_latency_ms', 'p99_latency_ms')}
+                    for n in names + gen_names
+                },
+            }
+            if gen_names:
+                block['decode'] = {
+                    n: (reg._entry(n).engine.metrics()['decode'] or {})
+                    for n in gen_names
+                }
+            report['replicas'][idx] = block
+    finally:
+        if router is not None:
+            router.close()
+        for srv in servers:
+            srv.close()
+        for reg in regs:
+            reg.stop()
+    print(json.dumps(report), flush=True)
+    return report
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument('--rate', type=float, default=None,
@@ -168,6 +327,10 @@ def main(argv=None):
                         'model (1 = per-scan-sync baseline)')
     p.add_argument('--models', type=int, default=1,
                    help='number of synthetic models to mix across')
+    p.add_argument('--replicas', type=int, default=1,
+                   help='serve through N replica registries behind '
+                        'the fleet router (ISSUE 17); the report '
+                        'gains fleet + per-replica blocks')
     p.add_argument('--model-dir', default=None,
                    help='serve this save_inference_model dir instead '
                         'of synthetic models (single feed)')
@@ -193,6 +356,9 @@ def main(argv=None):
     import numpy as np
     import paddle_tpu.fluid as fluid  # noqa: F401 (registers flags)
     from paddle_tpu import serving
+
+    if args.replicas > 1:
+        return _run_fleet(args)
 
     cfg = serving.ServingConfig(
         max_batch_size=args.max_batch,

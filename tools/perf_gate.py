@@ -2262,6 +2262,291 @@ def run_master_chaos():
     return rec
 
 
+def build_fleet():
+    """Fleet-vs-single serving windows (ISSUE 17): one forward scorer
+    + one stepwise decode model, each with ONE scope + ONE executor
+    shared by the single-registry baseline and every fleet replica —
+    identical weights (the bitwise asserts) and a shared compile cache
+    (replica N never pays the fwd/decode compile again).  The paired
+    stream is two phases: phase A (untimed) carries the seeded
+    lost-response fault and pins every decode session; the victim
+    replica — whichever holds session 0's SlotStateCache slots — is
+    then killed with sessions mid-stream, and phase B is the TIMED
+    post-kill window: the survivor serves the whole stream (failover,
+    re-prefill, re-pin included) against the fault-free single
+    registry serving the identical phase-B requests.  Every output is
+    compared 1:1 against the single-registry reference — exactly-once
+    delivery IS the bitwise ledger, and the dropped response's retry
+    must land as a dedup REPLAY, not a second execution."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import serving
+    from paddle_tpu.distributed import FaultInjector, RetryPolicy
+    from paddle_tpu.fluid import core
+
+    n_req = int(os.environ.get('PERF_GATE_FLEET_REQS', '32'))
+    n_sessions = int(os.environ.get('PERF_GATE_FLEET_SESSIONS', '3'))
+    # the client socket timeout IS the price of the scripted
+    # drop_response (one recv stall in the untimed phase A); it must
+    # still clear the survivor's worst per-RPC wall in phase B
+    cli_timeout = float(os.environ.get('PERF_GATE_FLEET_TIMEOUT',
+                                       '5.0'))
+    dim, classes, rows, seq = 16, 64, 4, 12
+    max_len = 6
+
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = 0
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data('x', shape=[-1, dim], dtype='float32')
+        pooled = fluid.layers.reduce_sum(x, dim=1)
+        pred = fluid.layers.fc(pooled, classes, act='softmax')
+    test_prog = prog.clone(for_test=True)
+    place = fluid.TPUPlace() if core.is_compiled_with_tpu() \
+        else fluid.CPUPlace()
+    fwd_scope = fluid.core.Scope()
+    fwd_exe = fluid.Executor(place)
+    with fluid.scope_guard(fwd_scope):
+        fwd_exe.run(startup)
+
+    from paddle_tpu.models import seq2seq
+    with fluid.unique_name.guard():
+        gm = seq2seq.build_step_decode(
+            src_dict_dim=24, trg_dict_dim=20, embedding_dim=6,
+            encoder_size=10, decoder_size=10, max_len=8)
+    gm['prefill'].random_seed = 3
+    gen_exe = fluid.Executor(place)
+    gen_scope = fluid.core.Scope()
+    with fluid.scope_guard(gen_scope):
+        gen_exe.run(gm['prefill_startup'])
+        gen_exe.run(gm['step_startup'])
+    gspec = serving.GenerationSpec.from_model(gm)
+    src_feed = gm['prefill_feeds'][0]
+
+    def make_registry():
+        reg = serving.ModelRegistry()
+        reg.load('fwd', program=test_prog, feed_names=['x'],
+                 fetch_list=[pred], scope=fwd_scope, executor=fwd_exe)
+        reg.load('nmt', program=gm['prefill'],
+                 feed_names=gm['prefill_feeds'],
+                 fetch_list=gm['prefill_fetches'], scope=gen_scope,
+                 executor=gen_exe, generation=gspec,
+                 config=serving.ServingConfig(decode_slots=4,
+                                              decode_steps=3))
+        reg.start()
+        return reg
+
+    # the whole offered stream is pre-built and seeded: both lanes
+    # (and every block) replay the identical requests
+    rng = np.random.RandomState(17)
+    sessions = ['s%d' % i for i in range(n_sessions)]
+
+    def _prompt(l):
+        return fluid.create_lod_tensor(
+            rng.randint(2, 24, size=(l, 1)).tolist(), [[l]])
+
+    feeds, prompts = {}, {}
+    for k, ph in enumerate(('a', 'b')):
+        feeds[ph] = [rng.standard_normal(
+            (rows, seq, dim)).astype('float32') for _ in range(n_req)]
+        prompts[ph] = [_prompt(3 + (i + k) % 3)
+                       for i in range(n_sessions)]
+
+    def drive(target, phase, with_sessions=False):
+        """Submit the phase's whole stream, then gather in submission
+        order.  Returns (outputs, lost, wall_s)."""
+        t0 = time.time()
+        # router lane: cli_timeout stays the per-recv stall bound, but
+        # the SERVER-side budget is wide — a contended window then
+        # costs stall+retry (the dedup window replays), never a loss
+        skw = {'timeout': 60} if with_sessions else {}
+        futs = [('fwd', target.submit('fwd', {'x': f}, **skw))
+                for f in feeds[phase]]
+        for i, s in enumerate(sessions):
+            kw = dict(skw, session=s) if with_sessions else {}
+            futs.append(('gen', target.submit_generate(
+                'nmt', {src_feed: prompts[phase][i]},
+                max_len=max_len, **kw)))
+        out, lost = [], 0
+        for kind, fut in futs:
+            try:
+                r = fut.result(120)
+            except Exception:
+                lost += 1
+                out.append(None)
+                continue
+            out.append(np.asarray(r[0] if kind == 'fwd' else r))
+        return out, lost, time.time() - t0
+
+    # snappy retries: a dead replica must cost milliseconds of
+    # connect-refused probing, not the default backoff ladder — the
+    # timed post-kill window measures the fleet, not the retry timer
+    retry = RetryPolicy(max_attempts=4, base_backoff_s=0.02,
+                        max_backoff_s=0.2, deadline_s=60.0, seed=0)
+
+    # the bitwise REFERENCE is the fault-free single registry driven
+    # in-process (the ISSUE 17 oracle: no router, no faults)
+    base_reg = make_registry()
+    ref = {}
+    for ph in ('a', 'b'):
+        ref[ph], lost, _ = drive(base_reg, ph)
+        assert lost == 0, 'fault-free reference lost %d' % lost
+
+    # the TIMED baseline serves the same registry through a 1-replica
+    # fleet tier, so the goodput ratio isolates what the KILL costs
+    # (failover probing, re-prefill, survivor ownership) — not the
+    # wire codec both lanes pay equally
+    base_srv = serving.ReplicaServer(base_reg)
+    base_router = serving.FleetRouter([base_srv], retry=retry,
+                                      timeout=cli_timeout)
+    drive(base_router, 'b', with_sessions=True)  # warm the lane
+
+    def single_window():
+        """The single-replica baseline, re-timed per block so each
+        ratio shares a drift window with its fleet pair."""
+        out, lost, wall = drive(base_router, 'b', with_sessions=True)
+        assert lost == 0, lost
+        return (n_req + n_sessions) / wall, out
+
+    def fleet_window():
+        """One full chaos pass: 2 replicas, the seeded drop fault in
+        phase A, the pinned-victim kill between rounds (sessions hold
+        live decode slots), the TIMED post-kill phase B."""
+        fi = FaultInjector(seed=7)
+        fi.script('server_send', 'infer', 'drop_response', nth=1,
+                  times=1)
+        regs = [make_registry() for _ in range(2)]
+        servers = [serving.ReplicaServer(regs[0], fault_injector=fi),
+                   serving.ReplicaServer(regs[1])]
+        router = serving.FleetRouter(servers, retry=retry,
+                                     timeout=cli_timeout)
+        try:
+            got_a, lost_a, _ = drive(router, 'a', with_sessions=True)
+            log1 = router.session_dispatches()
+            aff1 = max(len(set(log1[s])) for s in sessions)
+            victim = log1[sessions[0]][0]
+            servers[victim].close()
+            got_b, lost_b, wall = drive(router, 'b',
+                                        with_sessions=True)
+            log2 = router.session_dispatches()
+            rm = router.metrics()
+            stats = {
+                'lost': lost_a + lost_b,
+                'bitwise': all(
+                    g is not None and np.array_equal(g, w)
+                    for g, w in zip(got_a + got_b,
+                                    ref['a'] + ref['b'])),
+                'injected': fi.applied,
+                'replays': sum(s._dedup.replays for s in servers),
+                'failovers': rm['failovers'],
+                'deaths': rm['replica_deaths'],
+                're_prefills': rm['re_prefills'],
+                'affinity_pre_kill_max_distinct': aff1,
+                'affinity_max_distinct': max(
+                    len(set(log2[s])) for s in sessions),
+                'post_kill_on_survivor': all(
+                    log2[s][-1] == 1 - victim for s in sessions),
+            }
+            return (n_req + n_sessions) / wall, stats
+        finally:
+            router.close()
+            for srv in servers:
+                srv.close()
+            for reg in regs:
+                reg.stop()
+
+    def cleanup():
+        base_router.close()
+        base_srv.close()
+        base_reg.stop()
+
+    ctx = {'n_req': n_req, 'n_sessions': n_sessions,
+           'cleanup': cleanup}
+    return single_window, fleet_window, ctx
+
+
+def run_fleet():
+    """The fleet record (ISSUE 17): interleaved single-registry /
+    fleet-under-kill windows over the identical seeded stream.  HARD
+    gates: ``fleet_lost`` == 0 and ``fleet_duplicated`` == 0 in EVERY
+    window (every request finishes exactly once — the dropped
+    response's retry must surface as a dedup replay, never a second
+    result); ``fleet_bitwise_outputs`` (every fleet output, across the
+    fault AND the kill, bitwise-equal to the fault-free
+    single-registry reference); affinity STRUCTURAL (one replica per
+    session fault-free, at most two across the kill, post-kill all on
+    the survivor); and ``post_kill_goodput_ratio`` — the survivor's
+    timed phase-B goodput over the single registry's, best shared
+    window — >= PERF_GATE_FLEET_GOODPUT (default 0.25: the timed
+    window DELIBERATELY contains the failover transition — every
+    victim-bound dispatch pays the connect-refused probe ladder until
+    the first failure marks the replica dead — so the gate bounds the
+    worst post-kill window, not the settled survivor steady state;
+    with real per-request service walls the fixed probing tax
+    shrinks against the stream and the ratio climbs toward 1)."""
+    single_w, fleet_w, ctx = build_fleet()
+    singles, fleets = [], []
+    try:
+        for _ in range(BLOCKS):
+            singles.append(single_w())
+            fleets.append(fleet_w())
+    finally:
+        ctx['cleanup']()
+    ratios = [fg / sg for (fg, _), (sg, _) in zip(fleets, singles)]
+    worst = {k: max(st[k] for _, st in fleets)
+             for k in ('lost', 'affinity_pre_kill_max_distinct',
+                       'affinity_max_distinct')}
+    every = {k: min(st[k] for _, st in fleets)
+             for k in ('injected', 'replays', 'failovers', 'deaths',
+                       're_prefills')}
+    rec = {
+        'config': 'fleet',
+        'post_kill_goodput_req_s': round(max(g for g, _ in fleets), 1),
+        'single_goodput_req_s': round(max(g for g, _ in singles), 1),
+        'fleet_goodput_blocks': [round(g, 1) for g, _ in fleets],
+        'single_goodput_blocks': [round(g, 1) for g, _ in singles],
+        # the HARD goodput gate: what one replica's death costs the
+        # offered stream once the survivor owns it, best shared window
+        'post_kill_goodput_ratio': round(max(ratios), 4),
+        'fleet_lost': worst['lost'],
+        # >1 result for a logical request is structurally impossible
+        # (futures finish once); the substantive exactly-once check is
+        # the bitwise 1:1 ledger + the replayed (not re-executed) retry
+        'fleet_duplicated': 0 if all(st['bitwise']
+                                     for _, st in fleets) else -1,
+        'fleet_bitwise_outputs': all(st['bitwise'] for _, st in fleets),
+        'fleet_injected_faults': every['injected'],
+        'fleet_dedup_replays': every['replays'],
+        'fleet_failovers': every['failovers'],
+        'fleet_replica_deaths': every['deaths'],
+        'fleet_re_prefills': every['re_prefills'],
+        'fleet_affinity_pre_kill_max_distinct':
+            worst['affinity_pre_kill_max_distinct'],
+        'fleet_affinity_max_distinct': worst['affinity_max_distinct'],
+        'fleet_post_kill_on_survivor': all(
+            st['post_kill_on_survivor'] for _, st in fleets),
+        'requests_per_phase': ctx['n_req'],
+        'sessions': ctx['n_sessions'],
+        'blocks': BLOCKS,
+    }
+    floor = float(os.environ.get('PERF_GATE_FLEET_GOODPUT', '0.25'))
+    assert rec['post_kill_goodput_ratio'] >= floor, rec
+    assert rec['fleet_lost'] == 0, rec
+    assert rec['fleet_duplicated'] == 0, rec
+    assert rec['fleet_bitwise_outputs'], rec
+    assert rec['fleet_injected_faults'] >= 1, rec
+    assert rec['fleet_dedup_replays'] >= 1, rec
+    assert rec['fleet_failovers'] >= 1, rec
+    assert rec['fleet_replica_deaths'] == 1, rec
+    assert rec['fleet_re_prefills'] >= 1, rec
+    # affinity structural: one replica per session fault-free, at most
+    # two across the kill, and post-kill everything on the survivor
+    assert rec['fleet_affinity_pre_kill_max_distinct'] == 1, rec
+    assert rec['fleet_affinity_max_distinct'] <= 2, rec
+    assert rec['fleet_post_kill_on_survivor'], rec
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 def check_profile_shed():
     """ISSUE 9's sharpened shed contract, checked DETERMINISTICALLY
     (no model, no timing): a MicroBatcher fed the per-signature
@@ -2551,6 +2836,7 @@ CONFIGS = {
     'embed_cache': (build_embed_cache, 'rows_per_sec'),
     'elastic': (build_elastic, 'rows_per_sec'),
     'master_chaos': (build_master_chaos, 'rows_per_sec'),
+    'fleet': (build_fleet, 'goodput_req_s'),
 }
 
 
@@ -2579,6 +2865,8 @@ def run_config(name):
         return run_elastic()
     if name == 'master_chaos':
         return run_master_chaos()
+    if name == 'fleet':
+        return run_fleet()
     build, unit = CONFIGS[name]
     # both sides compiled first, then INTERLEAVED blocks: a drift window
     # between two monolithic measurements would otherwise decide the
