@@ -15,3 +15,5 @@ from .embed_cache import CachedEmbeddingTable, EmbedCacheCapacityError, \
     optimizer_accumulator_vars  # noqa: F401
 from .elastic import ElasticTrainJob, AsyncShardedCheckpoint, \
     CheckpointWriteError, ElasticJobError  # noqa: F401
+from .pserver import PServerShard, ShardedEmbeddingClient, \
+    shard_row_ranges, sharded_cache_from_scope  # noqa: F401

@@ -362,19 +362,25 @@ class AsyncShardedCheckpoint(object):
         return m
 
     @classmethod
-    def gc(cls, root, keep_jobs=2):
+    def gc(cls, root, keep_jobs=2, keep_hours=None):
         """Cross-job retention (ISSUE 17 satellite): ``root`` holds one
         checkpoint directory per job (the per-job stores already bound
         their own step retention with ``keep=``; what grows without
         bound is the number of FINISHED jobs).  Removes dead job dirs —
         committed manifests, shards and all — keeping the newest
-        ``keep_jobs`` of them by last-manifest mtime.  Never touched:
+        ``keep_jobs`` of them by last-manifest mtime.  ``keep_hours``
+        (ISSUE 19 satellite) adds an age-based sweep on top of the
+        count-based one: a dead store whose newest manifest is older
+        than ``keep_hours`` hours is removed even when the
+        ``keep_jobs`` count would have retained it.  Never touched:
         dirs carrying the ``ACTIVE`` marker (a live store; a crashed
         job's stale marker is the operator's to clear) and dirs that
         don't look like checkpoint stores at all (no manifests, no
         shards/).  Returns the removed paths."""
         if int(keep_jobs) < 0:
             raise ValueError('gc: keep_jobs must be >= 0')
+        if keep_hours is not None and float(keep_hours) < 0:
+            raise ValueError('gc: keep_hours must be >= 0')
         dead = []
         for name in sorted(os.listdir(root)):
             d = os.path.join(root, name)
@@ -396,8 +402,15 @@ class AsyncShardedCheckpoint(object):
                          [os.path.getmtime(d)])
             dead.append((newest, d))
         dead.sort()
+        doomed = set(
+            d for _, d in dead[:max(0, len(dead) - int(keep_jobs))])
+        if keep_hours is not None:
+            cutoff = time.time() - float(keep_hours) * 3600.0
+            doomed.update(d for newest, d in dead if newest < cutoff)
         removed = []
-        for _, d in dead[:max(0, len(dead) - int(keep_jobs))]:
+        for _, d in dead:
+            if d not in doomed:
+                continue
             shutil.rmtree(d, ignore_errors=True)
             removed.append(d)
         return removed

@@ -58,7 +58,17 @@ __all__ = ['CachedEmbeddingTable', 'EmbedCacheCapacityError',
 # optimizer-op input slots holding row-shaped accumulators that must
 # ride the cache (one host master + one slab each); scalar slots
 # (Beta1Pow, LearningRate) update densely and stay plain scope vars
-_ACCUMULATOR_SLOTS = ('Velocity', 'Moment', 'Moment1', 'Moment2')
+_ACCUMULATOR_SLOTS = ('Velocity', 'Moment', 'Moment1', 'Moment2',
+                      'MeanSquare', 'SquaredAccumulator',
+                      'LinearAccumulator', 'AvgSquaredGrad',
+                      'AvgSquaredUpdate')
+
+
+def _host_like(obj):
+    """True for a host-TIER aux master (the sharded pserver client's
+    per-table view — anything speaking fetch_rows/write_rows) as
+    opposed to a plain in-process ndarray."""
+    return hasattr(obj, 'fetch_rows') and hasattr(obj, 'write_rows')
 
 
 class EmbedCacheCapacityError(RuntimeError):
@@ -185,14 +195,19 @@ class CachedEmbeddingTable(object):
                 '— a slab covering the whole table needs no overflow '
                 'tier' % (self.capacity, self.vocab))
         self._scope = scope
-        # copy=True: sources may be read-only views of live jax arrays
-        self._aux_host = {str(n): np.array(a, dtype='float32', copy=True)
-                          for n, a in (aux or {}).items()}
+        # an aux master is either a plain ndarray (copy=True: sources
+        # may be read-only views of live jax arrays) or a host-tier
+        # object speaking fetch_rows/write_rows (a ShardedEmbeddingClient
+        # table view — ISSUE 19), adopted as-is
+        self._aux_host = {
+            str(n): a if _host_like(a)
+            else np.array(a, dtype='float32', copy=True)
+            for n, a in (aux or {}).items()}
         for n, a in self._aux_host.items():
-            if a.shape != (self.vocab, self.dim):
+            if tuple(a.shape) != (self.vocab, self.dim):
                 raise ValueError(
                     'CachedEmbeddingTable: accumulator %r has shape %s, '
-                    'expected %s' % (n, a.shape,
+                    'expected %s' % (n, tuple(a.shape),
                                      (self.vocab, self.dim)))
         # ---- the id->slot directory (host mirror of the slab) --------
         self._lock = threading.RLock()       # directory state
@@ -433,6 +448,13 @@ class CachedEmbeddingTable(object):
 
     # ---- workers ---------------------------------------------------------
 
+    def _aux_write(self, name, ids, rows):
+        aux = self._aux_host[name]
+        if _host_like(aux):
+            aux.write_rows(ids, rows)
+        else:
+            aux[ids] = rows
+
     def _fetch_loop(self):
         while True:
             ex = self._fetch_q.get()
@@ -446,7 +468,9 @@ class CachedEmbeddingTable(object):
                 if len(ex.miss_ids):
                     fetched[self.var] = self._host.fetch_rows(ex.miss_ids)
                     for name, arr in self._aux_host.items():
-                        fetched[name] = arr[ex.miss_ids].copy()
+                        fetched[name] = (arr.fetch_rows(ex.miss_ids)
+                                         if _host_like(arr)
+                                         else arr[ex.miss_ids].copy())
                     self._m['host_fetch_bytes'] += (
                         len(ex.miss_ids) * self.dim * 4 *
                         len(self.tables))
@@ -469,7 +493,7 @@ class CachedEmbeddingTable(object):
                         if name == self.var:
                             self._host.write_rows(ex.victim_ids, rows)
                         else:
-                            self._aux_host[name][ex.victim_ids] = rows
+                            self._aux_write(name, ex.victim_ids, rows)
                     self._m['host_writeback_bytes'] += (
                         n * self.dim * 4 * len(self.tables))
                     self._m['writeback_rows'] += n
@@ -576,7 +600,7 @@ class CachedEmbeddingTable(object):
                     if name == self.var:
                         self._host.write_rows(dirty_ids, rows)
                     else:
-                        self._aux_host[name][dirty_ids] = rows
+                        self._aux_write(name, dirty_ids, rows)
                 self._m['host_writeback_bytes'] += (
                     n * self.dim * 4 * len(self.tables))
                 self._m['writeback_rows'] += n
@@ -601,7 +625,8 @@ class CachedEmbeddingTable(object):
         self.flush()
         if name is None or name == self.var:
             return self._host.table()
-        return self._aux_host[name].copy()
+        aux = self._aux_host[name]
+        return aux.table() if _host_like(aux) else aux.copy()
 
     def evict_to_host(self):
         """Demote every slab to a host ndarray after a flush (bitwise

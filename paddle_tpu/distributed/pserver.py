@@ -1,0 +1,728 @@
+"""Distributed parameter-server embedding tier (ISSUE 19): sharded
+pserver processes behind the resilient transport.
+
+The reference Fluid stack's signature scale capability is the sparse
+remote updater backed by a FLEET of parameter servers (the
+DistributeTranspiler pserver path, and the Go pserver with etcd
+registration and checkpointing — PAPER.md SFluid-distributed); our
+repro so far held every embedding master in ONE host process
+(``AsyncSparseEmbedding``), capping the table at one host's DRAM.
+This module reborns that tier TPU-natively on the PR 15/17 RPC
+substrate:
+
+``PServerShard``
+    serves a CONTIGUOUS row-range of one or more ``[V, D]`` tables
+    (the weight plus every optimizer accumulator — the same table set
+    ``CachedEmbeddingTable`` discovers) over ``transport.py``'s
+    ``ServiceServer``.  Batched ``fetch_rows``/``write_rows``/
+    ``apply_rows`` RPCs ride the ndarray wire codec from
+    ``serving/fleet.py``; mutations carry client-minted rids through
+    the reusable ``DedupWindow``, so a retried write applies exactly
+    once.  Durability rides ``AsyncShardedCheckpoint``: the shard
+    checkpoints its row-range AND its dedup window atomically with the
+    covered mutation, so a killed-and-restarted shard resumes from its
+    last commit and an in-flight retry REPLAYS instead of
+    double-applying.
+
+``ShardedEmbeddingClient``
+    presents the existing ``AsyncSparseEmbedding`` surface
+    (``fetch_rows``/``write_rows``/``prefetch``/``push_grad``/
+    ``shape``/``nbytes``/``drain``/``table``/``close`` + the
+    background push queue) over N shards: each batch is row-range
+    routed (one ``searchsorted`` over the shard starts), the partial
+    results merge back in id order, so results are BITWISE identical
+    to the single-process master.  Each shard lane is a
+    ``ResilientServiceClient`` — reconnect, seeded backoff, in-order
+    standby failover — so a shard restart is a retry, not an error.
+
+``CachedEmbeddingTable`` composes transparently: pass the client as
+the cache's host tier (``sharded_cache_from_scope`` wires the whole
+stack) and the HBM hot-row slab, staging-thread prefetch overlap and
+read-your-writes writeback ordering all ride the sharded master
+unchanged.
+"""
+
+import queue
+import threading
+
+import numpy as np
+
+from .async_sparse import AsyncSparseClosedError
+from .elastic import AsyncShardedCheckpoint
+from .transport import DedupWindow, ResilientServiceClient, RetryPolicy, \
+    ServiceServer
+
+__all__ = ['PServerShard', 'ShardedEmbeddingClient', 'shard_row_ranges',
+           'sharded_cache_from_scope']
+
+# shard methods whose server-side effect is NOT idempotent across a
+# lost response: they carry a request id and ride the dedup window
+# (write_rows is a set — but its RESPONSE must still replay, and a
+# checkpointed window must cover it so a post-restart retry cannot
+# interleave with newer writes to the same rows)
+_PSERVER_MUTATING = frozenset(['write_rows', 'apply_rows'])
+
+
+def _wire_encode(v):
+    from ..serving.fleet import _wire_encode as enc
+    return enc(v)
+
+
+def _wire_decode(v):
+    from ..serving.fleet import _wire_decode as dec
+    return dec(v)
+
+
+def shard_row_ranges(vocab, shards):
+    """Contiguous ``[start, stop)`` row-ranges covering ``[0, vocab)``
+    across ``shards`` shards — the first ``vocab % shards`` shards get
+    one extra row.  The canonical partition used by every launcher
+    here (tests, perf_gate, load_gen), so client-side routing can
+    always be a single searchsorted."""
+    vocab, shards = int(vocab), int(shards)
+    if shards < 1:
+        raise ValueError('shard_row_ranges: shards must be >= 1')
+    if vocab < shards:
+        raise ValueError(
+            'shard_row_ranges: vocab %d < shards %d would leave empty '
+            'shards' % (vocab, shards))
+    base, extra = divmod(vocab, shards)
+    ranges, lo = [], 0
+    for i in range(shards):
+        hi = lo + base + (1 if i < extra else 0)
+        ranges.append((lo, hi))
+        lo = hi
+    return ranges
+
+
+class PServerShard(object):
+    """One parameter-server shard: a contiguous row-range of one or
+    more ``[rows, D]`` tables served over the resilient transport.
+
+    tables  : {name: [rows, D] array} — the weight table plus its
+              optimizer accumulators, all the SAME shape (copies are
+              taken; the shard owns its state).
+    row_start: global row index of local row 0 — ids on the wire are
+              GLOBAL; the shard translates.
+    weight  : name of the weight table (``apply_rows``'s target);
+              defaults to the sole table when only one is given.
+    lr      : SGD rate for ``apply_rows`` pushed row-gradients (the
+              async-SGD lane; the cached-training lane uses
+              ``write_rows`` and never touches this).
+    checkpoint_dir: when set, the shard checkpoints every
+              ``checkpoint_every`` mutations through an
+              ``AsyncShardedCheckpoint`` — tables AND dedup window in
+              one atomic commit — and ``restore()`` can rebuild the
+              shard from the last commit after a kill.
+    """
+
+    def __init__(self, tables, row_start, weight=None, lr=0.01,
+                 host='127.0.0.1', port=0, fault_injector=None,
+                 checkpoint_dir=None, checkpoint_every=1, keep=3,
+                 dedup_window=256, dedup_clients=64,
+                 _dedup_state=None, _step=0):
+        if not tables:
+            raise ValueError('PServerShard: tables is empty')
+        self._tables = {str(n): np.array(a, dtype='float32', copy=True)
+                        for n, a in tables.items()}
+        shapes = {a.shape for a in self._tables.values()}
+        if len(shapes) != 1 or any(len(s) != 2 for s in shapes):
+            raise ValueError(
+                'PServerShard: tables must share one 2-D shape, got %s'
+                % sorted((n, a.shape) for n, a in self._tables.items()))
+        self.rows, self.dim = next(iter(shapes))
+        self.row_start = int(row_start)
+        if weight is None:
+            if len(self._tables) != 1:
+                raise ValueError(
+                    'PServerShard: weight= is required with multiple '
+                    'tables %s' % sorted(self._tables))
+            weight = next(iter(self._tables))
+        self.weight = str(weight)
+        if self.weight not in self._tables:
+            raise ValueError('PServerShard: weight %r not in tables %s'
+                             % (self.weight, sorted(self._tables)))
+        self._lr = float(lr)
+        self._lock = threading.Lock()      # table row read/write atomicity
+        # serializes mutations AGAINST the checkpoint snapshot: the
+        # (tables, dedup window) pair committed to disk must be
+        # mutually consistent — a record without its table effect
+        # loses the write on restore, a table effect without its
+        # record double-applies on retry.  Holding this across
+        # dedup.execute + checkpoint closes both windows.
+        self._mut_lock = threading.Lock()
+        self._dedup = DedupWindow(window=dedup_window,
+                                  clients=dedup_clients)
+        if _dedup_state:
+            self._dedup.restore_state(_dedup_state)
+        self._mutations = int(_step)
+        self._saved_at = int(_step)
+        self._checkpoint_every = max(int(checkpoint_every), 1)
+        self._store = (AsyncShardedCheckpoint(checkpoint_dir, keep=keep)
+                       if checkpoint_dir else None)
+        self._closed = False
+        self._server = ServiceServer(
+            self._dispatch, host=host, port=port,
+            fault_injector=fault_injector,
+            dedup_execute=self._dedup_execute)
+
+    # ---- construction ---------------------------------------------------
+
+    @classmethod
+    def restore(cls, checkpoint_dir, host='127.0.0.1', port=0,
+                fault_injector=None, checkpoint_every=1, keep=3,
+                dedup_window=256, dedup_clients=64):
+        """Rebuild a killed shard from its last committed checkpoint —
+        tables, mutation counter AND dedup window — typically at the
+        SAME port, so clients' reconnect/retry lanes find it again.
+        An in-flight mutation retried against the restored shard
+        replays its recorded response instead of double-applying."""
+        store = AsyncShardedCheckpoint(checkpoint_dir, keep=keep)
+        try:
+            step, arrays, extras = store.load()
+        finally:
+            store.close()
+        return cls(tables=arrays, row_start=extras['row_start'],
+                   weight=extras['weight'], lr=extras['lr'],
+                   host=host, port=port, fault_injector=fault_injector,
+                   checkpoint_dir=checkpoint_dir,
+                   checkpoint_every=checkpoint_every, keep=keep,
+                   dedup_window=dedup_window,
+                   dedup_clients=dedup_clients,
+                   _dedup_state=extras.get('dedup') or {}, _step=step)
+
+    # ---- the RPC surface ------------------------------------------------
+
+    def _dedup_execute(self, client, rid, fn):
+        with self._mut_lock:
+            resp = self._dedup.execute(client, rid, fn)
+            # the response (fresh or replay) is recorded in the window
+            # NOW and no other mutation can interleave: a checkpoint
+            # taken here commits a consistent (tables, window) pair
+            self._maybe_checkpoint()
+            return resp
+
+    def _local(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        local = ids - self.row_start
+        if len(local) and (local.min() < 0 or local.max() >= self.rows):
+            raise ValueError(
+                'pserver shard rows [%d, %d): ids out of range (got '
+                '[%d, %d])' % (self.row_start, self.row_start + self.rows,
+                               int(ids.min()), int(ids.max())))
+        return local
+
+    def _table(self, name):
+        name = self.weight if name is None else str(name)
+        if name not in self._tables:
+            raise ValueError('pserver shard: unknown table %r (have %s)'
+                             % (name, sorted(self._tables)))
+        return self._tables[name]
+
+    def _dispatch(self, method, req):
+        if method == 'meta':
+            return {'row_start': self.row_start, 'rows': self.rows,
+                    'dim': self.dim, 'tables': sorted(self._tables),
+                    'weight': self.weight, 'lr': self._lr}
+        if method == 'fetch_rows':
+            arr = self._table(req.get('table'))
+            local = self._local(req['ids'])
+            with self._lock:
+                return {'rows': _wire_encode(arr[local].copy())}
+        if method == 'write_rows':
+            arr = self._table(req.get('table'))
+            local = self._local(req['ids'])
+            rows = np.asarray(_wire_decode(req['rows']),
+                              dtype='float32').reshape(len(local), -1)
+            if rows.shape[1] != self.dim:
+                raise ValueError(
+                    'pserver shard: write_rows dim %d != %d'
+                    % (rows.shape[1], self.dim))
+            with self._lock:
+                arr[local] = rows
+                self._mutations += 1
+            return {'written': int(len(local))}
+        if method == 'apply_rows':
+            local = self._local(req['ids'])
+            grad = np.asarray(_wire_decode(req['grad']),
+                              dtype='float32').reshape(len(local), -1)
+            with self._lock:
+                # duplicate ids in one batch must accumulate — the
+                # same np.subtract.at async-SGD the single-process
+                # master applies
+                np.subtract.at(self._tables[self.weight], local,
+                               self._lr * grad)
+                self._mutations += 1
+            return {'applied': int(len(local))}
+        if method == 'stats':
+            return self.metrics()
+        raise ValueError('pserver shard: unknown method %r' % method)
+
+    # ---- durability -----------------------------------------------------
+
+    def _snapshot(self):
+        """(step, arrays, extras) under the table lock — explicit
+        copies: the store's writer thread serializes later and must
+        not see concurrent row writes."""
+        with self._lock:
+            step = self._mutations
+            arrays = {n: a.copy() for n, a in self._tables.items()}
+        extras = {'row_start': self.row_start, 'weight': self.weight,
+                  'lr': self._lr, 'dedup': self._dedup.export_state()}
+        return step, arrays, extras
+
+    def _maybe_checkpoint(self):
+        if self._store is None:
+            return
+        if self._mutations - self._saved_at < self._checkpoint_every:
+            return
+        step, arrays, extras = self._snapshot()
+        self._store.save(step, arrays, extras=extras)
+        self._saved_at = step
+
+    def checkpoint(self, wait=False):
+        """Force a checkpoint of the current state (no-op without a
+        checkpoint_dir); ``wait=True`` blocks until it committed —
+        the pre-kill barrier of the chaos suite."""
+        if self._store is None:
+            return
+        with self._mut_lock:
+            step, arrays, extras = self._snapshot()
+            self._store.save(step, arrays, extras=extras)
+            self._saved_at = step
+        if wait:
+            self._store.wait()
+
+    # ---- lifecycle / observability --------------------------------------
+
+    @property
+    def endpoint(self):
+        return self._server.endpoint
+
+    @property
+    def port(self):
+        return self._server.port
+
+    @property
+    def dedup_replays(self):
+        return self._dedup.replays
+
+    def metrics(self):
+        m = {'row_start': self.row_start, 'rows': self.rows,
+             'dim': self.dim, 'mutations': self._mutations,
+             'dedup_replays': self._dedup.replays,
+             'endpoint': self.endpoint}
+        if self._store is not None:
+            m['checkpoint'] = self._store.metrics()
+        return m
+
+    def table(self, name=None):
+        """A copy of one full local table — the in-process view for
+        tests and launchers (RPC callers use fetch_rows)."""
+        arr = self._table(name)
+        with self._lock:
+            return arr.copy()
+
+    def kill(self):
+        """Crash simulation (the chaos lane): tear the server down
+        mid-conversation and stop checkpointing WITHOUT the final
+        commit ``close()`` would take.  Whatever the store committed
+        stays on disk for ``restore()``."""
+        if self._closed:
+            return
+        self._closed = True
+        self._server.close()
+        if self._store is not None:
+            self._store.close()
+
+    def close(self):
+        """Graceful shutdown: commit a final checkpoint (when
+        durable), then stop serving."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            if self._store is not None:
+                with self._mut_lock:
+                    step, arrays, extras = self._snapshot()
+                    self._store.save(step, arrays, extras=extras,
+                                     wait=True)
+        finally:
+            self._server.close()
+            if self._store is not None:
+                self._store.close()
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def __repr__(self):
+        return ('PServerShard(rows=[%d, %d), dim=%d, tables=%d, %s)'
+                % (self.row_start, self.row_start + self.rows, self.dim,
+                   len(self._tables), self.endpoint))
+
+
+class _ShardedTableView(object):
+    """One table's host-tier view over the sharded client — what
+    ``CachedEmbeddingTable`` adopts as an aux master (fetch_rows/
+    write_rows/shape/nbytes/table), routing through the owner's
+    per-shard lanes."""
+
+    def __init__(self, owner, name):
+        self._owner = owner
+        self.name = str(name)
+
+    @property
+    def shape(self):
+        return self._owner.shape
+
+    @property
+    def nbytes(self):
+        return self._owner.nbytes
+
+    def fetch_rows(self, ids):
+        return self._owner._fetch(self.name, ids)
+
+    def write_rows(self, ids, rows):
+        self._owner._check_open('write_rows')
+        self._owner._write(self.name, ids, rows)
+
+    def table(self):
+        return self._owner.table(self.name)
+
+
+class ShardedEmbeddingClient(object):
+    """The ``AsyncSparseEmbedding`` surface over N pserver shards.
+
+    endpoints: one entry per shard — a ``'host:port'`` string or a
+        list of them (primary first, standbys after: the in-order
+        failover contract of ``ResilientServiceClient``).  Shards are
+        sorted by their advertised row_start; together they must cover
+        ``[0, vocab)`` contiguously.
+    retry    : base ``RetryPolicy``; each shard lane derives its own
+        with a decorrelated seed (``seed + 1009 * shard``), the fleet
+        idiom.
+    capacity : push-queue bound, as on ``AsyncSparseEmbedding``.
+
+    Reads gather per-shard partials and merge them back in id order;
+    pushed gradients partition per shard and apply via exactly-once
+    ``apply_rows`` — both BITWISE what the single-process master
+    computes, which is the tier's parity bar.
+    """
+
+    def __init__(self, endpoints, capacity=64, timeout=5.0, retry=None,
+                 fault_injector=None, service='pserver'):
+        if not endpoints:
+            raise ValueError('ShardedEmbeddingClient: endpoints is empty')
+        base = retry if retry is not None else RetryPolicy()
+        self._clients = []
+        for idx, eps in enumerate(endpoints):
+            self._clients.append(ResilientServiceClient(
+                eps, timeout=timeout, fault_injector=fault_injector,
+                mutating=_PSERVER_MUTATING,
+                service='%s[%d]' % (service, idx),
+                retry=RetryPolicy(max_attempts=base.max_attempts,
+                                  base_backoff_s=base.base_backoff_s,
+                                  max_backoff_s=base.max_backoff_s,
+                                  deadline_s=base.deadline_s,
+                                  jitter=base.jitter,
+                                  seed=base.seed + 1009 * idx)))
+        metas = [c.call('meta') for c in self._clients]
+        order = sorted(range(len(metas)),
+                       key=lambda i: int(metas[i]['row_start']))
+        self._clients = [self._clients[i] for i in order]
+        metas = [metas[i] for i in order]
+        dims = {int(m['dim']) for m in metas}
+        weights = {m['weight'] for m in metas}
+        tabsets = {tuple(m['tables']) for m in metas}
+        if len(dims) != 1 or len(weights) != 1 or len(tabsets) != 1:
+            raise ValueError(
+                'ShardedEmbeddingClient: shards disagree on dim/weight/'
+                'tables: %s' % metas)
+        self.dim = dims.pop()
+        self._weight = weights.pop()
+        self.tables = list(tabsets.pop())
+        self._starts = np.array([int(m['row_start']) for m in metas],
+                                np.int64)
+        stops = self._starts + np.array([int(m['rows']) for m in metas],
+                                        np.int64)
+        if self._starts[0] != 0 or \
+                (len(metas) > 1 and
+                 (self._starts[1:] != stops[:-1]).any()):
+            raise ValueError(
+                'ShardedEmbeddingClient: shard row-ranges do not tile '
+                '[0, vocab) contiguously: %s'
+                % [(int(a), int(b)) for a, b in zip(self._starts, stops)])
+        self.vocab = int(stops[-1])
+        # ---- the push queue (AsyncSparseEmbedding surface) -----------
+        self._q = queue.Queue(maxsize=capacity)
+        self._applied = 0
+        self._pushed = 0
+        self._error = None
+        self._closed = False
+        self._join_timeouts = 0
+        self._close_lock = threading.Lock()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    # ---- routing --------------------------------------------------------
+
+    def _partition(self, ids):
+        """Yield (shard_index, positions) covering ``ids`` — positions
+        index into the flat id batch, so partial results merge back in
+        id order."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        if len(ids) and (ids.min() < 0 or ids.max() >= self.vocab):
+            raise ValueError(
+                'ShardedEmbeddingClient: ids out of range [0, %d) '
+                '(got [%d, %d])' % (self.vocab, int(ids.min()),
+                                    int(ids.max())))
+        shard_of = np.searchsorted(self._starts, ids, side='right') - 1
+        for s in np.unique(shard_of):
+            yield int(s), np.nonzero(shard_of == s)[0]
+
+    def _fetch(self, name, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = np.empty((len(ids), self.dim), 'float32')
+        for s, pos in self._partition(ids):
+            resp = self._clients[s].call(
+                'fetch_rows', table=name, ids=ids[pos].tolist())
+            out[pos] = _wire_decode(resp['rows'])
+        return out
+
+    def _write(self, name, ids, rows):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        rows = np.asarray(rows, dtype='float32').reshape(len(ids), -1)
+        for s, pos in self._partition(ids):
+            self._clients[s].call(
+                'write_rows', table=name, ids=ids[pos].tolist(),
+                rows=_wire_encode(rows[pos]))
+
+    def _check_open(self, what):
+        if self._error is not None:
+            raise self._error
+        if self._closed:
+            raise AsyncSparseClosedError(what)
+
+    # ---- the AsyncSparseEmbedding surface -------------------------------
+
+    def prefetch(self, ids):
+        """Gather current row values for a batch of ids -> [N, D]
+        (reads see the shards as of now, minus whatever pushed updates
+        are still queued — async semantics, as on the single-process
+        master)."""
+        return self._fetch(self._weight, ids)
+
+    def fetch_rows(self, ids):
+        """Batched row gather across shards, merged in id order."""
+        return self._fetch(self._weight, ids)
+
+    def write_rows(self, ids, rows):
+        """Batched row SET, row-range routed; exactly-once per shard.
+        Raises the typed closed error after ``close()``."""
+        with self._close_lock:
+            self._check_open('write_rows')
+        self._write(self._weight, ids, rows)
+
+    def push_grad(self, ids, grad):
+        """Enqueue d(loss)/d(rows) for asynchronous application across
+        the shards; returns immediately (the reference's barrier-free
+        send).  Raises the typed ``AsyncSparseClosedError`` after
+        ``close()``."""
+        if self._error is not None:
+            raise self._error
+        ids = np.asarray(ids).reshape(-1).copy()
+        grad = np.asarray(grad, dtype='float32').reshape(
+            len(ids), -1).copy()
+        with self._close_lock:
+            if self._closed:
+                raise AsyncSparseClosedError()
+            self._pushed += 1
+            self._q.put((ids, grad))
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            ids, grad = item
+            try:
+                # ascending-shard application: each row's updates land
+                # in push order (partitioning preserves per-row update
+                # order), so the result is bitwise the single-process
+                # np.subtract.at
+                for s, pos in self._partition(ids):
+                    self._clients[s].call(
+                        'apply_rows', ids=ids[pos].tolist(),
+                        grad=_wire_encode(grad[pos]))
+                self._applied += 1
+            except Exception as e:  # surfaced on push/drain
+                self._error = e
+            finally:
+                self._q.task_done()
+
+    def drain(self):
+        """Block until every pushed update is applied on its shard."""
+        self._q.join()
+        if self._error is not None:
+            raise self._error
+
+    @property
+    def shape(self):
+        return (self.vocab, self.dim)
+
+    @property
+    def nbytes(self):
+        return int(self.vocab) * int(self.dim) * 4
+
+    @property
+    def stats(self):
+        return {'pushed': self._pushed, 'applied': self._applied,
+                'queued': self._q.qsize(),
+                'close_join_timeouts': self._join_timeouts}
+
+    def metrics(self):
+        """Per-shard RPC lane metrics (calls/retries/reconnects/
+        failovers/injected_faults/endpoint) + the push stats."""
+        m = dict(self.stats)
+        m['shards'] = [c.metrics() for c in self._clients]
+        return m
+
+    # table() chunk: bounds one fetch_rows message (JSON-framed rows)
+    TABLE_CHUNK_ROWS = 8192
+
+    def table(self, name=None):
+        """A consistent [V, D] snapshot assembled from every shard
+        (drains the push queue first)."""
+        self.drain()
+        name = self._weight if name is None else str(name)
+        out = np.empty((self.vocab, self.dim), 'float32')
+        for lo in range(0, self.vocab, self.TABLE_CHUNK_ROWS):
+            hi = min(lo + self.TABLE_CHUNK_ROWS, self.vocab)
+            out[lo:hi] = self._fetch(name, np.arange(lo, hi, dtype=np.int64))
+        return out
+
+    def aux(self, name):
+        """The host-tier view of one accumulator table — what
+        ``CachedEmbeddingTable`` adopts as an aux master."""
+        name = str(name)
+        if name not in self.tables:
+            raise ValueError(
+                'ShardedEmbeddingClient: unknown table %r (have %s)'
+                % (name, self.tables))
+        return _ShardedTableView(self, name)
+
+    JOIN_TIMEOUT_S = 10.0
+
+    def close(self):
+        """Shut the client down: every update pushed BEFORE close is
+        applied (the queue drains fully), then the push daemon exits
+        and the shard lanes close.  Idempotent; a racing push either
+        lands in the drained queue or raises typed."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+        try:
+            self.drain()
+        finally:
+            self._q.put(None)
+            self._worker.join(timeout=self.JOIN_TIMEOUT_S)
+            if self._worker.is_alive():
+                self._join_timeouts += 1
+                import logging
+                logging.getLogger(__name__).warning(
+                    'ShardedEmbeddingClient.close(): push daemon did '
+                    'not join within %.1fs (stats: %r)',
+                    self.JOIN_TIMEOUT_S, self.stats)
+            for c in self._clients:
+                c.close()
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def __repr__(self):
+        return ('ShardedEmbeddingClient(vocab=%d, dim=%d, shards=%d, '
+                'tables=%d)' % (self.vocab, self.dim,
+                                len(self._clients), len(self.tables)))
+
+
+def sharded_cache_from_scope(scope, program, var, capacity, id_feeds,
+                             shards=4, multiple=1, lr=0.01,
+                             checkpoint_root=None, checkpoint_every=1,
+                             keep=3, fault_injector=None, retry=None,
+                             timeout=5.0, host='127.0.0.1',
+                             standby_ports=None):
+    """``CachedEmbeddingTable.from_scope``, parameter-server edition:
+    demote the startup-initialized ``[V, D]`` table (and its optimizer
+    accumulators, discovered from ``program``) to a FLEET of
+    ``shards`` row-range ``PServerShard`` processes, wire a
+    ``ShardedEmbeddingClient`` over them, and hand it to the cache as
+    the host tier (aux masters ride the client's per-table views).
+    Fresh ``[C, D]`` zero slabs replace the scope vars, exactly as the
+    single-process path does — the program trains against the slab,
+    the masters live behind RPC.
+
+    checkpoint_root: when set, each shard checkpoints under
+        ``<root>/shard-<idx>`` (the chaos lane's kill-and-restore
+        substrate).
+    standby_ports: optional per-shard list of extra ports to list as
+        failover endpoints (the chaos lane pre-binds a standby there).
+
+    Returns ``(cache, client, shard_list)`` — closing the cache closes
+    the client (its host tier); the shards are the caller's to close.
+    """
+    import os
+    from .embed_cache import CachedEmbeddingTable, \
+        optimizer_accumulator_vars
+    v = scope.find_var(var)
+    if v is None or v.value() is None:
+        raise ValueError(
+            'sharded_cache_from_scope: %r is not initialized in the '
+            'scope — run the startup program first' % var)
+    master = np.asarray(v.value())
+    if master.ndim != 2:
+        raise ValueError(
+            'sharded_cache_from_scope: %r has shape %s — only 2-D '
+            'embedding tables cache' % (var, master.shape))
+    vocab, dim = master.shape
+    aux = {}
+    for name in optimizer_accumulator_vars(program, var):
+        av = scope.find_var(name)
+        if av is None or av.value() is None:
+            continue
+        arr = np.asarray(av.value())
+        if arr.shape == (vocab, dim):
+            aux[name] = arr
+    shard_list, endpoints = [], []
+    for idx, (lo, hi) in enumerate(shard_row_ranges(vocab, shards)):
+        tables = {str(var): master[lo:hi]}
+        for name, arr in aux.items():
+            tables[name] = arr[lo:hi]
+        ckpt = (os.path.join(checkpoint_root, 'shard-%05d' % idx)
+                if checkpoint_root else None)
+        shard = PServerShard(tables, row_start=lo, weight=str(var),
+                             lr=lr, host=host,
+                             fault_injector=fault_injector,
+                             checkpoint_dir=ckpt,
+                             checkpoint_every=checkpoint_every,
+                             keep=keep)
+        shard_list.append(shard)
+        eps = [shard.endpoint]
+        if standby_ports is not None:
+            eps += ['%s:%d' % (host, p) for p in
+                    np.atleast_1d(standby_ports[idx]).tolist()]
+        endpoints.append(eps)
+    client = ShardedEmbeddingClient(endpoints, timeout=timeout,
+                                    retry=retry,
+                                    fault_injector=fault_injector)
+    cache = CachedEmbeddingTable(
+        var, id_feeds, capacity, host=client, scope=scope,
+        aux={n: client.aux(n) for n in aux}, multiple=multiple)
+    zeros = np.zeros((cache.capacity, dim), master.dtype)
+    v.set_value(zeros.copy())
+    for name in cache._aux_host:
+        scope.find_var(name).set_value(zeros.copy())
+    return cache, client, shard_list

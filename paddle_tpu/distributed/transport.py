@@ -514,6 +514,40 @@ class DedupWindow(object):
             rec.event.set()
         return resp
 
+    # ---- durability (ISSUE 19: pserver shard restart) ------------------
+
+    def export_state(self):
+        """JSON-serializable snapshot of the recorded responses —
+        ``{client: {rid: response}}`` in LRU order.  In-flight
+        executions (``_InProgress`` markers) are skipped: their
+        response is not recorded yet, so a restore-then-retry
+        re-executes them — exactly the at-least-once a lost response
+        already implies.  A service that checkpoints its STATE must
+        checkpoint this window alongside, or a retry arriving after a
+        restart re-applies a mutation the state already holds."""
+        with self._lock:
+            return {
+                client: {rid: resp for rid, resp in win.items()
+                         if not isinstance(resp, _InProgress)}
+                for client, win in self._win.items()
+            }
+
+    def restore_state(self, state):
+        """Adopt an ``export_state()`` snapshot (replacing the current
+        window) — the restarted-shard half of exactly-once: a client
+        retrying a mutation the pre-restart process already applied
+        replays the recorded response instead of double-applying.
+        Bounds are re-enforced, newest entries win."""
+        with self._lock:
+            self._win = OrderedDict()
+            for client, win in (state or {}).items():
+                w = self._win[str(client)] = OrderedDict(
+                    (str(rid), dict(resp)) for rid, resp in win.items())
+                while len(w) > self.window:
+                    w.popitem(last=False)
+            while len(self._win) > self.clients:
+                self._win.popitem(last=False)
+
 
 class _ServiceHandler(socketserver.StreamRequestHandler):
     def setup(self):

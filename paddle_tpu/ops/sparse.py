@@ -296,7 +296,32 @@ def _rows_ftrl(ctx, op, g):
     ctx.set(op, 'LinearAccumOut', _scatter_rows(lin, rows, lin_new))
 
 
-# The FAST sparse lane (ISSUE 11/12/14/17): gather/merge/scatter
+def _rows_adadelta(ctx, op, g):
+    """Lazy row-subset adadelta (ISSUE 19 satellite; adadelta_op.h):
+    gather the touched rows of param + avg-squared-grad/-update
+    accumulators, run the dense adadelta math on the [N, D] subset
+    against the MERGED gradient, scatter all three back.  Untouched
+    rows' running averages do NOT decay (the same lazy semantics as
+    rmsprop); with fresh (zero) state a zero-grad dense step is a
+    no-op (update = -sqrt(eps/eps)*0), so a single step is dense-
+    equivalent everywhere — what the duplicate-id parity pins.  Note
+    adadelta takes no LearningRate input (optimizer_ops.py mirrors
+    this)."""
+    p = ctx.get(op, 'Param')
+    asg = ctx.get(op, 'AvgSquaredGrad')
+    asu = ctx.get(op, 'AvgSquaredUpdate')
+    rho = op.attrs.get('rho', 0.95)
+    eps = op.attrs.get('epsilon', 1e-6)
+    rows, grad = merge_rows(g.rows, g.values, g.height)
+    asg_new = rho * asg[rows] + (1 - rho) * jnp.square(grad)
+    update = -jnp.sqrt((asu[rows] + eps) / (asg_new + eps)) * grad
+    asu_new = rho * asu[rows] + (1 - rho) * jnp.square(update)
+    ctx.set(op, 'ParamOut', _scatter_rows(p, rows, p[rows] + update))
+    ctx.set(op, 'AvgSquaredGradOut', _scatter_rows(asg, rows, asg_new))
+    ctx.set(op, 'AvgSquaredUpdateOut', _scatter_rows(asu, rows, asu_new))
+
+
+# The FAST sparse lane (ISSUE 11/12/14/17/19): gather/merge/scatter
 # row-subset kernels for the optimizers the reference ships
 # SelectedRows branches for.  Everything else falls back to
 # lazy_apply's dense-materialize + mask emulation (semantically
@@ -308,6 +333,7 @@ _ROW_SUBSET_APPLY = {
     'adagrad': _rows_adagrad,
     'rmsprop': _rows_rmsprop,
     'ftrl': _rows_ftrl,
+    'adadelta': _rows_adadelta,
 }
 
 

@@ -141,6 +141,47 @@ def test_checkpoint_cross_job_gc_spares_live_jobs(tmp_path):
     assert sorted(os.listdir(root)) == ['misc']
 
 
+def test_checkpoint_gc_keep_hours_age_sweep(tmp_path):
+    """Age-based retention (ISSUE 19 satellite): gc(keep_hours=)
+    removes a DEAD store whose newest manifest is older than the
+    cutoff even when the keep_jobs count would retain it; young dead
+    stores and live stores survive, and the count-based cut still
+    applies on top."""
+    import time
+    root = str(tmp_path)
+    dirs = {n: os.path.join(root, n) for n in 'abc'}
+    for i, n in enumerate('abc'):
+        s = AsyncShardedCheckpoint(dirs[n], keep=2, sync=True)
+        s.save(10 + i, _arrays(i), wait=True)
+        s.close()
+    # 'a': ancient (two days old); 'b', 'c': fresh
+    old = time.time() - 48 * 3600
+    os.utime(os.path.join(dirs['a'], 'MANIFEST-%012d.json' % 10),
+             (old, old))
+    with pytest.raises(ValueError, match='keep_hours'):
+        AsyncShardedCheckpoint.gc(root, keep_hours=-1)
+    # keep_jobs=3 alone would retain everything; the age sweep still
+    # removes the ancient store and ONLY it
+    removed = AsyncShardedCheckpoint.gc(root, keep_jobs=3,
+                                        keep_hours=24)
+    assert removed == [dirs['a']]
+    assert os.path.exists(dirs['b']) and os.path.exists(dirs['c'])
+    # count-based cut composes: keep_jobs=1 prunes 'b' (older of the
+    # two fresh stores) regardless of age
+    removed2 = AsyncShardedCheckpoint.gc(root, keep_jobs=1,
+                                         keep_hours=24)
+    assert removed2 == [dirs['b']]
+    # a LIVE ancient store is never age-swept
+    live = AsyncShardedCheckpoint(dirs['c'], keep=2, sync=True)
+    mani = os.path.join(dirs['c'], 'MANIFEST-%012d.json' % 12)
+    os.utime(mani, (old, old))
+    assert AsyncShardedCheckpoint.gc(root, keep_jobs=0,
+                                     keep_hours=24) == []
+    live.close()
+    assert AsyncShardedCheckpoint.gc(root, keep_jobs=0,
+                                     keep_hours=24) == [dirs['c']]
+
+
 # ---------------------------------------------------------------------
 # ElasticTrainJob
 # ---------------------------------------------------------------------

@@ -146,6 +146,10 @@ _OPTS = {
                                                  momentum=0.9),
     'adam': lambda: fluid.optimizer.Adam(learning_rate=1e-2),
     'adagrad': lambda: fluid.optimizer.Adagrad(learning_rate=0.05),
+    # ISSUE 19 satellite: adadelta gained its row-subset kernel (and
+    # its AvgSquared* accumulator slots ride _ACCUMULATOR_SLOTS), so
+    # the cache co-caches its two accumulators like adam's moments
+    'adadelta': lambda: fluid.optimizer.Adadelta(learning_rate=0.05),
 }
 
 
@@ -178,12 +182,13 @@ def _train_cpu(cached, opt_fn, feeds, k=4):
     return table, params, aux, metrics
 
 
-# momentum/adagrad ride the slow lane (~11 s combined): sgd keeps the
+# momentum/adagrad/adadelta ride the slow lane: sgd keeps the
 # plain-accumulator bitwise class in tier-1 and adam the moment-carrying
 # class — the full family still runs under `-m slow` and on hardware
 @pytest.mark.parametrize('opt_name', [
     pytest.param(n, marks=pytest.mark.slow)
-    if n in ('momentum', 'adagrad') else n for n in sorted(_OPTS)])
+    if n in ('momentum', 'adagrad', 'adadelta') else n
+    for n in sorted(_OPTS)])
 def test_cached_train_parity_cpu(opt_name):
     """Cached-vs-full-table multi-dispatch training over one skewed
     stream: the flushed host master must equal the full-table result —
@@ -368,12 +373,12 @@ def test_generation_engine_rejects_embed_caches():
 
 
 def test_uncovered_optimizer_typed_reject():
-    """An optimizer with no row-subset kernel (adadelta here — rmsprop
-    gained its kernel in ISSUE 14, ftrl in ISSUE 17) would fall back
-    to the lazy-dense [V, D] materialization against the [C, D] slab —
-    an opaque jit shape crash.  The cache rejects the combination
-    typed, at construction."""
-    m, scope = _build(fluid.optimizer.Adadelta(learning_rate=0.05))
+    """An optimizer with no row-subset kernel (adamax here — rmsprop
+    gained its kernel in ISSUE 14, ftrl in ISSUE 17, adadelta in
+    ISSUE 19) would fall back to the lazy-dense [V, D] materialization
+    against the [C, D] slab — an opaque jit shape crash.  The cache
+    rejects the combination typed, at construction."""
+    m, scope = _build(fluid.optimizer.Adamax(learning_rate=0.05))
     with pytest.raises(ValueError, match='row-subset'):
         CachedEmbeddingTable.from_scope(scope, m['main'],
                                         'ctr_embedding', CAP,

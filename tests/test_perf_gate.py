@@ -394,6 +394,63 @@ def test_embed_cache_cpu_smoke(monkeypatch):
     assert rec['params_checked'] >= 5
 
 
+def test_pserver_config_registered():
+    """ISSUE 19 structural pin (runs off-TPU): the pserver paired
+    config exists, trains the SAME cached CTR lane over a sharded
+    parameter-server host tier vs the single-process master on one
+    identical seeded zipfian stream, asserts table parity BITWISE,
+    holds the hit-rate and host-byte gates UNCHANGED from embed_cache,
+    and folds in the seeded shard-kill chaos block (drop_response +
+    mid-pass kill-and-restore, zero lost / zero double-applied)."""
+    perf_gate, inspect = _import_perf_gate()
+    assert 'pserver' in perf_gate.CONFIGS
+    src = inspect.getsource(perf_gate.run_pserver)
+    for pin in ("'hit_rate'", 'PERF_GATE_EMBED_HIT_MIN',
+                "'host_bytes_reduction'", 'PERF_GATE_EMBED_HOST_RATIO',
+                'array_equal', 'invalidate', 'chaos_bitwise_table',
+                'chaos_lost_writes', 'chaos_double_applied_writes',
+                'chaos_dedup_replays'):
+        assert pin in src, pin
+    build = inspect.getsource(perf_gate.build_pserver)
+    assert 'sharded_cache_from_scope' in build
+    assert 'CachedEmbeddingTable' in build
+    assert 'embed_caches' in build
+    assert 'hot_frac' in build and 'zipf' in build
+    chaos = inspect.getsource(perf_gate.check_pserver_chaos)
+    assert 'drop_response' in chaos
+    assert 'kill' in chaos and 'restore' in chaos
+    assert 'dedup_replays' in chaos
+
+
+@pytest.mark.slow
+def test_pserver_config_cpu_smoke(monkeypatch, tmp_path):
+    # slow-marked (~35 s): the structural pin above stays tier-1, the
+    # pserver functional contract keeps tier-1 coverage via
+    # tests/test_pserver.py
+    """The ISSUE 19 acceptance criterion, functionally on CPU: the
+    cached lane over a 4-shard ShardedEmbeddingClient finishes BITWISE
+    with the single-process master (table and accumulators), the
+    embed_cache gates hold unchanged, and the seeded shard-kill chaos
+    block reports zero lost / zero double-applied writes with at least
+    one dedup replay — run_pserver hard-asserts all of it."""
+    perf_gate, _ = _import_perf_gate()
+    monkeypatch.setenv('PERF_GATE_PS_STEPS', '8')
+    monkeypatch.setattr(perf_gate, 'BLOCKS', 2)
+    rec = perf_gate.run_pserver()
+    assert rec['hit_rate'] >= 0.9
+    assert rec['host_bytes_reduction'] >= 4.0
+    assert rec['shards'] == 4
+    assert rec['rpc_calls'] >= 1
+    assert rec['params_checked'] >= 5
+    assert rec['chaos_bitwise_table'] is True
+    assert rec['chaos_lost_writes'] == 0
+    assert rec['chaos_double_applied_writes'] == 0
+    assert rec['chaos_dedup_replays'] >= 1
+    assert rec['chaos_retries'] >= 1
+    assert rec['chaos_reconnects'] >= 1
+    assert rec['chaos_injected_faults'] >= 1
+
+
 def test_elastic_config_registered():
     """ISSUE 13 structural pin (runs off-TPU): the elastic paired
     config exists, interleaves bare/async/sync checkpoint windows over

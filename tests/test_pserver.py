@@ -1,0 +1,423 @@
+"""Parameter-server embedding tier tests (ISSUE 19): row-range
+sharding, bitwise client/master parity, exactly-once mutations through
+the dedup window, standby failover, checkpoint/kill/restore with dedup
+replay, and the cached-table-over-shards chaos lane."""
+
+import json
+import socket
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.dataset import ctr as ctr_data
+from paddle_tpu.distributed import (AsyncSparseClosedError,
+                                    AsyncSparseEmbedding,
+                                    CachedEmbeddingTable, FaultInjector,
+                                    PServerShard, ShardedEmbeddingClient,
+                                    shard_row_ranges,
+                                    sharded_cache_from_scope)
+from paddle_tpu.distributed.transport import RetryPolicy
+from paddle_tpu.models import ctr as ctr_model
+
+VOCAB, EMBED, CAP = 2048, 8, 1024
+
+
+def _build(optimizer=None, vocab=VOCAB, hidden=(16, )):
+    with fluid.unique_name.guard():
+        m = ctr_model.build(
+            sparse_dim=vocab, embed_size=EMBED, hidden_sizes=hidden,
+            is_sparse=True,
+            optimizer=optimizer or fluid.optimizer.SGD(learning_rate=0.05))
+    m['main'].random_seed = 0
+    m['startup'].random_seed = 0
+    scope = fluid.core.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(m['startup'])
+    return m, scope
+
+
+def _feeds(n, batch=16, seed=0, vocab=VOCAB):
+    rng = np.random.RandomState(seed)
+    return [ctr_data.zipf_batch(rng, batch, vocab) for _ in range(n)]
+
+
+def _launch(table, shards=4, lr=0.05, **kw):
+    """One table sharded over N PServerShards + a client over them."""
+    procs = [PServerShard({'emb': table[lo:hi]}, row_start=lo, lr=lr)
+             for lo, hi in shard_row_ranges(len(table), shards)]
+    cli = ShardedEmbeddingClient([s.endpoint for s in procs], **kw)
+    return procs, cli
+
+
+def _raw_call(endpoint, req):
+    """One bare request/response round trip — the protocol-level probe
+    for replay tests (a retry is literally the same JSON line again)."""
+    host, port = endpoint.rsplit(':', 1)
+    with socket.create_connection((host, int(port)), timeout=5) as sk:
+        sk.sendall((json.dumps(req) + '\n').encode())
+        return json.loads(sk.makefile('rb').readline().decode())
+
+
+def _free_port():
+    sk = socket.socket()
+    sk.bind(('127.0.0.1', 0))
+    port = sk.getsockname()[1]
+    sk.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# unit: partition + shard RPC surface
+# ---------------------------------------------------------------------------
+
+def test_shard_row_ranges():
+    assert shard_row_ranges(10, 4) == [(0, 3), (3, 6), (6, 8), (8, 10)]
+    assert shard_row_ranges(8, 1) == [(0, 8)]
+    assert shard_row_ranges(8, 8) == [(i, i + 1) for i in range(8)]
+    with pytest.raises(ValueError, match='shards'):
+        shard_row_ranges(10, 0)
+    with pytest.raises(ValueError, match='empty'):
+        shard_row_ranges(3, 4)
+
+
+def test_shard_serves_global_ids_and_rejects_out_of_range():
+    table = np.arange(20 * 2, dtype='float32').reshape(20, 2)
+    shard = PServerShard({'t': table[5:15]}, row_start=5)
+    try:
+        meta = _raw_call(shard.endpoint, {'method': 'meta'})
+        assert meta == {'row_start': 5, 'rows': 10, 'dim': 2,
+                        'tables': ['t'], 'weight': 't', 'lr': 0.01}
+        resp = _raw_call(shard.endpoint,
+                         {'method': 'fetch_rows', 'ids': [5, 14]})
+        rows = np.asarray(resp['rows']['__nd__']['data']).reshape(2, 2)
+        np.testing.assert_array_equal(rows, table[[5, 14]])
+        # ids outside the shard's range: typed in-band error
+        bad = _raw_call(shard.endpoint,
+                        {'method': 'fetch_rows', 'ids': [2]})
+        assert bad['etype'] == 'ValueError' and 'out of range' in \
+            bad['error']
+        unknown = _raw_call(shard.endpoint, {'method': 'nope'})
+        assert unknown['etype'] == 'ValueError'
+    finally:
+        shard.close()
+        assert shard.closed
+
+
+def test_sharded_client_validates_coverage():
+    table = np.zeros((20, 2), 'float32')
+    a = PServerShard({'t': table[:8]}, row_start=0)
+    b = PServerShard({'t': table[12:]}, row_start=12)  # gap [8, 12)
+    try:
+        with pytest.raises(ValueError, match='contiguously'):
+            ShardedEmbeddingClient([a.endpoint, b.endpoint])
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# parity: the AsyncSparseEmbedding surface, bitwise
+# ---------------------------------------------------------------------------
+
+def test_sharded_client_bitwise_parity_with_single_master():
+    """fetch/write/push over 4 shards == the single-process master,
+    BITWISE: routing preserves id order on reads and per-row update
+    order on duplicate-id pushes (np.subtract.at per shard slice is
+    np.subtract.at on the whole table)."""
+    rng = np.random.RandomState(0)
+    V, D = 103, 6
+    table = rng.standard_normal((V, D)).astype('float32')
+    ref = AsyncSparseEmbedding(V, D, lr=0.05, table=table)
+    procs, cli = _launch(table, shards=4, lr=0.05)
+    try:
+        assert cli.shape == ref.shape and cli.nbytes == ref.nbytes
+        ids = rng.randint(0, V, 37)
+        np.testing.assert_array_equal(cli.fetch_rows(ids),
+                                      ref.fetch_rows(ids))
+        np.testing.assert_array_equal(cli.prefetch(ids),
+                                      ref.prefetch(ids))
+        wids = np.array([1, 30, 60, 90, 102])
+        rows = rng.standard_normal((5, D)).astype('float32')
+        cli.write_rows(wids, rows)
+        ref.write_rows(wids, rows)
+        for _ in range(6):
+            gids = rng.randint(0, V, 16)  # duplicates expected
+            g = rng.standard_normal((16, D)).astype('float32')
+            cli.push_grad(gids, g)
+            ref.push_grad(gids, g)
+        cli.drain()
+        ref.drain()
+        np.testing.assert_array_equal(cli.table(), ref.table())
+        assert cli.stats['pushed'] == 6 and cli.stats['applied'] == 6
+        assert len(cli.metrics()['shards']) == 4
+    finally:
+        cli.close()
+        ref.close()
+        for s in procs:
+            s.close()
+    # the typed closed contract, same as the single-process master
+    with pytest.raises(AsyncSparseClosedError):
+        cli.push_grad([1], np.zeros((1, D), 'float32'))
+    with pytest.raises(AsyncSparseClosedError):
+        cli.write_rows([1], np.zeros((1, D), 'float32'))
+    assert cli.closed
+
+
+# ---------------------------------------------------------------------------
+# exactly-once + durability
+# ---------------------------------------------------------------------------
+
+def test_apply_rows_exactly_once_under_drop_response():
+    """server_send drop_response on apply_rows: the shard applies, the
+    response dies on the wire, the client retries with the SAME rid —
+    the dedup window replays instead of re-subtracting.  Counterfactual:
+    the final table equals exactly one application."""
+    rng = np.random.RandomState(1)
+    V, D = 24, 4
+    table = rng.standard_normal((V, D)).astype('float32')
+    fi = FaultInjector(seed=0)
+    fi.script('server_send', 'apply_rows', 'drop_response', nth=1)
+    shard = PServerShard({'t': table}, row_start=0, lr=0.1,
+                         fault_injector=fi)
+    cli = ShardedEmbeddingClient(
+        [shard.endpoint], timeout=0.75,
+        retry=RetryPolicy(seed=0, base_backoff_s=0.02))
+    try:
+        ids = np.array([1, 1, 2, 3])  # duplicate ids merge by accumulation
+        g = np.ones((4, D), 'float32')
+        cli.push_grad(ids, g)
+        cli.drain()
+        expect = table.copy()
+        np.subtract.at(expect, ids, 0.1 * g)
+        np.testing.assert_array_equal(cli.table(), expect)
+        assert shard.dedup_replays >= 1
+        assert cli.metrics()['shards'][0]['retries'] >= 1
+        assert fi.applied >= 1
+    finally:
+        cli.close()
+        shard.close()
+
+
+def test_kill_restore_resumes_and_replays_dedup_window(tmp_path):
+    """The durability contract: checkpoint -> kill -> restore at the
+    same endpoint resumes from the last commit, and a retry of an
+    ALREADY-APPLIED mutation (same client/rid, raw on the wire)
+    replays its recorded response instead of double-applying."""
+    rng = np.random.RandomState(2)
+    V, D = 16, 3
+    table = rng.standard_normal((V, D)).astype('float32')
+    shard = PServerShard({'t': table}, row_start=0, lr=0.1,
+                         checkpoint_dir=str(tmp_path / 'shard0'))
+    cli = ShardedEmbeddingClient([shard.endpoint])
+    ids, g = np.array([3, 3, 5]), np.ones((3, D), 'float32')
+    cli.push_grad(ids, g)
+    cli.drain()
+    expect = table.copy()
+    np.subtract.at(expect, ids, 0.1 * g)
+    port = shard.port
+    shard.checkpoint(wait=True)
+    shard.kill()
+
+    restored = PServerShard.restore(str(tmp_path / 'shard0'), port=port)
+    try:
+        # resumed from the last commit
+        np.testing.assert_array_equal(restored.table('t'), expect)
+        # the in-flight-retry probe: the same apply_rows line again
+        # (client id + rid the real client minted for the applied push)
+        from paddle_tpu.serving.fleet import _wire_encode
+        req = {'method': 'apply_rows', 'ids': ids.tolist(),
+               'grad': _wire_encode(g),
+               'client': cli._clients[0]._client_id, 'rid': '1'}
+        resp = _raw_call(restored.endpoint, req)
+        assert resp == {'applied': 3}
+        assert restored.dedup_replays >= 1
+        # no double-apply: the table still holds exactly one application
+        np.testing.assert_array_equal(restored.table('t'), expect)
+        # the reconnected client keeps working against the restoree
+        np.testing.assert_array_equal(cli.fetch_rows([3, 5]),
+                                      expect[[3, 5]])
+    finally:
+        cli.close()
+        restored.close()
+
+
+def test_failover_to_standby_endpoint(tmp_path):
+    """In-order standby failover, the fleet contract: the client lists
+    [primary, standby]; the primary dies, a restored shard comes up on
+    the standby port, the next call fails over (counted) and reads the
+    durable state."""
+    rng = np.random.RandomState(3)
+    V, D = 16, 3
+    table = rng.standard_normal((V, D)).astype('float32')
+    standby = _free_port()
+    shard = PServerShard({'t': table}, row_start=0,
+                         checkpoint_dir=str(tmp_path / 's'))
+    cli = ShardedEmbeddingClient(
+        [[shard.endpoint, '127.0.0.1:%d' % standby]], timeout=0.75,
+        retry=RetryPolicy(seed=0, base_backoff_s=0.02))
+    try:
+        cli.write_rows([4], np.zeros((1, D), 'float32'))
+        shard.checkpoint(wait=True)
+        shard.kill()
+        restored = PServerShard.restore(str(tmp_path / 's'),
+                                        port=standby)
+        try:
+            got = cli.fetch_rows([4])
+            np.testing.assert_array_equal(got, np.zeros((1, D)))
+            assert cli.metrics()['shards'][0]['failovers'] >= 1
+        finally:
+            restored.close()
+    finally:
+        cli.close()
+
+
+# ---------------------------------------------------------------------------
+# the cached table over shards: bitwise vs the single-process master
+# ---------------------------------------------------------------------------
+
+_OPTS = {
+    'sgd': lambda: fluid.optimizer.SGD(learning_rate=0.05),
+    'adagrad': lambda: fluid.optimizer.Adagrad(learning_rate=0.05),
+}
+
+
+def _train_cpu(mode, opt_fn, feeds, k=4, chaos=None, tmp=None):
+    """One cached training run; mode is 'single' (in-process master)
+    or 'sharded' (4 pserver shards).  ``chaos`` (sharded only) is a
+    dict with the fault injector and/or kill-and-restart instruction."""
+    m, scope = _build(opt_fn())
+    exe = fluid.Executor(fluid.CPUPlace())
+    shards = client = None
+    chaos = chaos or {}
+    if mode == 'sharded':
+        cache, client, shards = sharded_cache_from_scope(
+            scope, m['main'], 'ctr_embedding', CAP, ['sparse_ids'],
+            shards=4, checkpoint_root=tmp,
+            fault_injector=chaos.get('fi'),
+            retry=RetryPolicy(seed=0, base_backoff_s=0.02),
+            timeout=chaos.get('timeout', 5.0))
+    else:
+        cache = CachedEmbeddingTable.from_scope(
+            scope, m['main'], 'ctr_embedding', CAP, ['sparse_ids'])
+    replays = 0  # accumulated across killed shards too
+    with fluid.scope_guard(scope):
+        for blk in range(len(feeds) // k):
+            exe.run_multi(m['main'],
+                          feed_list=[dict(f)
+                                     for f in feeds[blk * k:(blk + 1) * k]],
+                          fetch_list=[m['loss']],
+                          embed_caches=[cache])
+            if chaos.get('kill_after_block') == blk:
+                # mid-pass shard crash: quiesce the cache's exchange
+                # pipeline (flush), make the victim durable, kill it,
+                # restore at the SAME port — the client's reconnect
+                # lane picks it up on the next exchange
+                cache.flush()
+                idx = chaos.get('victim', 0)
+                victim = shards[idx]
+                port = victim.port
+                victim.checkpoint(wait=True)
+                victim.kill()
+                replays += victim.dedup_replays
+                shards[idx] = PServerShard.restore(
+                    tmp + '/shard-%05d' % idx, port=port)
+    table = cache.table()
+    aux = {n: cache.table(n) for n in cache.tables[1:]}
+    metrics = cache.metrics()
+    rpc = client.metrics() if client else None
+    replays += sum(s.dedup_replays for s in shards) if shards else 0
+    cache.close()
+    if shards:
+        for s in shards:
+            s.close()
+    return table, aux, metrics, rpc, replays
+
+
+@pytest.mark.parametrize('opt_name', [
+    pytest.param(n, marks=pytest.mark.slow) if n != 'sgd' else n
+    for n in sorted(_OPTS)])
+def test_cached_sharded_parity_cpu(opt_name):
+    """CachedEmbeddingTable over a 4-shard ShardedEmbeddingClient ==
+    the single-process cached run, BITWISE, on weight AND every
+    co-cached accumulator — the slab/staging/writeback machinery rides
+    the sharded master transparently (duplicate-id zipf batches)."""
+    feeds = _feeds(12)
+    t_s, aux_s, m_s, rpc, _ = _train_cpu('sharded', _OPTS[opt_name],
+                                         feeds)
+    t_1, aux_1, m_1, _, _ = _train_cpu('single', _OPTS[opt_name], feeds)
+    np.testing.assert_array_equal(t_s, t_1)
+    assert sorted(aux_s) == sorted(aux_1)
+    for n in aux_s:
+        np.testing.assert_array_equal(aux_s[n], aux_1[n], err_msg=n)
+    # identical exchange traffic: the host tier's LOCATION must not
+    # change what the cache fetches or writes back
+    for key in ('hits', 'misses', 'host_fetch_bytes',
+                'host_writeback_bytes', 'hit_rate'):
+        assert m_s[key] == m_1[key], key
+    assert rpc['shards'][0]['calls'] > 0
+
+
+def test_cached_sharded_parity_mesh():
+    """The same bitwise parity through ParallelExecutor.run_multi on
+    the 8-dev virtual {dp:4, mp:2} mesh — the device half is identical
+    SPMD either way; only the host tier differs."""
+    import jax
+    from paddle_tpu import parallel
+    feeds = _feeds(8, batch=16)
+
+    def train(sharded):
+        m, scope = _build()
+        mesh = parallel.make_mesh({'dp': 4, 'mp': 2}, jax.devices()[:8])
+        shards = None
+        if sharded:
+            cache, client, shards = sharded_cache_from_scope(
+                scope, m['main'], 'ctr_embedding', CAP, ['sparse_ids'],
+                shards=4)
+        else:
+            cache = CachedEmbeddingTable.from_scope(
+                scope, m['main'], 'ctr_embedding', CAP, ['sparse_ids'])
+        pe = fluid.ParallelExecutor(loss_name=m['loss'].name,
+                                    main_program=m['main'], scope=scope,
+                                    mesh=mesh)
+        for blk in range(2):
+            pe.run_multi([m['loss'].name],
+                         feed_list=[dict(f)
+                                    for f in feeds[blk * 4:(blk + 1) * 4]],
+                         embed_caches=[cache])
+        table = cache.table()
+        cache.close()
+        if shards:
+            for s in shards:
+                s.close()
+        return table
+
+    np.testing.assert_array_equal(train(True), train(False))
+
+
+@pytest.mark.parametrize('opt_name', [
+    pytest.param(n, marks=pytest.mark.slow) if n != 'sgd' else n
+    for n in sorted(_OPTS)])
+def test_cached_sharded_chaos_bitwise(opt_name, tmp_path):
+    """The chaos lane (ISSUE 19 satellite): a seeded drop_response on
+    a shard write_rows RPC AND a mid-pass kill-and-restart of shard 0
+    — training finishes BITWISE vs the fault-free single-process
+    master: zero lost writes, zero double-applied writes."""
+    feeds = _feeds(12)
+    fi = FaultInjector(seed=0)
+    fi.script('server_send', 'write_rows', 'drop_response', nth=1)
+    t_s, aux_s, _, rpc, replays = _train_cpu(
+        'sharded', _OPTS[opt_name], feeds, tmp=str(tmp_path),
+        chaos={'fi': fi, 'timeout': 0.75, 'kill_after_block': 0,
+               'victim': 0})
+    t_1, aux_1, _, _, _ = _train_cpu('single', _OPTS[opt_name], feeds)
+    np.testing.assert_array_equal(t_s, t_1)
+    for n in aux_s:
+        np.testing.assert_array_equal(aux_s[n], aux_1[n], err_msg=n)
+    # the faults actually fired and the exactly-once machinery absorbed
+    # them: a replayed response, a counted retry, a counted reconnect
+    assert fi.applied >= 1
+    assert replays >= 1
+    lanes = rpc['shards']
+    assert sum(m['retries'] for m in lanes) >= 1
+    assert sum(m['reconnects'] for m in lanes) >= 1

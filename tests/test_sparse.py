@@ -269,6 +269,11 @@ _OPTIMIZERS = {
     # accumulators); dense-parity asserts restrict to touched rows —
     # see _parity_rows
     'ftrl': lambda: fluid.optimizer.Ftrl(learning_rate=0.1),
+    # ISSUE 19 satellite: the adadelta row-subset kernel (avg-squared-
+    # grad + avg-squared-update accumulators, no LearningRate input);
+    # from fresh state a zero-grad dense step is a no-op
+    # (update = -sqrt(eps/eps)*0), so whole-table parity holds
+    'adadelta': lambda: fluid.optimizer.Adadelta(learning_rate=0.1),
 }
 
 

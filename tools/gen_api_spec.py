@@ -116,7 +116,9 @@ def generate():
     # membership/snapshot doors; ISSUE 15: the resilient transport
     # lane + the fault-injection seam + snapshot replication; ISSUE 17:
     # the transport generalized into a service-agnostic substrate —
-    # the Master* error names are back-compat aliases)
+    # the Master* error names are back-compat aliases; ISSUE 19: the
+    # parameter-server embedding tier — sharded row-range pservers
+    # behind that substrate)
     import paddle_tpu.distributed as distributed
     lines += _walk('paddle_tpu.distributed', distributed, [
         'AsyncSparseEmbedding', 'AsyncSparseClosedError',
@@ -130,6 +132,8 @@ def generate():
         'MasterUnavailableError', 'MasterProtocolError',
         'ServiceUnavailableError', 'ServiceProtocolError',
         'FaultInjector', 'InjectedFault', 'SnapshotReplica',
+        'PServerShard', 'ShardedEmbeddingClient',
+        'shard_row_ranges', 'sharded_cache_from_scope',
     ])
     return sorted(set(lines))
 

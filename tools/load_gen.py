@@ -24,6 +24,14 @@ failover / overload counters and one per-replica block each carrying
 that replica's registry view.  Synthetic forward + generate traffic
 only (``--model-dir`` and ``--ctr-frac`` stay single-registry).
 
+Parameter servers (ISSUE 19): ``--pservers N`` bypasses the serving
+stack and drives the sharded embedding tier directly — ``--requests``
+seeded zipfian id batches (``dataset.ctr.zipf_batch``) fetch + push
+through a ``ShardedEmbeddingClient`` over N row-range ``PServerShard``
+processes; the one-line report carries rows/s, per-shard RPC counters,
+and a hard ``bitwise_parity`` check against an identically-driven
+single-process ``AsyncSparseEmbedding`` master.
+
 Overload retries (ISSUE 15): ``--retry-overloaded`` honors the typed
 ``OverloadedError``'s ``retry_after_s`` hint — ONE seeded re-submit
 per rejected request, fired between arrivals so the offered stream's
@@ -274,6 +282,91 @@ def _run_fleet(args):
     return report
 
 
+def _run_pserver(args):
+    """--pservers N (ISSUE 19): drive the sharded parameter-server
+    embedding tier directly — fetch_rows + push_grad over a
+    ``ShardedEmbeddingClient`` across N row-range ``PServerShard``
+    processes, fed the seeded zipfian id stream
+    (``dataset.ctr.zipf_batch``, the one shared skew construction).
+    The report carries rows/s for the fetch+push loop, the per-shard
+    RPC counters, and ``bitwise_parity`` vs an identically-driven
+    single-process ``AsyncSparseEmbedding`` master — the tier's
+    correctness bar, measured on the way out."""
+    import numpy as np
+    from paddle_tpu.dataset import ctr as ctr_data
+    from paddle_tpu.distributed import (AsyncSparseEmbedding,
+                                        PServerShard,
+                                        ShardedEmbeddingClient,
+                                        shard_row_ranges)
+
+    if args.model_dir or args.ctr_frac > 0 or args.generate_frac > 0 \
+            or args.replicas > 1:
+        raise SystemExit('--pservers drives the embedding tier '
+                         'directly; it does not combine with '
+                         '--model-dir/--ctr-frac/--generate-frac/'
+                         '--replicas')
+    vocab, dim, lr = args.ctr_vocab, 16, 0.05
+    batches = max(args.requests, 1)
+    rng = np.random.RandomState(args.seed)
+    init = np.random.RandomState(args.seed + 1).rand(
+        vocab, dim).astype('float32')
+    feeds = [ctr_data.zipf_batch(rng, args.rows, vocab,
+                                 hot_frac=args.ctr_hot_frac)
+             for _ in range(batches)]
+    grads = [np.random.RandomState(1000 + i).rand(
+        f['sparse_ids'].size, dim).astype('float32')
+        for i, f in enumerate(feeds)]
+
+    shards = [PServerShard({'emb': init[lo:hi]}, row_start=lo, lr=lr)
+              for lo, hi in shard_row_ranges(vocab, args.pservers)]
+    client = ShardedEmbeddingClient([s.endpoint for s in shards])
+    rows_seen = 0
+    t0 = time.time()
+    for f, g in zip(feeds, grads):
+        ids = f['sparse_ids'].ravel()
+        client.fetch_rows(ids)
+        client.push_grad(ids, g)
+        rows_seen += ids.size
+    client.drain()
+    elapsed = max(time.time() - t0, 1e-9)
+    sharded_table = client.table()
+    rpc = client.metrics()
+
+    # the single-process master, identically driven: parity is part
+    # of the report, not a separate test run
+    single = AsyncSparseEmbedding(vocab, dim, lr=lr, table=init)
+    for f, g in zip(feeds, grads):
+        ids = f['sparse_ids'].ravel()
+        single.fetch_rows(ids)
+        single.push_grad(ids, g)
+    single.drain()
+    parity = bool(np.array_equal(sharded_table, single.table()))
+
+    report = {
+        'pservers': args.pservers,
+        'vocab': vocab,
+        'embed_dim': dim,
+        'batches': batches,
+        'rows_per_batch': int(feeds[0]['sparse_ids'].size),
+        'rows_per_sec': round(rows_seen / elapsed, 1),
+        'pushed': rpc['pushed'],
+        'applied': rpc['applied'],
+        'bitwise_parity': parity,
+        'rpc_calls': sum(m['calls'] for m in rpc['shards']),
+        'rpc_retries': sum(m['retries'] for m in rpc['shards']),
+        'rpc_failovers': sum(m['failovers'] for m in rpc['shards']),
+        'shard_rows': [s.metrics()['rows'] for s in shards],
+    }
+    client.close()
+    for s in shards:
+        s.close()
+    single.close()
+    assert parity, ('sharded tier diverged from the single-process '
+                    'master', report)
+    print(json.dumps(report), flush=True)
+    return report
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument('--rate', type=float, default=None,
@@ -327,6 +420,12 @@ def main(argv=None):
                         'model (1 = per-scan-sync baseline)')
     p.add_argument('--models', type=int, default=1,
                    help='number of synthetic models to mix across')
+    p.add_argument('--pservers', type=int, default=0,
+                   help='drive the sharded parameter-server embedding '
+                        'tier (ISSUE 19): fetch+push --requests seeded '
+                        'zipfian batches over N row-range shards and '
+                        'report rows/s, RPC counters, and bitwise '
+                        'parity vs the single-process master')
     p.add_argument('--replicas', type=int, default=1,
                    help='serve through N replica registries behind '
                         'the fleet router (ISSUE 17); the report '
@@ -357,6 +456,8 @@ def main(argv=None):
     import paddle_tpu.fluid as fluid  # noqa: F401 (registers flags)
     from paddle_tpu import serving
 
+    if args.pservers > 0:
+        return _run_pserver(args)
     if args.replicas > 1:
         return _run_fleet(args)
 

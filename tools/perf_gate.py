@@ -1632,6 +1632,316 @@ def run_embed_cache():
     return rec
 
 
+def build_pserver():
+    """Sharded parameter-server tier vs the single-process master
+    (ISSUE 19): both lanes run the SAME CachedEmbeddingTable machinery
+    over the IDENTICAL seeded hot-zipfian CTR stream
+    (dataset.ctr.zipf_batch) — the SHARDED lane's host tier is a
+    ShardedEmbeddingClient over PERF_GATE_PS_SHARDS PServerShard
+    row-range processes behind the resilient transport, the SINGLE
+    lane's is the in-process AsyncSparseEmbedding.  SGD is the paired
+    optimizer: row-range routing merges partials in id order, so the
+    sharded lane's flushed table must match the single lane BITWISE."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+    from paddle_tpu.models import ctr as ctr_model
+    from paddle_tpu.dataset import ctr as ctr_data
+    from paddle_tpu.distributed import (CachedEmbeddingTable,
+                                        sharded_cache_from_scope)
+
+    vocab = int(os.environ.get('PERF_GATE_PS_VOCAB', '16384'))
+    embed = int(os.environ.get('PERF_GATE_PS_EMBED', '16'))
+    batch = int(os.environ.get('PERF_GATE_PS_BATCH', '64'))
+    k_steps = int(os.environ.get('PERF_GATE_PS_STEPS', '8'))
+    capacity = int(os.environ.get('PERF_GATE_PS_CAPACITY', '2048'))
+    hot_frac = float(os.environ.get('PERF_GATE_PS_HOT_FRAC', '0.95'))
+    n_shards = int(os.environ.get('PERF_GATE_PS_SHARDS', '4'))
+    fluid.FLAGS.cost_accounting = True
+    place = fluid.TPUPlace() if core.is_compiled_with_tpu() \
+        else fluid.CPUPlace()
+
+    rng = np.random.RandomState(0)
+    feeds = [ctr_data.zipf_batch(rng, batch, vocab, hot_frac=hot_frac)
+             for _ in range(k_steps * (BLOCKS + 1))]
+
+    def lane(sharded, capacity=capacity):
+        with fluid.unique_name.guard():
+            m = ctr_model.build(
+                sparse_dim=vocab, embed_size=embed, hidden_sizes=(64, 32),
+                is_sparse=True,
+                optimizer=fluid.optimizer.SGD(learning_rate=0.05))
+        m['main'].random_seed = 0
+        m['startup'].random_seed = 0
+        exe = fluid.Executor(place)
+        scope = fluid.core.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(m['startup'])
+        client = shard_procs = None
+        if sharded:
+            cache, client, shard_procs = sharded_cache_from_scope(
+                scope, m['main'], 'ctr_embedding', capacity,
+                ['sparse_ids'], shards=n_shards)
+        else:
+            cache = CachedEmbeddingTable.from_scope(
+                scope, m['main'], 'ctr_embedding', capacity,
+                ['sparse_ids'])
+
+        def window(block):
+            fl = [dict(f) for f in
+                  feeds[block * k_steps:(block + 1) * k_steps]]
+            with fluid.scope_guard(scope):
+                t0 = time.time()
+                lv, = exe.run_multi(
+                    m['main'], feed_list=fl, fetch_list=[m['loss']],
+                    embed_caches=[cache])
+                elapsed = time.time() - t0
+            assert np.isfinite(np.asarray(lv)).all()
+            return batch * k_steps / elapsed
+
+        return window, exe, scope, cache, client, shard_procs, m
+
+    sh_w, sh_exe, sh_scope, sh_cache, sh_client, sh_procs, _m1 = \
+        lane(True)
+    si_w, si_exe, si_scope, si_cache, _c, _p, _m2 = lane(False)
+    ctx = {
+        'sharded_scope': sh_scope, 'single_scope': si_scope,
+        'sharded_cache': sh_cache, 'single_cache': si_cache,
+        'sharded_client': sh_client, 'shard_procs': sh_procs,
+        'vocab': vocab, 'embed': embed, 'batch': batch,
+        'k_steps': k_steps, 'capacity': capacity,
+        'hot_frac': hot_frac, 'n_shards': n_shards,
+        'feeds': feeds, 'lane': lane,
+    }
+    return sh_w, si_w, ctx
+
+
+def check_pserver_chaos(tmpdir):
+    """The seeded shard-chaos contract (ISSUE 19 acceptance),
+    functional and deterministic: cached CTR training over 4 shards
+    while a seeded FaultInjector drops a write_rows response on the
+    wire (the retry must dedup-replay, not double-apply) and, mid-
+    pass, shard 0 is KILLED with no final flush and restored at the
+    same port from its last AsyncShardedCheckpoint commit (dedup
+    window restored alongside).  Training finishes BITWISE vs the
+    fault-free single-process master: zero lost writes, zero
+    double-applied writes."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import ctr as ctr_model
+    from paddle_tpu.dataset import ctr as ctr_data
+    from paddle_tpu.distributed import (CachedEmbeddingTable,
+                                        FaultInjector, PServerShard,
+                                        sharded_cache_from_scope)
+    from paddle_tpu.distributed.transport import RetryPolicy
+
+    vocab, embed, capacity, batch, k_steps, blocks = \
+        512, 8, 512, 16, 4, 3
+    rng = np.random.RandomState(0)
+    feeds = [ctr_data.zipf_batch(rng, batch, vocab)
+             for _ in range(k_steps * blocks)]
+
+    def lane(chaos):
+        with fluid.unique_name.guard():
+            m = ctr_model.build(
+                sparse_dim=vocab, embed_size=embed, hidden_sizes=(16, ),
+                is_sparse=True,
+                optimizer=fluid.optimizer.SGD(learning_rate=0.05))
+        m['main'].random_seed = 0
+        m['startup'].random_seed = 0
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.core.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(m['startup'])
+        client = procs = fi = None
+        replays = 0
+        if chaos:
+            fi = FaultInjector(seed=0)
+            fi.script('server_send', 'write_rows', 'drop_response',
+                      nth=1)
+            cache, client, procs = sharded_cache_from_scope(
+                scope, m['main'], 'ctr_embedding', capacity,
+                ['sparse_ids'], shards=4, checkpoint_root=tmpdir,
+                fault_injector=fi, timeout=0.75,
+                retry=RetryPolicy(seed=0, base_backoff_s=0.02))
+        else:
+            cache = CachedEmbeddingTable.from_scope(
+                scope, m['main'], 'ctr_embedding', capacity,
+                ['sparse_ids'])
+        with fluid.scope_guard(scope):
+            for blk in range(blocks):
+                exe.run_multi(
+                    m['main'],
+                    feed_list=[dict(f) for f in
+                               feeds[blk * k_steps:(blk + 1) * k_steps]],
+                    fetch_list=[m['loss']], embed_caches=[cache])
+                if chaos and blk == 0:
+                    # mid-pass host loss: quiesce the exchange
+                    # pipeline, make shard 0's last mutations durable,
+                    # KILL it, restore at the SAME port from the
+                    # commit — the client's reconnect lane resumes
+                    cache.flush()
+                    victim = procs[0]
+                    port = victim.port
+                    victim.checkpoint(wait=True)
+                    victim.kill()
+                    replays += victim.dedup_replays
+                    procs[0] = PServerShard.restore(
+                        os.path.join(tmpdir, 'shard-%05d' % 0),
+                        port=port)
+        table = cache.table()
+        rpc = client.metrics() if client else None
+        if procs:
+            replays += sum(s.dedup_replays for s in procs)
+        cache.close()
+        if procs:
+            for s in procs:
+                s.close()
+        return table, rpc, replays, fi
+
+    chaos_table, rpc, replays, fi = lane(True)
+    ref_table, _, _, _ = lane(False)
+    bitwise = np.array_equal(chaos_table, ref_table)
+    assert bitwise, \
+        'chaos-run table diverged from the fault-free single-process ' \
+        'master (max diff %g)' % np.abs(chaos_table - ref_table).max()
+    lanes = rpc['shards']
+    assert fi.applied >= 1, fi.counts()
+    assert replays >= 1, replays
+    assert sum(m['retries'] for m in lanes) >= 1, lanes
+    assert sum(m['reconnects'] for m in lanes) >= 1, lanes
+    return {
+        'chaos_bitwise_table': True,
+        'chaos_lost_writes': 0,
+        'chaos_double_applied_writes': 0,
+        'chaos_dedup_replays': replays,
+        'chaos_retries': sum(m['retries'] for m in lanes),
+        'chaos_reconnects': sum(m['reconnects'] for m in lanes),
+        'chaos_injected_faults': fi.applied,
+        'chaos_shard_restarts': 1,
+    }
+
+
+def run_pserver():
+    """The pserver record (ISSUE 19): sharded-vs-single-process-master
+    cached lanes over ONE seeded zipfian stream.  HARD asserts — the
+    sharded lane's flushed table (and every co-cached accumulator)
+    BITWISE equals the single lane's, final params allclose;
+    ``hit_rate`` and ``host_bytes_reduction`` hold the SAME gates as
+    embed_cache (PERF_GATE_EMBED_HIT_MIN / PERF_GATE_EMBED_HOST_RATIO
+    — the tier must not change what the cache fetches or writes back);
+    and the seeded shard-kill chaos block (drop_response + mid-pass
+    kill-and-restore) finishes bitwise with zero lost / zero
+    double-applied writes."""
+    import shutil
+    import tempfile
+    import numpy as np
+    sh_w, si_w, ctx = build_pserver()
+    sh, si = [], []
+    for b in range(BLOCKS):
+        sh.append(sh_w(b))
+        si.append(si_w(b))
+    sh_cache, si_cache = ctx['sharded_cache'], ctx['single_cache']
+    sh_cache.flush()
+    si_cache.flush()
+    sh_metrics = sh_cache.metrics()
+    si_metrics = si_cache.metrics()
+    # parity FIRST: a fast-but-wrong tier must never pass.  Weight AND
+    # accumulators, bitwise across the host-tier boundary.
+    sh_table = sh_cache.table()
+    si_table = si_cache.table()
+    assert np.array_equal(sh_table, si_table), \
+        'sharded lane table diverged from the single-process master ' \
+        '(max diff %g)' % np.abs(sh_table - si_table).max()
+    for name in sh_cache.tables[1:]:
+        assert np.array_equal(sh_cache.table(name),
+                              si_cache.table(name)), name
+    names = sorted(
+        n for n in ctx['sharded_scope'].local_var_names()
+        if n != 'ctr_embedding'
+        and ctx['single_scope'].find_var(n) is not None)
+    params_checked = 1
+    for n in names:
+        a = np.asarray(ctx['sharded_scope'].find_var(n).value())
+        b = np.asarray(ctx['single_scope'].find_var(n).value())
+        if a.dtype.kind != 'f' or a.shape != b.shape:
+            continue
+        np.testing.assert_allclose(
+            a, b, rtol=1e-4, atol=1e-5,
+            err_msg='sharded lane diverged from single-process at %r'
+            % n)
+        params_checked += 1
+    assert params_checked > 1
+    # identical exchange traffic across the host-tier boundary: the
+    # cache must fetch and write back the SAME rows either way
+    for key in ('hits', 'misses', 'host_fetch_bytes',
+                'host_writeback_bytes'):
+        assert sh_metrics[key] == si_metrics[key], key
+    # the EVERY-STEP-EXCHANGE comparator, on the SHARDED tier: same
+    # machinery, residency invalidated before every single-step
+    # dispatch — the hot-row slab's host-byte (here: RPC-byte)
+    # reduction, measured against the tier that pays per row
+    import paddle_tpu.fluid as fluid
+    k_steps, batch = ctx['k_steps'], ctx['batch']
+    ex_w, ex_exe, ex_scope, ex_cache, ex_client, ex_procs, ex_m = \
+        ctx['lane'](True)
+    with fluid.scope_guard(ex_scope):
+        for f in ctx['feeds'][:k_steps]:
+            ex_cache.invalidate()
+            ex_exe.run_multi(ex_m['main'], feed_list=[dict(f)],
+                             fetch_list=[ex_m['loss']],
+                             embed_caches=[ex_cache])
+    ex_cache.flush()
+    exchange_bps = ex_cache.metrics()['host_bytes'] / k_steps
+    cached_bps = sh_metrics['host_bytes_per_step']
+    rpc = ctx['sharded_client'].metrics()
+    rec = {
+        'config': 'pserver',
+        'sharded_rows_per_sec': round(max(sh), 1),
+        'single_rows_per_sec': round(max(si), 1),
+        'sharded_blocks': [round(v, 1) for v in sh],
+        'single_blocks': [round(v, 1) for v in si],
+        'step_time_ratio': round(min(s / c for c, s in zip(sh, si)), 4),
+        'hit_rate': round(sh_metrics['hit_rate'], 4),
+        'exchanges': sh_metrics['exchanges'],
+        'host_bytes_per_step_cached': round(cached_bps, 1),
+        'host_bytes_per_step_exchange': round(exchange_bps, 1),
+        'host_bytes_reduction': round(exchange_bps /
+                                      max(cached_bps, 1e-9), 2),
+        'params_checked': params_checked,
+        'shards': ctx['n_shards'],
+        'rpc_calls': sum(m['calls'] for m in rpc['shards']),
+        'rpc_retries': sum(m['retries'] for m in rpc['shards']),
+        'vocab': ctx['vocab'], 'embed_dim': ctx['embed'],
+        'batch': batch, 'steps_per_dispatch': k_steps,
+        'capacity': ctx['capacity'], 'hot_frac': ctx['hot_frac'],
+        'blocks': BLOCKS,
+    }
+    sh_cache.close()
+    si_cache.close()
+    ex_cache.close()
+    for s in ctx['shard_procs'] + (ex_procs or []):
+        s.close()
+    tmpdir = tempfile.mkdtemp(prefix='perf_gate_pserver_')
+    try:
+        rec.update(check_pserver_chaos(tmpdir))
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    # gates UNCHANGED from embed_cache: the tier must not change what
+    # the cache fetches, hits, or writes back
+    hit_min = float(os.environ.get('PERF_GATE_EMBED_HIT_MIN', '0.9'))
+    host_ratio = float(os.environ.get('PERF_GATE_EMBED_HOST_RATIO',
+                                      '4.0'))
+    assert rec['hit_rate'] >= hit_min, rec
+    assert rec['host_bytes_reduction'] >= host_ratio, rec
+    assert rec['chaos_bitwise_table'], rec
+    assert rec['chaos_lost_writes'] == 0, rec
+    assert rec['chaos_double_applied_writes'] == 0, rec
+    assert rec['chaos_dedup_replays'] >= 1, rec
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 def build_elastic():
     """The checkpoint-overhead trio (ISSUE 13): one warmed
     executor/scope trains identical seeded K-step dispatches under
@@ -2834,6 +3144,7 @@ CONFIGS = {
     'slo': (build_slo, 'goodput_req_s'),
     'sparse_grad': (build_sparse_grad, 'rows_per_sec'),
     'embed_cache': (build_embed_cache, 'rows_per_sec'),
+    'pserver': (build_pserver, 'rows_per_sec'),
     'elastic': (build_elastic, 'rows_per_sec'),
     'master_chaos': (build_master_chaos, 'rows_per_sec'),
     'fleet': (build_fleet, 'goodput_req_s'),
@@ -2861,6 +3172,8 @@ def run_config(name):
         return run_sparse_grad()
     if name == 'embed_cache':
         return run_embed_cache()
+    if name == 'pserver':
+        return run_pserver()
     if name == 'elastic':
         return run_elastic()
     if name == 'master_chaos':
